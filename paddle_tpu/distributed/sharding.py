@@ -1,0 +1,29 @@
+"""group_sharded_parallel API (ref: python/paddle/distributed/sharding/group_sharded.py
+wrapping GroupShardedStage2/3 + GroupShardedOptimizerStage2).
+
+TPU-native: ZeRO is a sharding-rule decision, not a hook pipeline.  This returns the
+model/optimizer unchanged but records the requested stage; ShardedTrainStep reads it
+and shards optimizer state (stage 1/2) or parameters too (stage 3) over the
+'sharding' mesh axis — XLA emits the reduce-scatter/all-gather the reference's
+GroupSharded hooks performed manually.
+"""
+from __future__ import annotations
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False):
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}.get(level, 2)
+    model._group_sharded_stage = stage
+    optimizer._group_sharded_stage = stage
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ..framework.io import save
+
+    save(model.state_dict(), output + ".pdmodel.state")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

@@ -1,0 +1,167 @@
+"""paddle.distribution parity (ref: python/paddle/distribution/)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, apply_op, _unwrap
+from ..framework import random as _random
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..tensor.math import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(jnp.asarray(loc, jnp.float32))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(scale, jnp.float32))
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        eps = jax.random.normal(_random.get_rng_key(), shape, jnp.float32)
+        return Tensor(eps * self.scale._value + self.loc._value)
+
+    def log_prob(self, value):
+        def _f(v, loc, scale):
+            var = scale * scale
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) - 0.5 * math.log(2 * math.pi)
+
+        return apply_op(_f, (value, self.loc, self.scale), name="normal_log_prob")
+
+    def entropy(self):
+        def _f(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale) + jnp.zeros(self._batch_shape)
+
+        return apply_op(_f, (self.scale,), name="normal_entropy")
+
+    def kl_divergence(self, other):
+        def _f(l1, s1, l2, s2):
+            vr = (s1 / s2) ** 2
+            t1 = ((l1 - l2) / s2) ** 2
+            return 0.5 * (vr + t1 - 1 - jnp.log(vr))
+
+        return apply_op(_f, (self.loc, self.scale, other.loc, other.scale), name="normal_kl")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = low if isinstance(low, Tensor) else Tensor(jnp.asarray(low, jnp.float32))
+        self.high = high if isinstance(high, Tensor) else Tensor(jnp.asarray(high, jnp.float32))
+        super().__init__(tuple(np.broadcast_shapes(self.low.shape, self.high.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        u = jax.random.uniform(_random.get_rng_key(), shape, jnp.float32)
+        return Tensor(u * (self.high._value - self.low._value) + self.low._value)
+
+    def log_prob(self, value):
+        def _f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return apply_op(_f, (value, self.low, self.high), name="uniform_log_prob")
+
+    def entropy(self):
+        return apply_op(lambda lo, hi: jnp.log(hi - lo), (self.low, self.high), name="uniform_entropy")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = logits if isinstance(logits, Tensor) else Tensor(jnp.asarray(logits, jnp.float32))
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        n = int(np.prod(shape)) if shape else 1
+        out = jax.random.categorical(_random.get_rng_key(), self.logits._value,
+                                     shape=tuple(shape) + tuple(self._batch_shape))
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        def _f(logits, v):
+            logp = jax.nn.log_softmax(logits, -1)
+            return jnp.take_along_axis(logp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+
+        return apply_op(_f, (self.logits, value), name="categorical_log_prob")
+
+    def probs(self, value=None):
+        from ..nn.functional import softmax
+
+        p = softmax(self.logits, axis=-1)
+        if value is None:
+            return p
+        from ..tensor.manipulation import take_along_axis
+
+        return take_along_axis(p, value.unsqueeze(-1), -1).squeeze(-1)
+
+    def entropy(self):
+        def _f(logits):
+            logp = jax.nn.log_softmax(logits, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+
+        return apply_op(_f, (self.logits,), name="categorical_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = probs if isinstance(probs, Tensor) else Tensor(jnp.asarray(probs, jnp.float32))
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        out = jax.random.bernoulli(_random.get_rng_key(), self.probs_._value,
+                                   tuple(shape) + tuple(self._batch_shape))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def _f(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply_op(_f, (self.probs_, value), name="bernoulli_log_prob")
+
+
+def kl_divergence(p, q):
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        return p.kl_divergence(q)
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        def _f(lp, lq):
+            a = jax.nn.log_softmax(lp, -1)
+            b = jax.nn.log_softmax(lq, -1)
+            return jnp.sum(jnp.exp(a) * (a - b), -1)
+
+        return apply_op(_f, (p.logits, q.logits), name="categorical_kl")
+    raise NotImplementedError(f"kl({type(p).__name__},{type(q).__name__})")

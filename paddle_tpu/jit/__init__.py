@@ -1,0 +1,118 @@
+"""paddle.jit parity (ref: python/paddle/jit/__init__.py:23 — to_static/save/load)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from .to_static import to_static, declarative, not_to_static, StaticFunction  # noqa: F401
+from .train_step import TrainStep  # noqa: F401
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+
+class InputSpec:
+    """Ref: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save parity (ref fluid/dygraph/jit.py:649).
+
+    Persists (a) the state_dict as .pdiparams and (b) an AOT-exported StableHLO
+    program as .pdmodel when input_spec is given (jax.export replaces the reference's
+    serialized inference ProgramDesc).
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
+    if isinstance(layer, Layer):
+        for k, v in layer.state_dict().items():
+            state[k] = np.asarray(v._value)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+
+    if input_spec is not None and isinstance(layer, Layer):
+        from jax import export as jax_export
+
+        was_training = layer.training
+        layer.eval()
+        try:
+            params, buffers = layer.functional_state()
+
+            def infer_fn(params, buffers, *xs):
+                restore = layer.bind_functional_state(params, buffers)
+                try:
+                    outs = layer(*[Tensor(x) for x in xs])
+                finally:
+                    restore()
+                if isinstance(outs, (tuple, list)):
+                    return tuple(o._value for o in outs)
+                return outs._value
+
+            shapes = [jax.ShapeDtypeStruct(tuple(s.shape), np.dtype(s.dtype) if isinstance(s.dtype, str) else s.dtype)
+                      for s in input_spec]
+            exported = jax_export.export(jax.jit(infer_fn))(params, buffers, *shapes)
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(exported.serialize())
+        except Exception as e:  # platform may not support export; params remain usable
+            with open(path + ".pdmodel.err", "w") as f:
+                f.write(repr(e))
+        finally:
+            if was_training:
+                layer.train()
+
+
+class TranslatedLayer(Layer):
+    """Ref: fluid/dygraph/io.py TranslatedLayer — a loaded inference program."""
+
+    def __init__(self, exported, state):
+        super().__init__()
+        self._exported = exported
+        self._state = state
+
+    def forward(self, *args):
+        params = {k: v for k, v in self._state.items()}
+        raw = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+        out = self._exported.call(params["__params__"], params["__buffers__"], *raw)
+        if isinstance(out, (tuple, list)):
+            outs = tuple(Tensor(o) for o in out)
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
+
+
+def load(path, **configs):
+    """jit.load parity (ref fluid/dygraph/jit.py:1069)."""
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    model_file = path + ".pdmodel"
+    if os.path.exists(model_file):
+        from jax import export as jax_export
+
+        with open(model_file, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        # reconstruct params/buffers trees the exported fn expects
+        t = TranslatedLayer(exported, {"__params__": {}, "__buffers__": {}})
+        # state keys were flattened from named_parameters/buffers; the exported call
+        # closure needs exactly the same pytree: rebuild both dicts
+        t._state["__params__"] = {k: v for k, v in state.items()}
+        t._state["__buffers__"] = {}
+        return t
+    raise FileNotFoundError(f"no serialized program at {model_file}; "
+                            f"load params with paddle.load({path + '.pdiparams'!r}) instead")
+
+
+def enable_to_static(flag: bool = True):
+    global _to_static_enabled
+    _to_static_enabled = flag
+
+
+_to_static_enabled = True

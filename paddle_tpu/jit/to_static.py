@@ -1,0 +1,230 @@
+"""@to_static: compile a dygraph function/Layer into one XLA program.
+
+Reference analog: dy2static (`python/paddle/fluid/dygraph/dygraph_to_static/` —
+`program_translator.py:239` StaticFunction, `partial_program.py:363` run_program) which
+AST-transforms Python into a ProgramDesc and runs it via `run_program_op` with CINN as
+the optional compiler (`paddle/fluid/framework/paddle2cinn/`).
+
+TPU-native design: no AST surgery.  The dygraph code *is* traceable because every op is
+a pure JAX call — `to_static` builds a pure function over (params, buffers, rng_key,
+*args), `jax.jit`s it, and routes calls through the autograd tape via `jax.vjp` of the
+jitted function, so `loss.backward()` runs a single compiled backward program.  Python
+control flow is baked at trace time (same as the reference's static path); for traced
+control flow users write lax.cond/scan via paddle_tpu.static.nn.cond/while_loop.
+
+Buffer mutation (BN running stats) is captured functionally: the traced function
+returns updated buffer values as auxiliary outputs, written back after each call.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, Parameter, apply_op
+from ..autograd import tape
+from ..framework import random as _random
+from ..nn.layer.layers import Layer
+
+
+def _tree_flatten_args(args, kwargs):
+    """Split (args, kwargs) into (tensor_leaves, rebuild_fn, static_signature)."""
+    leaves = []
+    sig = []
+
+    def go(x):
+        if isinstance(x, Tensor):
+            leaves.append(x)
+            sig.append(("T", tuple(x._value.shape), str(x._value.dtype)))
+            return ("__leaf__", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(go(i) for i in x)
+        if isinstance(x, dict):
+            return {k: go(v) for k, v in x.items()}
+        sig.append(("S", repr(x)))
+        return x
+
+    skeleton = (go(list(args)), go(dict(kwargs)))
+
+    def rebuild(raw_leaves, wrap):
+        def back(x):
+            if isinstance(x, tuple) and len(x) == 2 and x[0] == "__leaf__":
+                return wrap(raw_leaves[x[1]])
+            if isinstance(x, (list, tuple)) and not (len(x) == 2 and x[0] == "__leaf__"):
+                return type(x)(back(i) for i in x)
+            if isinstance(x, dict):
+                return {k: back(v) for k, v in x.items()}
+            return x
+
+        a, k = back(skeleton[0]), back(skeleton[1])
+        return a, k
+
+    return leaves, rebuild, tuple(sig)
+
+
+class StaticFunction:
+    """Ref: program_translator.py:239 StaticFunction."""
+
+    def __init__(self, function, input_spec=None, build_strategy=None, layer=None, backend=None):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: dict[Any, Any] = {}
+        self.__name__ = getattr(function, "__name__", "static_fn")
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    def _get_layer(self, args):
+        if self._layer is not None:
+            return self._layer, args
+        if args and isinstance(args[0], Layer):
+            return args[0], args[1:]
+        return None, args
+
+    def _build(self, layer, training, n_leaves, rebuild, out_template):
+        fn = self._function
+
+        def pure_fn(param_vals, buffer_vals, key, leaf_vals):
+            with _random.rng_key_scope(key):
+                restore = (layer.bind_functional_state(param_vals, buffer_vals)
+                           if layer is not None else (lambda: None))
+                try:
+                    a, k = rebuild(leaf_vals, lambda raw: Tensor(raw, stop_gradient=True))
+                    # inputs participate in grad: mark diff leaves non-stop so the
+                    # inner tape links them (outer vjp supplies actual cotangents)
+                    with tape.enable_grad():
+                        if layer is not None and self._layer is None:
+                            out = fn(layer, *a, **k)
+                        else:
+                            out = fn(*a, **k)
+                    out_leaves, out_rebuild = _flatten_output(out)
+                    new_buffers = ({kk: b._value for kk, b in layer.named_buffers()}
+                                   if layer is not None else {})
+                    out_template.append(out_rebuild)
+                finally:
+                    restore()
+                return tuple(o._value if isinstance(o, Tensor) else o for o in out_leaves), new_buffers
+
+        return jax.jit(pure_fn)
+
+    def __call__(self, *args, **kwargs):
+        layer, fargs = self._get_layer(args)
+        leaves, rebuild, sig = _tree_flatten_args(fargs, kwargs)
+        training = layer.training if layer is not None else False
+        key = (training, sig)
+        entry = self._cache.get(key)
+        if entry is None:
+            out_template: list = []
+            jitted = self._build(layer, training, len(leaves), rebuild, out_template)
+            entry = {"jitted": jitted, "template": out_template}
+            self._cache[key] = entry
+        jitted = entry["jitted"]
+
+        if layer is not None:
+            param_items = list(layer.named_parameters())
+            buffer_items = list(layer.named_buffers())
+        else:
+            param_items, buffer_items = [], []
+        param_tensors = [p for _, p in param_items]
+        buffer_vals = {k: b._value for k, b in buffer_items}
+        rng = _random.get_rng_key()
+
+        def closed(*flat):
+            pvals = {k: v for (k, _), v in zip(param_items, flat[: len(param_items)])}
+            lvals = list(flat[len(param_items):])
+            outs, new_bufs = jitted(pvals, buffer_vals, rng, lvals)
+            return (*outs, *[new_bufs[k] for k, _ in buffer_items])
+
+        all_inputs = (*param_tensors, *leaves)
+        result = apply_op(closed, all_inputs, name=f"to_static:{self.__name__}")
+        result = result if isinstance(result, tuple) else (result,)
+        n_buf = len(buffer_items)
+        out_leaves = result[: len(result) - n_buf]
+        # write updated buffers back (BN running stats etc.)
+        for (k, b), new in zip(buffer_items, result[len(result) - n_buf:]):
+            b.set_value(new._value)
+        out_rebuild = entry["template"][0] if entry["template"] else None
+        if out_rebuild is None:
+            return out_leaves[0] if len(out_leaves) == 1 else out_leaves
+        return out_rebuild(list(out_leaves))
+
+    @property
+    def code(self):
+        import inspect
+
+        try:
+            return inspect.getsource(self._function)
+        except Exception:
+            return "<source unavailable>"
+
+    def concrete_program(self):
+        return None
+
+    def get_lowered(self, *args, **kwargs):
+        """Return the jax lowering (StableHLO) for inspection/AOT export."""
+        layer, fargs = self._get_layer(args)
+        leaves, rebuild, sig = _tree_flatten_args(fargs, kwargs)
+        raise NotImplementedError
+
+
+def _flatten_output(out):
+    leaves = []
+
+    def go(x):
+        if isinstance(x, Tensor):
+            leaves.append(x)
+            return ("__leaf__", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(go(i) for i in x)
+        if isinstance(x, dict):
+            return {k: go(v) for k, v in x.items()}
+        return x
+
+    skeleton = go(out)
+
+    def rebuild(ts):
+        def back(x):
+            if isinstance(x, tuple) and len(x) == 2 and x[0] == "__leaf__":
+                return ts[x[1]]
+            if isinstance(x, (list, tuple)) and not (len(x) == 2 and x[0] == "__leaf__"):
+                return type(x)(back(i) for i in x)
+            if isinstance(x, dict):
+                return {k: back(v) for k, v in x.items()}
+            return x
+
+        return back(skeleton)
+
+    return leaves, rebuild
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """@paddle.jit.to_static parity (ref fluid/dygraph/jit.py:163 declarative)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            sf = StaticFunction(fn.forward, input_spec, build_strategy, layer=fn)
+            fn.forward = sf
+            return fn
+        return StaticFunction(fn, input_spec, build_strategy)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    return fn
+
+
+class ignore_module:
+    def __init__(self, modules):
+        pass

@@ -1,0 +1,3 @@
+"""Pallas TPU kernels + hand-rolled distributed primitives (flash attention, ring
+attention, MoE dispatch) — the few ops where XLA's automatic lowering leaves MXU/HBM
+performance on the table (see /opt/skills/guides/pallas_guide.md)."""

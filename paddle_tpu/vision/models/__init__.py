@@ -1,0 +1,23 @@
+"""vision model zoo (ref: python/paddle/vision/models/__init__.py)."""
+from .lenet import LeNet  # noqa: F401
+
+# resnet / vgg / mobilenet / vit land as they are built; import lazily to keep import light
+def __getattr__(name):
+    if name in ("ResNet", "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+                "wide_resnet50_2", "wide_resnet101_2"):
+        from . import resnet
+
+        return getattr(resnet, name)
+    if name in ("VGG", "vgg11", "vgg13", "vgg16", "vgg19"):
+        from . import vgg
+
+        return getattr(vgg, name)
+    if name in ("MobileNetV2", "mobilenet_v2", "MobileNetV3Small", "MobileNetV3Large"):
+        from . import mobilenet
+
+        return getattr(mobilenet, name)
+    if name in ("VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16"):
+        from . import vit
+
+        return getattr(vit, name)
+    raise AttributeError(name)

@@ -1,0 +1,17 @@
+"""Test harness config: force an 8-device virtual CPU mesh (SURVEY.md §4 takeaway (2):
+the reference simulates multi-node by multi-process-on-localhost; here SPMD sharding is
+validated on host devices the same way the driver's dryrun does).
+
+NOTE: the axon TPU plugin force-appends itself to jax_platforms, so the env var alone
+is not enough — jax.config.update wins.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,183 @@
+"""Autograd tests: tape vs jax.grad oracle (the reference checks analytic vs
+finite-difference in OpTest.check_grad; jax.grad is a stronger oracle)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.autograd import PyLayer
+
+
+def t(a, sg=False):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestBackward:
+    def test_chain(self):
+        x = t(np.random.rand(3, 4))
+        y = paddle.tanh(paddle.matmul(x, x.T))
+        loss = y.sum()
+        loss.backward()
+        ref = jax.grad(lambda v: jnp.sum(jnp.tanh(v @ v.T)))(x._value)
+        assert np.allclose(x.grad.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+    def test_accumulation(self):
+        x = t(np.ones(3))
+        (x * 2).sum().backward()
+        (x * 3).sum().backward()
+        assert np.allclose(x.grad.numpy(), [5, 5, 5])
+        x.clear_grad()
+        assert x.grad is None
+
+    def test_stop_gradient(self):
+        x = t(np.ones(3))
+        y = t(np.ones(3), sg=True)
+        (x * y).sum().backward()
+        assert x.grad is not None and y._grad is None
+
+    def test_detach(self):
+        x = t(np.ones(3))
+        d = x.detach()
+        assert d.stop_gradient
+        (d * 2).sum()  # no tape recorded
+
+    def test_branching(self):
+        x = t(np.random.rand(4))
+        a = x * 2
+        b = a + 1
+        c = a * 3
+        (b.sum() + c.sum()).backward()
+        assert np.allclose(x.grad.numpy(), np.full(4, 2 + 6.0))
+
+    def test_grad_api(self):
+        x = t(np.random.rand(3))
+        y = (x**2).sum()
+        (gx,) = paddle.grad(y, x)
+        assert np.allclose(gx.numpy(), 2 * x.numpy(), rtol=1e-6)
+        assert x._grad is None  # paddle.grad must not pollute .grad
+
+    def test_grad_intermediate(self):
+        x = t(np.random.rand(3))
+        h = x * 2
+        z = (h**2).sum()
+        (gh,) = paddle.grad(z, h)
+        assert np.allclose(gh.numpy(), 2 * h.numpy(), rtol=1e-6)
+
+    def test_retain_graph(self):
+        x = t(np.random.rand(3))
+        y = (x * 3).sum()
+        y.backward(retain_graph=True)
+        y.backward()
+        assert np.allclose(x.grad.numpy(), np.full(3, 6.0))
+
+    def test_double_backward_raises(self):
+        x = t(np.random.rand(3))
+        y = (x * 3).sum()
+        y.backward()
+        with pytest.raises(RuntimeError):
+            y.backward()
+
+    def test_no_grad(self):
+        x = t(np.ones(3))
+        with paddle.no_grad():
+            y = x * 2
+        assert y.stop_gradient and y._node is None
+
+    def test_multi_output_partial(self):
+        x = t(np.random.rand(3, 5))
+        vals, idx = paddle.topk(x, 2, axis=1)
+        vals.sum().backward()  # idx gets no cotangent -> float0 fill path
+        assert x.grad is not None
+        assert np.isclose(x.grad.numpy().sum(), 6.0)
+
+    def test_hooks(self):
+        x = t(np.ones(3))
+        seen = []
+        h = x.register_hook(lambda g: seen.append(g.shape) or g * 2)
+        (x * 1.0).sum().backward()
+        assert seen and np.allclose(x.grad.numpy(), [2, 2, 2])
+        h.remove()
+
+    def test_backward_with_grad_tensor(self):
+        x = t(np.ones(3))
+        y = x * 2
+        y.backward(paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+        assert np.allclose(x.grad.numpy(), [2, 4, 6])
+
+
+class TestHigherOrder:
+    def test_double_backward(self):
+        x = t(np.array([3.0]))
+        (g,) = paddle.grad(x * x * x, x, create_graph=True, retain_graph=True)
+        assert np.isclose(g.numpy()[0], 27.0)
+        (g2,) = paddle.grad(g, x)
+        assert np.isclose(g2.numpy()[0], 18.0)
+
+    def test_triple_backward(self):
+        x = t(np.array([2.0]))
+        (g1,) = paddle.grad(x**4, x, create_graph=True)
+        (g2,) = paddle.grad(g1, x, create_graph=True)
+        (g3,) = paddle.grad(g2, x)
+        assert np.isclose(g3.numpy()[0], 48.0)
+
+    def test_grad_penalty_pattern(self):
+        # WGAN-GP style: loss includes ||dL/dx||^2
+        w = paddle.Parameter(np.array([[2.0]], np.float32))
+        x = t(np.array([[3.0]]))
+        y = paddle.matmul(x, w).sum()
+        (gx,) = paddle.grad(y, x, create_graph=True)
+        penalty = (gx**2).sum()
+        penalty.backward()
+        # d/dw of w^2 = 2w = 4
+        assert np.isclose(w.grad.numpy()[0, 0], 4.0)
+
+
+class TestPyLayer:
+    def test_custom(self):
+        class Cube(PyLayer):
+            @staticmethod
+            def forward(ctx, x):
+                ctx.save_for_backward(x)
+                return x * x * x
+
+            @staticmethod
+            def backward(ctx, grad):
+                (x,) = ctx.saved_tensor()
+                return grad * 3 * x * x
+
+        x = t(np.array([2.0]))
+        y = Cube.apply(x)
+        y.backward()
+        assert np.allclose(x.grad.numpy(), [12.0])
+
+
+class TestLayerGrads:
+    def test_linear_grads_match_jax(self):
+        import paddle_tpu.nn as nn
+
+        paddle.seed(0)
+        lin = nn.Linear(4, 3)
+        x = t(np.random.rand(2, 4))
+        loss = paddle.mean(lin(x) ** 2)
+        loss.backward()
+
+        W, b = lin.weight._value, lin.bias._value
+
+        def f(W, b, xv):
+            return jnp.mean((xv @ W + b) ** 2)
+
+        gW, gb = jax.grad(f, argnums=(0, 1))(W, b, x._value)
+        assert np.allclose(lin.weight.grad.numpy(), gW, rtol=1e-5, atol=1e-6)
+        assert np.allclose(lin.bias.grad.numpy(), gb, rtol=1e-5, atol=1e-6)
+
+    def test_conv_bn_grads_finite(self):
+        import paddle_tpu.nn as nn
+
+        net = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.BatchNorm2D(2), nn.ReLU())
+        x = t(np.random.rand(2, 1, 8, 8))
+        y = net(x)
+        y.mean().backward()
+        for p in net.parameters():
+            assert p.grad is not None
+            assert np.isfinite(p.grad.numpy()).all()

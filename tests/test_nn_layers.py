@@ -1,0 +1,242 @@
+"""Layer tests (ref model: API/dygraph tests vs numpy, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a, np.float32), stop_gradient=sg)
+
+
+class TestCommon:
+    def test_linear(self):
+        lin = nn.Linear(4, 3)
+        x = t(np.random.rand(5, 4))
+        assert lin(x).shape == [5, 3]
+        ref = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        assert np.allclose(lin(x).numpy(), ref, rtol=1e-5)
+
+    def test_embedding(self):
+        emb = nn.Embedding(10, 6)
+        idx = paddle.to_tensor(np.array([[1, 2], [3, 4]]))
+        out = emb(idx)
+        assert out.shape == [2, 2, 6]
+        assert np.allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_dropout_modes(self):
+        d = nn.Dropout(0.5)
+        x = t(np.ones((100, 100)))
+        d.train()
+        y = d(x)
+        frac = (y.numpy() == 0).mean()
+        assert 0.3 < frac < 0.7
+        kept = y.numpy()[y.numpy() != 0]
+        assert np.allclose(kept, 2.0)  # upscale_in_train
+        d.eval()
+        assert np.allclose(d(x).numpy(), 1.0)
+
+    def test_flatten_sequential(self):
+        net = nn.Sequential(nn.Flatten(), nn.Linear(12, 5))
+        x = t(np.random.rand(2, 3, 4))
+        assert net(x).shape == [2, 5]
+
+
+class TestConvPool:
+    def test_conv2d_shape_and_value(self):
+        conv = nn.Conv2D(2, 4, 3, padding=1)
+        x = t(np.random.rand(1, 2, 8, 8))
+        assert conv(x).shape == [1, 4, 8, 8]
+        # numeric check vs manual correlation for a single pixel
+        conv2 = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+        xx = np.random.rand(1, 1, 5, 5).astype(np.float32)
+        out = conv2(t(xx)).numpy()
+        w = conv2.weight.numpy()[0, 0]
+        ref = sum(xx[0, 0, i:i + 3, j:j + 3].ravel() @ w.ravel()
+                  for i in [0] for j in [0])
+        assert np.allclose(out[0, 0, 0, 0], ref, rtol=1e-4)
+
+    def test_groups_and_stride(self):
+        conv = nn.Conv2D(4, 8, 3, stride=2, padding=1, groups=2)
+        x = t(np.random.rand(2, 4, 16, 16))
+        assert conv(x).shape == [2, 8, 8, 8]
+
+    def test_conv_transpose(self):
+        convt = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+        x = t(np.random.rand(1, 3, 8, 8))
+        assert convt(x).shape == [1, 2, 16, 16]
+
+    def test_pools(self):
+        x = t(np.random.rand(1, 2, 8, 8))
+        assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+        assert np.allclose(
+            nn.AdaptiveAvgPool2D(1)(x).numpy()[0, 0, 0, 0], x.numpy()[0, 0].mean(), rtol=1e-5
+        )
+        mx = nn.MaxPool2D(2, 2)(x).numpy()
+        assert np.allclose(mx[0, 0, 0, 0], x.numpy()[0, 0, :2, :2].max())
+
+
+class TestNorms:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(3)
+        x = t(np.random.rand(4, 3, 5, 5) * 3 + 1)
+        bn.train()
+        y = bn(x)
+        assert abs(float(y.numpy().mean())) < 1e-4
+        assert abs(float(y.numpy().std()) - 1) < 1e-2
+        m0 = bn._mean.numpy().copy()
+        bn(x)
+        assert not np.allclose(bn._mean.numpy(), m0)  # running stats updated
+        bn.eval()
+        y2 = bn(x)
+        assert y2.shape == [4, 3, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(8)
+        x = t(np.random.rand(2, 4, 8) * 5)
+        y = ln(x).numpy()
+        assert np.allclose(y.mean(-1), 0, atol=1e-5)
+        assert np.allclose(y.std(-1), 1, atol=1e-2)
+
+    def test_groupnorm_instancenorm(self):
+        x = t(np.random.rand(2, 4, 6, 6))
+        assert nn.GroupNorm(2, 4)(x).shape == [2, 4, 6, 6]
+        assert nn.InstanceNorm2D(4)(x).shape == [2, 4, 6, 6]
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = t(np.random.rand(2, 8))
+        y = rn(x).numpy()
+        ms = (x.numpy() ** 2).mean(-1, keepdims=True)
+        assert np.allclose(y, x.numpy() / np.sqrt(ms + 1e-6), rtol=1e-4)
+
+
+class TestActivationsLosses:
+    def test_activations(self):
+        x = t(np.array([-2.0, -0.5, 0.0, 0.5, 2.0]))
+        assert np.allclose(F.relu(x).numpy(), [0, 0, 0, 0.5, 2])
+        assert np.allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+        sm = F.softmax(x).numpy()
+        assert np.isclose(sm.sum(), 1.0)
+        import math
+
+        erf = np.vectorize(math.erf)
+        ref = 0.5 * x.numpy() * (1 + erf(x.numpy() / np.sqrt(2)))
+        assert np.allclose(F.gelu(x).numpy(), ref, rtol=1e-4, atol=1e-5)
+
+    def test_cross_entropy(self):
+        logits = t(np.random.rand(4, 10))
+        labels = paddle.to_tensor(np.array([1, 3, 5, 7]))
+        loss = F.cross_entropy(logits, labels)
+        logp = np.log(np.exp(logits.numpy()) / np.exp(logits.numpy()).sum(-1, keepdims=True))
+        ref = -logp[np.arange(4), [1, 3, 5, 7]].mean()
+        assert np.isclose(loss.item(), ref, rtol=1e-5)
+
+    def test_cross_entropy_soft_and_smooth(self):
+        logits = t(np.random.rand(4, 10))
+        soft = np.random.rand(4, 10).astype(np.float32)
+        soft /= soft.sum(-1, keepdims=True)
+        l1 = F.cross_entropy(logits, t(soft), soft_label=True)
+        assert l1.item() > 0
+        labels = paddle.to_tensor(np.array([1, 3, 5, 7]))
+        l2 = F.cross_entropy(logits, labels, label_smoothing=0.1)
+        assert l2.item() > 0
+
+    def test_ignore_index(self):
+        logits = t(np.random.rand(4, 10))
+        labels = paddle.to_tensor(np.array([1, -100, 5, -100]))
+        loss = F.cross_entropy(logits, labels, ignore_index=-100)
+        logp = np.log(np.exp(logits.numpy()) / np.exp(logits.numpy()).sum(-1, keepdims=True))
+        ref = -(logp[0, 1] + logp[2, 5]) / 2
+        assert np.isclose(loss.item(), ref, rtol=1e-5)
+
+    def test_mse_bce(self):
+        a = t(np.random.rand(5))
+        b = t(np.random.rand(5))
+        assert np.isclose(F.mse_loss(a, b).item(), ((a.numpy() - b.numpy()) ** 2).mean(), rtol=1e-6)
+        logit = t(np.random.randn(5))
+        y = t((np.random.rand(5) > 0.5).astype(np.float32))
+        bce = F.binary_cross_entropy_with_logits(logit, y)
+        p = 1 / (1 + np.exp(-logit.numpy()))
+        ref = -(y.numpy() * np.log(p) + (1 - y.numpy()) * np.log(1 - p)).mean()
+        assert np.isclose(bce.item(), ref, rtol=1e-4)
+
+
+class TestTransformer:
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(16, 4)
+        x = t(np.random.rand(2, 6, 16))
+        assert mha(x, x, x).shape == [2, 6, 16]
+
+    def test_encoder(self):
+        layer = nn.TransformerEncoderLayer(16, 4, 32, dropout=0.0)
+        enc = nn.TransformerEncoder(layer, 2)
+        x = t(np.random.rand(2, 5, 16))
+        assert enc(x).shape == [2, 5, 16]
+
+    def test_decoder_and_full(self):
+        model = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=1,
+                               num_decoder_layers=1, dim_feedforward=32, dropout=0.0)
+        src = t(np.random.rand(2, 5, 16))
+        tgt = t(np.random.rand(2, 3, 16))
+        assert model(src, tgt).shape == [2, 3, 16]
+
+    def test_sdpa_causal(self):
+        q = t(np.random.rand(1, 4, 2, 8))
+        out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        assert out.shape == [1, 4, 2, 8]
+
+
+class TestRNN:
+    def test_lstm(self):
+        lstm = nn.LSTM(8, 16, num_layers=2)
+        x = t(np.random.rand(3, 5, 8))
+        out, (h, c) = lstm(x)
+        assert out.shape == [3, 5, 16]
+        assert h.shape == [2, 3, 16]
+
+    def test_gru_bidirect(self):
+        gru = nn.GRU(8, 16, direction="bidirect")
+        x = t(np.random.rand(3, 5, 8))
+        out, h = gru(x)
+        assert out.shape == [3, 5, 32]
+
+    def test_lstm_cell(self):
+        cell = nn.LSTMCell(4, 8)
+        x = t(np.random.rand(2, 4))
+        out, (h, c) = cell(x)
+        assert out.shape == [2, 8] and c.shape == [2, 8]
+
+
+class TestStateDict:
+    def test_roundtrip(self):
+        net = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+        sd = net.state_dict()
+        assert any("weight" in k for k in sd)
+        net2 = nn.Sequential(nn.Linear(4, 8), nn.BatchNorm1D(8), nn.Linear(8, 2))
+        net2.set_state_dict(sd)
+        for (k1, v1), (k2, v2) in zip(net.state_dict().items(), net2.state_dict().items()):
+            assert np.allclose(v1.numpy(), v2.numpy())
+
+    def test_save_load(self, tmp_path):
+        net = nn.Linear(4, 2)
+        path = str(tmp_path / "model.pdparams")
+        paddle.save(net.state_dict(), path)
+        sd = paddle.load(path)
+        net2 = nn.Linear(4, 2)
+        net2.set_state_dict(sd)
+        assert np.allclose(net.weight.numpy(), net2.weight.numpy())
+
+    def test_named_parameters_hooks(self):
+        net = nn.Linear(3, 3)
+        names = [n for n, _ in net.named_parameters()]
+        assert names == ["weight", "bias"]
+        called = []
+        h = net.register_forward_post_hook(lambda l, i, o: called.append(1))
+        net(t(np.ones((1, 3))))
+        assert called
+        h.remove()

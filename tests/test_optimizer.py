@@ -1,0 +1,124 @@
+"""Optimizer tests (ref: unittests/test_adamw_op.py style numeric checks)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def quad_problem():
+    paddle.seed(0)
+    w = paddle.Parameter(np.array([5.0, -3.0], np.float32))
+    target = np.array([1.0, 2.0], np.float32)
+
+    def loss_fn():
+        return paddle.sum((w - paddle.to_tensor(target)) ** 2)
+
+    return w, target, loss_fn
+
+
+@pytest.mark.parametrize("opt_cls,kwargs", [
+    (paddle.optimizer.SGD, dict(learning_rate=0.1)),
+    (paddle.optimizer.Momentum, dict(learning_rate=0.05, momentum=0.9)),
+    (paddle.optimizer.Adam, dict(learning_rate=0.3)),
+    (paddle.optimizer.AdamW, dict(learning_rate=0.3, weight_decay=0.0)),
+    (paddle.optimizer.RMSProp, dict(learning_rate=0.1)),
+    (paddle.optimizer.Adagrad, dict(learning_rate=1.0)),
+    (paddle.optimizer.Adamax, dict(learning_rate=0.5)),
+    (paddle.optimizer.Lamb, dict(learning_rate=0.1, lamb_weight_decay=0.0)),
+])
+def test_converges(opt_cls, kwargs):
+    w, target, loss_fn = quad_problem()
+    opt = opt_cls(parameters=[w], **kwargs)
+    for _ in range(100):
+        loss = loss_fn()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    assert np.allclose(w.numpy(), target, atol=0.3), f"{opt_cls.__name__}: {w.numpy()}"
+
+
+def test_sgd_exact_step():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    (w * 2).sum().backward()  # grad = 2
+    opt.step()
+    assert np.isclose(w.numpy()[0], 1.0 - 0.1 * 2.0)
+
+
+def test_adam_matches_reference_formula():
+    w = paddle.Parameter(np.array([2.0], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                                epsilon=1e-8, parameters=[w])
+    (w * 3).sum().backward()
+    opt.step()
+    g = 3.0
+    m = 0.1 * g
+    v = 0.001 * g * g
+    mhat = m / (1 - 0.9)
+    vhat = v / (1 - 0.999)
+    expect = 2.0 - 0.1 * mhat / (np.sqrt(vhat) + 1e-8)
+    assert np.isclose(w.numpy()[0], expect, rtol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.Parameter(np.array([2.0], np.float32))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.1, parameters=[w])
+    (w * 0.0).sum().backward()  # zero grad: only decay acts
+    opt.step()
+    assert np.isclose(w.numpy()[0], 2.0 - 0.1 * 0.1 * 2.0, rtol=1e-5)
+
+
+def test_grad_clip_global_norm():
+    w = paddle.Parameter(np.array([1.0, 1.0], np.float32))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+    (w * 10.0).sum().backward()  # grad = [10, 10], norm ~14.14
+    opt.step()
+    moved = 1.0 - w.numpy()
+    assert np.isclose(np.linalg.norm(moved), 1.0, rtol=1e-3)
+
+
+def test_lr_scheduler():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=0.1, step_size=2, gamma=0.5)
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[w])
+    assert np.isclose(opt.get_lr(), 0.1)
+    sched.step()
+    sched.step()
+    assert np.isclose(opt.get_lr(), 0.05)
+
+
+def test_warmup_scheduler():
+    s = paddle.optimizer.lr.LinearWarmup(0.1, warmup_steps=10, start_lr=0.0, end_lr=0.1)
+    lrs = []
+    for _ in range(12):
+        lrs.append(s())
+        s.step()
+    assert lrs[0] < lrs[5] < lrs[9]
+    assert np.isclose(lrs[11], 0.1)
+
+
+def test_state_dict_roundtrip():
+    w = paddle.Parameter(np.array([1.0, 2.0], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    (w**2).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[w])
+    opt2.set_state_dict(sd)
+    st1 = opt._state_for(w)
+    st2 = opt2._state_for(w)
+    assert np.allclose(np.asarray(st1["moment1"]), np.asarray(st2["moment1"]))
+
+
+def test_grad_scaler():
+    w = paddle.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[w])
+    scaler = paddle.amp.GradScaler(init_loss_scaling=4.0)
+    loss = (w * 2).sum()
+    scaled = scaler.scale(loss)
+    scaled.backward()
+    scaler.step(opt)
+    scaler.update()
+    assert np.isclose(w.numpy()[0], 1.0 - 0.1 * 2.0, rtol=1e-5)  # unscaled correctly

@@ -1,0 +1,155 @@
+"""Op unit tests vs numpy oracle (ref test model: OpTest check_output,
+python/paddle/fluid/tests/unittests/op_test.py:309)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, sg=True):
+    return paddle.to_tensor(np.asarray(a), stop_gradient=sg)
+
+
+class TestMath:
+    def test_binary_ops(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        assert np.allclose((t(a) + t(b)).numpy(), a + b)
+        assert np.allclose((t(a) - t(b)).numpy(), a - b)
+        assert np.allclose((t(a) * t(b)).numpy(), a * b)
+        assert np.allclose((t(a) / t(b)).numpy(), a / b, rtol=1e-5)
+        assert np.allclose(paddle.maximum(t(a), t(b)).numpy(), np.maximum(a, b))
+        assert np.allclose((t(a) ** 2).numpy(), a**2)
+
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        assert np.allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        assert np.allclose(
+            paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b, rtol=1e-5
+        )
+
+    def test_reductions(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        assert np.allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+        assert np.allclose(paddle.mean(t(a), axis=1).numpy(), a.mean(1), rtol=1e-5)
+        assert np.allclose(paddle.max(t(a), axis=2).numpy(), a.max(2))
+        assert np.allclose(paddle.std(t(a), axis=0).numpy(), a.std(0, ddof=1), rtol=1e-4)
+        assert np.allclose(paddle.logsumexp(t(a), axis=-1).numpy(),
+                           np.log(np.exp(a).sum(-1)), rtol=1e-5)
+
+    def test_unary(self):
+        a = np.random.rand(5).astype(np.float32) + 0.1
+        assert np.allclose(paddle.sqrt(t(a)).numpy(), np.sqrt(a), rtol=1e-6)
+        assert np.allclose(paddle.exp(t(a)).numpy(), np.exp(a), rtol=1e-6)
+        assert np.allclose(paddle.log(t(a)).numpy(), np.log(a), rtol=1e-6)
+        assert np.allclose(paddle.tanh(t(a)).numpy(), np.tanh(a), rtol=1e-6)
+        assert np.allclose(paddle.rsqrt(t(a)).numpy(), 1 / np.sqrt(a), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        assert np.allclose(paddle.cumsum(t(a), axis=1).numpy(), a.cumsum(1), rtol=1e-5)
+        assert np.allclose(paddle.clip(t(a), 0.2, 0.8).numpy(), a.clip(0.2, 0.8))
+
+
+class TestManipulation:
+    def test_reshape_transpose(self):
+        a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+        assert paddle.reshape(t(a), [4, 6]).shape == [4, 6]
+        assert paddle.transpose(t(a), [2, 0, 1]).shape == [4, 2, 3]
+        assert paddle.flatten(t(a), 1).shape == [2, 12]
+
+    def test_concat_split_stack(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        c = paddle.concat([t(a), t(b)], axis=0)
+        assert np.allclose(c.numpy(), np.concatenate([a, b]))
+        s = paddle.split(c, 2, axis=0)
+        assert np.allclose(s[0].numpy(), a)
+        st = paddle.stack([t(a), t(b)], axis=1)
+        assert st.shape == [2, 2, 3]
+
+    def test_gather_scatter(self):
+        a = np.arange(12, dtype=np.float32).reshape(4, 3)
+        idx = np.array([0, 2])
+        g = paddle.gather(t(a), t(idx), axis=0)
+        assert np.allclose(g.numpy(), a[[0, 2]])
+        upd = np.ones((2, 3), np.float32)
+        s = paddle.scatter(t(a), t(idx), t(upd))
+        expect = a.copy()
+        expect[[0, 2]] = 1.0
+        assert np.allclose(s.numpy(), expect)
+
+    def test_squeeze_expand_tile(self):
+        a = np.random.rand(1, 3, 1).astype(np.float32)
+        assert paddle.squeeze(t(a)).shape == [3]
+        assert paddle.unsqueeze(t(a), 0).shape == [1, 1, 3, 1]
+        assert paddle.tile(t(np.ones((2, 2), np.float32)), [2, 3]).shape == [4, 6]
+        assert paddle.expand(t(np.ones((1, 3), np.float32)), [5, 3]).shape == [5, 3]
+
+    def test_pad_cast(self):
+        a = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        p = paddle.nn.functional.pad(t(a), [1, 1, 2, 2])
+        assert p.shape == [2, 3, 8, 6]
+        assert paddle.cast(t(a), "int32").dtype == np.int32
+
+
+class TestSearchLogic:
+    def test_argmax_topk_sort(self):
+        a = np.random.rand(3, 5).astype(np.float32)
+        assert np.allclose(paddle.argmax(t(a), axis=1).numpy(), a.argmax(1))
+        vals, idx = paddle.topk(t(a), 2, axis=1)
+        ref = np.sort(a, 1)[:, ::-1][:, :2]
+        assert np.allclose(vals.numpy(), ref, rtol=1e-6)
+        s = paddle.sort(t(a), axis=1, descending=True)
+        assert np.allclose(s.numpy(), np.sort(a, 1)[:, ::-1])
+
+    def test_where_compare(self):
+        a = np.random.rand(4).astype(np.float32)
+        b = np.random.rand(4).astype(np.float32)
+        w = paddle.where(t(a) > t(b), t(a), t(b))
+        assert np.allclose(w.numpy(), np.maximum(a, b))
+        assert bool(paddle.all(t(a) == t(a)).item())
+
+    def test_nonzero_masked(self):
+        a = np.array([0.0, 1.0, 0.0, 2.0], np.float32)
+        nz = paddle.nonzero(t(a))
+        assert nz.numpy().tolist() == [[1], [3]]
+        m = paddle.masked_select(t(a), t(a) > 0)
+        assert m.numpy().tolist() == [1.0, 2.0]
+
+
+class TestLinalg:
+    def test_solve_inv(self):
+        a = np.random.rand(4, 4).astype(np.float32) + 4 * np.eye(4, dtype=np.float32)
+        b = np.random.rand(4, 2).astype(np.float32)
+        x = paddle.linalg.solve(t(a), t(b))
+        assert np.allclose(a @ x.numpy(), b, atol=1e-4)
+        inv = paddle.linalg.inv(t(a))
+        assert np.allclose(inv.numpy() @ a, np.eye(4), atol=1e-4)
+
+    def test_norm_svd(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        assert np.allclose(paddle.norm(t(a)).item(), np.linalg.norm(a), rtol=1e-5)
+        u, s, vh = paddle.linalg.svd(t(a))
+        rec = u.numpy() @ np.diag(s.numpy()) @ vh.numpy()
+        assert np.allclose(rec, a, atol=1e-4)
+
+
+class TestCreation:
+    def test_creation_ops(self):
+        assert paddle.zeros([2, 3]).numpy().sum() == 0
+        assert paddle.ones([2, 3]).numpy().sum() == 6
+        assert paddle.full([2], 7.0).numpy().tolist() == [7.0, 7.0]
+        assert paddle.arange(5).numpy().tolist() == [0, 1, 2, 3, 4]
+        assert paddle.eye(3).numpy().trace() == 3
+        assert paddle.linspace(0, 1, 5).shape == [5]
+
+    def test_random_reproducible(self):
+        paddle.seed(7)
+        a = paddle.randn([4, 4])
+        paddle.seed(7)
+        b = paddle.randn([4, 4])
+        assert np.allclose(a.numpy(), b.numpy())
+        r = paddle.uniform([100], min=0.0, max=1.0)
+        assert 0 <= r.numpy().min() and r.numpy().max() <= 1
