@@ -1,0 +1,71 @@
+"""Headline benchmark: ResNet-50 training throughput (BASELINE config #2).
+
+Runs the compiled TrainStep (forward+backward+SGD-momentum in one XLA program) in
+bfloat16 on whatever accelerator is attached (the driver provides one TPU v5e chip)
+and prints ONE JSON line.
+
+vs_baseline: the reference repo publishes no numbers (BASELINE.md), so the comparison
+oracle is the public Paddle-CUDA ResNet-50 AMP number on V100 (~780 images/s, from
+Paddle's own model-benchmark CI era); vs_baseline = images_per_sec / 780.
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.vision.models import resnet50
+
+    on_accel = jax.default_backend() not in ("cpu",)
+    batch = 128 if on_accel else 8
+    img = 224 if on_accel else 64
+    steps = 20 if on_accel else 3
+    warmup = 5 if on_accel else 1
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    model.bfloat16() if on_accel else None
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(x, y):
+        logits = model(x)
+        return ce(logits.astype("float32"), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+
+    dtype = np.float32
+    x = paddle.to_tensor(np.random.rand(batch, 3, img, img).astype(dtype) * 2 - 1,
+                         dtype="bfloat16" if on_accel else "float32")
+    y = paddle.to_tensor(np.random.randint(0, 1000, (batch,), np.int32))
+
+    for _ in range(warmup):
+        loss = step(x, y)
+    float(loss.item())  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = step(x, y)
+    float(loss.item())  # sync
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec" if on_accel else "resnet50_train_images_per_sec_cpu_smoke",
+        "value": round(ips, 2),
+        "unit": "images/s",
+        "vs_baseline": round(ips / 780.0, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -73,6 +73,7 @@ from . import framework  # noqa: F401
 from . import device  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401
+from . import models  # noqa: F401
 from . import sysconfig  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework.flags import set_flags, get_flags  # noqa: F401

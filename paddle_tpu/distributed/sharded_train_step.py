@@ -122,20 +122,12 @@ class ShardedTrainStep:
 
             (loss, (new_buffers, aux)), grads = jax.value_and_grad(pure_loss, has_aux=True)(t_params)
             clipped = opt._clipped_grads(list(grads.items()))
-            decay = opt._decay_coeff()
-            mode = opt._decay_mode()
             new_params = dict(frozen)
             new_opt = {}
             for k, g in clipped:
-                p = params[k]
-                g = g.astype(p.dtype)
-                if decay and mode == "l2":
-                    g = g + decay * p
-                np_, ns = opt._update_rule(p, g, opt_state[k], lr)
-                if decay and mode == "decoupled":
-                    np_ = np_ - lr * decay * p
-                new_params[k] = np_
-                new_opt[k] = ns
+                new_params[k], new_opt[k] = opt._apply_update(
+                    params[k], g, opt_state[k], lr, opt._param_decay_coeff(named[k])
+                )
             return new_params, new_buffers, new_opt, loss, aux
 
         rep = NamedSharding(mesh, P())

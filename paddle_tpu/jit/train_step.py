@@ -67,23 +67,13 @@ class TrainStep:
                 return loss_t._value, (new_buffers, aux_out)
 
             (loss, (new_buffers, aux)), grads = jax.value_and_grad(pure_loss, has_aux=True)(t_params)
-            pg = [(k, grads[k]) for k in grads]
-            # grad clip (reuse eager rule on raw arrays)
-            clipped = opt._clipped_grads([(k, g) for k, g in pg])
-            decay = opt._decay_coeff()
-            mode = opt._decay_mode()
+            clipped = opt._clipped_grads(list(grads.items()))
             new_params = dict(frozen)
             new_opt = {}
             for k, g in clipped:
-                p = params[k]
-                g = g.astype(p.dtype)
-                if decay and mode == "l2":
-                    g = g + decay * p
-                np_, ns = opt._update_rule(p, g, opt_state[k], lr)
-                if decay and mode == "decoupled":
-                    np_ = np_ - lr * decay * p
-                new_params[k] = np_
-                new_opt[k] = ns
+                new_params[k], new_opt[k] = opt._apply_update(
+                    params[k], g, opt_state[k], lr, opt._param_decay_coeff(named[k])
+                )
             return new_params, new_buffers, new_opt, loss, aux
 
         donate = (0, 2) if self._donate else ()

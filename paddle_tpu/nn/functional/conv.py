@@ -48,14 +48,17 @@ def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nd, n
     dn = (lhs_spec, rhs_spec, out_spec)
 
     def _f(v, w, b):
+        # NB: no preferred_element_type here — the MXU accumulates bf16 in f32
+        # internally, and an explicit f32 accumulate breaks the conv transpose rule
+        # under AD (f32 cotangent vs bf16 weight).  lax.conv requires equal input
+        # dtypes; follow the activation dtype when a layer wasn't cast.
+        if w.dtype != v.dtype:
+            w = w.astype(v.dtype)
         out = jax.lax.conv_general_dilated(
             v, w, window_strides=strides, padding=pad,
             rhs_dilation=dilations, dimension_numbers=dn,
             feature_group_count=groups,
-            preferred_element_type=jnp.float32 if v.dtype == jnp.bfloat16 else None,
         )
-        if out.dtype != v.dtype:
-            out = out.astype(v.dtype)
         if b is not None:
             shape = [1] * out.ndim
             shape[out_spec.index("C")] = b.shape[0]

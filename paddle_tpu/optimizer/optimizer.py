@@ -111,6 +111,28 @@ class Optimizer:
             return [(p, jnp.clip(g, clip.min, clip.max)) for p, g in params_and_grads]
         return params_and_grads
 
+    def _apply_update(self, p_val, g, state, lr, decay):
+        """The single update path shared by eager step, TrainStep and
+        ShardedTrainStep: decay + rule + dtype restore (an f32 lr array must not
+        promote bf16 params or optimizer state — that would silently retrace/
+        un-donate the jitted step every call)."""
+        if g.dtype != p_val.dtype:
+            g = g.astype(p_val.dtype)
+        if decay and self._decay_mode() == "l2":
+            g = g + decay * p_val
+        new_p, new_state = self._update_rule(p_val, g, state, lr)
+        if decay and self._decay_mode() == "decoupled":
+            new_p = new_p - lr * decay * p_val
+        if new_p.dtype != p_val.dtype:
+            new_p = new_p.astype(p_val.dtype)
+        new_state = {
+            k: (v.astype(state[k].dtype)
+                if hasattr(v, "dtype") and hasattr(state[k], "dtype") and v.dtype != state[k].dtype
+                else v)
+            for k, v in new_state.items()
+        }
+        return new_p, new_state
+
     @tape.no_grad()
     def step(self):
         """Apply one update (ref optimizer.py:1232)."""
@@ -119,15 +141,8 @@ class Optimizer:
         pg = [(p, p._grad) for p in self._params() if p._grad is not None and not p.stop_gradient]
         pg = self._clipped_grads(pg)
         for p, g in pg:
-            if g.dtype != p._value.dtype:
-                g = g.astype(p._value.dtype)
             state = self._state_for(p)
-            decay = self._param_decay_coeff(p)
-            if decay and self._decay_mode() == "l2":
-                g = g + decay * p._value
-            new_p, new_state = self._update_rule(p._value, g, state, lr)
-            if decay and self._decay_mode() == "decoupled":
-                new_p = new_p - lr * decay * p._value
+            new_p, new_state = self._apply_update(p._value, g, state, lr, self._param_decay_coeff(p))
             p._rebind(new_p)
             self._accumulators[id(p)] = new_state
 
