@@ -151,17 +151,37 @@ ErnieModel = BertModel
 
 
 class BertPretrainingHeads(nn.Layer):
+    """MLM transform + decoder and NSP head.  When `embedding_weights` (the
+    [vocab, hidden] word-embedding Parameter) is given, the MLM decoder is TIED to
+    it — logits = x @ W_emb^T + b — matching the reference pretraining setup."""
+
     def __init__(self, config: BertConfig, embedding_weights=None):
         super().__init__()
         self.transform = nn.Linear(config.hidden_size, config.hidden_size)
         self.act = getattr(F, config.hidden_act)
         self.norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
-        self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+        if embedding_weights is not None:
+            # bypass Layer.__setattr__: the Parameter must stay registered ONLY under
+            # the embedding's name or the functional path would train two copies
+            object.__setattr__(self, "_tied_weight", embedding_weights)
+            self.decoder_bias = self.create_parameter(
+                [config.vocab_size], is_bias=True,
+                default_initializer=nn.initializer.Constant(0.0))
+            self.decoder = None
+        else:
+            object.__setattr__(self, "_tied_weight", None)
+            self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
         self.seq_relationship = nn.Linear(config.hidden_size, 2)
 
     def forward(self, sequence_output, pooled_output):
         x = self.norm(self.act(self.transform(sequence_output)))
-        return self.decoder(x), self.seq_relationship(pooled_output)
+        if self._tied_weight is not None:
+            from ..tensor import linalg as L
+
+            mlm = L.matmul(x, self._tied_weight, transpose_y=True) + self.decoder_bias
+        else:
+            mlm = self.decoder(x)
+        return mlm, self.seq_relationship(pooled_output)
 
 
 class BertForPretraining(nn.Layer):
@@ -171,7 +191,8 @@ class BertForPretraining(nn.Layer):
         super().__init__()
         self.config = config
         self.bert = BertModel(config)
-        self.cls = BertPretrainingHeads(config)
+        self.cls = BertPretrainingHeads(
+            config, embedding_weights=self.bert.embeddings.word_embeddings.weight)
 
     def forward(self, input_ids, token_type_ids=None, attention_mask=None,
                 masked_lm_labels=None, next_sentence_label=None):
@@ -192,5 +213,6 @@ class BertForPretraining(nn.Layer):
 
 class ErnieForPretraining(BertForPretraining):
     def __init__(self, config: BertConfig):
-        config.use_task_id = True
-        super().__init__(config)
+        import dataclasses
+
+        super().__init__(dataclasses.replace(config, use_task_id=True))

@@ -95,26 +95,25 @@ class LlamaAttention(nn.Layer):
                                             has_bias=False, input_is_parallel=True)
         else:
             self.o_proj = nn.Linear(self.num_heads * self.head_dim, self.hidden_size, bias_attr=False)
-        cos, sin = _rope_cache(self.head_dim, config.max_position_embeddings, config.rope_theta)
-        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
-        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, hidden_states, attn_mask=None, cache=None):
+    def forward(self, hidden_states, rope, attn_mask=None, cache=None, use_cache=False):
+        """rope: (cos, sin) Tensors shared at LlamaModel level (one copy, not 32).
+        cache=None with use_cache=True is the prefill step: the returned cache is
+        this call's own k/v."""
+        rope_cos, rope_sin = rope
         B, S = hidden_states.shape[0], hidden_states.shape[1]
         q = self.q_proj(hidden_states).reshape([B, S, self.num_heads, self.head_dim])
         k = self.k_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
         v = self.v_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
 
         offset = cache[0].shape[1] if cache is not None else 0
-        q = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (q, self.rope_cos, self.rope_sin), name="rope")
-        k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, self.rope_cos, self.rope_sin), name="rope")
+        q = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (q, rope_cos, rope_sin), name="rope")
+        k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, rope_cos, rope_sin), name="rope")
 
         if cache is not None:
             k = M.concat([cache[0], k], axis=1)
             v = M.concat([cache[1], v], axis=1)
-            new_cache = (k, v)
-        else:
-            new_cache = None
+        new_cache = (k, v) if use_cache else None
 
         # GQA: repeat kv heads to match q heads
         if self.num_kv_heads != self.num_heads:
@@ -128,7 +127,7 @@ class LlamaAttention(nn.Layer):
         )
         out = out.reshape([B, S, self.num_heads * self.head_dim])
         out = self.o_proj(out)
-        if cache is not None:
+        if use_cache:
             return out, new_cache
         return out
 
@@ -159,15 +158,15 @@ class LlamaDecoderLayer(nn.Layer):
         self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
         self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, x, attn_mask=None, cache=None):
+    def forward(self, x, rope, attn_mask=None, cache=None, use_cache=False):
         h = self.input_layernorm(x)
-        if cache is not None:
-            attn_out, new_cache = self.self_attn(h, attn_mask, cache)
+        if use_cache:
+            attn_out, new_cache = self.self_attn(h, rope, attn_mask, cache, use_cache=True)
         else:
-            attn_out = self.self_attn(h, attn_mask)
+            attn_out = self.self_attn(h, rope, attn_mask)
         x = x + attn_out
         x = x + self.mlp(self.post_attention_layernorm(x))
-        if cache is not None:
+        if use_cache:
             return x, new_cache
         return x
 
@@ -182,18 +181,28 @@ class LlamaModel(nn.Layer):
             self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
         self.layers = nn.LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = _rope_cache(config.hidden_size // config.num_attention_heads,
+                               config.max_position_embeddings, config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
 
-    def forward(self, input_ids, attn_mask=None, caches=None):
+    def forward(self, input_ids, attn_mask=None, caches=None, use_cache=False):
+        """caches=[None]*num_layers (or caches=None with use_cache=True) is the
+        prefill bootstrap; each entry is then a (k, v) pair for the decode steps."""
+        use_cache = use_cache or caches is not None
+        if use_cache and caches is None:
+            caches = [None] * len(self.layers)
         x = self.embed_tokens(input_ids)
-        new_caches = [] if caches is not None else None
+        rope = (self.rope_cos, self.rope_sin)
+        new_caches = [] if use_cache else None
         for i, layer in enumerate(self.layers):
-            if caches is not None:
-                x, c = layer(x, attn_mask, caches[i])
+            if use_cache:
+                x, c = layer(x, rope, attn_mask, caches[i], use_cache=True)
                 new_caches.append(c)
             else:
-                x = layer(x, attn_mask)
+                x = layer(x, rope, attn_mask)
         x = self.norm(x)
-        if caches is not None:
+        if use_cache:
             return x, new_caches
         return x
 
@@ -227,7 +236,7 @@ class LlamaForCausalLM(nn.Layer):
 
         return sum(int(np.prod(p.shape)) for p in self.parameters())
 
-    def generate_step(self, input_ids, caches):
-        """single-token decode step (inference path)."""
-        hidden, caches = self.llama(input_ids, caches=caches)
+    def generate_step(self, input_ids, caches=None):
+        """Prefill (caches=None) or single-token decode step (inference path)."""
+        hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)
         return self.lm_head(hidden[:, -1:]), caches

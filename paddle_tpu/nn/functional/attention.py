@@ -10,10 +10,11 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...framework import random as _random
 from ...tensor.tensor import Tensor, apply_op, _unwrap
 
 
-def _dense_sdpa(q, k, v, mask, dropout_p, is_causal, scale):
+def _dense_sdpa(q, k, v, mask, dropout_p, is_causal, scale, training=True):
     # q,k,v: [B, S, H, D] (paddle layout)
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / (d ** 0.5)
@@ -31,6 +32,9 @@ def _dense_sdpa(q, k, v, mask, dropout_p, is_causal, scale):
         else:
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    if dropout_p and training:
+        keep = jax.random.bernoulli(_random.get_rng_key(), 1.0 - dropout_p, probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout_p), jnp.zeros_like(probs))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 
@@ -48,8 +52,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             import jax as _jax
 
             on_tpu = _jax.default_backend() in ("tpu", "axon")
-            use_flash = backend == "flash" or (
-                on_tpu and seq >= 1024 and seq % 128 == 0 and hd in (64, 128, 256) and attn_mask is None
+            no_drop = dropout_p == 0.0 or not training
+            if backend == "flash" and not no_drop:
+                import warnings
+
+                warnings.warn(
+                    "backend='flash' with active attention dropout falls back to the "
+                    "dense SDPA path (the Pallas flash kernel has no dropout); full "
+                    "[B,H,S,S] attention probs will be materialized")
+            use_flash = (backend == "flash" and no_drop) or (
+                on_tpu and seq >= 1024 and seq % 128 == 0 and hd in (64, 128, 256)
+                and attn_mask is None and no_drop
             )
         except Exception:
             use_flash = False
@@ -63,14 +76,16 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
         return apply_op(_f, (query, key, value), name="flash_attention")
 
     def _f(q, k, v, m):
-        return _dense_sdpa(q, k, v, m, dropout_p, is_causal, scale)
+        return _dense_sdpa(q, k, v, m, dropout_p, is_causal, scale, training)
 
     return apply_op(_f, (query, key, value, attn_mask), name="sdpa")
 
 
 # paddle.nn.functional.flash_attention module-style API parity
-def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False, name=None):
-    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout, is_causal=causal)
+def flash_attention(query, key, value, dropout=0.0, causal=False, return_softmax=False,
+                    training=True, name=None):
+    out = scaled_dot_product_attention(query, key, value, dropout_p=dropout,
+                                       is_causal=causal, training=training)
     if return_softmax:
         return out, None
     return out, None

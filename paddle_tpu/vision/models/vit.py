@@ -2,8 +2,6 @@
 reference's nn.TransformerEncoder building blocks)."""
 from __future__ import annotations
 
-import numpy as np
-
 from ... import nn
 from ...tensor.tensor import Parameter
 from ...tensor import manipulation as M
@@ -59,8 +57,12 @@ class VisionTransformer(nn.Layer):
         self.patch_embed = PatchEmbed(img_size, patch_size, in_chans, embed_dim)
         n = self.patch_embed.num_patches
         self.cls_token = Parameter(jnp.zeros([1, 1, embed_dim], jnp.float32))
+        # drawn from the framework RNG so paddle.seed() reproduces construction
+        import jax as _jax
+        from ...framework import random as _random
+
         self.pos_embed = Parameter(
-            jnp.asarray(np.random.randn(1, n + 1, embed_dim).astype(np.float32) * 0.02)
+            _jax.random.normal(_random.get_rng_key(), (1, n + 1, embed_dim), jnp.float32) * 0.02
         )
         self.pos_drop = nn.Dropout(drop_rate)
         self.blocks = nn.LayerList([
