@@ -49,7 +49,7 @@ from .tensor.manipulation import *  # noqa: F401,F403
 from .tensor.logic import *  # noqa: F401,F403
 from .tensor.search import *  # noqa: F401,F403
 from .tensor import linalg  # noqa: F401
-from .tensor.linalg import norm, dist, cholesky, dot, t  # noqa: F401
+from .tensor.linalg import norm, dist, cholesky, dot, t, einsum  # noqa: F401
 from .tensor.math import max, min, sum, abs, pow, round  # noqa: F401  (shadow builtins as paddle does)
 from .tensor.logic import all, any  # noqa: F401
 from .tensor import creation as _creation

@@ -1,5 +1,7 @@
 """paddle.incubate parity surface (ref: python/paddle/incubate/)."""
 from . import autograd  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import MoELayer  # noqa: F401
 from ..autograd.tape import no_grad  # noqa: F401
 
 

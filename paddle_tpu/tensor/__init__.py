@@ -114,6 +114,7 @@ Tensor.__setitem__ = _setitem
 
 _METHOD_SOURCES = [math, manipulation, logic, search, linalg, stat]
 _SKIP = {
+    "einsum",  # first arg is the equation string, not a tensor
     "matmul_",
     "assign",
     "builtins_sum",

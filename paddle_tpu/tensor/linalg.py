@@ -12,6 +12,16 @@ from .tensor import Tensor, apply_op, _unwrap
 from .math import matmul, mm, bmm, dot  # re-exported (ref linalg.py exports)
 
 
+def einsum(equation, *operands):
+    """Ref: python/paddle/tensor/einsum.py.  Direct XLA einsum — contractions land
+    on the MXU with the compiler choosing the contraction order."""
+
+    def _f(*ops):
+        return jnp.einsum(equation, *ops)
+
+    return apply_op(_f, tuple(operands), name="einsum")
+
+
 def norm(x, p="fro", axis=None, keepdim=False, name=None):
     def _f(v):
         if axis is None and p in ("fro", 2):
