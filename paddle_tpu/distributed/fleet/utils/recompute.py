@@ -1,0 +1,114 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: `python/paddle/distributed/fleet/utils/recompute.py` — a PyLayer whose
+forward runs under no_grad saving only inputs + RNG state, and whose backward re-runs
+the forward to rebuild activations before backprop.
+
+TPU-native: the recomputed region becomes ONE taped op whose primal is wrapped in
+`jax.checkpoint` (remat).  Eagerly this gives the same save-inputs-only semantics;
+under `to_static`/jit the XLA scheduler rematerializes the region in the backward
+pass, trading FLOPs for HBM exactly like the reference — but fused and overlapped by
+the compiler instead of a Python-driven re-forward.
+"""
+from __future__ import annotations
+
+import jax
+
+from ....tensor.tensor import Tensor, apply_op
+from ....autograd import tape
+from ....framework import random as _random
+from ....nn.layer.layers import Layer
+
+
+def _owning_layer(function):
+    if isinstance(function, Layer):
+        return function
+    owner = getattr(function, "__self__", None)
+    return owner if isinstance(owner, Layer) else None
+
+
+def recompute(function, *args, preserve_rng_state: bool = True, use_reentrant: bool = True,
+              **kwargs):
+    """Run `function(*args)` but save only its inputs for backward; activations are
+    rebuilt (XLA remat) when gradients flow.  `function` may be an `nn.Layer` (its
+    parameters are captured as differentiable inputs) or any callable of Tensors."""
+    layer = _owning_layer(function)
+    param_items = list(layer.named_parameters()) if layer is not None else []
+    buffers = {k: b for k, b in layer.named_buffers()} if layer is not None else {}
+
+    n_args = len(args)
+    key = _random.get_rng_key() if preserve_rng_state else None
+
+    def primal(*flat):
+        call_args = [
+            Tensor(v, stop_gradient=True) if isinstance(args[i], Tensor) else args[i]
+            for i, v in enumerate(flat[:n_args])
+        ]
+        params = {k: v for (k, _), v in zip(param_items, flat[n_args:])}
+        scope = _random.rng_key_scope(key) if key is not None else _nullcontext()
+        with scope, tape.no_grad():
+            if layer is not None:
+                restore = layer.bind_functional_state(
+                    params, {k: b._value for k, b in buffers.items()})
+                try:
+                    out = function(*call_args, **kwargs)
+                finally:
+                    restore()
+            else:
+                out = function(*call_args, **kwargs)
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    flat_inputs = (*args, *[p for _, p in param_items])
+    static = tuple(i for i, a in enumerate(flat_inputs)
+                   if not isinstance(a, Tensor) and not hasattr(a, "shape"))
+    return apply_op(jax.checkpoint(primal, static_argnums=static), flat_inputs,
+                    name="recompute")
+
+
+class _nullcontext:
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+class _Chunk(Layer):
+    """A registered container for one recomputed segment so `recompute` can capture
+    the segment's parameters as differentiable inputs (not closure constants)."""
+
+    def __init__(self, layers):
+        super().__init__()
+        self._n = len(layers)
+        for i, l in enumerate(layers):
+            setattr(self, f"seg{i}", l)
+
+    def forward(self, *xs):
+        y = xs
+        for i in range(self._n):
+            l = getattr(self, f"seg{i}")
+            y = l(*y) if isinstance(y, tuple) else l(y)
+            if not isinstance(y, tuple):
+                y = (y,)
+        return y[0] if len(y) == 1 else y
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Ref fleet/utils/recompute.py `recompute_sequential`: chunk a Sequential and
+    recompute each segment."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else int(ctx or 1)
+    if isinstance(functions, Layer):
+        layers = list(functions.children()) or [functions]
+    else:
+        layers = list(functions)
+    n = len(layers)
+    seg = max(1, n // max(1, segments))
+    out = args
+    for start in range(0, n, seg):
+        chunk = _Chunk(layers[start:start + seg])
+        out = recompute(chunk, *out, **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out[0] if len(out) == 1 else out

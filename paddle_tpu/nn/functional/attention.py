@@ -47,7 +47,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
     if backend in ("auto", "flash"):
         try:
             qv = _unwrap(query)
+            kv = _unwrap(key)
             seq = qv.shape[1]
+            seq_k = kv.shape[1]
             hd = qv.shape[-1]
             import jax as _jax
 
@@ -60,8 +62,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                     "backend='flash' with active attention dropout falls back to the "
                     "dense SDPA path (the Pallas flash kernel has no dropout); full "
                     "[B,H,S,S] attention probs will be materialized")
+            blocks_ok = seq % min(128, seq) == 0 and seq_k % min(128, seq_k) == 0
             use_flash = (backend == "flash" and no_drop) or (
-                on_tpu and seq >= 1024 and seq % 128 == 0 and hd in (64, 128, 256)
+                on_tpu and seq >= 1024 and blocks_ok and hd in (64, 128, 256)
                 and attn_mask is None and no_drop
             )
         except Exception:

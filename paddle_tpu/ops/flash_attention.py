@@ -30,13 +30,15 @@ def _interpret_default():
 
 
 # --------------------------------------------------------------------- forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, seq_k):
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, seq_q, seq_k):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # [bq, D]
     nkb = pl.cdiv(seq_k, bk)
+    # bottom-right alignment (matches the dense path): query i attends kpos <= i + off
+    off = seq_k - seq_q
     if causal:
         # visit key blocks only up to (and including) this q block's diagonal
-        nkb = jnp.minimum(nkb, pl.cdiv((qi + 1) * bq, bk))
+        nkb = jnp.minimum(nkb, ((qi + 1) * bq + off + bk - 1) // bk)
 
     def body(kj, carry):
         m, l, acc = carry
@@ -47,7 +49,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, s
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
@@ -68,7 +70,8 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
     Sk = k.shape[1]
     grid = (BH, Sq // bq)
     o, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk, seq_k=Sk),
+        functools.partial(_fwd_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+                          seq_q=Sq, seq_k=Sk),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
@@ -90,15 +93,16 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
 
 # -------------------------------------------------------------------- backward
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
-               *, scale, causal, bq, bk, seq_k):
+               *, scale, causal, bq, bk, seq_q, seq_k):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
     lse = lse_ref[0][:, None]   # [bq, 1]
     dsum = dsum_ref[0][:, None]
     nkb = pl.cdiv(seq_k, bk)
+    off = seq_k - seq_q
     if causal:
-        nkb = jnp.minimum(nkb, pl.cdiv((qi + 1) * bq, bk))
+        nkb = jnp.minimum(nkb, ((qi + 1) * bq + off + bk - 1) // bk)
 
     def body(kj, dq):
         k = k_ref[0, pl.ds(kj * bk, bk), :].astype(jnp.float32)
@@ -108,7 +112,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
         p = jnp.exp(s - lse)                       # [bq, bk]
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -120,12 +124,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dk_ref, dv_ref,
-                *, scale, causal, bq, bk, seq_q):
+                *, scale, causal, bq, bk, seq_q, seq_k):
     kj = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)   # [bk, D]
     v = v_ref[0].astype(jnp.float32)
     nqb = pl.cdiv(seq_q, bq)
-    start = jnp.maximum((kj * bk) // bq, 0) if causal else 0
+    off = seq_k - seq_q
+    start = jnp.maximum((kj * bk - off) // bq, 0) if causal else 0
 
     def body(qi, carry):
         dk, dv = carry
@@ -138,7 +143,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dk_ref, dv_ref,
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+            s = jnp.where(qpos + off >= kpos, s, NEG_INF)
         p = jnp.exp(s - lse)                       # [bq, bk]
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -162,7 +167,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
     dsum = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
 
     dq = pl.pallas_call(
-        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk, seq_k=Sk),
+        functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+                          seq_q=Sq, seq_k=Sk),
         grid=(BH, Sq // bq),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
@@ -178,7 +184,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
     )(q, k, v, do, lse, dsum)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk, seq_q=Sq),
+        functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
+                          seq_q=Sq, seq_k=Sk),
         grid=(BH, Sk // bk),
         in_specs=[
             pl.BlockSpec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),

@@ -71,3 +71,27 @@ def test_flash_inside_jit_and_nonsquare_blocks():
     out = f(q, k, v)
     ref = _dense_oracle(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_cross_attention_sq_ne_sk(causal):
+    """Bottom-right causal alignment: Sq != Sk (chunked prefill / KV-cache shape)
+    must match the dense oracle, fwd and bwd."""
+    rng = np.random.RandomState(11)
+    q = jnp.asarray(rng.randn(2, 128, 2, 64).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 256, 2, 64).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 256, 2, 64).astype(np.float32))
+    out = flash_attention(q, k, v, causal=causal, block_q=64, block_k=64)
+    ref = _dense_oracle(q, k, v, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal=causal, block_q=64, block_k=64) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(_dense_oracle(q, k, v, causal) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-3)
