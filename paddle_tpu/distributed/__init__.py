@@ -22,6 +22,10 @@ from .sharded_train_step import ShardedTrainStep  # noqa: F401
 from .sharding_ctx import mesh_scope, constraint, annotate  # noqa: F401
 from . import fleet  # noqa: F401
 from . import sharding  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
+from .store import Store, TCPStore  # noqa: F401
+from . import launch  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

@@ -18,6 +18,9 @@ from ..env import init_parallel_env, get_rank, get_world_size
 from ..parallel import DataParallel
 from .. import collective as _collective
 from ...optimizer.optimizer import Optimizer
+from .. import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from . import elastic  # noqa: F401
 from ..meta_parallel import mp_layers  # noqa: F401
 from ..meta_parallel.mp_layers import (  # noqa: F401 (fleet.meta_parallel re-exports)
     VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear, ParallelCrossEntropy,

@@ -12,29 +12,71 @@ the compiler instead of a Python-driven re-forward.
 """
 from __future__ import annotations
 
+import contextlib
+import functools
+
 import jax
 
 from ....tensor.tensor import Tensor, apply_op
 from ....autograd import tape
 from ....framework import random as _random
 from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList
 
 
-def _owning_layer(function):
-    if isinstance(function, Layer):
-        return function
-    owner = getattr(function, "__self__", None)
-    return owner if isinstance(owner, Layer) else None
+def _collect_layers(function) -> list[Layer]:
+    """Find every Layer whose parameters `function` can reach: the function itself,
+    a bound method's owner, functools.partial payloads, and closure cells.  These
+    params must enter the checkpointed primal as differentiable inputs — anything
+    reached only as a closure constant would silently get no gradient."""
+    seen: dict[int, Layer] = {}
+
+    def visit(obj, depth=0):
+        if depth > 3:
+            return
+        if isinstance(obj, Layer):
+            seen.setdefault(id(obj), obj)
+            return
+        owner = getattr(obj, "__self__", None)
+        if isinstance(owner, Layer):
+            seen.setdefault(id(owner), owner)
+        if isinstance(obj, functools.partial):
+            visit(obj.func, depth + 1)
+            for a in obj.args:
+                visit(a, depth + 1)
+            for v in obj.keywords.values():
+                visit(v, depth + 1)
+        closure = getattr(obj, "__closure__", None)
+        if closure:
+            for cell in closure:
+                try:
+                    visit(cell.cell_contents, depth + 1)
+                except ValueError:
+                    pass
+        if isinstance(obj, (list, tuple)):
+            for it in obj:
+                visit(it, depth + 1)
+
+    visit(function)
+    return list(seen.values())
 
 
 def recompute(function, *args, preserve_rng_state: bool = True, use_reentrant: bool = True,
               **kwargs):
     """Run `function(*args)` but save only its inputs for backward; activations are
-    rebuilt (XLA remat) when gradients flow.  `function` may be an `nn.Layer` (its
-    parameters are captured as differentiable inputs) or any callable of Tensors."""
-    layer = _owning_layer(function)
-    param_items = list(layer.named_parameters()) if layer is not None else []
-    buffers = {k: b for k, b in layer.named_buffers()} if layer is not None else {}
+    rebuilt (XLA remat) when gradients flow.  `function` may be an `nn.Layer`, a bound
+    method, a closure/partial over Layers (their parameters are discovered and
+    captured as differentiable inputs), or any pure callable of Tensors."""
+    layers = _collect_layers(function)
+    param_items = []   # (layer_idx, name, Parameter); dedup shared Parameter objects
+    buffer_state = []  # (layer_idx, {name: raw})
+    seen_params: set[int] = set()
+    for li, layer in enumerate(layers):
+        for k, p in layer.named_parameters():
+            if id(p) not in seen_params:
+                seen_params.add(id(p))
+                param_items.append((li, k, p))
+        buffer_state.append({k: b._value for k, b in layer.named_buffers()})
 
     n_args = len(args)
     key = _random.get_rng_key() if preserve_rng_state else None
@@ -44,51 +86,42 @@ def recompute(function, *args, preserve_rng_state: bool = True, use_reentrant: b
             Tensor(v, stop_gradient=True) if isinstance(args[i], Tensor) else args[i]
             for i, v in enumerate(flat[:n_args])
         ]
-        params = {k: v for (k, _), v in zip(param_items, flat[n_args:])}
-        scope = _random.rng_key_scope(key) if key is not None else _nullcontext()
+        per_layer: list[dict] = [{} for _ in layers]
+        for (li, k, _), v in zip(param_items, flat[n_args:]):
+            per_layer[li][k] = v
+        scope = _random.rng_key_scope(key) if key is not None else contextlib.nullcontext()
+        restores = []
         with scope, tape.no_grad():
-            if layer is not None:
-                restore = layer.bind_functional_state(
-                    params, {k: b._value for k, b in buffers.items()})
-                try:
-                    out = function(*call_args, **kwargs)
-                finally:
-                    restore()
-            else:
+            try:
+                for li, layer in enumerate(layers):
+                    restores.append(layer.bind_functional_state(per_layer[li],
+                                                                buffer_state[li]))
                 out = function(*call_args, **kwargs)
+            finally:
+                for r in reversed(restores):
+                    r()
         if isinstance(out, (tuple, list)):
             return tuple(o._value if isinstance(o, Tensor) else o for o in out)
         return out._value if isinstance(out, Tensor) else out
 
-    flat_inputs = (*args, *[p for _, p in param_items])
+    flat_inputs = (*args, *[p for _, _, p in param_items])
     static = tuple(i for i, a in enumerate(flat_inputs)
                    if not isinstance(a, Tensor) and not hasattr(a, "shape"))
     return apply_op(jax.checkpoint(primal, static_argnums=static), flat_inputs,
                     name="recompute")
 
 
-class _nullcontext:
-    def __enter__(self):
-        return None
-
-    def __exit__(self, *a):
-        return False
-
-
 class _Chunk(Layer):
-    """A registered container for one recomputed segment so `recompute` can capture
-    the segment's parameters as differentiable inputs (not closure constants)."""
+    """A registered container for one recomputed segment (params discoverable by
+    `_collect_layers` via the Layer itself)."""
 
     def __init__(self, layers):
         super().__init__()
-        self._n = len(layers)
-        for i, l in enumerate(layers):
-            setattr(self, f"seg{i}", l)
+        self.segs = LayerList(layers)
 
     def forward(self, *xs):
         y = xs
-        for i in range(self._n):
-            l = getattr(self, f"seg{i}")
+        for l in self.segs:
             y = l(*y) if isinstance(y, tuple) else l(y)
             if not isinstance(y, tuple):
                 y = (y,)

@@ -1,0 +1,128 @@
+"""Launcher CLI (ref: python/paddle/distributed/launch/main.py:18 + controllers/
+collective.py:87-97 which sets PADDLE_MASTER / PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS for every spawned trainer).
+
+The CollectiveController spawns `nproc_per_node` local trainer processes with the
+reference env contract plus JAX multi-host env (coordinator address/process id), logs
+each rank to `--log_dir`, watches exits (ref controllers/watcher.py) and restarts
+failed ranks up to `--max_restart` times (elastic level >= 1).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch",
+                                description="TPU distributed launcher")
+    p.add_argument("--master", default=None,
+                   help="rendezvous server host:port (jax coordinator)")
+    p.add_argument("--rank", type=int, default=-1, help="node rank (-1: auto)")
+    p.add_argument("--nnodes", default="1", help="number of nodes, or MIN:MAX for elastic")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--run_mode", default="collective", choices=["collective"])
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None, help="visible device ids, e.g. 0,1,2,3")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class CollectiveController:
+    """Ref controllers/collective.py — build env per rank, spawn, watch."""
+
+    def __init__(self, args):
+        self.args = args
+        self.procs: list[subprocess.Popen] = []
+        self.restarts = 0
+        nn = str(args.nnodes)
+        self.min_nodes = int(nn.split(":")[0])
+        self.max_nodes = int(nn.split(":")[-1])
+
+    def _endpoints(self, n):
+        base = 61000 + (hash(self.args.job_id) % 1000)
+        return ",".join(f"127.0.0.1:{base + i}" for i in range(n))
+
+    def build_env(self, local_rank: int) -> dict:
+        a = self.args
+        n = a.nproc_per_node
+        node_rank = max(a.rank, 0)
+        global_rank = node_rank * n + local_rank
+        world = self.min_nodes * n
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": self._endpoints(world),
+            "PADDLE_CURRENT_ENDPOINT": self._endpoints(world).split(",")[global_rank],
+            "PADDLE_JOB_ID": a.job_id,
+        })
+        if a.master:
+            env["PADDLE_MASTER"] = a.master
+        if a.devices is not None:
+            env["PADDLE_VISIBLE_DEVICES"] = a.devices
+        return env
+
+    def spawn_one(self, local_rank: int) -> subprocess.Popen:
+        a = self.args
+        os.makedirs(a.log_dir, exist_ok=True)
+        log_path = os.path.join(a.log_dir, f"workerlog.{local_rank}")
+        logf = open(log_path, "ab")
+        cmd = [sys.executable, a.training_script] + list(a.training_script_args)
+        return subprocess.Popen(cmd, env=self.build_env(local_rank),
+                                stdout=logf, stderr=subprocess.STDOUT)
+
+    def start(self):
+        self.procs = [self.spawn_one(i) for i in range(self.args.nproc_per_node)]
+
+    def watch(self) -> int:
+        """Ref controllers/watcher.py: poll children; on failure either restart the
+        failed ranks (elastic_level >= 1, up to max_restart) or tear down."""
+        while True:
+            time.sleep(0.5)
+            states = [p.poll() for p in self.procs]
+            if all(s == 0 for s in states):
+                return 0
+            failed = [i for i, s in enumerate(states) if s not in (None, 0)]
+            if failed:
+                if self.args.elastic_level >= 1 and self.restarts < self.args.max_restart:
+                    self.restarts += 1
+                    for i in failed:
+                        self.procs[i] = self.spawn_one(i)
+                    continue
+                self.stop()
+                return next(s for s in states if s not in (None, 0))
+
+    def stop(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    ctl = CollectiveController(args)
+    ctl.start()
+    try:
+        rc = ctl.watch()
+    except KeyboardInterrupt:
+        ctl.stop()
+        rc = 130
+    sys.exit(rc)

@@ -19,7 +19,13 @@ class Generator:
 
     def __init__(self, seed: int = 0):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = None  # lazy: don't touch the backend at import time
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
@@ -33,7 +39,7 @@ class Generator:
         self._key = key
 
     def split(self):
-        self._key, sub = jax.random.split(self._key)
+        self._key, sub = jax.random.split(self.key)
         return sub
 
 
@@ -69,7 +75,7 @@ def rng_key_scope(key):
 
 
 def get_cuda_rng_state():  # parity shims
-    return default_generator()._key
+    return default_generator().key
 
 
 def set_cuda_rng_state(state):

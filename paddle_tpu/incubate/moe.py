@@ -153,7 +153,7 @@ class MoELayer(Layer):
             lambda p, tv, ti: _dispatch_combine(p, tv, ti, E, C, k),
             (probs, topv, topi), name="moe_dispatch")
         dispatch, combine, l_aux = disp_comb
-        self.l_aux = l_aux * self.gate_layer.aux_loss_weight
+        self.l_aux = l_aux * getattr(self.gate_layer, "aux_loss_weight", 1.0)
 
         # token-major -> expert-major [E, C, d]
         from ..tensor.linalg import einsum

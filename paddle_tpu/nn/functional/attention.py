@@ -63,9 +63,10 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                     "dense SDPA path (the Pallas flash kernel has no dropout); full "
                     "[B,H,S,S] attention probs will be materialized")
             blocks_ok = seq % min(128, seq) == 0 and seq_k % min(128, seq_k) == 0
-            use_flash = (backend == "flash" and no_drop) or (
-                on_tpu and seq >= 1024 and blocks_ok and hd in (64, 128, 256)
-                and attn_mask is None and no_drop
+            causal_ok = not is_causal or seq <= seq_k
+            use_flash = (backend == "flash" and no_drop and causal_ok) or (
+                on_tpu and seq >= 1024 and blocks_ok and causal_ok
+                and hd in (64, 128, 256) and attn_mask is None and no_drop
             )
         except Exception:
             use_flash = False

@@ -238,6 +238,12 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
+    if causal and Sq > Sk:
+        # queries 0..Sq-Sk-1 would attend zero keys (all-masked rows -> 0/0); the
+        # dense path is the right tool for that degenerate shape
+        raise ValueError(
+            f"flash_attention(causal=True) requires Sq <= Sk, got Sq={Sq} Sk={Sk}; "
+            "use the dense SDPA path")
     if interpret is None:
         interpret = _interpret_default()
     bq = min(block_q, Sq)

@@ -36,6 +36,7 @@ class Tensor:
         "is_leaf_retain",
         "_grad_hooks",
         "sharding_spec",
+        "process_mesh",
         "__weakref__",
     )
 

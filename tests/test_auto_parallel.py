@@ -1,0 +1,110 @@
+"""auto_parallel tests on the 8-device CPU mesh (conftest forces
+xla_force_host_platform_device_count=8).  Oracle per SURVEY.md §4: numeric parity
+between the sharded Engine and a single-device run."""
+import numpy as np
+import pytest
+
+import jax
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.auto_parallel import ProcessMesh, shard_tensor, shard_op, Engine
+
+
+def _mlp(h=16, out=4):
+    paddle.seed(0)
+    return nn.Sequential(nn.Linear(h, 32), nn.Tanh(), nn.Linear(32, out))
+
+
+def test_process_mesh_shape_and_jax_bridge():
+    pm = ProcessMesh(mesh=[[0, 1, 2, 3], [4, 5, 6, 7]], dim_names=["x", "y"])
+    assert pm.shape == [2, 4]
+    assert pm.get_dim_size("y") == 4
+    assert pm.process_ids == list(range(8))
+    m = pm.to_jax_mesh()
+    assert m.axis_names == ("x", "y")
+    assert dict(m.shape) == {"x": 2, "y": 4}
+
+
+def test_process_mesh_validation():
+    with pytest.raises(ValueError):
+        ProcessMesh(mesh=[[0, 1], [2, 3]], dim_names=["only_one"])
+    big = ProcessMesh(shape=[100], dim_names=["x"])
+    with pytest.raises(ValueError):
+        big.to_jax_mesh()
+
+
+def test_shard_tensor_places_array():
+    pm = ProcessMesh(shape=[8], dim_names=["x"])
+    t = paddle.to_tensor(np.arange(32, dtype=np.float32).reshape(8, 4))
+    st = shard_tensor(t, pm, ["x", None])
+    assert st.sharding_spec == ("x", None)
+    # the backing array is actually distributed over 8 devices
+    assert len(st._value.sharding.device_set) == 8
+    np.testing.assert_allclose(np.asarray(st._value),
+                               np.arange(32, dtype=np.float32).reshape(8, 4))
+
+
+def test_shard_tensor_context_mesh():
+    with ProcessMesh(shape=[2, 4], dim_names=["a", "b"]):
+        t = shard_tensor(paddle.ones([4, 8]), shard_spec=["a", "b"])
+        assert t.process_mesh.dim_names == ["a", "b"]
+    with pytest.raises(ValueError):
+        shard_tensor(paddle.ones([4]), shard_spec=[None])
+
+
+def test_shard_op_wraps_callable():
+    pm = ProcessMesh(shape=[8], dim_names=["x"])
+    f = shard_op(lambda a, b: a + b, pm, in_shard_specs=[["x", None], ["x", None]],
+                 out_shard_specs=[["x", None]])
+    a = paddle.ones([8, 4])
+    b = paddle.ones([8, 4])
+    out = f(a, b)
+    np.testing.assert_allclose(np.asarray(out._value), 2 * np.ones((8, 4), np.float32))
+
+
+def test_engine_fit_matches_single_device():
+    """Engine over a dp=8 ProcessMesh must track the single-device loss curve."""
+    from paddle_tpu.io import TensorDataset
+
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 16).astype(np.float32)
+    Y = rng.randint(0, 4, (64,)).astype(np.int64)
+    ds = TensorDataset([X, Y])
+    ce = nn.CrossEntropyLoss()
+
+    # single-device oracle
+    model_ref = _mlp()
+    opt_ref = paddle.optimizer.SGD(learning_rate=0.1, parameters=model_ref.parameters())
+    ref_losses = []
+    for i in range(0, 64, 16):
+        x = paddle.to_tensor(X[i:i + 16]); y = paddle.to_tensor(Y[i:i + 16])
+        loss = ce(model_ref(x), y)
+        loss.backward(); opt_ref.step(); opt_ref.clear_grad()
+        ref_losses.append(float(loss.item()))
+
+    # Engine over the 8-dev mesh (same init via same seed)
+    model = _mlp()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    pm = ProcessMesh(shape=[8], dim_names=["dp"])
+    eng = Engine(model=model, loss=ce, optimizer=opt, process_mesh=pm)
+    eng.fit(ds, epochs=1, batch_size=16, verbose=0, shuffle=False)
+
+    np.testing.assert_allclose(ref_losses, eng.history["loss"][:4], rtol=1e-4, atol=1e-5)
+
+
+def test_engine_evaluate_and_predict():
+    from paddle_tpu.io import TensorDataset
+    from paddle_tpu.metric import Accuracy
+
+    rng = np.random.RandomState(1)
+    X = rng.randn(32, 16).astype(np.float32)
+    Y = rng.randint(0, 4, (32,)).astype(np.int64)
+    ds = TensorDataset([X, Y])
+    model = _mlp()
+    eng = Engine(model=model, loss=nn.CrossEntropyLoss(), metrics=[Accuracy()],
+                 process_mesh=ProcessMesh(shape=[8], dim_names=["dp"]))
+    res = eng.evaluate(ds, batch_size=16)
+    assert np.isfinite(res["loss"])
+    preds = eng.predict(ds, batch_size=16)
+    assert len(preds) == 2 and preds[0].shape == (16, 4)

@@ -117,3 +117,41 @@ def test_recompute_in_jitted_train_step():
     l0 = float(step(x).item())
     l1 = float(step(x).item())
     assert l1 < l0
+
+
+def test_recompute_closure_and_partial_capture_params():
+    """Plain closures/partials over Layers (the common paddle pattern) must still
+    get parameter gradients through recompute."""
+    import functools
+
+    paddle.seed(9)
+    lin = nn.Linear(8, 8)
+
+    x = paddle.to_tensor(np.random.RandomState(9).randn(4, 8).astype(np.float32))
+    loss = paddle.mean(recompute(lambda t: lin(t), x) ** 2)
+    loss.backward()
+    assert lin.weight.grad is not None
+    g_closure = np.asarray(lin.weight.grad._value)
+    lin.clear_gradients()
+
+    loss2 = paddle.mean(lin(x) ** 2)
+    loss2.backward()
+    np.testing.assert_allclose(g_closure, np.asarray(lin.weight.grad._value),
+                               rtol=1e-5, atol=1e-6)
+    lin.clear_gradients()
+
+    fn = functools.partial(lambda l, t: l(t), lin)
+    loss3 = paddle.mean(recompute(fn, x) ** 2)
+    loss3.backward()
+    np.testing.assert_allclose(g_closure, np.asarray(lin.weight.grad._value),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_flash_causal_sq_gt_sk_rejected():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.flash_attention import flash_attention as raw_flash
+
+    q = jnp.ones((1, 256, 1, 64), jnp.float32)
+    k = jnp.ones((1, 128, 1, 64), jnp.float32)
+    with pytest.raises(ValueError, match="Sq <= Sk"):
+        raw_flash(q, k, k, causal=True, block_q=64, block_k=64, interpret=True)
