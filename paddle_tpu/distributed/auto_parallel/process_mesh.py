@@ -92,8 +92,12 @@ class ProcessMesh:
         return self._jax_mesh
 
     def named_sharding(self, shard_spec) -> NamedSharding:
-        clean = tuple(s if s in self._dim_names else None for s in (shard_spec or []))
-        return NamedSharding(self.to_jax_mesh(), P(*clean))
+        for s in shard_spec or []:
+            if s is not None and s not in self._dim_names:
+                raise ValueError(
+                    f"shard_spec dim {s!r} is not one of this mesh's dim_names "
+                    f"{self._dim_names}")
+        return NamedSharding(self.to_jax_mesh(), P(*(shard_spec or [])))
 
     def __enter__(self):
         _g_process_mesh_stack.append(self)

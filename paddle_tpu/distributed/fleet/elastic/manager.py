@@ -104,8 +104,15 @@ class ElasticManager:
         pre = f"{self.prefix}/nodes/"
         out = []
         now = time.time()
+        # use the store's non-blocking get where available: a node deregistering
+        # between the prefix scan and the read must not stall the watcher on a
+        # blocking-G wait (TCPStore.get blocks until the key exists)
+        getter = getattr(self.store, "get_nb", None) or self.store.get
         for k in self.store.keys_with_prefix(pre):
-            v = self.store.get(k)
+            try:
+                v = getter(k)
+            except Exception:
+                continue
             if v is None:
                 continue
             ts = float(v.decode() if isinstance(v, bytes) else v)

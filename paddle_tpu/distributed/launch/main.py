@@ -58,7 +58,10 @@ class CollectiveController:
         n = a.nproc_per_node
         node_rank = max(a.rank, 0)
         global_rank = node_rank * n + local_rank
-        world = self.min_nodes * n
+        # elastic MIN:MAX: the current node count must cover this node's rank —
+        # world reflects it so endpoint indexing stays in range on every node
+        n_nodes = min(max(self.min_nodes, node_rank + 1), self.max_nodes)
+        world = n_nodes * n
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINER_ID": str(global_rank),

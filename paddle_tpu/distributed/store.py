@@ -58,14 +58,17 @@ class _KVServer(threading.Thread):
     def _serve(self, conn):
         try:
             while True:
-                hdr = _recvn(conn, 5)
-                if hdr is None:
+                try:
+                    hdr = _recvn(conn, 5)
+                except ConnectionError:
                     return
                 op = chr(hdr[0])
                 klen = struct.unpack("<I", hdr[1:5])[0]
-                key = _recvn(conn, klen).decode()
+                key = _recvn(conn, klen).decode() if klen else ""
                 vlen = struct.unpack("<I", _recvn(conn, 4))[0]
                 val = _recvn(conn, vlen) if vlen else b""
+                # NOTE: every branch copies under the lock and sends OUTSIDE it —
+                # a stalled client must not wedge the whole store
                 if op == "S":
                     with self._cond:
                         self._data[key] = val
@@ -81,12 +84,20 @@ class _KVServer(threading.Thread):
                     _send_val(conn, str(cur).encode())
                 elif op == "G":  # blocking get
                     with self._cond:
-                        while key not in self._data:
+                        while key not in self._data and self._running:
                             self._cond.wait(timeout=1.0)
-                        _send_val(conn, self._data[key])
+                        out = self._data.get(key)
+                    if out is None:
+                        return  # server stopping
+                    _send_val(conn, out)
+                elif op == "N":  # non-blocking get: presence flag + value
+                    with self._cond:
+                        out = self._data.get(key)
+                    _send_val(conn, b"0" if out is None else b"1" + out)
                 elif op == "W":  # non-blocking check
                     with self._cond:
-                        _send_val(conn, b"1" if key in self._data else b"0")
+                        present = key in self._data
+                    _send_val(conn, b"1" if present else b"0")
                 elif op == "D":
                     with self._cond:
                         self._data.pop(key, None)
@@ -95,6 +106,8 @@ class _KVServer(threading.Thread):
                     with self._cond:
                         keys = [k for k in self._data if k.startswith(key)]
                     _send_val(conn, "\n".join(keys).encode())
+                else:
+                    return
         except (ConnectionError, OSError):
             return
         finally:
@@ -102,6 +115,8 @@ class _KVServer(threading.Thread):
 
     def stop(self):
         self._running = False
+        with self._cond:
+            self._cond.notify_all()  # release blocking-G waiters
         try:
             self._sock.close()
         except OSError:
@@ -109,11 +124,12 @@ class _KVServer(threading.Thread):
 
 
 def _recvn(conn, n):
+    """Read exactly n bytes or raise ConnectionError (EOF / short read)."""
     buf = b""
     while len(buf) < n:
         chunk = conn.recv(n - len(buf))
         if not chunk:
-            return None if not buf else buf
+            raise ConnectionError("peer closed connection")
         buf += chunk
     return buf
 
@@ -126,14 +142,28 @@ class TCPStore(Store):
     """Ref tcp_store.h:120 — host:port KV store; `is_master` runs the server."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, is_master: bool = False,
-                 world_size: int = 1, timeout: float = 300.0):
+                 world_size: int = 1, timeout: float = 300.0, use_native: bool = True):
         self._server = None
         self.timeout = timeout
         if is_master:
-            self._server = _KVServer(port)
-            self._server.start()
+            self._server = self._start_server(port, use_native)
             port = self._server.port
         self.host, self.port = host, port
+
+    @staticmethod
+    def _start_server(port: int, use_native: bool):
+        """Prefer the C++ server (core/native) — same wire protocol; fall back to the
+        Python thread server when the toolchain is unavailable."""
+        if use_native:
+            try:
+                from ..core.native import NativeKVServer
+
+                return NativeKVServer(port)
+            except Exception:
+                pass
+        srv = _KVServer(port)
+        srv.start()
+        return srv
 
     def _rpc(self, op: str, key: str, value: bytes = b"") -> bytes:
         deadline = time.time() + self.timeout
@@ -157,6 +187,11 @@ class TCPStore(Store):
 
     def get(self, key) -> bytes:
         return self._rpc("G", key)
+
+    def get_nb(self, key) -> bytes | None:
+        """Non-blocking get: None if the key is absent (op 'N')."""
+        out = self._rpc("N", key)
+        return out[1:] if out[:1] == b"1" else None
 
     def add(self, key, amount: int) -> int:
         return int(self._rpc("A", key, str(amount).encode()).decode())

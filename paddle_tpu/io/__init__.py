@@ -274,12 +274,99 @@ class _PrefetchIter:
         return item
 
 
+class _NativeWorkerIter:
+    """Multi-worker prefetch over the C++ ring (core/native NativeRing).
+
+    Reference analog: the multiprocess `_DataLoaderIterMultiProcess`
+    (fluid/dataloader/dataloader_iter.py:342) whose workers push batches through
+    shared memory.  Here N fetcher threads run __getitem__ + collate (numpy releases
+    the GIL for the heavy copies) and push pickled batches into a GIL-free C++ MPMC
+    ring; batch order follows ring arrival (like the reference's out-of-order cache,
+    without the reordering — samplers shard disjoint indices so epoch coverage is
+    exact)."""
+
+    def __init__(self, loader, num_workers, depth):
+        import pickle
+
+        from ..core.native import NativeRing
+
+        self._pickle = pickle
+        self._ring = NativeRing(depth)
+        self._loader = loader
+        indices = list(loader.batch_sampler)
+        self._n_batches = len(indices)
+        self._received = 0
+        self._shards = [indices[w::num_workers] for w in range(num_workers)]
+        self._threads = [
+            threading.Thread(target=self._worker, args=(shard,), daemon=True)
+            for shard in self._shards if shard
+        ]
+        self._live = len(self._threads)
+        self._live_lock = threading.Lock()
+        for t in self._threads:
+            t.start()
+
+    def _worker(self, shard):
+        try:
+            for idx_batch in shard:
+                batch = [self._loader.dataset[i] for i in idx_batch]
+                collated = self._loader.collate_fn(batch)
+                if not self._ring.push(self._pickle.dumps(collated, protocol=4)):
+                    return  # ring closed by consumer
+        except BaseException as e:
+            try:
+                payload = self._pickle.dumps(("__error__", e), protocol=4)
+            except Exception:
+                # unpicklable exception payload: surface type + message, not silence
+                payload = self._pickle.dumps(
+                    ("__error__", RuntimeError(f"{type(e).__name__}: {e}")), protocol=4)
+            try:
+                self._ring.push(payload)
+            except Exception:
+                pass
+        finally:
+            with self._live_lock:
+                self._live -= 1
+                if self._live == 0:
+                    self._ring.close()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._received >= self._n_batches:
+            self._ring.close()
+            raise StopIteration
+        data = self._ring.pop()
+        if data is None:
+            raise StopIteration
+        item = self._pickle.loads(data)
+        if (isinstance(item, tuple) and len(item) == 2
+                and isinstance(item[0], str) and item[0] == "__error__"):
+            raise item[1]
+        self._received += 1
+        return self._loader._to_tensors(item)
+
+    def __del__(self):
+        # free the C++ ring only once every worker thread is done with it
+        try:
+            self._ring.close()
+            for t in self._threads:
+                t.join(timeout=1.0)
+            if all(not t.is_alive() for t in self._threads):
+                self._ring.free()
+        except Exception:
+            pass
+
+
 class DataLoader:
     """Ref: fluid/reader.py:275 DataLoader (+dataloader_iter.py:148,342).
 
-    num_workers>0 uses a background prefetch thread (the reference's multiprocess
-    workers + shared memory are unnecessary here: batches are numpy, and the step's
-    H2D copy is async under JAX).
+    num_workers>0 prefetches in the background: preferred path is N worker threads
+    feeding a GIL-free C++ ring buffer (core/native), falling back to a single
+    Python prefetch thread when the native library is unavailable.  The reference's
+    process workers + shared memory are unnecessary: batches are numpy, and the
+    step's H2D copy is async under JAX.
     """
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
@@ -300,6 +387,7 @@ class DataLoader:
         else:
             self.batch_sampler = None
         self.batch_size = batch_size
+        self._use_shared_memory = use_shared_memory
 
     def _gen(self):
         if self._iterable_mode:
@@ -325,6 +413,12 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers and self.num_workers > 0:
+            if self.batch_sampler is not None and self._use_shared_memory:
+                try:
+                    return _NativeWorkerIter(self, self.num_workers,
+                                             self.num_workers * self.prefetch_factor)
+                except Exception:
+                    pass
             return _PrefetchIter(self._gen, self.num_workers * self.prefetch_factor)
         return self._gen()
 
