@@ -162,6 +162,8 @@ class NativeRing:
                 raise TimeoutError("ring pop timed out")
             if n == -2:
                 continue  # raced with a larger item; retry with its size
+            if n == -3:
+                return b""  # popped item with empty payload (distinct from end)
             if n == 0:
                 return None  # closed and drained
             return buf.raw[:n]

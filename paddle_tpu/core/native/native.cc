@@ -28,7 +28,9 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <cerrno>
 #include <chrono>
+#include <cstdlib>
 #include <condition_variable>
 #include <cstdint>
 #include <cstring>
@@ -110,14 +112,33 @@ void serve_conn(KVServer* s, int fd) {
       s->cv.notify_all();
       if (!send_val(fd, "ok")) break;
     } else if (op == 'A') {
-      long long cur = 0;
+      // strtoll with full error checking: a non-numeric stored value or payload
+      // must produce an in-band error reply, not an exception that would
+      // std::terminate() the rendezvous server's worker thread.
+      auto parse_ll = [](const std::string& str, long long* out) -> bool {
+        if (str.empty()) { *out = 0; return true; }
+        errno = 0;
+        char* end = nullptr;
+        long long v = std::strtoll(str.c_str(), &end, 10);
+        if (errno != 0 || end == str.c_str() || *end != '\0') return false;
+        *out = v;
+        return true;
+      };
+      long long cur = 0, inc = 0;
+      bool parsed = true;
       {
         std::lock_guard<std::mutex> lk(s->mu);
         auto it = s->data.find(key);
-        if (it != s->data.end() && !it->second.empty())
-          cur = std::stoll(it->second);
-        cur += std::stoll(val.empty() ? "0" : val);
-        s->data[key] = std::to_string(cur);
+        parsed = (it == s->data.end() || parse_ll(it->second, &cur)) &&
+                 parse_ll(val, &inc);
+        if (parsed) {
+          cur += inc;
+          s->data[key] = std::to_string(cur);
+        }
+      }
+      if (!parsed) {
+        if (!send_val(fd, "ERR non-integer value")) break;
+        continue;
       }
       s->cv.notify_all();
       if (!send_val(fd, std::to_string(cur))) break;
@@ -270,7 +291,8 @@ int pt_ring_push(void* h, const char* data, int64_t n, double timeout_s) {
   return 1;
 }
 
-// returns size of popped item (>=0), 0 with closed ring means end, -1 on timeout
+// returns size of popped item (>0), -3 for a popped zero-length item,
+// 0 for closed-and-drained (end of stream), -1 on timeout, -2 buffer too small
 int64_t pt_ring_pop(void* h, char* out, int64_t out_cap, double timeout_s) {
   auto* r = static_cast<Ring*>(h);
   std::unique_lock<std::mutex> lk(r->mu);
@@ -289,7 +311,7 @@ int64_t pt_ring_pop(void* h, char* out, int64_t out_cap, double timeout_s) {
   r->popped++;
   lk.unlock();
   r->not_full.notify_one();
-  return n;
+  return n == 0 ? -3 : n;  // -3 disambiguates an empty payload from end-of-stream
 }
 
 // peek size of the next item without popping (-1 if empty)

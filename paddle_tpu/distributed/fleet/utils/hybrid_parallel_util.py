@@ -10,7 +10,7 @@ re-place tensors with the replicated sharding to force consistency.
 """
 from __future__ import annotations
 
-from ...collective import all_reduce, broadcast
+from ...collective import ReduceOp, all_reduce, broadcast
 
 
 def broadcast_input_data(hcg, *inputs, **kwargs):
@@ -35,15 +35,17 @@ def broadcast_sharding_parameters(model, hcg):
 
 
 def fused_allreduce_gradients(parameter_list, hcg):
-    """Ref: fused_allreduce_gradients — dp-group grad allreduce.  SPMD emits these
-    inside the compiled step; eagerly, reduce each grad over the dp axis and write
-    the reduced value back into the parameter's accumulated grad."""
+    """Ref: fused_allreduce_gradients — dp-group grad allreduce.  The reference
+    (_apply_collective_grads_eager, hybrid_parallel_util.py:83) scales grads by
+    1/nranks before the allreduce, i.e. the contract is an AVERAGE over the dp
+    group; ReduceOp.AVG (lax.pmean in-trace) matches that."""
     from ....tensor.tensor import Tensor
 
     group = hcg.get_data_parallel_group() if hcg is not None else None
     for p in parameter_list:
         if getattr(p, "_grad", None) is not None:
-            out = all_reduce(Tensor(p._grad, stop_gradient=True), group=group)
+            out = all_reduce(Tensor(p._grad, stop_gradient=True),
+                             op=ReduceOp.AVG, group=group)
             p._grad = out._value if isinstance(out, Tensor) else out
 
 

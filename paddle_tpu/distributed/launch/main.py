@@ -15,6 +15,21 @@ import signal
 import subprocess
 import sys
 import time
+import zlib
+
+
+def _detect_host(master_host: str) -> str:
+    """Local address as seen on the route toward the master (no traffic sent)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((master_host, 9))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
 
 
 def parse_args(argv=None):
@@ -45,13 +60,46 @@ class CollectiveController:
         self.args = args
         self.procs: list[subprocess.Popen] = []
         self.restarts = 0
+        self._host_list = None
         nn = str(args.nnodes)
         self.min_nodes = int(nn.split(":")[0])
         self.max_nodes = int(nn.split(":")[-1])
 
     def _endpoints(self, n):
-        base = 61000 + (hash(self.args.job_id) % 1000)
-        return ",".join(f"127.0.0.1:{base + i}" for i in range(n))
+        # deterministic port base: hash() is randomized per process (PYTHONHASHSEED),
+        # which would give every launcher invocation/node a different endpoint list
+        # for the same job_id; crc32 is stable across processes and hosts
+        base = 61000 + (zlib.crc32(self.args.job_id.encode()) % 1000)
+        nproc = self.args.nproc_per_node
+        hosts = self._hosts()
+        # ports stay globally unique so multi-node-on-localhost tests don't collide
+        return ",".join(f"{hosts[min(i // nproc, len(hosts) - 1)]}:{base + i}"
+                        for i in range(n))
+
+    def _hosts(self):
+        """One host per node.  Multi-node: every launcher registers its own address
+        in the master rendezvous store and reads back the full list, so all nodes
+        agree on PADDLE_TRAINER_ENDPOINTS (ref: the reference master/watch KV
+        rendezvous in launch/controllers/master.py).  Single-node: loopback."""
+        if self.max_nodes > 1 and self.args.master:
+            if self._host_list is None:
+                self._host_list = self._rendezvous_hosts()
+            return self._host_list
+        return ["127.0.0.1"] * max(self.max_nodes, 1)
+
+    def _rendezvous_hosts(self):
+        from ..store import TCPStore
+
+        a = self.args
+        master_host, master_port = a.master.rsplit(":", 1)
+        node_rank = max(a.rank, 0)
+        local = os.environ.get("PADDLE_LOCAL_HOST") or _detect_host(master_host)
+        store = TCPStore(master_host, int(master_port),
+                         is_master=(node_rank == 0), world_size=self.min_nodes)
+        store.set(f"{a.job_id}/host/{node_rank}", local.encode())
+        # blocking get = barrier until every node has registered
+        return [store.get(f"{a.job_id}/host/{r}").decode()
+                for r in range(self.min_nodes)]
 
     def build_env(self, local_rank: int) -> dict:
         a = self.args

@@ -75,13 +75,17 @@ class _KVServer(threading.Thread):
                         self._cond.notify_all()
                     _send_val(conn, b"ok")
                 elif op == "A":
-                    amt = int(val.decode())
-                    with self._cond:
-                        cur = int(self._data.get(key, b"0").decode() or 0)
-                        cur += amt
-                        self._data[key] = str(cur).encode()
-                        self._cond.notify_all()
-                    _send_val(conn, str(cur).encode())
+                    try:
+                        amt = int(val.decode())
+                        with self._cond:
+                            cur = int(self._data.get(key, b"0").decode() or 0)
+                            cur += amt
+                            self._data[key] = str(cur).encode()
+                            self._cond.notify_all()
+                        reply = str(cur).encode()
+                    except ValueError:
+                        reply = b"ERR non-integer value"
+                    _send_val(conn, reply)
                 elif op == "G":  # blocking get
                     with self._cond:
                         while key not in self._data and self._running:
@@ -194,7 +198,11 @@ class TCPStore(Store):
         return out[1:] if out[:1] == b"1" else None
 
     def add(self, key, amount: int) -> int:
-        return int(self._rpc("A", key, str(amount).encode()).decode())
+        out = self._rpc("A", key, str(amount).encode())
+        if out.startswith(b"ERR"):
+            raise ValueError(
+                f"TCPStore.add({key!r}): stored value is not an integer")
+        return int(out.decode())
 
     def check(self, key) -> bool:
         return self._rpc("W", key) == b"1"

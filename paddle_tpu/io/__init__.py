@@ -281,9 +281,9 @@ class _NativeWorkerIter:
     (fluid/dataloader/dataloader_iter.py:342) whose workers push batches through
     shared memory.  Here N fetcher threads run __getitem__ + collate (numpy releases
     the GIL for the heavy copies) and push pickled batches into a GIL-free C++ MPMC
-    ring; batch order follows ring arrival (like the reference's out-of-order cache,
-    without the reordering — samplers shard disjoint indices so epoch coverage is
-    exact)."""
+    ring.  Each batch is tagged with its sampler ordinal and the consumer reorders
+    via a small cache, preserving strict sampler order exactly like the reference's
+    `_rcvd_idx` reorder cache (dataloader_iter.py:356)."""
 
     def __init__(self, loader, num_workers, depth):
         import pickle
@@ -296,7 +296,20 @@ class _NativeWorkerIter:
         indices = list(loader.batch_sampler)
         self._n_batches = len(indices)
         self._received = 0
-        self._shards = [indices[w::num_workers] for w in range(num_workers)]
+        self._reorder = {}  # sampler ordinal -> collated batch
+        # producer-side window: a worker may only fetch ordinal o once
+        # o < received + window, bounding outstanding batches (ring + reorder
+        # cache) the way the reference bounds _outstanding_capacity — otherwise
+        # one slow worker lets the fast ones park a whole epoch in the cache
+        self._window = max(depth, num_workers)
+        self._win_cv = threading.Condition()
+        self._stopped = False
+        # shard round-robin: worker w owns ordinals w, w+N, w+2N, ...
+        self._shards = [
+            [(w + k * num_workers, idx_batch)
+             for k, idx_batch in enumerate(indices[w::num_workers])]
+            for w in range(num_workers)
+        ]
         self._threads = [
             threading.Thread(target=self._worker, args=(shard,), daemon=True)
             for shard in self._shards if shard
@@ -308,10 +321,17 @@ class _NativeWorkerIter:
 
     def _worker(self, shard):
         try:
-            for idx_batch in shard:
+            for ordinal, idx_batch in shard:
+                with self._win_cv:
+                    while (not self._stopped
+                           and ordinal >= self._received + self._window):
+                        self._win_cv.wait(0.1)
+                    if self._stopped:
+                        return
                 batch = [self._loader.dataset[i] for i in idx_batch]
                 collated = self._loader.collate_fn(batch)
-                if not self._ring.push(self._pickle.dumps(collated, protocol=4)):
+                payload = self._pickle.dumps((ordinal, collated), protocol=4)
+                if not self._ring.push(payload):
                     return  # ring closed by consumer
         except BaseException as e:
             try:
@@ -337,19 +357,28 @@ class _NativeWorkerIter:
         if self._received >= self._n_batches:
             self._ring.close()
             raise StopIteration
-        data = self._ring.pop()
-        if data is None:
-            raise StopIteration
-        item = self._pickle.loads(data)
-        if (isinstance(item, tuple) and len(item) == 2
-                and isinstance(item[0], str) and item[0] == "__error__"):
-            raise item[1]
-        self._received += 1
+        while self._received not in self._reorder:
+            data = self._ring.pop()
+            if data is None:
+                raise StopIteration
+            item = self._pickle.loads(data)
+            if (isinstance(item, tuple) and len(item) == 2
+                    and isinstance(item[0], str) and item[0] == "__error__"):
+                raise item[1]
+            ordinal, collated = item
+            self._reorder[ordinal] = collated
+        item = self._reorder.pop(self._received)
+        with self._win_cv:
+            self._received += 1
+            self._win_cv.notify_all()
         return self._loader._to_tensors(item)
 
     def __del__(self):
         # free the C++ ring only once every worker thread is done with it
         try:
+            with self._win_cv:
+                self._stopped = True
+                self._win_cv.notify_all()
             self._ring.close()
             for t in self._threads:
                 t.join(timeout=1.0)

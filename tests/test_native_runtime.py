@@ -114,9 +114,59 @@ def test_dataloader_native_worker_path():
     assert type(it).__name__ == "_NativeWorkerIter"
     batches = list(it)
     assert len(batches) == 8
-    # all rows present exactly once (order across workers may interleave)
-    seen = np.sort(np.concatenate([np.asarray(b[1]._value) for b in batches]))
-    np.testing.assert_array_equal(seen, np.arange(64))
+    # strict sampler order (reference _rcvd_idx reorder-cache contract): batch k
+    # holds rows [8k, 8k+8) even though workers race on the ring
+    got = np.concatenate([np.asarray(b[1]._value) for b in batches])
+    np.testing.assert_array_equal(got, np.arange(64))
+
+
+def test_dataloader_native_worker_preserves_order_with_slow_worker():
+    import time
+
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class Slow(Dataset):
+        """Even indices are slow: worker 0 (owner of batches 0,2,..) lags so a
+        naive arrival-order iterator would yield odd batches first."""
+
+        def __len__(self):
+            return 32
+
+        def __getitem__(self, i):
+            if (i // 4) % 2 == 0:
+                time.sleep(0.01)
+            return np.full(2, i, np.float32)
+
+    loader = DataLoader(Slow(), batch_size=4, num_workers=2, shuffle=False)
+    it = iter(loader)
+    assert type(it).__name__ == "_NativeWorkerIter"
+    got = np.concatenate([np.asarray(b._value)[:, 0] for b in it])
+    np.testing.assert_array_equal(got, np.arange(32))
+
+
+def test_ring_empty_payload_distinct_from_close():
+    from paddle_tpu.core.native import NativeRing
+
+    ring = NativeRing(4)
+    assert ring.push(b"")
+    assert ring.push(b"x")
+    assert ring.pop(timeout=5.0) == b""   # empty payload, NOT end-of-stream
+    assert ring.pop(timeout=5.0) == b"x"
+    ring.close()
+    assert ring.pop(timeout=5.0) is None  # closed and drained
+    ring.free()
+
+
+def test_store_add_non_integer_value_errors_not_crashes():
+    from paddle_tpu.distributed.store import TCPStore
+
+    store = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    store.set("strkey", b"not-a-number")
+    with pytest.raises(ValueError):
+        store.add("strkey", 1)
+    # server must survive the bad request
+    assert store.add("ctr", 2) == 2
+    assert store.add("ctr", 3) == 5
 
 
 def test_dataloader_native_worker_propagates_errors():
