@@ -61,6 +61,7 @@ class CollectiveController:
         self.procs: list[subprocess.Popen] = []
         self.restarts = 0
         self._host_list = None
+        self._rdzv_rank = None
         nn = str(args.nnodes)
         self.min_nodes = int(nn.split(":")[0])
         self.max_nodes = int(nn.split(":")[-1])
@@ -76,47 +77,86 @@ class CollectiveController:
         return ",".join(f"{hosts[min(i // nproc, len(hosts) - 1)]}:{base + i}"
                         for i in range(n))
 
-    def _hosts(self):
-        """One host per node.  Multi-node: every launcher registers its own address
-        in the master rendezvous store and reads back the full list, so all nodes
-        agree on PADDLE_TRAINER_ENDPOINTS (ref: the reference master/watch KV
-        rendezvous in launch/controllers/master.py).  Single-node: loopback."""
-        if self.max_nodes > 1 and self.args.master:
-            if self._host_list is None:
-                self._host_list = self._rendezvous_hosts()
-            return self._host_list
-        return ["127.0.0.1"] * max(self.max_nodes, 1)
+    def _multi_node(self):
+        return self.max_nodes > 1 and self.args.master
 
-    def _rendezvous_hosts(self):
+    def _hosts(self):
+        """One agreed host list, one entry per node (see _rendezvous).
+        Single-node: loopback."""
+        if self._multi_node():
+            self._rendezvous()
+            return self._host_list
+        n_nodes = min(max(self.min_nodes, max(self.args.rank, 0) + 1), self.max_nodes)
+        return ["127.0.0.1"] * max(n_nodes, 1)
+
+    def node_rank(self):
+        if self._multi_node():
+            self._rendezvous()
+            return self._rdzv_rank
+        return max(self.args.rank, 0)
+
+    def _rendezvous(self):
+        """Agree on (node_rank, host list) across all launchers (ref: the KV
+        rendezvous in launch/controllers/master.py).
+
+        Mastership: explicit --rank 0 hosts the store; --rank>0 connects; with
+        --rank -1 (auto) the node that wins the bind race on the master port hosts
+        it.  Auto ranks come from an atomic counter; node 0 then publishes the
+        final host list under {job}/world so every node sees the SAME world size
+        and endpoints (late joiners beyond that list get a clear error)."""
+        if self._host_list is not None:
+            return
         from ..store import TCPStore
 
         a = self.args
         master_host, master_port = a.master.rsplit(":", 1)
-        node_rank = max(a.rank, 0)
         local = os.environ.get("PADDLE_LOCAL_HOST") or _detect_host(master_host)
-        store = TCPStore(master_host, int(master_port),
-                         is_master=(node_rank == 0), world_size=self.min_nodes)
+        if a.rank == 0:
+            store = TCPStore(master_host, int(master_port), is_master=True)
+        elif a.rank > 0:
+            store = TCPStore(master_host, int(master_port), is_master=False)
+        else:
+            try:
+                store = TCPStore(master_host, int(master_port), is_master=True,
+                                 use_native=False)
+            except OSError:
+                store = TCPStore(master_host, int(master_port), is_master=False)
+        node_rank = a.rank if a.rank >= 0 else store.add(f"{a.job_id}/nrank", 1) - 1
         store.set(f"{a.job_id}/host/{node_rank}", local.encode())
-        # blocking get = barrier until every node has registered
-        return [store.get(f"{a.job_id}/host/{r}").decode()
-                for r in range(self.min_nodes)]
+        if node_rank == 0:
+            # barrier on the minimum quorum, then fold in any extra early joiners
+            hosts = [store.get(f"{a.job_id}/host/{r}").decode()
+                     for r in range(self.min_nodes)]
+            n_reg = store.add(f"{a.job_id}/nrank", 0) if a.rank < 0 else self.min_nodes
+            n_use = min(max(int(n_reg), self.min_nodes), self.max_nodes)
+            hosts += [store.get(f"{a.job_id}/host/{r}").decode()
+                      for r in range(self.min_nodes, n_use)]
+            store.set(f"{a.job_id}/world", ",".join(hosts).encode())
+        else:
+            hosts = store.get(f"{a.job_id}/world").decode().split(",")
+        if node_rank >= len(hosts):
+            raise RuntimeError(
+                f"node rank {node_rank} joined after the job world of "
+                f"{len(hosts)} nodes was sealed; scale-up of a running job goes "
+                "through fleet.elastic, not the launcher")
+        self._rdzv_rank = node_rank
+        self._host_list = hosts
+        self._store = store  # keep the master server thread alive
 
     def build_env(self, local_rank: int) -> dict:
         a = self.args
         n = a.nproc_per_node
-        node_rank = max(a.rank, 0)
+        node_rank = self.node_rank()
         global_rank = node_rank * n + local_rank
-        # elastic MIN:MAX: the current node count must cover this node's rank —
-        # world reflects it so endpoint indexing stays in range on every node
-        n_nodes = min(max(self.min_nodes, node_rank + 1), self.max_nodes)
-        world = n_nodes * n
+        world = len(self._hosts()) * n
+        eps = self._endpoints(world)
         env = dict(os.environ)
         env.update({
             "PADDLE_TRAINER_ID": str(global_rank),
             "PADDLE_LOCAL_RANK": str(local_rank),
             "PADDLE_TRAINERS_NUM": str(world),
-            "PADDLE_TRAINER_ENDPOINTS": self._endpoints(world),
-            "PADDLE_CURRENT_ENDPOINT": self._endpoints(world).split(",")[global_rank],
+            "PADDLE_TRAINER_ENDPOINTS": eps,
+            "PADDLE_CURRENT_ENDPOINT": eps.split(",")[global_rank],
             "PADDLE_JOB_ID": a.job_id,
         })
         if a.master:

@@ -34,11 +34,21 @@ def save(layer, path, input_spec=None, **configs):
     """
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     state = {}
+    param_keys, buffer_keys = [], []
     if isinstance(layer, Layer):
-        for k, v in layer.state_dict().items():
+        for k, v in layer.named_parameters():
             state[k] = np.asarray(v._value)
+            param_keys.append(k)
+        for k, v in layer.named_buffers():
+            state[k] = np.asarray(v._value)
+            buffer_keys.append(k)
     with open(path + ".pdiparams", "wb") as f:
         pickle.dump(state, f)
+    # the exported closure was traced with the exact (params, buffers) pytree from
+    # functional_state(); persist the key split so load() can rebuild it (the round-1
+    # bug: stuffing everything into __params__ broke any model with buffers, e.g. BN)
+    with open(path + ".pdiparams.info", "wb") as f:
+        pickle.dump({"param_keys": param_keys, "buffer_keys": buffer_keys}, f)
 
     if input_spec is not None and isinstance(layer, Layer):
         from jax import export as jax_export
@@ -74,38 +84,49 @@ def save(layer, path, input_spec=None, **configs):
 class TranslatedLayer(Layer):
     """Ref: fluid/dygraph/io.py TranslatedLayer — a loaded inference program."""
 
-    def __init__(self, exported, state):
+    def __init__(self, exported, params, buffers):
         super().__init__()
         self._exported = exported
-        self._state = state
+        self._params = params    # flat {name: jnp array}, the exact exported pytree
+        self._buffers_tree = buffers
 
     def forward(self, *args):
-        params = {k: v for k, v in self._state.items()}
         raw = tuple(a._value if isinstance(a, Tensor) else a for a in args)
-        out = self._exported.call(params["__params__"], params["__buffers__"], *raw)
+        out = self._exported.call(self._params, self._buffers_tree, *raw)
         if isinstance(out, (tuple, list)):
             outs = tuple(Tensor(o) for o in out)
             return outs[0] if len(outs) == 1 else outs
         return Tensor(out)
 
+    def state_dict(self, *a, **kw):
+        import jax.numpy as jnp
+
+        return {k: Tensor(jnp.asarray(v))
+                for k, v in {**self._params, **self._buffers_tree}.items()}
+
 
 def load(path, **configs):
     """jit.load parity (ref fluid/dygraph/jit.py:1069)."""
+    import jax.numpy as jnp
+
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
+    info_file = path + ".pdiparams.info"
+    if os.path.exists(info_file):
+        with open(info_file, "rb") as f:
+            info = pickle.load(f)
+        params = {k: jnp.asarray(state[k]) for k in info["param_keys"]}
+        buffers = {k: jnp.asarray(state[k]) for k in info["buffer_keys"]}
+    else:  # legacy save: assume everything is a parameter
+        params = {k: jnp.asarray(v) for k, v in state.items()}
+        buffers = {}
     model_file = path + ".pdmodel"
     if os.path.exists(model_file):
         from jax import export as jax_export
 
         with open(model_file, "rb") as f:
             exported = jax_export.deserialize(f.read())
-        # reconstruct params/buffers trees the exported fn expects
-        t = TranslatedLayer(exported, {"__params__": {}, "__buffers__": {}})
-        # state keys were flattened from named_parameters/buffers; the exported call
-        # closure needs exactly the same pytree: rebuild both dicts
-        t._state["__params__"] = {k: v for k, v in state.items()}
-        t._state["__buffers__"] = {}
-        return t
+        return TranslatedLayer(exported, params, buffers)
     raise FileNotFoundError(f"no serialized program at {model_file}; "
                             f"load params with paddle.load({path + '.pdiparams'!r}) instead")
 

@@ -253,6 +253,9 @@ class Layer:
     # ------------------------------------------------------------- state dict
     def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="", use_hook=True):
         """Ref layers.py:1407."""
+        hook = getattr(self, "_pre_state_hook", None)
+        if hook is not None:
+            hook()  # e.g. stacked-pipeline weights written back before reading
         dest = destination if destination is not None else OrderedDict()
         for name, p in self.named_parameters():
             dest[structured_name_prefix + name] = p
@@ -328,8 +331,11 @@ class Layer:
             p.clear_grad()
 
     # ------------------------------------------------------------- functional bridge
-    def functional_state(self):
+    def functional_state(self, _sync=True):
         """(params_dict, buffers_dict) of raw jax arrays — the pytree handed to jit."""
+        hook = getattr(self, "_pre_state_hook", None)
+        if _sync and hook is not None:
+            hook()
         params = {k: p._value for k, p in self.named_parameters()}
         buffers = {k: b._value for k, b in self.named_buffers()}
         return params, buffers

@@ -23,6 +23,10 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 NEG_INF = -1e30
+# TPU vector lanes: the lse/dsum residuals are broadcast along a 128-lane minor dim
+# so their block shapes satisfy the mosaic (8, 128) tiling rule (same trick as
+# jax.experimental.pallas.ops.tpu.flash_attention MIN_BLOCK_SIZE).
+LANES = 128
 
 
 def _interpret_default():
@@ -62,7 +66,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, s
     acc0 = jnp.zeros((bq, q.shape[-1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, acc0))
     o_ref[0] = (acc / l).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l))[:, 0]
+    lse_ref[0] = jnp.broadcast_to(m + jnp.log(l), (bq, LANES))
 
 
 def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
@@ -80,11 +84,11 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, qi: (bh, qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -92,13 +96,13 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
 
 
 # -------------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                *, scale, causal, bq, bk, seq_q, seq_k):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, None]   # [bq, 1]
-    dsum = dsum_ref[0][:, None]
+    lse = lse_ref[0][:, :1]     # [bq, 1] (lanes-broadcast residual)
+    dsum = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
     nkb = pl.cdiv(seq_k, bk)
     off = seq_k - seq_q
     if causal:
@@ -123,7 +127,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dq_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dk_ref, dv_ref,
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
                 *, scale, causal, bq, bk, seq_q, seq_k):
     kj = pl.program_id(1)
     k = k_ref[0].astype(jnp.float32)   # [bk, D]
@@ -136,8 +140,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dk_ref, dv_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(qi * bq, bq)][:, None]
-        dsum = dsum_ref[0, pl.ds(qi * bq, bq)][:, None]
+        o = o_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(qi * bq, bq), :1]
+        dsum = jnp.sum(do * o, axis=-1, keepdims=True)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -164,7 +169,6 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref, dk_ref, dv_ref,
 def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
-    dsum = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [BH, Sq]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
@@ -175,13 +179,13 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             pl.BlockSpec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
-            pl.BlockSpec((1, bq), lambda bh, qi: (bh, qi)),
+            pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((1, bq, LANES), lambda bh, qi: (bh, qi, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         interpret=interpret,
-    )(q, k, v, do, lse, dsum)
+    )(q, k, v, o, do, lse)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
@@ -192,8 +196,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             pl.BlockSpec((1, bk, D), lambda bh, kj: (bh, kj, 0)),
             pl.BlockSpec((1, bk, D), lambda bh, kj: (bh, kj, 0)),
             pl.BlockSpec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),
-            pl.BlockSpec((1, Sq), lambda bh, kj: (bh, 0)),
-            pl.BlockSpec((1, Sq), lambda bh, kj: (bh, 0)),
+            pl.BlockSpec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),
+            pl.BlockSpec((1, Sq, LANES), lambda bh, kj: (bh, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bh, kj: (bh, kj, 0)),
@@ -204,7 +208,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, dsum)
+    )(q, k, v, o, do, lse)
     return dq, dk, dv
 
 

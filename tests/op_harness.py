@@ -1,0 +1,215 @@
+"""OpTest analog (ref: python/paddle/fluid/tests/unittests/op_test.py:309).
+
+The reference harness checks every op's output on every place (`check_output`) and its
+analytic gradient against finite differences (`check_grad`).  The TPU-native analog
+checks, for each op spec:
+
+  1. eager vs jit parity   — the tape path and the traced path must agree exactly
+  2. f32 vs bf16 behavior  — op must run in bf16 and stay within loose tolerance
+  3. analytic grad vs finite difference — tape backward vs a central-difference
+     directional probe  u . (f(x+eps v) - f(x-eps v)) / 2eps  ==  < grad(u.f), v >
+
+Specs are declarative; test_op_suite.py sweeps them with pytest.parametrize.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor.tensor import Tensor
+
+
+class In:
+    """Input spec: a float tensor by default; kind: 'float'|'pos'|'unit'|'int'|'bool'."""
+
+    def __init__(self, *shape, kind="float", low=None, high=None, dtype=None):
+        self.shape = shape
+        self.kind = kind
+        self.low = low
+        self.high = high
+        self.dtype = dtype
+
+    def make(self, rng):
+        s = self.shape
+        if self.kind == "float":
+            a = rng.standard_normal(s).astype(np.float32)
+        elif self.kind == "pos":          # strictly positive, away from 0
+            a = (rng.random(s) * 1.5 + 0.3).astype(np.float32)
+        elif self.kind == "unit":         # open interval (lo, hi), away from edges
+            lo = 0.05 if self.low is None else self.low
+            hi = 0.95 if self.high is None else self.high
+            a = (rng.random(s) * (hi - lo) + lo).astype(np.float32)
+        elif self.kind == "int":
+            a = rng.integers(self.low or 0, self.high or 10, s).astype(self.dtype or np.int32)
+        elif self.kind == "bool":
+            a = rng.random(s) > 0.5
+        else:
+            raise ValueError(self.kind)
+        if self.dtype and self.kind != "int":
+            a = a.astype(self.dtype)
+        return a
+
+
+class OpSpec:
+    def __init__(self, name, fn, inputs, kwargs=None, *, grad=True, bf16=True,
+                 jit=True, grad_rtol=1e-2, grad_atol=1e-3, bf16_rtol=0.08,
+                 bf16_atol=0.05, eps=1e-2, nondiff_smooth=False):
+        self.name = name
+        self.fn = fn
+        self.inputs = inputs
+        self.kwargs = kwargs or {}
+        self.grad = grad
+        self.bf16 = bf16
+        self.jit = jit
+        self.grad_rtol = grad_rtol
+        self.grad_atol = grad_atol
+        self.bf16_rtol = bf16_rtol
+        self.bf16_atol = bf16_atol
+        self.eps = eps
+        # ops with kinks (relu/abs/min/max): retry the fd probe at a shifted point
+        self.nondiff_smooth = nondiff_smooth
+
+    def __repr__(self):
+        return f"OpSpec({self.name})"
+
+    def make_inputs(self, seed=0):
+        rng = np.random.default_rng(seed)
+        return [i.make(rng) for i in self.inputs]
+
+
+def _flatten_all(out):
+    """Collect ALL arrays from (possibly nested) op output, as a list of jnp arrays."""
+    outs = []
+
+    def rec(o):
+        if isinstance(o, (tuple, list)):
+            for x in o:
+                rec(x)
+        elif isinstance(o, Tensor):
+            rec(o._value)
+        elif o is not None:
+            outs.append(jnp.asarray(o))
+
+    rec(out)
+    return outs
+
+
+def _is_float(a):
+    return jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+
+
+def _flatten_floats(out):
+    return [a for a in _flatten_all(out) if _is_float(a)]
+
+
+def _run_eager(spec, arrays, stop_gradient=True):
+    ts = [paddle.to_tensor(a, stop_gradient=stop_gradient) for a in arrays]
+    return ts, spec.fn(*ts, **spec.kwargs)
+
+
+def check_output_jit(spec, seed=0):
+    """Eager vs jit parity (ref OpTest.check_output / check_eager)."""
+    arrays = spec.make_inputs(seed)
+    _, eager_out = _run_eager(spec, arrays)
+    eager = [np.asarray(o) for o in _flatten_all(eager_out)]
+
+    def pure(*raw):
+        ts = [Tensor(r) for r in raw]
+        return tuple(_flatten_all(spec.fn(*ts, **spec.kwargs)))
+
+    jit_out = jax.jit(pure)(*arrays)
+    assert len(jit_out) == len(eager), f"{spec.name}: output arity mismatch"
+    for e, j in zip(eager, jit_out):
+        np.testing.assert_allclose(
+            e, np.asarray(j), rtol=1e-5, atol=1e-5,
+            err_msg=f"{spec.name}: eager vs jit mismatch")
+
+
+def check_bf16(spec, seed=0):
+    """Op runs in bf16 and tracks the f32 result (ref: OpTest bf16 place sweep)."""
+    arrays = spec.make_inputs(seed)
+    _, out32 = _run_eager(spec, arrays)
+    ref = [np.asarray(o, np.float32) for o in _flatten_floats(out32)]
+
+    cast = [a.astype(jnp.bfloat16) if a.dtype == np.float32 else a for a in arrays]
+    _, out16 = _run_eager(spec, cast)
+    got = _flatten_floats(out16)
+    assert len(got) == len(ref), f"{spec.name}: bf16 output arity mismatch"
+    for r, g in zip(ref, got):
+        np.testing.assert_allclose(
+            r, np.asarray(g, np.float32), rtol=spec.bf16_rtol, atol=spec.bf16_atol,
+            err_msg=f"{spec.name}: bf16 diverges from f32")
+
+
+def check_grad(spec, seed=0):
+    """Tape backward vs central finite difference, directional probe
+    (ref OpTest.check_grad: get_numeric_gradient vs analytic)."""
+    arrays = spec.make_inputs(seed)
+    rng = np.random.default_rng(seed + 1)
+
+    diff_idx = [i for i, a in enumerate(arrays) if a.dtype == np.float32]
+    assert diff_idx, f"{spec.name}: no float inputs to diff"
+
+    ts, out = _run_eager(spec, arrays, stop_gradient=False)
+    floats = _flatten_floats(out)
+    assert floats, f"{spec.name}: no float outputs"
+    us = [jnp.asarray(rng.standard_normal(np.shape(f)).astype(np.float32)) for f in floats]
+
+    # scalar objective s = sum_i u_i . f_i  — build it on the tape over float outputs
+    s = None
+    k = 0
+
+    def rec(o):
+        nonlocal s, k
+        if isinstance(o, (tuple, list)):
+            for x in o:
+                rec(x)
+        elif isinstance(o, Tensor) and _is_float(o._value):
+            term = (o * paddle.to_tensor(us[k])).sum()
+            s = term if s is None else s + term
+            k += 1
+        elif o is not None and not isinstance(o, Tensor) and _is_float(o):
+            k += 1  # raw float array: not on the tape; consume its probe slot
+
+    rec(out)
+    assert s is not None, f"{spec.name}: no differentiable tape output"
+    s.backward()
+    grads = {i: (np.zeros_like(arrays[i]) if ts[i].grad is None
+                 else np.asarray(ts[i].grad._value, np.float32))
+             for i in diff_idx}
+
+    # numeric directional derivative via jitted pure fn (fast + precise on CPU f32)
+    def pure_scalar(*raw):
+        outs = _flatten_floats(spec.fn(*[Tensor(r) for r in raw], **spec.kwargs))
+        return sum(jnp.vdot(u.astype(jnp.float32), o.astype(jnp.float32))
+                   for u, o in zip(us, outs))
+
+    pure_jit = jax.jit(pure_scalar)
+    for i in diff_idx:
+        v = rng.standard_normal(arrays[i].shape).astype(np.float32)
+        vn = np.linalg.norm(v.ravel()) or 1.0
+        v = v / vn
+        eps = spec.eps
+        plus = list(arrays)
+        minus = list(arrays)
+        plus[i] = arrays[i] + eps * v
+        minus[i] = arrays[i] - eps * v
+        numeric = (float(pure_jit(*plus)) - float(pure_jit(*minus))) / (2 * eps)
+        analytic = float(np.vdot(grads[i], v))
+        scale = max(abs(numeric), abs(analytic), 1.0)
+        assert abs(numeric - analytic) <= spec.grad_rtol * scale + spec.grad_atol, (
+            f"{spec.name}: grad mismatch on input {i}: "
+            f"numeric={numeric:.6f} analytic={analytic:.6f}")
+
+
+def run_all_checks(spec, seed=0):
+    if spec.jit:
+        check_output_jit(spec, seed)
+    else:  # dynamic-shape op: eager only, still must execute
+        _run_eager(spec, spec.make_inputs(seed))
+    if spec.bf16:
+        check_bf16(spec, seed)
+    if spec.grad:
+        check_grad(spec, seed)

@@ -1,0 +1,357 @@
+"""Systematic op sweep (ref: the per-op unittests under
+python/paddle/fluid/tests/unittests/test_*_op.py, all built on op_test.py).
+
+Each OpSpec gets: eager-vs-jit parity, bf16 behavior, and analytic-grad vs
+finite-difference (see op_harness.py).  ~200 ops across paddle.* and F.*.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_harness import In, OpSpec, run_all_checks
+
+
+def _specs():
+    S = []
+
+    def add(name, fn, inputs, kwargs=None, **flags):
+        S.append(OpSpec(name, fn, inputs, kwargs, **flags))
+
+    # ---------------------------------------------------------------- unary math
+    f24 = [In(2, 3, 4)]
+    pos = [In(2, 3, 4, kind="pos")]
+    unit = [In(2, 3, 4, kind="unit")]
+    for n in ["exp", "expm1", "sin", "cos", "tan", "atan", "sinh", "cosh", "tanh",
+              "asinh", "erf", "neg", "square", "deg2rad", "rad2deg", "exp2",
+              "sigmoid", "abs"]:
+        add(n, getattr(paddle, n), f24)
+    for n in ["log", "log2", "log10", "log1p", "sqrt", "rsqrt", "reciprocal",
+              "lgamma", "digamma", "i0", "i1"]:
+        add(n, getattr(paddle, n), pos)
+    add("asin", paddle.asin, [In(2, 3, kind="unit", low=-0.9, high=0.9)])
+    add("acos", paddle.acos, [In(2, 3, kind="unit", low=-0.9, high=0.9)])
+    add("atanh", paddle.atanh, [In(2, 3, kind="unit", low=-0.9, high=0.9)])
+    add("acosh", paddle.acosh, [In(2, 3, kind="unit", low=1.2, high=3.0)])
+    add("logit", paddle.logit, unit)
+    add("clip", paddle.clip, f24, {"min": -0.5, "max": 0.5})
+    add("scale", paddle.scale, f24, {"scale": 2.0, "bias": 1.0})
+    add("stanh", paddle.stanh, f24)
+    add("nan_to_num", paddle.nan_to_num, f24)
+    for n in ["floor", "ceil", "round", "trunc", "rint", "frac", "sign", "sgn"]:
+        add(n, getattr(paddle, n), f24, grad=False)
+    add("isnan", paddle.isnan, f24, grad=False, bf16=False)
+    add("isinf", paddle.isinf, f24, grad=False, bf16=False)
+    add("isfinite", paddle.isfinite, f24, grad=False, bf16=False)
+    add("angle", paddle.angle, f24, grad=False)
+
+    # --------------------------------------------------------------- binary math
+    ff = [In(2, 3, 4), In(2, 3, 4)]
+    add("add", paddle.add, ff)
+    add("subtract", paddle.subtract, ff)
+    add("multiply", paddle.multiply, ff)
+    add("divide", [In(2, 3, 4), In(2, 3, 4, kind="pos")].__class__ and paddle.divide,
+        [In(2, 3, 4), In(2, 3, 4, kind="pos")])
+    add("pow", paddle.pow, pos, {"y": 2.5})
+    add("maximum", paddle.maximum, ff)
+    add("minimum", paddle.minimum, ff)
+    add("fmax", paddle.fmax, ff)
+    add("fmin", paddle.fmin, ff)
+    add("atan2", paddle.atan2, [In(2, 3, kind="pos"), In(2, 3, kind="pos")])
+    add("hypot", paddle.hypot, [In(2, 3, kind="pos"), In(2, 3, kind="pos")])
+    add("logaddexp", paddle.logaddexp, ff)
+    add("copysign", paddle.copysign, ff, grad=False)
+    add("mod", paddle.mod, [In(2, 3), In(2, 3, kind="pos")], grad=False)
+    add("floor_divide", paddle.floor_divide, [In(2, 3), In(2, 3, kind="pos")],
+        grad=False)
+    add("remainder", paddle.remainder, [In(2, 3), In(2, 3, kind="pos")], grad=False)
+    add("heaviside", paddle.heaviside, ff, grad=False)
+    add("nextafter", paddle.nextafter, ff, grad=False, bf16=False)
+    add("lerp", paddle.lerp, ff, {"weight": 0.3})
+    add("dist", paddle.dist, ff, {"p": 2})
+    add("broadcast_add", paddle.add, [In(2, 3, 4), In(3, 1)])
+
+    # ------------------------------------------------------------------- matmuls
+    add("matmul", paddle.matmul, [In(4, 8), In(8, 5)])
+    add("matmul_tx", paddle.matmul, [In(8, 4), In(8, 5)], {"transpose_x": True})
+    add("matmul_ty", paddle.matmul, [In(4, 8), In(5, 8)], {"transpose_y": True})
+    add("matmul_batched", paddle.matmul, [In(2, 3, 4, 8), In(2, 3, 8, 5)])
+    add("mm", paddle.mm, [In(4, 8), In(8, 5)])
+    add("bmm", paddle.bmm, [In(3, 4, 8), In(3, 8, 5)])
+    add("dot", paddle.dot, [In(8), In(8)])
+    add("inner", paddle.inner, [In(3, 8), In(4, 8)])
+    add("outer", paddle.outer, [In(5), In(7)])
+    add("kron", paddle.kron, [In(2, 3), In(3, 2)])
+    add("addmm", paddle.addmm, [In(4, 5), In(4, 8), In(8, 5)])
+    add("cross", paddle.cross, [In(4, 3), In(4, 3)])
+    add("tensordot", paddle.tensordot, [In(3, 4, 5), In(4, 5, 6)], {"axes": 2})
+    add("einsum", lambda x, y: paddle.einsum("bij,bjk->bik", x, y),
+        [In(2, 3, 4), In(2, 4, 5)])
+    add("trace", paddle.trace, [In(5, 5)])
+    add("cholesky", lambda x: paddle.cholesky(
+        paddle.matmul(x, x, transpose_y=True) + 3.0 * paddle.eye(4)), [In(4, 4)],
+        bf16=False, grad_rtol=3e-2)
+
+    # ---------------------------------------------------------------- reductions
+    add("sum", paddle.sum, f24)
+    add("sum_axis", paddle.sum, f24, {"axis": 1})
+    add("sum_keepdim", paddle.sum, f24, {"axis": [0, 2], "keepdim": True})
+    add("mean", paddle.mean, f24)
+    add("mean_axis", paddle.mean, f24, {"axis": -1})
+    add("max", paddle.max, f24)
+    add("max_axis", paddle.max, f24, {"axis": 1})
+    add("min", paddle.min, f24)
+    add("amax", paddle.amax, f24, {"axis": 1})
+    add("amin", paddle.amin, f24, {"axis": 1})
+    add("prod", paddle.prod, pos)
+    add("logsumexp", paddle.logsumexp, f24)
+    add("logcumsumexp", paddle.logcumsumexp, f24, {"axis": 1})
+    add("cumsum", paddle.cumsum, f24, {"axis": 1})
+    add("cumprod", paddle.cumprod, pos, {"dim": 1})
+    add("cummax", paddle.cummax, f24, {"axis": 1}, grad=False)
+    add("std", paddle.std, f24)
+    add("var", paddle.var, f24, {"axis": 1})
+    add("nanmean", paddle.nanmean, f24)
+    add("nansum", paddle.nansum, f24)
+    add("median", paddle.median, [In(2, 7)], {"axis": 1}, grad=False)
+    add("quantile", paddle.quantile, [In(2, 7)], {"q": 0.5, "axis": 1}, grad=False)
+    add("count_nonzero", paddle.count_nonzero, f24, grad=False, bf16=False)
+    add("all", paddle.all, [In(2, 3, kind="bool")], grad=False, bf16=False)
+    add("any", paddle.any, [In(2, 3, kind="bool")], grad=False, bf16=False)
+    add("norm_fro", paddle.norm, f24)
+    add("norm_1", paddle.norm, f24, {"p": 1, "axis": 1})
+
+    # -------------------------------------------------------------- manipulation
+    add("reshape", paddle.reshape, f24, {"shape": [4, 6]})
+    add("reshape_infer", paddle.reshape, f24, {"shape": [-1, 4]})
+    add("transpose", paddle.transpose, f24, {"perm": [2, 0, 1]})
+    add("concat", lambda a, b: paddle.concat([a, b], axis=1), ff)
+    add("split", lambda x: paddle.split(x, 2, axis=1), [In(2, 6)])
+    add("chunk", lambda x: paddle.chunk(x, 3, axis=1), [In(2, 6)])
+    add("stack", lambda a, b: paddle.stack([a, b], axis=1), ff)
+    add("unstack", lambda x: paddle.unstack(x, axis=0), [In(3, 4)])
+    add("squeeze", paddle.squeeze, [In(2, 1, 4)], {"axis": 1})
+    add("unsqueeze", paddle.unsqueeze, f24, {"axis": [0, 3]})
+    add("flatten", paddle.flatten, f24, {"start_axis": 1})
+    add("tile", paddle.tile, [In(2, 3)], {"repeat_times": [2, 1]})
+    add("expand", paddle.expand, [In(1, 3)], {"shape": [4, 3]})
+    add("expand_as", paddle.expand_as, [In(1, 3), In(4, 3)])
+    add("broadcast_to", paddle.broadcast_to, [In(1, 3)], {"shape": [4, 3]})
+    add("flip", paddle.flip, f24, {"axis": [0, 2]})
+    add("roll", paddle.roll, f24, {"shifts": 2, "axis": 1})
+    add("rot90", paddle.rot90, [In(3, 4)])
+    add("moveaxis", paddle.moveaxis, f24, {"source": 0, "destination": 2})
+    add("swapaxes", lambda x: paddle.swapaxes(x, 0, 2), f24)
+    add("t", paddle.t, [In(3, 4)])
+    add("tril", paddle.tril, [In(4, 4)])
+    add("triu", paddle.triu, [In(4, 4)])
+    add("diag", paddle.diag, [In(5)])
+    add("diagflat", paddle.diagflat, [In(4)])
+    add("diagonal", paddle.diagonal, [In(3, 4, 4)], {"axis1": 1, "axis2": 2})
+    add("diag_embed", paddle.diag_embed, [In(2, 4)])
+    add("unbind", lambda x: paddle.unbind(x, axis=1), [In(2, 3, 4)])
+    add("repeat_interleave", paddle.repeat_interleave, [In(2, 3)],
+        {"repeats": 2, "axis": 1})
+    add("pad2d", lambda x: paddle.pad(x, [1, 2], value=0.0), [In(2, 6)])
+    add("gather", lambda x, i: paddle.gather(x, i), [In(5, 3), In(4, kind="int", high=5)])
+    add("gather_axis", lambda x, i: paddle.gather(x, i, axis=1),
+        [In(3, 5), In(4, kind="int", high=5)])
+    add("gather_nd", lambda x, i: paddle.gather_nd(x, i),
+        [In(4, 5), In(3, 2, kind="int", high=4)])
+    add("index_select", lambda x, i: paddle.index_select(x, i, axis=1),
+        [In(3, 5), In(4, kind="int", high=5)])
+    add("index_sample", paddle.index_sample, [In(3, 6), In(3, 2, kind="int", high=6)])
+    add("take_along_axis", lambda x, i: paddle.take_along_axis(x, i, axis=1),
+        [In(3, 5), In(3, 2, kind="int", high=5)])
+    add("take", paddle.take, [In(3, 4), In(5, kind="int", high=12)])
+    add("masked_fill", lambda x, m: paddle.masked_fill(x, m, -1.0),
+        [In(2, 3, 4), In(2, 3, 4, kind="bool")])
+    add("masked_select", paddle.masked_select,
+        [In(2, 6), In(2, 6, kind="bool")], jit=False, grad=False)
+    add("where", paddle.where, [In(2, 3, kind="bool"), In(2, 3), In(2, 3)])
+    add("nonzero", paddle.nonzero, [In(2, 3, kind="bool")], jit=False, grad=False,
+        bf16=False)
+    add("unique", lambda x: paddle.unique(x), [In(8, kind="int", high=5)],
+        jit=False, grad=False, bf16=False)
+    add("scatter", lambda x, i, u: paddle.scatter(x, i, u),
+        [In(5, 3), In(2, kind="int", high=5), In(2, 3)], grad=False)
+    add("scatter_nd_add", paddle.scatter_nd_add,
+        [In(5, 3), In(2, 1, kind="int", high=5), In(2, 3)])
+    add("put_along_axis", lambda x, i, v: paddle.put_along_axis(x, i, v, axis=1),
+        [In(3, 5), In(3, 1, kind="int", high=5), In(3, 1)], grad=False)
+    add("index_put", lambda x, i, v: paddle.index_put(x, [i], v),
+        [In(5, 3), In(2, kind="int", high=5), In(2, 3)], grad=False)
+    add("bucketize", paddle.bucketize,
+        [In(2, 6), In(4, kind="unit", low=-2.0, high=2.0)], grad=False, bf16=False)
+    add("searchsorted", paddle.searchsorted,
+        [In(4, kind="unit", low=-2.0, high=2.0), In(2, 6)], grad=False, bf16=False)
+    add("one_hot_m", lambda i: F.one_hot(i, 6), [In(2, 3, kind="int", high=6)],
+        grad=False)
+
+    # --------------------------------------------------------------------- logic
+    add("equal", paddle.equal, ff, grad=False, bf16=False)
+    add("not_equal", paddle.not_equal, ff, grad=False, bf16=False)
+    add("greater_than", paddle.greater_than, ff, grad=False, bf16=False)
+    add("greater_equal", paddle.greater_equal, ff, grad=False, bf16=False)
+    add("less_than", paddle.less_than, ff, grad=False, bf16=False)
+    add("less_equal", paddle.less_equal, ff, grad=False, bf16=False)
+    add("equal_all", paddle.equal_all, ff, grad=False, bf16=False)
+    add("isclose", paddle.isclose, ff, grad=False, bf16=False)
+    add("allclose", paddle.allclose, ff, grad=False, bf16=False)
+    bb = [In(2, 3, kind="bool"), In(2, 3, kind="bool")]
+    add("logical_and", paddle.logical_and, bb, grad=False, bf16=False)
+    add("logical_or", paddle.logical_or, bb, grad=False, bf16=False)
+    add("logical_xor", paddle.logical_xor, bb, grad=False, bf16=False)
+    add("logical_not", paddle.logical_not, bb[:1], grad=False, bf16=False)
+    ii = [In(2, 3, kind="int", high=7), In(2, 3, kind="int", high=7)]
+    add("bitwise_and", paddle.bitwise_and, ii, grad=False, bf16=False)
+    add("bitwise_or", paddle.bitwise_or, ii, grad=False, bf16=False)
+    add("bitwise_xor", paddle.bitwise_xor, ii, grad=False, bf16=False)
+    add("bitwise_not", paddle.bitwise_not, ii[:1], grad=False, bf16=False)
+
+    # -------------------------------------------------------------------- search
+    add("argmax", paddle.argmax, f24, {"axis": 1}, grad=False, bf16=False)
+    add("argmin", paddle.argmin, f24, {"axis": 1}, grad=False, bf16=False)
+    add("argsort", paddle.argsort, f24, {"axis": 1}, grad=False, bf16=False)
+    add("sort", paddle.sort, f24, {"axis": 1})
+    add("topk", lambda x: paddle.topk(x, 3, axis=1), [In(2, 6)], bf16=False)
+    add("kthvalue", lambda x: paddle.kthvalue(x, 2, axis=1), [In(2, 6)], bf16=False)
+    add("mode", lambda x: paddle.mode(x, axis=1), [In(2, 6)], grad=False, bf16=False)
+
+    # --------------------------------------------------------------- activations
+    for n in ["relu", "relu6", "elu", "celu", "selu", "gelu", "silu", "mish",
+              "softplus", "softsign", "swish", "tanhshrink", "leaky_relu",
+              "hardswish", "hardsigmoid", "hardtanh", "log_sigmoid"]:
+        add(n, getattr(F, n), f24)
+    add("gelu_tanh", F.gelu, f24, {"approximate": True})
+    add("hardshrink", F.hardshrink, f24)
+    add("softshrink", F.softshrink, f24)
+    add("thresholded_relu", F.thresholded_relu, f24)
+    add("softmax", F.softmax, f24, {"axis": -1})
+    add("log_softmax", F.log_softmax, f24, {"axis": -1})
+    add("glu", F.glu, [In(2, 6)], {"axis": -1})
+    add("maxout", F.maxout, [In(2, 4, 3, 3)], {"groups": 2})
+    add("prelu", F.prelu, [In(2, 4, 3), In(4, kind="pos")])
+
+    # ---------------------------------------------------------------------- norm
+    add("layer_norm", lambda x, w, b: F.layer_norm(x, (8,), w, b),
+        [In(2, 5, 8), In(8, kind="pos"), In(8)])
+    add("rms_norm", lambda x, w: F.rms_norm(x, w), [In(2, 5, 8), In(8, kind="pos")])
+    add("batch_norm_eval",
+        lambda x, m, v, w, b: F.batch_norm(x, m, v, w, b, training=False),
+        [In(2, 4, 6), In(4), In(4, kind="pos"), In(4, kind="pos"), In(4)])
+    add("instance_norm", lambda x, w, b: F.instance_norm(x, weight=w, bias=b),
+        [In(2, 4, 8, 8), In(4, kind="pos"), In(4)])
+    add("group_norm", lambda x, w, b: F.group_norm(x, 2, weight=w, bias=b),
+        [In(2, 4, 8, 8), In(4, kind="pos"), In(4)])
+    add("local_response_norm", F.local_response_norm, [In(2, 6, 8, 8)], {"size": 3})
+    add("normalize", F.normalize, [In(3, 8)])
+    add("cosine_similarity", F.cosine_similarity, [In(3, 8), In(3, 8)])
+
+    # -------------------------------------------------------------------- common
+    add("linear", F.linear, [In(3, 8), In(8, 5), In(5)])
+    add("bilinear", F.bilinear, [In(3, 4), In(3, 5), In(2, 4, 5)])
+    add("embedding", lambda i, w: F.embedding(i, w),
+        [In(2, 5, kind="int", high=10), In(10, 6)])
+    add("dropout_eval", lambda x: F.dropout(x, p=0.5, training=False), f24)
+    add("label_smooth", F.label_smooth, [In(3, 5, kind="unit")])
+    add("interpolate_nearest", lambda x: F.interpolate(x, scale_factor=2, mode="nearest"),
+        [In(1, 3, 4, 4)])
+    add("interpolate_bilinear",
+        lambda x: F.interpolate(x, scale_factor=2, mode="bilinear"), [In(1, 3, 4, 4)])
+    add("pixel_shuffle", lambda x: F.pixel_shuffle(x, 2), [In(1, 8, 3, 3)])
+    add("pixel_unshuffle", lambda x: F.pixel_unshuffle(x, 2), [In(1, 2, 6, 6)])
+    add("zeropad2d", lambda x: F.zeropad2d(x, [1, 1, 2, 2]), [In(1, 2, 4, 4)])
+    add("unfold_f", lambda x: F.unfold(x, 2, strides=2), [In(1, 3, 4, 4)])
+    add("fold", lambda x: F.fold(x, output_sizes=[4, 4], kernel_sizes=2, strides=2),
+        [In(1, 12, 4)])
+    add("sequence_mask", lambda l: F.sequence_mask(l, maxlen=8),
+        [In(4, kind="int", low=1, high=8)], grad=False, bf16=False)
+
+    # ------------------------------------------------------------------- pooling
+    add("max_pool2d", lambda x: F.max_pool2d(x, 2, stride=2), [In(1, 3, 8, 8)])
+    add("avg_pool2d", lambda x: F.avg_pool2d(x, 2, stride=2), [In(1, 3, 8, 8)])
+    add("max_pool1d", lambda x: F.max_pool1d(x, 2, stride=2), [In(1, 3, 8)])
+    add("avg_pool1d", lambda x: F.avg_pool1d(x, 2, stride=2), [In(1, 3, 8)])
+    add("max_pool3d", lambda x: F.max_pool3d(x, 2, stride=2), [In(1, 2, 4, 4, 4)])
+    add("avg_pool3d", lambda x: F.avg_pool3d(x, 2, stride=2), [In(1, 2, 4, 4, 4)])
+    add("adaptive_avg_pool2d", lambda x: F.adaptive_avg_pool2d(x, 2), [In(1, 3, 8, 8)])
+    add("adaptive_max_pool2d", lambda x: F.adaptive_max_pool2d(x, 2), [In(1, 3, 8, 8)])
+    add("adaptive_avg_pool1d", lambda x: F.adaptive_avg_pool1d(x, 2), [In(1, 3, 8)])
+    add("adaptive_max_pool1d", lambda x: F.adaptive_max_pool1d(x, 2), [In(1, 3, 8)])
+
+    # ----------------------------------------------------------------------- conv
+    add("conv2d", lambda x, w, b: F.conv2d(x, w, b, padding=1),
+        [In(1, 3, 8, 8), In(4, 3, 3, 3), In(4)])
+    add("conv2d_stride", lambda x, w: F.conv2d(x, w, stride=2),
+        [In(1, 3, 9, 9), In(4, 3, 3, 3)])
+    add("conv2d_groups", lambda x, w: F.conv2d(x, w, groups=2),
+        [In(1, 4, 6, 6), In(6, 2, 3, 3)])
+    add("conv2d_nhwc", lambda x, w: F.conv2d(x, w, data_format="NHWC"),
+        [In(1, 8, 8, 3), In(4, 3, 3, 3)])
+    add("conv1d", lambda x, w: F.conv1d(x, w, padding=1), [In(1, 3, 8), In(4, 3, 3)])
+    add("conv3d", lambda x, w: F.conv3d(x, w), [In(1, 2, 4, 4, 4), In(3, 2, 2, 2, 2)])
+    add("conv2d_transpose", lambda x, w: F.conv2d_transpose(x, w, stride=2),
+        [In(1, 4, 4, 4), In(4, 3, 2, 2)])
+    add("conv1d_transpose", lambda x, w: F.conv1d_transpose(x, w, stride=2),
+        [In(1, 4, 6), In(4, 3, 2)])
+
+    # --------------------------------------------------------------------- losses
+    add("mse_loss", F.mse_loss, ff)
+    add("l1_loss", F.l1_loss, ff)
+    add("smooth_l1_loss", F.smooth_l1_loss, ff)
+    add("nll_loss", F.nll_loss,
+        [In(4, 5), In(4, kind="int", high=5, dtype=np.int64)])
+    add("cross_entropy", F.cross_entropy,
+        [In(4, 5), In(4, kind="int", high=5, dtype=np.int64)])
+    add("cross_entropy_soft", lambda x, y: F.cross_entropy(x, F.softmax(y), soft_label=True),
+        [In(4, 5), In(4, 5)])
+    add("binary_cross_entropy", F.binary_cross_entropy,
+        [In(4, 5, kind="unit"), In(4, 5, kind="unit")])
+    add("bce_with_logits", F.binary_cross_entropy_with_logits,
+        [In(4, 5), In(4, 5, kind="unit")])
+    add("kl_div", F.kl_div, [In(4, 5), In(4, 5, kind="unit")])
+    add("margin_ranking_loss", lambda a, b, c: F.margin_ranking_loss(a, b, paddle.sign(c)),
+        [In(4), In(4), In(4)], grad=False)
+    add("hinge_embedding_loss", F.hinge_embedding_loss, [In(4, 5), In(4, 5)])
+    add("sigmoid_focal_loss", F.sigmoid_focal_loss,
+        [In(4, 5), In(4, 5, kind="bool")], grad=False)
+    add("dice_loss", F.dice_loss,
+        [In(4, 3, 5, kind="unit"), In(4, 3, 1, kind="int", high=5, dtype=np.int64)])
+    add("log_loss", F.log_loss, [In(4, 1, kind="unit"), In(4, 1, kind="unit")])
+    add("square_error_cost", F.square_error_cost, ff)
+    add("softmax_with_cross_entropy", F.softmax_with_cross_entropy,
+        [In(4, 5), In(4, 1, kind="int", high=5, dtype=np.int64)])
+    add("triplet_margin_loss", F.triplet_margin_loss, [In(4, 8), In(4, 8), In(4, 8)])
+    add("cosine_embedding_loss",
+        lambda a, b: F.cosine_embedding_loss(a, b, paddle.to_tensor(np.array([1, -1, 1, -1], np.int32))),
+        [In(4, 8), In(4, 8)])
+
+    # ----------------------------------------------------------- attention / misc
+    add("sdpa", lambda q, k, v: F.scaled_dot_product_attention(q, k, v, is_causal=True),
+        [In(2, 8, 2, 4), In(2, 8, 2, 4), In(2, 8, 2, 4)], grad_rtol=2e-2)
+    add("multiplex", lambda a, b, i: paddle.multiplex([a, b], i),
+        [In(4, 3), In(4, 3), In(4, 1, kind="int", high=2)], grad=False)
+    add("bincount", paddle.bincount, [In(10, kind="int", high=6)], grad=False,
+        bf16=False, jit=False)
+    add("histogram", paddle.histogram, [In(20)], {"bins": 5}, grad=False, bf16=False)
+    add("increment", paddle.increment, [In(1)])
+    add("as_complex_real", lambda x: paddle.as_real(paddle.as_complex(x)),
+        [In(3, 4, 2)], bf16=False, grad=False)
+    return S
+
+
+SPECS = _specs()
+_IDS = [s.name for s in SPECS]
+assert len(set(_IDS)) == len(_IDS), "duplicate op spec names"
+
+
+@pytest.mark.parametrize("spec", SPECS, ids=_IDS)
+def test_op(spec):
+    run_all_checks(spec)
+
+
+def test_sweep_size():
+    # the VERDICT bar: >=150 ops under systematic output/grad/bf16 checks
+    assert len(SPECS) >= 150, len(SPECS)

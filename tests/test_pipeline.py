@@ -65,12 +65,55 @@ def test_pipeline_matches_single_device(data):
         l_ref = float(step_ref(paddle.to_tensor(x), paddle.to_tensor(y)).item())
         np.testing.assert_allclose(l_pp, l_ref, rtol=2e-4, atol=2e-5)
 
-    # params stay in lockstep after optimizer updates
+    # params stay in lockstep after optimizer updates (stacked body weights are
+    # written back on sync_model(), not per step)
+    step_pp.sync_model()
     p_pp, _ = model_pp.functional_state()
     p_ref, _ = model_ref.functional_state()
     for k in p_pp:
         np.testing.assert_allclose(np.asarray(p_pp[k]), np.asarray(p_ref[k]),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_pipeline_stacked_memory_contract(data):
+    """v2 memory contract: body params stacked [pp, ...] and sharded over 'pp' —
+    per-device bytes == total/pp (the reference 1F1B property,
+    pipeline_parallel.py:82 keeps only the stage's layers per rank)."""
+    x, y = data
+    mesh = dist.build_mesh(dp=2, pp=4)
+    model = _make_model(3)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = PipelineTrainStep(model, _mse, opt, mesh, n_microbatch=4)
+    step(paddle.to_tensor(x), paddle.to_tensor(y))
+    assert step.stacked_mode
+    for rel, arr in step._stacked.items():
+        assert arr.shape[0] == 4, rel
+        shard = arr.addressable_shards[0].data
+        assert shard.shape[0] == 1, f"{rel}: stage dim not sharded, {shard.shape}"
+        assert shard.size == arr.size // 4
+
+
+def test_pipeline_heterogeneous_falls_back(data):
+    """A non-homogeneous body (different widths per stage) still trains via the
+    replicated v1 path."""
+    x, y = data
+    mesh = dist.build_mesh(dp=2, pp=2)
+    paddle.seed(11)
+    model = PipelineLayer(
+        layers=[
+            LayerDesc(nn.Linear, 16, 32),
+            LayerDesc(Block, 32),
+            LayerDesc(nn.Sequential, nn.Linear(32, 32), nn.Tanh()),  # different structure
+            LayerDesc(nn.Linear, 32, 8),
+        ],
+        num_stages=2,
+        loss_fn=_mse,
+    )
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = PipelineTrainStep(model, _mse, opt, mesh, n_microbatch=2)
+    l0 = float(step(paddle.to_tensor(x), paddle.to_tensor(y)).item())
+    assert not step.stacked_mode
+    assert np.isfinite(l0)
 
 
 def test_pipeline_train_batch_api(data):
