@@ -127,7 +127,15 @@ class CollectiveController:
             # barrier on the minimum quorum, then fold in any extra early joiners
             hosts = [store.get(f"{a.job_id}/host/{r}").decode()
                      for r in range(self.min_nodes)]
-            n_reg = store.add(f"{a.job_id}/nrank", 0) if a.rank < 0 else self.min_nodes
+            if a.rank < 0:
+                n_reg = store.add(f"{a.job_id}/nrank", 0)
+            else:
+                # explicit ranks: count contiguously registered hosts above the
+                # quorum so an initial gang of min..max nodes isn't sealed out
+                n_reg = self.min_nodes
+                while n_reg < self.max_nodes and \
+                        store.get_nb(f"{a.job_id}/host/{n_reg}") is not None:
+                    n_reg += 1
             n_use = min(max(int(n_reg), self.min_nodes), self.max_nodes)
             hosts += [store.get(f"{a.job_id}/host/{r}").decode()
                       for r in range(self.min_nodes, n_use)]

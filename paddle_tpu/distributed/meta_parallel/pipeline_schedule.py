@@ -327,8 +327,15 @@ class PipelineTrainStep:
         rep = NamedSharding(mesh, P())
         stacked = {}
         for idx, (rel, _) in enumerate(info[0]):
-            arrs = [named[info[s][idx][1]]._value for s in range(S)]
-            stacked[rel] = jax.device_put(jnp.stack(arrs), pp_shard)
+            # stack on host, then place sharded: the full [pp, ...] array never
+            # materializes in one device's HBM
+            arrs = [np.asarray(named[info[s][idx][1]]._value) for s in range(S)]
+            stacked[rel] = jax.device_put(np.stack(arrs), pp_shard)
+            # free the originals: rebind each stage's Tensor to its host copy so
+            # device 0 doesn't keep the full body-param set alive alongside the
+            # stacked shards (sync_model restores device arrays on demand)
+            for s in range(S):
+                named[info[s][idx][1]]._rebind(arrs[s])
         self._stacked = stacked
 
         rep_keys = [k for k in named if k not in body_flats]
@@ -342,9 +349,18 @@ class PipelineTrainStep:
             def __init__(self, v):
                 self._value = v
 
+        def _place_stacked_state(state):
+            # moments share the stacked [pp, ...] shape -> shard over pp; 0-d
+            # leaves (Adam beta1_pow/beta2_pow etc.) must stay replicated
+            return jax.tree.map(
+                lambda leaf: jax.device_put(
+                    leaf, pp_shard if getattr(leaf, "ndim", 0) >= 1
+                    and leaf.shape[0] == S else rep),
+                state)
+
         self._opt_state = {
             **{k: jax.device_put(opt._init_state(named[k]), rep) for k in trainable},
-            **{"·stack·" + rel: jax.device_put(opt._init_state(_Shim(v)), pp_shard)
+            **{"·stack·" + rel: _place_stacked_state(opt._init_state(_Shim(v)))
                for rel, v in stacked.items()},
         }
 

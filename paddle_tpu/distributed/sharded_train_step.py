@@ -89,9 +89,13 @@ class ShardedTrainStep:
         for k, b in self.model.named_buffers():
             b._rebind(jax.device_put(b._value, NamedSharding(self.mesh, P())))
 
+        # a checkpoint restore may have pre-populated _opt_state — keep it and
+        # only (re)place the leaves onto this mesh's shardings
+        restored = self._opt_state or {}
         self._opt_state = {
             k: jax.tree.map(lambda v: jax.device_put(v, oshard[k] if hasattr(v, "shape") and v.shape == named[k]._value.shape else NamedSharding(self.mesh, P())),
-                            self.optimizer._init_state(named[k]))
+                            restored.get(k, None) if restored.get(k, None) is not None
+                            else self.optimizer._init_state(named[k]))
             for k in trainable
         }
 

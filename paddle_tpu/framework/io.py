@@ -2,7 +2,8 @@
 
 Pickle-based object save with tensors converted to numpy (the reference serializes
 LoDTensor payloads inside the pickle too).  Large sharded checkpoints use
-paddle_tpu.incubate.checkpoint (orbax) — this is the single-file object path.
+paddle_tpu.distributed.checkpoint (per-process shard volumes + chunk-table
+reshard-on-load) — this is the single-file object path.
 """
 from __future__ import annotations
 

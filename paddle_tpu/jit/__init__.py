@@ -36,6 +36,11 @@ def save(layer, path, input_spec=None, **configs):
     state = {}
     param_keys, buffer_keys = [], []
     if isinstance(layer, Layer):
+        # a stacked PipelineTrainStep keeps trained body weights in its own
+        # sharded store until a state read — run the sync hook before snapshotting
+        hook = getattr(layer, "_pre_state_hook", None)
+        if hook is not None:
+            hook()
         for k, v in layer.named_parameters():
             state[k] = np.asarray(v._value)
             param_keys.append(k)

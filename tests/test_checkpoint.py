@@ -1,0 +1,142 @@
+"""Distributed checkpoint: sharded save, reshard-on-load, loss continuation.
+
+Reference oracle: dist_saver.py saves per-rank shards; converter.py re-slices a
+tp=2 checkpoint into a tp=4 run.  Here the same leaf saved under mesh A must
+restore bit-exact under mesh B (different axis split) and training must
+continue with the same loss curve it would have had uninterrupted.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import ShardedTrainStep
+import paddle_tpu.nn as nn
+
+
+def _mesh(shape, names):
+    devs = np.array(jax.devices()[: int(np.prod(shape))]).reshape(shape)
+    return Mesh(devs, names)
+
+
+def test_roundtrip_plain(tmp_path):
+    state = {"a": jnp.arange(12.0).reshape(3, 4), "b": [jnp.ones(5), 7],
+             "meta": {"step": 3, "name": "x"}}
+    ckpt.save_state(str(tmp_path), state)
+    out = ckpt.load_state(str(tmp_path))
+    np.testing.assert_array_equal(out["a"], state["a"])
+    np.testing.assert_array_equal(out["b"][0], state["b"][0])
+    assert out["b"][1] == 7 and out["meta"] == {"step": 3, "name": "x"}
+
+
+def test_reshard_on_load(tmp_path):
+    """Save sharded (2,4)-mesh leaves, restore under a (4,2) mesh — and to host."""
+    m1 = _mesh((2, 4), ("dp", "mp"))
+    x = jnp.arange(64.0 * 16).reshape(64, 16)
+    xs = jax.device_put(x, NamedSharding(m1, P("dp", "mp")))
+    y = jnp.arange(32.0)
+    ys = jax.device_put(y, NamedSharding(m1, P("mp")))
+    ckpt.save_state(str(tmp_path), {"x": xs, "y": ys})
+
+    m2 = _mesh((4, 2), ("dp", "mp"))
+    out = ckpt.load_state(
+        str(tmp_path),
+        shardings={"x": NamedSharding(m2, P("mp", "dp")), "y": NamedSharding(m2, P("dp"))})
+    assert out["x"].sharding.spec == P("mp", "dp")
+    np.testing.assert_array_equal(np.asarray(out["x"]), np.asarray(x))
+    np.testing.assert_array_equal(np.asarray(out["y"]), np.asarray(y))
+
+    host = ckpt.load_state(str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(host["x"]), np.asarray(x))
+
+
+def test_manager_retention_and_latest(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=2, save_interval=2)
+    for step in range(1, 7):
+        mgr.save(step, {"w": jnp.full((4,), float(step))})
+    assert mgr.latest_step() == 6
+    assert mgr.all_steps() == [4, 6]      # keep=2, interval=2 -> saved 2,4,6, gc'd 2
+    out = mgr.restore()
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.full((4,), 6.0))
+
+
+class _MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _make(mesh_shape, names, seed=0):
+    paddle.seed(seed)
+    model = _MLP()
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+    mesh = _mesh(mesh_shape, names)
+
+    def loss_fn(x, y):
+        out = model(x)
+        return paddle.nn.functional.mse_loss(out, y)
+
+    step = ShardedTrainStep(model, loss_fn, opt, mesh, zero_stage=1)
+    return model, opt, step
+
+
+def test_train_state_continuation_across_meshes(tmp_path):
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal((8, 16)).astype(np.float32) for _ in range(6)]
+    ys = [rng.standard_normal((8, 4)).astype(np.float32) for _ in range(6)]
+
+    # uninterrupted reference run on mesh B
+    model_r, _, step_r = _make((2, 4), ("dp", "sharding"), seed=7)
+    ref_losses = [float(step_r(x, y)) for x, y in zip(xs, ys)]
+
+    # run 1: 3 steps on mesh A (4,2), save
+    model_a, opt_a, step_a = _make((4, 2), ("dp", "sharding"), seed=7)
+    for x, y in zip(xs[:3], ys[:3]):
+        step_a(x, y)
+    ckpt.save_train_state(str(tmp_path), model_a, optimizer=opt_a,
+                          train_step=step_a, step=3)
+
+    # run 2: fresh everything on mesh B (2,4), restore, continue
+    model_b, opt_b, step_b = _make((2, 4), ("dp", "sharding"), seed=123)
+    meta = ckpt.load_train_state(str(tmp_path), model_b, optimizer=opt_b,
+                                 train_step=step_b)
+    assert int(meta["step"]) == 3
+    cont = [float(step_b(x, y)) for x, y in zip(xs[3:], ys[3:])]
+    np.testing.assert_allclose(cont, ref_losses[3:], rtol=2e-4, atol=2e-5)
+
+
+def test_elastic_scale_event_saves_checkpoint(tmp_path):
+    """Scale event -> on_change saves a restorable checkpoint (the TPU elastic
+    story: checkpoint-restore, not communicator rebuild)."""
+    from paddle_tpu.distributed.fleet.elastic.manager import ElasticManager, _DictStore
+
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=2)
+    state = {"w": jnp.arange(8.0), "meta": {"step": 11}}
+    saved = []
+
+    def on_change(event, hosts):
+        saved.append(event)
+        mgr.save(11, state, force=True)
+
+    store = _DictStore()
+    em = ElasticManager(store=store, job_id="j", np="1:4", host="a:1",
+                        heartbeat_interval=0.05, on_change=on_change)
+    em.register()
+    store.set("/paddle_tpu/elastic/j/nodes/b:2", str(__import__("time").time()))
+    import time
+    deadline = time.time() + 3
+    while not saved and time.time() < deadline:
+        time.sleep(0.05)
+    em.exit()
+    assert "scale_out" in saved
+    out = mgr.restore()
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(8.0))
+    assert out["meta"]["step"] == 11

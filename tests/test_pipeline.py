@@ -135,3 +135,24 @@ def test_pipeline_train_batch_api(data):
     l0 = pp_model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt)
     l1 = pp_model.train_batch((paddle.to_tensor(x), paddle.to_tensor(y)), opt)
     assert float(l1.item()) < float(l0.item())  # it learns
+
+
+def test_pipeline_stacked_adam(data):
+    """Adam/AdamW state has 0-d leaves (beta pows) that must stay replicated
+    while the moments shard over 'pp' — regression for the stacked-mode crash."""
+    x, y = data
+    mesh = dist.build_mesh(dp=2, pp=4)
+    model_pp = _make_model(5)
+    model_ref = _make_model(5)
+    opt_pp = paddle.optimizer.AdamW(learning_rate=0.01,
+                                    parameters=model_pp.parameters())
+    opt_ref = paddle.optimizer.AdamW(learning_rate=0.01,
+                                     parameters=model_ref.parameters())
+    step_pp = PipelineTrainStep(model_pp, _mse, opt_pp, mesh, n_microbatch=4)
+    step_ref = paddle.jit.TrainStep(model_ref, lambda a, b: _mse(model_ref(a), b),
+                                    opt_ref)
+    for _ in range(3):
+        l_pp = float(step_pp(paddle.to_tensor(x), paddle.to_tensor(y)).item())
+        l_ref = float(step_ref(paddle.to_tensor(x), paddle.to_tensor(y)).item())
+        np.testing.assert_allclose(l_pp, l_ref, rtol=2e-4, atol=2e-5)
+    assert step_pp.stacked_mode
