@@ -30,9 +30,11 @@ class AmpScaler:
     def scale(self, var):
         if not self._enable:
             return var
+        self._sync_from_device()
         return var * self._scale
 
     def _unscale_and_check(self, optimizer):
+        self._sync_from_device()
         params = [p for p in optimizer._params() if p._grad is not None]
         found = False
         inv = 1.0 / self._scale
@@ -65,6 +67,7 @@ class AmpScaler:
     def update(self):
         if not (self._enable and self._dynamic):
             return
+        self._sync_from_device()
         if self._found_inf:
             self._bad_steps += 1
             self._good_steps = 0
@@ -78,6 +81,20 @@ class AmpScaler:
                 self._scale *= self._incr_ratio
                 self._good_steps = 0
 
+    # ---- compiled-step integration (TrainStep/ShardedTrainStep scaler=...):
+    # the (scale, good, bad) counters live on device inside the jitted step;
+    # host reads sync lazily so the fast path never blocks on a transfer.
+    def _attach_device_state(self, st):
+        self._device_state = st
+
+    def _sync_from_device(self):
+        st = getattr(self, "_device_state", None)
+        if st is not None:
+            self._scale = float(st["scale"])
+            self._good_steps = int(st["good"])
+            self._bad_steps = int(st["bad"])
+            self._device_state = None
+
     def is_enable(self):
         return self._enable
 
@@ -85,12 +102,16 @@ class AmpScaler:
         return self._dynamic
 
     def get_loss_scaling(self):
+        self._sync_from_device()
         return Tensor(jnp.asarray(self._scale))
 
     def set_init_loss_scaling(self, v):
+        self._device_state = None  # explicit host write wins over pending device state
+        self._host_dirty = True    # compiled steps re-seed their device state
         self._scale = float(v)
 
     def state_dict(self):
+        self._sync_from_device()
         return {
             "scale": self._scale,
             "incr_ratio": self._incr_ratio,
@@ -102,6 +123,8 @@ class AmpScaler:
         }
 
     def load_state_dict(self, sd):
+        self._device_state = None  # restored host state wins over pending device state
+        self._host_dirty = True    # compiled steps re-seed their device state
         self._scale = sd.get("scale", self._scale)
         self._good_steps = sd.get("good_steps", 0)
         self._bad_steps = sd.get("bad_steps", 0)

@@ -71,6 +71,21 @@ def _unflatten(skel, leaves):
     return skel
 
 
+def _to_storable(data):
+    """npz can't round-trip ml_dtypes (bfloat16/float8 come back as raw void):
+    store such chunks as flat uint8 bytes; _from_storable reinterprets."""
+    if data.dtype.kind == "V" or data.dtype.name.startswith(("bfloat", "float8")):
+        return np.ascontiguousarray(data).reshape(-1).view(np.uint8)
+    return data
+
+
+def _from_storable(data, dtype, sizes):
+    dtype = np.dtype(dtype)
+    if data.dtype == np.uint8 and dtype != np.uint8:
+        return data.view(dtype).reshape(sizes)
+    return data
+
+
 def _norm_index(index, shape):
     """Normalize a shard index (tuple of slices) to (starts, sizes)."""
     starts, sizes = [], []
@@ -116,7 +131,7 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
         if shards is None:
             if proc == 0:
                 ck = f"{key}#0"
-                chunks[ck] = np.asarray(arr)
+                chunks[ck] = _to_storable(np.asarray(arr))
                 entry["chunks"].append({"volume": vol_name, "key": ck,
                                         "offset": [0] * len(global_shape),
                                         "sizes": list(global_shape)})
@@ -129,7 +144,7 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
                     continue
                 seen.add(sig)
                 ck = f"{key}#{i}"
-                chunks[ck] = np.asarray(sh.data)
+                chunks[ck] = _to_storable(np.asarray(sh.data))
                 entry["chunks"].append({"volume": vol_name, "key": ck,
                                         "offset": starts, "sizes": sizes})
         index[key] = entry
@@ -224,7 +239,8 @@ def _assemble(entry, req_slices, vols):
             continue
         src = tuple(slice(l - o, h - o) for l, h, o in zip(lo, hi, off))
         dst = tuple(slice(l - s, h - s) for l, h, s in zip(lo, hi, starts))
-        data = vols.get(ch["volume"], ch["key"])
+        data = _from_storable(vols.get(ch["volume"], ch["key"]),
+                              entry["dtype"], csz)
         out[dst] = data[src]
         covered += int(np.prod([h - l for l, h in zip(lo, hi)]))
     want = int(np.prod(sizes)) if sizes else 1

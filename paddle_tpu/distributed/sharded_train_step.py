@@ -22,8 +22,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..tensor.tensor import Tensor
-from ..autograd import tape
 from ..framework import random as _random
+from ..jit._step_impl import build_step_fn, init_scaler_state
 from .sharding_ctx import mesh_scope, param_sharding
 
 
@@ -45,11 +45,16 @@ def _zero_spec(shape, spec, axis_name, mesh):
 
 class ShardedTrainStep:
     def __init__(self, model, loss_fn, optimizer, mesh: Mesh, batch_spec=None,
-                 zero_stage: int = 0, donate: bool = True):
+                 zero_stage: int = 0, donate: bool = True, accum_steps: int = 1,
+                 scaler=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
         self.mesh = mesh
+        if zero_stage == 0:
+            # honor a prior group_sharded_parallel(model, opt, level) call —
+            # that API records the requested ZeRO stage on the model
+            zero_stage = int(getattr(model, "_group_sharded_stage", 0) or 0)
         self.zero_stage = zero_stage
         # batch axis 0 sharded over all data-like mesh axes present
         data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names and mesh.shape[a] > 1)
@@ -59,6 +64,9 @@ class ShardedTrainStep:
         self._param_sharding = None
         self._opt_sharding = None
         self._donate = donate
+        self.accum_steps = max(1, int(accum_steps))
+        self.scaler = scaler
+        self._scaler_state = None
 
     def _specs(self):
         named = dict(self.model.named_parameters())
@@ -99,40 +107,17 @@ class ShardedTrainStep:
             for k in trainable
         }
 
-        opt = self.optimizer
-        model = self.model
-        loss_fn = self.loss_fn
         mesh = self.mesh
+        self._scaler_state = init_scaler_state(self.scaler)
+        mb_sharding = NamedSharding(mesh, P(None, *tuple(self.batch_spec)))
 
-        def step(params, buffers, opt_state, lr, key, *batch):
-            t_params = {k: v for k, v in params.items() if k in trainable}
-            frozen = {k: v for k, v in params.items() if k not in trainable}
+        def mb_constraint(a):
+            return jax.lax.with_sharding_constraint(a, mb_sharding)
 
-            def pure_loss(tp):
-                allp = {**tp, **frozen}
-                with _random.rng_key_scope(key):
-                    restore = model.bind_functional_state(allp, buffers)
-                    try:
-                        with tape.no_grad():
-                            args = tuple(Tensor(b, stop_gradient=True) for b in batch)
-                            out = loss_fn(*args)
-                        loss_t = out[0] if isinstance(out, (tuple, list)) else out
-                        aux_out = tuple(o._value if isinstance(o, Tensor) else o
-                                        for o in (out[1:] if isinstance(out, (tuple, list)) else ()))
-                        new_buffers = {kk: b._value for kk, b in model.named_buffers()}
-                    finally:
-                        restore()
-                return loss_t._value.astype(jnp.float32), (new_buffers, aux_out)
-
-            (loss, (new_buffers, aux)), grads = jax.value_and_grad(pure_loss, has_aux=True)(t_params)
-            clipped = opt._clipped_grads(list(grads.items()))
-            new_params = dict(frozen)
-            new_opt = {}
-            for k, g in clipped:
-                new_params[k], new_opt[k] = opt._apply_update(
-                    params[k], g, opt_state[k], lr, opt._param_decay_coeff(named[k])
-                )
-            return new_params, new_buffers, new_opt, loss, aux
+        inner = build_step_fn(self.model, self.loss_fn, self.optimizer, named,
+                              trainable, accum_steps=self.accum_steps,
+                              scaler=self.scaler, cast_loss_f32=True,
+                              mb_constraint=mb_constraint)
 
         rep = NamedSharding(mesh, P())
 
@@ -142,13 +127,16 @@ class ShardedTrainStep:
 
         opt_shardings = {k: jax.tree.map(_opt_leaf_sharding(k), self._opt_state[k])
                          for k in self._opt_state}
+        scaler_shardings = (jax.tree.map(lambda _: rep, self._scaler_state)
+                            if self._scaler_state is not None else None)
         batch_shardings = tuple(NamedSharding(mesh, self.batch_spec) for _ in batch)
-        in_shardings = (pshard, rep, opt_shardings, rep, rep, *batch_shardings)
-        out_shardings = (pshard, rep, opt_shardings, rep, rep)
+        in_shardings = (pshard, rep, opt_shardings, scaler_shardings, rep, rep,
+                        *batch_shardings)
+        out_shardings = (pshard, rep, opt_shardings, scaler_shardings, rep, rep)
 
         def traced(*args):
             with mesh_scope(mesh):
-                return step(*args)
+                return inner(*args)
 
         donate = (0, 2) if self._donate else ()
         self._jitted = jax.jit(traced, in_shardings=in_shardings, out_shardings=out_shardings,
@@ -158,13 +146,19 @@ class ShardedTrainStep:
         raw = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
         if self._jitted is None:
             self._init(raw)
+        if self.scaler is not None and getattr(self.scaler, "_host_dirty", False):
+            self._scaler_state = init_scaler_state(self.scaler)
+            self.scaler._host_dirty = False
         params, buffers = self.model.functional_state()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.get_rng_key()
-        new_params, new_buffers, new_opt, loss, aux = self._jitted(
-            params, buffers, self._opt_state, lr, key, *raw
+        new_params, new_buffers, new_opt, new_scaler, loss, aux = self._jitted(
+            params, buffers, self._opt_state, self._scaler_state, lr, key, *raw
         )
         self._opt_state = new_opt
+        self._scaler_state = new_scaler
+        if new_scaler is not None:
+            self.scaler._attach_device_state(new_scaler)
         self.model.load_functional_state(new_params, new_buffers)
         self.optimizer._step_count += 1
         loss_t = Tensor(loss)

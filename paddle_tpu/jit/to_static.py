@@ -17,7 +17,9 @@ returns updated buffer values as auxiliary outputs, written back after each call
 """
 from __future__ import annotations
 
+import collections
 import functools
+import warnings
 from typing import Any
 
 import jax
@@ -30,10 +32,35 @@ from ..framework import random as _random
 from ..nn.layer.layers import Layer
 
 
+def _static_key(x, keepalive):
+    """A stable, hashable cache key for a non-tensor argument.
+
+    repr() is NOT stable for arbitrary objects (default reprs embed addresses,
+    so a config object rebuilt each call would silently recompile every call —
+    the SURVEY §7.3.4 recompilation storm).  Primitives and containers key by
+    value; arrays by shape/dtype/content hash; everything else by type + id.
+    Objects keyed by id are appended to `keepalive`, which the cache entry
+    retains — otherwise CPython could reuse a freed object's id and silently
+    hit a stale compiled variant."""
+    if x is None or isinstance(x, (bool, int, float, str, bytes)):
+        return ("P", x)
+    if isinstance(x, (list, tuple)):
+        return ("L", type(x).__name__, tuple(_static_key(i, keepalive) for i in x))
+    if isinstance(x, dict):
+        return ("D", tuple(sorted((str(k), _static_key(v, keepalive))
+                                  for k, v in x.items())))
+    if isinstance(x, np.ndarray):
+        return ("A", x.shape, str(x.dtype), hash(x.tobytes()))
+    keepalive.append(x)
+    return ("O", type(x).__qualname__, id(x))
+
+
 def _tree_flatten_args(args, kwargs):
-    """Split (args, kwargs) into (tensor_leaves, rebuild_fn, static_signature)."""
+    """Split (args, kwargs) into (tensor_leaves, rebuild_fn, static_signature,
+    keepalive-objects)."""
     leaves = []
     sig = []
+    keepalive: list = []
 
     def go(x):
         if isinstance(x, Tensor):
@@ -44,7 +71,7 @@ def _tree_flatten_args(args, kwargs):
             return type(x)(go(i) for i in x)
         if isinstance(x, dict):
             return {k: go(v) for k, v in x.items()}
-        sig.append(("S", repr(x)))
+        sig.append(_static_key(x, keepalive))
         return x
 
     skeleton = (go(list(args)), go(dict(kwargs)))
@@ -62,17 +89,21 @@ def _tree_flatten_args(args, kwargs):
         a, k = back(skeleton[0]), back(skeleton[1])
         return a, k
 
-    return leaves, rebuild, tuple(sig)
+    return leaves, rebuild, tuple(sig), keepalive
 
 
 class StaticFunction:
     """Ref: program_translator.py:239 StaticFunction."""
 
+    MAX_CACHE = 64          # LRU bound on compiled variants per function
+    STORM_WARN_EVERY = 16   # warn every N fresh compiles (recompilation storm)
+
     def __init__(self, function, input_spec=None, build_strategy=None, layer=None, backend=None):
         self._function = function
         self._layer = layer
         self._input_spec = input_spec
-        self._cache: dict[Any, Any] = {}
+        self._cache: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
+        self._compile_count = 0
         self.__name__ = getattr(function, "__name__", "static_fn")
 
     def __get__(self, instance, owner):
@@ -113,17 +144,36 @@ class StaticFunction:
 
         return jax.jit(pure_fn)
 
-    def __call__(self, *args, **kwargs):
-        layer, fargs = self._get_layer(args)
-        leaves, rebuild, sig = _tree_flatten_args(fargs, kwargs)
-        training = layer.training if layer is not None else False
+    def _entry_for(self, layer, training, leaves, rebuild, sig, keepalive):
         key = (training, sig)
         entry = self._cache.get(key)
         if entry is None:
+            self._compile_count += 1
+            if self._compile_count % self.STORM_WARN_EVERY == 0:
+                warnings.warn(
+                    f"to_static('{self.__name__}') compiled {self._compile_count} "
+                    f"variants — each distinct input shape/dtype or non-tensor "
+                    f"argument value triggers a fresh XLA compile. Pad/bucket "
+                    f"dynamic shapes or hoist varying python args out of the "
+                    f"traced function (SURVEY §7.3.4 recompilation storm).",
+                    stacklevel=3)
             out_template: list = []
             jitted = self._build(layer, training, len(leaves), rebuild, out_template)
-            entry = {"jitted": jitted, "template": out_template}
+            # keepalive pins id()-keyed arg objects for the entry's lifetime
+            entry = {"jitted": jitted, "template": out_template,
+                     "keepalive": keepalive}
             self._cache[key] = entry
+            if len(self._cache) > self.MAX_CACHE:
+                self._cache.popitem(last=False)  # evict LRU compiled variant
+        else:
+            self._cache.move_to_end(key)
+        return entry
+
+    def __call__(self, *args, **kwargs):
+        layer, fargs = self._get_layer(args)
+        leaves, rebuild, sig, keepalive = _tree_flatten_args(fargs, kwargs)
+        training = layer.training if layer is not None else False
+        entry = self._entry_for(layer, training, leaves, rebuild, sig, keepalive)
         jitted = entry["jitted"]
 
         if layer is not None:
@@ -163,14 +213,35 @@ class StaticFunction:
         except Exception:
             return "<source unavailable>"
 
-    def concrete_program(self):
-        return None
+    def concrete_program(self, *args, **kwargs):
+        """Reference ConcreteProgram analog: the lowered program + its I/O.
+        Here 'main_program' is the StableHLO text of the traced function."""
+        lowered, leaves = self._lowered(args, kwargs)
+        Concrete = collections.namedtuple("ConcreteProgram",
+                                          ["main_program", "inputs", "outputs"])
+        return Concrete(main_program=lowered.as_text(),
+                        inputs=[("x%d" % i, tuple(l._value.shape),
+                                 str(l._value.dtype)) for i, l in enumerate(leaves)],
+                        outputs=None)
 
     def get_lowered(self, *args, **kwargs):
-        """Return the jax lowering (StableHLO) for inspection/AOT export."""
+        """Return the jax lowering (StableHLO) for inspection/AOT export
+        (the slot where the reference captured a ProgramDesc; §3.4)."""
+        return self._lowered(args, kwargs)[0]
+
+    def _lowered(self, args, kwargs):
         layer, fargs = self._get_layer(args)
-        leaves, rebuild, sig = _tree_flatten_args(fargs, kwargs)
-        raise NotImplementedError
+        leaves, rebuild, sig, keepalive = _tree_flatten_args(fargs, kwargs)
+        training = layer.training if layer is not None else False
+        entry = self._entry_for(layer, training, leaves, rebuild, sig, keepalive)
+        param_vals = ({k: p._value for k, p in layer.named_parameters()}
+                      if layer is not None else {})
+        buffer_vals = ({k: b._value for k, b in layer.named_buffers()}
+                       if layer is not None else {})
+        key = _random.get_rng_key()
+        lowered = entry["jitted"].lower(param_vals, buffer_vals, key,
+                                        [l._value for l in leaves])
+        return lowered, leaves
 
 
 def _flatten_output(out):
@@ -208,8 +279,12 @@ def to_static(function=None, input_spec=None, build_strategy=None, backend=None,
 
     def decorate(fn):
         if isinstance(fn, Layer):
+            if getattr(fn.forward, "_paddle_not_to_static", False):
+                return fn
             sf = StaticFunction(fn.forward, input_spec, build_strategy, layer=fn)
             fn.forward = sf
+            return fn
+        if getattr(fn, "_paddle_not_to_static", False):
             return fn
         return StaticFunction(fn, input_spec, build_strategy)
 
@@ -222,6 +297,9 @@ declarative = to_static
 
 
 def not_to_static(fn):
+    """Exclude `fn` from to_static conversion (ref jit.py not_to_static):
+    a later to_static(fn) returns it unchanged and it keeps running eagerly."""
+    fn._paddle_not_to_static = True
     return fn
 
 

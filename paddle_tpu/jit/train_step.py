@@ -16,15 +16,22 @@ import jax
 import jax.numpy as jnp
 
 from ..tensor.tensor import Tensor
-from ..autograd import tape
 from ..framework import random as _random
 from ..optimizer.optimizer import Optimizer
+from ._step_impl import build_step_fn, init_scaler_state
 
 
 class TrainStep:
-    """train_step = TrainStep(model, loss_fn, optimizer); loss = train_step(x, y)."""
+    """train_step = TrainStep(model, loss_fn, optimizer); loss = train_step(x, y).
 
-    def __init__(self, model, loss_fn: Callable, optimizer: Optimizer, donate: bool = True):
+    `accum_steps > 1` accumulates gradients over that many microbatches (batch
+    axis split in-graph, one optimizer update — ref gradient_merge_optimizer).
+    `scaler=GradScaler(...)` runs dynamic fp16 loss scaling inside the compiled
+    step (no host sync; overflow steps skip the update in-graph).
+    """
+
+    def __init__(self, model, loss_fn: Callable, optimizer: Optimizer, donate: bool = True,
+                 accum_steps: int = 1, scaler=None):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -32,68 +39,48 @@ class TrainStep:
         self._param_names = None
         self._opt_state = None
         self._donate = donate
+        self.accum_steps = max(1, int(accum_steps))
+        self.scaler = scaler
+        self._scaler_state = None
 
     def _init(self):
         params, buffers = self.model.functional_state()
         self._param_names = list(params.keys())
         named = dict(self.model.named_parameters())
+        restored = self._opt_state or {}
         self._opt_state = {
-            k: self.optimizer._init_state(named[k]) for k in self._param_names
-            if not named[k].stop_gradient
+            k: (restored[k] if restored.get(k) is not None
+                else self.optimizer._init_state(named[k]))
+            for k in self._param_names if not named[k].stop_gradient
         }
-        opt = self.optimizer
-        model = self.model
-        loss_fn = self.loss_fn
         trainable = {k for k in self._param_names if not named[k].stop_gradient}
+        self._scaler_state = init_scaler_state(self.scaler)
 
-        def step(params, buffers, opt_state, lr, key, *batch):
-            t_params = {k: v for k, v in params.items() if k in trainable}
-            frozen = {k: v for k, v in params.items() if k not in trainable}
-
-            def pure_loss(tp):
-                allp = {**tp, **frozen}
-                with _random.rng_key_scope(key):
-                    restore = model.bind_functional_state(allp, buffers)
-                    try:
-                        with tape.no_grad():
-                            args = tuple(Tensor(b, stop_gradient=True) for b in batch)
-                            out = loss_fn(*args)
-                        loss_t = out[0] if isinstance(out, (tuple, list)) else out
-                        aux_out = tuple(o._value if isinstance(o, Tensor) else o
-                                        for o in (out[1:] if isinstance(out, (tuple, list)) else ()))
-                        new_buffers = {kk: b._value for kk, b in model.named_buffers()}
-                    finally:
-                        restore()
-                return loss_t._value, (new_buffers, aux_out)
-
-            (loss, (new_buffers, aux)), grads = jax.value_and_grad(pure_loss, has_aux=True)(t_params)
-            clipped = opt._clipped_grads(list(grads.items()))
-            new_params = dict(frozen)
-            new_opt = {}
-            for k, g in clipped:
-                new_params[k], new_opt[k] = opt._apply_update(
-                    params[k], g, opt_state[k], lr, opt._param_decay_coeff(named[k])
-                )
-            return new_params, new_buffers, new_opt, loss, aux
-
+        step = build_step_fn(self.model, self.loss_fn, self.optimizer, named,
+                             trainable, accum_steps=self.accum_steps,
+                             scaler=self.scaler)
         donate = (0, 2) if self._donate else ()
         self._jitted = jax.jit(step, donate_argnums=donate)
 
     def __call__(self, *batch):
         if self._jitted is None:
             self._init()
+        if self.scaler is not None and getattr(self.scaler, "_host_dirty", False):
+            self._scaler_state = init_scaler_state(self.scaler)
+            self.scaler._host_dirty = False
         params, buffers = self.model.functional_state()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.get_rng_key()
         raw = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
-        new_params, new_buffers, new_opt, loss, aux = self._jitted(
-            params, buffers, self._opt_state, lr, key, *raw
+        new_params, new_buffers, new_opt, new_scaler, loss, aux = self._jitted(
+            params, buffers, self._opt_state, self._scaler_state, lr, key, *raw
         )
         self._opt_state = new_opt
+        self._scaler_state = new_scaler
+        if new_scaler is not None:
+            self.scaler._attach_device_state(new_scaler)
         self.model.load_functional_state(new_params, new_buffers)
         self.optimizer._step_count += 1
-        if isinstance(self.optimizer._learning_rate, object) and hasattr(self.optimizer._learning_rate, "step"):
-            pass  # schedulers stepped by the user per paddle convention
         loss_t = Tensor(loss)
         if aux:
             return (loss_t, *[Tensor(a) for a in aux])

@@ -117,15 +117,24 @@ def _raw(x):
 
 
 def save(program, model_path, **kwargs):
-    pass
+    raise NotImplementedError(
+        "paddle.static.save: static Programs have no serialized form on the TPU "
+        "build (a 'program' is a jitted function) — save the Layer with "
+        "paddle.jit.save(layer, path, input_spec=...) or its state with "
+        "paddle.save(layer.state_dict(), path)")
 
 
 def load(program, model_path, **kwargs):
-    pass
+    raise NotImplementedError(
+        "paddle.static.load: use paddle.jit.load(path) for deployed programs or "
+        "paddle.load(path) for state dicts")
 
 
 def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
-    pass
+    raise NotImplementedError(
+        "paddle.static.save_inference_model: use paddle.jit.save(layer, "
+        "path_prefix, input_spec=[...]) — the AOT-exported program is the TPU "
+        "inference artifact (loaded by paddle.jit.load or inference.Predictor)")
 
 
 def load_inference_model(path_prefix, executor, **kwargs):

@@ -351,7 +351,31 @@ def apply_op(fn: Callable, args: tuple, kwargs: dict | None = None, name: str = 
     return _wrap_outputs(out, node, name)
 
 
+def _host_nan_check(name, arr):
+    if not np.all(np.isfinite(arr)):
+        raise RuntimeError(
+            f"Operator '{name}' output contains Inf or NaN "
+            f"(FLAGS_check_nan_inf is on; ref framework/details/nan_inf_utils.h:29)")
+
+
+def _check_nan_inf(name, out):
+    """Per-op NaN/Inf debug mode (ref FLAGS_check_nan_inf + nan_inf_utils.h:29:
+    CheckVarHasNanOrInf after every op).  Eager values are checked inline;
+    traced values get a host callback so the check also fires inside jit."""
+    from ..framework import flags as _flags
+
+    if not _flags.get_flag("FLAGS_check_nan_inf", False):
+        return
+    for o in out if isinstance(out, (tuple, list)) else (out,):
+        if hasattr(o, "dtype") and _dt.is_floating(o.dtype):
+            if isinstance(o, jax.core.Tracer):
+                jax.debug.callback(_host_nan_check, name, o)
+            else:
+                _host_nan_check(name, np.asarray(o))
+
+
 def _wrap_outputs(out, node, name):
+    _check_nan_inf(name, out)
     if isinstance(out, (tuple, list)):
         wrapped = []
         for i, o in enumerate(out):

@@ -1,0 +1,181 @@
+"""Gradient accumulation + in-jit dynamic loss scaling.
+
+Oracles (ref): gradient_merge_optimizer.py — k_steps accumulation must equal
+one big-batch step; amp/grad_scaler.py — overflow steps skip the update and
+shrink the scale, finite steps eventually grow it.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.amp import GradScaler
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def _mk(seed, **step_kw):
+    paddle.seed(seed)
+    m = MLP()
+    o = paddle.optimizer.Adam(learning_rate=0.02, parameters=m.parameters())
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(m(x), y)
+
+    return m, paddle.jit.TrainStep(m, loss_fn, o, **step_kw)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(0)
+    return (rng.standard_normal((16, 16)).astype(np.float32),
+            rng.standard_normal((16, 4)).astype(np.float32))
+
+
+def test_accum_matches_full_batch(data):
+    """accum_steps=4 over a 16-batch == one step over the same 16-batch
+    (mean loss => averaged microbatch grads are identical)."""
+    x, y = data
+    m1, s1 = _mk(3)
+    m4, s4 = _mk(3, accum_steps=4)
+    for _ in range(3):
+        l1 = float(s1(x, y).item())
+        l4 = float(s4(x, y).item())
+        np.testing.assert_allclose(l4, l1, rtol=1e-5, atol=1e-6)
+    p1, _ = m1.functional_state()
+    p4, _ = m4.functional_state()
+    for k in p1:
+        np.testing.assert_allclose(np.asarray(p4[k]), np.asarray(p1[k]),
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_accum_sharded(data):
+    x, y = data
+    mesh = dist.build_mesh(dp=2, sharding=4)
+
+    def build(accum):
+        paddle.seed(5)
+        m = MLP()
+        o = paddle.optimizer.Adam(learning_rate=0.02, parameters=m.parameters())
+        loss_fn = lambda a, b: paddle.nn.functional.mse_loss(m(a), b)
+        return m, dist.ShardedTrainStep(m, loss_fn, o, mesh, zero_stage=2,
+                                        accum_steps=accum)
+
+    m1, s1 = build(1)
+    m4, s4 = build(4)
+    for _ in range(2):
+        l1 = float(s1(x, y).item())
+        l4 = float(s4(x, y).item())
+        np.testing.assert_allclose(l4, l1, rtol=2e-4, atol=2e-5)
+
+
+def test_scaler_in_jit_matches_unscaled(data):
+    """Dynamic scaling must not change fp32 numerics (scale cancels)."""
+    x, y = data
+    m0, s0 = _mk(9)
+    scaler = GradScaler(init_loss_scaling=2.0 ** 13)
+    m1, s1 = _mk(9, scaler=scaler)
+    for _ in range(3):
+        l0 = float(s0(x, y).item())
+        l1 = float(s1(x, y).item())
+        np.testing.assert_allclose(l1, l0, rtol=1e-5, atol=1e-6)
+    p0, _ = m0.functional_state()
+    p1, _ = m1.functional_state()
+    for k in p0:
+        np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p0[k]),
+                                   rtol=1e-4, atol=1e-5)
+    # 3 finite steps recorded on device
+    assert scaler.state_dict()["good_steps"] == 3
+
+
+def test_scaler_skips_overflow_step(data):
+    x, y = data
+    scaler = GradScaler(init_loss_scaling=1024.0, decr_ratio=0.5,
+                        decr_every_n_nan_or_inf=1)
+    m, s = _mk(11, scaler=scaler)
+    s(x, y)  # warm compile + one good step
+    p_before, _ = m.functional_state()
+    p_before = {k: np.asarray(v).copy() for k, v in p_before.items()}
+    x_bad = x.copy()
+    x_bad[0, 0] = np.inf
+    s(x_bad, y)
+    p_after, _ = m.functional_state()
+    for k in p_before:
+        np.testing.assert_array_equal(p_before[k], np.asarray(p_after[k]))
+    assert float(scaler.get_loss_scaling().item()) == 512.0
+    assert scaler.state_dict()["good_steps"] == 0
+
+
+def test_scaler_growth():
+    scaler = GradScaler(init_loss_scaling=8.0, incr_ratio=2.0,
+                        incr_every_n_steps=2)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    y = rng.standard_normal((8, 4)).astype(np.float32)
+    m, s = _mk(13, scaler=scaler)
+    for _ in range(4):
+        s(x, y)
+    assert float(scaler.get_loss_scaling().item()) == 32.0  # grew twice
+
+
+def test_accum_with_bn_trains():
+    """BN models can't be bit-identical under accumulation (stats update per
+    microbatch) but must train: loss decreases, stats move."""
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((16, 16)).astype(np.float32)
+    y = rng.standard_normal((16, 4)).astype(np.float32)
+    paddle.seed(17)
+
+    class BNNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.bn = nn.BatchNorm1D(32)
+            self.fc2 = nn.Linear(32, 4)
+
+        def forward(self, t):
+            return self.fc2(paddle.nn.functional.relu(self.bn(self.fc1(t))))
+
+    m = BNNet()
+    o = paddle.optimizer.Adam(learning_rate=0.05, parameters=m.parameters())
+    loss_fn = lambda a, b: paddle.nn.functional.mse_loss(m(a), b)
+    s = paddle.jit.TrainStep(m, loss_fn, o, accum_steps=4)
+    losses = [float(s(x, y).item()) for _ in range(6)]
+    assert losses[-1] < losses[0]
+    _, bufs = m.functional_state()
+    mean_key = next(k for k in bufs if "mean" in k)
+    assert float(jnp.abs(bufs[mean_key]).sum()) > 0
+
+
+def test_scaler_load_state_dict_wins_over_device_state(data):
+    """load_state_dict after compiled steps must not be clobbered by stale
+    pending device state, and the next compiled step must use the new scale."""
+    x, y = data
+    scaler = GradScaler(init_loss_scaling=1024.0)
+    m, s = _mk(21, scaler=scaler)
+    s(x, y)  # leaves pending _device_state
+    scaler.load_state_dict({"scale": 64.0, "good_steps": 5, "bad_steps": 0})
+    assert float(scaler.get_loss_scaling().item()) == 64.0  # not clobbered
+    s(x, y)  # re-seeds device state from host
+    sd = scaler.state_dict()
+    assert sd["scale"] == 64.0 and sd["good_steps"] == 6
+
+
+def test_accum_indivisible_batch_errors():
+    m, s = _mk(23, accum_steps=3)
+    x = np.ones((16, 16), np.float32)
+    y = np.ones((16, 4), np.float32)
+    with pytest.raises(ValueError, match="accum_steps"):
+        s(x, y)

@@ -140,3 +140,22 @@ def test_elastic_scale_event_saves_checkpoint(tmp_path):
     out = mgr.restore()
     np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(8.0))
     assert out["meta"]["step"] == 11
+
+
+def test_bf16_roundtrip(tmp_path):
+    """npz can't round-trip ml_dtypes natively — bf16 must survive save/load
+    (bf16 is the default TPU training dtype)."""
+    m1 = _mesh((2, 4), ("dp", "mp"))
+    x = jnp.arange(32.0 * 8, dtype=jnp.bfloat16).reshape(32, 8)
+    xs = jax.device_put(x, NamedSharding(m1, P("dp", "mp")))
+    ckpt.save_state(str(tmp_path), {"w": xs, "s": jnp.ones((), jnp.bfloat16)})
+    out = ckpt.load_state(str(tmp_path))
+    assert out["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(np.asarray(out["w"], np.float32),
+                                  np.asarray(x, np.float32))
+    m2 = _mesh((4, 2), ("dp", "mp"))
+    out2 = ckpt.load_state(str(tmp_path),
+                           shardings={"w": NamedSharding(m2, P("mp",)),
+                                      "s": NamedSharding(m2, P())})
+    np.testing.assert_array_equal(np.asarray(out2["w"], np.float32),
+                                  np.asarray(x, np.float32))

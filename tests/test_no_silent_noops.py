@@ -1,0 +1,208 @@
+"""No API may accept user intent and silently discard it (round-1 verdict #10).
+
+Pins: FLAGS_check_nan_inf actually checks, group_sharded_parallel actually
+configures ZeRO, text datasets refuse to fabricate corpora, static.save raises
+instead of no-opping, and DataParallel's GSPMD-era semantics are explicit.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+
+
+class TinyNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc = nn.Linear(8, 4)
+
+    def forward(self, x):
+        return self.fc(x)
+
+
+# ------------------------------------------------------------ check_nan_inf
+def test_check_nan_inf_eager():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        x = paddle.to_tensor(np.array([1.0, np.inf], np.float32))
+        y = paddle.to_tensor(np.array([1.0, 1.0], np.float32))
+        with pytest.raises(RuntimeError, match="Inf or NaN"):
+            paddle.add(x, y)
+        # finite values pass
+        paddle.add(y, y)
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+    # flag off: no raise
+    x = paddle.to_tensor(np.array([np.nan], np.float32))
+    paddle.add(x, x)
+
+
+def test_check_nan_inf_under_jit():
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        net = TinyNet()
+
+        @paddle.jit.to_static
+        def f(t):
+            return paddle.log(t)  # log(-1) -> nan
+
+        with pytest.raises(Exception):
+            out = f(paddle.to_tensor(np.full((4,), -1.0, np.float32)))
+            np.asarray(out._value)  # force execution
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
+# ------------------------------------------------- group_sharded_parallel
+def test_group_sharded_parallel_configures_step():
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    paddle.seed(0)
+    m = TinyNet()
+    o = paddle.optimizer.Adam(parameters=m.parameters())
+    m, o = group_sharded_parallel(m, o, "os_g")
+    mesh = dist.build_mesh(sharding=8)
+    step = dist.ShardedTrainStep(m, lambda x, y: paddle.nn.functional.mse_loss(m(x), y),
+                                 o, mesh)
+    assert step.zero_stage == 2  # consumed, not discarded
+    # and it actually runs sharded
+    rng = np.random.default_rng(0)
+    loss = step(rng.standard_normal((16, 8)).astype(np.float32),
+                rng.standard_normal((16, 4)).astype(np.float32))
+    assert np.isfinite(float(loss.item()))
+
+
+def test_group_sharded_parallel_rejects_bad_args():
+    from paddle_tpu.distributed.sharding import group_sharded_parallel
+
+    m = TinyNet()
+    o = paddle.optimizer.Adam(parameters=m.parameters())
+    with pytest.raises(ValueError, match="level"):
+        group_sharded_parallel(m, o, "zeros-4")
+    with pytest.raises(NotImplementedError, match="offload"):
+        group_sharded_parallel(m, o, "p_g_os", offload=True)
+
+
+# --------------------------------------------------------------- text data
+def test_text_datasets_refuse_silent_fabrication():
+    import paddle_tpu.text as text
+
+    with pytest.raises(RuntimeError, match="data source"):
+        text.Imdb()
+    with pytest.raises(RuntimeError, match="data source"):
+        text.UCIHousing()
+    with pytest.warns(UserWarning, match="GENERATED"):
+        ds = text.Imdb(synthetic=True)
+    assert len(ds) > 0
+
+
+def test_uci_housing_real_file(tmp_path):
+    import paddle_tpu.text as text
+
+    rng = np.random.default_rng(0)
+    raw = rng.random((20, 14)).astype(np.float32)
+    f = tmp_path / "housing.data"
+    np.savetxt(f, raw)
+    tr = text.UCIHousing(data_file=str(f), mode="train")
+    te = text.UCIHousing(data_file=str(f), mode="test")
+    assert len(tr) == 16 and len(te) == 4
+    x, y = tr[0]
+    assert x.shape == (13,) and y.shape == (1,)
+    assert float(x.max()) <= 1.0 + 1e-6  # normalized
+
+
+def test_imdb_real_dir(tmp_path):
+    import paddle_tpu.text as text
+
+    for sub, txt in (("pos", "great movie great acting"),
+                     ("neg", "terrible movie terrible plot")):
+        d = tmp_path / "train" / sub
+        d.mkdir(parents=True)
+        for i in range(3):
+            (d / f"{i}.txt").write_text(txt)
+    ds = text.Imdb(data_file=str(tmp_path), mode="train", cutoff=2)
+    assert len(ds) == 6
+    doc, lbl = ds[0]
+    assert doc.dtype == np.int64 and lbl in (0, 1)
+    assert "movie" in ds.word_idx  # appears 6 times >= cutoff
+
+
+# ------------------------------------------------------------------ static
+def test_static_save_raises():
+    prog = paddle.static.default_main_program()
+    with pytest.raises(NotImplementedError, match="jit.save"):
+        paddle.static.save(prog, "/tmp/x")
+    with pytest.raises(NotImplementedError):
+        paddle.static.save_inference_model("/tmp/x", [], [], None)
+
+
+# ------------------------------------------------------------ DataParallel
+def test_data_parallel_semantics_pinned():
+    """Under GSPMD the wrapper is transparent: forward == inner forward,
+    scale_loss is identity, apply_collective_grads is a no-op (the all-reduce
+    is emitted by the partitioner inside the jitted step, not by hooks)."""
+    paddle.seed(0)
+    inner = TinyNet()
+    dp = dist.DataParallel(inner)
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    np.testing.assert_array_equal(np.asarray(dp(x)._value),
+                                  np.asarray(inner(x)._value))
+    loss = paddle.mean(dp(x))
+    assert dp.scale_loss(loss) is loss
+    dp.apply_collective_grads()  # must not throw
+    assert dp.state_dict().keys() == inner.state_dict().keys()
+
+
+# --------------------------------------------------------------- to_static
+def test_to_static_stable_cache_key():
+    """A config object rebuilt each call must not recompile each call forever;
+    identical primitive/dict args must share one compiled variant."""
+    import paddle_tpu.jit.to_static as ts
+
+    calls = []
+
+    @paddle.jit.to_static
+    def f(x, cfg):
+        calls.append(1)
+        return x * cfg["scale"]
+
+    x = paddle.to_tensor(np.ones((4,), np.float32))
+    f(x, {"scale": 2.0})
+    f(x, {"scale": 2.0})
+    f(x, {"scale": 2.0})
+    assert f._compile_count == 1
+    f(x, {"scale": 3.0})  # different static value -> one more compile
+    assert f._compile_count == 2
+
+
+def test_to_static_cache_eviction():
+    @paddle.jit.to_static
+    def g(x, n):
+        return x + n
+
+    g.MAX_CACHE = 4
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    for i in range(8):
+        g(x, float(i))
+    assert len(g._cache) <= 4
+
+
+def test_not_to_static_honored():
+    @paddle.jit.not_to_static
+    def h(x):
+        return x + 1
+
+    out = paddle.jit.to_static(h)
+    assert out is h  # returned unchanged, still eager
+
+
+def test_get_lowered_returns_stablehlo():
+    net = TinyNet()
+    sf = paddle.jit.to_static(net)
+    x = paddle.to_tensor(np.ones((2, 8), np.float32))
+    lowered = net.forward.get_lowered(x)
+    text = lowered.as_text()
+    assert "stablehlo" in text or "mhlo" in text or "func" in text
+    cp = net.forward.concrete_program(x)
+    assert cp.inputs[0][1] == (2, 8)
