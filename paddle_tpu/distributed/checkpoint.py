@@ -108,6 +108,13 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
     on every process (the volumes are disjoint).  Returns the checkpoint dir.
     """
     proc = jax.process_index() if process_index is None else process_index
+    nprocs = jax.process_count() if process_count is None else process_count
+    if step is None and (nprocs > 1 or proc > 0):
+        # without a step there is no generation marker to tell a fresh sidecar
+        # from a stale one left by a previous, wider save
+        raise ValueError(
+            "save_state(step=None) is single-process only; multi-host saves "
+            "must pass a step so each save generation is distinguishable")
     ckpt = _step_dir(path, step)
     os.makedirs(ckpt, exist_ok=True)
 
@@ -154,16 +161,24 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
 
     if proc == 0:
         idx_path = os.path.join(ckpt, _INDEX)
-        # a re-save into the same dir (step=None) invalidates any sidecars a
-        # previous, wider world may have left behind — drop the stale ones
+        # drop stale artifacts from a previous save generation: step=None dirs
+        # are single-process (enforced above), so ALL sidecars/foreign volumes
+        # are stale; step dirs drop sidecars whose recorded step mismatches
         for name in os.listdir(ckpt):
+            full = os.path.join(ckpt, name)
             if name.startswith("index_p") and name.endswith(".json"):
+                if step is None:
+                    os.remove(full)
+                    continue
                 try:
-                    with open(os.path.join(ckpt, name)) as f:
+                    with open(full) as f:
                         if json.load(f).get("step") != step:
-                            os.remove(os.path.join(ckpt, name))
+                            os.remove(full)
                 except (OSError, ValueError):
-                    os.remove(os.path.join(ckpt, name))
+                    os.remove(full)
+            elif step is None and name.startswith("volume_p") and \
+                    name != vol_name and name.endswith(".npz"):
+                os.remove(full)
         with open(idx_path, "w") as f:
             json.dump({"version": 1, "step": step, "leaves": index}, f)
         with open(os.path.join(ckpt, _SKELETON), "wb") as f:

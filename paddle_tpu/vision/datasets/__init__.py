@@ -84,7 +84,12 @@ class Cifar10(Dataset):
 
     def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
         self.transform = transform
-        if data_file is not None and os.path.exists(data_file):
+        if data_file is not None:
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(
+                    f"{type(self).__name__}: data_file '{data_file}' does not "
+                    "exist (an explicitly given path never falls back to "
+                    "generated data)")
             self._load_pickled(data_file, mode)
         else:
             import warnings

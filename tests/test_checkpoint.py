@@ -159,3 +159,29 @@ def test_bf16_roundtrip(tmp_path):
                                       "s": NamedSharding(m2, P())})
     np.testing.assert_array_equal(np.asarray(out2["w"], np.float32),
                                   np.asarray(x, np.float32))
+
+
+def test_step_none_resave_drops_stale_artifacts(tmp_path):
+    """A step=None re-save into the same dir must not leave stale sidecars or
+    foreign volumes that would corrupt the next load."""
+    # fake a stale wider-world save: sidecar + volume from "process 1"
+    ckpt.save_state(str(tmp_path), {"w": jnp.ones((4,))})
+    import json
+    with open(tmp_path / "index_p00001.json", "w") as f:
+        json.dump({"step": None, "leaves": {"w": {
+            "shape": [4], "dtype": "float32",
+            "chunks": [{"volume": "volume_p00001.npz", "key": "w#0",
+                        "offset": [0], "sizes": [4]}]}}}, f)
+    np.savez(tmp_path / "volume_p00001.npz", **{"w#0": np.full((4,), 99.0, np.float32)})
+
+    ckpt.save_state(str(tmp_path), {"w": jnp.full((4,), 7.0)})
+    out = ckpt.load_state(str(tmp_path))
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.full((4,), 7.0))
+    assert not (tmp_path / "index_p00001.json").exists()
+    assert not (tmp_path / "volume_p00001.npz").exists()
+
+
+def test_step_none_multiproc_rejected(tmp_path):
+    with pytest.raises(ValueError, match="single-process"):
+        ckpt.save_state(str(tmp_path), {"w": jnp.ones(2)}, process_index=1,
+                        process_count=2)

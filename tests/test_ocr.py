@@ -1,0 +1,138 @@
+"""OCR slice (BASELINE config #3): CTC loss vs torch oracle, DB det net,
+CRNN rec net, width bucketing policy."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.vision import ocr
+
+
+# ----------------------------------------------------------------- CTC oracle
+def test_ctc_loss_matches_torch():
+    """Per-sample negative log likelihoods must match torch's (torch's 'mean'
+    additionally divides by label length — a different convention from
+    paddle's, so compare reduction='none')."""
+    import torch
+
+    rng = np.random.default_rng(0)
+    T, N, C, L = 12, 3, 7, 4
+    logits = rng.standard_normal((T, N, C)).astype(np.float32)
+    lp = logits - np.log(np.exp(logits).sum(-1, keepdims=True))
+    labels = rng.integers(1, C, (N, L)).astype(np.int64)
+    ilen = np.array([12, 10, 8], np.int64)
+    llen = np.array([4, 3, 2], np.int64)
+
+    ours = np.asarray(F.ctc_loss(paddle.to_tensor(lp), paddle.to_tensor(labels),
+                                 ilen, llen, blank=0, reduction="none")._value)
+    ref = torch.nn.functional.ctc_loss(
+        torch.tensor(lp), torch.tensor(labels), torch.tensor(ilen),
+        torch.tensor(llen), blank=0, reduction="none", zero_infinity=False)
+    np.testing.assert_allclose(ours.reshape(-1), ref.numpy(), rtol=1e-4)
+
+
+def test_ctc_loss_grad_matches_torch():
+    import torch
+
+    rng = np.random.default_rng(1)
+    T, N, C, L = 8, 2, 5, 3
+    logits = rng.standard_normal((T, N, C)).astype(np.float32)
+    labels = rng.integers(1, C, (N, L)).astype(np.int64)
+    ilen = np.array([8, 6], np.int64)
+    llen = np.array([3, 2], np.int64)
+
+    # ours: grad wrt raw logits through log_softmax + ctc
+    x = paddle.to_tensor(logits, stop_gradient=False)
+    lp = F.log_softmax(x, axis=-1)
+    loss = F.ctc_loss(lp, paddle.to_tensor(labels), ilen, llen, reduction="sum")
+    loss.backward()
+    g_ours = np.asarray(x.grad._value)
+
+    xt = torch.tensor(logits, requires_grad=True)
+    lpt = torch.nn.functional.log_softmax(xt, dim=-1)
+    lt = torch.nn.functional.ctc_loss(lpt, torch.tensor(labels),
+                                      torch.tensor(ilen), torch.tensor(llen),
+                                      blank=0, reduction="sum")
+    lt.backward()
+    np.testing.assert_allclose(g_ours, xt.grad.numpy(), rtol=1e-3, atol=1e-5)
+
+
+# ------------------------------------------------------------------- DB detect
+def test_dbnet_forward_and_loss_decreases():
+    paddle.seed(0)
+    net = ocr.DBNet(backbone_scale=0.35, arch="small", neck_channels=32)
+    opt = paddle.optimizer.Adam(learning_rate=2e-3, parameters=net.parameters())
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 64, 64)).astype(np.float32)
+    shrink = np.zeros((2, 64, 64), np.float32)
+    shrink[:, 16:48, 16:48] = 1.0     # a synthetic text region
+    mask = np.ones_like(shrink)
+    thresh = shrink * 0.7
+
+    out = net(paddle.to_tensor(x))
+    assert tuple(out["maps"].shape) == (2, 3, 64, 64)
+    p = np.asarray(out["prob"]._value)
+    assert p.min() >= 0.0 and p.max() <= 1.0
+
+    def loss_fn(xv, sm, mk, tm):
+        pred = net(xv)
+        return ocr.db_loss(pred, sm, mk, thresh_map=tm)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    losses = [float(step(x, shrink, mask, thresh).item()) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+# ---------------------------------------------------------------------- CRNN
+def test_crnn_shapes_and_ctc_training():
+    paddle.seed(1)
+    vocab = 11  # 10 chars + blank 0
+    net = ocr.CRNN(num_classes=vocab, hidden_size=32, channels=(16, 32, 48, 48))
+    opt = paddle.optimizer.Adam(learning_rate=5e-3, parameters=net.parameters())
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((4, 3, 32, 64)).astype(np.float32)
+    labels = rng.integers(1, vocab, (4, 5)).astype(np.int64)
+    llen = np.full((4,), 5, np.int64)
+
+    logits = net(paddle.to_tensor(x))
+    assert tuple(logits.shape) == (4, 16, vocab)  # T = W/4
+
+    def loss_fn(xv, lbl, ll):
+        return ocr.crnn_ctc_loss(net(xv), lbl, ll)
+
+    step = paddle.jit.TrainStep(net, loss_fn, opt)
+    losses = [float(step(x, labels, llen).item()) for _ in range(10)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_ctc_greedy_decode():
+    # frames argmax: [blank, 3, 3, blank, 5] -> [3, 5]
+    logits = np.full((1, 5, 6), -5.0, np.float32)
+    for t, c in enumerate([0, 3, 3, 0, 5]):
+        logits[0, t, c] = 5.0
+    out = ocr.ctc_greedy_decode(paddle.to_tensor(logits))
+    assert out == [[3, 5]]
+
+
+# ------------------------------------------------------------------ bucketing
+def test_width_bucketing_bounds_compiles():
+    rng = np.random.default_rng(3)
+    widths = rng.integers(40, 700, 257).tolist()
+    sampler = ocr.WidthBucketBatchSampler(widths, batch_size=8, shuffle=True)
+    seen_buckets = set()
+    seen_idx = []
+    for bucket, idxs in sampler:
+        assert all(ocr.bucket_width(widths[i]) == bucket for i in idxs)
+        seen_buckets.add(bucket)
+        seen_idx += idxs
+    assert sorted(seen_idx) == list(range(257))          # every sample once
+    assert seen_buckets <= set(ocr.DEFAULT_WIDTH_BUCKETS)  # bounded shapes
+
+
+def test_pad_to_width():
+    img = np.ones((3, 32, 50), np.float32)
+    padded = ocr.pad_to_width(img, 64)
+    assert padded.shape == (3, 32, 64)
+    assert padded[..., 50:].sum() == 0
+    down = ocr.pad_to_width(np.ones((3, 32, 100), np.float32), 64)
+    assert down.shape == (3, 32, 64)
