@@ -16,7 +16,7 @@ from .collective import (  # noqa: F401
 from .parallel import DataParallel  # noqa: F401
 from .topology import (  # noqa: F401
     CommunicateTopology, HybridCommunicateGroup, build_mesh,
-    get_hybrid_communicate_group,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
 )
 from .sharded_train_step import ShardedTrainStep  # noqa: F401
 from .sharding_ctx import mesh_scope, constraint, annotate  # noqa: F401

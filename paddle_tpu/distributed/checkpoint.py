@@ -175,7 +175,10 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
                         if json.load(f).get("step") != step:
                             os.remove(full)
                 except (OSError, ValueError):
-                    os.remove(full)
+                    # unreadable != stale: sidecars are written atomically
+                    # (tmp + rename), so this is a transient read race — leave
+                    # it; _read_index skips mismatched/garbled sidecars anyway
+                    pass
             elif step is None and name.startswith("volume_p") and \
                     name != vol_name and name.endswith(".npz"):
                 os.remove(full)
@@ -192,8 +195,10 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
         # non-zero process: publish our chunk table so proc 0 can merge it, or —
         # shared-filesystem case — just append via a sidecar the loader also reads.
         side = os.path.join(ckpt, f"index_p{proc:05d}.json")
-        with open(side, "w") as f:
+        tmp_side = side + ".tmp"
+        with open(tmp_side, "w") as f:
             json.dump({"step": step, "leaves": index}, f)
+        os.replace(tmp_side, side)  # atomic: readers never see a partial file
     return ckpt
 
 
@@ -225,8 +230,11 @@ def _read_index(ckpt):
     # from a different save generation (mismatched step) is stale — skip it
     for name in sorted(os.listdir(ckpt)):
         if name.startswith("index_p") and name.endswith(".json"):
-            with open(os.path.join(ckpt, name)) as f:
-                side_doc = json.load(f)
+            try:
+                with open(os.path.join(ckpt, name)) as f:
+                    side_doc = json.load(f)
+            except (OSError, ValueError):
+                continue  # transient write race; chunk coverage check catches real gaps
             if side_doc.get("step") != index.get("step"):
                 continue
             side = side_doc["leaves"]

@@ -53,7 +53,9 @@ def init_parallel_env():
     if _initialized:
         return ParallelEnv()
     n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
-    if n_procs > 1 and jax.process_count() == 1:
+    # NB: don't probe jax.process_count() here — it would initialize the XLA
+    # backend, after which jax.distributed.initialize refuses to run
+    if n_procs > 1 and not jax.distributed.is_initialized():
         coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
         pid = int(os.environ.get("PADDLE_TRAINER_ID", 0))
         if coord:

@@ -126,8 +126,16 @@ class HybridCommunicateGroup:
         return self._sep_degree
 
     def _coord(self):
-        # single-process SPMD: coordinates materialize only inside shard_map; host
-        # coordinate is process-level (multi-host) or 0
+        """This process's coordinate in the mesh = coordinate of its first
+        addressable device (per-rank coordinates only exist at process
+        granularity on TPU; within a process SPMD materializes them inside
+        shard_map).  Single-process: (0,0,0,0,0)."""
+        if self.mesh is not None and jax.process_count() > 1:
+            local_ids = {d.id for d in jax.local_devices()}
+            devs = self.mesh.devices
+            for idx in np.ndindex(devs.shape):
+                if devs[idx].id in local_ids:
+                    return tuple(int(i) for i in idx)
         return (0, 0, 0, 0, 0)
 
     def get_data_parallel_rank(self):
@@ -161,7 +169,21 @@ class HybridCommunicateGroup:
         return self._mp_group
 
     def get_rank_from_stage(self, stage_id, **kwargs):
-        return stage_id
+        """PROCESS rank owning pipeline stage `stage_id` at this process's
+        other coordinates (overridable via kwargs, ref topology.py).  On a
+        multi-device-per-process mesh this is the owning process index, not a
+        per-device ordinal."""
+        coord = list(self._coord())
+        coord[0] = stage_id
+        for i, name in enumerate(("pp", "dp", "sharding", "sep", "mp")):
+            if name in kwargs:
+                coord[i] = kwargs[name]
+        if self.mesh is not None:
+            dev = self.mesh.devices[tuple(coord)]
+            return int(getattr(dev, "process_index", 0))
+        dims = (self._pp_degree, self._dp_degree, self._sharding_degree,
+                self._sep_degree, self._mp_degree)
+        return int(np.ravel_multi_index(coord, dims))
 
     def topology(self):
         return self._topo

@@ -391,11 +391,13 @@ class _NativeWorkerIter:
 class DataLoader:
     """Ref: fluid/reader.py:275 DataLoader (+dataloader_iter.py:148,342).
 
-    num_workers>0 prefetches in the background: preferred path is N worker threads
-    feeding a GIL-free C++ ring buffer (core/native), falling back to a single
-    Python prefetch thread when the native library is unavailable.  The reference's
-    process workers + shared memory are unnecessary: batches are numpy, and the
-    step's H2D copy is async under JAX.
+    num_workers>0 prefetches in the background.  With use_shared_memory=True
+    (default, the reference's semantics) batches come from N forked worker
+    PROCESSES through POSIX shared memory (io/_mp_loader.py) — real extra cores
+    for JPEG-decode-heavy pipelines, no GIL.  use_shared_memory=False keeps the
+    work in-process: N threads feeding a GIL-free C++ ring (core/native),
+    falling back to a single Python prefetch thread.  All paths preserve strict
+    sampler order (the reference's _rcvd_idx reorder contract).
     """
 
     def __init__(self, dataset, feed_list=None, places=None, return_list=True,
@@ -417,6 +419,8 @@ class DataLoader:
             self.batch_sampler = None
         self.batch_size = batch_size
         self._use_shared_memory = use_shared_memory
+        self._timeout = timeout
+        self._worker_init_fn = worker_init_fn
 
     def _gen(self):
         if self._iterable_mode:
@@ -444,6 +448,30 @@ class DataLoader:
         if self.num_workers and self.num_workers > 0:
             if self.batch_sampler is not None and self._use_shared_memory:
                 try:
+                    from ._mp_loader import MultiprocessIter
+
+                    return MultiprocessIter(
+                        self, self.num_workers,
+                        prefetch_factor=self.prefetch_factor,
+                        timeout=self._timeout,
+                        worker_init_fn=self._worker_init_fn)
+                except Exception as e:
+                    # thread paths can't honor per-process init; degrading
+                    # silently would change semantics the user asked for
+                    if self._worker_init_fn is not None:
+                        raise RuntimeError(
+                            "multiprocess DataLoader workers failed to start and "
+                            "worker_init_fn only runs in process workers — fix "
+                            "the cause (often an unpicklable dataset/collate_fn) "
+                            "or drop worker_init_fn") from e
+                    import warnings
+
+                    warnings.warn(
+                        f"multiprocess DataLoader workers unavailable "
+                        f"({type(e).__name__}: {e}); falling back to in-process "
+                        f"worker threads", stacklevel=2)
+            if self.batch_sampler is not None:
+                try:
                     return _NativeWorkerIter(self, self.num_workers,
                                              self.num_workers * self.prefetch_factor)
                 except Exception:
@@ -458,4 +486,7 @@ class DataLoader:
 
 
 def get_worker_info():
-    return None
+    """Ref worker.py get_worker_info — non-None only inside a worker process."""
+    from ._mp_loader import get_worker_info as _gwi
+
+    return _gwi()

@@ -53,7 +53,14 @@ def save(layer, path, input_spec=None, **configs):
     # functional_state(); persist the key split so load() can rebuild it (the round-1
     # bug: stuffing everything into __params__ broke any model with buffers, e.g. BN)
     with open(path + ".pdiparams.info", "wb") as f:
-        pickle.dump({"param_keys": param_keys, "buffer_keys": buffer_keys}, f)
+        pickle.dump({
+            "param_keys": param_keys, "buffer_keys": buffer_keys,
+            "inputs": [
+                {"name": getattr(s, "name", None) or f"x{i}",
+                 "shape": list(s.shape), "dtype": str(s.dtype)}
+                for i, s in enumerate(input_spec)
+            ] if input_spec is not None else None,
+        }, f)
 
     if input_spec is not None and isinstance(layer, Layer):
         from jax import export as jax_export
@@ -89,11 +96,12 @@ def save(layer, path, input_spec=None, **configs):
 class TranslatedLayer(Layer):
     """Ref: fluid/dygraph/io.py TranslatedLayer — a loaded inference program."""
 
-    def __init__(self, exported, params, buffers):
+    def __init__(self, exported, params, buffers, info=None):
         super().__init__()
         self._exported = exported
         self._params = params    # flat {name: jnp array}, the exact exported pytree
         self._buffers_tree = buffers
+        self._info = info or {}
 
     def forward(self, *args):
         raw = tuple(a._value if isinstance(a, Tensor) else a for a in args)
@@ -117,6 +125,7 @@ def load(path, **configs):
     with open(path + ".pdiparams", "rb") as f:
         state = pickle.load(f)
     info_file = path + ".pdiparams.info"
+    info = {}
     if os.path.exists(info_file):
         with open(info_file, "rb") as f:
             info = pickle.load(f)
@@ -131,7 +140,7 @@ def load(path, **configs):
 
         with open(model_file, "rb") as f:
             exported = jax_export.deserialize(f.read())
-        return TranslatedLayer(exported, params, buffers)
+        return TranslatedLayer(exported, params, buffers, info)
     raise FileNotFoundError(f"no serialized program at {model_file}; "
                             f"load params with paddle.load({path + '.pdiparams'!r}) instead")
 

@@ -4,6 +4,7 @@ forward returns [main, aux1, aux2] like the reference (aux heads are trained
 with discounted losses; at eval only `main` matters)."""
 from __future__ import annotations
 
+import paddle_tpu as paddle
 from ... import nn
 
 
@@ -25,8 +26,6 @@ class Inception(nn.Layer):
                                      _conv(in_c, proj, 1))
 
     def forward(self, x):
-        import paddle_tpu as paddle
-
         return paddle.concat([self.branch1(x), self.branch2(x),
                               self.branch3(x), self.branch4(x)], axis=1)
 

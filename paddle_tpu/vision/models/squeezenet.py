@@ -1,6 +1,7 @@
 """SqueezeNet 1.0/1.1 (ref: python/paddle/vision/models/squeezenet.py:76)."""
 from __future__ import annotations
 
+import paddle_tpu as paddle
 from ... import nn
 
 
@@ -16,8 +17,6 @@ class Fire(nn.Layer):
                                      nn.ReLU())
 
     def forward(self, x):
-        import paddle_tpu as paddle
-
         s = self.squeeze(x)
         return paddle.concat([self.expand1(s), self.expand3(s)], axis=1)
 
@@ -60,8 +59,6 @@ class SqueezeNet(nn.Layer):
         if self.num_classes > 0:
             x = self.classifier(x).flatten(1)
         elif self.with_pool:
-            import paddle_tpu as paddle
-
             x = paddle.nn.functional.adaptive_avg_pool2d(x, 1)
         return x
 

@@ -1,0 +1,67 @@
+"""Trainer payload for the multi-process parity test (ref pattern:
+unittests/dist_mnist.py run by test_dist_base.py:786).
+
+Launched with PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM / PADDLE_MASTER set;
+bootstraps through init_parallel_env (-> jax.distributed.initialize), trains a
+deterministic model under dp=2, writes losses + topology coords as JSON."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def main():
+    out_path = sys.argv[1]
+    penv = dist.init_parallel_env()
+    nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert jax.process_count() == nproc, jax.process_count()
+
+    paddle.seed(42)
+    model = Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+    hcg = dist.HybridCommunicateGroup(dp=nproc, mp=1, pp=1, sharding=1)
+    dist.set_hybrid_communicate_group(hcg)
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    step = dist.ShardedTrainStep(model, loss_fn, opt, hcg.mesh)
+    rng = np.random.default_rng(7)
+    losses = []
+    for _ in range(5):
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 4)).astype(np.float32)
+        losses.append(float(step(x, y).item()))
+
+    with open(out_path, "w") as f:
+        json.dump({
+            "rank": penv.rank,
+            "world_size": penv.world_size,
+            "coord": list(hcg._coord()),
+            "dp_rank": hcg.get_data_parallel_rank(),
+            "losses": losses,
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,193 @@
+"""Distribution zoo vs scipy/torch oracles (ref python/paddle/distribution/)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _t(x):
+    return paddle.to_tensor(np.asarray(x, np.float32))
+
+
+def _np(t):
+    return np.asarray(t._value)
+
+
+class TestLogProbs:
+    def test_beta(self):
+        a, b, v = 2.5, 1.5, 0.3
+        lp = D.Beta(a, b).log_prob(_t(v))
+        np.testing.assert_allclose(float(lp.item()), st.beta.logpdf(v, a, b), rtol=1e-5)
+
+    def test_dirichlet(self):
+        c = np.array([1.5, 2.0, 3.0], np.float32)
+        v = np.array([0.2, 0.3, 0.5], np.float32)
+        lp = D.Dirichlet(c).log_prob(_t(v))
+        np.testing.assert_allclose(float(lp.item()),
+                                   st.dirichlet.logpdf(v, c), rtol=1e-5)
+
+    def test_multinomial(self):
+        p = np.array([0.2, 0.3, 0.5], np.float32)
+        v = np.array([1.0, 2.0, 3.0], np.float32)
+        lp = D.Multinomial(6, p).log_prob(_t(v))
+        np.testing.assert_allclose(float(lp.item()),
+                                   st.multinomial.logpmf(v, 6, p), rtol=1e-5)
+
+    def test_laplace(self):
+        lp = D.Laplace(0.5, 2.0).log_prob(_t(1.7))
+        np.testing.assert_allclose(float(lp.item()),
+                                   st.laplace.logpdf(1.7, 0.5, 2.0), rtol=1e-5)
+
+    def test_gumbel(self):
+        lp = D.Gumbel(0.5, 2.0).log_prob(_t(1.7))
+        np.testing.assert_allclose(float(lp.item()),
+                                   st.gumbel_r.logpdf(1.7, 0.5, 2.0), rtol=1e-5)
+
+    def test_lognormal(self):
+        lp = D.LogNormal(0.2, 0.8).log_prob(_t(1.3))
+        np.testing.assert_allclose(
+            float(lp.item()), st.lognorm.logpdf(1.3, s=0.8, scale=np.exp(0.2)),
+            rtol=1e-5)
+
+
+class TestEntropy:
+    def test_beta(self):
+        np.testing.assert_allclose(float(D.Beta(2.0, 3.0).entropy().item()),
+                                   st.beta.entropy(2.0, 3.0), rtol=1e-5)
+
+    def test_dirichlet(self):
+        c = np.array([1.5, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(float(D.Dirichlet(c).entropy().item()),
+                                   st.dirichlet.entropy(c), rtol=1e-5)
+
+    def test_laplace(self):
+        np.testing.assert_allclose(float(D.Laplace(0.0, 2.0).entropy().item()),
+                                   st.laplace.entropy(0.0, 2.0), rtol=1e-5)
+
+    def test_gumbel(self):
+        np.testing.assert_allclose(float(D.Gumbel(0.0, 2.0).entropy().item()),
+                                   st.gumbel_r.entropy(0.0, 2.0), rtol=1e-5)
+
+
+class TestSampling:
+    def test_beta_moments(self):
+        paddle.seed(0)
+        s = _np(D.Beta(2.0, 5.0).sample((20000,)))
+        assert abs(s.mean() - 2 / 7) < 0.01
+        assert (s > 0).all() and (s < 1).all()
+
+    def test_dirichlet_simplex(self):
+        paddle.seed(0)
+        s = _np(D.Dirichlet(np.array([2.0, 3.0, 4.0], np.float32)).sample((5000,)))
+        np.testing.assert_allclose(s.sum(-1), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(s.mean(0), [2 / 9, 3 / 9, 4 / 9], atol=0.01)
+
+    def test_multinomial_counts(self):
+        paddle.seed(0)
+        d = D.Multinomial(10, np.array([0.5, 0.5], np.float32))
+        s = _np(d.sample((2000,)))
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+        np.testing.assert_allclose(s.mean(0), [5.0, 5.0], atol=0.2)
+
+
+class TestKL:
+    def test_registry_dispatch_and_values(self):
+        import torch
+        import torch.distributions as td
+
+        pairs = [
+            (D.Beta(2.0, 3.0), D.Beta(4.0, 2.0),
+             td.Beta(2.0, 3.0), td.Beta(4.0, 2.0)),
+            (D.Laplace(0.0, 1.0), D.Laplace(1.0, 2.0),
+             td.Laplace(0.0, 1.0), td.Laplace(1.0, 2.0)),
+            (D.Normal(0.0, 1.0), D.Normal(1.0, 2.0),
+             td.Normal(0.0, 1.0), td.Normal(1.0, 2.0)),
+        ]
+        for p, q, tp, tq in pairs:
+            ours = float(D.kl_divergence(p, q).item())
+            ref = float(td.kl_divergence(tp, tq))
+            np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_dirichlet_kl(self):
+        import torch.distributions as td
+        import torch
+
+        c1 = np.array([1.5, 2.0, 3.0], np.float32)
+        c2 = np.array([2.0, 2.0, 2.0], np.float32)
+        ours = float(D.kl_divergence(D.Dirichlet(c1), D.Dirichlet(c2)).item())
+        ref = float(td.kl_divergence(td.Dirichlet(torch.tensor(c1)),
+                                     td.Dirichlet(torch.tensor(c2))))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    def test_register_kl_custom(self):
+        class MyDist(D.Normal):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl(p, q):
+            return "custom"
+
+        assert D.kl_divergence(MyDist(0.0, 1.0), MyDist(0.0, 1.0)) == "custom"
+        # subclass falls back to (Normal, Normal) against a plain Normal
+        out = D.kl_divergence(MyDist(0.0, 1.0), D.Normal(1.0, 2.0))
+        assert float(out.item()) > 0
+
+    def test_unregistered_raises(self):
+        with pytest.raises(NotImplementedError, match="register_kl"):
+            D.kl_divergence(D.Beta(1.0, 1.0), D.Normal(0.0, 1.0))
+
+
+class TestTransforms:
+    def test_affine_exp_roundtrip_and_ldj(self):
+        x = _t(np.linspace(-2, 2, 9).astype(np.float32))
+        for t in (D.AffineTransform(1.0, 2.5), D.ExpTransform(),
+                  D.SigmoidTransform(), D.TanhTransform()):
+            y = t.forward(x)
+            back = t.inverse(y)
+            np.testing.assert_allclose(_np(back), _np(x), rtol=1e-4, atol=1e-5)
+
+    def test_tanh_ldj_matches_autodiff(self):
+        import jax
+        import jax.numpy as jnp
+
+        t = D.TanhTransform()
+        x = np.linspace(-1.5, 1.5, 7).astype(np.float32)
+        ldj = _np(t.forward_log_det_jacobian(_t(x)))
+        ref = np.log(np.abs(jax.vmap(jax.grad(jnp.tanh))(jnp.asarray(x))))
+        np.testing.assert_allclose(ldj, np.asarray(ref), rtol=1e-4)
+
+    def test_chain(self):
+        t = D.ChainTransform([D.AffineTransform(0.0, 2.0), D.ExpTransform()])
+        x = _t(np.array([0.5], np.float32))
+        np.testing.assert_allclose(_np(t.forward(x)), np.exp(1.0), rtol=1e-5)
+        np.testing.assert_allclose(
+            _np(t.inverse(t.forward(x))), [0.5], rtol=1e-5)
+
+    def test_stickbreaking_simplex(self):
+        t = D.StickBreakingTransform()
+        x = _t(np.array([0.3, -0.2, 0.7], np.float32))
+        y = _np(t.forward(x))
+        assert y.shape == (4,)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(_np(t.inverse(_t(y))), _np(x), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_transformed_distribution_lognormal(self):
+        """TransformedDistribution(Normal, Exp) == LogNormal."""
+        base = D.Normal(0.2, 0.8)
+        td_ = D.TransformedDistribution(base, [D.ExpTransform()])
+        v = _t(np.array([0.7, 1.3, 2.1], np.float32))
+        np.testing.assert_allclose(_np(td_.log_prob(v)),
+                                   _np(D.LogNormal(0.2, 0.8).log_prob(v)),
+                                   rtol=1e-5)
+
+    def test_independent(self):
+        base = D.Normal(_t(np.zeros((3, 4))), _t(np.ones((3, 4))))
+        ind = D.Independent(base, 1)
+        assert ind.batch_shape == [3] and ind.event_shape == [4]
+        v = _t(np.ones((3, 4), np.float32))
+        lp = _np(ind.log_prob(v))
+        assert lp.shape == (3,)
+        np.testing.assert_allclose(lp, _np(base.log_prob(v)).sum(-1), rtol=1e-6)

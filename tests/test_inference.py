@@ -1,0 +1,97 @@
+"""paddle.inference predictor over jit.save artifacts
+(ref analysis_predictor.h: Config -> create_predictor -> handles -> run)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.inference import Config, create_predictor
+from paddle_tpu.jit import InputSpec
+
+
+class BNNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.bn = nn.BatchNorm1D(16)
+        self.fc2 = nn.Linear(16, 3)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.bn(self.fc1(x))))
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("infer")
+    paddle.seed(3)
+    net = BNNet()
+    # train a couple of eager steps so BN stats are non-trivial
+    opt = paddle.optimizer.Adam(parameters=net.parameters())
+    rng = np.random.default_rng(0)
+    for _ in range(3):
+        x = paddle.to_tensor(rng.standard_normal((4, 8)).astype(np.float32))
+        y = paddle.to_tensor(rng.standard_normal((4, 3)).astype(np.float32))
+        loss = paddle.nn.functional.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    prefix = str(tmp / "model")
+    paddle.jit.save(net, prefix, input_spec=[InputSpec([4, 8], "float32", "x")])
+    net.eval()
+    ref_in = rng.standard_normal((4, 8)).astype(np.float32)
+    ref_out = np.asarray(net(paddle.to_tensor(ref_in))._value)
+    return prefix, ref_in, ref_out
+
+
+def test_predictor_matches_eager(saved_model):
+    prefix, ref_in, ref_out = saved_model
+    config = Config(prefix)
+    predictor = create_predictor(config)
+
+    names = predictor.get_input_names()
+    assert names == ["x"]  # the InputSpec name recorded at save time
+    predictor.get_input_handle("x").copy_from_cpu(ref_in)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0]).copy_to_cpu()
+    np.testing.assert_allclose(out, ref_out, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_dynamic_batch(saved_model):
+    """Exported at batch 4; serve batch 2 (pad) and batch 10 (chunk)."""
+    prefix, ref_in, _ = saved_model
+    predictor = create_predictor(Config(prefix))
+    rng = np.random.default_rng(1)
+
+    small = rng.standard_normal((2, 8)).astype(np.float32)
+    (out_small,) = predictor.run([small])
+    assert out_small.shape == (2, 3)
+
+    big = rng.standard_normal((10, 8)).astype(np.float32)
+    (out_big,) = predictor.run([big])
+    assert out_big.shape == (10, 3)
+    # chunked result must equal per-chunk direct execution
+    np.testing.assert_allclose(out_big[:2], predictor.run([big[:2]])[0],
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_config_knobs(saved_model):
+    prefix, _, _ = saved_model
+    config = Config(prefix + ".pdmodel")  # suffix accepted like the reference
+    config.disable_gpu()
+    config.enable_memory_optim()
+    config.switch_ir_optim(False)
+    config.set_cpu_math_library_num_threads(4)
+    predictor = create_predictor(config)
+    assert predictor.get_input_names() == ["x"]
+
+
+def test_predictor_missing_inputs_error(saved_model):
+    prefix, _, _ = saved_model
+    predictor = create_predictor(Config(prefix))
+    with pytest.raises(RuntimeError, match="inputs not set"):
+        predictor.run()
+
+
+def test_config_requires_path():
+    with pytest.raises(ValueError, match="model path"):
+        create_predictor(Config())

@@ -1,0 +1,100 @@
+"""Real multi-process distributed training (ref: test_dist_base.py:786 —
+subprocess-launch N trainers on localhost, assert loss parity vs one process).
+
+Each subprocess gets ONE cpu device; jax.distributed.initialize (via
+init_parallel_env) forms the 2-process world and collectives run over Gloo.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAYLOAD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "payloads", "dist_train_payload.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(rank, nproc, port, out, timeout=240):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_TRAINERS_NUM": str(nproc),
+        "PADDLE_MASTER": f"127.0.0.1:{port}",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+        "JAX_PLATFORMS": "cpu",
+        "REPO_ROOT": REPO_ROOT,
+    })
+    return subprocess.Popen([sys.executable, PAYLOAD, out], env=env,
+                            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+
+
+@pytest.fixture(scope="module")
+def dist_results(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("dist")
+    port = _free_port()
+    outs = [str(tmp / f"rank{r}.json") for r in range(2)]
+    procs = [_spawn(r, 2, port, outs[r]) for r in range(2)]
+    logs = []
+    for p in procs:
+        stdout, _ = p.communicate(timeout=300)
+        logs.append(stdout.decode(errors="replace"))
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"trainer failed:\n{log[-3000:]}"
+    return [json.load(open(o)) for o in outs]
+
+
+def test_two_process_loss_parity_with_single(dist_results):
+    """dp=2 over 2 processes must reproduce the single-process loss curve
+    (the reference's core distributed oracle)."""
+    r0, r1 = sorted(dist_results, key=lambda r: r["rank"])
+    assert r0["world_size"] == 2
+
+    # both ranks observe the same global loss
+    np.testing.assert_allclose(r0["losses"], r1["losses"], rtol=1e-6)
+
+    # single-process oracle (in-process: conftest's 8-device cpu world)
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.distributed as dist
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(16, 32)
+            self.fc2 = nn.Linear(32, 4)
+
+        def forward(self, x):
+            return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+    paddle.seed(42)
+    model = Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+    mesh = dist.build_mesh(dp=1, devices=np.array([__import__("jax").devices()[0]]))
+    step = dist.ShardedTrainStep(
+        model, lambda x, y: paddle.nn.functional.mse_loss(model(x), y), opt, mesh)
+    rng = np.random.default_rng(7)
+    ref = []
+    for _ in range(5):
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 4)).astype(np.float32)
+        ref.append(float(step(x, y).item()))
+    np.testing.assert_allclose(r0["losses"], ref, rtol=2e-4, atol=2e-5)
+
+
+def test_process_coordinates_differ(dist_results):
+    """HybridCommunicateGroup._coord derives real per-process coordinates
+    (round-1 weak #6: it used to hardcode (0,0,0,0,0) for every rank)."""
+    r0, r1 = sorted(dist_results, key=lambda r: r["rank"])
+    assert r0["dp_rank"] == 0
+    assert r1["dp_rank"] == 1
+    assert r0["coord"] != r1["coord"]

@@ -109,7 +109,8 @@ def test_dataloader_native_worker_path():
     X = np.arange(64 * 4, dtype=np.float32).reshape(64, 4)
     Y = np.arange(64, dtype=np.int64)
     ds = TensorDataset([X, Y])
-    loader = DataLoader(ds, batch_size=8, num_workers=2, shuffle=False)
+    loader = DataLoader(ds, batch_size=8, num_workers=2, shuffle=False,
+                        use_shared_memory=False)  # in-process native-ring path
     it = iter(loader)
     assert type(it).__name__ == "_NativeWorkerIter"
     batches = list(it)
@@ -137,7 +138,8 @@ def test_dataloader_native_worker_preserves_order_with_slow_worker():
                 time.sleep(0.01)
             return np.full(2, i, np.float32)
 
-    loader = DataLoader(Slow(), batch_size=4, num_workers=2, shuffle=False)
+    loader = DataLoader(Slow(), batch_size=4, num_workers=2, shuffle=False,
+                        use_shared_memory=False)  # in-process native-ring path
     it = iter(loader)
     assert type(it).__name__ == "_NativeWorkerIter"
     got = np.concatenate([np.asarray(b._value)[:, 0] for b in it])
