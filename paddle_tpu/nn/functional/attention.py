@@ -62,7 +62,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                     "backend='flash' with active attention dropout falls back to the "
                     "dense SDPA path (the Pallas flash kernel has no dropout); full "
                     "[B,H,S,S] attention probs will be materialized")
-            blocks_ok = seq % min(128, seq) == 0 and seq_k % min(128, seq_k) == 0
+            from ...ops.flash_attention import supports_seq
+
+            blocks_ok = supports_seq(seq) and supports_seq(seq_k)
             causal_ok = not is_causal or seq <= seq_k
             use_flash = (backend == "flash" and no_drop and causal_ok) or (
                 on_tpu and seq >= 1024 and blocks_ok and causal_ok

@@ -21,6 +21,7 @@ import functools
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
 # TPU vector lanes: the lse/dsum residuals are broadcast along a 128-lane minor dim
@@ -33,10 +34,19 @@ def _interpret_default():
     return jax.default_backend() not in ("tpu", "axon")
 
 
+def _compiler_params(interpret):
+    """All three kernels write disjoint output blocks along both grid axes."""
+    if interpret:
+        return None
+    return pltpu.CompilerParams(dimension_semantics=("parallel", "parallel"))
+
+
 # --------------------------------------------------------------------- forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, seq_q, seq_k):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [bq, D]
+    # keep matmul inputs in their storage dtype (bf16): the MXU contracts
+    # bf16 x bf16 -> f32 at full rate; upcasting first forces f32 passes
+    q = q_ref[0]  # [bq, D]
     nkb = pl.cdiv(seq_k, bk)
     # bottom-right alignment (matches the dense path): query i attends kpos <= i + off
     off = seq_k - seq_q
@@ -46,10 +56,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, s
 
     def body(kj, carry):
         m, l, acc = carry
-        k = k_ref[0, pl.ds(kj * bk, bk), :].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0, pl.ds(kj * bk, bk), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kj * bk, bk), :]  # [bk, D]
+        v = v_ref[0, pl.ds(kj * bk, bk), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale  # [bq, bk]
+                                preferred_element_type=jnp.float32) * scale  # [bq, bk] f32
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -58,7 +68,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal, bq, bk, s
         p = jnp.exp(s - m_new)
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        acc = acc * corr + jnp.dot(p.astype(v.dtype), v,
+                                   preferred_element_type=jnp.float32)
         return m_new, l, acc
 
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
@@ -91,6 +102,7 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
             jax.ShapeDtypeStruct((BH, Sq, LANES), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q, k, v)
     return o, lse
 
@@ -99,68 +111,74 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
 def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
                *, scale, causal, bq, bk, seq_q, seq_k):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
+    q = q_ref[0]
+    do = do_ref[0]
     lse = lse_ref[0][:, :1]     # [bq, 1] (lanes-broadcast residual)
-    dsum = jnp.sum(do * o_ref[0].astype(jnp.float32), axis=-1, keepdims=True)
+    dsum = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
+                   axis=-1, keepdims=True)
     nkb = pl.cdiv(seq_k, bk)
     off = seq_k - seq_q
     if causal:
         nkb = jnp.minimum(nkb, ((qi + 1) * bq + off + bk - 1) // bk)
 
     def body(kj, dq):
-        k = k_ref[0, pl.ds(kj * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kj * bk, bk), :].astype(jnp.float32)
+        k = k_ref[0, pl.ds(kj * bk, bk), :]
+        v = v_ref[0, pl.ds(kj * bk, bk), :]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qpos + off >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk]
+        p = jnp.exp(s - lse)                       # [bq, bk] f32
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dsum)
-        return dq + jnp.dot(ds, k, preferred_element_type=jnp.float32) * scale
+        ds = (p * (dp - dsum)).astype(k.dtype)
+        return dq + jax.lax.dot_general(ds, k, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32) * scale
 
-    dq = jax.lax.fori_loop(0, nkb, body, jnp.zeros_like(q))
+    dq = jax.lax.fori_loop(0, nkb, body,
+                           jnp.zeros((bq, q.shape[-1]), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
                 *, scale, causal, bq, bk, seq_q, seq_k):
     kj = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)   # [bk, D]
-    v = v_ref[0].astype(jnp.float32)
+    k = k_ref[0]   # [bk, D]
+    v = v_ref[0]
     nqb = pl.cdiv(seq_q, bq)
     off = seq_k - seq_q
     start = jnp.maximum((kj * bk - off) // bq, 0) if causal else 0
 
     def body(qi, carry):
         dk, dv = carry
-        q = q_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
-        o = o_ref[0, pl.ds(qi * bq, bq), :].astype(jnp.float32)
+        q = q_ref[0, pl.ds(qi * bq, bq), :]
+        do = do_ref[0, pl.ds(qi * bq, bq), :]
+        o = o_ref[0, pl.ds(qi * bq, bq), :]
         lse = lse_ref[0, pl.ds(qi * bq, bq), :1]
-        dsum = jnp.sum(do * o, axis=-1, keepdims=True)
+        dsum = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                       axis=-1, keepdims=True)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
             qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
             kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(qpos + off >= kpos, s, NEG_INF)
-        p = jnp.exp(s - lse)                       # [bq, bk]
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+        p = jnp.exp(s - lse)                       # [bq, bk] f32
+        pc = p.astype(do.dtype)
+        dv = dv + jax.lax.dot_general(pc, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - dsum)
+        ds = (p * (dp - dsum)).astype(q.dtype)
         dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32) * scale
         return dk, dv
 
-    dk0 = jnp.zeros_like(k)
-    dv0 = jnp.zeros_like(v)
+    D = k.shape[-1]
+    dk0 = jnp.zeros((bk, D), jnp.float32)
+    dv0 = jnp.zeros((bk, D), jnp.float32)
     dk, dv = jax.lax.fori_loop(start, nqb, body, (dk0, dv0))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
@@ -185,6 +203,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q, k, v, o, do, lse)
 
     dk, dv = pl.pallas_call(
@@ -208,6 +227,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
         ],
         interpret=interpret,
+        compiler_params=_compiler_params(interpret),
     )(q, k, v, o, do, lse)
     return dq, dk, dv
 
@@ -232,13 +252,36 @@ def _flash_bhsd_bwd(causal, scale, bq, bk, interpret, res, do):
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
-def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
+def supports_seq(seq):
+    """Shapes the kernel handles without degenerate blocks (callers use this to
+    gate flash vs dense SDPA)."""
+    return seq % 128 == 0 or (seq <= 512 and seq % 8 == 0)
+
+
+def _auto_block(seq):
+    """Largest power-of-two block <= 512 dividing seq: big blocks amortize the
+    per-grid-step overhead (measured on v5e: 512 beats 128 by ~25% at S=2048).
+    Short sequences (<=512, 8-aligned) run as a single block; anything else is
+    an error — tiny blocks would silently be 100x slower than dense SDPA."""
+    for b in (512, 256, 128):
+        if seq % b == 0:
+            return b
+    if seq <= 512 and seq % 8 == 0:
+        return seq
+    raise ValueError(
+        f"flash_attention: sequence length {seq} is not divisible by a "
+        f">=128 block (and too long for a single block) — pad the sequence "
+        f"or use the dense SDPA path")
+
+
+def flash_attention(q, k, v, causal=False, scale=None, block_q=None, block_k=None,
                     interpret=None):
     """q/k/v: [B, S, H, D] (paddle layout).  Returns [B, S, H, D].
 
     Requires S divisible by the block sizes and equal q/k head counts (the GQA
     repeat happens in the caller).  Differentiable via a recompute-based
-    FlashAttention-2 backward.
+    FlashAttention-2 backward.  Block sizes default to the largest power of two
+    <= 512 dividing the sequence.
     """
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
@@ -250,8 +293,8 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=128, block_k=128,
             "use the dense SDPA path")
     if interpret is None:
         interpret = _interpret_default()
-    bq = min(block_q, Sq)
-    bk = min(block_k, Sk)
+    bq = min(block_q, Sq) if block_q else _auto_block(Sq)
+    bk = min(block_k, Sk) if block_k else _auto_block(Sk)
     if Sq % bq or Sk % bk:
         raise ValueError(f"seq lens ({Sq},{Sk}) must divide block sizes ({bq},{bk})")
     if scale is None:
