@@ -1,0 +1,116 @@
+"""paddle_tpu: a TPU-native deep learning framework with PaddlePaddle's API surface.
+
+Built on JAX/XLA/Pallas: eager ops are jax.numpy compositions with taped autograd
+(jax.vjp per op); `to_static`/jit compiles whole training steps with XLA; distribution
+is jax.sharding Meshes + XLA collectives over ICI/DCN.  See SURVEY.md for the blueprint
+and per-module docstrings for reference file:line parity pointers.
+"""
+from __future__ import annotations
+
+# -- core dtype / device / rng surface
+from .core.dtypes import (  # noqa: F401
+    bool_ as bool8,
+    uint8,
+    int8,
+    int16,
+    int32,
+    int64,
+    float16,
+    bfloat16,
+    float32,
+    float64,
+    complex64,
+    complex128,
+    set_default_dtype,
+    get_default_dtype,
+)
+from .core import dtypes as dtypes  # noqa: F401
+from .core.device import (  # noqa: F401
+    CPUPlace,
+    CUDAPlace,
+    TPUPlace,
+    CustomPlace,
+    set_device,
+    get_device,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+)
+from .framework.random import seed, Generator  # noqa: F401
+
+# -- autograd
+from .autograd.tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad  # noqa: F401
+from . import autograd  # noqa: F401
+
+# -- tensor & ops: re-export every public op into the paddle namespace
+from .tensor import Tensor, Parameter  # noqa: F401
+from .tensor.creation import *  # noqa: F401,F403
+from .tensor.math import *  # noqa: F401,F403
+from .tensor.manipulation import *  # noqa: F401,F403
+from .tensor.logic import *  # noqa: F401,F403
+from .tensor.search import *  # noqa: F401,F403
+from .tensor import linalg  # noqa: F401
+from .tensor.linalg import norm, dist, cholesky, dot, t, einsum  # noqa: F401
+from .tensor.math import max, min, sum, abs, pow, round  # noqa: F401  (shadow builtins as paddle does)
+from .tensor.logic import all, any  # noqa: F401
+from .tensor import creation as _creation
+from .tensor import math as _math
+
+# -- subpackages (import order matters: nn depends on tensor)
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from .io import DataLoader  # noqa: F401
+from . import metric  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import vision  # noqa: F401
+from . import distributed  # noqa: F401
+from . import distribution  # noqa: F401
+from . import incubate  # noqa: F401
+from . import inference  # noqa: F401
+from . import profiler  # noqa: F401
+from . import framework  # noqa: F401
+from . import device  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model  # noqa: F401
+from . import models  # noqa: F401
+from . import sysconfig  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+from .framework.flags import set_flags, get_flags  # noqa: F401
+from .jit import to_static  # noqa: F401
+from .nn.layer.container import Sequential  # noqa: F401
+from .amp.grad_scaler import GradScaler  # noqa: F401
+from .hapi import summary, flops  # noqa: F401
+
+# DataParallel at top level (ref: paddle.DataParallel)
+from .distributed.parallel import DataParallel  # noqa: F401
+
+disable_static = lambda place=None: None  # dygraph is the default and only eager mode
+enable_static = static.enable_static
+
+__version__ = "0.1.0"
+
+
+def is_grad_enabled_():
+    return is_grad_enabled()
+
+
+def get_cudnn_version():
+    return None
+
+
+def device_count():
+    import jax
+
+    return len(jax.devices())
+
+
+def in_dynamic_mode():
+    return not static.in_static_mode()
+
+
+def set_printoptions(**kwargs):
+    import numpy as np
+
+    np.set_printoptions(**{k: v for k, v in kwargs.items() if k in ("precision", "threshold", "edgeitems", "linewidth")})

@@ -1,0 +1,96 @@
+"""AMP (ref: python/paddle/amp/auto_cast.py:21, grad_scaler.py:26).
+
+TPU-native: bf16 is the native mixed-precision dtype — no loss scaling needed.  The
+O1 autocast white/black lists (ref imperative/amp_auto_cast.h:45 AmpOperators) are
+honored by casting inputs of matmul/conv-class ops inside `auto_cast` regions;
+`GradScaler` keeps full API parity and becomes a no-op scale=1 path for bf16.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..core import dtypes as _dt
+from ..tensor.tensor import Tensor
+
+from .grad_scaler import GradScaler, AmpScaler  # noqa: F401
+
+# ops cast to low precision inside autocast (ref fluid/dygraph/amp/auto_cast.py lists)
+WHITE_LIST = {"matmul", "mm", "bmm", "conv2d", "conv1d", "conv3d", "linear", "einsum",
+              "sdpa", "flash_attention", "addmm"}
+BLACK_LIST = {"exp", "square", "log", "mean", "sum", "cos_sim", "softmax",
+              "softmax_with_cross_entropy", "sigmoid_cross_entropy_with_logits",
+              "cross_entropy", "layer_norm", "batch_norm"}
+
+_amp_state = {"enabled": False, "dtype": None, "level": "O1"}
+
+
+def amp_state():
+    return _amp_state
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None, level="O1",
+              dtype="bfloat16"):
+    """paddle.amp.auto_cast parity.  On TPU dtype defaults to bfloat16."""
+    prev = dict(_amp_state)
+    _amp_state.update(
+        enabled=bool(enable),
+        dtype=_dt.convert_dtype(dtype),
+        level=level,
+    )
+    if custom_white_list:
+        WHITE_LIST.update(custom_white_list)
+    if custom_black_list:
+        BLACK_LIST.update(custom_black_list)
+    try:
+        yield
+    finally:
+        _amp_state.update(prev)
+
+
+autocast = auto_cast
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16", master_weight=None,
+             save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts parameters to the low dtype."""
+    d = _dt.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m._cast_all(d)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+def maybe_cast_inputs(op_name, raw_args):
+    """Hook used by apply_op when autocast is active."""
+    if not _amp_state["enabled"]:
+        return raw_args
+    d = _amp_state["dtype"]
+    if op_name in WHITE_LIST:
+        return [a.astype(d) if hasattr(a, "dtype") and jnp.issubdtype(a.dtype, jnp.floating) else a
+                for a in raw_args]
+    if op_name in BLACK_LIST:
+        return [a.astype(jnp.float32) if hasattr(a, "dtype") and a.dtype in (jnp.bfloat16, jnp.float16) else a
+                for a in raw_args]
+    return raw_args
+
+
+# register the autocast hook on the op-dispatch point
+from ..tensor import tensor as _tensor_mod
+
+_tensor_mod._amp_cast_hook = maybe_cast_inputs
+_tensor_mod._amp_state_ref = _amp_state
+
+
+def is_bfloat16_supported(place=None):
+    return True
+
+
+def is_float16_supported(place=None):
+    return True

@@ -1,0 +1,135 @@
+"""GradScaler (ref: python/paddle/amp/grad_scaler.py:26 over fluid AmpScaler
+loss_scaler.py:40, using check_finite_and_unscale + update_loss_scaling ops).
+
+On TPU bf16 training needs no loss scaling; the scaler still implements the full
+dynamic-scaling contract for fp16 parity (scale/unscale/found-inf bookkeeping in jnp).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..autograd import tape
+
+
+class AmpScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0**15, incr_ratio=2.0,
+                 decr_ratio=0.5, incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                 use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        self._sync_from_device()
+        return var * self._scale
+
+    def _unscale_and_check(self, optimizer):
+        self._sync_from_device()
+        params = [p for p in optimizer._params() if p._grad is not None]
+        found = False
+        inv = 1.0 / self._scale
+        for p in params:
+            g = p._grad * inv
+            p._grad = g
+        if params:
+            tot = sum(jnp.sum(p._grad.astype(jnp.float32)) for p in params)
+            found = bool(~jnp.isfinite(tot))
+        self._found_inf = found
+        return found
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+        self.update()
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        found = self._unscale_and_check(optimizer)
+        if not found:
+            optimizer.step()
+
+    def unscale_(self, optimizer):
+        if self._enable:
+            self._unscale_and_check(optimizer)
+
+    def update(self):
+        if not (self._enable and self._dynamic):
+            return
+        self._sync_from_device()
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+
+    # ---- compiled-step integration (TrainStep/ShardedTrainStep scaler=...):
+    # the (scale, good, bad) counters live on device inside the jitted step;
+    # host reads sync lazily so the fast path never blocks on a transfer.
+    def _attach_device_state(self, st):
+        self._device_state = st
+
+    def _sync_from_device(self):
+        st = getattr(self, "_device_state", None)
+        if st is not None:
+            self._scale = float(st["scale"])
+            self._good_steps = int(st["good"])
+            self._bad_steps = int(st["bad"])
+            self._device_state = None
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        self._sync_from_device()
+        return Tensor(jnp.asarray(self._scale))
+
+    def set_init_loss_scaling(self, v):
+        self._device_state = None  # explicit host write wins over pending device state
+        self._host_dirty = True    # compiled steps re-seed their device state
+        self._scale = float(v)
+
+    def state_dict(self):
+        self._sync_from_device()
+        return {
+            "scale": self._scale,
+            "incr_ratio": self._incr_ratio,
+            "decr_ratio": self._decr_ratio,
+            "incr_every_n_steps": self._incr_every_n_steps,
+            "decr_every_n_nan_or_inf": self._decr_every_n,
+            "good_steps": self._good_steps,
+            "bad_steps": self._bad_steps,
+        }
+
+    def load_state_dict(self, sd):
+        self._device_state = None  # restored host state wins over pending device state
+        self._host_dirty = True    # compiled steps re-seed their device state
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+class GradScaler(AmpScaler):
+    """Public API (ref grad_scaler.py:26)."""
+    pass

@@ -1,0 +1,17 @@
+"""paddle.autograd namespace (ref: python/paddle/autograd/__init__.py)."""
+from .tape import no_grad, enable_grad, is_grad_enabled, set_grad_enabled, grad  # noqa: F401
+from .py_layer import PyLayer, PyLayerContext  # noqa: F401
+
+backward = None  # populated lazily to avoid cycles
+
+
+def _backward(tensors, grad_tensors=None, retain_graph=False):
+    from .tape import run_backward
+
+    tensors = tensors if isinstance(tensors, (list, tuple)) else [tensors]
+    if grad_tensors is not None and not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+    run_backward(list(tensors), grad_tensors, retain_graph=retain_graph)
+
+
+backward = _backward

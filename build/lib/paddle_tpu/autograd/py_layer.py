@@ -1,0 +1,70 @@
+"""PyLayer: user-defined forward/backward (ref: python/paddle/autograd/py_layer.py,
+C++ glue fluid/eager/pylayer/).  Implemented directly on the tape: forward runs
+eagerly, and a TapeNode is recorded whose vjp calls the user's backward."""
+from __future__ import annotations
+
+from ..tensor.tensor import Tensor
+from . import tape
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = []
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = list(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    saved_tensors = saved_tensor
+
+
+class PyLayer:
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        with tape.no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = not isinstance(outs, (tuple, list))
+        outs_t = (outs,) if single else tuple(outs)
+        outs_t = tuple(o if isinstance(o, Tensor) else o for o in outs_t)
+
+        tensor_inputs = [a for a in args if isinstance(a, Tensor) and not a.stop_gradient]
+        if tape.is_grad_enabled() and tensor_inputs:
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                ct_tensors = tuple(Tensor(c, stop_gradient=True) for c in cts)
+                with tape.no_grad():
+                    grads = cls.backward(ctx, *ct_tensors)
+                grads = (grads,) if isinstance(grads, Tensor) or grads is None else tuple(grads)
+                out = []
+                gi = 0
+                for a in args:
+                    if isinstance(a, Tensor) and not a.stop_gradient:
+                        g = grads[gi] if gi < len(grads) else None
+                        out.append(g._value if isinstance(g, Tensor) else g)
+                    if isinstance(a, Tensor):
+                        gi += 1
+                return tuple(out)
+
+            avals = [(tuple(o._value.shape), o._value.dtype) for o in outs_t if isinstance(o, Tensor)]
+            node = tape.TapeNode(vjp_fn, tensor_inputs, avals, name=cls.__name__)
+            for i, o in enumerate(outs_t):
+                if isinstance(o, Tensor):
+                    o._node = node
+                    o._out_index = i
+                    o.stop_gradient = False
+        return outs_t[0] if single else outs_t
+
+
+PyLayerMeta = type(PyLayer)

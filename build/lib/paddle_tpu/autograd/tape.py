@@ -1,0 +1,307 @@
+"""Eager autograd tape.
+
+Reference design: the eager autograd engine (`/root/reference/paddle/fluid/eager/`):
+`GradNodeBase`+`Edge` (`grad_node_info.h:50,168`), `GradTensorHolder` accumulation and
+the topological `RunBackward` loop (`backward.cc:556,666-700`).
+
+TPU-native design: instead of per-op hand-written GradNodes, every primitive op call
+obtains its VJP from `jax.vjp` at call time (trace-based AD — the JAX way), and records
+one `TapeNode` holding the vjp closure.  `backward()` runs the same in-degree-counted
+topological walk the reference uses.  Because the vjp closures are themselves pure JAX
+functions, the whole tape degrades gracefully under `jax.jit` tracing (used by
+`to_static`), where XLA fuses forward+backward into one program.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+# ----------------------------------------------------------------------------- grad mode
+
+_grad_enabled: bool = True
+
+
+def is_grad_enabled() -> bool:
+    return _grad_enabled
+
+
+def set_grad_enabled(mode: bool):
+    global _grad_enabled
+    _grad_enabled = bool(mode)
+
+
+class no_grad(contextlib.ContextDecorator):
+    """paddle.no_grad parity (context manager AND decorator)."""
+
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+class enable_grad(contextlib.ContextDecorator):
+    def __enter__(self):
+        global _grad_enabled
+        self._prev = _grad_enabled
+        _grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        global _grad_enabled
+        _grad_enabled = self._prev
+        return False
+
+
+# ----------------------------------------------------------------------------- tape node
+
+
+class TapeNode:
+    """One recorded op: vjp closure + edges to input tensors.
+
+    Ref analog: a generated `GradNodeBase` subclass (grad_node_info.h:168) whose
+    TensorWrappers are subsumed by the residuals captured inside `vjp_fn`.
+    `out_avals` lets the engine synthesize zero cotangents for unused outputs of
+    multi-output ops (ref GradTensorHolder zero-fill).
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "out_is_tuple", "name", "released",
+                 "hooks", "primal_fn")
+
+    def __init__(self, vjp_fn: Callable, inputs: Sequence[Any], out_avals, name: str = "op",
+                 out_is_tuple: bool | None = None, primal_fn: Callable | None = None):
+        self.vjp_fn = vjp_fn
+        self.inputs = list(inputs)  # input Tensors that require grad (edges)
+        self.out_avals = list(out_avals)  # (shape, dtype) per output
+        self.out_is_tuple = len(self.out_avals) > 1 if out_is_tuple is None else out_is_tuple
+        self.name = name
+        self.released = False
+        self.hooks: list[Callable] = []
+        # pure fn over the diff inputs; enables create_graph (higher-order) backward
+        # by re-linearizing inside a taped op (see run_backward create_graph path)
+        self.primal_fn = primal_fn
+
+    @property
+    def n_outputs(self):
+        return len(self.out_avals)
+
+    def release(self):
+        self.vjp_fn = None
+        self.inputs = []
+        self.released = True
+
+
+# ----------------------------------------------------------------------------- engine
+
+
+def _zero_ct(aval):
+    """Zero cotangent for an unused output; float0 for non-inexact outputs
+    (jax.vjp's required cotangent type for integer/bool primal outputs)."""
+    import numpy as np
+
+    shape, dtype = aval
+    if not jnp.issubdtype(dtype, jnp.inexact):
+        return np.zeros(shape, jax.dtypes.float0)
+    return jnp.zeros(shape, dtype)
+
+
+def _accumulate(store: dict, key, value):
+    if key in store:
+        store[key] = store[key] + value
+    else:
+        store[key] = value
+
+
+def _taped_node_vjp(node: TapeNode, filled):
+    """create_graph path: recompute the node's vjp INSIDE a taped op, so the produced
+    cotangents carry their own tape history (true double backward).  The node's
+    primal_fn is re-linearized w.r.t. its live input tensors; non-inexact cotangents
+    (float0) pass through raw."""
+    from ..tensor.tensor import Tensor, apply_op
+
+    n_p = len(node.inputs)
+
+    def recompute_vjp(*args):
+        primals = args[:n_p]
+        cts = args[n_p:]
+        _, vfn = jax.vjp(node.primal_fn, *primals)
+        seed = tuple(cts) if node.out_is_tuple else cts[0]
+        return tuple(vfn(seed))
+
+    ct_args = tuple(
+        c if isinstance(c, Tensor) or not hasattr(c, "dtype") or not jnp.issubdtype(c.dtype, jnp.inexact)
+        else Tensor(c)
+        for c in filled
+    )
+    res = apply_op(recompute_vjp, (*node.inputs, *ct_args), name=f"grad:{node.name}")
+    return res if isinstance(res, tuple) else (res,)
+
+
+def run_backward(tensors, grad_tensors=None, retain_graph: bool = False, accumulate_fn=None,
+                 create_graph: bool = False):
+    """Topological reverse walk (ref eager/backward.cc:556 RunBackward).
+
+    Seeds `tensors` with `grad_tensors` (None -> ones), walks producer nodes in
+    reverse-topological order with in-degree counting (ref getInDegreeMap
+    backward.cc:666-700), and accumulates into `.grad` of leaf tensors with
+    stop_gradient=False.
+    """
+    from ..tensor.tensor import Tensor  # import-cycle-free at call time
+
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+
+    cts: dict[tuple[int, int], Any] = {}  # (id(node), out_index) -> cotangent
+    roots: list[TapeNode] = []
+
+    def leaf_accumulate(t, g):
+        if accumulate_fn is not None:
+            accumulate_fn(t, g)
+        elif not t.stop_gradient:
+            t._accumulate_grad(g)
+
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            g = jnp.ones_like(t._value)
+        elif isinstance(g, Tensor):
+            g = g._value
+        if t._node is None:
+            leaf_accumulate(t, g)
+            continue
+        if t._node.released:
+            raise RuntimeError(
+                "Trying to run backward through the same graph a second time. "
+                "Specify retain_graph=True on the first backward call."
+            )
+        _accumulate(cts, (id(t._node), t._out_index), g)
+        roots.append(t._node)
+
+    # discover reachable nodes + per-node consumer count
+    pending: dict[int, int] = {}
+    nodes: dict[int, TapeNode] = {}
+    stack = list(roots)
+    while stack:
+        node = stack.pop()
+        if id(node) in nodes:
+            continue
+        nodes[id(node)] = node
+        for inp in node.inputs:
+            prod = inp._node
+            if prod is not None and not prod.released:
+                pending[id(prod)] = pending.get(id(prod), 0) + 1
+                stack.append(prod)
+
+    queue = [n for i, n in nodes.items() if pending.get(i, 0) == 0]
+    processed: set[int] = set()
+    while queue:
+        node = queue.pop()
+        if id(node) in processed:
+            continue
+        processed.add(id(node))
+
+        out_cts = [cts.pop((id(node), i), None) for i in range(node.n_outputs)]
+        if any(c is not None for c in out_cts):
+            filled = tuple(
+                c if c is not None else _zero_ct(node.out_avals[i])
+                for i, c in enumerate(out_cts)
+            )
+            if create_graph and node.primal_fn is not None:
+                in_cts = _taped_node_vjp(node, filled)
+            else:
+                raw_filled = tuple(c._value if isinstance(c, Tensor) else c for c in filled)
+                seed = raw_filled if node.out_is_tuple else raw_filled[0]
+                in_cts = node.vjp_fn(seed)
+            for hook in node.hooks:
+                in_cts = hook(in_cts)
+            for inp, ct in zip(node.inputs, in_cts):
+                if ct is None:
+                    continue
+                for h in inp._grad_hooks:
+                    ct = h(ct)
+                    if ct is None:
+                        break
+                if ct is None:
+                    continue
+                prod = inp._node
+                if prod is None or prod.released:
+                    leaf_accumulate(inp, ct)
+                else:
+                    _accumulate(cts, (id(prod), inp._out_index), ct)
+
+        for inp in node.inputs:
+            prod = inp._node
+            if prod is not None and id(prod) in nodes and not prod.released:
+                pending[id(prod)] -= 1
+                if pending[id(prod)] == 0:
+                    queue.append(prod)
+        if not retain_graph:
+            node.release()
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=None,
+    create_graph=False,
+    only_inputs=True,
+    allow_unused=False,
+    no_grad_vars=None,
+):
+    """paddle.grad parity (ref GeneralGrad, eager/backward.cc:859).
+
+    Returns grads of `outputs` w.r.t. `inputs` without mutating `.grad` fields.
+    NOTE: create_graph (higher-order through the tape) requires retained graphs;
+    prefer `paddle.incubate.autograd` functional transforms for heavy higher-order use.
+    """
+    from ..tensor.tensor import Tensor
+
+    outputs = list(outputs) if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = list(inputs) if isinstance(inputs, (list, tuple)) else [inputs]
+    if retain_graph is None:
+        retain_graph = create_graph
+
+    grads: dict[int, Any] = {}
+    targets = {id(t): t for t in inputs}
+
+    def collect(t, g):
+        if id(t) in targets:
+            _accumulate(grads, id(t), g)
+
+    # route every leaf cotangent through `collect`; also catch non-leaf inputs by
+    # temporarily severing their producer edge so they behave as leaves.
+    severed = []
+    for t in inputs:
+        if t._node is not None:
+            severed.append((t, t._node))
+            t._node = None
+    try:
+        run_backward(outputs, grad_outputs, retain_graph=bool(retain_graph),
+                     accumulate_fn=collect, create_graph=create_graph)
+    finally:
+        for t, n in severed:
+            t._node = n
+
+    results = []
+    for t in inputs:
+        g = grads.get(id(t))
+        if g is None:
+            if not allow_unused:
+                raise RuntimeError(
+                    "One of the differentiated tensors appears to not have been used "
+                    "in the graph. Set allow_unused=True if this is intended."
+                )
+            results.append(None)
+        elif isinstance(g, Tensor):
+            results.append(g)  # create_graph path: tape history preserved
+        else:
+            results.append(Tensor(g, stop_gradient=not create_graph))
+    return results

@@ -1,0 +1,1 @@
+from . import dtypes, device  # noqa: F401

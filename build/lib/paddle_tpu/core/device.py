@@ -1,0 +1,109 @@
+"""Device / Place surface.
+
+Reference: `Place`/`CPUPlace`/`CUDAPlace` (`/root/reference/paddle/phi/common/place.h:115`)
+and `paddle.set_device` (`python/paddle/device/__init__.py`).  On TPU, device identity
+is a `jax.Device`; Places are thin descriptors that resolve to one.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+class Place:
+    """Base place descriptor (ref place.h:115)."""
+
+    device_type = "unknown"
+
+    def __init__(self, device_id: int = 0):
+        self.device_id = int(device_id)
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Place)
+            and self.device_type == other.device_type
+            and self.device_id == other.device_id
+        )
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def jax_device(self):
+        devs = [d for d in jax.devices() if _kind(d) == self.device_type]
+        if not devs:
+            devs = jax.devices()
+        return devs[self.device_id % len(devs)]
+
+
+class CPUPlace(Place):
+    device_type = "cpu"
+
+    def jax_device(self):
+        return jax.devices("cpu")[self.device_id % len(jax.devices("cpu"))]
+
+
+class TPUPlace(Place):
+    device_type = "tpu"
+
+
+# CUDAPlace parity alias: on this framework "gpu" means the accelerator (TPU).
+CUDAPlace = TPUPlace
+XPUPlace = TPUPlace
+CustomPlace = TPUPlace
+
+
+def _kind(dev) -> str:
+    plat = dev.platform
+    if plat in ("tpu", "axon"):
+        return "tpu"
+    return plat
+
+
+@functools.lru_cache(None)
+def _accelerator_available() -> bool:
+    return any(_kind(d) == "tpu" for d in jax.devices())
+
+
+_current_place: Place | None = None
+
+
+def set_device(device: str):
+    """paddle.set_device parity: 'tpu', 'tpu:0', 'cpu', 'gpu' (alias of tpu)."""
+    global _current_place
+    name, _, idx = device.partition(":")
+    idx = int(idx) if idx else 0
+    if name in ("tpu", "gpu", "xpu", "npu", "custom_device"):
+        _current_place = TPUPlace(idx) if _accelerator_available() else CPUPlace(idx)
+    elif name == "cpu":
+        _current_place = CPUPlace(idx)
+    else:
+        raise ValueError(f"unknown device {device!r}")
+    return _current_place
+
+
+def get_device() -> str:
+    p = _get_place()
+    return f"{p.device_type}:{p.device_id}"
+
+
+def _get_place() -> Place:
+    global _current_place
+    if _current_place is None:
+        _current_place = TPUPlace(0) if _accelerator_available() else CPUPlace(0)
+    return _current_place
+
+
+def is_compiled_with_cuda() -> bool:  # parity shim
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return _accelerator_available()
+
+
+def default_jax_device():
+    return _get_place().jax_device()

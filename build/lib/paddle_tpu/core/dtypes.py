@@ -1,0 +1,86 @@
+"""Dtype surface.
+
+The reference exposes ``paddle.float32``-style dtype objects backed by a C++ enum
+(`/root/reference/paddle/phi/common/data_type.h`).  TPU-natively there is no enum —
+jax/numpy dtypes are the single currency — so we alias them directly and keep a
+global default dtype (ref: python/paddle/framework/framework.py set_default_dtype).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+# Canonical dtype aliases (np.dtype instances compare equal to np.float32 etc.)
+bool_ = np.dtype("bool")
+uint8 = np.dtype("uint8")
+int8 = np.dtype("int8")
+int16 = np.dtype("int16")
+int32 = np.dtype("int32")
+int64 = np.dtype("int64")
+float16 = np.dtype("float16")
+bfloat16 = jnp.bfloat16  # numpy has no bfloat16; use the ml_dtypes-backed one
+float32 = np.dtype("float32")
+float64 = np.dtype("float64")
+complex64 = np.dtype("complex64")
+complex128 = np.dtype("complex128")
+
+_STR_ALIASES = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+}
+
+_default_dtype = float32
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype parity (ref: python/paddle/framework/framework.py)."""
+    global _default_dtype
+    _default_dtype = convert_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def convert_dtype(d):
+    """Normalise str/np/jnp dtype-likes to a np.dtype (or bfloat16 scalar type).
+
+    TPU note: without x64, int64/float64 are represented as int32/float32 (the
+    reference's int64 indices map to XLA s32 — wider types buy nothing on the MXU).
+    """
+    if d is None:
+        return _default_dtype
+    if isinstance(d, str):
+        out = _STR_ALIASES.get(d) or np.dtype(d)
+    elif d is bfloat16 or d is jnp.bfloat16:
+        return jnp.dtype(jnp.bfloat16)
+    else:
+        out = jnp.dtype(d)
+    import jax
+
+    if not jax.config.jax_enable_x64:
+        if out == int64:
+            return int32
+        if out == float64:
+            return float32
+        if out == complex128:
+            return complex64
+    return out
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)

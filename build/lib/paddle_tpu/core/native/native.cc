@@ -1,0 +1,549 @@
+// paddle_tpu native runtime core.
+//
+// TPU-native equivalents of the reference's C++ runtime pieces that remain genuinely
+// native in a JAX/XLA world (the kernels & executors collapsed into XLA; what's left
+// is the host-side control plane and IO):
+//
+//  1. TCPStore  — rendezvous/control-plane KV store
+//     (ref: paddle/fluid/distributed/store/tcp_store.h:120, store.h:26).
+//     Same length-prefixed wire protocol as the Python fallback in
+//     paddle_tpu/distributed/store.py: [op u8][klen u32][key][vlen u32][val].
+//  2. Ring buffer — bounded MPMC byte-slot queue backing DataLoader prefetch
+//     (ref: fluid/dataloader worker queues + paddle/fluid/framework/data_feed.cc);
+//     blocking push/pop without holding the Python GIL.
+//  3. Trace collector — lock-striped in-memory span buffer with chrome://tracing
+//     JSON export (ref: paddle/fluid/platform/profiler/chrometracing_logger.cc,
+//     RecordEvent event_tracing.h:49).
+//  4. Host buffer pool — size-class free-list allocator for pinned host staging
+//     buffers with live/peak stats (ref: memory/allocation/auto_growth_best_fit_
+//     allocator.h:30 + memory/stats.cc).
+//
+// Exposed as a flat C ABI consumed via ctypes (pybind11 is not available in this
+// image; see paddle_tpu/core/native/__init__.py).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cerrno>
+#include <chrono>
+#include <cstdlib>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ----------------------------------------------------------------------------
+// 1. TCPStore
+// ----------------------------------------------------------------------------
+
+namespace {
+
+struct KVServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> running{true};
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::vector<int> conn_fds;  // open connections, shut down on stop (guarded by mu)
+};
+
+bool recv_n(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = ::recv(fd, p + got, n - got, 0);
+    if (r <= 0) return false;
+    got += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_n(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  size_t sent = 0;
+  while (sent < n) {
+    ssize_t r = ::send(fd, p + sent, n - sent, MSG_NOSIGNAL);
+    if (r <= 0) return false;
+    sent += static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_val(int fd, const std::string& v) {
+  uint32_t len = static_cast<uint32_t>(v.size());
+  if (!send_n(fd, &len, 4)) return false;
+  return v.empty() ? true : send_n(fd, v.data(), v.size());
+}
+
+void serve_conn(KVServer* s, int fd) {
+  for (;;) {
+    unsigned char hdr[5];
+    if (!recv_n(fd, hdr, 5)) break;
+    char op = static_cast<char>(hdr[0]);
+    uint32_t klen;
+    std::memcpy(&klen, hdr + 1, 4);
+    std::string key(klen, '\0');
+    if (klen && !recv_n(fd, key.data(), klen)) break;
+    uint32_t vlen;
+    if (!recv_n(fd, &vlen, 4)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !recv_n(fd, val.data(), vlen)) break;
+
+    if (op == 'S') {
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->data[key] = val;
+      }
+      s->cv.notify_all();
+      if (!send_val(fd, "ok")) break;
+    } else if (op == 'A') {
+      // strtoll with full error checking: a non-numeric stored value or payload
+      // must produce an in-band error reply, not an exception that would
+      // std::terminate() the rendezvous server's worker thread.
+      auto parse_ll = [](const std::string& str, long long* out) -> bool {
+        if (str.empty()) { *out = 0; return true; }
+        errno = 0;
+        char* end = nullptr;
+        long long v = std::strtoll(str.c_str(), &end, 10);
+        if (errno != 0 || end == str.c_str() || *end != '\0') return false;
+        *out = v;
+        return true;
+      };
+      long long cur = 0, inc = 0;
+      bool parsed = true;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->data.find(key);
+        parsed = (it == s->data.end() || parse_ll(it->second, &cur)) &&
+                 parse_ll(val, &inc);
+        if (parsed) {
+          cur += inc;
+          s->data[key] = std::to_string(cur);
+        }
+      }
+      if (!parsed) {
+        if (!send_val(fd, "ERR non-integer value")) break;
+        continue;
+      }
+      s->cv.notify_all();
+      if (!send_val(fd, std::to_string(cur))) break;
+    } else if (op == 'G') {  // blocking get (TCPStore::wait semantics)
+      std::unique_lock<std::mutex> lk(s->mu);
+      s->cv.wait(lk, [&] { return !s->running || s->data.count(key); });
+      if (!s->running) break;
+      std::string v = s->data[key];
+      lk.unlock();
+      if (!send_val(fd, v)) break;
+    } else if (op == 'W') {  // check
+      std::string v;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        v = s->data.count(key) ? "1" : "0";
+      }
+      if (!send_val(fd, v)) break;
+    } else if (op == 'N') {  // non-blocking get: 1-byte presence flag + value
+      std::string v;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        auto it = s->data.find(key);
+        v = (it == s->data.end()) ? std::string("0") : "1" + it->second;
+      }
+      if (!send_val(fd, v)) break;
+    } else if (op == 'D') {
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->data.erase(key);
+      }
+      if (!send_val(fd, "ok")) break;
+    } else if (op == 'L') {  // list keys with prefix, newline-joined
+      std::string out;
+      {
+        std::lock_guard<std::mutex> lk(s->mu);
+        for (auto it = s->data.lower_bound(key);
+             it != s->data.end() && it->first.compare(0, key.size(), key) == 0; ++it) {
+          if (!out.empty()) out += '\n';
+          out += it->first;
+        }
+      }
+      if (!send_val(fd, out)) break;
+    } else {
+      break;
+    }
+  }
+  ::close(fd);
+  std::lock_guard<std::mutex> lk(s->mu);
+  for (auto it = s->conn_fds.begin(); it != s->conn_fds.end(); ++it) {
+    if (*it == fd) {
+      s->conn_fds.erase(it);
+      break;
+    }
+  }
+}
+
+}  // namespace
+
+void* pt_store_server_start(int port) {
+  auto* s = new KVServer();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread([s] {
+    while (s->running) {
+      int fd = ::accept(s->listen_fd, nullptr, nullptr);
+      if (fd < 0) return;
+      {
+        // register BEFORE spawning so stop()'s shutdown sweep can't miss it
+        std::lock_guard<std::mutex> lk(s->mu);
+        s->conn_fds.push_back(fd);
+      }
+      s->workers.emplace_back(serve_conn, s, fd);
+    }
+  });
+  return s;
+}
+
+int pt_store_server_port(void* h) { return static_cast<KVServer*>(h)->port; }
+
+void pt_store_server_stop(void* h) {
+  auto* s = static_cast<KVServer*>(h);
+  s->running = false;
+  s->cv.notify_all();  // wake blocking-'G' waiters
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    // unblock workers stuck in recv() so they can be joined (no detach: the
+    // threads reference s->mu/cv/data, so s must outlive them)
+    std::lock_guard<std::mutex> lk(s->mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+  }
+  for (auto& t : s->workers)
+    if (t.joinable()) t.join();
+  delete s;
+}
+
+// ----------------------------------------------------------------------------
+// 2. Prefetch ring buffer (MPMC, byte slots)
+// ----------------------------------------------------------------------------
+
+namespace {
+
+struct Ring {
+  std::deque<std::string> q;
+  size_t capacity;
+  std::mutex mu;
+  std::condition_variable not_full, not_empty;
+  std::atomic<bool> closed{false};
+  std::atomic<uint64_t> pushed{0}, popped{0};
+};
+
+}  // namespace
+
+void* pt_ring_new(int capacity) {
+  auto* r = new Ring();
+  r->capacity = capacity > 0 ? static_cast<size_t>(capacity) : 1;
+  return r;
+}
+
+// returns 1 on success, 0 if closed, -1 on timeout
+int pt_ring_push(void* h, const char* data, int64_t n, double timeout_s) {
+  auto* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto pred = [&] { return r->closed || r->q.size() < r->capacity; };
+  if (timeout_s < 0) {
+    r->not_full.wait(lk, pred);
+  } else if (!r->not_full.wait_for(lk, std::chrono::duration<double>(timeout_s), pred)) {
+    return -1;
+  }
+  if (r->closed) return 0;
+  r->q.emplace_back(data, static_cast<size_t>(n));
+  r->pushed++;
+  lk.unlock();
+  r->not_empty.notify_one();
+  return 1;
+}
+
+// returns size of popped item (>0), -3 for a popped zero-length item,
+// 0 for closed-and-drained (end of stream), -1 on timeout, -2 buffer too small
+int64_t pt_ring_pop(void* h, char* out, int64_t out_cap, double timeout_s) {
+  auto* r = static_cast<Ring*>(h);
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto pred = [&] { return r->closed || !r->q.empty(); };
+  if (timeout_s < 0) {
+    r->not_empty.wait(lk, pred);
+  } else if (!r->not_empty.wait_for(lk, std::chrono::duration<double>(timeout_s), pred)) {
+    return -1;
+  }
+  if (r->q.empty()) return 0;  // closed and drained
+  std::string& front = r->q.front();
+  int64_t n = static_cast<int64_t>(front.size());
+  if (n > out_cap) return -2;  // caller buffer too small; item stays queued
+  std::memcpy(out, front.data(), front.size());
+  r->q.pop_front();
+  r->popped++;
+  lk.unlock();
+  r->not_full.notify_one();
+  return n == 0 ? -3 : n;  // -3 disambiguates an empty payload from end-of-stream
+}
+
+// peek size of the next item without popping (-1 if empty)
+int64_t pt_ring_peek_size(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return r->q.empty() ? -1 : static_cast<int64_t>(r->q.front().size());
+}
+
+int pt_ring_size(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  std::lock_guard<std::mutex> lk(r->mu);
+  return static_cast<int>(r->q.size());
+}
+
+void pt_ring_close(void* h) {
+  auto* r = static_cast<Ring*>(h);
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->not_empty.notify_all();
+  r->not_full.notify_all();
+}
+
+void pt_ring_free(void* h) { delete static_cast<Ring*>(h); }
+
+// ----------------------------------------------------------------------------
+// 3. Trace collector (chrome://tracing)
+// ----------------------------------------------------------------------------
+
+namespace {
+
+struct TraceEvent {
+  std::string name;
+  uint64_t ts_us;   // begin
+  uint64_t dur_us;  // duration
+  uint64_t tid;
+};
+
+struct Tracer {
+  std::vector<TraceEvent> events;
+  std::mutex mu;
+  std::atomic<bool> enabled{false};
+  std::chrono::steady_clock::time_point t0 = std::chrono::steady_clock::now();
+};
+
+Tracer g_tracer;
+
+thread_local std::vector<std::pair<std::string, uint64_t>> tl_span_stack;
+
+uint64_t now_us() {
+  return static_cast<uint64_t>(std::chrono::duration_cast<std::chrono::microseconds>(
+                                   std::chrono::steady_clock::now() - g_tracer.t0)
+                                   .count());
+}
+
+uint64_t tid_hash() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id()) % 100000;
+}
+
+}  // namespace
+
+void pt_trace_enable(int on) { g_tracer.enabled = on != 0; }
+int pt_trace_enabled() { return g_tracer.enabled ? 1 : 0; }
+
+void pt_trace_begin(const char* name) {
+  if (!g_tracer.enabled) return;
+  tl_span_stack.emplace_back(name, now_us());
+}
+
+void pt_trace_end() {
+  if (!g_tracer.enabled || tl_span_stack.empty()) return;
+  auto [name, begin] = tl_span_stack.back();
+  tl_span_stack.pop_back();
+  TraceEvent ev{std::move(name), begin, now_us() - begin, tid_hash()};
+  std::lock_guard<std::mutex> lk(g_tracer.mu);
+  g_tracer.events.push_back(std::move(ev));
+}
+
+// complete event with explicit times (for python-side spans)
+void pt_trace_complete(const char* name, uint64_t ts_us, uint64_t dur_us) {
+  if (!g_tracer.enabled) return;
+  TraceEvent ev{name, ts_us, dur_us, tid_hash()};
+  std::lock_guard<std::mutex> lk(g_tracer.mu);
+  g_tracer.events.push_back(std::move(ev));
+}
+
+int64_t pt_trace_count() {
+  std::lock_guard<std::mutex> lk(g_tracer.mu);
+  return static_cast<int64_t>(g_tracer.events.size());
+}
+
+void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_tracer.mu);
+  g_tracer.events.clear();
+}
+
+// Serialize to chrome://tracing JSON (ref chrometracing_logger.cc output format).
+// Returns bytes written (excluding NUL), or required size if buf is null/small.
+int64_t pt_trace_dump_json(char* buf, int64_t cap) {
+  std::string out = "{\"traceEvents\":[";
+  {
+    std::lock_guard<std::mutex> lk(g_tracer.mu);
+    bool first = true;
+    for (const auto& ev : g_tracer.events) {
+      if (!first) out += ',';
+      first = false;
+      out += "{\"name\":\"";
+      for (char c : ev.name) {  // minimal JSON escape
+        if (c == '"' || c == '\\') out += '\\';
+        if (static_cast<unsigned char>(c) >= 0x20) out += c;
+      }
+      out += "\",\"ph\":\"X\",\"pid\":0,\"tid\":" + std::to_string(ev.tid) +
+             ",\"ts\":" + std::to_string(ev.ts_us) +
+             ",\"dur\":" + std::to_string(ev.dur_us) + "}";
+    }
+  }
+  out += "]}";
+  int64_t need = static_cast<int64_t>(out.size());
+  if (buf == nullptr || cap < need) return need;
+  std::memcpy(buf, out.data(), out.size());
+  return need;
+}
+
+uint64_t pt_trace_now_us() { return now_us(); }
+
+// ----------------------------------------------------------------------------
+// 4. Host buffer pool (size-class free lists + stats)
+// ----------------------------------------------------------------------------
+
+namespace {
+
+struct Pool {
+  std::unordered_map<size_t, std::vector<void*>> free_lists;  // size-class -> buffers
+  std::unordered_map<void*, size_t> live;                     // ptr -> class size
+  std::mutex mu;
+  std::atomic<int64_t> allocated{0};   // bytes held (live + cached)
+  std::atomic<int64_t> in_use{0};      // bytes handed out
+  std::atomic<int64_t> peak{0};
+  std::atomic<int64_t> hits{0}, misses{0};
+};
+
+size_t size_class(size_t n) {
+  // round up to the next power of two >= 256 (alignment-friendly for DMA staging)
+  size_t c = 256;
+  while (c < n) c <<= 1;
+  return c;
+}
+
+}  // namespace
+
+void* pt_pool_new() { return new Pool(); }
+
+void* pt_pool_alloc(void* h, int64_t n) {
+  auto* p = static_cast<Pool*>(h);
+  size_t cls = size_class(static_cast<size_t>(n));
+  void* buf = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    auto& fl = p->free_lists[cls];
+    if (!fl.empty()) {
+      buf = fl.back();
+      fl.pop_back();
+      p->hits++;
+    }
+  }
+  if (buf == nullptr) {
+    if (posix_memalign(&buf, 4096, cls) != 0) return nullptr;  // page-aligned
+    p->misses++;
+    p->allocated += static_cast<int64_t>(cls);
+  }
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->live[buf] = cls;
+  }
+  p->in_use += static_cast<int64_t>(cls);
+  int64_t u = p->in_use.load();
+  int64_t pk = p->peak.load();
+  while (u > pk && !p->peak.compare_exchange_weak(pk, u)) {
+  }
+  return buf;
+}
+
+int pt_pool_free(void* h, void* buf) {
+  auto* p = static_cast<Pool*>(h);
+  size_t cls;
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    auto it = p->live.find(buf);
+    if (it == p->live.end()) return -1;
+    cls = it->second;
+    p->live.erase(it);
+    p->free_lists[cls].push_back(buf);
+  }
+  p->in_use -= static_cast<int64_t>(cls);
+  return 0;
+}
+
+// stats: [allocated, in_use, peak, hits, misses]
+void pt_pool_stats(void* h, int64_t* out5) {
+  auto* p = static_cast<Pool*>(h);
+  out5[0] = p->allocated.load();
+  out5[1] = p->in_use.load();
+  out5[2] = p->peak.load();
+  out5[3] = p->hits.load();
+  out5[4] = p->misses.load();
+}
+
+void pt_pool_trim(void* h) {
+  auto* p = static_cast<Pool*>(h);
+  std::lock_guard<std::mutex> lk(p->mu);
+  for (auto& [cls, fl] : p->free_lists) {
+    for (void* b : fl) {
+      ::free(b);
+      p->allocated -= static_cast<int64_t>(cls);
+    }
+    fl.clear();
+  }
+}
+
+void pt_pool_delete(void* h) {
+  auto* p = static_cast<Pool*>(h);
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    for (auto& [cls, fl] : p->free_lists)
+      for (void* b : fl) ::free(b);
+    for (auto& [b, cls] : p->live) ::free(b);
+  }
+  delete p;
+}
+
+int pt_native_abi_version() { return 1; }
+
+}  // extern "C"

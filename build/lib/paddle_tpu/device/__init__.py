@@ -1,0 +1,81 @@
+"""paddle.device namespace (ref: python/paddle/device/__init__.py)."""
+from ..core.device import (  # noqa: F401
+    set_device, get_device, is_compiled_with_cuda, is_compiled_with_tpu,
+    CPUPlace, CUDAPlace, TPUPlace, CustomPlace, Place,
+)
+import jax
+
+
+def get_all_device_type():
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device():
+    return get_available_device()
+
+
+def device_count():
+    return len(jax.devices())
+
+
+class cuda:
+    """paddle.device.cuda shims mapped to the accelerator."""
+
+    @staticmethod
+    def device_count():
+        return len(jax.devices())
+
+    @staticmethod
+    def synchronize(device=None):
+        import jax.numpy as jnp
+
+        jnp.zeros(()).block_until_ready()
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("peak_bytes_in_use", 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def memory_allocated(device=None):
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return stats.get("bytes_in_use", 0)
+        except Exception:
+            return 0
+
+
+def synchronize(device=None):
+    cuda.synchronize()
+
+
+class Stream:
+    """Streams are XLA's scheduling concern on TPU; kept as no-op parity objects."""
+
+    def __init__(self, device=None, priority=2):
+        pass
+
+    def synchronize(self):
+        cuda.synchronize()
+
+
+class Event:
+    def __init__(self, enable_timing=False, blocking=False, interprocess=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def synchronize(self):
+        cuda.synchronize()

@@ -1,0 +1,55 @@
+"""paddle.distributed parity surface (ref: python/paddle/distributed/__init__.py).
+
+See SURVEY.md §2.4/§5.8 for the inventory this implements: env bootstrap, collectives
+("ProcessGroupXLA" = mesh-axis metadata + lax collectives), topology Mesh,
+DataParallel, fleet facade, meta_parallel TP/PP layers, sharded train steps (ZeRO),
+MoE alltoall, launch CLI.
+"""
+from .env import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, is_initialized, ParallelEnv,
+)
+from .collective import (  # noqa: F401
+    ReduceOp, Group, new_group, get_group, all_reduce, all_gather, all_gather_object,
+    broadcast, broadcast_object_list, reduce, reduce_scatter, scatter, alltoall,
+    all_to_all, send, recv, isend, irecv, barrier, wait, destroy_process_group,
+)
+from .parallel import DataParallel  # noqa: F401
+from .topology import (  # noqa: F401
+    CommunicateTopology, HybridCommunicateGroup, build_mesh,
+    get_hybrid_communicate_group, set_hybrid_communicate_group,
+)
+from .sharded_train_step import ShardedTrainStep  # noqa: F401
+from .sharding_ctx import mesh_scope, constraint, annotate  # noqa: F401
+from . import fleet  # noqa: F401
+from . import sharding  # noqa: F401
+from . import auto_parallel  # noqa: F401
+from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
+from .store import Store, TCPStore  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import launch  # noqa: F401
+
+
+def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):
+    """Ref: distributed/spawn.py.  Single-host TPU: SPMD over the local mesh makes
+    process-spawning unnecessary; run func once in-process for parity."""
+    import multiprocessing as mp
+    import os
+
+    if nprocs in (-1, 0, 1):
+        func(*args)
+        return
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = dict(os.environ, PADDLE_TRAINER_ID=str(rank), PADDLE_TRAINERS_NUM=str(nprocs))
+
+        def target(r=rank, e=env):
+            os.environ.update(e)
+            func(*args)
+
+        p = ctx.Process(target=target, daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()

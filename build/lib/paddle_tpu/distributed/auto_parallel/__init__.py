@@ -1,0 +1,10 @@
+"""auto_parallel (ref: python/paddle/distributed/auto_parallel/ — ProcessMesh
+process_mesh.py:39, shard_tensor/shard_op interface.py:34,73, Engine engine.py:53).
+
+The reference's completion (dist-attr propagation), partitioner (program slicing) and
+resharder (cross-mesh moves) are replaced wholesale by XLA's GSPMD partitioner: users
+annotate with ProcessMesh + shard_tensor, and the Engine compiles one SPMD program.
+"""
+from .process_mesh import ProcessMesh, get_current_process_mesh  # noqa: F401
+from .interface import shard_tensor, shard_op, reshard  # noqa: F401
+from .engine import Engine  # noqa: F401

@@ -1,0 +1,208 @@
+"""auto_parallel Engine (ref: distributed/auto_parallel/engine.py:53,95,378).
+
+The reference Engine takes a serial model + loss + optimizer and a DistributedStrategy,
+runs completion/partition/reshard passes, and executes per-rank programs.  TPU-native:
+the Engine compiles ONE SPMD training/eval step over the ProcessMesh's jax Mesh —
+parameter shardings come from layer annotations + shard_tensor markers, batch sharding
+from `data_spec`, and XLA GSPMD does completion/partition/reshard.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor
+from ...autograd import tape
+from ...framework import random as _random
+from ..sharding_ctx import mesh_scope
+from ..sharded_train_step import ShardedTrainStep
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+
+class Engine:
+    def __init__(self, model=None, loss=None, optimizer=None, metrics=None,
+                 strategy=None, process_mesh=None):
+        self.model = model
+        self.loss = loss
+        self.optimizer = optimizer
+        self.metrics = (metrics if isinstance(metrics, (list, tuple))
+                        else [metrics]) if metrics is not None else []
+        self.strategy = strategy
+        self._process_mesh = process_mesh or get_current_process_mesh()
+        self._train_step = None
+        self._eval_fn = None
+        self.history = {"loss": []}
+
+    # ------------------------------------------------------------------ mesh
+    def _jax_mesh(self) -> Mesh:
+        if self._process_mesh is not None:
+            return self._process_mesh.to_jax_mesh()
+        hc = getattr(self.strategy, "hybrid_configs", None) if self.strategy else None
+        if hc:
+            from ..topology import build_mesh
+
+            return build_mesh(dp=hc.get("dp_degree", 1), mp=hc.get("mp_degree", 1),
+                              pp=hc.get("pp_degree", 1),
+                              sharding=hc.get("sharding_degree", 1))
+        # default: pure data parallel over all devices
+        devs = np.array(jax.devices())
+        return Mesh(devs.reshape(len(devs)), ("dp",))
+
+    def _batch_spec(self, mesh: Mesh):
+        data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names
+                          and mesh.shape[a] > 1)
+        if data_axes:
+            return P(data_axes)
+        # generic ProcessMesh: shard the batch over the first mesh dim
+        first = mesh.axis_names[0]
+        return P(first) if mesh.shape[first] > 1 else P()
+
+    # ------------------------------------------------------------------ train
+    def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
+        """Ref engine.py:378 — build the compiled step lazily; kept for API parity."""
+        return self
+
+    def _ensure_train_step(self):
+        if self._train_step is None:
+            mesh = self._jax_mesh()
+
+            def loss_fn(x, y):
+                out = self.model(x)
+                return self.loss(out, y), out
+
+            zero = 0
+            if self.strategy is not None and getattr(self.strategy, "sharding", False):
+                zero = int(getattr(self.strategy, "sharding_configs", {}).get("stage", 2))
+            self._train_step = ShardedTrainStep(self.model, loss_fn, self.optimizer,
+                                                mesh, batch_spec=self._batch_spec(mesh),
+                                                zero_stage=zero)
+        return self._train_step
+
+    def fit(self, train_data=None, epochs=1, batch_size=1, steps_per_epoch=None,
+            log_freq=10, verbose=1, shuffle=True, **kwargs):
+        """Ref engine.py fit — train over a Dataset/DataLoader with the SPMD step."""
+        from ...io import DataLoader, Dataset
+
+        loader = (DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                             drop_last=True)
+                  if isinstance(train_data, Dataset) else train_data)
+        step_fn = self._ensure_train_step()
+        logs = {}
+        for epoch in range(epochs):
+            for step, batch in enumerate(loader):
+                if steps_per_epoch is not None and step >= steps_per_epoch:
+                    break
+                x, y = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) else (batch, None)
+                out = step_fn(x, y)
+                loss = out[0] if isinstance(out, tuple) else out
+                lf = float(loss.item())
+                self.history["loss"].append(lf)
+                logs = {"epoch": epoch, "step": step, "loss": lf}
+                if verbose and step % log_freq == 0:
+                    print(f"[autoparallel] epoch {epoch} step {step} loss {lf:.5f}")
+        return logs
+
+    # ------------------------------------------------------------------ eval
+    def _ensure_eval_fn(self):
+        if self._eval_fn is None:
+            mesh = self._jax_mesh()
+            model = self.model
+            loss_obj = self.loss
+            bspec = self._batch_spec(mesh)
+
+            def eval_step(params, buffers, key, x, y):
+                with _random.rng_key_scope(key):
+                    restore = model.bind_functional_state(params, buffers)
+                    try:
+                        with tape.no_grad():
+                            out = model(Tensor(x, stop_gradient=True))
+                            loss = (loss_obj(out, Tensor(y, stop_gradient=True))
+                                    if loss_obj is not None else None)
+                    finally:
+                        restore()
+                return (out._value, loss._value if loss is not None else None)
+
+            rep = NamedSharding(mesh, P())
+            bs = NamedSharding(mesh, bspec)
+            jitted = jax.jit(eval_step, in_shardings=(None, None, rep, bs, bs))
+
+            def run(x, y):
+                with mesh_scope(mesh):
+                    params, buffers = model.functional_state()
+                    return jitted(params, buffers, _random.get_rng_key(), x, y)
+
+            self._eval_fn = run
+        return self._eval_fn
+
+    def evaluate(self, valid_data=None, batch_size=1, steps=None, verbose=0, **kwargs):
+        from ...io import DataLoader, Dataset
+
+        loader = (DataLoader(valid_data, batch_size=batch_size, drop_last=True)
+                  if isinstance(valid_data, Dataset) else valid_data)
+        self.model.eval()
+        fn = self._ensure_eval_fn()
+        losses = []
+        for m in self.metrics:
+            m.reset()
+        for step, batch in enumerate(loader):
+            if steps is not None and step >= steps:
+                break
+            x, y = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) else (batch, None)
+            x = x._value if isinstance(x, Tensor) else np.asarray(x)
+            y = y._value if isinstance(y, Tensor) else np.asarray(y)
+            out, loss = fn(x, y)
+            if loss is not None:
+                losses.append(float(loss))
+            for m in self.metrics:
+                try:
+                    m.update(m.compute(Tensor(out), Tensor(y)))
+                except Exception:
+                    pass
+        self.model.train()
+        result = {"loss": float(np.mean(losses)) if losses else None}
+        for m in self.metrics:
+            result[m.name()] = m.accumulate()
+        return result
+
+    def predict(self, test_data=None, batch_size=1, steps=None, **kwargs):
+        from ...io import DataLoader, Dataset
+
+        loader = (DataLoader(test_data, batch_size=batch_size)
+                  if isinstance(test_data, Dataset) else test_data)
+        self.model.eval()
+        outs = []
+        with tape.no_grad():
+            for step, batch in enumerate(loader):
+                if steps is not None and step >= steps:
+                    break
+                x = batch[0] if isinstance(batch, (list, tuple)) else batch
+                outs.append(self.model(x).numpy())
+        self.model.train()
+        return outs
+
+    # ------------------------------------------------------------------ io
+    def save(self, path, training=True):
+        from ...framework.io import save as psave
+
+        psave(self.model.state_dict(), path + ".pdparams")
+        if training and self.optimizer is not None:
+            psave(self.optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, strict=True, load_optimizer=True):
+        import os
+
+        from ...framework.io import load as pload
+
+        self.model.set_state_dict(pload(path + ".pdparams"))
+        if load_optimizer and self.optimizer is not None and os.path.exists(path + ".pdopt"):
+            self.optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    @property
+    def main_program(self):  # static-graph parity shims
+        return None
+
+    @property
+    def startup_program(self):
+        return None

@@ -1,0 +1,68 @@
+"""shard_tensor / shard_op markers (ref: distributed/auto_parallel/interface.py:34,73).
+
+In the reference these attach DistAttr to variables in a serial Program; the
+completion pass (completion.py) propagates them and the partitioner rewrites the
+program per rank.  TPU-native: `shard_tensor` immediately places the array with a
+NamedSharding (the annotation IS the dist-attr) and records the spec so compiled
+steps reuse it; propagation and program slicing are XLA GSPMD's job.
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor
+from .process_mesh import ProcessMesh, get_current_process_mesh
+
+
+def shard_tensor(x, process_mesh: ProcessMesh | None = None, shard_spec=None):
+    """Annotate + place `x` per `shard_spec` (a list of dim names / None per axis).
+
+    Ref interface.py:34.  Returns the same Tensor, now backed by a sharded array.
+    Inside a trace it becomes a with_sharding_constraint.
+    """
+    pm = process_mesh or get_current_process_mesh()
+    if pm is None:
+        raise ValueError("shard_tensor needs a ProcessMesh (argument or context)")
+    if shard_spec is None:
+        shard_spec = [None] * len(x.shape)
+    sharding = pm.named_sharding(shard_spec)
+    t = x if isinstance(x, Tensor) else Tensor(x)
+    if isinstance(t._value, jax.core.Tracer):
+        t._rebind(jax.lax.with_sharding_constraint(t._value, sharding))
+    else:
+        t._rebind(jax.device_put(t._value, sharding))
+    t.sharding_spec = tuple(s if s is None else s for s in shard_spec)
+    t.process_mesh = pm
+    return t
+
+
+def shard_op(op_fn, process_mesh: ProcessMesh | None = None, in_shard_specs=None,
+             out_shard_specs=None):
+    """Ref interface.py:73 — wrap a callable so its inputs/outputs are resharded per
+    the given specs on entry/exit."""
+    pm = process_mesh or get_current_process_mesh()
+
+    def wrapped(*args, **kwargs):
+        if pm is not None and in_shard_specs is not None:
+            args = tuple(
+                shard_tensor(a, pm, spec) if isinstance(a, Tensor) and spec is not None else a
+                for a, spec in zip(args, in_shard_specs)
+            )
+        out = op_fn(*args, **kwargs)
+        if pm is not None and out_shard_specs is not None:
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            outs = tuple(
+                shard_tensor(o, pm, spec) if isinstance(o, Tensor) and spec is not None else o
+                for o, spec in zip(outs, out_shard_specs)
+            )
+            out = outs if isinstance(out, (tuple, list)) else outs[0]
+        return out
+
+    return wrapped
+
+
+def reshard(x, process_mesh: ProcessMesh, shard_spec):
+    """Explicit cross-sharding move (ref reshard.py's Resharder, collapsed to a
+    device_put with the target NamedSharding — XLA plans the collective moves)."""
+    return shard_tensor(x, process_mesh, shard_spec)

@@ -1,0 +1,112 @@
+"""ProcessMesh (ref: python/paddle/distributed/auto_parallel/process_mesh.py:39).
+
+The reference's ProcessMesh is a logical N-D array of process ranks with named dims;
+dist-attrs are propagated over it by the completion pass and the partitioner slices
+the serial program per rank.  TPU-native: a ProcessMesh *is* a jax.sharding.Mesh over
+real devices — the "completion + partition" pipeline collapses into XLA's SPMD
+partitioner, driven by NamedSharding annotations (see interface.shard_tensor).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_g_process_mesh_stack: list = []
+
+
+class ProcessMesh:
+    """A named logical mesh of processes/devices.
+
+    `mesh` is a (nested) list / ndarray of global device ids; `dim_names` names each
+    mesh dimension for use in shard_spec annotations.
+    """
+
+    def __init__(self, mesh=None, dim_names=None, shape=None, process_ids=None):
+        if mesh is None and shape is not None:
+            ids = process_ids if process_ids is not None else list(range(int(np.prod(shape))))
+            mesh = np.asarray(ids).reshape(shape)
+        arr = np.asarray(mesh)
+        self._mesh = arr
+        self._shape = tuple(arr.shape)
+        self._process_ids = [int(i) for i in arr.flatten()]
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError(f"dim_names {dim_names} must match mesh ndim {arr.ndim}")
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    # --- reference-shaped accessors (process_mesh.py)
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def shape(self):
+        return list(self._shape)
+
+    @property
+    def ndim(self):
+        return self._mesh.ndim
+
+    @property
+    def dim_names(self):
+        return self._dim_names
+
+    @property
+    def process_ids(self):
+        return self._process_ids
+
+    @property
+    def processes(self):  # legacy alias
+        return self._process_ids
+
+    def get_dim_size(self, dim_name):
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh) and self._shape == other._shape
+                and self._process_ids == other._process_ids
+                and self._dim_names == other._dim_names)
+
+    def __ne__(self, other):
+        return not self.__eq__(other)
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, dim_names={self._dim_names})"
+
+    # --- TPU-native bridge
+    def to_jax_mesh(self) -> Mesh:
+        """Materialize as a jax Mesh over real devices.  Device i in jax.devices()
+        backs logical process id i (single-host: ids index local devices; multi-host:
+        the launch layer guarantees global device ordering)."""
+        if self._jax_mesh is None:
+            devs = jax.devices()
+            if len(self._process_ids) > len(devs):
+                raise ValueError(
+                    f"ProcessMesh needs {len(self._process_ids)} devices, have {len(devs)}")
+            arr = np.asarray([devs[i] for i in self._process_ids]).reshape(self._shape)
+            self._jax_mesh = Mesh(arr, tuple(self._dim_names))
+        return self._jax_mesh
+
+    def named_sharding(self, shard_spec) -> NamedSharding:
+        for s in shard_spec or []:
+            if s is not None and s not in self._dim_names:
+                raise ValueError(
+                    f"shard_spec dim {s!r} is not one of this mesh's dim_names "
+                    f"{self._dim_names}")
+        return NamedSharding(self.to_jax_mesh(), P(*(shard_spec or [])))
+
+    def __enter__(self):
+        _g_process_mesh_stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _g_process_mesh_stack.pop()
+        return False
+
+
+def get_current_process_mesh() -> ProcessMesh | None:
+    return _g_process_mesh_stack[-1] if _g_process_mesh_stack else None

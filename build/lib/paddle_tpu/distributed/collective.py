@@ -1,0 +1,248 @@
+"""Collective communication (ref: python/paddle/distributed/collective.py;
+C++ ProcessGroup.h:53; operators/collective/ 148 files; SURVEY.md §5.8).
+
+TPU-native design ("ProcessGroupXLA"): a Group carries mesh-axis metadata; collectives
+called INSIDE jit/shard_map emit jax.lax collectives (psum/all_gather/ppermute/
+all_to_all) over the named axis — compiled onto ICI by XLA.  Called EAGERLY they
+operate on the device-local view: with a single participant they are identity (the
+degenerate case the reference handles via ring of size 1); true multi-host eager mode
+routes through shard_map over the global mesh.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, apply_op, _unwrap
+from . import env as _env
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+@dataclasses.dataclass
+class Group:
+    """Ref ProcessGroup (ProcessGroup.h:53) — here: ranks + optional mesh axis name."""
+
+    ranks: list
+    gid: int = 0
+    axis_name: str | None = None  # set when the group maps onto a mesh axis
+
+    @property
+    def nranks(self):
+        return len(self.ranks)
+
+    @property
+    def world_size(self):
+        return len(self.ranks)
+
+    @property
+    def rank(self):
+        r = _env.get_rank()
+        return self.ranks.index(r) if r in self.ranks else -1
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if rank in self.ranks else -1
+
+    @property
+    def process_group(self):
+        return self
+
+    @property
+    def id(self):
+        return self.gid
+
+    @property
+    def name(self):
+        return f"group_{self.gid}"
+
+
+_group_counter = [0]
+_default_group: Group | None = None
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        ws = _env.get_world_size()
+        _default_group = Group(list(range(ws)), 0, axis_name=None)
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    """Ref collective.py:366."""
+    _group_counter[0] += 1
+    if ranks is None:
+        ranks = list(range(_env.get_world_size()))
+    return Group(list(ranks), _group_counter[0], axis_name=axis_name)
+
+
+def get_group(gid=0):
+    return _get_default_group()
+
+
+def _axis(group):
+    g = group or _get_default_group()
+    return g.axis_name
+
+
+def _in_trace(x):
+    return isinstance(x, jax.core.Tracer)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    """Ref collective.py:711.  In-jit w/ axis: lax.psum over ICI; eager 1-rank: identity."""
+    ax = _axis(group)
+
+    def _f(v):
+        if ax is not None and _in_trace(v):
+            if op == ReduceOp.SUM:
+                return jax.lax.psum(v, ax)
+            if op == ReduceOp.MAX:
+                return jax.lax.pmax(v, ax)
+            if op == ReduceOp.MIN:
+                return jax.lax.pmin(v, ax)
+            if op == ReduceOp.AVG:
+                return jax.lax.pmean(v, ax)
+            raise NotImplementedError("PROD all_reduce inside jit")
+        return v  # single-participant eager view
+
+    out = apply_op(_f, (tensor,), name="all_reduce")
+    if isinstance(tensor, Tensor) and not _in_trace(tensor._value):
+        tensor.set_value(out._value)
+        return tensor
+    return out
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    """Ref collective.py:915.  In-jit: lax.all_gather; returns list for API parity."""
+    ax = _axis(group)
+    g = group or _get_default_group()
+
+    def _f(v):
+        if ax is not None and _in_trace(v):
+            return jax.lax.all_gather(v, ax)
+        return v[None]
+
+    out = apply_op(_f, (tensor,), name="all_gather")
+    if tensor_list is not None:
+        n = out.shape[0]
+        for i in range(n):
+            tensor_list.append(out[i])
+        return
+    return out
+
+
+def all_gather_object(object_list, obj, group=None):
+    object_list.append(obj)
+
+
+def broadcast(tensor, src, group=None, sync_op=True):
+    """In-jit SPMD: values are already consistent per sharding; eager: identity."""
+    return tensor
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list
+
+
+def reduce(tensor, dst, op=ReduceOp.SUM, group=None, sync_op=True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list, op=ReduceOp.SUM, group=None, sync_op=True):
+    ax = _axis(group)
+
+    def _f(*vs):
+        v = jnp.stack(vs) if len(vs) > 1 else vs[0]
+        if ax is not None and _in_trace(v):
+            return jax.lax.psum_scatter(v, ax, tiled=False)
+        return vs[0] if len(vs) == 1 else v[0]
+
+    src = tensor_list if isinstance(tensor_list, (list, tuple)) else [tensor_list]
+    out = apply_op(_f, tuple(src), name="reduce_scatter")
+    if isinstance(tensor, Tensor):
+        tensor.set_value(out._value if isinstance(out, Tensor) else out)
+    return out
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if tensor_list:
+        tensor.set_value(_unwrap(tensor_list[0]))
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list, group=None, sync_op=True):
+    """Ref collective.py:1844 (+ global_scatter/global_gather MoE ops).
+    In-jit: lax.all_to_all over the axis."""
+    ax = _axis(group)
+    if isinstance(in_tensor_list, Tensor):
+        # tensor form: split axis 0 across ranks
+        def _f(v):
+            if ax is not None and _in_trace(v):
+                n = jax.lax.axis_size(ax)
+                vr = v.reshape(n, v.shape[0] // n, *v.shape[1:])
+                return jax.lax.all_to_all(vr, ax, split_axis=0, concat_axis=0, tiled=False).reshape(v.shape)
+            return v
+
+        return apply_op(_f, (in_tensor_list,), name="alltoall")
+    # list form, eager single-rank: identity copy
+    for t in in_tensor_list:
+        out_tensor_list.append(t.clone() if isinstance(t, Tensor) else t)
+
+
+def all_to_all(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    return alltoall(in_tensor_list, out_tensor_list, group, sync_op)
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv are expressed as ppermute inside compiled pipeline "
+        "programs on TPU (see meta_parallel.pipeline_parallel); eager p2p is not supported"
+    )
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "point-to-point send/recv are expressed as ppermute inside compiled pipeline "
+        "programs on TPU (see meta_parallel.pipeline_parallel); eager p2p is not supported"
+    )
+
+
+isend = send
+irecv = recv
+
+
+def barrier(group=None):
+    jnp.zeros(()).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and hasattr(tensor._value, "block_until_ready"):
+        tensor._value.block_until_ready()
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None
+
+
+# in-jit helpers used by meta_parallel layers (explicit-axis forms)
+def psum(x, axis_name):
+    return jax.lax.psum(x, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    return jax.lax.ppermute(x, axis_name, perm)
+
+
+def axis_index(axis_name):
+    return jax.lax.axis_index(axis_name)

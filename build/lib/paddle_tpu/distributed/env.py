@@ -1,0 +1,91 @@
+"""Process/bootstrap environment (ref: python/paddle/distributed/parallel.py:94
+init_parallel_env + TCPStore rendezvous, launch/controllers/collective.py env vars).
+
+TPU-native: jax.distributed.initialize handles rendezvous (its coordinator service is
+the TCPStore analog); PADDLE_* env vars are honored for launch compatibility.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+_initialized = False
+
+
+class ParallelEnv:
+    """Ref: fluid/dygraph/parallel.py ParallelEnv."""
+
+    def __init__(self):
+        self._rank = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", 0))
+
+    @property
+    def dev_id(self):
+        return self.local_rank
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+
+def init_parallel_env():
+    """Ref parallel.py:94.  Multi-host: jax.distributed.initialize from PADDLE_* env."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    n_procs = int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+    # NB: don't probe jax.process_count() here — it would initialize the XLA
+    # backend, after which jax.distributed.initialize refuses to run
+    if n_procs > 1 and not jax.distributed.is_initialized():
+        coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+        pid = int(os.environ.get("PADDLE_TRAINER_ID", 0))
+        if coord:
+            jax.distributed.initialize(coordinator_address=coord, num_processes=n_procs,
+                                       process_id=pid)
+    _initialized = True
+    return ParallelEnv()
+
+
+def get_rank(group=None):
+    if group is not None and hasattr(group, "rank"):
+        return group.rank
+    try:
+        return jax.process_index()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINER_ID", 0))
+
+
+def get_world_size(group=None):
+    if group is not None and hasattr(group, "nranks"):
+        return group.nranks
+    try:
+        return jax.process_count()
+    except Exception:
+        return int(os.environ.get("PADDLE_TRAINERS_NUM", 1))
+
+
+def is_initialized():
+    return _initialized
+
+
+def parallel_device_count():
+    return len(jax.devices())

@@ -1,0 +1,183 @@
+"""fleet facade (ref: python/paddle/distributed/fleet/base/fleet_base.py:144,211,890,947
+and DistributedStrategy fleet/base/distributed_strategy.py:110 over
+framework/distributed_strategy.proto's 28 messages).
+
+fleet.init builds the HybridCommunicateGroup Mesh from strategy.hybrid_configs;
+distributed_model/distributed_optimizer return wrappers whose compiled path is
+ShardedTrainStep (dp/mp/sharding via NamedSharding, pp via the compiled pipeline).
+"""
+from __future__ import annotations
+
+from ..topology import (
+    CommunicateTopology,
+    HybridCommunicateGroup,
+    get_hybrid_communicate_group,
+    set_hybrid_communicate_group,
+)
+from ..env import init_parallel_env, get_rank, get_world_size
+from ..parallel import DataParallel
+from .. import collective as _collective
+from ...optimizer.optimizer import Optimizer
+from .. import meta_parallel  # noqa: F401
+from . import utils  # noqa: F401
+from . import elastic  # noqa: F401
+from ..meta_parallel import mp_layers  # noqa: F401
+from ..meta_parallel.mp_layers import (  # noqa: F401 (fleet.meta_parallel re-exports)
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear, ParallelCrossEntropy,
+    get_rng_state_tracker,
+)
+
+
+class DistributedStrategy:
+    """Ref distributed_strategy.py:110 — the single knob surface."""
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+        self.hybrid_configs = {
+            "dp_degree": 1,
+            "mp_degree": 1,
+            "pp_degree": 1,
+            "sharding_degree": 1,
+            "sep_degree": 1,
+        }
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.lamb = False
+        self.lars = False
+        self.dgc = False
+        self.localsgd = False
+        self.gradient_scale_configs = {"scale_strategy": "avg"}
+        self.find_unused_parameters = False
+        self.fuse_all_reduce_ops = True
+        self.fuse_grad_size_in_MB = 32
+        self.nccl_comm_num = 1
+        self.heter_ccl_mode = False
+        self.without_graph_optimization = False
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+class _Fleet:
+    def __init__(self):
+        self._strategy = None
+        self._hcg = None
+        self._is_initialized = False
+        self.worker_num_ = 1
+
+    def init(self, role_maker=None, is_collective=False, strategy=None, log_level="INFO"):
+        """Ref fleet_base.py:211."""
+        init_parallel_env()
+        self._strategy = strategy or DistributedStrategy()
+        hc = self._strategy.hybrid_configs
+        self._hcg = HybridCommunicateGroup(
+            dp=hc.get("dp_degree", 1), mp=hc.get("mp_degree", 1),
+            pp=hc.get("pp_degree", 1), sharding=hc.get("sharding_degree", 1),
+            sep=hc.get("sep_degree", 1),
+        )
+        set_hybrid_communicate_group(self._hcg)
+        self._is_initialized = True
+        return self
+
+    def is_first_worker(self):
+        return get_rank() == 0
+
+    def worker_index(self):
+        return get_rank()
+
+    def worker_num(self):
+        return get_world_size()
+
+    def is_worker(self):
+        return True
+
+    def worker_endpoints(self, to_string=False):
+        import os
+
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        return ",".join(eps) if to_string else eps
+
+    def barrier_worker(self):
+        _collective.barrier()
+
+    def get_hybrid_communicate_group(self):
+        return self._hcg
+
+    @property
+    def _hcg_prop(self):
+        return self._hcg
+
+    def distributed_model(self, model):
+        """Ref fleet_base.py:947,1052-1077 — wrap per strategy.  With SPMD shardings
+        the tp/sharding wrappers are no-ops (annotations live on the layers); pp wraps
+        into the compiled PipelineParallel; pure-dp wraps in DataParallel."""
+        if self._hcg is not None and self._hcg.get_pipe_parallel_world_size() > 1:
+            from ..meta_parallel.pipeline_parallel import PipelineParallel
+
+            if not isinstance(model, PipelineParallel):
+                model = PipelineParallel(model, self._hcg, self._strategy)
+            return model
+        if self._hcg is not None and self._hcg.get_model_parallel_world_size() > 1:
+            from ..meta_parallel.tensor_parallel import TensorParallel
+
+            return TensorParallel(model, self._hcg, strategy=self._strategy)
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        """Ref fleet_base.py:890 → HybridParallelOptimizer."""
+        from .hybrid_optimizer import HybridParallelOptimizer
+
+        return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    # PS-mode stubs (SURVEY.md §7.4: parameter-server stack is an explicit non-goal)
+    def is_server(self):
+        return False
+
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        raise NotImplementedError("parameter-server mode is out of scope for the TPU build")
+
+    def run_server(self):
+        raise NotImplementedError("parameter-server mode is out of scope for the TPU build")
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, *args, **kwargs):
+        pass
+
+    def save_persistables(self, *args, **kwargs):
+        pass
+
+
+fleet = _Fleet()
+
+# module-level function aliases (paddle.distributed.fleet.init style)
+init = fleet.init
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_first_worker = fleet.is_first_worker
+get_hybrid_communicate_group_fn = fleet.get_hybrid_communicate_group
+
+
+class UserDefinedRoleMaker:
+    def __init__(self, *args, **kwargs):
+        pass
+
+
+class PaddleCloudRoleMaker:
+    def __init__(self, is_collective=False, **kwargs):
+        self._is_collective = is_collective

@@ -1,0 +1,3 @@
+"""Elastic training (ref: fleet/elastic/__init__.py:48 launch_elastic,
+fleet/elastic/manager.py:131 ElasticManager)."""
+from .manager import ElasticManager, ElasticStatus, enable_elastic, launch_elastic  # noqa: F401

@@ -1,0 +1,175 @@
+"""ElasticManager (ref: fleet/elastic/manager.py:131).
+
+The reference registers each node under an etcd prefix with a TTL heartbeat
+(manager.py:217-239); a watcher detects scale-in/out, rewrites
+PADDLE_TRAINER_ENDPOINTS and relaunches local trainers.
+
+TPU-native redesign: TPU pods don't rebuild NCCL communicators — recovery is
+checkpoint-restore (SURVEY.md §7.3 item 8).  Membership lives in the control-plane KV
+store (distributed.store.TCPStore or any dict-like store for tests); each node
+heartbeats `{prefix}/nodes/{host}` with a timestamp; the watcher thread flags nodes
+whose heartbeat is older than 3 intervals (scale-in: a preempted host) or new keys
+(scale-out).  On membership change the manager calls the registered callback —
+typically "save checkpoint and re-exec under the new world size" — instead of
+hot-patching communicators.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+ELASTIC_EXIT_CODE = 101
+ELASTIC_AUTO_PARALLEL_EXIT_CODE = 102
+
+
+class ElasticStatus:
+    COMPLETED = "completed"
+    ERROR = "error"
+    HOLD = "hold"
+    RESTART = "restart"
+    EXIT = "exit"
+
+
+class _DictStore:
+    """In-memory store for tests (reference precedent: mocked etcd in
+    test_fleet_elastic_manager.py)."""
+
+    def __init__(self):
+        self._d = {}
+        self._lock = threading.Lock()
+
+    def set(self, k, v):
+        with self._lock:
+            self._d[k] = v if isinstance(v, bytes) else str(v).encode()
+
+    def get(self, k):
+        with self._lock:
+            return self._d.get(k)
+
+    def delete_key(self, k):
+        with self._lock:
+            self._d.pop(k, None)
+
+    def keys_with_prefix(self, prefix):
+        with self._lock:
+            return [k for k in self._d if k.startswith(prefix)]
+
+
+class ElasticManager:
+    """Membership + heartbeat + scale detection.
+
+    `np` may be "N" or "MIN:MAX" (ref manager.py parses PADDLE_ELASTIC_NP the same
+    way).  `on_change(event, hosts)` fires with event in {"scale_in", "scale_out"}.
+    """
+
+    def __init__(self, store=None, job_id=None, np=None, host=None,
+                 heartbeat_interval=1.0, on_change=None):
+        self.store = store if store is not None else _DictStore()
+        self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
+        np = str(np or os.environ.get("PADDLE_ELASTIC_NP", "1"))
+        self.min_np = int(np.split(":")[0])
+        self.max_np = int(np.split(":")[-1])
+        self.host = host or os.environ.get("PADDLE_CURRENT_ENDPOINT",
+                                           f"127.0.0.1:{os.getpid()}")
+        self.interval = heartbeat_interval
+        self.on_change = on_change
+        self.prefix = f"/paddle_tpu/elastic/{self.job_id}"
+        self.enabled = self.max_np > self.min_np or os.environ.get(
+            "PADDLE_ELASTIC_ENABLE", "0") in ("1", "true", "True")
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._known_hosts: set[str] = set()
+
+    # ------------------------------------------------------------- membership
+    def _node_key(self, host=None):
+        return f"{self.prefix}/nodes/{host or self.host}"
+
+    def register(self):
+        """Ref manager.py:217 — register + start heartbeat + watcher."""
+        self.store.set(self._node_key(), str(time.time()))
+        self._known_hosts = set(self.hosts())
+        t_hb = threading.Thread(target=self._heartbeat_loop, daemon=True)
+        t_w = threading.Thread(target=self._watch_loop, daemon=True)
+        self._threads = [t_hb, t_w]
+        for t in self._threads:
+            t.start()
+
+    def _heartbeat_loop(self):
+        while not self._stop.wait(self.interval):
+            self.store.set(self._node_key(), str(time.time()))
+
+    def hosts(self) -> list[str]:
+        pre = f"{self.prefix}/nodes/"
+        out = []
+        now = time.time()
+        # use the store's non-blocking get where available: a node deregistering
+        # between the prefix scan and the read must not stall the watcher on a
+        # blocking-G wait (TCPStore.get blocks until the key exists)
+        getter = getattr(self.store, "get_nb", None) or self.store.get
+        for k in self.store.keys_with_prefix(pre):
+            try:
+                v = getter(k)
+            except Exception:
+                continue
+            if v is None:
+                continue
+            ts = float(v.decode() if isinstance(v, bytes) else v)
+            if now - ts <= 3 * self.interval:
+                out.append(k[len(pre):])
+        return sorted(out)
+
+    def _watch_loop(self):
+        while not self._stop.wait(self.interval):
+            live = set(self.hosts())
+            gone = self._known_hosts - live
+            new = live - self._known_hosts
+            if gone or new:
+                self._known_hosts = live
+                if self.on_change is not None:
+                    if gone:
+                        self.on_change("scale_in", sorted(live))
+                    if new:
+                        self.on_change("scale_out", sorted(live))
+
+    # ------------------------------------------------------------- decisions
+    def check(self) -> str:
+        """Map current membership to an action (ref manager.py exit/restart logic)."""
+        n = len(self.hosts())
+        if n >= self.min_np:
+            return ElasticStatus.COMPLETED if n <= self.max_np else ElasticStatus.ERROR
+        return ElasticStatus.HOLD  # wait for nodes to (re)join
+
+    def wait_for_np(self, timeout=60) -> bool:
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            if self.min_np <= len(self.hosts()) <= self.max_np:
+                return True
+            time.sleep(self.interval / 2)
+        return False
+
+    def exit(self, completed=True):
+        self._stop.set()
+        self.store.delete_key(self._node_key())
+        for t in self._threads:
+            t.join(timeout=2 * self.interval)
+
+
+def enable_elastic(args=None, etcd_client=None) -> bool:
+    np = str(getattr(args, "np", None) or os.environ.get("PADDLE_ELASTIC_NP", "1"))
+    return ":" in np or os.environ.get("PADDLE_ELASTIC_ENABLE", "0") in ("1", "true")
+
+
+def launch_elastic(args, store=None):
+    """Ref elastic/__init__.py:48 — run the launcher under elastic supervision."""
+    from ...launch.main import CollectiveController
+
+    mgr = ElasticManager(store=store, np=getattr(args, "nnodes", "1"))
+    mgr.register()
+    ctl = CollectiveController(args)
+    ctl.start()
+    try:
+        return ctl.watch()
+    finally:
+        mgr.exit()

@@ -1,0 +1,49 @@
+"""HybridParallelOptimizer (ref: fleet/meta_parallel/dygraph_optimizer/
+hybrid_parallel_optimizer.py:172 — TP-aware grad clip + inner optimizer).
+
+With SPMD shardings the global-norm clip is already global (XLA reduces over all
+shards), so this wrapper mostly forwards; it keeps the reference surface
+(inner_opt, _dp_enable etc.) for script parity.
+"""
+from __future__ import annotations
+
+from ...optimizer.optimizer import Optimizer
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg=None, strategy=None):
+        self._inner_opt = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    @property
+    def inner_opt(self):
+        return self._inner_opt
+
+    def __getattr__(self, item):
+        return getattr(self._inner_opt, item)
+
+    def step(self):
+        self._inner_opt.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        return self._inner_opt.minimize(loss, startup_program, parameters, no_grad_set)
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner_opt.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner_opt.state_dict()
+
+    def set_state_dict(self, sd):
+        self._inner_opt.set_state_dict(sd)
+
+
+class HybridParallelGradScaler:
+    def __init__(self, scaler, hcg=None):
+        self._scaler = scaler
+
+    def __getattr__(self, item):
+        return getattr(self._scaler, item)

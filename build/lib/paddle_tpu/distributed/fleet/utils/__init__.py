@@ -1,0 +1,8 @@
+"""fleet.utils (ref: python/paddle/distributed/fleet/utils/) — recompute +
+hybrid-parallel helpers."""
+from .recompute import recompute, recompute_sequential  # noqa: F401
+from . import hybrid_parallel_util  # noqa: F401
+from .hybrid_parallel_util import (  # noqa: F401
+    broadcast_input_data, broadcast_mp_parameters, broadcast_dp_parameters,
+    broadcast_sharding_parameters, fused_allreduce_gradients,
+)

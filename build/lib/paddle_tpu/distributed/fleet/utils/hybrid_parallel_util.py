@@ -1,0 +1,53 @@
+"""Hybrid-parallel parameter/gradient sync helpers.
+
+Reference: `fleet/utils/hybrid_parallel_util.py` (broadcast_dp_parameters,
+fused_allreduce_gradients, ...).  Under SPMD/pjit these are no-ops or thin
+mesh-collective wrappers: XLA's partitioner inserts the gradient all-reduces the
+reference did with EagerReducer hooks, and parameter consistency across data-parallel
+replicas is a property of replicated NamedShardings rather than an explicit broadcast.
+The functions exist so reference-shaped training scripts run unchanged; eagerly they
+re-place tensors with the replicated sharding to force consistency.
+"""
+from __future__ import annotations
+
+from ...collective import ReduceOp, all_reduce, broadcast
+
+
+def broadcast_input_data(hcg, *inputs, **kwargs):
+    return inputs if len(inputs) != 1 else inputs[0]
+
+
+def _broadcast_params(model, group):
+    for _, p in model.named_parameters():
+        broadcast(p, src=0, group=group)
+
+
+def broadcast_mp_parameters(model, hcg):
+    _broadcast_params(model, hcg.get_model_parallel_group())
+
+
+def broadcast_dp_parameters(model, hcg):
+    _broadcast_params(model, hcg.get_data_parallel_group())
+
+
+def broadcast_sharding_parameters(model, hcg):
+    _broadcast_params(model, hcg.get_sharding_parallel_group())
+
+
+def fused_allreduce_gradients(parameter_list, hcg):
+    """Ref: fused_allreduce_gradients — dp-group grad allreduce.  The reference
+    (_apply_collective_grads_eager, hybrid_parallel_util.py:83) scales grads by
+    1/nranks before the allreduce, i.e. the contract is an AVERAGE over the dp
+    group; ReduceOp.AVG (lax.pmean in-trace) matches that."""
+    from ....tensor.tensor import Tensor
+
+    group = hcg.get_data_parallel_group() if hcg is not None else None
+    for p in parameter_list:
+        if getattr(p, "_grad", None) is not None:
+            out = all_reduce(Tensor(p._grad, stop_gradient=True),
+                             op=ReduceOp.AVG, group=group)
+            p._grad = out._value if isinstance(out, Tensor) else out
+
+
+def sharding_reduce_gradients(parameter_list, hcg):
+    fused_allreduce_gradients(parameter_list, hcg)

@@ -1,0 +1,147 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference: `python/paddle/distributed/fleet/utils/recompute.py` — a PyLayer whose
+forward runs under no_grad saving only inputs + RNG state, and whose backward re-runs
+the forward to rebuild activations before backprop.
+
+TPU-native: the recomputed region becomes ONE taped op whose primal is wrapped in
+`jax.checkpoint` (remat).  Eagerly this gives the same save-inputs-only semantics;
+under `to_static`/jit the XLA scheduler rematerializes the region in the backward
+pass, trading FLOPs for HBM exactly like the reference — but fused and overlapped by
+the compiler instead of a Python-driven re-forward.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+
+import jax
+
+from ....tensor.tensor import Tensor, apply_op
+from ....autograd import tape
+from ....framework import random as _random
+from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList
+
+
+def _collect_layers(function) -> list[Layer]:
+    """Find every Layer whose parameters `function` can reach: the function itself,
+    a bound method's owner, functools.partial payloads, and closure cells.  These
+    params must enter the checkpointed primal as differentiable inputs — anything
+    reached only as a closure constant would silently get no gradient."""
+    seen: dict[int, Layer] = {}
+
+    def visit(obj, depth=0):
+        if depth > 3:
+            return
+        if isinstance(obj, Layer):
+            seen.setdefault(id(obj), obj)
+            return
+        owner = getattr(obj, "__self__", None)
+        if isinstance(owner, Layer):
+            seen.setdefault(id(owner), owner)
+        if isinstance(obj, functools.partial):
+            visit(obj.func, depth + 1)
+            for a in obj.args:
+                visit(a, depth + 1)
+            for v in obj.keywords.values():
+                visit(v, depth + 1)
+        closure = getattr(obj, "__closure__", None)
+        if closure:
+            for cell in closure:
+                try:
+                    visit(cell.cell_contents, depth + 1)
+                except ValueError:
+                    pass
+        if isinstance(obj, (list, tuple)):
+            for it in obj:
+                visit(it, depth + 1)
+
+    visit(function)
+    return list(seen.values())
+
+
+def recompute(function, *args, preserve_rng_state: bool = True, use_reentrant: bool = True,
+              **kwargs):
+    """Run `function(*args)` but save only its inputs for backward; activations are
+    rebuilt (XLA remat) when gradients flow.  `function` may be an `nn.Layer`, a bound
+    method, a closure/partial over Layers (their parameters are discovered and
+    captured as differentiable inputs), or any pure callable of Tensors."""
+    layers = _collect_layers(function)
+    param_items = []   # (layer_idx, name, Parameter); dedup shared Parameter objects
+    buffer_state = []  # (layer_idx, {name: raw})
+    seen_params: set[int] = set()
+    for li, layer in enumerate(layers):
+        for k, p in layer.named_parameters():
+            if id(p) not in seen_params:
+                seen_params.add(id(p))
+                param_items.append((li, k, p))
+        buffer_state.append({k: b._value for k, b in layer.named_buffers()})
+
+    n_args = len(args)
+    key = _random.get_rng_key() if preserve_rng_state else None
+
+    def primal(*flat):
+        call_args = [
+            Tensor(v, stop_gradient=True) if isinstance(args[i], Tensor) else args[i]
+            for i, v in enumerate(flat[:n_args])
+        ]
+        per_layer: list[dict] = [{} for _ in layers]
+        for (li, k, _), v in zip(param_items, flat[n_args:]):
+            per_layer[li][k] = v
+        scope = _random.rng_key_scope(key) if key is not None else contextlib.nullcontext()
+        restores = []
+        with scope, tape.no_grad():
+            try:
+                for li, layer in enumerate(layers):
+                    restores.append(layer.bind_functional_state(per_layer[li],
+                                                                buffer_state[li]))
+                out = function(*call_args, **kwargs)
+            finally:
+                for r in reversed(restores):
+                    r()
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+        return out._value if isinstance(out, Tensor) else out
+
+    flat_inputs = (*args, *[p for _, _, p in param_items])
+    static = tuple(i for i, a in enumerate(flat_inputs)
+                   if not isinstance(a, Tensor) and not hasattr(a, "shape"))
+    return apply_op(jax.checkpoint(primal, static_argnums=static), flat_inputs,
+                    name="recompute")
+
+
+class _Chunk(Layer):
+    """A registered container for one recomputed segment (params discoverable by
+    `_collect_layers` via the Layer itself)."""
+
+    def __init__(self, layers):
+        super().__init__()
+        self.segs = LayerList(layers)
+
+    def forward(self, *xs):
+        y = xs
+        for l in self.segs:
+            y = l(*y) if isinstance(y, tuple) else l(y)
+            if not isinstance(y, tuple):
+                y = (y,)
+        return y[0] if len(y) == 1 else y
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Ref fleet/utils/recompute.py `recompute_sequential`: chunk a Sequential and
+    recompute each segment."""
+    segments = int(ctx.get("segments", 1)) if isinstance(ctx, dict) else int(ctx or 1)
+    if isinstance(functions, Layer):
+        layers = list(functions.children()) or [functions]
+    else:
+        layers = list(functions)
+    n = len(layers)
+    seg = max(1, n // max(1, segments))
+    out = args
+    for start in range(0, n, seg):
+        chunk = _Chunk(layers[start:start + seg])
+        out = recompute(chunk, *out, **kwargs)
+        if not isinstance(out, tuple):
+            out = (out,)
+    return out[0] if len(out) == 1 else out

@@ -1,0 +1,8 @@
+"""`python -m paddle_tpu.distributed.launch` (ref: launch/main.py:18).
+
+On TPU the launch topology is one process per HOST (all local chips belong to one
+process and jax.distributed coordinates hosts), unlike the reference's
+one-process-per-GPU — `--nproc_per_node` therefore defaults to 1 and is honored only
+for CPU-simulation runs.
+"""
+from .main import launch, parse_args  # noqa: F401

@@ -1,0 +1,227 @@
+"""Launcher CLI (ref: python/paddle/distributed/launch/main.py:18 + controllers/
+collective.py:87-97 which sets PADDLE_MASTER / PADDLE_TRAINER_ID /
+PADDLE_TRAINER_ENDPOINTS for every spawned trainer).
+
+The CollectiveController spawns `nproc_per_node` local trainer processes with the
+reference env contract plus JAX multi-host env (coordinator address/process id), logs
+each rank to `--log_dir`, watches exits (ref controllers/watcher.py) and restarts
+failed ranks up to `--max_restart` times (elastic level >= 1).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+import zlib
+
+
+def _detect_host(master_host: str) -> str:
+    """Local address as seen on the route toward the master (no traffic sent)."""
+    import socket
+
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        s.connect((master_host, 9))
+        return s.getsockname()[0]
+    except OSError:
+        return "127.0.0.1"
+    finally:
+        s.close()
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch",
+                                description="TPU distributed launcher")
+    p.add_argument("--master", default=None,
+                   help="rendezvous server host:port (jax coordinator)")
+    p.add_argument("--rank", type=int, default=-1, help="node rank (-1: auto)")
+    p.add_argument("--nnodes", default="1", help="number of nodes, or MIN:MAX for elastic")
+    p.add_argument("--nproc_per_node", type=int, default=1)
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--log_level", default="INFO")
+    p.add_argument("--run_mode", default="collective", choices=["collective"])
+    p.add_argument("--job_id", default="default")
+    p.add_argument("--devices", default=None, help="visible device ids, e.g. 0,1,2,3")
+    p.add_argument("--max_restart", type=int, default=3)
+    p.add_argument("--elastic_level", type=int, default=-1)
+    p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("training_script")
+    p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return p.parse_args(argv)
+
+
+class CollectiveController:
+    """Ref controllers/collective.py — build env per rank, spawn, watch."""
+
+    def __init__(self, args):
+        self.args = args
+        self.procs: list[subprocess.Popen] = []
+        self.restarts = 0
+        self._host_list = None
+        self._rdzv_rank = None
+        nn = str(args.nnodes)
+        self.min_nodes = int(nn.split(":")[0])
+        self.max_nodes = int(nn.split(":")[-1])
+
+    def _endpoints(self, n):
+        # deterministic port base: hash() is randomized per process (PYTHONHASHSEED),
+        # which would give every launcher invocation/node a different endpoint list
+        # for the same job_id; crc32 is stable across processes and hosts
+        base = 61000 + (zlib.crc32(self.args.job_id.encode()) % 1000)
+        nproc = self.args.nproc_per_node
+        hosts = self._hosts()
+        # ports stay globally unique so multi-node-on-localhost tests don't collide
+        return ",".join(f"{hosts[min(i // nproc, len(hosts) - 1)]}:{base + i}"
+                        for i in range(n))
+
+    def _multi_node(self):
+        return self.max_nodes > 1 and self.args.master
+
+    def _hosts(self):
+        """One agreed host list, one entry per node (see _rendezvous).
+        Single-node: loopback."""
+        if self._multi_node():
+            self._rendezvous()
+            return self._host_list
+        n_nodes = min(max(self.min_nodes, max(self.args.rank, 0) + 1), self.max_nodes)
+        return ["127.0.0.1"] * max(n_nodes, 1)
+
+    def node_rank(self):
+        if self._multi_node():
+            self._rendezvous()
+            return self._rdzv_rank
+        return max(self.args.rank, 0)
+
+    def _rendezvous(self):
+        """Agree on (node_rank, host list) across all launchers (ref: the KV
+        rendezvous in launch/controllers/master.py).
+
+        Mastership: explicit --rank 0 hosts the store; --rank>0 connects; with
+        --rank -1 (auto) the node that wins the bind race on the master port hosts
+        it.  Auto ranks come from an atomic counter; node 0 then publishes the
+        final host list under {job}/world so every node sees the SAME world size
+        and endpoints (late joiners beyond that list get a clear error)."""
+        if self._host_list is not None:
+            return
+        from ..store import TCPStore
+
+        a = self.args
+        master_host, master_port = a.master.rsplit(":", 1)
+        local = os.environ.get("PADDLE_LOCAL_HOST") or _detect_host(master_host)
+        if a.rank == 0:
+            store = TCPStore(master_host, int(master_port), is_master=True)
+        elif a.rank > 0:
+            store = TCPStore(master_host, int(master_port), is_master=False)
+        else:
+            try:
+                store = TCPStore(master_host, int(master_port), is_master=True,
+                                 use_native=False)
+            except OSError:
+                store = TCPStore(master_host, int(master_port), is_master=False)
+        node_rank = a.rank if a.rank >= 0 else store.add(f"{a.job_id}/nrank", 1) - 1
+        store.set(f"{a.job_id}/host/{node_rank}", local.encode())
+        if node_rank == 0:
+            # barrier on the minimum quorum, then fold in any extra early joiners
+            hosts = [store.get(f"{a.job_id}/host/{r}").decode()
+                     for r in range(self.min_nodes)]
+            if a.rank < 0:
+                n_reg = store.add(f"{a.job_id}/nrank", 0)
+            else:
+                # explicit ranks: count contiguously registered hosts above the
+                # quorum so an initial gang of min..max nodes isn't sealed out
+                n_reg = self.min_nodes
+                while n_reg < self.max_nodes and \
+                        store.get_nb(f"{a.job_id}/host/{n_reg}") is not None:
+                    n_reg += 1
+            n_use = min(max(int(n_reg), self.min_nodes), self.max_nodes)
+            hosts += [store.get(f"{a.job_id}/host/{r}").decode()
+                      for r in range(self.min_nodes, n_use)]
+            store.set(f"{a.job_id}/world", ",".join(hosts).encode())
+        else:
+            hosts = store.get(f"{a.job_id}/world").decode().split(",")
+        if node_rank >= len(hosts):
+            raise RuntimeError(
+                f"node rank {node_rank} joined after the job world of "
+                f"{len(hosts)} nodes was sealed; scale-up of a running job goes "
+                "through fleet.elastic, not the launcher")
+        self._rdzv_rank = node_rank
+        self._host_list = hosts
+        self._store = store  # keep the master server thread alive
+
+    def build_env(self, local_rank: int) -> dict:
+        a = self.args
+        n = a.nproc_per_node
+        node_rank = self.node_rank()
+        global_rank = node_rank * n + local_rank
+        world = len(self._hosts()) * n
+        eps = self._endpoints(world)
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(global_rank),
+            "PADDLE_LOCAL_RANK": str(local_rank),
+            "PADDLE_TRAINERS_NUM": str(world),
+            "PADDLE_TRAINER_ENDPOINTS": eps,
+            "PADDLE_CURRENT_ENDPOINT": eps.split(",")[global_rank],
+            "PADDLE_JOB_ID": a.job_id,
+        })
+        if a.master:
+            env["PADDLE_MASTER"] = a.master
+        if a.devices is not None:
+            env["PADDLE_VISIBLE_DEVICES"] = a.devices
+        return env
+
+    def spawn_one(self, local_rank: int) -> subprocess.Popen:
+        a = self.args
+        os.makedirs(a.log_dir, exist_ok=True)
+        log_path = os.path.join(a.log_dir, f"workerlog.{local_rank}")
+        logf = open(log_path, "ab")
+        cmd = [sys.executable, a.training_script] + list(a.training_script_args)
+        return subprocess.Popen(cmd, env=self.build_env(local_rank),
+                                stdout=logf, stderr=subprocess.STDOUT)
+
+    def start(self):
+        self.procs = [self.spawn_one(i) for i in range(self.args.nproc_per_node)]
+
+    def watch(self) -> int:
+        """Ref controllers/watcher.py: poll children; on failure either restart the
+        failed ranks (elastic_level >= 1, up to max_restart) or tear down."""
+        while True:
+            time.sleep(0.5)
+            states = [p.poll() for p in self.procs]
+            if all(s == 0 for s in states):
+                return 0
+            failed = [i for i, s in enumerate(states) if s not in (None, 0)]
+            if failed:
+                if self.args.elastic_level >= 1 and self.restarts < self.args.max_restart:
+                    self.restarts += 1
+                    for i in failed:
+                        self.procs[i] = self.spawn_one(i)
+                    continue
+                self.stop()
+                return next(s for s in states if s not in (None, 0))
+
+    def stop(self):
+        for p in self.procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + 10
+        for p in self.procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def launch(argv=None):
+    args = parse_args(argv)
+    ctl = CollectiveController(args)
+    ctl.start()
+    try:
+        rc = ctl.watch()
+    except KeyboardInterrupt:
+        ctl.stop()
+        rc = 130
+    sys.exit(rc)

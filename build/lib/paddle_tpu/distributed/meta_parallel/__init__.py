@@ -1,0 +1,8 @@
+"""meta_parallel (ref: fleet/meta_parallel/) — TP/PP/sharded wrappers."""
+from . import mp_layers  # noqa: F401
+from .mp_layers import (  # noqa: F401
+    VocabParallelEmbedding, ColumnParallelLinear, RowParallelLinear,
+    ParallelCrossEntropy, get_rng_state_tracker, model_parallel_random_seed,
+)
+from .tensor_parallel import TensorParallel  # noqa: F401
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer  # noqa: F401

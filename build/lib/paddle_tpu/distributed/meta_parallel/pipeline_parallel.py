@@ -1,0 +1,52 @@
+"""Pipeline parallel runtime (ref: fleet/meta_parallel/pipeline_parallel.py:31,82 —
+host-driven 1F1B over NCCL p2p, p2p_communication.py:232).
+
+TPU-native: the schedule is COMPILED, not Python-driven.  `pipeline_train_step` builds
+one XLA program that scans microbatches through the stage dimension with
+`shard_map` over the 'pp' mesh axis + `ppermute` for stage-to-stage transfer
+(GPipe-style fill/drain schedule; same bubble as 1F1B, weights kept resident).  The
+PipelineParallel wrapper keeps the reference's `train_batch()` API.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...nn.layer.layers import Layer
+from ...tensor.tensor import Tensor
+from ...autograd import tape
+from ...framework import random as _random
+from ..sharding_ctx import mesh_scope
+
+
+class PipelineParallel(Layer):
+    """train_batch(data, optimizer) parity wrapper (ref pipeline_parallel.py:154)."""
+
+    def __init__(self, layers, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        self._strategy = strategy
+        cfg = strategy.pipeline_configs if strategy is not None else {}
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        self.micro_batch_size = cfg.get("micro_batch_size", 1)
+        self._step = None
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        from .pipeline_schedule import PipelineTrainStep
+
+        if self._step is None:
+            loss_fn = getattr(self._layers, "_loss_fn", None)
+            self._step = PipelineTrainStep(
+                self._layers, loss_fn, getattr(optimizer, "inner_opt", optimizer),
+                self._hcg.mesh, n_microbatch=self.accumulate_steps,
+            )
+        x, y = data
+        return self._step(x, y)

@@ -1,0 +1,495 @@
+"""Compiled pipeline-parallel training step.
+
+Reference analog: `PipelineParallel.forward_backward_pipeline` (ref
+fleet/meta_parallel/pipeline_parallel.py:82) — a HOST-DRIVEN 1F1B loop issuing NCCL
+p2p sends/recvs per microbatch (p2p_communication.py:232).
+
+TPU-native: the whole schedule is ONE XLA program.  `jax.shard_map` is manual only
+over the 'pp' mesh axis; stage-to-stage transfer is `lax.ppermute` and the
+fill/steady/drain schedule is a `lax.scan` over ticks.  Autodiff through the scan +
+ppermute yields the reverse (backward) pipeline automatically — the transpose of a
+ppermute is the reverse ppermute, so XLA schedules forward and backward waves without
+any Python in the loop.  All other mesh axes (dp/sharding/mp) stay "auto": the SPMD
+partitioner shards the batch and inserts dp gradient all-reduces around the manual
+pp core, which is how dp×pp composition falls out for free.
+
+Stage partitioning: the layer list is split into
+  prologue  — leading layers that change the activation shape (e.g. embedding);
+              run on ALL microbatches before the pipeline (cheap, one fused kernel);
+  body      — the maximal shape-preserving run of layers (transformer blocks);
+              split contiguously into `pp` stages, dispatched by `lax.switch` on
+              the device's stage index;
+  epilogue  — trailing shape-changing layers (final norm / lm head) + loss, folded
+              into the LAST stage so the carried activation keeps one shape.
+
+Correctness of bubble ticks: stage k's tick t computes microbatch (t-k), which is
+out-of-range during fill/drain; those values are real-but-unused (clamped indices on
+finite inputs, zero-init carry), and the last stage masks their loss with a `where`,
+so neither the loss nor its gradient sees them.
+
+Memory layout (v2): when the body chunks are HOMOGENEOUS (every stage runs the same
+layer structure — true for L % pp == 0 transformer stacks), each body parameter is
+stacked across stages into one [pp, ...] array sharded over the 'pp' mesh axis
+(NamedSharding P('pp')), so per-device body-parameter bytes = total/pp — the memory
+contract of the reference's 1F1B pipeline (ref fleet/meta_parallel/
+pipeline_parallel.py:82) without its host-driven p2p loop.  Every device then runs
+the SAME stage program with its own weight slice (no lax.switch), and the per-tick
+work is wrapped in jax.checkpoint so peak activation memory scales with the
+microbatch count × carry size (the 1F1B memory shape), not batch × depth.
+Non-homogeneous models fall back to the v1 replicated layout.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...tensor.tensor import Tensor
+from ...autograd import tape
+from ...framework import random as _random
+
+
+def _apply_item(pair, t):
+    layer, ffunc = pair
+    if ffunc == "__callable__":
+        return layer(t)
+    if ffunc is not None:
+        return ffunc(layer, t)
+    return layer(t)
+
+
+class PipelineTrainStep:
+    """One-program GPipe schedule over the 'pp' mesh axis.
+
+    step = PipelineTrainStep(pipeline_layer, loss_fn, optimizer, mesh, n_microbatch)
+    loss = step(x, y)
+    """
+
+    def __init__(self, layers, loss_fn, optimizer, mesh, n_microbatch: int,
+                 donate: bool = True, remat: bool = True):
+        if "pp" not in mesh.axis_names:
+            raise ValueError("mesh has no 'pp' axis")
+        self.model = layers
+        self.loss_fn = loss_fn if loss_fn is not None else getattr(layers, "_loss_fn", None)
+        if self.loss_fn is None:
+            raise ValueError("pipeline needs a loss_fn (PipelineLayer(loss_fn=...))")
+        self.optimizer = optimizer
+        self.mesh = mesh
+        self.n_stages = mesh.shape["pp"]
+        self.n_microbatch = max(int(n_microbatch), self.n_stages)
+        self._donate = donate
+        self._remat = remat
+        self._jitted = None
+        self._opt_state = None
+        self._stacked = None       # {rel: [pp, ...] array} when homogeneous
+        self._stack_info = None    # per-stage [(rel, flat_name)] lists
+        self.stacked_mode = False
+
+    # ------------------------------------------------------------------ probing
+    def _probe_shapes(self, params, buffers, x_mb):
+        """Per-item output ShapeDtypeStructs for one microbatch-shaped input."""
+        items = self.model.run_function
+        model = self.model
+
+        def run(params, buffers, x):
+            restore = model.bind_functional_state(params, buffers)
+            try:
+                with tape.no_grad():
+                    t = Tensor(x, stop_gradient=True)
+                    outs = []
+                    for item in items:
+                        t = _apply_item(item, t)
+                        outs.append(t._value)
+            finally:
+                restore()
+            return outs
+
+        return jax.eval_shape(run, params, buffers,
+                              jax.ShapeDtypeStruct(x_mb.shape, x_mb.dtype))
+
+    def _partition(self, in_shape, out_shapes):
+        """prologue / body(chunked into stages) / epilogue item index ranges."""
+        n = len(out_shapes)
+        ins = [in_shape] + [(s.shape, s.dtype) for s in out_shapes[:-1]]
+        outs = [(s.shape, s.dtype) for s in out_shapes]
+        preserve = [ins[i] == outs[i] for i in range(n)]
+        body_end = -1
+        for i in range(n - 1, -1, -1):
+            if preserve[i]:
+                body_end = i
+                break
+        if body_end < 0:
+            raise ValueError("no shape-preserving layers to pipeline")
+        body_start = body_end
+        while body_start > 0 and preserve[body_start - 1]:
+            body_start -= 1
+        body = list(range(body_start, body_end + 1))
+        if len(body) < self.n_stages:
+            raise ValueError(
+                f"{len(body)} pipelineable layers < {self.n_stages} pipeline stages")
+        chunks = [list(c) for c in np.array_split(body, self.n_stages)]
+        return list(range(body_start)), chunks, list(range(body_end + 1, n))
+
+    # ---------------------------------------------------------------- stacking
+    def _try_stack_info(self, chunks, items, named):
+        """Per-stage [(rel_name, flat_name)] if every stage chunk has the same layer
+        structure (param names, shapes, dtypes, trainability) and no buffers."""
+        id2flat = {id(p): k for k, p in named.items()}
+        per_stage = []
+        for c in chunks:
+            plist = []
+            for j, i in enumerate(c):
+                layer = items[i][0]
+                if not callable(layer) or not hasattr(layer, "named_parameters"):
+                    return None
+                if list(layer.named_buffers()):
+                    return None  # stateful body layers: fall back to replicated
+                for pn, p in layer.named_parameters():
+                    if id(p) not in id2flat:
+                        return None
+                    plist.append((f"{j}.{pn}", id2flat[id(p)]))
+            per_stage.append(plist)
+        all_flats = [f for plist in per_stage for _, f in plist]
+        if len(set(all_flats)) != len(all_flats):
+            return None  # a parameter is shared across stages (tied weights):
+            # stacking would un-tie it; keep the replicated path
+        rels0 = [r for r, _ in per_stage[0]]
+        for plist in per_stage[1:]:
+            if [r for r, _ in plist] != rels0:
+                return None
+        for i in range(len(rels0)):
+            p0 = named[per_stage[0][i][1]]
+            for plist in per_stage[1:]:
+                p = named[plist[i][1]]
+                if (p._value.shape != p0._value.shape
+                        or p._value.dtype != p0._value.dtype
+                        or p.stop_gradient != p0.stop_gradient):
+                    return None
+            if p0.stop_gradient:
+                return None  # frozen body params unsupported in stacked mode
+        return per_stage
+
+    def sync_model(self):
+        """Write the stacked [pp, ...] body weights back into the model's Tensors
+        (needed before state_dict()/save; the train loop itself never unstacks)."""
+        if not self.stacked_mode or self._stacked is None:
+            return
+        named = dict(self.model.named_parameters())
+        for idx, (rel, _) in enumerate(self._stack_info[0]):
+            full = np.asarray(self._stacked[rel])
+            for s, plist in enumerate(self._stack_info):
+                named[plist[idx][1]]._rebind(jnp.asarray(full[s]))
+
+    # ------------------------------------------------------------------ build
+    def _init(self, x, y):
+        model = self.model
+        mesh = self.mesh
+        S = self.n_stages
+        M = self.n_microbatch
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        items = model.run_function
+
+        B = x.shape[0]
+        if B % M != 0:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        mb = B // M
+        x_mb1 = jax.ShapeDtypeStruct((mb,) + x.shape[1:], x.dtype)
+
+        params, buffers = model.functional_state()
+        out_shapes = self._probe_shapes(params, buffers, x_mb1)
+        prologue, chunks, epilogue = self._partition((x_mb1.shape, x_mb1.dtype), out_shapes)
+        hid = out_shapes[chunks[-1][-1]]  # [mb, *hidden]
+
+        named = dict(model.named_parameters())
+        self._stack_info = self._try_stack_info(chunks, items, named)
+        if self._stack_info is not None:
+            return self._init_stacked(items, prologue, chunks, epilogue, hid,
+                                      named, mb, M, S)
+        trainable = {k for k, p in named.items() if not p.stop_gradient}
+        self._opt_state = {k: opt._init_state(named[k]) for k in trainable}
+
+        # params + opt state replicated over the mesh (v1 fallback; see docstring)
+        rep = NamedSharding(mesh, P())
+        for k, p in named.items():
+            p._rebind(jax.device_put(p._value, rep))
+        for k, b in model.named_buffers():
+            b._rebind(jax.device_put(b._value, rep))
+        self._opt_state = jax.device_put(self._opt_state, rep)
+
+        # batch sharded over the data axes (auto axes of the shard_map)
+        data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names
+                          and mesh.shape[a] > 1)
+        self._batch_sharding = NamedSharding(mesh, P(data_axes if data_axes else None))
+
+        T = M + S - 1
+
+        def pipeline_loss(allp, buffers, xv, yv, key):
+            """Runs on every device; manual over 'pp' only."""
+            restore = model.bind_functional_state(allp, buffers)
+            try:
+                with _random.rng_key_scope(key), tape.no_grad():
+                    # prologue on all microbatches at once
+                    t = Tensor(xv, stop_gradient=True)
+                    for i in prologue:
+                        t = _apply_item(items[i], t)
+                    emb = t._value
+                    emb = emb.reshape((M, emb.shape[0] // M) + emb.shape[1:])
+                    y_mb = yv.reshape((M, yv.shape[0] // M) + yv.shape[1:])
+                    stage = lax.axis_index("pp")
+
+                    def make_branch(k):
+                        chunk = chunks[k]
+
+                        def branch(x_in, t_idx):
+                            h = Tensor(x_in, stop_gradient=True)
+                            for i in chunk:
+                                h = _apply_item(items[i], h)
+                            if k == S - 1:
+                                e = h
+                                for i in epilogue:
+                                    e = _apply_item(items[i], e)
+                                mb_idx = jnp.clip(t_idx - (S - 1), 0, M - 1)
+                                lbl = lax.dynamic_index_in_dim(y_mb, mb_idx, 0,
+                                                               keepdims=False)
+                                lt = loss_fn(e, Tensor(lbl, stop_gradient=True))
+                                raw = (lt._value if isinstance(lt, Tensor) else lt)
+                                raw = raw.astype(jnp.float32)
+                                l = jnp.where(t_idx >= S - 1, raw, 0.0)
+                            else:
+                                l = jnp.zeros((), jnp.float32)
+                            return h._value, l
+                        return branch
+
+                    branches = [make_branch(k) for k in range(S)]
+                    perm = [(i, (i + 1) % S) for i in range(S)]
+                    buf0 = jnp.zeros((emb.shape[1],) + hid.shape[1:], hid.dtype)
+
+                    def tick(carry, t_idx):
+                        buf, loss_acc = carry
+                        inj = lax.dynamic_index_in_dim(
+                            emb, jnp.clip(t_idx, 0, M - 1), 0, keepdims=False)
+                        x_in = jnp.where(stage == 0, inj.astype(buf.dtype), buf)
+                        h, l = lax.switch(stage, branches, x_in, t_idx)
+                        nxt = lax.ppermute(h, "pp", perm)
+                        return (nxt, loss_acc + l), None
+
+                    (_, loss_acc), _ = lax.scan(tick, (buf0, jnp.zeros((), jnp.float32)),
+                                                jnp.arange(T))
+                    loss = lax.psum(loss_acc, "pp") / M
+            finally:
+                restore()
+            return loss
+
+        sharded_loss = jax.shard_map(
+            pipeline_loss, mesh=mesh,
+            in_specs=(P(), P(), P(), P(), P()),
+            out_specs=P(),
+            axis_names={"pp"},
+            check_vma=False,
+        )
+
+        def step(params, buffers, opt_state, lr, key, xv, yv):
+            t_params = {k: v for k, v in params.items() if k in trainable}
+            frozen = {k: v for k, v in params.items() if k not in trainable}
+
+            def pure_loss(tp):
+                return sharded_loss({**tp, **frozen}, buffers, xv, yv, key)
+
+            loss, grads = jax.value_and_grad(pure_loss)(t_params)
+            clipped = opt._clipped_grads(list(grads.items()))
+            new_params = dict(frozen)
+            new_opt = {}
+            for k, g in clipped:
+                new_params[k], new_opt[k] = opt._apply_update(
+                    params[k], g, opt_state[k], lr, opt._param_decay_coeff(named[k]))
+            return new_params, new_opt, loss
+
+        donate = (0, 2) if self._donate else ()
+        self._jitted = jax.jit(step, donate_argnums=donate)
+
+    def _init_stacked(self, items, prologue, chunks, epilogue, hid, named, mb, M, S):
+        """v2: homogeneous stages — body weights stacked [pp, ...], sharded P('pp')."""
+        model = self.model
+        mesh = self.mesh
+        loss_fn = self.loss_fn
+        opt = self.optimizer
+        remat = self._remat
+        self.stacked_mode = True
+        info = self._stack_info
+        flat0 = {rel: flat for rel, flat in info[0]}   # template (chunk-0) names
+        body_flats = {flat for plist in info for _, flat in plist}
+
+        pp_shard = NamedSharding(mesh, P("pp"))
+        rep = NamedSharding(mesh, P())
+        stacked = {}
+        for idx, (rel, _) in enumerate(info[0]):
+            # stack on host, then place sharded: the full [pp, ...] array never
+            # materializes in one device's HBM
+            arrs = [np.asarray(named[info[s][idx][1]]._value) for s in range(S)]
+            stacked[rel] = jax.device_put(np.stack(arrs), pp_shard)
+            # free the originals: rebind each stage's Tensor to its host copy so
+            # device 0 doesn't keep the full body-param set alive alongside the
+            # stacked shards (sync_model restores device arrays on demand)
+            for s in range(S):
+                named[info[s][idx][1]]._rebind(arrs[s])
+        self._stacked = stacked
+
+        rep_keys = [k for k in named if k not in body_flats]
+        trainable = {k for k in rep_keys if not named[k].stop_gradient}
+        for k in rep_keys:
+            named[k]._rebind(jax.device_put(named[k]._value, rep))
+        for _, b in model.named_buffers():
+            b._rebind(jax.device_put(b._value, rep))
+
+        class _Shim:  # _init_state only reads ._value
+            def __init__(self, v):
+                self._value = v
+
+        def _place_stacked_state(state):
+            # moments share the stacked [pp, ...] shape -> shard over pp; 0-d
+            # leaves (Adam beta1_pow/beta2_pow etc.) must stay replicated
+            return jax.tree.map(
+                lambda leaf: jax.device_put(
+                    leaf, pp_shard if getattr(leaf, "ndim", 0) >= 1
+                    and leaf.shape[0] == S else rep),
+                state)
+
+        self._opt_state = {
+            **{k: jax.device_put(opt._init_state(named[k]), rep) for k in trainable},
+            **{"·stack·" + rel: _place_stacked_state(opt._init_state(_Shim(v)))
+               for rel, v in stacked.items()},
+        }
+
+        data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names
+                          and mesh.shape[a] > 1)
+        self._batch_sharding = NamedSharding(mesh, P(data_axes if data_axes else None))
+
+        T = M + S - 1
+        body = chunks[0]  # every stage runs the template chunk's program
+
+        def pipeline_loss(rep_params, stk, buffers, xv, yv, key):
+            local = {flat0[rel]: v[0] for rel, v in stk.items()}  # local [1,...] slice
+            restore = model.bind_functional_state({**rep_params, **local}, buffers)
+            try:
+                with _random.rng_key_scope(key), tape.no_grad():
+                    t = Tensor(xv, stop_gradient=True)
+                    for i in prologue:
+                        t = _apply_item(items[i], t)
+                    emb = t._value
+                    emb = emb.reshape((M, emb.shape[0] // M) + emb.shape[1:])
+                    y_mb = yv.reshape((M, yv.shape[0] // M) + yv.shape[1:])
+                    stage = lax.axis_index("pp")
+
+                    def run_tick(x_in, t_idx):
+                        h = Tensor(x_in, stop_gradient=True)
+                        for i in body:
+                            h = _apply_item(items[i], h)
+                        hv = h._value
+
+                        def last_fn(ev):
+                            e = Tensor(ev, stop_gradient=True)
+                            for i in epilogue:
+                                e = _apply_item(items[i], e)
+                            mb_idx = jnp.clip(t_idx - (S - 1), 0, M - 1)
+                            lbl = lax.dynamic_index_in_dim(y_mb, mb_idx, 0,
+                                                           keepdims=False)
+                            lt = loss_fn(e, Tensor(lbl, stop_gradient=True))
+                            raw = (lt._value if isinstance(lt, Tensor) else lt)
+                            return jnp.where(t_idx >= S - 1,
+                                             raw.astype(jnp.float32), 0.0)
+
+                        l = lax.cond(stage == S - 1, last_fn,
+                                     lambda ev: jnp.zeros((), jnp.float32), hv)
+                        return hv, l
+
+                    tick_body = jax.checkpoint(run_tick) if remat else run_tick
+                    perm = [(i, (i + 1) % S) for i in range(S)]
+                    buf0 = jnp.zeros((emb.shape[1],) + hid.shape[1:], hid.dtype)
+
+                    def tick(carry, t_idx):
+                        buf, loss_acc = carry
+                        inj = lax.dynamic_index_in_dim(
+                            emb, jnp.clip(t_idx, 0, M - 1), 0, keepdims=False)
+                        x_in = jnp.where(stage == 0, inj.astype(buf.dtype), buf)
+                        h, l = tick_body(x_in, t_idx)
+                        nxt = lax.ppermute(h, "pp", perm)
+                        return (nxt, loss_acc + l), None
+
+                    (_, loss_acc), _ = lax.scan(
+                        tick, (buf0, jnp.zeros((), jnp.float32)), jnp.arange(T))
+                    loss = lax.psum(loss_acc, "pp") / M
+            finally:
+                restore()
+            return loss
+
+        sharded_loss = jax.shard_map(
+            pipeline_loss, mesh=mesh,
+            in_specs=(P(), P("pp"), P(), P(), P(), P()),
+            out_specs=P(),
+            axis_names={"pp"},
+            check_vma=False,
+        )
+
+        def step(rep_params, stk, buffers, opt_state, lr, key, xv, yv):
+            t_rep = {k: v for k, v in rep_params.items() if k in trainable}
+            frozen = {k: v for k, v in rep_params.items() if k not in trainable}
+
+            def pure_loss(tp, tstk):
+                return sharded_loss({**tp, **frozen}, tstk, buffers, xv, yv, key)
+
+            loss, (g_rep, g_stk) = jax.value_and_grad(pure_loss, argnums=(0, 1))(
+                t_rep, stk)
+            pairs = list(g_rep.items()) + [("·stack·" + rel, g)
+                                           for rel, g in g_stk.items()]
+            clipped = dict(opt._clipped_grads(pairs))
+            new_rep = dict(frozen)
+            new_stk = {}
+            new_opt = {}
+            for k in trainable:
+                new_rep[k], new_opt[k] = opt._apply_update(
+                    rep_params[k], clipped[k], opt_state[k], lr,
+                    opt._param_decay_coeff(named[k]))
+            for rel in stk:
+                sk = "·stack·" + rel
+                new_stk[rel], new_opt[sk] = opt._apply_update(
+                    stk[rel], clipped[sk], opt_state[sk], lr,
+                    opt._param_decay_coeff(named[flat0[rel]]))
+            return new_rep, new_stk, new_opt, loss
+
+        donate = (0, 1, 3) if self._donate else ()
+        self._jitted = jax.jit(step, donate_argnums=donate)
+        # any external state read (state_dict / functional_state / checkpoint save)
+        # transparently writes the trained stacked weights back first
+        model._pre_state_hook = self.sync_model
+
+    # ------------------------------------------------------------------ call
+    def __call__(self, x, y):
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        if self._jitted is None:
+            self._init(xv, yv)
+        xv = jax.device_put(xv, self._batch_sharding)
+        yv = jax.device_put(yv, self._batch_sharding)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _random.get_rng_key()
+        if self.stacked_mode:
+            params, buffers = self.model.functional_state(_sync=False)
+            rep_params = {k: v for k, v in params.items()
+                          if k not in {f for pl in self._stack_info for _, f in pl}}
+            new_rep, new_stk, new_opt, loss = self._jitted(
+                rep_params, self._stacked, buffers, self._opt_state, lr, key, xv, yv)
+            self._stacked = new_stk
+            self._opt_state = new_opt
+            self.model.load_functional_state(new_rep)
+        else:
+            params, buffers = self.model.functional_state()
+            new_params, new_opt, loss = self._jitted(
+                params, buffers, self._opt_state, lr, key, xv, yv)
+            self._opt_state = new_opt
+            self.model.load_functional_state(new_params)
+        self.optimizer._step_count += 1
+        return Tensor(loss)

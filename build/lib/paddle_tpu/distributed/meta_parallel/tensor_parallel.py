@@ -1,0 +1,25 @@
+"""TensorParallel wrapper (ref: fleet/meta_parallel/tensor_parallel.py:25).
+
+The reference broadcasts params within the mp group and syncs; with SPMD shardings
+parameter placement is handled by ShardedTrainStep from the layer annotations, so this
+wrapper is transparent at forward time.
+"""
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+
+
+class TensorParallel(Layer):
+    def __init__(self, layers, hcg=None, strategy=None, **kwargs):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, sd, *args, **kwargs):
+        return self._layers.set_state_dict(sd, *args, **kwargs)

@@ -1,0 +1,45 @@
+"""DataParallel (ref: python/paddle/fluid/dygraph/parallel.py:419 + EagerReducer
+distributed/collective/reducer.h:88).
+
+TPU-native: no gradient bucketing/fusing machinery — wrap the model so a jitted train
+step shards the batch over the mesh 'dp' axis with NamedSharding; the XLA SPMD
+partitioner inserts (and overlaps) the gradient all-reduce, which is exactly the job
+EagerReducer did by hand.  Eagerly (single process) it is transparent.
+"""
+from __future__ import annotations
+
+from ..nn.layer.layers import Layer
+from .env import init_parallel_env, get_rank, get_world_size, ParallelEnv  # noqa: F401
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25, last_comm_buffer_size=1,
+                 find_unused_parameters=False, group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self.group = group
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    # passthroughs so the wrapper is transparent (ref parallel.py state_dict fwd)
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    @property
+    def _inner_layers(self):
+        return self._layers
+
+
+def scale_loss(loss):
+    return loss

@@ -1,0 +1,44 @@
+"""group_sharded_parallel API (ref: python/paddle/distributed/sharding/group_sharded.py
+wrapping GroupShardedStage2/3 + GroupShardedOptimizerStage2).
+
+TPU-native: ZeRO is a sharding-rule decision, not a hook pipeline.  The requested
+stage is recorded on the model/optimizer and CONSUMED by the compiled step:
+`ShardedTrainStep` (and therefore `auto_parallel.Engine` / `fleet.distributed_model`
+paths built on it) picks the stage up when `zero_stage` isn't set explicitly, and
+shards optimizer state (stage 1/2) or parameters too (stage 3) over the 'sharding'
+mesh axis — XLA emits the reduce-scatter/all-gather the reference's GroupSharded
+hooks performed manually.
+
+The eager (non-compiled) loop has no sharding benefit on a single process; ZeRO
+takes effect on the ShardedTrainStep path only, which is where the reference's
+GroupSharded classes were used for real training too.
+"""
+from __future__ import annotations
+
+
+def group_sharded_parallel(model, optimizer, level, scaler=None, group=None,
+                           offload=False, sync_buffers=False, buffer_max_size=2**23,
+                           segment_size=2**20, sync_comm=False):
+    if level not in ("os", "os_g", "p_g_os"):
+        raise ValueError(
+            f"group_sharded_parallel level must be one of 'os' (ZeRO-1), "
+            f"'os_g' (ZeRO-2), 'p_g_os' (ZeRO-3); got {level!r}")
+    if offload:
+        raise NotImplementedError(
+            "offload=True (CPU offload of sharded state) is not supported on the "
+            "TPU build: XLA/PJRT manages device memory, use zero stage 3 "
+            "(level='p_g_os') or activation recompute to reduce footprint")
+    stage = {"os": 1, "os_g": 2, "p_g_os": 3}[level]
+    model._group_sharded_stage = stage
+    optimizer._group_sharded_stage = stage
+    if scaler is not None:
+        return model, optimizer, scaler
+    return model, optimizer
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    from ..framework.io import save
+
+    save(model.state_dict(), output + ".pdmodel.state")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

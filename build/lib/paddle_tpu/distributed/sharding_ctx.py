@@ -1,0 +1,62 @@
+"""Mesh/sharding context shared by distributed layers and train steps.
+
+The scaling-book recipe: pick a Mesh, annotate shardings on params/activations, let
+XLA's SPMD partitioner insert collectives.  Layers record a `sharding_spec` tuple on
+their Parameters (e.g. ColumnParallelLinear weight -> (None, 'mp')); ShardedTrainStep
+turns specs into NamedShardings.  `with_sharding_constraint` is a no-op outside a mesh
+context so the same layer code runs eagerly on one chip.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_current_mesh: list = []
+
+
+@contextlib.contextmanager
+def mesh_scope(mesh: Mesh):
+    _current_mesh.append(mesh)
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _current_mesh.pop()
+
+
+def current_mesh() -> Mesh | None:
+    if _current_mesh:
+        return _current_mesh[-1]
+    return None
+
+
+def constraint(x, *spec):
+    """Apply a sharding constraint if a mesh is active and x is traced."""
+    mesh = current_mesh()
+    if mesh is None or not isinstance(x, jax.core.Tracer):
+        return x
+    # drop axis names the mesh doesn't have (e.g. running tp code on a dp-only mesh)
+    clean = tuple(s if (s is None or _axes_in(mesh, s)) else None for s in spec)
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, P(*clean)))
+
+
+def _axes_in(mesh, s):
+    names = mesh.axis_names
+    if isinstance(s, (tuple, list)):
+        return all(n in names for n in s)
+    return s in names
+
+
+def param_sharding(mesh: Mesh, spec):
+    if spec is None:
+        return NamedSharding(mesh, P())
+    clean = tuple(s if (s is None or _axes_in(mesh, s)) else None for s in spec)
+    return NamedSharding(mesh, P(*clean))
+
+
+def annotate(param, *spec):
+    """Record the logical sharding of a Parameter (consumed by ShardedTrainStep)."""
+    param.sharding_spec = tuple(spec)
+    return param

@@ -1,0 +1,236 @@
+"""Rendezvous / control-plane KV store.
+
+Reference: C++ `TCPStore` (paddle/fluid/distributed/store/tcp_store.h:120, store.h:26)
+used by init_parallel_env for NCCL-id exchange.  On TPU the data plane needs no
+rendezvous (XLA collectives ride ICI, jax.distributed has its own coordinator), so
+this store serves the *control* plane only: elastic membership, barriers, and
+user-level coordination.  A C++ implementation (paddle_tpu/core/native) backs the same
+wire protocol when built; this pure-socket Python fallback is always available.
+
+Wire protocol (length-prefixed): 1-byte op (S/G/A/W/D), u32 key len, key bytes,
+u32 value len, value bytes.  GET on a missing key blocks until set (reference
+TCPStore::wait semantics).
+"""
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+
+class Store:
+    """Ref store.h:26 abstract Store."""
+
+    def set(self, key: str, value: bytes):
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def add(self, key: str, amount: int) -> int:
+        raise NotImplementedError
+
+    def wait(self, keys, timeout=None):
+        raise NotImplementedError
+
+
+class _KVServer(threading.Thread):
+    def __init__(self, port: int):
+        super().__init__(daemon=True)
+        self._data: dict[str, bytes] = {}
+        self._cond = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", port))
+        self.port = self._sock.getsockname()[1]
+        self._sock.listen(128)
+        self._running = True
+
+    def run(self):
+        while self._running:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                try:
+                    hdr = _recvn(conn, 5)
+                except ConnectionError:
+                    return
+                op = chr(hdr[0])
+                klen = struct.unpack("<I", hdr[1:5])[0]
+                key = _recvn(conn, klen).decode() if klen else ""
+                vlen = struct.unpack("<I", _recvn(conn, 4))[0]
+                val = _recvn(conn, vlen) if vlen else b""
+                # NOTE: every branch copies under the lock and sends OUTSIDE it —
+                # a stalled client must not wedge the whole store
+                if op == "S":
+                    with self._cond:
+                        self._data[key] = val
+                        self._cond.notify_all()
+                    _send_val(conn, b"ok")
+                elif op == "A":
+                    try:
+                        amt = int(val.decode())
+                        with self._cond:
+                            cur = int(self._data.get(key, b"0").decode() or 0)
+                            cur += amt
+                            self._data[key] = str(cur).encode()
+                            self._cond.notify_all()
+                        reply = str(cur).encode()
+                    except ValueError:
+                        reply = b"ERR non-integer value"
+                    _send_val(conn, reply)
+                elif op == "G":  # blocking get
+                    with self._cond:
+                        while key not in self._data and self._running:
+                            self._cond.wait(timeout=1.0)
+                        out = self._data.get(key)
+                    if out is None:
+                        return  # server stopping
+                    _send_val(conn, out)
+                elif op == "N":  # non-blocking get: presence flag + value
+                    with self._cond:
+                        out = self._data.get(key)
+                    _send_val(conn, b"0" if out is None else b"1" + out)
+                elif op == "W":  # non-blocking check
+                    with self._cond:
+                        present = key in self._data
+                    _send_val(conn, b"1" if present else b"0")
+                elif op == "D":
+                    with self._cond:
+                        self._data.pop(key, None)
+                    _send_val(conn, b"ok")
+                elif op == "L":  # list keys with prefix
+                    with self._cond:
+                        keys = [k for k in self._data if k.startswith(key)]
+                    _send_val(conn, "\n".join(keys).encode())
+                else:
+                    return
+        except (ConnectionError, OSError):
+            return
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._running = False
+        with self._cond:
+            self._cond.notify_all()  # release blocking-G waiters
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+def _recvn(conn, n):
+    """Read exactly n bytes or raise ConnectionError (EOF / short read)."""
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf += chunk
+    return buf
+
+
+def _send_val(conn, val: bytes):
+    conn.sendall(struct.pack("<I", len(val)) + val)
+
+
+class TCPStore(Store):
+    """Ref tcp_store.h:120 — host:port KV store; `is_master` runs the server."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0, is_master: bool = False,
+                 world_size: int = 1, timeout: float = 300.0, use_native: bool = True):
+        self._server = None
+        self.timeout = timeout
+        if is_master:
+            self._server = self._start_server(port, use_native)
+            port = self._server.port
+        self.host, self.port = host, port
+
+    @staticmethod
+    def _start_server(port: int, use_native: bool):
+        """Prefer the C++ server (core/native) — same wire protocol; fall back to the
+        Python thread server when the toolchain is unavailable."""
+        if use_native:
+            try:
+                from ..core.native import NativeKVServer
+
+                return NativeKVServer(port)
+            except Exception:
+                pass
+        srv = _KVServer(port)
+        srv.start()
+        return srv
+
+    def _rpc(self, op: str, key: str, value: bytes = b"") -> bytes:
+        deadline = time.time() + self.timeout
+        while True:
+            try:
+                with socket.create_connection((self.host, self.port), timeout=self.timeout) as s:
+                    kb = key.encode()
+                    s.sendall(op.encode() + struct.pack("<I", len(kb)) + kb
+                              + struct.pack("<I", len(value)) + value)
+                    vlen = struct.unpack("<I", _recvn(s, 4))[0]
+                    return _recvn(s, vlen) if vlen else b""
+            except (ConnectionError, OSError):
+                if time.time() > deadline:
+                    raise TimeoutError(f"TCPStore rpc {op} {key} timed out")
+                time.sleep(0.1)
+
+    def set(self, key, value):
+        if isinstance(value, str):
+            value = value.encode()
+        self._rpc("S", key, value)
+
+    def get(self, key) -> bytes:
+        return self._rpc("G", key)
+
+    def get_nb(self, key) -> bytes | None:
+        """Non-blocking get: None if the key is absent (op 'N')."""
+        out = self._rpc("N", key)
+        return out[1:] if out[:1] == b"1" else None
+
+    def add(self, key, amount: int) -> int:
+        out = self._rpc("A", key, str(amount).encode())
+        if out.startswith(b"ERR"):
+            raise ValueError(
+                f"TCPStore.add({key!r}): stored value is not an integer")
+        return int(out.decode())
+
+    def check(self, key) -> bool:
+        return self._rpc("W", key) == b"1"
+
+    def delete_key(self, key):
+        self._rpc("D", key)
+
+    def keys_with_prefix(self, prefix: str) -> list[str]:
+        out = self._rpc("L", prefix).decode()
+        return out.split("\n") if out else []
+
+    def wait(self, keys, timeout=None):
+        keys = [keys] if isinstance(keys, str) else list(keys)
+        deadline = time.time() + (timeout or self.timeout)
+        for k in keys:
+            while not self.check(k):
+                if time.time() > deadline:
+                    raise TimeoutError(f"TCPStore wait({k}) timed out")
+                time.sleep(0.05)
+
+    def barrier(self, name: str, world_size: int, timeout=None):
+        n = self.add(f"__barrier__/{name}", 1)
+        deadline = time.time() + (timeout or self.timeout)
+        while int(self._rpc("A", f"__barrier__/{name}", b"0").decode()) < world_size:
+            if time.time() > deadline:
+                raise TimeoutError(f"barrier {name} timed out ({n}/{world_size})")
+            time.sleep(0.05)
+
+    def close(self):
+        if self._server is not None:
+            self._server.stop()

@@ -1,0 +1,498 @@
+"""paddle.distribution parity (ref: python/paddle/distribution/ — the full
+15-file zoo: base + exponential family, Beta/Dirichlet/Multinomial/Laplace/
+Gumbel/LogNormal, Independent/TransformedDistribution wrappers, the transform
+library, and the register_kl multi-dispatch table)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, apply_op, _unwrap
+from ..framework import random as _random
+from .kl import register_kl, kl_divergence  # noqa: F401
+from .transform import (  # noqa: F401
+    Transform, AbsTransform, AffineTransform, ChainTransform, ExpTransform,
+    IndependentTransform, PowerTransform, ReshapeTransform, SigmoidTransform,
+    SoftmaxTransform, StackTransform, StickBreakingTransform, TanhTransform,
+)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return list(self._batch_shape)
+
+    @property
+    def event_shape(self):
+        return list(self._event_shape)
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        from ..tensor.math import exp
+
+        return exp(self.log_prob(value))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = loc if isinstance(loc, Tensor) else Tensor(jnp.asarray(loc, jnp.float32))
+        self.scale = scale if isinstance(scale, Tensor) else Tensor(jnp.asarray(scale, jnp.float32))
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        eps = jax.random.normal(_random.get_rng_key(), shape, jnp.float32)
+        return Tensor(eps * self.scale._value + self.loc._value)
+
+    def log_prob(self, value):
+        def _f(v, loc, scale):
+            var = scale * scale
+            return -((v - loc) ** 2) / (2 * var) - jnp.log(scale) - 0.5 * math.log(2 * math.pi)
+
+        return apply_op(_f, (value, self.loc, self.scale), name="normal_log_prob")
+
+    def entropy(self):
+        def _f(scale):
+            return 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(scale) + jnp.zeros(self._batch_shape)
+
+        return apply_op(_f, (self.scale,), name="normal_entropy")
+
+    def kl_divergence(self, other):
+        def _f(l1, s1, l2, s2):
+            vr = (s1 / s2) ** 2
+            t1 = ((l1 - l2) / s2) ** 2
+            return 0.5 * (vr + t1 - 1 - jnp.log(vr))
+
+        return apply_op(_f, (self.loc, self.scale, other.loc, other.scale), name="normal_kl")
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = low if isinstance(low, Tensor) else Tensor(jnp.asarray(low, jnp.float32))
+        self.high = high if isinstance(high, Tensor) else Tensor(jnp.asarray(high, jnp.float32))
+        super().__init__(tuple(np.broadcast_shapes(self.low.shape, self.high.shape)))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        u = jax.random.uniform(_random.get_rng_key(), shape, jnp.float32)
+        return Tensor(u * (self.high._value - self.low._value) + self.low._value)
+
+    def log_prob(self, value):
+        def _f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        return apply_op(_f, (value, self.low, self.high), name="uniform_log_prob")
+
+    def entropy(self):
+        return apply_op(lambda lo, hi: jnp.log(hi - lo), (self.low, self.high), name="uniform_entropy")
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = logits if isinstance(logits, Tensor) else Tensor(jnp.asarray(logits, jnp.float32))
+        super().__init__(tuple(self.logits.shape[:-1]))
+
+    def sample(self, shape=()):
+        n = int(np.prod(shape)) if shape else 1
+        out = jax.random.categorical(_random.get_rng_key(), self.logits._value,
+                                     shape=tuple(shape) + tuple(self._batch_shape))
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        def _f(logits, v):
+            logp = jax.nn.log_softmax(logits, -1)
+            return jnp.take_along_axis(logp, v.astype(jnp.int32)[..., None], -1)[..., 0]
+
+        return apply_op(_f, (self.logits, value), name="categorical_log_prob")
+
+    def probs(self, value=None):
+        from ..nn.functional import softmax
+
+        p = softmax(self.logits, axis=-1)
+        if value is None:
+            return p
+        from ..tensor.manipulation import take_along_axis
+
+        return take_along_axis(p, value.unsqueeze(-1), -1).squeeze(-1)
+
+    def entropy(self):
+        def _f(logits):
+            logp = jax.nn.log_softmax(logits, -1)
+            return -jnp.sum(jnp.exp(logp) * logp, -1)
+
+        return apply_op(_f, (self.logits,), name="categorical_entropy")
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = probs if isinstance(probs, Tensor) else Tensor(jnp.asarray(probs, jnp.float32))
+        super().__init__(tuple(self.probs_.shape))
+
+    def sample(self, shape=()):
+        out = jax.random.bernoulli(_random.get_rng_key(), self.probs_._value,
+                                   tuple(shape) + tuple(self._batch_shape))
+        return Tensor(out.astype(jnp.float32))
+
+    def log_prob(self, value):
+        def _f(p, v):
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+
+        return apply_op(_f, (self.probs_, value), name="bernoulli_log_prob")
+
+
+def _as_t(v):
+    return v if isinstance(v, Tensor) else Tensor(jnp.asarray(v, jnp.float32))
+
+
+class ExponentialFamily(Distribution):
+    """Ref exponential_family.py — base for Beta/Dirichlet/Gamma-style
+    families; entropy via the Bregman identity is replaced by per-family
+    closed forms (jax.grad makes the generic route possible but the closed
+    forms are exact and cheaper)."""
+
+
+class Beta(ExponentialFamily):
+    """Ref beta.py."""
+
+    def __init__(self, alpha, beta):
+        self.alpha = _as_t(alpha)
+        self.beta = _as_t(beta)
+        super().__init__(tuple(np.broadcast_shapes(self.alpha.shape, self.beta.shape)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        k1, k2 = jax.random.split(_random.get_rng_key())
+        ga = jax.random.gamma(k1, self.alpha._value, shape)
+        gb = jax.random.gamma(k2, self.beta._value, shape)
+        return Tensor(ga / (ga + gb))
+
+    def log_prob(self, value):
+        def _f(v, a, b):
+            lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v) - lbeta
+
+        return apply_op(_f, (value, self.alpha, self.beta), name="beta_log_prob")
+
+    def mean(self):
+        return apply_op(lambda a, b: a / (a + b), (self.alpha, self.beta), name="beta_mean")
+
+    def variance(self):
+        def _f(a, b):
+            s = a + b
+            return a * b / (s * s * (s + 1))
+
+        return apply_op(_f, (self.alpha, self.beta), name="beta_var")
+
+    def entropy(self):
+        def _f(a, b):
+            dg = jax.scipy.special.digamma
+            lbeta = (jax.scipy.special.gammaln(a) + jax.scipy.special.gammaln(b)
+                     - jax.scipy.special.gammaln(a + b))
+            return (lbeta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+
+        return apply_op(_f, (self.alpha, self.beta), name="beta_entropy")
+
+
+class Dirichlet(ExponentialFamily):
+    """Ref dirichlet.py."""
+
+    def __init__(self, concentration):
+        self.concentration = _as_t(concentration)
+        super().__init__(tuple(self.concentration.shape[:-1]),
+                         tuple(self.concentration.shape[-1:]))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape) + tuple(self._event_shape)
+        g = jax.random.gamma(_random.get_rng_key(), self.concentration._value, shape)
+        return Tensor(g / jnp.sum(g, -1, keepdims=True))
+
+    def log_prob(self, value):
+        def _f(v, c):
+            lognorm = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                       - jax.scipy.special.gammaln(jnp.sum(c, -1)))
+            return jnp.sum((c - 1) * jnp.log(v), -1) - lognorm
+
+        return apply_op(_f, (value, self.concentration), name="dirichlet_log_prob")
+
+    def mean(self):
+        return apply_op(lambda c: c / jnp.sum(c, -1, keepdims=True),
+                        (self.concentration,), name="dirichlet_mean")
+
+    def entropy(self):
+        def _f(c):
+            dg = jax.scipy.special.digamma
+            c0 = jnp.sum(c, -1)
+            k = c.shape[-1]
+            lognorm = (jnp.sum(jax.scipy.special.gammaln(c), -1)
+                       - jax.scipy.special.gammaln(c0))
+            return (lognorm + (c0 - k) * dg(c0)
+                    - jnp.sum((c - 1) * dg(c), -1))
+
+        return apply_op(_f, (self.concentration,), name="dirichlet_entropy")
+
+
+class Multinomial(Distribution):
+    """Ref multinomial.py: counts over `total_count` trials."""
+
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs = _as_t(probs)
+        super().__init__(tuple(self.probs.shape[:-1]), tuple(self.probs.shape[-1:]))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        logits = jnp.log(self.probs._value)
+        draws = jax.random.categorical(
+            _random.get_rng_key(), logits, axis=-1,
+            shape=(self.total_count,) + shape)
+        k = self.probs._value.shape[-1]
+        counts = jax.nn.one_hot(draws, k, dtype=jnp.float32).sum(0)
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        def _f(v, p):
+            gl = jax.scipy.special.gammaln
+            logcoef = gl(jnp.asarray(self.total_count + 1.0)) - jnp.sum(gl(v + 1.0), -1)
+            return logcoef + jnp.sum(v * jnp.log(p), -1)
+
+        return apply_op(_f, (value, self.probs), name="multinomial_log_prob")
+
+    def mean(self):
+        return apply_op(lambda p: self.total_count * p, (self.probs,),
+                        name="multinomial_mean")
+
+
+class Laplace(Distribution):
+    """Ref laplace.py."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        u = jax.random.uniform(_random.get_rng_key(), shape, jnp.float32,
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return Tensor(self.loc._value
+                      - self.scale._value * jnp.sign(u) * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        def _f(v, loc, sc):
+            return -jnp.abs(v - loc) / sc - jnp.log(2 * sc)
+
+        return apply_op(_f, (value, self.loc, self.scale), name="laplace_log_prob")
+
+    def entropy(self):
+        return apply_op(lambda sc: 1 + jnp.log(2 * sc), (self.scale,),
+                        name="laplace_entropy")
+
+
+class Gumbel(Distribution):
+    """Ref gumbel.py (reference implements it as TransformedDistribution;
+    closed forms are exact here)."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(self._batch_shape)
+        g = jax.random.gumbel(_random.get_rng_key(), shape, jnp.float32)
+        return Tensor(self.loc._value + self.scale._value * g)
+
+    def log_prob(self, value):
+        def _f(v, loc, sc):
+            z = (v - loc) / sc
+            return -(z + jnp.exp(-z)) - jnp.log(sc)
+
+        return apply_op(_f, (value, self.loc, self.scale), name="gumbel_log_prob")
+
+    def mean(self):
+        return apply_op(lambda loc, sc: loc + np.euler_gamma * sc,
+                        (self.loc, self.scale), name="gumbel_mean")
+
+    def entropy(self):
+        return apply_op(lambda sc: jnp.log(sc) + 1 + np.euler_gamma,
+                        (self.scale,), name="gumbel_entropy")
+
+
+class LogNormal(Distribution):
+    """Ref lognormal.py: exp(Normal(loc, scale))."""
+
+    def __init__(self, loc, scale):
+        self.loc = _as_t(loc)
+        self.scale = _as_t(scale)
+        self._base = Normal(self.loc, self.scale)
+        super().__init__(tuple(np.broadcast_shapes(self.loc.shape, self.scale.shape)))
+
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(self._base.sample(shape)._value))
+
+    def log_prob(self, value):
+        def _f(v, loc, sc):
+            logv = jnp.log(v)
+            var = sc * sc
+            return (-((logv - loc) ** 2) / (2 * var) - jnp.log(sc)
+                    - 0.5 * math.log(2 * math.pi) - logv)
+
+        return apply_op(_f, (value, self.loc, self.scale), name="lognormal_log_prob")
+
+    def entropy(self):
+        return apply_op(
+            lambda loc, sc: 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(sc) + loc,
+            (self.loc, self.scale), name="lognormal_entropy")
+
+
+class Independent(Distribution):
+    """Ref independent.py: reinterpret rightmost batch dims as event dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bshape = tuple(base.batch_shape)
+        super().__init__(bshape[: len(bshape) - self.rank],
+                         bshape[len(bshape) - self.rank:] + tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)
+
+        def _f(v):
+            return jnp.sum(v, axis=tuple(range(-self.rank, 0)))
+
+        return apply_op(_f, (lp,), name="independent_log_prob")
+
+    def entropy(self):
+        ent = self.base.entropy()
+        return apply_op(lambda v: jnp.sum(v, axis=tuple(range(-self.rank, 0))),
+                        (ent,), name="independent_entropy")
+
+
+class TransformedDistribution(Distribution):
+    """Ref transformed_distribution.py: push base samples through transforms,
+    correcting densities by the log-det-Jacobian."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(tuple(base.batch_shape), tuple(base.event_shape))
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        lp = 0.0
+        y = value
+        for t in reversed(self.transforms):
+            x = t.inverse(y)
+            ldj = t.forward_log_det_jacobian(x)
+            lp = lp - ldj if not isinstance(lp, float) else -ldj
+            y = x
+        base_lp = self.base.log_prob(y)
+        return base_lp + lp if not isinstance(lp, float) else base_lp
+
+
+# ----------------------------------------------------------------- KL rules
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    return p.kl_divergence(q)
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    def _f(lp, lq):
+        a = jax.nn.log_softmax(lp, -1)
+        b = jax.nn.log_softmax(lq, -1)
+        return jnp.sum(jnp.exp(a) * (a - b), -1)
+
+    return apply_op(_f, (p.logits, q.logits), name="categorical_kl")
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    def _f(pl, ph, ql, qh):
+        inside = (ql <= pl) & (ph <= qh)
+        return jnp.where(inside, jnp.log((qh - ql) / (ph - pl)), jnp.inf)
+
+    return apply_op(_f, (p.low, p.high, q.low, q.high), name="uniform_kl")
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    def _f(a, b):
+        a = jnp.clip(a, 1e-7, 1 - 1e-7)
+        b = jnp.clip(b, 1e-7, 1 - 1e-7)
+        return a * (jnp.log(a) - jnp.log(b)) + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b))
+
+    return apply_op(_f, (p.probs_, q.probs_), name="bernoulli_kl")
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    def _f(a1, b1, a2, b2):
+        gl, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        lbeta1 = gl(a1) + gl(b1) - gl(a1 + b1)
+        lbeta2 = gl(a2) + gl(b2) - gl(a2 + b2)
+        return (lbeta2 - lbeta1 + (a1 - a2) * dg(a1) + (b1 - b2) * dg(b1)
+                + (a2 - a1 + b2 - b1) * dg(a1 + b1))
+
+    return apply_op(_f, (p.alpha, p.beta, q.alpha, q.beta), name="beta_kl")
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    def _f(c1, c2):
+        gl, dg = jax.scipy.special.gammaln, jax.scipy.special.digamma
+        s1 = jnp.sum(c1, -1)
+        return (gl(s1) - jnp.sum(gl(c1), -1)
+                - gl(jnp.sum(c2, -1)) + jnp.sum(gl(c2), -1)
+                + jnp.sum((c1 - c2) * (dg(c1) - dg(s1)[..., None]), -1))
+
+    return apply_op(_f, (p.concentration, q.concentration), name="dirichlet_kl")
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    def _f(l1, s1, l2, s2):
+        d = jnp.abs(l1 - l2)
+        return (jnp.log(s2 / s1) + d / s2
+                + s1 / s2 * jnp.exp(-d / s1) - 1)
+
+    return apply_op(_f, (p.loc, p.scale, q.loc, q.scale), name="laplace_kl")
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return p._base.kl_divergence(q._base)

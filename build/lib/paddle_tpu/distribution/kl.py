@@ -1,0 +1,38 @@
+"""KL divergence multi-dispatch registry (ref: python/paddle/distribution/kl.py:64
+register_kl / kl_divergence)."""
+from __future__ import annotations
+
+_REGISTER_TABLE: dict = {}
+
+
+def register_kl(cls_p, cls_q):
+    """Decorator registering a pairwise KL implementation (ref kl.py:64)."""
+
+    def decorator(f):
+        _REGISTER_TABLE[cls_p, cls_q] = f
+        return f
+
+    return decorator
+
+
+def _dispatch(type_p, type_q):
+    matches = [(sp, sq) for sp, sq in _REGISTER_TABLE
+               if issubclass(type_p, sp) and issubclass(type_q, sq)]
+    if not matches:
+        return None
+    # most-derived match wins (the reference sorts by MRO distance similarly)
+    def key(pair):
+        sp, sq = pair
+        return (type_p.__mro__.index(sp), type_q.__mro__.index(sq))
+
+    return _REGISTER_TABLE[min(matches, key=key)]
+
+
+def kl_divergence(p, q):
+    """Ref kl.py kl_divergence: dispatch on (type(p), type(q))."""
+    rule = _dispatch(type(p), type(q))
+    if rule is None:
+        raise NotImplementedError(
+            f"no KL rule registered for ({type(p).__name__}, {type(q).__name__}); "
+            f"add one with @register_kl({type(p).__name__}, {type(q).__name__})")
+    return rule(p, q)

@@ -1,0 +1,4 @@
+"""paddle.framework namespace."""
+from .random import seed, get_rng_key, Generator, default_generator  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from ..core.dtypes import set_default_dtype, get_default_dtype  # noqa: F401

@@ -1,0 +1,33 @@
+"""Global flag registry (ref: PADDLE_DEFINE_EXPORTED_* gflags, platform/flags.cc:65;
+python surface paddle.set_flags/get_flags, fluid/framework.py:7125,7149).
+
+TPU-natively most reference flags are XLA's business; we keep the registry for the
+flags that change framework behavior and accept-and-ignore unknown FLAGS_* names.
+"""
+from __future__ import annotations
+
+_FLAGS: dict = {
+    "FLAGS_check_nan_inf": False,        # per-op NaN/Inf checks (framework/details/nan_inf_utils.h)
+    "FLAGS_allocator_strategy": "xla",   # allocator is PJRT's; value kept for parity
+    "FLAGS_cudnn_deterministic": False,
+    "FLAGS_use_autotune": True,
+    "FLAGS_eager_delete_tensor_gb": 0.0,
+    "FLAGS_benchmark": False,
+    "FLAGS_paddle_tpu_flash_attention_min_seq": 1024,
+    "FLAGS_paddle_tpu_default_matmul_precision": "default",
+}
+
+
+def set_flags(flags: dict):
+    for k, v in flags.items():
+        _FLAGS[k] = v
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _FLAGS.get(k) for k in keys}
+
+
+def get_flag(key, default=None):
+    return _FLAGS.get(key, default)

@@ -1,0 +1,59 @@
+"""paddle.save / paddle.load (ref: python/paddle/framework/io.py:574,791).
+
+Pickle-based object save with tensors converted to numpy (the reference serializes
+LoDTensor payloads inside the pickle too).  Large sharded checkpoints use
+paddle_tpu.distributed.checkpoint (per-process shard volumes + chunk-table
+reshard-on-load) — this is the single-file object path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor, Parameter
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._value),
+                "stop_gradient": obj.stop_gradient, "name": obj.name,
+                "is_param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_param") else Tensor
+            t = cls(jnp.asarray(obj["data"]))
+            t.name = obj.get("name", "")
+            if not obj.get("is_param"):
+                t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=configs.get("return_numpy", False))

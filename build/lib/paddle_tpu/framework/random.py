@@ -1,0 +1,82 @@
+"""Stateful RNG facade over JAX threefry keys.
+
+Reference: `phi/core/generator.h:23` (stateful per-device Generator) and
+`paddle.seed` (`python/paddle/framework/random.py`).  JAX RNG is functional; we keep a
+stateful key that is split on every draw.  Under `to_static`/jit tracing, the traced
+program receives a fresh key argument each call via `push_key` so dropout masks are not
+baked in as constants.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """Stateful key-splitting generator (ref phi/core/generator.h:23)."""
+
+    def __init__(self, seed: int = 0):
+        self._seed = int(seed)
+        self._key = None  # lazy: don't touch the backend at import time
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.key(self._seed)
+        return self._key
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def set_key(self, key):
+        self._key = key
+
+    def split(self):
+        self._key, sub = jax.random.split(self.key)
+        return sub
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+_key_stack: list[Generator] = []
+
+
+def default_generator() -> Generator:
+    return _key_stack[-1] if _key_stack else _default_generator
+
+
+def seed(s: int):
+    """paddle.seed parity."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def get_rng_key():
+    """Split the current generator and return a fresh subkey."""
+    return default_generator().split()
+
+
+@contextlib.contextmanager
+def rng_key_scope(key):
+    """Run a region drawing randomness from `key` (used by to_static tracing)."""
+    gen = Generator(0)
+    gen.set_key(key)
+    _key_stack.append(gen)
+    try:
+        yield gen
+    finally:
+        _key_stack.pop()
+
+
+def get_cuda_rng_state():  # parity shims
+    return default_generator().key
+
+
+def set_cuda_rng_state(state):
+    default_generator().set_key(state)

@@ -1,0 +1,5 @@
+"""hapi: paddle.Model high-level API (ref: python/paddle/hapi/model.py:915 Model,
+.fit:1574, callbacks, summary)."""
+from .model import Model  # noqa: F401
+from .summary import summary, flops  # noqa: F401
+from . import callbacks  # noqa: F401

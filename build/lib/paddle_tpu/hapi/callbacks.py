@@ -1,0 +1,143 @@
+"""hapi callbacks (ref: python/paddle/hapi/callbacks.py)."""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+class Callback:
+    def set_model(self, model):
+        self.model = model
+
+    def set_params(self, params):
+        self.params = params
+
+    def on_begin(self, mode, logs=None):
+        pass
+
+    def on_end(self, mode, logs=None):
+        pass
+
+    def on_epoch_begin(self, epoch, logs=None):
+        pass
+
+    def on_epoch_end(self, epoch, logs=None):
+        pass
+
+    def on_batch_begin(self, mode, step, logs=None):
+        pass
+
+    def on_batch_end(self, mode, step, logs=None):
+        pass
+
+    def on_train_begin(self, logs=None):
+        pass
+
+    def on_train_end(self, logs=None):
+        pass
+
+    def on_eval_begin(self, logs=None):
+        pass
+
+    def on_eval_end(self, logs=None):
+        pass
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def __getattr__(self, name):
+        def call(*args, **kwargs):
+            for c in self.callbacks:
+                getattr(c, name)(*args, **kwargs)
+
+        return call
+
+
+class ProgBarLogger(Callback):
+    def __init__(self, log_freq=1, verbose=2):
+        self.log_freq = log_freq
+        self.verbose = verbose
+        self._t0 = None
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.epoch = epoch
+        self._t0 = time.time()
+        self._steps = 0
+
+    def on_batch_end(self, mode, step, logs=None):
+        self._steps += 1
+        if self.verbose and step % self.log_freq == 0:
+            loss = logs.get("loss", ["?"])[0] if logs else "?"
+            extras = {k: v for k, v in (logs or {}).items() if k not in ("loss", "step")}
+            msg = f"Epoch {self.epoch} step {step}: loss={loss}"
+            for k, v in extras.items():
+                msg += f" {k}={v:.4f}" if isinstance(v, float) else f" {k}={v}"
+            print(msg)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            dt = time.time() - (self._t0 or time.time())
+            print(f"Epoch {epoch} done in {dt:.2f}s ({self._steps} steps)")
+
+
+class ModelCheckpoint(Callback):
+    def __init__(self, save_freq=1, save_dir=None):
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.save_dir and epoch % self.save_freq == 0:
+            self.model.save(f"{self.save_dir}/epoch_{epoch}")
+
+
+class EarlyStopping(Callback):
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        self.monitor = monitor
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.wait = 0
+
+    def on_eval_end(self, logs=None):
+        if not logs or self.monitor not in logs:
+            return
+        cur = logs[self.monitor]
+        cur = cur[0] if isinstance(cur, (list, tuple)) else cur
+        if self.best is None or cur < self.best - self.min_delta:
+            self.best = cur
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait >= self.patience:
+                self.model.stop_training = True
+
+
+class LRScheduler(Callback):
+    def __init__(self, by_step=True, by_epoch=False):
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def on_batch_end(self, mode, step, logs=None):
+        if mode == "train" and self.by_step:
+            sch = getattr(self.model._optimizer, "_learning_rate", None)
+            if hasattr(sch, "step"):
+                sch.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            sch = getattr(self.model._optimizer, "_learning_rate", None)
+            if hasattr(sch, "step"):
+                sch.step()
+
+
+class VisualDL(Callback):
+    def __init__(self, log_dir):
+        self.log_dir = log_dir

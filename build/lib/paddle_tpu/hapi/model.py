@@ -1,0 +1,185 @@
+"""paddle.Model (ref: python/paddle/hapi/model.py:915; fit:1574/evaluate/predict).
+
+`prepare(jit=True)` (TPU-native extension, default) trains through the compiled
+TrainStep — one XLA program per step; jit=False runs the eager tape path.
+"""
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+from ..autograd import tape
+from ..metric import Metric
+from . import callbacks as cb_mod
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step = None
+        self._use_jit = True
+        self.stop_training = False
+
+    def prepare(self, optimizer=None, loss=None, metrics=None, amp_configs=None, jit=True):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
+        self._use_jit = jit
+        if jit and optimizer is not None and loss is not None:
+            from ..jit.train_step import TrainStep
+
+            def loss_fn(x, y):
+                out = self.network(x)
+                return self._loss(out, y), out
+
+            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+
+    def train_batch(self, inputs, labels=None, update=True):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        self.network.train()
+        if self._train_step is not None:
+            out = self._train_step(*inputs, *labels)
+            loss = out[0] if isinstance(out, tuple) else out
+            preds = out[1] if isinstance(out, tuple) and len(out) > 1 else None
+            metrics = self._eval_metrics(preds, labels)
+            return [float(loss.item())], metrics
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.item())], self._eval_metrics(outputs, labels)
+
+    @tape.no_grad()
+    def eval_batch(self, inputs, labels=None):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        labels = labels if isinstance(labels, (list, tuple)) else ([labels] if labels is not None else [])
+        self.network.eval()
+        outputs = self.network(*inputs)
+        loss = self._loss(outputs, *labels) if self._loss else None
+        return ([float(loss.item())] if loss is not None else []), self._eval_metrics(outputs, labels)
+
+    @tape.no_grad()
+    def predict_batch(self, inputs):
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self.network.eval()
+        out = self.network(*inputs)
+        return [out.numpy()] if isinstance(out, Tensor) else [o.numpy() for o in out]
+
+    def _eval_metrics(self, outputs, labels):
+        res = {}
+        if outputs is None:
+            return res
+        for m in self._metrics:
+            try:
+                correct = m.compute(outputs, *labels)
+                res[m.name()] = m.update(correct)
+            except Exception:
+                pass
+        return res
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1, eval_freq=1,
+            log_freq=10, save_dir=None, save_freq=1, verbose=2, drop_last=False,
+            shuffle=True, num_workers=0, callbacks=None, accumulate_grad_batches=1,
+            num_iters=None):
+        """Ref hapi/model.py:1574."""
+        from ..io import DataLoader, Dataset
+
+        if isinstance(train_data, Dataset):
+            train_loader = DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                                      drop_last=drop_last, num_workers=num_workers)
+        else:
+            train_loader = train_data
+        if isinstance(eval_data, Dataset):
+            eval_loader = DataLoader(eval_data, batch_size=batch_size, num_workers=num_workers)
+        else:
+            eval_loader = eval_data
+
+        cbs = cb_mod.CallbackList(callbacks or [cb_mod.ProgBarLogger(log_freq, verbose)])
+        cbs.set_model(self)
+        cbs.on_begin("train")
+        iters_done = 0
+        for epoch in range(epochs):
+            cbs.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            for step, batch in enumerate(train_loader):
+                x, y = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) and len(batch) >= 2 else (batch, None)
+                cbs.on_batch_begin("train", step, {})
+                losses, metrics = self.train_batch(x, y)
+                logs = {"loss": losses, **metrics, "step": step}
+                cbs.on_batch_end("train", step, logs)
+                iters_done += 1
+                if num_iters is not None and iters_done >= num_iters:
+                    break
+            cbs.on_epoch_end(epoch, {})
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, verbose=0)
+            if save_dir is not None and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if num_iters is not None and iters_done >= num_iters:
+                break
+        cbs.on_end("train", {})
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2, num_workers=0,
+                 callbacks=None, num_samples=None):
+        from ..io import DataLoader, Dataset
+
+        loader = DataLoader(eval_data, batch_size=batch_size) if isinstance(eval_data, Dataset) else eval_data
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            x, y = (batch[0], batch[1]) if isinstance(batch, (list, tuple)) and len(batch) >= 2 else (batch, None)
+            l, metrics = self.eval_batch(x, y)
+            losses.extend(l)
+        result = {"loss": [float(np.mean(losses))] if losses else []}
+        for m in self._metrics:
+            result[m.name()] = m.accumulate()
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        from ..io import DataLoader, Dataset
+
+        loader = DataLoader(test_data, batch_size=batch_size) if isinstance(test_data, Dataset) else test_data
+        outs = []
+        for batch in loader:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            outs.append(self.predict_batch(x))
+        if stack_outputs:
+            return [np.concatenate([o[i] for o in outs]) for i in range(len(outs[0]))]
+        return outs
+
+    def save(self, path, training=True):
+        from ..framework.io import save as psave
+
+        psave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            psave(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io import load as pload
+
+        self.network.set_state_dict(pload(path + ".pdparams"))
+        import os
+
+        if not reset_optimizer and self._optimizer is not None and os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(pload(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        from .summary import summary as _summary
+
+        return _summary(self.network, input_size, dtypes=dtype)

@@ -1,0 +1,36 @@
+"""paddle.incubate parity surface (ref: python/paddle/incubate/)."""
+from . import autograd  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import MoELayer  # noqa: F401
+from ..autograd.tape import no_grad  # noqa: F401
+
+
+class nn:  # incubate.nn fused layers namespace (fused == XLA-fused on TPU)
+    from ..nn import (  # noqa: F401
+        MultiHeadAttention as FusedMultiHeadAttention,
+        TransformerEncoderLayer as FusedTransformerEncoderLayer,
+    )
+
+
+def graph_send_recv(*args, **kwargs):
+    raise NotImplementedError
+
+
+def segment_sum(data, segment_ids):
+    import jax
+
+    from ..tensor.tensor import apply_op
+
+    def _f(d, s):
+        import jax.numpy as jnp
+
+        n = int(s.max()) + 1 if hasattr(s, "max") else 1
+        return jax.ops.segment_sum(d, s.astype(jnp.int32), num_segments=None)
+
+    return apply_op(_f, (data, segment_ids), name="segment_sum")
+
+
+class autotune:
+    @staticmethod
+    def set_config(config=None):
+        pass

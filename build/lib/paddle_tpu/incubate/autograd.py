@@ -1,0 +1,112 @@
+"""Functional/prim autodiff (ref: python/paddle/incubate/autograd/primapi.py
+forward_grad/grad, primops.py — the reference's experimental JAX-like primitive
+system).  Here the real JAX transforms ARE the implementation: jvp/vjp/vmap/jacobian/
+hessian over functions of Tensors.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+
+def _wrap_fn(func):
+    """Lift a Tensor->Tensor function to raw-array space."""
+
+    def raw(*arrays):
+        outs = func(*[Tensor(a, stop_gradient=True) for a in arrays])
+        if isinstance(outs, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o for o in outs)
+        return outs._value if isinstance(outs, Tensor) else outs
+
+    return raw
+
+
+def _raws(xs):
+    xs = xs if isinstance(xs, (tuple, list)) else [xs]
+    return tuple(x._value if isinstance(x, Tensor) else jnp.asarray(x) for x in xs)
+
+
+def _wrap_out(o):
+    if isinstance(o, tuple):
+        return tuple(Tensor(i) for i in o)
+    return Tensor(o)
+
+
+def jvp(func, xs, v=None):
+    raw = _wrap_fn(func)
+    primals = _raws(xs)
+    tangents = _raws(v) if v is not None else tuple(jnp.ones_like(p) for p in primals)
+    out, tangent_out = jax.jvp(raw, primals, tangents)
+    return _wrap_out(out), _wrap_out(tangent_out)
+
+
+def vjp(func, xs, v=None):
+    raw = _wrap_fn(func)
+    primals = _raws(xs)
+    out, vjp_fn = jax.vjp(raw, *primals)
+    if v is None:
+        seed = jax.tree.map(jnp.ones_like, out)
+    else:
+        seed = _raws(v)
+        seed = seed[0] if not isinstance(out, tuple) else seed
+    grads = vjp_fn(seed)
+    return _wrap_out(out), _wrap_out(grads if len(grads) > 1 else grads[0])
+
+
+class Jacobian:
+    def __init__(self, func, xs, is_batched=False):
+        raw = _wrap_fn(func)
+        primals = _raws(xs)
+        jac = jax.jacrev(raw, argnums=tuple(range(len(primals))))(*primals)
+        self._jac = jac
+
+    def __getitem__(self, idx):
+        j = self._jac
+        if isinstance(j, tuple) and len(j) == 1:
+            j = j[0]
+        return Tensor(jnp.asarray(j)[idx])
+
+    @property
+    def shape(self):
+        j = self._jac[0] if isinstance(self._jac, tuple) and len(self._jac) == 1 else self._jac
+        return list(jnp.asarray(j).shape)
+
+
+class Hessian:
+    def __init__(self, func, xs, is_batched=False):
+        raw = _wrap_fn(func)
+        primals = _raws(xs)
+        self._h = jax.hessian(raw)(*primals)
+
+    def __getitem__(self, idx):
+        return Tensor(jnp.asarray(self._h)[idx])
+
+
+def jacobian(func, xs, create_graph=False, allow_unused=False):
+    return Jacobian(func, xs)
+
+
+def hessian(func, xs, create_graph=False, allow_unused=False):
+    return Hessian(func, xs)
+
+
+def vmap(func, in_axes=0, out_axes=0):
+    raw = _wrap_fn(func)
+    mapped = jax.vmap(raw, in_axes=in_axes, out_axes=out_axes)
+
+    def wrapper(*xs):
+        return _wrap_out(mapped(*_raws(xs)))
+
+    return wrapper
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    raise NotImplementedError("use paddle_tpu.incubate.autograd.jvp")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    from ..autograd.tape import grad as _grad
+
+    return _grad(outputs, inputs, grad_outputs)

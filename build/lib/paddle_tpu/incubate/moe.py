@@ -1,0 +1,184 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Reference analog: `MoELayer` (`/root/reference/python/paddle/incubate/distributed/
+models/moe/moe_layer.py:244`) with gshard/switch/naive gates (`moe/gate/*.py`) and
+token exchange via the `global_scatter`/`global_gather` collective ops
+(`/root/reference/paddle/fluid/operators/collective/global_scatter_op.cc`).
+
+TPU-native: GShard-style DENSE dispatch — a [tokens, experts, capacity] one-hot
+dispatch/combine pair built from top-k gating with a static capacity, so the whole
+layer is jit-compilable with static shapes (no ragged sends).  Expert exchange:
+
+- single device / pure data parallel: experts applied locally, no comms;
+- expert parallel: called inside shard_map with `ep_axis` manual — the [E, C, d]
+  expert-major tensor goes through ONE `lax.all_to_all` (split experts, concat
+  capacity), local experts run, and a second all_to_all returns token-major.
+  This is exactly the reference's global_scatter/global_gather pair, but as XLA
+  collectives over ICI instead of NCCL alltoall.
+
+Gate aux losses follow GShard/Switch: l_aux = E * Σ_e mean_probs_e · frac_tokens_e,
+readable from `layer.l_aux` after forward (reference keeps it on the gate).
+"""
+from __future__ import annotations
+
+import math as _math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.tensor import Tensor, apply_op
+from ..nn.layer.layers import Layer
+
+
+class BaseGate(Layer):
+    """Ref moe/gate/base_gate.py."""
+
+    def __init__(self, d_model, num_expert, top_k):
+        super().__init__()
+        self.d_model = d_model
+        self.num_expert = num_expert
+        self.top_k = top_k
+        self.gate = nn.Linear(d_model, num_expert, bias_attr=False)
+
+    def logits(self, x):
+        return self.gate(x)
+
+
+class NaiveGate(BaseGate):
+    """Plain top-k softmax gate, no aux loss (ref moe/gate/naive_gate.py)."""
+
+    aux_loss_weight = 0.0
+
+    def forward(self, x):
+        logits = self.logits(x)
+        probs = F.softmax(logits.astype("float32"), axis=-1)
+        topv, topi = probs.topk(self.top_k, axis=-1)
+        return probs, topv, topi
+
+
+class GShardGate(NaiveGate):
+    """Top-2 gate with load-balancing aux loss (ref moe/gate/gshard_gate.py)."""
+
+    aux_loss_weight = 1.0
+
+
+class SwitchGate(NaiveGate):
+    """Top-1 gate (Switch Transformer; ref moe/gate/switch_gate.py)."""
+
+    aux_loss_weight = 1.0
+
+    def __init__(self, d_model, num_expert, top_k=1):
+        super().__init__(d_model, num_expert, top_k=1)
+
+
+_GATES = {"naive": NaiveGate, "gshard": GShardGate, "switch": SwitchGate}
+
+
+def _dispatch_combine(probs, topv, topi, num_expert, capacity, top_k):
+    """Build dense dispatch [T,E,C] bool and combine [T,E,C] f32 + aux loss.
+    Raw-array function (called under apply_op)."""
+    T = probs.shape[0]
+    E, C = num_expert, capacity
+
+    # renormalize the kept top-k probabilities (GShard)
+    denom = jnp.sum(topv, axis=-1, keepdims=True)
+    weights = topv / jnp.maximum(denom, 1e-9)
+
+    counts = jnp.zeros((E,), jnp.int32)
+    dispatch = jnp.zeros((T, E, C), jnp.float32)
+    combine = jnp.zeros((T, E, C), jnp.float32)
+    for j in range(top_k):
+        idx_j = topi[:, j]                                  # [T]
+        mask_j = jax.nn.one_hot(idx_j, E, dtype=jnp.int32)  # [T, E]
+        pos_in_e = jnp.cumsum(mask_j, axis=0) - 1 + counts[None, :]  # [T, E]
+        counts = counts + jnp.sum(mask_j, axis=0)
+        pos_j = jnp.sum(pos_in_e * mask_j, axis=-1)         # [T] position in expert
+        keep = pos_j < C
+        oh_pos = jax.nn.one_hot(pos_j, C, dtype=jnp.float32)            # [T, C]
+        contrib = (mask_j.astype(jnp.float32)[:, :, None] * oh_pos[:, None, :]
+                   * keep.astype(jnp.float32)[:, None, None])           # [T, E, C]
+        dispatch = jnp.maximum(dispatch, contrib)
+        combine = combine + weights[:, j][:, None, None] * contrib
+
+    # GShard load-balancing loss on the top-1 assignment
+    me = jnp.mean(probs, axis=0)                            # [E]
+    top1 = jax.nn.one_hot(topi[:, 0], E, dtype=jnp.float32)
+    ce = jnp.mean(top1, axis=0)                             # [E]
+    l_aux = E * jnp.sum(me * ce)
+    return dispatch, combine, l_aux
+
+
+class MoELayer(Layer):
+    """Ref moe_layer.py:244 API: MoELayer(d_model, experts=LayerList, gate=cfg).
+
+    forward(x: [B, S, d]) -> [B, S, d]; the gate aux loss is in `self.l_aux`.
+    With `ep_axis`, call inside shard_map (manual over that axis): local experts
+    are this rank's shard of the expert pool (total = axis_size * len(experts)).
+    """
+
+    def __init__(self, d_model, experts, gate="gshard", top_k=2,
+                 capacity_factor=1.25, ep_axis=None, ep_size=1, moe_group=None, **kwargs):
+        super().__init__()
+        self.d_model = d_model
+        self.experts = experts if isinstance(experts, nn.LayerList) else nn.LayerList(experts)
+        self.num_local_experts = len(self.experts)
+        self.ep_axis = ep_axis
+        self.ep_size = ep_size if ep_axis is not None else 1
+        self.capacity_factor = capacity_factor
+        self.num_expert = self.num_local_experts * self.ep_size
+        if isinstance(gate, dict):
+            top_k = gate.get("top_k", top_k)
+            gate = gate.get("type", "gshard")
+        if isinstance(gate, str):
+            self.gate_layer = _GATES[gate](d_model, self.num_expert, top_k=top_k)
+        else:
+            self.gate_layer = gate
+        self.l_aux = None
+
+    def forward(self, x):
+        orig_shape = x.shape
+        d = orig_shape[-1]
+        xt = x.reshape([-1, d])                              # [T, d]
+        T = xt.shape[0]
+        E = self.num_expert
+        k = self.gate_layer.top_k
+        C = max(1, int(_math.ceil(self.capacity_factor * k * T / E)))
+
+        probs, topv, topi = self.gate_layer(xt)
+
+        disp_comb = apply_op(
+            lambda p, tv, ti: _dispatch_combine(p, tv, ti, E, C, k),
+            (probs, topv, topi), name="moe_dispatch")
+        dispatch, combine, l_aux = disp_comb
+        self.l_aux = l_aux * getattr(self.gate_layer, "aux_loss_weight", 1.0)
+
+        # token-major -> expert-major [E, C, d]
+        from ..tensor.linalg import einsum
+
+        xe = einsum("tec,td->ecd", dispatch.astype(xt.dtype), xt)
+
+        if self.ep_axis is not None:
+            # global_scatter: experts split across ranks, capacity concat
+            xe = apply_op(
+                lambda a: lax.all_to_all(a, self.ep_axis, split_axis=0,
+                                         concat_axis=1, tiled=True),
+                (xe,), name="moe_global_scatter")
+
+        # run local experts on their [C_eff, d] slices
+        from ..tensor import manipulation as M
+
+        outs = [self.experts[e](xe[e]) for e in range(self.num_local_experts)]
+        ye = M.stack(outs, axis=0)                           # [E_local, C_eff, d]
+
+        if self.ep_axis is not None:
+            # global_gather: back to token-major expert layout
+            ye = apply_op(
+                lambda a: lax.all_to_all(a, self.ep_axis, split_axis=1,
+                                         concat_axis=0, tiled=True),
+                (ye,), name="moe_global_gather")
+
+        y = einsum("tec,ecd->td", combine.astype(xt.dtype), ye)
+        return y.reshape(list(orig_shape))

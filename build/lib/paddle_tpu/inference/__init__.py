@@ -1,0 +1,217 @@
+"""paddle.inference — AnalysisPredictor-shaped serving API.
+
+Reference analog: `paddle/fluid/inference/api/analysis_predictor.h` +
+`paddle_inference_api.h` (Config -> create_predictor -> input/output handles
+-> run).  TPU-native: the "optimized program" is the AOT StableHLO artifact
+written by `paddle.jit.save` (jax.export), loaded once and executed via PJRT;
+the pass pipeline the reference runs at load time (IR fusions etc.) is XLA's
+job at compile time.  Variable batch sizes go through pad-to-bucket, the same
+§7.3.4 policy the OCR pipeline uses, so serving traffic compiles a bounded set
+of programs.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = "float32"
+    Half = "float16"
+    Bfloat16 = "bfloat16"
+    Int8 = "int8"  # accepted for API parity; XLA decides quantization
+
+
+class Config:
+    """Ref paddle_analysis_config.h AnalysisConfig: model paths + knobs.
+    Accepts Config(prog_file_prefix) or Config(model_dir) like the reference's
+    two constructors; GPU/MKLDNN/TensorRT toggles are accepted and recorded
+    (XLA/PJRT owns those decisions on TPU)."""
+
+    def __init__(self, model_path=None, params_path=None):
+        self._prefix = None
+        if model_path is not None:
+            p = str(model_path)
+            for suffix in (".pdmodel", ".pdiparams"):
+                if p.endswith(suffix):
+                    p = p[: -len(suffix)]
+            self._prefix = p
+        self._dynamic_batch = True
+        self._memory_pool_mb = 0
+        self._enabled = {}
+        self._switches = {"ir_optim": True, "glog_info": True}
+
+    # --- reference-shaped knob surface (recorded; XLA owns the behavior)
+    def enable_use_gpu(self, memory_pool_init_size_mb=0, device_id=0):
+        self._memory_pool_mb = memory_pool_init_size_mb
+        self._enabled["gpu"] = device_id
+
+    def disable_gpu(self):
+        self._enabled.pop("gpu", None)
+
+    def enable_mkldnn(self):
+        self._enabled["mkldnn"] = True
+
+    def enable_memory_optim(self):
+        self._enabled["memory_optim"] = True
+
+    def switch_ir_optim(self, flag=True):
+        self._switches["ir_optim"] = bool(flag)
+
+    def disable_glog_info(self):
+        self._switches["glog_info"] = False
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._enabled["cpu_threads"] = int(n)
+
+    # --- TPU-specific: dynamic-batch policy against the fixed-shape program
+    def switch_dynamic_batch(self, flag=True):
+        """On (default): smaller batches are zero-padded up to the exported
+        batch size and larger ones are executed in chunks — ONE compiled
+        program serves any request size (§7.3.4 bounded-shapes policy)."""
+        self._dynamic_batch = bool(flag)
+
+    def model_path(self):
+        return self._prefix
+
+
+class _IOHandle:
+    """Ref ZeroCopyTensor: copy_from_cpu / reshape / copy_to_cpu."""
+
+    def __init__(self, name):
+        self.name = name
+        self._array = None
+
+    def reshape(self, shape):
+        if self._array is not None:
+            self._array = np.reshape(self._array, shape)
+
+    def copy_from_cpu(self, data):
+        self._array = np.asarray(data)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._array)
+
+    def shape(self):
+        return list(self._array.shape) if self._array is not None else []
+
+
+class Predictor:
+    """Ref analysis_predictor.h: named I/O handles around the loaded program."""
+
+    def __init__(self, config: Config):
+        from .. import jit as _jit
+
+        if config.model_path() is None:
+            raise ValueError("inference.Config needs a model path prefix "
+                             "(artifacts written by paddle.jit.save)")
+        self._config = config
+        self._layer = _jit.load(config.model_path())
+        specs = self._layer._info.get("inputs") or []
+        if specs:
+            self._input_names = [s["name"] for s in specs]
+            self._input_specs = specs
+        else:  # legacy artifact without recorded specs: single input assumed
+            self._input_names = ["x0"]
+            self._input_specs = None
+        self._inputs = {n: _IOHandle(n) for n in self._input_names}
+        self._outputs: dict[str, _IOHandle] = {}
+        self._output_names: list[str] = []
+
+    def get_input_names(self):
+        return list(self._input_names)
+
+    def get_input_handle(self, name):
+        return self._inputs[name]
+
+    def get_output_names(self):
+        return list(self._output_names)
+
+    def get_output_handle(self, name):
+        return self._outputs[name]
+
+    @property
+    def _program_batch(self):
+        """Batch size the program was exported with (leading dim of input 0,
+        from the input specs recorded at jit.save time)."""
+        if self._input_specs and self._input_specs[0]["shape"]:
+            dim0 = self._input_specs[0]["shape"][0]
+            return int(dim0) if dim0 and int(dim0) > 0 else None
+        return None
+
+    def _exec(self, arrays):
+        outs = self._layer(*[Tensor(a) for a in arrays])
+        outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+        return [np.asarray(o._value) for o in outs]
+
+    def run(self, inputs=None):
+        """Execute the program.  `run([arrays...])` is also accepted and
+        returns the outputs directly (convenience beyond the reference API).
+
+        With dynamic batch on (default), any request batch size is served by
+        the ONE exported program: pad-to-program-batch for small requests,
+        chunked execution for large ones."""
+        if inputs is not None:
+            for name, arr in zip(self._input_names, inputs):
+                self._inputs[name].copy_from_cpu(arr)
+        arrays = [self._inputs[n]._array for n in self._input_names]
+        if any(a is None for a in arrays):
+            missing = [n for n in self._input_names
+                       if self._inputs[n]._array is None]
+            raise RuntimeError(f"inputs not set: {missing}")
+        arrays = [np.asarray(a) for a in arrays]
+
+        pb = self._program_batch
+        # only inputs whose exported leading dim == the program batch are
+        # batched; constants/side inputs pass through whole
+        if self._input_specs:
+            is_batched = [bool(s["shape"]) and s["shape"][0] == pb
+                          for s in self._input_specs]
+        else:
+            is_batched = [a.ndim >= 1 for a in arrays]
+        n = next((a.shape[0] for a, b in zip(arrays, is_batched) if b), None)
+        if (not self._config._dynamic_batch) or pb is None or n is None or n == pb:
+            out_arrays = self._exec(arrays)
+        else:
+            # chunked + padded serving against the fixed-batch program
+            out_chunks = []
+            reals = []
+            for start in range(0, n, pb):
+                chunk = []
+                real = min(pb, n - start)
+                for a, b in zip(arrays, is_batched):
+                    if not b:
+                        chunk.append(a)
+                        continue
+                    c = a[start:start + pb]
+                    if c.shape[0] < pb:
+                        c = np.pad(c, [(0, pb - c.shape[0])] + [(0, 0)] * (a.ndim - 1))
+                    chunk.append(c)
+                out_chunks.append(self._exec(chunk))
+                reals.append(real)
+            # concatenate only outputs carrying the program batch dim; others
+            # (per-model scalars/constants) come from the first chunk
+            out_arrays = []
+            for i in range(len(out_chunks[0])):
+                o0 = out_chunks[0][i]
+                if o0.ndim >= 1 and o0.shape[0] == pb:
+                    out_arrays.append(np.concatenate(
+                        [c[i][:r] for c, r in zip(out_chunks, reals)]))
+                else:
+                    out_arrays.append(o0)
+
+        self._output_names = [f"out{i}" for i in range(len(out_arrays))]
+        self._outputs = {}
+        for name, arr in zip(self._output_names, out_arrays):
+            h = _IOHandle(name)
+            h.copy_from_cpu(arr)
+            self._outputs[name] = h
+        return out_arrays
+
+
+def create_predictor(config: Config) -> Predictor:
+    """Ref api/analysis_predictor.cc CreatePaddlePredictor."""
+    return Predictor(config)

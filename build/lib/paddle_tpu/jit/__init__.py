@@ -1,0 +1,153 @@
+"""paddle.jit parity (ref: python/paddle/jit/__init__.py:23 — to_static/save/load)."""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from .to_static import to_static, declarative, not_to_static, StaticFunction  # noqa: F401
+from .train_step import TrainStep  # noqa: F401
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+
+class InputSpec:
+    """Ref: paddle.static.InputSpec."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+def save(layer, path, input_spec=None, **configs):
+    """jit.save parity (ref fluid/dygraph/jit.py:649).
+
+    Persists (a) the state_dict as .pdiparams and (b) an AOT-exported StableHLO
+    program as .pdmodel when input_spec is given (jax.export replaces the reference's
+    serialized inference ProgramDesc).
+    """
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {}
+    param_keys, buffer_keys = [], []
+    if isinstance(layer, Layer):
+        # a stacked PipelineTrainStep keeps trained body weights in its own
+        # sharded store until a state read — run the sync hook before snapshotting
+        hook = getattr(layer, "_pre_state_hook", None)
+        if hook is not None:
+            hook()
+        for k, v in layer.named_parameters():
+            state[k] = np.asarray(v._value)
+            param_keys.append(k)
+        for k, v in layer.named_buffers():
+            state[k] = np.asarray(v._value)
+            buffer_keys.append(k)
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    # the exported closure was traced with the exact (params, buffers) pytree from
+    # functional_state(); persist the key split so load() can rebuild it (the round-1
+    # bug: stuffing everything into __params__ broke any model with buffers, e.g. BN)
+    with open(path + ".pdiparams.info", "wb") as f:
+        pickle.dump({
+            "param_keys": param_keys, "buffer_keys": buffer_keys,
+            "inputs": [
+                {"name": getattr(s, "name", None) or f"x{i}",
+                 "shape": list(s.shape), "dtype": str(s.dtype)}
+                for i, s in enumerate(input_spec)
+            ] if input_spec is not None else None,
+        }, f)
+
+    if input_spec is not None and isinstance(layer, Layer):
+        from jax import export as jax_export
+
+        was_training = layer.training
+        layer.eval()
+        try:
+            params, buffers = layer.functional_state()
+
+            def infer_fn(params, buffers, *xs):
+                restore = layer.bind_functional_state(params, buffers)
+                try:
+                    outs = layer(*[Tensor(x) for x in xs])
+                finally:
+                    restore()
+                if isinstance(outs, (tuple, list)):
+                    return tuple(o._value for o in outs)
+                return outs._value
+
+            shapes = [jax.ShapeDtypeStruct(tuple(s.shape), np.dtype(s.dtype) if isinstance(s.dtype, str) else s.dtype)
+                      for s in input_spec]
+            exported = jax_export.export(jax.jit(infer_fn))(params, buffers, *shapes)
+            with open(path + ".pdmodel", "wb") as f:
+                f.write(exported.serialize())
+        except Exception as e:  # platform may not support export; params remain usable
+            with open(path + ".pdmodel.err", "w") as f:
+                f.write(repr(e))
+        finally:
+            if was_training:
+                layer.train()
+
+
+class TranslatedLayer(Layer):
+    """Ref: fluid/dygraph/io.py TranslatedLayer — a loaded inference program."""
+
+    def __init__(self, exported, params, buffers, info=None):
+        super().__init__()
+        self._exported = exported
+        self._params = params    # flat {name: jnp array}, the exact exported pytree
+        self._buffers_tree = buffers
+        self._info = info or {}
+
+    def forward(self, *args):
+        raw = tuple(a._value if isinstance(a, Tensor) else a for a in args)
+        out = self._exported.call(self._params, self._buffers_tree, *raw)
+        if isinstance(out, (tuple, list)):
+            outs = tuple(Tensor(o) for o in out)
+            return outs[0] if len(outs) == 1 else outs
+        return Tensor(out)
+
+    def state_dict(self, *a, **kw):
+        import jax.numpy as jnp
+
+        return {k: Tensor(jnp.asarray(v))
+                for k, v in {**self._params, **self._buffers_tree}.items()}
+
+
+def load(path, **configs):
+    """jit.load parity (ref fluid/dygraph/jit.py:1069)."""
+    import jax.numpy as jnp
+
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    info_file = path + ".pdiparams.info"
+    info = {}
+    if os.path.exists(info_file):
+        with open(info_file, "rb") as f:
+            info = pickle.load(f)
+        params = {k: jnp.asarray(state[k]) for k in info["param_keys"]}
+        buffers = {k: jnp.asarray(state[k]) for k in info["buffer_keys"]}
+    else:  # legacy save: assume everything is a parameter
+        params = {k: jnp.asarray(v) for k, v in state.items()}
+        buffers = {}
+    model_file = path + ".pdmodel"
+    if os.path.exists(model_file):
+        from jax import export as jax_export
+
+        with open(model_file, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        return TranslatedLayer(exported, params, buffers, info)
+    raise FileNotFoundError(f"no serialized program at {model_file}; "
+                            f"load params with paddle.load({path + '.pdiparams'!r}) instead")
+
+
+def enable_to_static(flag: bool = True):
+    global _to_static_enabled
+    _to_static_enabled = flag
+
+
+_to_static_enabled = True

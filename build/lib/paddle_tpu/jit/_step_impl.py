@@ -1,0 +1,154 @@
+"""Shared inner step builder for TrainStep / ShardedTrainStep.
+
+One XLA program per optimizer update, with two orthogonal extensions the
+reference implements as separate meta-optimizers:
+
+- gradient accumulation (ref fleet/meta_optimizers/gradient_merge_optimizer.py,
+  dygraph `no_sync` + manual accumulation): `accum_steps > 1` splits the batch
+  into microbatches and lax.scan's the forward/backward, averaging grads into
+  ONE optimizer update — large global batches without large activations.
+- dynamic loss scaling in-graph (ref amp/grad_scaler.py:26 via
+  check_finite_and_unscale + update_loss_scaling ops): the scaler's
+  (scale, good, bad) counters live on device and the skip-update-on-overflow
+  select happens inside the compiled step — fp16 runs on the fast path with no
+  per-step host sync (the round-1 GradScaler pulled a bool to host every step).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..autograd import tape
+from ..framework import random as _random
+
+
+def init_scaler_state(scaler):
+    """Device-resident scaler state (None when no/disabled scaler)."""
+    if scaler is None or not scaler._enable:
+        return None
+    return {
+        "scale": jnp.asarray(scaler._scale, jnp.float32),
+        "good": jnp.asarray(scaler._good_steps, jnp.int32),
+        "bad": jnp.asarray(scaler._bad_steps, jnp.int32),
+    }
+
+
+def _update_scaler_state(scaler, st, found_inf):
+    """In-graph twin of GradScaler.update() (update_loss_scaling op)."""
+    if not scaler._dynamic:
+        return {**st, "good": st["good"], "bad": st["bad"]}
+    bad = jnp.where(found_inf, st["bad"] + 1, 0)
+    good = jnp.where(found_inf, 0, st["good"] + 1)
+    shrink = bad >= scaler._decr_every_n
+    grow = good >= scaler._incr_every_n_steps
+    scale = jnp.where(shrink, jnp.maximum(st["scale"] * scaler._decr_ratio, 1.0),
+                      jnp.where(grow, st["scale"] * scaler._incr_ratio, st["scale"]))
+    return {"scale": scale,
+            "good": jnp.where(grow, 0, good),
+            "bad": jnp.where(shrink, 0, bad)}
+
+
+def build_step_fn(model, loss_fn, opt, named, trainable, accum_steps=1,
+                  scaler=None, cast_loss_f32=False, mb_constraint=None):
+    """Returns step(params, buffers, opt_state, scaler_state, lr, key, *batch)
+    -> (new_params, new_buffers, new_opt, new_scaler_state, loss, aux).
+
+    `scaler_state`/`new_scaler_state` are None when scaler is None/disabled.
+    """
+    accum = max(1, int(accum_steps))
+    use_scaler = scaler is not None and scaler._enable
+
+    def forward_loss(allp, buffers, key, batch):
+        with _random.rng_key_scope(key):
+            restore = model.bind_functional_state(allp, buffers)
+            try:
+                with tape.no_grad():
+                    args = tuple(Tensor(b, stop_gradient=True) for b in batch)
+                    out = loss_fn(*args)
+                loss_t = out[0] if isinstance(out, (tuple, list)) else out
+                aux_out = tuple(o._value if isinstance(o, Tensor) else o
+                                for o in (out[1:] if isinstance(out, (tuple, list)) else ()))
+                new_buffers = {kk: b._value for kk, b in model.named_buffers()}
+            finally:
+                restore()
+        loss_v = loss_t._value
+        if cast_loss_f32:
+            loss_v = loss_v.astype(jnp.float32)
+        return loss_v, (new_buffers, aux_out)
+
+    def step(params, buffers, opt_state, scaler_state, lr, key, *batch):
+        t_params = {k: v for k, v in params.items() if k in trainable}
+        frozen = {k: v for k, v in params.items() if k not in trainable}
+        scale = scaler_state["scale"] if use_scaler else None
+
+        def pure_loss(tp, bufs, k, mb):
+            loss, auxes = forward_loss({**tp, **frozen}, bufs, k, mb)
+            scaled = loss * scale.astype(loss.dtype) if use_scaler else loss
+            return scaled, (loss, *auxes)
+
+        vgrad = jax.value_and_grad(pure_loss, has_aux=True)
+
+        if accum == 1:
+            (_, (loss, new_buffers, aux)), grads = vgrad(t_params, buffers, key, batch)
+        else:
+            for b in batch:
+                if b.shape[0] % accum:
+                    raise ValueError(
+                        f"accum_steps={accum} does not divide the batch size "
+                        f"{b.shape[0]} — gradient accumulation splits the batch "
+                        f"axis into equal microbatches")
+            mbs = tuple(b.reshape((accum, b.shape[0] // accum) + b.shape[1:])
+                        for b in batch)
+            if mb_constraint is not None:
+                # keep the data sharding on the per-microbatch axis (axis 1),
+                # not the scan axis — otherwise the partitioner fully
+                # rematerializes every dynamic_slice of the scan
+                mbs = tuple(mb_constraint(b) for b in mbs)
+            keys = jax.random.split(key, accum)
+
+            def body(carry, xs):
+                bufs, gsum, lsum = carry
+                k, mb = xs[0], xs[1:]
+                (_, (l, nb, aux_i)), g = vgrad(t_params, bufs, k, mb)
+                gsum = jax.tree.map(jnp.add, gsum, g)
+                return (nb, gsum, lsum + l.astype(jnp.float32)), aux_i
+
+            gzero = jax.tree.map(jnp.zeros_like, t_params)
+            (new_buffers, gsum, lsum), aux_st = jax.lax.scan(
+                body, (buffers, gzero, jnp.zeros((), jnp.float32)),
+                (keys, *mbs))
+            grads = jax.tree.map(lambda g: g / accum, gsum)
+            loss = lsum / accum
+            aux = jax.tree.map(lambda a: a[-1], aux_st)
+
+        if use_scaler:
+            inv = (1.0 / scale)
+            grads = {k: (g.astype(jnp.float32) * inv).astype(g.dtype)
+                     for k, g in grads.items()}
+            found_inf = jnp.zeros((), bool)
+            for g in grads.values():
+                found_inf = found_inf | ~jnp.all(jnp.isfinite(g.astype(jnp.float32)))
+        else:
+            found_inf = None
+
+        clipped = opt._clipped_grads(list(grads.items()))
+        new_params = dict(frozen)
+        new_opt = {}
+        for k, g in clipped:
+            np_k, no_k = opt._apply_update(
+                params[k], g, opt_state[k], lr, opt._param_decay_coeff(named[k]))
+            if use_scaler:
+                # overflow step: keep params/opt-state (check_finite_and_unscale
+                # + conditional update, done as a select so the step stays one
+                # traced program)
+                np_k = jnp.where(found_inf, params[k], np_k)
+                no_k = jax.tree.map(lambda new, old: jnp.where(found_inf, old, new),
+                                    no_k, opt_state[k])
+            new_params[k], new_opt[k] = np_k, no_k
+
+        new_scaler_state = (_update_scaler_state(scaler, scaler_state, found_inf)
+                            if use_scaler else None)
+        return new_params, new_buffers, new_opt, new_scaler_state, loss, aux
+
+    return step

@@ -1,0 +1,308 @@
+"""@to_static: compile a dygraph function/Layer into one XLA program.
+
+Reference analog: dy2static (`python/paddle/fluid/dygraph/dygraph_to_static/` —
+`program_translator.py:239` StaticFunction, `partial_program.py:363` run_program) which
+AST-transforms Python into a ProgramDesc and runs it via `run_program_op` with CINN as
+the optional compiler (`paddle/fluid/framework/paddle2cinn/`).
+
+TPU-native design: no AST surgery.  The dygraph code *is* traceable because every op is
+a pure JAX call — `to_static` builds a pure function over (params, buffers, rng_key,
+*args), `jax.jit`s it, and routes calls through the autograd tape via `jax.vjp` of the
+jitted function, so `loss.backward()` runs a single compiled backward program.  Python
+control flow is baked at trace time (same as the reference's static path); for traced
+control flow users write lax.cond/scan via paddle_tpu.static.nn.cond/while_loop.
+
+Buffer mutation (BN running stats) is captured functionally: the traced function
+returns updated buffer values as auxiliary outputs, written back after each call.
+"""
+from __future__ import annotations
+
+import collections
+import functools
+import warnings
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, Parameter, apply_op
+from ..autograd import tape
+from ..framework import random as _random
+from ..nn.layer.layers import Layer
+
+
+def _static_key(x, keepalive):
+    """A stable, hashable cache key for a non-tensor argument.
+
+    repr() is NOT stable for arbitrary objects (default reprs embed addresses,
+    so a config object rebuilt each call would silently recompile every call —
+    the SURVEY §7.3.4 recompilation storm).  Primitives and containers key by
+    value; arrays by shape/dtype/content hash; everything else by type + id.
+    Objects keyed by id are appended to `keepalive`, which the cache entry
+    retains — otherwise CPython could reuse a freed object's id and silently
+    hit a stale compiled variant."""
+    if x is None or isinstance(x, (bool, int, float, str, bytes)):
+        return ("P", x)
+    if isinstance(x, (list, tuple)):
+        return ("L", type(x).__name__, tuple(_static_key(i, keepalive) for i in x))
+    if isinstance(x, dict):
+        return ("D", tuple(sorted((str(k), _static_key(v, keepalive))
+                                  for k, v in x.items())))
+    if isinstance(x, np.ndarray):
+        return ("A", x.shape, str(x.dtype), hash(x.tobytes()))
+    keepalive.append(x)
+    return ("O", type(x).__qualname__, id(x))
+
+
+def _tree_flatten_args(args, kwargs):
+    """Split (args, kwargs) into (tensor_leaves, rebuild_fn, static_signature,
+    keepalive-objects)."""
+    leaves = []
+    sig = []
+    keepalive: list = []
+
+    def go(x):
+        if isinstance(x, Tensor):
+            leaves.append(x)
+            sig.append(("T", tuple(x._value.shape), str(x._value.dtype)))
+            return ("__leaf__", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(go(i) for i in x)
+        if isinstance(x, dict):
+            return {k: go(v) for k, v in x.items()}
+        sig.append(_static_key(x, keepalive))
+        return x
+
+    skeleton = (go(list(args)), go(dict(kwargs)))
+
+    def rebuild(raw_leaves, wrap):
+        def back(x):
+            if isinstance(x, tuple) and len(x) == 2 and x[0] == "__leaf__":
+                return wrap(raw_leaves[x[1]])
+            if isinstance(x, (list, tuple)) and not (len(x) == 2 and x[0] == "__leaf__"):
+                return type(x)(back(i) for i in x)
+            if isinstance(x, dict):
+                return {k: back(v) for k, v in x.items()}
+            return x
+
+        a, k = back(skeleton[0]), back(skeleton[1])
+        return a, k
+
+    return leaves, rebuild, tuple(sig), keepalive
+
+
+class StaticFunction:
+    """Ref: program_translator.py:239 StaticFunction."""
+
+    MAX_CACHE = 64          # LRU bound on compiled variants per function
+    STORM_WARN_EVERY = 16   # warn every N fresh compiles (recompilation storm)
+
+    def __init__(self, function, input_spec=None, build_strategy=None, layer=None, backend=None):
+        self._function = function
+        self._layer = layer
+        self._input_spec = input_spec
+        self._cache: "collections.OrderedDict[Any, Any]" = collections.OrderedDict()
+        self._compile_count = 0
+        self.__name__ = getattr(function, "__name__", "static_fn")
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return functools.partial(self.__call__, instance)
+
+    def _get_layer(self, args):
+        if self._layer is not None:
+            return self._layer, args
+        if args and isinstance(args[0], Layer):
+            return args[0], args[1:]
+        return None, args
+
+    def _build(self, layer, training, n_leaves, rebuild, out_template):
+        fn = self._function
+
+        def pure_fn(param_vals, buffer_vals, key, leaf_vals):
+            with _random.rng_key_scope(key):
+                restore = (layer.bind_functional_state(param_vals, buffer_vals)
+                           if layer is not None else (lambda: None))
+                try:
+                    a, k = rebuild(leaf_vals, lambda raw: Tensor(raw, stop_gradient=True))
+                    # inputs participate in grad: mark diff leaves non-stop so the
+                    # inner tape links them (outer vjp supplies actual cotangents)
+                    with tape.enable_grad():
+                        if layer is not None and self._layer is None:
+                            out = fn(layer, *a, **k)
+                        else:
+                            out = fn(*a, **k)
+                    out_leaves, out_rebuild = _flatten_output(out)
+                    new_buffers = ({kk: b._value for kk, b in layer.named_buffers()}
+                                   if layer is not None else {})
+                    out_template.append(out_rebuild)
+                finally:
+                    restore()
+                return tuple(o._value if isinstance(o, Tensor) else o for o in out_leaves), new_buffers
+
+        return jax.jit(pure_fn)
+
+    def _entry_for(self, layer, training, leaves, rebuild, sig, keepalive):
+        key = (training, sig)
+        entry = self._cache.get(key)
+        if entry is None:
+            self._compile_count += 1
+            if self._compile_count % self.STORM_WARN_EVERY == 0:
+                warnings.warn(
+                    f"to_static('{self.__name__}') compiled {self._compile_count} "
+                    f"variants — each distinct input shape/dtype or non-tensor "
+                    f"argument value triggers a fresh XLA compile. Pad/bucket "
+                    f"dynamic shapes or hoist varying python args out of the "
+                    f"traced function (SURVEY §7.3.4 recompilation storm).",
+                    stacklevel=3)
+            out_template: list = []
+            jitted = self._build(layer, training, len(leaves), rebuild, out_template)
+            # keepalive pins id()-keyed arg objects for the entry's lifetime
+            entry = {"jitted": jitted, "template": out_template,
+                     "keepalive": keepalive}
+            self._cache[key] = entry
+            if len(self._cache) > self.MAX_CACHE:
+                self._cache.popitem(last=False)  # evict LRU compiled variant
+        else:
+            self._cache.move_to_end(key)
+        return entry
+
+    def __call__(self, *args, **kwargs):
+        layer, fargs = self._get_layer(args)
+        leaves, rebuild, sig, keepalive = _tree_flatten_args(fargs, kwargs)
+        training = layer.training if layer is not None else False
+        entry = self._entry_for(layer, training, leaves, rebuild, sig, keepalive)
+        jitted = entry["jitted"]
+
+        if layer is not None:
+            param_items = list(layer.named_parameters())
+            buffer_items = list(layer.named_buffers())
+        else:
+            param_items, buffer_items = [], []
+        param_tensors = [p for _, p in param_items]
+        buffer_vals = {k: b._value for k, b in buffer_items}
+        rng = _random.get_rng_key()
+
+        def closed(*flat):
+            pvals = {k: v for (k, _), v in zip(param_items, flat[: len(param_items)])}
+            lvals = list(flat[len(param_items):])
+            outs, new_bufs = jitted(pvals, buffer_vals, rng, lvals)
+            return (*outs, *[new_bufs[k] for k, _ in buffer_items])
+
+        all_inputs = (*param_tensors, *leaves)
+        result = apply_op(closed, all_inputs, name=f"to_static:{self.__name__}")
+        result = result if isinstance(result, tuple) else (result,)
+        n_buf = len(buffer_items)
+        out_leaves = result[: len(result) - n_buf]
+        # write updated buffers back (BN running stats etc.)
+        for (k, b), new in zip(buffer_items, result[len(result) - n_buf:]):
+            b.set_value(new._value)
+        out_rebuild = entry["template"][0] if entry["template"] else None
+        if out_rebuild is None:
+            return out_leaves[0] if len(out_leaves) == 1 else out_leaves
+        return out_rebuild(list(out_leaves))
+
+    @property
+    def code(self):
+        import inspect
+
+        try:
+            return inspect.getsource(self._function)
+        except Exception:
+            return "<source unavailable>"
+
+    def concrete_program(self, *args, **kwargs):
+        """Reference ConcreteProgram analog: the lowered program + its I/O.
+        Here 'main_program' is the StableHLO text of the traced function."""
+        lowered, leaves = self._lowered(args, kwargs)
+        Concrete = collections.namedtuple("ConcreteProgram",
+                                          ["main_program", "inputs", "outputs"])
+        return Concrete(main_program=lowered.as_text(),
+                        inputs=[("x%d" % i, tuple(l._value.shape),
+                                 str(l._value.dtype)) for i, l in enumerate(leaves)],
+                        outputs=None)
+
+    def get_lowered(self, *args, **kwargs):
+        """Return the jax lowering (StableHLO) for inspection/AOT export
+        (the slot where the reference captured a ProgramDesc; §3.4)."""
+        return self._lowered(args, kwargs)[0]
+
+    def _lowered(self, args, kwargs):
+        layer, fargs = self._get_layer(args)
+        leaves, rebuild, sig, keepalive = _tree_flatten_args(fargs, kwargs)
+        training = layer.training if layer is not None else False
+        entry = self._entry_for(layer, training, leaves, rebuild, sig, keepalive)
+        param_vals = ({k: p._value for k, p in layer.named_parameters()}
+                      if layer is not None else {})
+        buffer_vals = ({k: b._value for k, b in layer.named_buffers()}
+                       if layer is not None else {})
+        key = _random.get_rng_key()
+        lowered = entry["jitted"].lower(param_vals, buffer_vals, key,
+                                        [l._value for l in leaves])
+        return lowered, leaves
+
+
+def _flatten_output(out):
+    leaves = []
+
+    def go(x):
+        if isinstance(x, Tensor):
+            leaves.append(x)
+            return ("__leaf__", len(leaves) - 1)
+        if isinstance(x, (list, tuple)):
+            return type(x)(go(i) for i in x)
+        if isinstance(x, dict):
+            return {k: go(v) for k, v in x.items()}
+        return x
+
+    skeleton = go(out)
+
+    def rebuild(ts):
+        def back(x):
+            if isinstance(x, tuple) and len(x) == 2 and x[0] == "__leaf__":
+                return ts[x[1]]
+            if isinstance(x, (list, tuple)) and not (len(x) == 2 and x[0] == "__leaf__"):
+                return type(x)(back(i) for i in x)
+            if isinstance(x, dict):
+                return {k: back(v) for k, v in x.items()}
+            return x
+
+        return back(skeleton)
+
+    return leaves, rebuild
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, backend=None, **kwargs):
+    """@paddle.jit.to_static parity (ref fluid/dygraph/jit.py:163 declarative)."""
+
+    def decorate(fn):
+        if isinstance(fn, Layer):
+            if getattr(fn.forward, "_paddle_not_to_static", False):
+                return fn
+            sf = StaticFunction(fn.forward, input_spec, build_strategy, layer=fn)
+            fn.forward = sf
+            return fn
+        if getattr(fn, "_paddle_not_to_static", False):
+            return fn
+        return StaticFunction(fn, input_spec, build_strategy)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+declarative = to_static
+
+
+def not_to_static(fn):
+    """Exclude `fn` from to_static conversion (ref jit.py not_to_static):
+    a later to_static(fn) returns it unchanged and it keeps running eagerly."""
+    fn._paddle_not_to_static = True
+    return fn
+
+
+class ignore_module:
+    def __init__(self, modules):
+        pass

@@ -1,0 +1,87 @@
+"""Compiled training step: forward+backward+optimizer in ONE XLA program.
+
+Reference analog: the whole-Program path (`Executor.run` over a Program containing
+forward, appended grad ops and optimizer ops — python/paddle/fluid/backward.py +
+optimizer.minimize).  TPU-native: `jax.value_and_grad` over the model's functional
+state, optimizer update rules applied in-graph, buffers donated so XLA updates
+parameters in place (no host round-trip, no per-op dispatch).
+
+This is the throughput path used by bench.py and hapi.Model.fit(jit=True).
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+from ..framework import random as _random
+from ..optimizer.optimizer import Optimizer
+from ._step_impl import build_step_fn, init_scaler_state
+
+
+class TrainStep:
+    """train_step = TrainStep(model, loss_fn, optimizer); loss = train_step(x, y).
+
+    `accum_steps > 1` accumulates gradients over that many microbatches (batch
+    axis split in-graph, one optimizer update — ref gradient_merge_optimizer).
+    `scaler=GradScaler(...)` runs dynamic fp16 loss scaling inside the compiled
+    step (no host sync; overflow steps skip the update in-graph).
+    """
+
+    def __init__(self, model, loss_fn: Callable, optimizer: Optimizer, donate: bool = True,
+                 accum_steps: int = 1, scaler=None):
+        self.model = model
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self._jitted = None
+        self._param_names = None
+        self._opt_state = None
+        self._donate = donate
+        self.accum_steps = max(1, int(accum_steps))
+        self.scaler = scaler
+        self._scaler_state = None
+
+    def _init(self):
+        params, buffers = self.model.functional_state()
+        self._param_names = list(params.keys())
+        named = dict(self.model.named_parameters())
+        restored = self._opt_state or {}
+        self._opt_state = {
+            k: (restored[k] if restored.get(k) is not None
+                else self.optimizer._init_state(named[k]))
+            for k in self._param_names if not named[k].stop_gradient
+        }
+        trainable = {k for k in self._param_names if not named[k].stop_gradient}
+        self._scaler_state = init_scaler_state(self.scaler)
+
+        step = build_step_fn(self.model, self.loss_fn, self.optimizer, named,
+                             trainable, accum_steps=self.accum_steps,
+                             scaler=self.scaler)
+        donate = (0, 2) if self._donate else ()
+        self._jitted = jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, *batch):
+        if self._jitted is None:
+            self._init()
+        if self.scaler is not None and getattr(self.scaler, "_host_dirty", False):
+            self._scaler_state = init_scaler_state(self.scaler)
+            self.scaler._host_dirty = False
+        params, buffers = self.model.functional_state()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _random.get_rng_key()
+        raw = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
+        new_params, new_buffers, new_opt, new_scaler, loss, aux = self._jitted(
+            params, buffers, self._opt_state, self._scaler_state, lr, key, *raw
+        )
+        self._opt_state = new_opt
+        self._scaler_state = new_scaler
+        if new_scaler is not None:
+            self.scaler._attach_device_state(new_scaler)
+        self.model.load_functional_state(new_params, new_buffers)
+        self.optimizer._step_count += 1
+        loss_t = Tensor(loss)
+        if aux:
+            return (loss_t, *[Tensor(a) for a in aux])
+        return loss_t

@@ -1,0 +1,4 @@
+"""NLP model zoo (PaddleNLP-equivalent families needed by BASELINE configs #4/#5)."""
+from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
+from .bert import BertConfig, BertModel, BertForPretraining, ErnieConfig, ErnieModel, ErnieForPretraining  # noqa: F401
+from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401

@@ -1,0 +1,218 @@
+"""BERT / ERNIE encoder family (BASELINE config #4: ERNIE-3.0 / BERT-base pretrain).
+
+Reference gap: PaddleNLP models live outside the snapshot; structure follows the
+standard BERT encoder with paddle-style MLM+NSP pretraining heads.  ERNIE shares the
+architecture (its contribution is the masking strategy, a data-pipeline concern) —
+ErnieModel aliases the encoder with task-type embeddings added.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.tensor import Tensor
+from ..tensor import manipulation as M
+from ..tensor import creation
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    tensor_parallel: bool = False
+    use_task_id: bool = False  # ERNIE task-type embedding
+
+    @staticmethod
+    def base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=512,
+                    max_position_embeddings=128)
+        base.update(kw)
+        return BertConfig(**base)
+
+
+ErnieConfig = BertConfig
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        Emb = VocabParallelEmbedding if config.tensor_parallel else nn.Embedding
+        self.word_embeddings = Emb(config.vocab_size, config.hidden_size)
+        self.position_embeddings = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.token_type_embeddings = nn.Embedding(config.type_vocab_size, config.hidden_size)
+        if config.use_task_id:
+            self.task_type_embeddings = nn.Embedding(16, config.hidden_size)
+        self.layer_norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self._use_task_id = config.use_task_id
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None, task_type_ids=None):
+        S = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(S, dtype="int32").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = creation.zeros(list(input_ids.shape), "int32")
+        emb = (self.word_embeddings(input_ids)
+               + self.position_embeddings(position_ids)
+               + self.token_type_embeddings(token_type_ids))
+        if self._use_task_id and task_type_ids is not None:
+            emb = emb + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(emb))
+
+
+class BertSelfAttention(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.num_heads = config.num_attention_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        h = config.hidden_size
+        tp = config.tensor_parallel
+        if tp:
+            self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+            self.out = RowParallelLinear(h, h, input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(h, 3 * h)
+            self.out = nn.Linear(h, h)
+        self.attn_drop = config.attention_probs_dropout_prob
+
+    def forward(self, x, mask=None):
+        B, S = x.shape[0], x.shape[1]
+        qkv = self.qkv(x).reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=mask,
+                                             dropout_p=self.attn_drop if self.training else 0.0)
+        return self.out(out.reshape([B, S, self.num_heads * self.head_dim]))
+
+
+class BertLayer(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        h = config.hidden_size
+        tp = config.tensor_parallel
+        self.attention = BertSelfAttention(config)
+        self.attn_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        if tp:
+            self.ffn_in = ColumnParallelLinear(h, config.intermediate_size, gather_output=False)
+            self.ffn_out = RowParallelLinear(config.intermediate_size, h, input_is_parallel=True)
+        else:
+            self.ffn_in = nn.Linear(h, config.intermediate_size)
+            self.ffn_out = nn.Linear(config.intermediate_size, h)
+        self.ffn_norm = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.dropout = nn.Dropout(config.hidden_dropout_prob)
+        self.act = getattr(F, config.hidden_act)
+
+    def forward(self, x, mask=None):
+        x = self.attn_norm(x + self.dropout(self.attention(x, mask)))
+        x = self.ffn_norm(x + self.dropout(self.ffn_out(self.act(self.ffn_in(x)))))
+        return x
+
+
+class BertModel(nn.Layer):
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = BertEmbeddings(config)
+        self.encoder = nn.LayerList([BertLayer(config) for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None, task_type_ids=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B,S] 1/0 mask -> additive [B,1,1,S]
+            m = (1.0 - attention_mask.astype("float32")) * -1e9
+            attention_mask = m.unsqueeze(1).unsqueeze(1)
+        x = self.embeddings(input_ids, token_type_ids, task_type_ids=task_type_ids)
+        for layer in self.encoder:
+            x = layer(x, attention_mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+ErnieModel = BertModel
+
+
+class BertPretrainingHeads(nn.Layer):
+    """MLM transform + decoder and NSP head.  When `embedding_weights` (the
+    [vocab, hidden] word-embedding Parameter) is given, the MLM decoder is TIED to
+    it — logits = x @ W_emb^T + b — matching the reference pretraining setup."""
+
+    def __init__(self, config: BertConfig, embedding_weights=None):
+        super().__init__()
+        self.transform = nn.Linear(config.hidden_size, config.hidden_size)
+        self.act = getattr(F, config.hidden_act)
+        self.norm = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+        if embedding_weights is not None:
+            # bypass Layer.__setattr__: the Parameter must stay registered ONLY under
+            # the embedding's name or the functional path would train two copies
+            object.__setattr__(self, "_tied_weight", embedding_weights)
+            self.decoder_bias = self.create_parameter(
+                [config.vocab_size], is_bias=True,
+                default_initializer=nn.initializer.Constant(0.0))
+            self.decoder = None
+        else:
+            object.__setattr__(self, "_tied_weight", None)
+            self.decoder = nn.Linear(config.hidden_size, config.vocab_size)
+        self.seq_relationship = nn.Linear(config.hidden_size, 2)
+
+    def forward(self, sequence_output, pooled_output):
+        x = self.norm(self.act(self.transform(sequence_output)))
+        if self._tied_weight is not None:
+            from ..tensor import linalg as L
+
+            mlm = L.matmul(x, self._tied_weight, transpose_y=True) + self.decoder_bias
+        else:
+            mlm = self.decoder(x)
+        return mlm, self.seq_relationship(pooled_output)
+
+
+class BertForPretraining(nn.Layer):
+    """MLM + NSP pretraining (the config #4 objective)."""
+
+    def __init__(self, config: BertConfig):
+        super().__init__()
+        self.config = config
+        self.bert = BertModel(config)
+        self.cls = BertPretrainingHeads(
+            config, embedding_weights=self.bert.embeddings.word_embeddings.weight)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None,
+                masked_lm_labels=None, next_sentence_label=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        mlm_logits, nsp_logits = self.cls(seq, pooled)
+        if masked_lm_labels is not None:
+            mlm_loss = F.cross_entropy(
+                mlm_logits.reshape([-1, self.config.vocab_size]),
+                masked_lm_labels.reshape([-1]),
+                ignore_index=-100,
+            )
+            loss = mlm_loss
+            if next_sentence_label is not None:
+                loss = loss + F.cross_entropy(nsp_logits, next_sentence_label.reshape([-1]))
+            return loss, mlm_logits
+        return mlm_logits, nsp_logits
+
+
+class ErnieForPretraining(BertForPretraining):
+    def __init__(self, config: BertConfig):
+        import dataclasses
+
+        super().__init__(dataclasses.replace(config, use_task_id=True))

@@ -1,0 +1,110 @@
+"""GPT family (learned-position causal decoder; complements LLaMA for the zoo)."""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor import creation
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    layer_norm_eps: float = 1e-5
+    tensor_parallel: bool = False
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=1024, hidden_size=128, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=512, max_position_embeddings=128)
+        base.update(kw)
+        return GPTConfig(**base)
+
+
+class GPTBlock(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        h = config.hidden_size
+        tp = config.tensor_parallel
+        self.ln_1 = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.num_heads = config.num_attention_heads
+        self.head_dim = h // config.num_attention_heads
+        if tp:
+            self.qkv = ColumnParallelLinear(h, 3 * h, gather_output=False)
+            self.proj = RowParallelLinear(h, h, input_is_parallel=True)
+            self.fc_in = ColumnParallelLinear(h, config.intermediate_size, gather_output=False)
+            self.fc_out = RowParallelLinear(config.intermediate_size, h, input_is_parallel=True)
+        else:
+            self.qkv = nn.Linear(h, 3 * h)
+            self.proj = nn.Linear(h, h)
+            self.fc_in = nn.Linear(h, config.intermediate_size)
+            self.fc_out = nn.Linear(config.intermediate_size, h)
+        self.ln_2 = nn.LayerNorm(h, epsilon=config.layer_norm_eps)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.attn_drop = config.attention_probs_dropout_prob
+
+    def forward(self, x):
+        B, S = x.shape[0], x.shape[1]
+        h = self.ln_1(x)
+        qkv = self.qkv(h).reshape([B, S, 3, self.num_heads, self.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(
+            q, k, v, is_causal=True,
+            dropout_p=self.attn_drop if self.training else 0.0,
+        )
+        x = x + self.drop(self.proj(attn.reshape([B, S, -1])))
+        x = x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+        return x
+
+
+class GPTModel(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        Emb = VocabParallelEmbedding if config.tensor_parallel else nn.Embedding
+        self.wte = Emb(config.vocab_size, config.hidden_size)
+        self.wpe = nn.Embedding(config.max_position_embeddings, config.hidden_size)
+        self.drop = nn.Dropout(config.hidden_dropout_prob)
+        self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
+        self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
+
+    def forward(self, input_ids):
+        S = input_ids.shape[1]
+        pos = creation.arange(S, dtype="int32").unsqueeze(0)
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        for block in self.h:
+            x = block(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(nn.Layer):
+    def __init__(self, config: GPTConfig):
+        super().__init__()
+        self.config = config
+        self.gpt = GPTModel(config)
+        if config.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                                has_bias=False, gather_output=True)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        logits = self.lm_head(self.gpt(input_ids))
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]), ignore_index=-100,
+            )
+            return loss, logits
+        return logits

@@ -1,0 +1,242 @@
+"""LLaMA-2 family (BASELINE config #5: LLaMA-2-7B hybrid tp+pp+sharding-stage-2).
+
+Reference gap: the Paddle snapshot has no LLaMA (PaddleNLP's lives outside the repo);
+this is the TPU-native flagship decoder: RMSNorm + RoPE + GQA + SwiGLU, with
+Megatron-style TP expressed as sharding annotations (mp_layers) so the SAME module
+runs dense on one chip or tp/dp/pp/sharded on a mesh via ShardedTrainStep /
+PipelineTrainStep.  Attention routes through F.scaled_dot_product_attention, which
+selects the Pallas flash kernel on TPU for long sequences.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+import numpy as np
+
+from .. import nn
+from ..nn import functional as F
+from ..tensor.tensor import Tensor, apply_op
+from ..tensor import manipulation as M
+from ..distributed.meta_parallel.mp_layers import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+)
+from ..distributed.sharding_ctx import annotate, constraint
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    use_flash_attention: bool = True
+    dtype: str = "float32"
+    # parallel plan (consumed via sharding annotations)
+    tensor_parallel: bool = True
+    sequence_parallel: bool = False
+
+    @staticmethod
+    def llama2_7b(**kw):
+        return LlamaConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=1024, hidden_size=256, intermediate_size=688,
+                    num_hidden_layers=2, num_attention_heads=8, num_key_value_heads=4,
+                    max_position_embeddings=512)
+        base.update(kw)
+        return LlamaConfig(**base)
+
+
+def _rope_cache(head_dim, max_pos, theta):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32) / head_dim))
+    t = np.arange(max_pos, dtype=np.float32)
+    freqs = np.outer(t, inv)  # [T, D/2]
+    return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
+
+
+def apply_rope(x, cos, sin, position_offset=0):
+    """x: [B, S, H, D] raw array; rotate pairs (x1,x2) per RoPE."""
+    S, D = x.shape[1], x.shape[-1]
+    c = cos[position_offset:position_offset + S][None, :, None, :]  # [1,S,1,D/2]
+    s = sin[position_offset:position_offset + S][None, :, None, :]
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    o1 = x1 * c - x2 * s
+    o2 = x2 * c + x1 * s
+    out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
+    return out
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.hidden_size = config.hidden_size
+        self.num_heads = config.num_attention_heads
+        self.num_kv_heads = config.num_key_value_heads
+        self.head_dim = config.hidden_size // config.num_attention_heads
+        tp = config.tensor_parallel
+        Lin = ColumnParallelLinear if tp else nn.Linear
+        mk = (lambda i, o: ColumnParallelLinear(i, o, has_bias=False, gather_output=False)) if tp \
+            else (lambda i, o: nn.Linear(i, o, bias_attr=False))
+        self.q_proj = mk(self.hidden_size, self.num_heads * self.head_dim)
+        self.k_proj = mk(self.hidden_size, self.num_kv_heads * self.head_dim)
+        self.v_proj = mk(self.hidden_size, self.num_kv_heads * self.head_dim)
+        if tp:
+            self.o_proj = RowParallelLinear(self.num_heads * self.head_dim, self.hidden_size,
+                                            has_bias=False, input_is_parallel=True)
+        else:
+            self.o_proj = nn.Linear(self.num_heads * self.head_dim, self.hidden_size, bias_attr=False)
+
+    def forward(self, hidden_states, rope, attn_mask=None, cache=None, use_cache=False):
+        """rope: (cos, sin) Tensors shared at LlamaModel level (one copy, not 32).
+        cache=None with use_cache=True is the prefill step: the returned cache is
+        this call's own k/v."""
+        rope_cos, rope_sin = rope
+        B, S = hidden_states.shape[0], hidden_states.shape[1]
+        q = self.q_proj(hidden_states).reshape([B, S, self.num_heads, self.head_dim])
+        k = self.k_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
+
+        offset = cache[0].shape[1] if cache is not None else 0
+        q = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (q, rope_cos, rope_sin), name="rope")
+        k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, rope_cos, rope_sin), name="rope")
+
+        if cache is not None:
+            k = M.concat([cache[0], k], axis=1)
+            v = M.concat([cache[1], v], axis=1)
+        new_cache = (k, v) if use_cache else None
+
+        # GQA: repeat kv heads to match q heads
+        if self.num_kv_heads != self.num_heads:
+            rep = self.num_heads // self.num_kv_heads
+            k = apply_op(lambda a: jnp.repeat(a, rep, axis=2), (k,), name="gqa_repeat")
+            v = apply_op(lambda a: jnp.repeat(a, rep, axis=2), (v,), name="gqa_repeat")
+
+        backend = "auto" if self.config.use_flash_attention else "math"
+        out = F.scaled_dot_product_attention(
+            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None, backend=backend,
+        )
+        out = out.reshape([B, S, self.num_heads * self.head_dim])
+        out = self.o_proj(out)
+        if use_cache:
+            return out, new_cache
+        return out
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        tp = config.tensor_parallel
+        h, inter = config.hidden_size, config.intermediate_size
+        if tp:
+            self.gate_proj = ColumnParallelLinear(h, inter, has_bias=False, gather_output=False)
+            self.up_proj = ColumnParallelLinear(h, inter, has_bias=False, gather_output=False)
+            self.down_proj = RowParallelLinear(inter, h, has_bias=False, input_is_parallel=True)
+        else:
+            self.gate_proj = nn.Linear(h, inter, bias_attr=False)
+            self.up_proj = nn.Linear(h, inter, bias_attr=False)
+            self.down_proj = nn.Linear(inter, h, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.self_attn = LlamaAttention(config)
+        self.mlp = LlamaMLP(config)
+        self.input_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        self.post_attention_layernorm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, x, rope, attn_mask=None, cache=None, use_cache=False):
+        h = self.input_layernorm(x)
+        if use_cache:
+            attn_out, new_cache = self.self_attn(h, rope, attn_mask, cache, use_cache=True)
+        else:
+            attn_out = self.self_attn(h, rope, attn_mask)
+        x = x + attn_out
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        if use_cache:
+            return x, new_cache
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        if config.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(config.vocab_size, config.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(config.vocab_size, config.hidden_size)
+        self.layers = nn.LayerList([LlamaDecoderLayer(config) for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+        cos, sin = _rope_cache(config.hidden_size // config.num_attention_heads,
+                               config.max_position_embeddings, config.rope_theta)
+        self.register_buffer("rope_cos", Tensor(cos), persistable=False)
+        self.register_buffer("rope_sin", Tensor(sin), persistable=False)
+
+    def forward(self, input_ids, attn_mask=None, caches=None, use_cache=False):
+        """caches=[None]*num_layers (or caches=None with use_cache=True) is the
+        prefill bootstrap; each entry is then a (k, v) pair for the decode steps."""
+        use_cache = use_cache or caches is not None
+        if use_cache and caches is None:
+            caches = [None] * len(self.layers)
+        x = self.embed_tokens(input_ids)
+        rope = (self.rope_cos, self.rope_sin)
+        new_caches = [] if use_cache else None
+        for i, layer in enumerate(self.layers):
+            if use_cache:
+                x, c = layer(x, rope, attn_mask, caches[i], use_cache=True)
+                new_caches.append(c)
+            else:
+                x = layer(x, rope, attn_mask)
+        x = self.norm(x)
+        if use_cache:
+            return x, new_caches
+        return x
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        self.llama = LlamaModel(config)
+        if config.tensor_parallel:
+            self.lm_head = ColumnParallelLinear(config.hidden_size, config.vocab_size,
+                                                has_bias=False, gather_output=True)
+        else:
+            self.lm_head = nn.Linear(config.hidden_size, config.vocab_size, bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.llama(input_ids)
+        logits = self.lm_head(hidden)
+        if labels is not None:
+            loss = F.cross_entropy(
+                logits.reshape([-1, self.config.vocab_size]),
+                labels.reshape([-1]),
+                ignore_index=-100,
+            )
+            return loss, logits
+        return logits
+
+    @property
+    def num_params(self):
+        import numpy as np
+
+        return sum(int(np.prod(p.shape)) for p in self.parameters())
+
+    def generate_step(self, input_ids, caches=None):
+        """Prefill (caches=None) or single-token decode step (inference path)."""
+        hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)
+        return self.lm_head(hidden[:, -1:]), caches

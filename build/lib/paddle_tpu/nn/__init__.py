@@ -1,0 +1,100 @@
+"""paddle.nn parity surface (ref: python/paddle/nn/__init__.py)."""
+from .layer.layers import Layer  # noqa: F401
+from .layer.container import Sequential, LayerList, ParameterList, LayerDict  # noqa: F401
+from .layer.common import (  # noqa: F401
+    Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
+    Flatten, Pad1D, Pad2D, Pad3D, ZeroPad2D, Upsample, UpsamplingBilinear2D,
+    UpsamplingNearest2D, PixelShuffle, PixelUnshuffle, Bilinear, CosineSimilarity,
+    Unfold, Fold,
+)
+from .layer.conv import (  # noqa: F401
+    Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
+)
+from .layer.norm import (  # noqa: F401
+    BatchNorm, BatchNorm1D, BatchNorm2D, BatchNorm3D, SyncBatchNorm,
+    LayerNorm, RMSNorm, GroupNorm, InstanceNorm1D, InstanceNorm2D, InstanceNorm3D,
+    LocalResponseNorm,
+)
+from .layer.pooling import (  # noqa: F401
+    MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
+    AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+)
+from .layer.activation import (  # noqa: F401
+    ReLU, ReLU6, GELU, Sigmoid, Tanh, Softmax, LogSoftmax, LogSigmoid, Softplus,
+    Softsign, Softshrink, Hardshrink, Hardsigmoid, Hardswish, Hardtanh, LeakyReLU,
+    ELU, SELU, CELU, Silu, Swish, Mish, Tanhshrink, ThresholdedReLU, Maxout, GLU,
+    RReLU, PReLU,
+)
+from .layer.loss import (  # noqa: F401
+    CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
+    KLDivLoss, SmoothL1Loss, MarginRankingLoss, CTCLoss, HingeEmbeddingLoss,
+    CosineEmbeddingLoss, TripletMarginLoss,
+)
+from .layer.transformer import (  # noqa: F401
+    MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
+    TransformerDecoderLayer, TransformerDecoder, Transformer,
+)
+from .layer.rnn import (  # noqa: F401
+    RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU,
+)
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from ..framework.param_attr import ParamAttr  # noqa: F401
+
+
+class ClipGradByGlobalNorm:
+    """Ref: fluid/clip.py GradientClipByGlobalNorm — consumed by Optimizer."""
+
+    def __init__(self, clip_norm=1.0, group_name="default", auto_skip_clip=False):
+        self.clip_norm = clip_norm
+
+    def __repr__(self):
+        return f"ClipGradByGlobalNorm(clip_norm={self.clip_norm})"
+
+
+class ClipGradByNorm:
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = clip_norm
+
+
+class ClipGradByValue:
+    def __init__(self, max, min=None):
+        self.max = max
+        self.min = min if min is not None else -max
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """paddle.nn.utils.clip_grad_norm_ parity (eager)."""
+    import jax.numpy as jnp
+
+    params = [p for p in parameters if p._grad is not None]
+    if not params:
+        return None
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(p._grad.astype(jnp.float32))) for p in params))
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p._grad = (p._grad.astype(jnp.float32) * scale).astype(p._grad.dtype)
+    from ..tensor.tensor import Tensor
+
+    return Tensor(total)
+
+
+class utils:  # namespace shim: paddle.nn.utils
+    clip_grad_norm_ = staticmethod(clip_grad_norm_)
+
+    @staticmethod
+    def parameters_to_vector(parameters):
+        import jax.numpy as jnp
+        from ..tensor.tensor import Tensor
+
+        return Tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
+
+    @staticmethod
+    def vector_to_parameters(vec, parameters):
+        import numpy as np
+
+        offset = 0
+        for p in parameters:
+            n = int(np.prod(p._value.shape))
+            p.set_value(vec._value[offset:offset + n].reshape(p._value.shape))
+            offset += n

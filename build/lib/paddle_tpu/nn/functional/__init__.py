@@ -1,0 +1,33 @@
+"""paddle.nn.functional parity surface (ref: python/paddle/nn/functional/__init__.py)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import (  # noqa: F401
+    conv1d,
+    conv2d,
+    conv3d,
+    conv1d_transpose,
+    conv2d_transpose,
+    conv3d_transpose,
+)
+from .norm import (  # noqa: F401
+    batch_norm,
+    layer_norm,
+    instance_norm,
+    group_norm,
+    local_response_norm,
+    rms_norm,
+)
+from .pooling import (  # noqa: F401
+    max_pool1d,
+    max_pool2d,
+    max_pool3d,
+    avg_pool1d,
+    avg_pool2d,
+    avg_pool3d,
+    adaptive_avg_pool1d,
+    adaptive_avg_pool2d,
+    adaptive_max_pool1d,
+    adaptive_max_pool2d,
+)
+from .loss import *  # noqa: F401,F403
+from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401

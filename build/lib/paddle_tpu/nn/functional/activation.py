@@ -1,0 +1,135 @@
+"""Activation functionals (ref: python/paddle/nn/functional/activation.py).
+
+Pure jax.nn/jnp compositions — XLA fuses these into adjacent matmuls on TPU, replacing
+the reference's hand-written CUDA activation kernels (phi/kernels/gpu/activation_*).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import apply_op
+
+
+def _mk(name, fn):
+    def op(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        return apply_op(lambda v: fn(v, *args, **kwargs), (x,), name=name)
+
+    op.__name__ = name
+    return op
+
+
+relu = _mk("relu", jax.nn.relu)
+relu6 = _mk("relu6", jax.nn.relu6)
+sigmoid = _mk("sigmoid", jax.nn.sigmoid)
+tanh = _mk("tanh", jnp.tanh)
+softplus = _mk("softplus", lambda v, beta=1.0, threshold=20.0: jnp.where(v * beta > threshold, v, jax.nn.softplus(v * beta) / beta))
+softsign = _mk("softsign", jax.nn.soft_sign)
+silu = _mk("silu", jax.nn.silu)
+swish = silu
+mish = _mk("mish", lambda v: v * jnp.tanh(jax.nn.softplus(v)))
+tanhshrink = _mk("tanhshrink", lambda v: v - jnp.tanh(v))
+log_sigmoid = _mk("log_sigmoid", jax.nn.log_sigmoid)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply_op(lambda v: jax.nn.gelu(v, approximate=approximate), (x,), name="gelu")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply_op(lambda v: jax.nn.leaky_relu(v, negative_slope), (x,), name="leaky_relu")
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.elu(v, alpha), (x,), name="elu")
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply_op(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), (x,), name="selu")
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply_op(lambda v: jax.nn.celu(v, alpha), (x,), name="celu")
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply_op(lambda v: jnp.clip(v, min, max), (x,), name="hardtanh")
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply_op(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), (x,), name="hardshrink")
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply_op(
+        lambda v: jnp.where(v > threshold, v - threshold, jnp.where(v < -threshold, v + threshold, 0.0)),
+        (x,),
+        name="softshrink",
+    )
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply_op(lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), (x,), name="hardsigmoid")
+
+
+def hardswish(x, name=None):
+    return apply_op(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, (x,), name="hardswish")
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op(lambda v: jax.nn.softmax(v, axis=axis), (x,), name="softmax")
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply_op(lambda v: jax.nn.log_softmax(v, axis=axis), (x,), name="log_softmax")
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as _random
+
+    def _f(v):
+        g = jax.random.gumbel(_random.get_rng_key(), v.shape, v.dtype)
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.put_along_axis(jnp.zeros_like(y), idx, 1.0, axis=axis, inplace=False)
+            y = jax.lax.stop_gradient(y_hard - y) + y  # straight-through estimator
+        return y
+
+    return apply_op(_f, (x,), name="gumbel_softmax")
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def _f(v, w):
+        if w.size == 1:
+            return jnp.where(v > 0, v, w.reshape(()) * v)
+        shape = [1] * v.ndim
+        ch_axis = 1 if data_format == "NCHW" else v.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(v > 0, v, w.reshape(shape) * v)
+
+    return apply_op(_f, (x, weight), name="prelu")
+
+
+def rrelu(x, lower=0.125, upper=0.333, training=False, name=None):
+    mid = (lower + upper) / 2.0
+    return apply_op(lambda v: jnp.where(v >= 0, v, mid * v), (x,), name="rrelu")
+
+
+def glu(x, axis=-1, name=None):
+    return apply_op(lambda v: jax.nn.glu(v, axis=axis), (x,), name="glu")
+
+
+def maxout(x, groups, axis=1, name=None):
+    def _f(v):
+        s = list(v.shape)
+        c = s[axis]
+        s[axis:axis + 1] = [c // groups, groups]
+        return jnp.max(v.reshape(s), axis=axis + 1)
+
+    return apply_op(_f, (x,), name="maxout")
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply_op(lambda v: jnp.where(v > threshold, v, 0.0), (x,), name="thresholded_relu")

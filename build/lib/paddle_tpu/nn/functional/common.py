@@ -1,0 +1,306 @@
+"""Common functionals: linear/dropout/pad/embedding/interpolate/one_hot/...
+
+Reference: python/paddle/nn/functional/common.py + input.py.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Tensor, apply_op, _unwrap
+from ...framework import random as _random
+from ...core import dtypes as _dt
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b — the MXU workhorse (ref: phi MatmulKernel + EW add fusion)."""
+
+    def _f(v, w, b):
+        out = jnp.matmul(v, w)
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(_f, (x, weight, bias), name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training and p > 0.0:
+            return apply_op(lambda v: v * (1.0 - float(p)), (x,), name="dropout_infer")
+        return apply_op(lambda v: v, (x,), name="dropout_id")
+    rate = float(p)
+
+    def _f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(_random.get_rng_key(), 1.0 - rate, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - rate), jnp.zeros_like(v))
+        return jnp.where(keep, v, jnp.zeros_like(v))
+
+    return apply_op(_f, (x,), name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axis = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axis = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axis, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return apply_op(lambda v: v, (x,), name="alpha_dropout_id")
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def _f(v):
+        keep = jax.random.bernoulli(_random.get_rng_key(), 1.0 - p, v.shape)
+        a = (1.0 / ((1.0 - p) * (1.0 + p * alpha_p**2)) ** 0.5)
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, alpha_p) + b
+
+    return apply_op(_f, (x,), name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Ref: phi EmbeddingKernel; gather feeding the MXU-heavy layers above it."""
+
+    def _f(idx, w):
+        out = jnp.take(w, idx.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (idx == padding_idx)[..., None]
+            out = jnp.where(mask, jnp.zeros_like(out), out)
+        return out
+
+    return apply_op(lambda w, idx: _f(idx, w), (weight, _unwrap(x)), name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(lambda v: jax.nn.one_hot(v.astype(jnp.int32), num_classes, dtype=_dt.get_default_dtype()), (x,), name="one_hot")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def _f(l, pd):
+        k = l.shape[-1]
+        if pd is not None:
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+
+    return apply_op(_f, (label, prior_dist), name="label_smooth")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", pad_from_left_axis=False, name=None):
+    """Paddle pad: `pad` is [last-dim lo, hi, 2nd-last lo, hi, ...] for the int-list
+    form applied per data_format spatial dims, or full per-axis when len==2*ndim."""
+
+    def _f(v, padlist):
+        nd = v.ndim
+        if isinstance(padlist, (list, tuple)) and len(padlist) == 2 * nd:
+            cfg = [(int(padlist[2 * i]), int(padlist[2 * i + 1])) for i in range(nd)]
+        else:
+            # spatial form: applies to W (and H, D) depending on rank & format
+            p = [int(q) for q in padlist]
+            cfg = [(0, 0)] * nd
+            if data_format.startswith("NC"):
+                spatial = list(range(2, nd))
+            else:
+                spatial = list(range(1, nd - 1))
+            # paddle order: innermost (last spatial) first
+            pairs = [(p[i], p[i + 1]) for i in range(0, len(p), 2)]
+            for ax, pr in zip(reversed(spatial), pairs):
+                cfg[ax] = pr
+        jmode = {"constant": "constant", "reflect": "reflect", "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(v, cfg, mode="constant", constant_values=value)
+        return jnp.pad(v, cfg, mode=jmode)
+
+    padlist = pad if not isinstance(pad, Tensor) else [int(i) for i in np.asarray(pad._value)]
+    return apply_op(lambda v: _f(v, padlist), (x,), name="pad")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    return pad(x, padding, mode="constant", value=0.0, data_format=data_format)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def _f(v):
+        n = jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=axis, keepdims=True), 1.0 / p)
+        return v / jnp.maximum(n, epsilon)
+
+    return apply_op(_f, (x,), name="normalize")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    from ...tensor.linalg import cosine_similarity as _cs
+
+    return _cs(x1, x2, axis, eps)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+                align_mode=0, data_format="NCHW", name=None):
+    """Ref: phi InterpolateKernel. Uses jax.image.resize for the core method."""
+
+    def _out_size(v):
+        if data_format == "NCHW":
+            spatial = v.shape[2:]
+        else:
+            spatial = v.shape[1:-1]
+        if size is not None:
+            s = size if not isinstance(size, Tensor) else [int(i) for i in np.asarray(size._value)]
+            return tuple(int(i) if not isinstance(i, Tensor) else int(i.item()) for i in s)
+        sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * len(spatial)
+        return tuple(int(d * f) for d, f in zip(spatial, sf))
+
+    method = {"nearest": "nearest", "bilinear": "bilinear", "bicubic": "bicubic",
+              "trilinear": "trilinear", "linear": "linear", "area": "linear"}[mode]
+
+    def _f(v):
+        out_sp = _out_size(v)
+        if data_format == "NCHW":
+            full = v.shape[:2] + out_sp
+        else:
+            full = (v.shape[0],) + out_sp + (v.shape[-1],)
+        if align_corners and method != "nearest" and all(o > 1 for o in out_sp):
+            # align_corners resize via explicit gather
+            if data_format == "NCHW":
+                sp_axes = list(range(2, v.ndim))
+            else:
+                sp_axes = list(range(1, v.ndim - 1))
+            out = v
+            for ax, o in zip(sp_axes, out_sp):
+                n = out.shape[ax]
+                pos = jnp.linspace(0.0, n - 1.0, o)
+                lo = jnp.floor(pos).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, n - 1)
+                w = (pos - lo).astype(v.dtype)
+                a = jnp.take(out, lo, axis=ax)
+                b = jnp.take(out, hi, axis=ax)
+                shape = [1] * out.ndim
+                shape[ax] = o
+                w = w.reshape(shape)
+                out = a * (1 - w) + b * w
+            return out
+        if method == "trilinear":
+            return jax.image.resize(v, full, method="linear" if v.ndim == 5 else method)
+        return jax.image.resize(v, full, method=method)
+
+    return apply_op(_f, (x,), name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest", align_corners=False,
+             align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def _f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            v = v.reshape(n, c // (r * r), r, r, h, w)
+            v = v.transpose(0, 1, 4, 2, 5, 3)
+            return v.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = v.shape
+        v = v.reshape(n, h, w, r, r, c // (r * r))
+        v = v.transpose(0, 1, 3, 2, 4, 5)
+        return v.reshape(n, h * r, w * r, c // (r * r))
+
+    return apply_op(_f, (x,), name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def _f(v):
+        n, c, h, w = v.shape
+        v = v.reshape(n, c, h // r, r, w // r, r)
+        v = v.transpose(0, 1, 3, 5, 2, 4)
+        return v.reshape(n, c * r * r, h // r, w // r)
+
+    return apply_op(_f, (x,), name="pixel_unshuffle")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col (ref: phi UnfoldKernel)."""
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def _f(v):
+        n, c, h, w = v.shape
+        v = jnp.pad(v, [(0, 0), (0, 0), (pd[0], pd[2]), (pd[1], pd[3])])
+        oh = (v.shape[2] - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        ow = (v.shape[3] - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        patches = jax.lax.conv_general_dilated_patches(
+            v, filter_shape=ks, window_strides=st, padding="VALID", rhs_dilation=dl,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )  # [n, c*kh*kw, oh, ow]
+        return patches.reshape(n, c * ks[0] * ks[1], oh * ow)
+
+    return apply_op(_f, (x,), name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    if len(pd) == 2:
+        pd = [pd[0], pd[1], pd[0], pd[1]]
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+    oh, ow = output_sizes
+
+    def _f(v):
+        n, ckk, L = v.shape
+        c = ckk // (ks[0] * ks[1])
+        ph, pw = oh + pd[0] + pd[2], ow + pd[1] + pd[3]
+        nh = (ph - (dl[0] * (ks[0] - 1) + 1)) // st[0] + 1
+        nw = (pw - (dl[1] * (ks[1] - 1) + 1)) // st[1] + 1
+        out = jnp.zeros((n, c, ph, pw), v.dtype)
+        v = v.reshape(n, c, ks[0], ks[1], nh, nw)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                hs = i * dl[0]
+                ws = j * dl[1]
+                out = out.at[:, :, hs:hs + nh * st[0]:st[0], ws:ws + nw * st[1]:st[1]].add(v[:, :, i, j])
+        return out[:, :, pd[0]:pd[0] + oh, pd[1]:pd[1] + ow]
+
+    return apply_op(_f, (x,), name="fold")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def _f(a, b, w, bi):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bi is not None:
+            out = out + bi
+        return out
+
+    return apply_op(_f, (x1, x2, weight, bias), name="bilinear")
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    def _f(v):
+        m = maxlen if maxlen is not None else int(jnp.max(v))
+        return (jnp.arange(m)[None, :] < v[..., None]).astype(_dt.convert_dtype(dtype))
+
+    if maxlen is None:
+        v = np.asarray(_unwrap(x))
+        m = int(v.max())
+        return Tensor(jnp.asarray((np.arange(m)[None, :] < v[..., None]).astype(str(_dt.convert_dtype(dtype)))))
+    return apply_op(_f, (x,), name="sequence_mask")
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample pending")

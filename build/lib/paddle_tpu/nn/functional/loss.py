@@ -1,0 +1,274 @@
+"""Loss functionals (ref: python/paddle/nn/functional/loss.py, phi CrossEntropyKernel).
+
+cross_entropy keeps the reference's semantics: int or soft labels, ignore_index,
+weight, reduction, use_softmax toggle (softmax_with_cross_entropy fusion is XLA's job).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, apply_op, _unwrap
+
+
+def _reduce(v, reduction, weight_sum=None):
+    if reduction == "mean":
+        if weight_sum is not None:
+            return jnp.sum(v) / jnp.maximum(weight_sum, 1e-12)
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
+    def _f(logits, lbl, w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, None))
+        nclass = logits.shape[axis]
+        if soft_label:
+            soft = lbl
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / nclass
+            per = -jnp.sum(soft * logp, axis=axis)
+            if reduction == "none":
+                return per
+            return _reduce(per, reduction)
+        idx = lbl.astype(jnp.int32)
+        if idx.ndim == logp.ndim:  # [N, ..., 1] form
+            idx = jnp.squeeze(idx, axis=axis)
+        safe_idx = jnp.where(idx == ignore_index, 0, idx)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe_idx, axis), axis=axis)
+        per = -jnp.squeeze(picked, axis=axis)
+        if label_smoothing > 0:
+            smooth = -jnp.mean(logp, axis=axis)
+            per = (1 - label_smoothing) * per + label_smoothing * smooth
+        valid = (idx != ignore_index).astype(per.dtype)
+        per = per * valid
+        if w is not None:
+            wt = jnp.take(w, safe_idx, axis=0) * valid
+            per = per * jnp.take(w, safe_idx, axis=0)
+            if reduction == "mean":
+                return jnp.sum(per * valid) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid), 1.0)
+        return _reduce(per, reduction)
+
+    return apply_op(_f, (input, label, weight), name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, ignore_index=-100,
+                               numeric_stable_mode=True, return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label, ignore_index=ignore_index,
+                         reduction="none", axis=axis)
+    from .activation import softmax as _softmax
+
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean", name=None):
+    def _f(logp, lbl, w):
+        idx = lbl.astype(jnp.int32)
+        safe = jnp.where(idx == ignore_index, 0, idx)
+        per = -jnp.take_along_axis(logp, safe[:, None] if logp.ndim == 2 else jnp.expand_dims(safe, 1), axis=1)
+        per = jnp.squeeze(per, axis=1)
+        valid = (idx != ignore_index).astype(per.dtype)
+        if w is not None:
+            wt = jnp.take(w, safe, axis=0)
+            per = per * wt
+            if reduction == "mean":
+                return jnp.sum(per * valid) / jnp.maximum(jnp.sum(wt * valid), 1e-12)
+        per = per * valid
+        if reduction == "mean":
+            return jnp.sum(per) / jnp.maximum(jnp.sum(valid), 1.0)
+        return _reduce(per, reduction)
+
+    return apply_op(_f, (input, label, weight), name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction), (input, label), name="mse_loss")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction), (input, label), name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def _f(a, b):
+        d = jnp.abs(a - b)
+        v = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(v, reduction)
+
+    return apply_op(_f, (input, label), name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def _f(p, y, w):
+        p = jnp.clip(p, 1e-12, 1 - 1e-12)
+        per = -(y * jnp.log(p) + (1 - y) * jnp.log1p(-p))
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+
+    return apply_op(_f, (input, label, weight), name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def _f(z, y, w, pw):
+        # numerically-stable BCE-with-logits
+        neg_abs = -jnp.abs(z)
+        if pw is not None:
+            log_w = (pw - 1) * y + 1
+            per = (1 - y) * z + log_w * (jnp.log1p(jnp.exp(neg_abs)) + jnp.maximum(-z, 0.0))
+        else:
+            per = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(neg_abs))
+        if w is not None:
+            per = per * w
+        return _reduce(per, reduction)
+
+    return apply_op(_f, (logit, label, weight, pos_weight), name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    def _f(logp, tgt):
+        per = tgt * (jnp.log(jnp.clip(tgt, 1e-12, None)) - logp)
+        if reduction == "batchmean":
+            return jnp.sum(per) / logp.shape[0]
+        return _reduce(per, reduction)
+
+    return apply_op(_f, (input, label), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean", name=None):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin), reduction),
+        (input, other, label),
+        name="margin_ranking_loss",
+    )
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean"):
+    return apply_op(
+        lambda x, y: _reduce(jnp.where(y == 1, x, jnp.maximum(0.0, margin - x)), reduction),
+        (input, label),
+        name="hinge_embedding_loss",
+    )
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean"):
+    def _f(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        per = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(per, reduction)
+
+    return apply_op(_f, (input1, input2, label), name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0, epsilon=1e-6,
+                        swap=False, reduction="mean"):
+    def _f(a, pos, neg):
+        dp = jnp.linalg.norm(a - pos + epsilon, ord=p, axis=-1)
+        dn = jnp.linalg.norm(a - neg + epsilon, ord=p, axis=-1)
+        if swap:
+            dn2 = jnp.linalg.norm(pos - neg + epsilon, ord=p, axis=-1)
+            dn = jnp.minimum(dn, dn2)
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(_f, (input, positive, negative), name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0, reduction="mean", norm_by_times=False):
+    """CTC via the classic dynamic program in log space (lax.scan over time).
+
+    Ref: phi WarpctcKernel — here a pure-XLA scan, no warpctc dependency.
+    log_probs: [T, N, C] (paddle layout); labels: [N, L] padded.
+    """
+
+    def _f(lp, lbl):
+        T, N, C = lp.shape
+        lbl = lbl.astype(jnp.int32)
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended label sequence: blank l1 blank l2 ... blank
+        ext = jnp.full((N, S), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lbl)
+        ilen = jnp.asarray(_unwrap(input_lengths)).astype(jnp.int32)
+        llen = jnp.asarray(_unwrap(label_lengths)).astype(jnp.int32)
+
+        neg_inf = -1e30
+        alpha0 = jnp.full((N, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(N), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(llen > 0, lp[0, jnp.arange(N), ext[:, 1]], neg_inf))
+
+        same = jnp.concatenate([jnp.full((N, 2), True), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def logaddexp(a, b):
+            m = jnp.maximum(a, b)
+            return m + jnp.log1p(jnp.exp(-jnp.abs(a - b)))
+
+        def step(carry, t):
+            alpha = carry
+            shift1 = jnp.concatenate([jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            shift2 = jnp.concatenate([jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            shift2 = jnp.where(same, neg_inf, shift2)
+            a = logaddexp(logaddexp(alpha, shift1), shift2)
+            emit = lp[t, jnp.arange(N)[:, None], ext]
+            new = a + emit
+            new = jnp.where(t < ilen[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        endS = 2 * llen
+        last1 = alpha[jnp.arange(N), endS]
+        last2 = jnp.where(llen > 0, alpha[jnp.arange(N), jnp.maximum(endS - 1, 0)], neg_inf)
+        ll = logaddexp(last1, last2)
+        loss = -ll
+        if norm_by_times:
+            loss = loss / ilen.astype(loss.dtype)
+        return _reduce(loss, reduction)
+
+    return apply_op(_f, (log_probs, labels), name="ctc_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5):
+    def _f(p, y):
+        y1 = jax.nn.one_hot(y.squeeze(-1).astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * y1, axis=-1)
+        union = jnp.sum(p, axis=-1) + jnp.sum(y1, axis=-1)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+
+    return apply_op(_f, (input, label), name="dice_loss")
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), (input, label), name="square_error_cost")
+
+
+def log_loss(input, label, epsilon=1e-4):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon),
+        (input, label),
+        name="log_loss",
+    )
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, reduction="sum"):
+    def _f(z, y, nrm):
+        p = jax.nn.sigmoid(z)
+        ce = jnp.maximum(z, 0.0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        pt = p * y + (1 - p) * (1 - y)
+        at = alpha * y + (1 - alpha) * (1 - y)
+        per = at * jnp.power(1 - pt, gamma) * ce
+        if nrm is not None:
+            per = per / nrm
+        return _reduce(per, reduction)
+
+    return apply_op(_f, (logit, label, normalizer), name="sigmoid_focal_loss")

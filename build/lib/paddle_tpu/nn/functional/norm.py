@@ -1,0 +1,133 @@
+"""Normalization functionals (ref: python/paddle/nn/functional/norm.py, phi BatchNormKernel).
+
+Running-stat updates are returned functionally and written back to layer buffers by the
+calling Layer — keeping the computation pure so whole steps jit cleanly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, apply_op, _unwrap
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=False,
+               momentum=0.9, epsilon=1e-5, data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    use_batch_stats = training and not use_global_stats
+
+    def _f(v, rm, rv, w, b):
+        shape = [1] * v.ndim
+        shape[ch_axis] = v.shape[ch_axis]
+        axes = tuple(i for i in range(v.ndim) if i != (ch_axis % v.ndim))
+        if use_batch_stats:
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+        else:
+            mean, var = rm, rv
+        inv = jax.lax.rsqrt(var.reshape(shape).astype(v.dtype) + epsilon)
+        out = (v - mean.reshape(shape).astype(v.dtype)) * inv
+        if w is not None:
+            out = out * w.reshape(shape).astype(v.dtype)
+        if b is not None:
+            out = out + b.reshape(shape).astype(v.dtype)
+        return out
+
+    out = apply_op(_f, (x, running_mean, running_var, weight, bias), name="batch_norm")
+
+    if use_batch_stats and isinstance(running_mean, Tensor):
+        # functional stat update written back to the buffers (ref BatchNormKernel saved stats)
+        v = _unwrap(x)
+        ch = ch_axis % v.ndim
+        axes = tuple(i for i in range(v.ndim) if i != ch)
+        mean = jnp.mean(v.astype(jnp.float32), axis=axes)
+        var = jnp.var(v.astype(jnp.float32), axis=axes)
+        n = 1
+        for i in axes:
+            n *= v.shape[i]
+        unbiased = var * (n / max(n - 1, 1))
+        running_mean.set_value(momentum * _unwrap(running_mean) + (1 - momentum) * mean)
+        running_var.set_value(momentum * _unwrap(running_var) + (1 - momentum) * unbiased)
+    return out
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5, name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) else [normalized_shape]
+    nd = len(ns)
+
+    def _f(v, w, b):
+        axes = tuple(range(v.ndim - nd, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        if w is not None:
+            out = out * w
+        if b is not None:
+            out = out + b
+        return out
+
+    return apply_op(_f, (x, weight, bias), name="layer_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5, data_format="NCHW", name=None):
+    def _f(v, w, b):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        if w is not None:
+            shape = [1, -1] + [1] * (v.ndim - 2)
+            out = out * w.reshape(shape)
+        if b is not None:
+            shape = [1, -1] + [1] * (v.ndim - 2)
+            out = out + b.reshape(shape)
+        return out
+
+    return apply_op(_f, (x, weight, bias), name="instance_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None, data_format="NCHW", name=None):
+    def _f(v, w, b):
+        n, c = v.shape[0], v.shape[1]
+        rest = v.shape[2:]
+        g = v.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v.shape)
+        shape = [1, c] + [1] * len(rest)
+        if w is not None:
+            out = out * w.reshape(shape)
+        if b is not None:
+            out = out + b.reshape(shape)
+        return out
+
+    return apply_op(_f, (x, weight, bias), name="group_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0, data_format="NCHW", name=None):
+    def _f(v):
+        sq = jnp.square(v)
+        half = size // 2
+        c = v.shape[1]
+        padded = jnp.pad(sq, [(0, 0), (half, size - 1 - half)] + [(0, 0)] * (v.ndim - 2))
+        acc = jnp.zeros_like(v)
+        for i in range(size):
+            acc = acc + padded[:, i:i + c]
+        return v / jnp.power(k + alpha * acc, beta)
+
+    return apply_op(_f, (x,), name="local_response_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, name=None):
+    """Net-new (LLaMA-family); ref gap: Paddle snapshot has no fused RMSNorm."""
+
+    def _f(v, w):
+        ms = jnp.mean(jnp.square(v.astype(jnp.float32)), axis=-1, keepdims=True)
+        out = (v.astype(jnp.float32) * jax.lax.rsqrt(ms + epsilon)).astype(v.dtype)
+        if w is not None:
+            out = out * w
+        return out
+
+    return apply_op(_f, (x, weight), name="rms_norm")

@@ -1,0 +1,235 @@
+"""Pooling functionals (ref: python/paddle/nn/functional/pooling.py, phi Pool2dKernel).
+
+lax.reduce_window lowers to XLA ReduceWindow — fused, MXU-adjacent on TPU.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import apply_op
+
+
+def _pair(v, n=2):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _pool_pad(padding, nd):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * nd
+    p = list(padding)
+    if len(p) == nd:
+        return [(int(q), int(q)) for q in p]
+    if len(p) == 2 * nd:
+        return [(int(p[2 * i]), int(p[2 * i + 1])) for i in range(nd)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _reduce_pool(v, ksize, strides, pad, nd, op, init, ceil_mode):
+    window = (1, 1) + ksize
+    strd = (1, 1) + strides
+    if isinstance(pad, str):
+        padding = pad
+    else:
+        padding = [(0, 0), (0, 0)] + list(pad)
+        if ceil_mode:
+            padding = [(lo, hi + s - 1) for (lo, hi), s in zip(padding, strd)]
+    return jax.lax.reduce_window(v, init, op, window, strd, padding)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCHW", name=None):
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+    pad = _pool_pad(padding, 2)
+
+    def _f(v):
+        if data_format != "NCHW":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        neg = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+        out = _reduce_pool(v, ks, st, pad, 2, jax.lax.max, neg, ceil_mode)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        if return_mask:
+            # argmax within each window -> flattened HxW index (ref MaxPool2dWithIndexKernel)
+            n, c, h, w = v.shape
+            plist = [(0, 0), (0, 0)] + (list(pad) if not isinstance(pad, str) else None)
+            patches = jax.lax.conv_general_dilated_patches(
+                jnp.where(jnp.isfinite(v), v, neg), ks, st,
+                padding=pad if isinstance(pad, str) else list(pad),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+            )  # [n, c*kh*kw, oh, ow]
+            oh, ow = patches.shape[2], patches.shape[3]
+            patches = patches.reshape(n, c, ks[0] * ks[1], oh, ow)
+            win = jnp.argmax(patches, axis=2)
+            wi, wj = win // ks[1], win % ks[1]
+            ph = 0 if isinstance(pad, str) else pad[0][0]
+            pw = 0 if isinstance(pad, str) else pad[1][0]
+            gi = jnp.arange(oh).reshape(1, 1, -1, 1) * st[0] - ph + wi
+            gj = jnp.arange(ow).reshape(1, 1, 1, -1) * st[1] - pw + wj
+            mask = (gi * w + gj).astype(jnp.int32)
+            return out, mask
+        return out
+
+    return apply_op(_f, (x,), name="max_pool2d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCHW", name=None):
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+    pad = _pool_pad(padding, 2)
+
+    def _f(v):
+        if data_format != "NCHW":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        s = _reduce_pool(v, ks, st, pad, 2, jax.lax.add, 0.0 if jnp.issubdtype(v.dtype, jnp.floating) else 0, ceil_mode)
+        if divisor_override:
+            out = s / divisor_override
+        elif exclusive and not isinstance(pad, str):
+            ones = jnp.ones_like(v)
+            cnt = _reduce_pool(ones, ks, st, pad, 2, jax.lax.add, 0.0, ceil_mode)
+            out = s / cnt
+        else:
+            out = s / (ks[0] * ks[1])
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op(_f, (x,), name="avg_pool2d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False, name=None):
+    ks = _pair(kernel_size, 1)
+    st = _pair(stride, 1) if stride is not None else ks
+    pad = _pool_pad(padding, 1)
+
+    def _f(v):
+        neg = -jnp.inf
+        return _reduce_pool(v, ks, st, pad, 1, jax.lax.max, neg, ceil_mode)
+
+    return apply_op(_f, (x,), name="max_pool1d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True, ceil_mode=False, name=None):
+    ks = _pair(kernel_size, 1)
+    st = _pair(stride, 1) if stride is not None else ks
+    pad = _pool_pad(padding, 1)
+
+    def _f(v):
+        s = _reduce_pool(v, ks, st, pad, 1, jax.lax.add, 0.0, ceil_mode)
+        if exclusive and not isinstance(pad, str):
+            cnt = _reduce_pool(jnp.ones_like(v), ks, st, pad, 1, jax.lax.add, 0.0, ceil_mode)
+            return s / cnt
+        return s / ks[0]
+
+    return apply_op(_f, (x,), name="avg_pool1d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_mode=False,
+               data_format="NCDHW", name=None):
+    ks = _pair(kernel_size, 3)
+    st = _pair(stride, 3) if stride is not None else ks
+    pad = _pool_pad(padding, 3)
+
+    def _f(v):
+        return _reduce_pool(v, ks, st, pad, 3, jax.lax.max, -jnp.inf, ceil_mode)
+
+    return apply_op(_f, (x,), name="max_pool3d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False, exclusive=True,
+               divisor_override=None, data_format="NCDHW", name=None):
+    ks = _pair(kernel_size, 3)
+    st = _pair(stride, 3) if stride is not None else ks
+    pad = _pool_pad(padding, 3)
+
+    def _f(v):
+        s = _reduce_pool(v, ks, st, pad, 3, jax.lax.add, 0.0, ceil_mode)
+        if exclusive and not isinstance(pad, str):
+            cnt = _reduce_pool(jnp.ones_like(v), ks, st, pad, 3, jax.lax.add, 0.0, ceil_mode)
+            return s / cnt
+        return s / (ks[0] * ks[1] * ks[2])
+
+    return apply_op(_f, (x,), name="avg_pool3d")
+
+
+def _adaptive_bins(in_size, out_size):
+    starts = [int(np.floor(i * in_size / out_size)) for i in range(out_size)]
+    ends = [int(np.ceil((i + 1) * in_size / out_size)) for i in range(out_size)]
+    return starts, ends
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    os = _pair(output_size)
+
+    def _f(v):
+        if data_format != "NCHW":
+            v = jnp.transpose(v, (0, 3, 1, 2))
+        n, c, h, w = v.shape
+        if h % os[0] == 0 and w % os[1] == 0:
+            out = v.reshape(n, c, os[0], h // os[0], os[1], w // os[1]).mean(axis=(3, 5))
+        else:
+            hs, he = _adaptive_bins(h, os[0])
+            ws, we = _adaptive_bins(w, os[1])
+            rows = []
+            for i in range(os[0]):
+                cols = []
+                for j in range(os[1]):
+                    cols.append(v[:, :, hs[i]:he[i], ws[j]:we[j]].mean(axis=(2, 3)))
+                rows.append(jnp.stack(cols, axis=-1))
+            out = jnp.stack(rows, axis=-2)
+        if data_format != "NCHW":
+            out = jnp.transpose(out, (0, 2, 3, 1))
+        return out
+
+    return apply_op(_f, (x,), name="adaptive_avg_pool2d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    os = _pair(output_size)
+
+    def _f(v):
+        n, c, h, w = v.shape
+        if h % os[0] == 0 and w % os[1] == 0:
+            return v.reshape(n, c, os[0], h // os[0], os[1], w // os[1]).max(axis=(3, 5))
+        hs, he = _adaptive_bins(h, os[0])
+        ws, we = _adaptive_bins(w, os[1])
+        rows = []
+        for i in range(os[0]):
+            cols = [v[:, :, hs[i]:he[i], ws[j]:we[j]].max(axis=(2, 3)) for j in range(os[1])]
+            rows.append(jnp.stack(cols, axis=-1))
+        return jnp.stack(rows, axis=-2)
+
+    return apply_op(_f, (x,), name="adaptive_max_pool2d")
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    os = int(output_size)
+
+    def _f(v):
+        n, c, l = v.shape
+        if l % os == 0:
+            return v.reshape(n, c, os, l // os).mean(axis=3)
+        ss, es = _adaptive_bins(l, os)
+        return jnp.stack([v[:, :, s:e].mean(axis=2) for s, e in zip(ss, es)], axis=-1)
+
+    return apply_op(_f, (x,), name="adaptive_avg_pool1d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    os = int(output_size)
+
+    def _f(v):
+        n, c, l = v.shape
+        if l % os == 0:
+            return v.reshape(n, c, os, l // os).max(axis=3)
+        ss, es = _adaptive_bins(l, os)
+        return jnp.stack([v[:, :, s:e].max(axis=2) for s, e in zip(ss, es)], axis=-1)
+
+    return apply_op(_f, (x,), name="adaptive_max_pool1d")

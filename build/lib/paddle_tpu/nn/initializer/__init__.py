@@ -1,0 +1,200 @@
+"""Initializers (ref: python/paddle/nn/initializer/, fluid/initializer.py).
+
+Each initializer mutates a Parameter in place via set_value — randomness from the
+global Generator (threefry keys), so `paddle.seed` reproduces inits exactly.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as _random
+from ...tensor.tensor import Tensor
+
+
+class Initializer:
+    def __call__(self, param, block=None):
+        raise NotImplementedError
+
+
+def _fan_in_out(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    rf = int(np.prod(shape[2:]))
+    return shape[1] * rf, shape[0] * rf
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        param.set_value(jnp.full(param._value.shape, self.value, param._value.dtype))
+        return param
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        v = jax.random.normal(_random.get_rng_key(), param._value.shape, jnp.float32)
+        param.set_value((v * self.std + self.mean).astype(param._value.dtype))
+        return param
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, param, block=None):
+        v = jax.random.truncated_normal(_random.get_rng_key(), -2.0, 2.0, param._value.shape, jnp.float32)
+        param.set_value((v * self.std + self.mean).astype(param._value.dtype))
+        return param
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, param, block=None):
+        v = jax.random.uniform(_random.get_rng_key(), param._value.shape, jnp.float32,
+                               minval=self.low, maxval=self.high)
+        param.set_value(v.astype(param._value.dtype))
+        return param
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fan_in_out(param._value.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        v = jax.random.normal(_random.get_rng_key(), param._value.shape, jnp.float32) * std
+        param.set_value(v.astype(param._value.dtype))
+        return param
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, param, block=None):
+        fi, fo = _fan_in_out(param._value.shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        v = jax.random.uniform(_random.get_rng_key(), param._value.shape, jnp.float32,
+                               minval=-limit, maxval=limit)
+        param.set_value(v.astype(param._value.dtype))
+        return param
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fan_in_out(param._value.shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0) if self.nonlinearity == "relu" else math.sqrt(2.0 / (1 + self.negative_slope**2))
+        std = gain / math.sqrt(fi)
+        v = jax.random.normal(_random.get_rng_key(), param._value.shape, jnp.float32) * std
+        param.set_value(v.astype(param._value.dtype))
+        return param
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in, self.negative_slope, self.nonlinearity = fan_in, negative_slope, nonlinearity
+
+    def __call__(self, param, block=None):
+        fi, _ = _fan_in_out(param._value.shape)
+        fi = self.fan_in or fi
+        gain = math.sqrt(2.0) if self.nonlinearity == "relu" else math.sqrt(2.0 / (1 + self.negative_slope**2))
+        limit = gain * math.sqrt(3.0 / fi)
+        v = jax.random.uniform(_random.get_rng_key(), param._value.shape, jnp.float32,
+                               minval=-limit, maxval=limit)
+        param.set_value(v.astype(param._value.dtype))
+        return param
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def __call__(self, param, block=None):
+        shape = param._value.shape
+        rows = shape[0]
+        cols = int(np.prod(shape[1:]))
+        flat = jax.random.normal(_random.get_rng_key(), (max(rows, cols), min(rows, cols)), jnp.float32)
+        q, r = jnp.linalg.qr(flat)
+        q = q * jnp.sign(jnp.diagonal(r))
+        q = q.T if rows < cols else q
+        param.set_value((self.gain * q[:rows, :cols]).reshape(shape).astype(param._value.dtype))
+        return param
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, param, block=None):
+        v = self.value._value if isinstance(self.value, Tensor) else jnp.asarray(np.asarray(self.value))
+        param.set_value(v.astype(param._value.dtype))
+        return param
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def __call__(self, param, block=None):
+        shape = param._value.shape
+        v = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        centers = [s // 2 for s in shape[2:]]
+        for g in range(self.groups):
+            for i in range(min(oc // self.groups, ic)):
+                idx = (g * (oc // self.groups) + i, i) + tuple(centers)
+                v[idx] = 1.0
+        param.set_value(jnp.asarray(v).astype(param._value.dtype))
+        return param
+
+
+# fluid-style aliases (ref fluid/initializer.py)
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform
+XavierInitializer = XavierNormal
+MSRAInitializer = KaimingNormal
+TruncatedNormalInitializer = TruncatedNormal
+
+calculate_gain = lambda nonlinearity, param=None: {
+    "sigmoid": 1.0,
+    "tanh": 5.0 / 3,
+    "relu": math.sqrt(2.0),
+    "leaky_relu": math.sqrt(2.0 / (1 + (param or 0.01) ** 2)),
+    "selu": 3.0 / 4,
+    "linear": 1.0,
+    "conv2d": 1.0,
+}.get(nonlinearity, 1.0)
+
+
+def set_global_initializer(weight_init=None, bias_init=None):
+    global _global_weight_init, _global_bias_init
+    _global_weight_init, _global_bias_init = weight_init, bias_init
+
+
+_global_weight_init = None
+_global_bias_init = None

@@ -1,0 +1,64 @@
+"""Activation layers (ref: python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .layers import Layer
+from .. import functional as F
+from ..initializer import Constant
+
+
+def _mk(name, fname=None, **default_kwargs):
+    fname = fname or name.lower()
+
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            kwargs.pop("name", None)
+            self._args = args
+            self._kwargs = {**default_kwargs, **kwargs}
+
+        def forward(self, x):
+            return getattr(F, fname)(x, *self._args, **self._kwargs)
+
+    _Act.__name__ = name
+    _Act.__qualname__ = name
+    return _Act
+
+
+ReLU = _mk("ReLU", "relu")
+ReLU6 = _mk("ReLU6", "relu6")
+GELU = _mk("GELU", "gelu")
+Sigmoid = _mk("Sigmoid", "sigmoid")
+Tanh = _mk("Tanh", "tanh")
+Softmax = _mk("Softmax", "softmax")
+LogSoftmax = _mk("LogSoftmax", "log_softmax")
+LogSigmoid = _mk("LogSigmoid", "log_sigmoid")
+Softplus = _mk("Softplus", "softplus")
+Softsign = _mk("Softsign", "softsign")
+Softshrink = _mk("Softshrink", "softshrink")
+Hardshrink = _mk("Hardshrink", "hardshrink")
+Hardsigmoid = _mk("Hardsigmoid", "hardsigmoid")
+Hardswish = _mk("Hardswish", "hardswish")
+Hardtanh = _mk("Hardtanh", "hardtanh")
+LeakyReLU = _mk("LeakyReLU", "leaky_relu")
+ELU = _mk("ELU", "elu")
+SELU = _mk("SELU", "selu")
+CELU = _mk("CELU", "celu")
+Silu = _mk("Silu", "silu")
+Swish = _mk("Swish", "swish")
+Mish = _mk("Mish", "mish")
+Tanhshrink = _mk("Tanhshrink", "tanhshrink")
+ThresholdedReLU = _mk("ThresholdedReLU", "thresholded_relu")
+Maxout = _mk("Maxout", "maxout")
+GLU = _mk("GLU", "glu")
+RReLU = _mk("RReLU", "rrelu")
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None, data_format="NCHW", name=None):
+        super().__init__()
+        self._data_format = data_format
+        self.weight = self.create_parameter([num_parameters], attr=weight_attr,
+                                            default_initializer=Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, self._data_format)

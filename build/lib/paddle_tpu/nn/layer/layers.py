@@ -1,0 +1,371 @@
+"""nn.Layer base class.
+
+Reference: `python/paddle/fluid/dygraph/layers.py:84` (Layer) — parameters/buffers
+registries, sublayer tree, forward hooks, state_dict/set_state_dict, train/eval,
+apply, to/astype.  TPU-native addition: `functional_state()`/`load_functional_state()`
+expose the parameter+buffer pytree so whole layers drop into jit/pjit train steps.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Callable, Iterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor.tensor import Tensor, Parameter
+from ...core import dtypes as _dt
+
+
+class HookRemoveHelper:
+    def __init__(self, store, key):
+        self._store = store
+        self._key = key
+
+    def remove(self):
+        self._store.pop(self._key, None)
+
+
+_global_layer_counter = [0]
+
+
+class Layer:
+    """Base network layer (ref layers.py:84)."""
+
+    def __init__(self, name_scope=None, dtype="float32"):
+        self.training = True
+        self._dtype = _dt.convert_dtype(dtype)
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._hook_counter = [0]
+        _global_layer_counter[0] += 1
+        self._full_name = (name_scope or self.__class__.__name__.lower()) + f"_{_global_layer_counter[0]}"
+
+    # ------------------------------------------------------------- registration
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() before assigning parameters")
+            params[name] = value
+            buffers.pop(name, None) if buffers else None
+            self.__dict__.pop(name, None)
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() before assigning sublayers")
+            layers[name] = value
+            self.__dict__.pop(name, None)
+        else:
+            if params is not None and name in params:
+                if value is None:
+                    params.pop(name)
+                    object.__setattr__(self, name, None)
+                    return
+                raise TypeError(f"cannot assign non-Parameter to parameter slot {name}")
+            if buffers is not None and name in buffers:
+                if value is None or isinstance(value, Tensor):
+                    if value is None:
+                        buffers.pop(name)
+                        object.__setattr__(self, name, None)
+                    else:
+                        buffers[name] = value
+                    return
+            if layers is not None and name in layers and value is None:
+                layers.pop(name)
+                object.__setattr__(self, name, None)
+                return
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        if "_parameters" in self.__dict__ and name in self.__dict__["_parameters"]:
+            return self.__dict__["_parameters"][name]
+        if "_buffers" in self.__dict__ and name in self.__dict__["_buffers"]:
+            return self.__dict__["_buffers"][name]
+        if "_sub_layers" in self.__dict__ and name in self.__dict__["_sub_layers"]:
+            return self.__dict__["_sub_layers"][name]
+        raise AttributeError(f"{self.__class__.__name__} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_buffers", "_sub_layers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def add_parameter(self, name, parameter):
+        if parameter is not None and not isinstance(parameter, Parameter):
+            raise TypeError("add_parameter expects a Parameter")
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False, default_initializer=None):
+        """Ref layers.py create_parameter: honors ParamAttr initializer/trainable."""
+        from ..initializer import Constant, XavierUniform
+        from ...framework.param_attr import ParamAttr
+
+        dtype = _dt.convert_dtype(dtype or self._dtype)
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        init = None
+        trainable = True
+        if attr is not None:
+            init = attr.initializer
+            trainable = attr.trainable
+        if init is None:
+            init = default_initializer or (Constant(0.0) if is_bias else XavierUniform())
+        p = Parameter(jnp.zeros([int(s) for s in shape], dtype), trainable=trainable,
+                      name=(attr.name if attr is not None else None))
+        init(p)
+        return p
+
+    def create_tensor(self, name=None, persistable=None, dtype=None):
+        return Tensor(jnp.zeros([], _dt.convert_dtype(dtype or self._dtype)))
+
+    # ------------------------------------------------------------- iteration
+    def parameters(self, include_sublayers=True) -> list:
+        return [p for _, p in self.named_parameters(include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix="", include_sublayers=True, include_self=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{name}.{pname}" if name else pname), p
+            if not include_sublayers:
+                break
+
+    def buffers(self, include_sublayers=True) -> list:
+        return [b for _, b in self.named_buffers(include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{name}.{bname}" if name else bname), b
+            if not include_sublayers:
+                break
+
+    def sublayers(self, include_self=False) -> list:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None):
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=sub_prefix, include_self=True, layers_set=layers_set)
+
+    def children(self):
+        return iter(l for l in self._sub_layers.values() if l is not None)
+
+    def named_children(self):
+        return iter((n, l) for n, l in self._sub_layers.items() if l is not None)
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # ------------------------------------------------------------- modes
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # ------------------------------------------------------------- hooks
+    def register_forward_pre_hook(self, hook):
+        self._hook_counter[0] += 1
+        key = self._hook_counter[0]
+        self._forward_pre_hooks[key] = hook
+        return HookRemoveHelper(self._forward_pre_hooks, key)
+
+    def register_forward_post_hook(self, hook):
+        self._hook_counter[0] += 1
+        key = self._hook_counter[0]
+        self._forward_post_hooks[key] = hook
+        return HookRemoveHelper(self._forward_post_hooks, key)
+
+    # ------------------------------------------------------------- call
+    def __call__(self, *inputs, **kwargs):
+        for hook in list(self._forward_pre_hooks.values()):
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in list(self._forward_post_hooks.values()):
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def extra_repr(self):
+        return ""
+
+    def __repr__(self):
+        lines = []
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).split("\n")
+            sub_repr = [sub_repr[0]] + ["  " + l for l in sub_repr[1:]]
+            lines.append(f"  ({name}): " + "\n".join(sub_repr))
+        extra = self.extra_repr()
+        main = f"{self.__class__.__name__}({extra}" + ("" if not lines else "\n" + "\n".join(lines) + "\n")
+        return main + ")"
+
+    # ------------------------------------------------------------- state dict
+    def state_dict(self, destination=None, include_sublayers=True, structured_name_prefix="", use_hook=True):
+        """Ref layers.py:1407."""
+        hook = getattr(self, "_pre_state_hook", None)
+        if hook is not None:
+            hook()  # e.g. stacked-pipeline weights written back before reading
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters():
+            dest[structured_name_prefix + name] = p
+        for name, b in self.named_buffers():
+            leaf = name.rsplit(".", 1)[-1]
+            if leaf in self._find_owner(name)._non_persistable_buffer_names:
+                continue
+            dest[structured_name_prefix + name] = b
+        return dest
+
+    def _find_owner(self, qualified_name):
+        parts = qualified_name.split(".")[:-1]
+        layer = self
+        for p in parts:
+            layer = layer._sub_layers.get(p, layer)
+        return layer
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        """Ref layers.py:1442."""
+        missing, unexpected = [], []
+        own = self.state_dict()
+        matched = set()
+        for name, t in own.items():
+            if name in state_dict:
+                v = state_dict[name]
+                arr = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+                if tuple(arr.shape) != tuple(t._value.shape):
+                    raise ValueError(f"shape mismatch for {name}: {arr.shape} vs {t._value.shape}")
+                t.set_value(arr.astype(t._value.dtype))
+                matched.add(name)
+            else:
+                missing.append(name)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # ------------------------------------------------------------- dtype/device
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self._cast_all(_dt.convert_dtype(dtype))
+        return self
+
+    def astype(self, dtype):
+        self._cast_all(_dt.convert_dtype(dtype))
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def half(self):
+        return self.astype("float16")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def _cast_all(self, dtype):
+        for _, p in self.named_parameters():
+            if jnp.issubdtype(p._value.dtype, jnp.floating):
+                p._rebind(p._value.astype(dtype))
+        for _, b in self.named_buffers():
+            if jnp.issubdtype(b._value.dtype, jnp.floating):
+                b._rebind(b._value.astype(dtype))
+        self._dtype = dtype
+
+    def full_name(self):
+        return self._full_name
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    # ------------------------------------------------------------- functional bridge
+    def functional_state(self, _sync=True):
+        """(params_dict, buffers_dict) of raw jax arrays — the pytree handed to jit."""
+        hook = getattr(self, "_pre_state_hook", None)
+        if _sync and hook is not None:
+            hook()
+        params = {k: p._value for k, p in self.named_parameters()}
+        buffers = {k: b._value for k, b in self.named_buffers()}
+        return params, buffers
+
+    def load_functional_state(self, params=None, buffers=None):
+        if params:
+            own = dict(self.named_parameters())
+            for k, v in params.items():
+                own[k]._rebind(v)
+        if buffers:
+            own = dict(self.named_buffers())
+            for k, v in buffers.items():
+                own[k]._rebind(v)
+
+    def bind_functional_state(self, params=None, buffers=None):
+        """Temporarily swap in traced arrays (used by to_static); returns restore fn."""
+        saved = []
+        own_p = dict(self.named_parameters())
+        own_b = dict(self.named_buffers())
+        for k, v in (params or {}).items():
+            saved.append((own_p[k], own_p[k]._value, own_p[k]._node, own_p[k]._out_index))
+            own_p[k]._value = v
+            own_p[k]._node = None
+        for k, v in (buffers or {}).items():
+            saved.append((own_b[k], own_b[k]._value, own_b[k]._node, own_b[k]._out_index))
+            own_b[k]._value = v
+            own_b[k]._node = None
+
+        def restore():
+            for t, val, node, idx in saved:
+                t._value, t._node, t._out_index = val, node, idx
+
+        return restore

@@ -1,0 +1,305 @@
+"""Recurrent layers (ref: python/paddle/nn/layer/rnn.py).
+
+The per-timestep loop is `lax.scan` — compiled once, contrast with the reference's
+cudnn RNN kernels (phi/kernels/gpu/rnn_kernel.cu).  Cells expose the same
+(inputs, states) -> (outputs, new_states) contract as the reference RNNCellBase.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .layers import Layer
+from ..initializer import Uniform
+from ...tensor.tensor import Tensor, apply_op
+from ...tensor import creation
+
+
+class RNNCellBase(Layer):
+    def get_initial_states(self, batch_ref, shape=None, dtype="float32", init_value=0.0, batch_dim_idx=0):
+        B = batch_ref.shape[batch_dim_idx]
+        shape = shape or self.state_shape
+        if isinstance(shape, (list, tuple)) and shape and isinstance(shape[0], (list, tuple)):
+            return tuple(creation.full([B, *s], init_value, dtype) for s in shape)
+        return creation.full([B, *shape], init_value, dtype)
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / hidden_size**0.5
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.activation = activation
+        self.weight_ih = self.create_parameter([hidden_size, input_size], attr=weight_ih_attr, default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=Uniform(-std, std))
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+
+        def _f(x, h, wi, wh, bi, bh):
+            return act(x @ wi.T + bi + h @ wh.T + bh)
+
+        h = apply_op(_f, (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh), name="rnn_cell")
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / hidden_size**0.5
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.weight_ih = self.create_parameter([4 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([4 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([4 * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([4 * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=Uniform(-std, std))
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs, self.state_shape)
+        h, c = states
+
+        def _f(x, hp, cp, wi, wh, bi, bh):
+            gates = x @ wi.T + bi + hp @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            cn = f * cp + i * g
+            hn = o * jnp.tanh(cn)
+            return hn, cn
+
+        hn, cn = apply_op(_f, (inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh), name="lstm_cell")
+        return hn, (hn, cn)
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        std = 1.0 / hidden_size**0.5
+        self.hidden_size = hidden_size
+        self.input_size = input_size
+        self.weight_ih = self.create_parameter([3 * hidden_size, input_size], attr=weight_ih_attr, default_initializer=Uniform(-std, std))
+        self.weight_hh = self.create_parameter([3 * hidden_size, hidden_size], attr=weight_hh_attr, default_initializer=Uniform(-std, std))
+        self.bias_ih = self.create_parameter([3 * hidden_size], attr=bias_ih_attr, is_bias=True, default_initializer=Uniform(-std, std))
+        self.bias_hh = self.create_parameter([3 * hidden_size], attr=bias_hh_attr, is_bias=True, default_initializer=Uniform(-std, std))
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+
+        def _f(x, hp, wi, wh, bi, bh):
+            gi = x @ wi.T + bi
+            gh = hp @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn_ = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn_)
+            return (1 - z) * n + z * hp
+
+        h = apply_op(_f, (inputs, states, self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh), name="gru_cell")
+        return h, h
+
+
+def _scan_rnn(mode, x, h0, c0, params, time_major):
+    """One direction, one layer, compiled with lax.scan.  x: [B,T,I] (or [T,B,I])."""
+    wi, wh, bi, bh = params
+    if not time_major:
+        x = jnp.swapaxes(x, 0, 1)  # -> [T,B,I]
+
+    if mode == "LSTM":
+        def step(carry, xt):
+            hp, cp = carry
+            gates = xt @ wi.T + bi + hp @ wh.T + bh
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            g = jnp.tanh(g)
+            cn = f * cp + i * g
+            hn = o * jnp.tanh(cn)
+            return (hn, cn), hn
+
+        (hT, cT), ys = jax.lax.scan(step, (h0, c0), x)
+        out_states = (hT, cT)
+    elif mode == "GRU":
+        def step(hp, xt):
+            gi = xt @ wi.T + bi
+            gh = hp @ wh.T + bh
+            ir, iz, in_ = jnp.split(gi, 3, axis=-1)
+            hr, hz, hn_ = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(ir + hr)
+            z = jax.nn.sigmoid(iz + hz)
+            n = jnp.tanh(in_ + r * hn_)
+            hn = (1 - z) * n + z * hp
+            return hn, hn
+
+        hT, ys = jax.lax.scan(step, h0, x)
+        out_states = (hT,)
+    else:
+        def step(hp, xt):
+            hn = jnp.tanh(xt @ wi.T + bi + hp @ wh.T + bh)
+            return hn, hn
+
+        hT, ys = jax.lax.scan(step, h0, x)
+        out_states = (hT,)
+    if not time_major:
+        ys = jnp.swapaxes(ys, 0, 1)
+    return ys, out_states
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        num_dirs = 2 if self.bidirect else 1
+        self.num_directions = num_dirs
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[mode]
+        std = 1.0 / hidden_size**0.5
+        self._all_weights = []
+        for layer in range(num_layers):
+            for d in range(num_dirs):
+                in_sz = input_size if layer == 0 else hidden_size * num_dirs
+                suffix = f"_reverse" if d else ""
+                wi = self.create_parameter([gate_mult * hidden_size, in_sz], default_initializer=Uniform(-std, std))
+                wh = self.create_parameter([gate_mult * hidden_size, hidden_size], default_initializer=Uniform(-std, std))
+                bi = self.create_parameter([gate_mult * hidden_size], is_bias=True, default_initializer=Uniform(-std, std))
+                bh = self.create_parameter([gate_mult * hidden_size], is_bias=True, default_initializer=Uniform(-std, std))
+                self.add_parameter(f"weight_ih_l{layer}{suffix}", wi)
+                self.add_parameter(f"weight_hh_l{layer}{suffix}", wh)
+                self.add_parameter(f"bias_ih_l{layer}{suffix}", bi)
+                self.add_parameter(f"bias_hh_l{layer}{suffix}", bh)
+                self._all_weights.append((wi, wh, bi, bh))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        is_lstm = self.mode == "LSTM"
+        B = inputs.shape[1] if self.time_major else inputs.shape[0]
+        n_state = self.num_layers * self.num_directions
+        if initial_states is None:
+            z = creation.zeros([n_state, B, self.hidden_size], "float32")
+            initial_states = (z, creation.zeros([n_state, B, self.hidden_size], "float32")) if is_lstm else z
+
+        flat_params = [p for tup in self._all_weights for p in tup]
+
+        def _f(x, h0s, c0s, *params):
+            outs = x
+            hTs, cTs = [], []
+            idx = 0
+            mode = self.mode if self.mode in ("LSTM", "GRU") else "RNN"
+            for layer in range(self.num_layers):
+                dir_outs = []
+                for d in range(self.num_directions):
+                    p = params[4 * idx: 4 * idx + 4]
+                    h0 = h0s[idx]
+                    c0 = c0s[idx] if is_lstm else None
+                    xin = jnp.flip(outs, axis=0 if self.time_major else 1) if d else outs
+                    ys, st = _scan_rnn(mode, xin, h0, c0, p, self.time_major)
+                    if d:
+                        ys = jnp.flip(ys, axis=0 if self.time_major else 1)
+                    dir_outs.append(ys)
+                    hTs.append(st[0])
+                    if is_lstm:
+                        cTs.append(st[1])
+                    idx += 1
+                outs = jnp.concatenate(dir_outs, axis=-1) if self.num_directions > 1 else dir_outs[0]
+            hT = jnp.stack(hTs)
+            if is_lstm:
+                return outs, hT, jnp.stack(cTs)
+            return outs, hT
+
+        if is_lstm:
+            h0, c0 = initial_states
+            res = apply_op(lambda x, h, c, *ps: _f(x, h, c, *ps), (inputs, h0, c0, *flat_params), name=self.mode)
+            out, hT, cT = res
+            return out, (hT, cT)
+        res = apply_op(lambda x, h, *ps: _f(x, h, None, *ps), (inputs, initial_states, *flat_params), name=self.mode)
+        out, hT = res
+        return out, hT
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, activation="tanh", **kwargs):
+        mode = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(mode, input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("LSTM", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1, direction="forward",
+                 time_major=False, dropout=0.0, **kwargs):
+        super().__init__("GRU", input_size, hidden_size, num_layers, direction, time_major, dropout)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scan over time (ref rnn.py RNN)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # eager python loop (cell may be arbitrary); jit users wrap the whole step
+        axis = 0 if self.time_major else 1
+        T = inputs.shape[axis]
+        steps = range(T - 1, -1, -1) if self.is_reverse else range(T)
+        states = initial_states
+        outs = []
+        from ...tensor import manipulation as M
+
+        for t in steps:
+            xt = inputs[(t,) if self.time_major else (slice(None), t)]
+            out, states = self.cell(xt, states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        outputs = M.stack(outs, axis=axis)
+        return outputs, states
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...tensor import manipulation as M
+
+        fw, sf = self.rnn_fw(inputs, None if initial_states is None else initial_states[0])
+        bw, sb = self.rnn_bw(inputs, None if initial_states is None else initial_states[1])
+        return M.concat([fw, bw], axis=-1), (sf, sb)

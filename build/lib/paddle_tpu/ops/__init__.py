@@ -1,0 +1,6 @@
+"""Pallas TPU kernels + hand-rolled distributed primitives (flash attention, ring
+attention, MoE dispatch) — the few ops where XLA's automatic lowering leaves MXU/HBM
+performance on the table (see /opt/skills/guides/pallas_guide.md)."""
+
+from .flash_attention import flash_attention  # noqa: F401
+from .sequence_parallel import ring_attention, ulysses_attention  # noqa: F401

@@ -1,0 +1,129 @@
+"""Sequence/context parallelism primitives: ring attention and Ulysses.
+
+Reference gap (SURVEY.md §5.7): the Paddle snapshot has NO sequence/context
+parallelism of any kind (tree-wide grep: zero hits for ring_attention /
+context_parallel / ulysses) — these are designed fresh for TPU:
+
+- `ring_attention`: blockwise attention over a sequence-sharded axis.  Each device
+  holds a [B, S/n, H, D] shard of q/k/v; k/v blocks rotate around the ring via
+  `lax.ppermute` (riding ICI neighbor links) while each device accumulates its
+  local q block's attention with the online-softmax combine (order-independent,
+  so the rotation order doesn't matter).  Causal masking is block-level: blocks
+  strictly in the future are skipped with `lax.cond` (no compute, no NaNs from
+  all-masked rows), the diagonal block gets an iota mask.  O(S/n) memory per
+  device; autodiff flows through cond + ppermute, giving the reverse ring in the
+  backward pass automatically.
+
+- `ulysses_attention` (DeepSpeed-Ulysses style): `lax.all_to_all` swaps the
+  sharded axis from sequence to heads, runs DENSE/flash attention on the full
+  sequence with H/n local heads, and swaps back.  Cheaper than a ring when
+  H % n == 0 and the full-sequence scores fit (two all-to-alls vs n-1 permutes).
+
+Both must be called INSIDE jit/shard_map with the sequence axis sharded over
+`axis_name` (the 'sep' axis of paddle_tpu.distributed.build_mesh).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_scores(q, k, scale):
+    # q: [B, Sq, H, D], k: [B, Sk, H, D] -> [B, H, Sq, Sk] f32
+    return jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                      k.astype(jnp.float32)) * scale
+
+
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
+    """Blockwise ring attention.  q/k/v: local shards [B, S/n, H, D] inside
+    shard_map over `axis_name`.  Returns the local output shard [B, S/n, H, D]."""
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, Sl, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(t, carry):
+        m, l, acc, kb, vb = carry
+        src = (me - t) % n  # whose k/v block we hold at step t
+
+        def visible(_):
+            s = _block_scores(q, kb, scale)  # [B, H, Sq, Sk]
+            if causal:
+                qpos = me * Sl + lax.broadcasted_iota(jnp.int32, (Sl, Sl), 0)
+                kpos = src * Sl + lax.broadcasted_iota(jnp.int32, (Sl, Sl), 1)
+                mask = (qpos >= kpos)[None, None]
+                s2 = jnp.where(mask, s, NEG_INF)
+            else:
+                s2 = s
+            m_new = jnp.maximum(m, jnp.max(s2, axis=-1, keepdims=True))
+            p = jnp.exp(s2 - m_new)
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc_new = acc * corr + jnp.einsum(
+                "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32))
+            return m_new, l_new, acc_new
+
+        def hidden(_):
+            return m, l, acc
+
+        if causal:
+            m, l, acc = lax.cond(src <= me, visible, hidden, None)
+        else:
+            m, l, acc = visible(None)
+
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return m, l, acc, kb, vb
+
+    m0 = jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, Sl, D), jnp.float32)
+    carry = (m0, l0, acc0, k, v)
+    # python loop: n is static; each iteration is a distinct ppermute in the HLO
+    for t in range(n):
+        carry = step(t, carry)
+    m, l, acc, _, _ = carry
+    out = acc / l  # [B, H, Sq, D]
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, Sq, H, D]
+
+
+def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale=None,
+                      attn_fn=None):
+    """Ulysses alltoall attention.  q/k/v: local shards [B, S/n, H, D] inside
+    shard_map over `axis_name`; needs H % n == 0.  `attn_fn(q, k, v)` runs the
+    full-sequence attention on [B, S, H/n, D] (defaults to dense softmax;
+    pass the Pallas flash kernel for long sequences)."""
+    n = lax.axis_size(axis_name)
+    B, Sl, H, D = q.shape
+    if H % n != 0:
+        raise ValueError(f"num_heads {H} not divisible by axis size {n}")
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+
+    # [B, S/n, H, D] -> [B, S, H/n, D]: split heads, concat sequence
+    def seq2head(x):
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    def head2seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    qg, kg, vg = seq2head(q), seq2head(k), seq2head(v)
+
+    if attn_fn is None:
+        s = _block_scores(qg, kg, scale)  # [B, h_loc, S, S]
+        if causal:
+            S = s.shape[-1]
+            mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+            s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        og = jnp.einsum("bhqk,bkhd->bqhd", p, vg.astype(jnp.float32)).astype(q.dtype)
+    else:
+        og = attn_fn(qg, kg, vg)
+
+    return head2seq(og)

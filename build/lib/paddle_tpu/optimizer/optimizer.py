@@ -1,0 +1,356 @@
+"""Optimizers (ref: python/paddle/optimizer/optimizer.py:91 base; step:1232, minimize:1167).
+
+Design: each optimizer defines a pure functional update rule
+`_update_rule(p, g, state, lr) -> (new_p, new_state)` over raw jax arrays.  The eager
+`step()` walks parameters and rebinds values; the same rule is reused verbatim inside
+jitted train steps (jit/train_step.py) — one source of truth, no divergence between
+eager and compiled training.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor.tensor import Tensor, Parameter
+from ..autograd import tape
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._accumulators: dict[int, dict] = {}
+        self._step_count = 0
+        self.helper = None
+
+    # ------------------------------------------------------------------ lr
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler):
+        self._learning_rate = scheduler
+
+    # ------------------------------------------------------------------ state
+    def _state_for(self, p: Parameter) -> dict:
+        st = self._accumulators.get(id(p))
+        if st is None:
+            st = self._init_state(p)
+            self._accumulators[id(p)] = st
+        return st
+
+    def _init_state(self, p: Parameter) -> dict:
+        return {}
+
+    def state_dict(self):
+        sd = {"_step_count": self._step_count}
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        for i, p in enumerate(self._params()):
+            for k, v in self._state_for(p).items():
+                sd[f"{p.name or i}_{k}"] = Tensor(v) if not isinstance(v, Tensor) else v
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("_step_count", 0))
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for i, p in enumerate(self._params()):
+            st = self._state_for(p)
+            for k in list(st.keys()):
+                key = f"{p.name or i}_{k}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    st[k] = v._value if isinstance(v, Tensor) else jnp.asarray(np.asarray(v))
+
+    # ------------------------------------------------------------------ step
+    def _params(self):
+        if self._parameter_list is None:
+            raise RuntimeError("optimizer constructed without a parameters list")
+        return [p for p in self._parameter_list if isinstance(p, Tensor)]
+
+    def _decay_coeff(self):
+        wd = self._weight_decay
+        if wd is None:
+            return 0.0
+        if hasattr(wd, "_coeff"):  # L2Decay regularizer
+            return float(wd._coeff)
+        return float(wd)
+
+    def _clipped_grads(self, params_and_grads):
+        clip = self._grad_clip
+        if clip is None:
+            return params_and_grads
+        cname = type(clip).__name__
+        if cname == "ClipGradByGlobalNorm":
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for _, g in params_and_grads)
+            gnorm = jnp.sqrt(sq)
+            scale = jnp.where(gnorm > clip.clip_norm, clip.clip_norm / (gnorm + 1e-6), 1.0)
+            return [(p, (g.astype(jnp.float32) * scale).astype(g.dtype)) for p, g in params_and_grads]
+        if cname == "ClipGradByNorm":
+            out = []
+            for p, g in params_and_grads:
+                n = jnp.linalg.norm(g.astype(jnp.float32))
+                scale = jnp.where(n > clip.clip_norm, clip.clip_norm / (n + 1e-6), 1.0)
+                out.append((p, (g * scale.astype(g.dtype))))
+            return out
+        if cname == "ClipGradByValue":
+            return [(p, jnp.clip(g, clip.min, clip.max)) for p, g in params_and_grads]
+        return params_and_grads
+
+    def _apply_update(self, p_val, g, state, lr, decay):
+        """The single update path shared by eager step, TrainStep and
+        ShardedTrainStep: decay + rule + dtype restore (an f32 lr array must not
+        promote bf16 params or optimizer state — that would silently retrace/
+        un-donate the jitted step every call)."""
+        if g.dtype != p_val.dtype:
+            g = g.astype(p_val.dtype)
+        if decay and self._decay_mode() == "l2":
+            g = g + decay * p_val
+        new_p, new_state = self._update_rule(p_val, g, state, lr)
+        if decay and self._decay_mode() == "decoupled":
+            new_p = new_p - lr * decay * p_val
+        if new_p.dtype != p_val.dtype:
+            new_p = new_p.astype(p_val.dtype)
+        new_state = {
+            k: (v.astype(state[k].dtype)
+                if hasattr(v, "dtype") and hasattr(state[k], "dtype") and v.dtype != state[k].dtype
+                else v)
+            for k, v in new_state.items()
+        }
+        return new_p, new_state
+
+    @tape.no_grad()
+    def step(self):
+        """Apply one update (ref optimizer.py:1232)."""
+        lr = self.get_lr()
+        self._step_count += 1
+        pg = [(p, p._grad) for p in self._params() if p._grad is not None and not p.stop_gradient]
+        pg = self._clipped_grads(pg)
+        for p, g in pg:
+            state = self._state_for(p)
+            new_p, new_state = self._apply_update(p._value, g, state, lr, self._param_decay_coeff(p))
+            p._rebind(new_p)
+            self._accumulators[id(p)] = new_state
+
+    def _param_decay_coeff(self, p):
+        """Per-parameter decay (overridden by AdamW's apply_decay_param_fun)."""
+        return self._decay_coeff()
+
+    def _decay_mode(self):
+        return "l2"
+
+    def _update_rule(self, p, g, state, lr):
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._params():
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        """Ref optimizer.py:1167 — backward + step."""
+        loss.backward()
+        self.step()
+        return None, None
+
+    def _apply_optimize(self, loss, startup_program=None, params_grads=None):
+        self.step()
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+
+    def _update_rule(self, p, g, state, lr):
+        return p - lr * g, state
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state(self, p):
+        return {"velocity": jnp.zeros_like(p._value)}
+
+    def _update_rule(self, p, g, state, lr):
+        v = self._momentum * state["velocity"] + g
+        if self._nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, lazy_mode=False,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {
+            "moment1": jnp.zeros_like(p._value),
+            "moment2": jnp.zeros_like(p._value),
+            "beta1_pow": jnp.ones([], jnp.float32),
+            "beta2_pow": jnp.ones([], jnp.float32),
+        }
+
+    def _update_rule(self, p, g, state, lr):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        mhat = m / (1 - b1p).astype(m.dtype)
+        vhat = v / (1 - b2p).astype(v.dtype)
+        new_p = p - lr * mhat / (jnp.sqrt(vhat) + self._eps)
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (ref optimizer/adamw.py)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=0.01, lr_ratio=None, apply_decay_param_fun=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision, name)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _decay_mode(self):
+        return "decoupled"
+
+    def _param_decay_coeff(self, p):
+        if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
+            return 0.0
+        return self._decay_coeff()
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None, weight_decay=None,
+                 grad_clip=None, initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._eps = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state(self, p):
+        return {"moment": jnp.full_like(p._value, self._init_acc)}
+
+    def _update_rule(self, p, g, state, lr):
+        acc = state["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(acc) + self._eps), {"moment": acc}
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999, epsilon=1e-8,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+
+    def _init_state(self, p):
+        return {"moment": jnp.zeros_like(p._value), "inf_norm": jnp.zeros_like(p._value),
+                "beta1_pow": jnp.ones([], jnp.float32)}
+
+    def _update_rule(self, p, g, state, lr):
+        m = self._beta1 * state["moment"] + (1 - self._beta1) * g
+        u = jnp.maximum(self._beta2 * state["inf_norm"], jnp.abs(g))
+        b1p = state["beta1_pow"] * self._beta1
+        new_p = p - (lr / (1 - b1p)).astype(p.dtype) * m / (u + self._eps)
+        return new_p, {"moment": m, "inf_norm": u, "beta1_pow": b1p}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0, centered=False,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho, self._eps, self._momentum, self._centered = rho, epsilon, momentum, centered
+
+    def _init_state(self, p):
+        st = {"mean_square": jnp.zeros_like(p._value), "momentum": jnp.zeros_like(p._value)}
+        if self._centered:
+            st["mean_grad"] = jnp.zeros_like(p._value)
+        return st
+
+    def _update_rule(self, p, g, state, lr):
+        ms = self._rho * state["mean_square"] + (1 - self._rho) * jnp.square(g)
+        st = dict(state, mean_square=ms)
+        if self._centered:
+            mg = self._rho * state["mean_grad"] + (1 - self._rho) * g
+            st["mean_grad"] = mg
+            denom = jnp.sqrt(ms - jnp.square(mg) + self._eps)
+        else:
+            denom = jnp.sqrt(ms + self._eps)
+        mom = self._momentum * state["momentum"] + lr * g / denom
+        st["momentum"] = mom
+        return p - mom, st
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, parameters=None, grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._wd = lamb_weight_decay
+        self._beta1, self._beta2, self._eps = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state(self, p):
+        return {"moment1": jnp.zeros_like(p._value), "moment2": jnp.zeros_like(p._value),
+                "beta1_pow": jnp.ones([], jnp.float32), "beta2_pow": jnp.ones([], jnp.float32)}
+
+    def _update_rule(self, p, g, state, lr):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * state["moment1"] + (1 - b1) * g
+        v = b2 * state["moment2"] + (1 - b2) * jnp.square(g)
+        b1p = state["beta1_pow"] * b1
+        b2p = state["beta2_pow"] * b2
+        mhat = m / (1 - b1p).astype(m.dtype)
+        vhat = v / (1 - b2p).astype(v.dtype)
+        r = mhat / (jnp.sqrt(vhat) + self._eps)
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) else self._wd
+        update = r + wd * p
+        wnorm = jnp.linalg.norm(p.astype(jnp.float32))
+        unorm = jnp.linalg.norm(update.astype(jnp.float32))
+        trust = jnp.where((wnorm > 0) & (unorm > 0), wnorm / unorm, 1.0).astype(p.dtype)
+        new_p = p - lr * trust * update
+        return new_p, {"moment1": m, "moment2": v, "beta1_pow": b1p, "beta2_pow": b2p}
+
+
+class Lars(Momentum):
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, momentum, parameters, False, None, grad_clip, name)
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+
+    def _update_rule(self, p, g, state, lr):
+        wnorm = jnp.linalg.norm(p.astype(jnp.float32))
+        gnorm = jnp.linalg.norm(g.astype(jnp.float32))
+        local_lr = jnp.where(
+            (wnorm > 0) & (gnorm > 0),
+            self._lars_coeff * wnorm / (gnorm + self._lars_wd * wnorm + 1e-9),
+            1.0,
+        ).astype(p.dtype)
+        g = g + self._lars_wd * p
+        v = self._momentum * state["velocity"] + lr * local_lr * g
+        return p - v, {"velocity": v}

@@ -1,0 +1,213 @@
+"""paddle.profiler parity (ref: python/paddle/profiler/profiler.py:271).
+
+Two collectors, mirroring the reference's host-tracer + device-tracer split
+(platform/profiler/host_tracer.cc + cuda_tracer.cc):
+  - device/XLA side: jax.profiler XPlane traces (TensorBoard/Perfetto), the CUPTI
+    analog — enabled when a Profiler context is active;
+  - host side: `RecordEvent` spans collected by the native C++ trace buffer
+    (core/native, ref event_tracing.h:49 RAII spans + chrometracing_logger.cc),
+    exported as chrome://tracing JSON via `Profiler.export(path)`.
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import time
+
+import jax
+
+_native_tracer = None
+
+
+def _tracer():
+    global _native_tracer
+    if _native_tracer is None:
+        try:
+            from ..core.native import NativeTracer
+
+            _native_tracer = NativeTracer()
+        except Exception:
+            _native_tracer = False
+    return _native_tracer or None
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    CUSTOM_DEVICE = "tpu"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+def make_scheduler(*, closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Ref profiler.py make_scheduler — step-phase state machine."""
+    cycle = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        os.makedirs(dir_name, exist_ok=True)
+        prof.export(os.path.join(dir_name, f"{worker_name or 'worker'}.json"))
+
+    handler._dir = dir_name
+    return handler
+
+
+class Profiler:
+    """with Profiler(targets=[...], on_trace_ready=export_chrome_tracing('./log')): ..."""
+
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None, timer_only=False,
+                 record_shapes=False, profile_memory=False, with_flops=False):
+        self._dir = "./paddle_tpu_profile"
+        self._on_trace_ready = on_trace_ready
+        if on_trace_ready is not None and hasattr(on_trace_ready, "_dir"):
+            self._dir = on_trace_ready._dir
+        self._timer_only = timer_only
+        self._started = False
+        self._step_num = 0
+        self._step_t0 = None
+        self._step_times: list[float] = []
+
+    def start(self):
+        tr = _tracer()
+        if tr is not None:
+            tr.clear()
+            tr.enable(True)
+        if not self._timer_only:
+            os.makedirs(self._dir, exist_ok=True)
+            try:
+                jax.profiler.start_trace(self._dir)
+                self._started = True
+            except Exception:
+                self._started = False
+        self._step_t0 = time.perf_counter()
+
+    def stop(self):
+        if self._started:
+            jax.profiler.stop_trace()
+            self._started = False
+        tr = _tracer()
+        if tr is not None:
+            tr.enable(False)
+        if self._on_trace_ready is not None:
+            try:
+                self._on_trace_ready(self)
+            except Exception:
+                pass
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._step_t0 is not None:
+            self._step_times.append(now - self._step_t0)
+        self._step_t0 = now
+        self._step_num += 1
+
+    def step_info(self, unit=None):
+        if not self._step_times:
+            return ""
+        import numpy as np
+
+        arr = np.asarray(self._step_times)
+        return (f"step {self._step_num}: avg {arr.mean()*1000:.3f} ms, "
+                f"min {arr.min()*1000:.3f} ms, max {arr.max()*1000:.3f} ms")
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False, time_unit="ms"):
+        tr = _tracer()
+        host = f"{tr.count()} host spans collected" if tr is not None else "host tracer off"
+        return f"{host}; XLA trace in {self._dir} (TensorBoard/Perfetto)"
+
+    def export(self, path, format="json"):
+        """Write collected host spans as chrome://tracing JSON
+        (ref chrometracing_logger.cc output contract)."""
+        tr = _tracer()
+        doc = tr.dump_json() if tr is not None else '{"traceEvents":[]}'
+        with open(path, "w") as f:
+            f.write(doc)
+        return path
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+class RecordEvent:
+    """RAII span (ref platform/profiler/event_tracing.h:49): recorded into the native
+    host-trace buffer AND as a jax TraceAnnotation so spans show up in both the
+    chrome-trace export and the XPlane timeline."""
+
+    def __init__(self, name, event_type=None):
+        self._name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+        self._t0 = None
+
+    def begin(self):
+        self.__enter__()
+
+    def end(self):
+        self.__exit__(None, None, None)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        tr = _tracer()
+        if tr is not None:
+            self._t0 = tr.now_us()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(None, None, None)
+        tr = _tracer()
+        if tr is not None and self._t0 is not None:
+            tr.complete(self._name, self._t0, tr.now_us() - self._t0)
+            self._t0 = None
+        return False
+
+
+def load_profiler_result(filename):
+    with open(filename) as f:
+        return json.load(f)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/profile"):
+    """legacy fluid.profiler.profiler shim."""
+    p = Profiler()
+    p.start()
+    try:
+        yield
+    finally:
+        p.stop()
+
+
+def start_profiler(state="All", tracer_option="Default"):
+    jax.profiler.start_trace("./paddle_tpu_profile")
+
+
+def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
+    jax.profiler.stop_trace()

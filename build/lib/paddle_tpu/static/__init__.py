@@ -1,0 +1,141 @@
+"""paddle.static parity shims (ref: python/paddle/static/__init__.py).
+
+The reference's static graph (ProgramDesc + Executor, §3.3 of SURVEY.md) has no
+separate existence on TPU: a "static program" IS a jitted function.  We keep the
+`enable_static`/`Executor`-shaped surface for script compatibility: `data` declares
+InputSpec-like placeholders, `Executor.run` executes a to_static-compiled callable.
+Control-flow ops (cond/while_loop/case) are real: they map to lax primitives and work
+inside to_static traces — the TPU equivalent of conditional_block_op/while_op
+(ref operators/controlflow/conditional_block_op.cc, while_op.cc).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor, apply_op
+from ..jit import InputSpec  # noqa: F401
+
+_static_mode = False
+
+
+def enable_static():
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static():
+    global _static_mode
+    _static_mode = False
+
+
+def in_static_mode():
+    return _static_mode
+
+
+class Program:  # minimal placeholder graph object
+    def __init__(self):
+        self.ops = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+
+def default_main_program():
+    return Program()
+
+
+def default_startup_program():
+    return Program()
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    return InputSpec(shape, dtype, name)
+
+
+class Executor:
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None, **kwargs):
+        if callable(program):
+            out = program(**(feed or {}))
+            return out if isinstance(out, (list, tuple)) else [out]
+        return []
+
+
+class nn:
+    """Compiled control flow — the dy2static control-flow capture analog."""
+
+    @staticmethod
+    def cond(pred, true_fn, false_fn, name=None):
+        def _f(p):
+            return jax.lax.cond(jnp.all(p), lambda: _raw(true_fn()), lambda: _raw(false_fn()))
+
+        return apply_op(_f, (pred,), name="cond")
+
+    @staticmethod
+    def while_loop(cond, body, loop_vars, name=None):
+        raws = [v._value if isinstance(v, Tensor) else v for v in loop_vars]
+
+        def _f(*vs):
+            def c(vs_):
+                r = cond(*[Tensor(v, stop_gradient=True) for v in vs_])
+                return jnp.all(r._value if isinstance(r, Tensor) else r)
+
+            def b(vs_):
+                out = body(*[Tensor(v, stop_gradient=True) for v in vs_])
+                out = out if isinstance(out, (list, tuple)) else [out]
+                return tuple(o._value if isinstance(o, Tensor) else o for o in out)
+
+            return jax.lax.while_loop(c, b, tuple(vs))
+
+        return apply_op(_f, tuple(loop_vars), name="while_loop")
+
+    @staticmethod
+    def case(pred_fn_pairs, default=None, name=None):
+        for pred, fn in pred_fn_pairs:
+            v = pred.item() if isinstance(pred, Tensor) else bool(pred)
+            if v:
+                return fn()
+        return default() if default is not None else None
+
+    @staticmethod
+    def switch_case(branch_index, branch_fns, default=None, name=None):
+        idx = int(branch_index.item()) if isinstance(branch_index, Tensor) else int(branch_index)
+        fns = dict(branch_fns) if isinstance(branch_fns, (list, tuple)) else branch_fns
+        return fns.get(idx, default or (lambda: None))()
+
+
+def _raw(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(_raw(i) for i in x)
+    return x._value if isinstance(x, Tensor) else x
+
+
+def save(program, model_path, **kwargs):
+    raise NotImplementedError(
+        "paddle.static.save: static Programs have no serialized form on the TPU "
+        "build (a 'program' is a jitted function) — save the Layer with "
+        "paddle.jit.save(layer, path, input_spec=...) or its state with "
+        "paddle.save(layer.state_dict(), path)")
+
+
+def load(program, model_path, **kwargs):
+    raise NotImplementedError(
+        "paddle.static.load: use paddle.jit.load(path) for deployed programs or "
+        "paddle.load(path) for state dicts")
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
+    raise NotImplementedError(
+        "paddle.static.save_inference_model: use paddle.jit.save(layer, "
+        "path_prefix, input_spec=[...]) — the AOT-exported program is the TPU "
+        "inference artifact (loaded by paddle.jit.load or inference.Predictor)")
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    raise NotImplementedError("use paddle.jit.load for deployed programs")

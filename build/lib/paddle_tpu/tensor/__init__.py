@@ -1,0 +1,177 @@
+"""Tensor package: assembles the Tensor API surface.
+
+Reference analog: `python/paddle/tensor/__init__.py`, which monkey-patches generated op
+wrappers onto the C++ tensor type (`tensor_method_func` list).  We do the same
+declaratively: every public op in the sub-modules becomes a Tensor method, and Python
+operators map onto them.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, Parameter, apply_op, defop, _unwrap
+from . import creation, math, manipulation, logic, search, linalg
+from . import stat  # noqa: F401  (after math to avoid cycle)
+
+# ---------------------------------------------------------------- operator overloads
+
+
+def _binop(fn, reverse=False):
+    def op(self, other):
+        if isinstance(other, (list, tuple, np.ndarray)):
+            other = Tensor(jnp.asarray(other))
+        if reverse:
+            return fn(other, self)
+        return fn(self, other)
+
+    return op
+
+
+Tensor.__add__ = _binop(math.add)
+Tensor.__radd__ = _binop(math.add, True)
+Tensor.__sub__ = _binop(math.subtract)
+Tensor.__rsub__ = _binop(math.subtract, True)
+Tensor.__mul__ = _binop(math.multiply)
+Tensor.__rmul__ = _binop(math.multiply, True)
+Tensor.__truediv__ = _binop(math.divide)
+Tensor.__rtruediv__ = _binop(math.divide, True)
+Tensor.__floordiv__ = _binop(math.floor_divide)
+Tensor.__rfloordiv__ = _binop(math.floor_divide, True)
+Tensor.__mod__ = _binop(math.mod)
+Tensor.__pow__ = _binop(math.pow)
+Tensor.__rpow__ = _binop(math.pow, True)
+Tensor.__matmul__ = _binop(math.matmul)
+Tensor.__rmatmul__ = _binop(math.matmul, True)
+Tensor.__neg__ = lambda self: math.neg(self)
+Tensor.__abs__ = lambda self: math.abs(self)
+Tensor.__eq__ = _binop(logic.equal)
+Tensor.__ne__ = _binop(logic.not_equal)
+Tensor.__lt__ = _binop(logic.less_than)
+Tensor.__le__ = _binop(logic.less_equal)
+Tensor.__gt__ = _binop(logic.greater_than)
+Tensor.__ge__ = _binop(logic.greater_equal)
+Tensor.__and__ = _binop(logic.logical_and)
+Tensor.__or__ = _binop(logic.logical_or)
+Tensor.__xor__ = _binop(logic.logical_xor)
+Tensor.__invert__ = lambda self: logic.logical_not(self)
+
+
+def _getitem(self, idx):
+    def normalize(i):
+        if isinstance(i, Tensor):
+            a = i._value
+            return a
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+
+    if isinstance(idx, tuple):
+        nidx = tuple(normalize(i) for i in idx)
+    else:
+        nidx = normalize(idx)
+
+    # boolean-mask indexing has a dynamic result shape -> host eager
+    def _has_bool(i):
+        import jax
+
+        return hasattr(i, "dtype") and i.dtype == jnp.bool_ and not isinstance(i, jax.core.Tracer)
+
+    items = nidx if isinstance(nidx, tuple) else (nidx,)
+    if any(_has_bool(i) for i in items):
+        v = np.asarray(self._value)
+        np_idx = tuple(np.asarray(i) if hasattr(i, "dtype") else i for i in items)
+        return Tensor(jnp.asarray(v[np_idx if isinstance(nidx, tuple) else np_idx[0]]))
+
+    return apply_op(lambda v: v[nidx], (self,), name="getitem")
+
+
+def _setitem(self, idx, value):
+    def normalize(i):
+        if isinstance(i, Tensor):
+            return i._value
+        if isinstance(i, (list, np.ndarray)):
+            return jnp.asarray(i)
+        return i
+
+    nidx = tuple(normalize(i) for i in idx) if isinstance(idx, tuple) else normalize(idx)
+
+    def _set(v, val):
+        val = jnp.asarray(val, v.dtype) if not hasattr(val, "dtype") else val.astype(v.dtype)
+        return v.at[nidx].set(val)
+
+    out = apply_op(_set, (self, value), name="setitem")
+    self._value = out._value
+    self._node = out._node
+    self._out_index = out._out_index
+    return self
+
+
+Tensor.__getitem__ = _getitem
+Tensor.__setitem__ = _setitem
+
+# ---------------------------------------------------------------- method attachment
+
+_METHOD_SOURCES = [math, manipulation, logic, search, linalg, stat]
+_SKIP = {
+    "einsum",  # first arg is the equation string, not a tensor
+    "matmul_",
+    "assign",
+    "builtins_sum",
+    "builtins_abs",
+    "broadcast_shape",
+    "slice_builtin",
+}
+
+
+def _make_method(fn):
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+
+    method.__name__ = fn.__name__
+    return method
+
+
+for _mod in _METHOD_SOURCES:
+    for _name in dir(_mod):
+        if _name.startswith("_") or _name in _SKIP:
+            continue
+        _fn = getattr(_mod, _name)
+        if not callable(_fn) or isinstance(_fn, type):
+            continue
+        if getattr(_fn, "__module__", "").startswith("jax") or getattr(_fn, "__module__", "") in ("numpy",):
+            continue
+        if not hasattr(Tensor, _name):
+            setattr(Tensor, _name, _make_method(_fn))
+
+# explicit aliases / overrides
+Tensor.astype = lambda self, dtype: manipulation.cast(self, dtype)
+Tensor.cast = Tensor.astype
+Tensor.add_ = lambda self, y: self.set_value(self._value + _unwrap(y))
+Tensor.subtract_ = lambda self, y: self.set_value(self._value - _unwrap(y))
+Tensor.scale_ = lambda self, s=1.0, bias=0.0, **k: self.set_value(self._value * s + bias)
+Tensor.zero_ = lambda self: self.set_value(jnp.zeros_like(self._value))
+Tensor.fill_ = lambda self, v: self.set_value(jnp.full_like(self._value, v))
+Tensor.clip_ = lambda self, min=None, max=None: self.set_value(jnp.clip(self._value, min, max))
+Tensor.exponential_ = lambda self, lam=1.0: self.set_value(
+    -jnp.log1p(-np.random.rand(*self._value.shape).astype(np.float32)) / lam
+)
+Tensor.uniform_ = lambda self, min=-1.0, max=1.0, seed=0: self.set_value(
+    jnp.asarray(np.random.uniform(min, max, self._value.shape).astype(str(self._value.dtype)))
+)
+Tensor.normal_ = lambda self, mean=0.0, std=1.0: self.set_value(
+    jnp.asarray(np.random.normal(mean, std, self._value.shape).astype(str(self._value.dtype)))
+)
+Tensor.dim = lambda self: self.ndim
+Tensor.rank = lambda self: Tensor(jnp.asarray(self.ndim))
+Tensor.numel = lambda self: self.size
+Tensor.element_size = lambda self: self._value.dtype.itemsize
+Tensor.is_floating_point = lambda self: jnp.issubdtype(self._value.dtype, jnp.floating)
+Tensor.is_integer = lambda self: jnp.issubdtype(self._value.dtype, jnp.integer)
+Tensor.is_complex = lambda self: jnp.issubdtype(self._value.dtype, jnp.complexfloating)
+Tensor.pow = lambda self, y: math.pow(self, y)
+Tensor.mod = lambda self, y: math.mod(self, y)
+Tensor.remainder = lambda self, y: math.mod(self, y)
+Tensor.bfloat16 = lambda self: self.astype("bfloat16")
+Tensor.half = lambda self: self.astype("float16")
+Tensor.float = lambda self: self.astype("float32")

@@ -1,0 +1,212 @@
+"""Tensor creation ops (ref: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, Parameter, apply_op, _unwrap
+from ..core import dtypes as _dt
+from ..core import device as _device
+from ..framework import random as _random
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True):
+    """paddle.to_tensor parity."""
+    if isinstance(data, Tensor):
+        v = data._value
+        if dtype is not None:
+            v = v.astype(_dt.convert_dtype(dtype))
+        t = Tensor(v, stop_gradient=stop_gradient)
+        return t
+    if isinstance(data, (jax.Array,)):
+        v = data
+    else:
+        arr = np.asarray(data)
+        if dtype is None and arr.dtype == np.float64:
+            arr = arr.astype(_dt.get_default_dtype())
+        v = jnp.asarray(arr)
+    if dtype is not None:
+        v = v.astype(_dt.convert_dtype(dtype))
+    return Tensor(v, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_arg(shape), _dt.convert_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_arg(shape), _dt.convert_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fv = _unwrap(fill_value)
+    if dtype is None and isinstance(fill_value, (bool, int, float)):
+        dtype = _dt.get_default_dtype() if isinstance(fill_value, float) else None
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.full(_shape_arg(shape), fv, d))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply_op(lambda v: jnp.zeros_like(v, dtype=d), (x,), name="zeros_like")
+
+
+def ones_like(x, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply_op(lambda v: jnp.ones_like(v, dtype=d), (x,), name="ones_like")
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply_op(lambda v, f: jnp.full_like(v, f, dtype=d), (x, fill_value), name="full_like")
+
+
+def empty_like(x, dtype=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start = _unwrap(start)
+    end = _unwrap(end)
+    step = _unwrap(step)
+    if end is None:
+        start, end = 0, start
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.arange(start, end, step, dtype=d))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.linspace(_unwrap(start), _unwrap(stop), int(_unwrap(num)), dtype=d))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None):
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return Tensor(jnp.logspace(_unwrap(start), _unwrap(stop), int(_unwrap(num)), base=base, dtype=d))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt.convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    tensors = args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args
+    outs = jnp.meshgrid(*[_unwrap(t) for t in tensors], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def tril_indices(row, col, offset=0, dtype="int64"):
+    r, c = jnp.tril_indices(row, offset, col)
+    return Tensor(jnp.stack([r, c]))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = jnp.triu_indices(row, offset, col if col is not None else row)
+    return Tensor(jnp.stack([r, c]))
+
+
+def clone(x, name=None):
+    from . import math as _math
+
+    return _math.assign(x)
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size))
+
+
+def create_parameter(shape, dtype=None, name=None, attr=None, is_bias=False, default_initializer=None):
+    d = _dt.convert_dtype(dtype)
+    if default_initializer is None:
+        from ..nn.initializer import Constant, XavierNormal
+
+        default_initializer = Constant(0.0) if is_bias else XavierNormal()
+    p = Parameter(jnp.zeros(_shape_arg(shape), d), name=name)
+    default_initializer(p)
+    return p
+
+
+# --------------------------------------------------------------------- random creation
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype)
+    return Tensor(jax.random.normal(_random.get_rng_key(), _shape_arg(shape), dtype=d))
+
+
+def standard_normal(shape, dtype=None, name=None):
+    return randn(shape, dtype)
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if shape is None:
+        shape = np.broadcast_shapes(np.shape(_unwrap(mean)), np.shape(_unwrap(std)))
+    d = _dt.get_default_dtype()
+    noise = jax.random.normal(_random.get_rng_key(), _shape_arg(shape) if shape else (), dtype=d)
+    return Tensor(noise * _unwrap(std) + _unwrap(mean))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    d = _dt.convert_dtype(dtype)
+    key = jax.random.key(seed) if seed else _random.get_rng_key()
+    return Tensor(jax.random.uniform(key, _shape_arg(shape), dtype=d, minval=float(_unwrap(min)), maxval=float(_unwrap(max))))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(
+        jax.random.randint(_random.get_rng_key(), _shape_arg(shape), int(low), int(high)).astype(
+            _dt.convert_dtype(dtype)
+        )
+    )
+
+
+def randint_like(x, low=0, high=None, dtype=None):
+    return randint(low, high, tuple(x.shape), dtype or str(x.dtype))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_random.get_rng_key(), n).astype(_dt.convert_dtype(dtype)))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    logits = jnp.log(jnp.clip(_unwrap(x), 1e-30, None))
+    if replacement:
+        out = jax.random.categorical(_random.get_rng_key(), logits, axis=-1, shape=(*logits.shape[:-1], num_samples) if logits.ndim > 1 else (num_samples,))
+        if logits.ndim > 1:
+            out = out.reshape(*logits.shape[:-1], num_samples)
+        return Tensor(out.astype(jnp.int64))
+    # without replacement: gumbel top-k
+    g = jax.random.gumbel(_random.get_rng_key(), logits.shape)
+    _, idx = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(idx.astype(jnp.int64))
+
+
+def bernoulli(x, name=None):
+    return Tensor(jax.random.bernoulli(_random.get_rng_key(), _unwrap(x)).astype(x.dtype))
+
+
+def poisson(x, name=None):
+    return Tensor(jax.random.poisson(_random.get_rng_key(), _unwrap(x)).astype(x.dtype))
+
+
+def assign(x, output=None):
+    from . import math as _math
+
+    return _math.assign(x, output)

@@ -1,0 +1,189 @@
+"""Linear algebra (ref: python/paddle/tensor/linalg.py + phi lapack kernels).
+
+Dense decompositions route to jnp.linalg (XLA custom calls / QR-based paths on TPU).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, apply_op, _unwrap
+from .math import matmul, mm, bmm, dot  # re-exported (ref linalg.py exports)
+
+
+def einsum(equation, *operands):
+    """Ref: python/paddle/tensor/einsum.py.  Direct XLA einsum — contractions land
+    on the MXU with the compiler choosing the contraction order."""
+
+    def _f(*ops):
+        return jnp.einsum(equation, *ops)
+
+    return apply_op(_f, tuple(operands), name="einsum")
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def _f(v):
+        if axis is None and p in ("fro", 2):
+            return jnp.sqrt(jnp.sum(jnp.square(v)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(v), axis=ax, keepdims=keepdim))
+        if p == np.inf or p == float("inf"):
+            return jnp.max(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == -np.inf or p == float("-inf"):
+            return jnp.min(jnp.abs(v), axis=ax, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((v != 0).astype(v.dtype), axis=ax, keepdims=keepdim)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(v), p), axis=ax, keepdims=keepdim), 1.0 / p)
+
+    return apply_op(_f, (x,), name="norm")
+
+
+def dist(x, y, p=2):
+    def _f(a, b):
+        d = a - b
+        if p == 0:
+            return jnp.sum((d != 0).astype(d.dtype)).astype(d.dtype)
+        if p == float("inf"):
+            return jnp.max(jnp.abs(d))
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(d))
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(d), p)), 1.0 / p)
+
+    return apply_op(_f, (x, y), name="dist")
+
+
+def cholesky(x, upper=False, name=None):
+    def _f(v):
+        L = jnp.linalg.cholesky(v)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+
+    return apply_op(_f, (x,), name="cholesky")
+
+
+def inv(x, name=None):
+    return apply_op(lambda v: jnp.linalg.inv(v), (x,), name="inv")
+
+
+def det(x, name=None):
+    return apply_op(lambda v: jnp.linalg.det(v), (x,), name="det")
+
+
+def slogdet(x, name=None):
+    def _f(v):
+        sign, logdet = jnp.linalg.slogdet(v)
+        return jnp.stack([sign, logdet])
+
+    return apply_op(_f, (x,), name="slogdet")
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply_op(lambda v: tuple(jnp.linalg.svd(v, full_matrices=full_matrices)), (x,), name="svd")
+
+
+def qr(x, mode="reduced", name=None):
+    return apply_op(lambda v: tuple(jnp.linalg.qr(v, mode=mode)), (x,), name="qr")
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply_op(lambda v: tuple(jnp.linalg.eigh(v, UPLO=UPLO)), (x,), name="eigh")
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), (x,), name="eigvalsh")
+
+
+def eig(x, name=None):
+    # general eig: CPU-only in XLA; host round-trip
+    v = np.asarray(_unwrap(x))
+    w, vec = np.linalg.eig(v)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
+
+
+def solve(x, y, name=None):
+    return apply_op(lambda a, b: jnp.linalg.solve(a, b), (x, y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def _f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0, unit_diagonal=unitriangular
+        )
+
+    return apply_op(_f, (x, y), name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False):
+    def _f(b, chol):
+        return jax.scipy.linalg.cho_solve((chol, not upper), b)
+
+    return apply_op(_f, (x, y), name="cholesky_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None):
+    def _f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+
+    return apply_op(_f, (x, y), name="lstsq")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda v: jnp.linalg.pinv(v, rtol=rcond, hermitian=hermitian), (x,), name="pinv")
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_power(v, n), (x,), name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_op(lambda v: jnp.linalg.matrix_rank(v, tol=tol), (x,), name="matrix_rank")
+
+
+def cond(x, p=None, name=None):
+    return apply_op(lambda v: jnp.linalg.cond(v, p=p), (x,), name="cond")
+
+
+def multi_dot(tensors, name=None):
+    return apply_op(lambda *vs: jnp.linalg.multi_dot(vs), tuple(tensors), name="multi_dot")
+
+
+def lu(x, pivot=True, get_infos=False):
+    def _f(v):
+        lu_, piv = jax.scipy.linalg.lu_factor(v)
+        return lu_, piv.astype(jnp.int32)
+
+    out = apply_op(_f, (x,), name="lu")
+    if get_infos:
+        from .creation import zeros
+
+        return (*out, zeros([1], "int32"))
+    return out
+
+
+def corrcoef(x, rowvar=True):
+    return apply_op(lambda v: jnp.corrcoef(v, rowvar=rowvar), (x,), name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None):
+    return apply_op(lambda v: jnp.cov(v, rowvar=rowvar, ddof=1 if ddof else 0), (x,), name="cov")
+
+
+def histogramdd(x, bins, *a, **k):
+    raise NotImplementedError("histogramdd is not yet supported on the TPU build")
+
+
+def t(x, name=None):
+    from .manipulation import t as _t
+
+    return _t(x)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def _f(a, b):
+        num = jnp.sum(a * b, axis=axis)
+        den = jnp.linalg.norm(a, axis=axis) * jnp.linalg.norm(b, axis=axis)
+        return num / jnp.maximum(den, eps)
+
+    return apply_op(_f, (x1, x2), name="cosine_similarity")

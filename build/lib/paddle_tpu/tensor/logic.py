@@ -1,0 +1,75 @@
+"""Comparison / logical ops (ref: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, apply_op, _unwrap
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return apply_op(fn, (x, y), name=name)
+
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", lambda a, b: jnp.equal(a, b))
+not_equal = _cmp("not_equal", lambda a, b: jnp.not_equal(a, b))
+greater_than = _cmp("greater_than", lambda a, b: jnp.greater(a, b))
+greater_equal = _cmp("greater_equal", lambda a, b: jnp.greater_equal(a, b))
+less_than = _cmp("less_than", lambda a, b: jnp.less(a, b))
+less_equal = _cmp("less_equal", lambda a, b: jnp.less_equal(a, b))
+logical_and = _cmp("logical_and", lambda a, b: jnp.logical_and(a, b))
+logical_or = _cmp("logical_or", lambda a, b: jnp.logical_or(a, b))
+logical_xor = _cmp("logical_xor", lambda a, b: jnp.logical_xor(a, b))
+bitwise_and = _cmp("bitwise_and", lambda a, b: jnp.bitwise_and(a, b))
+bitwise_or = _cmp("bitwise_or", lambda a, b: jnp.bitwise_or(a, b))
+bitwise_xor = _cmp("bitwise_xor", lambda a, b: jnp.bitwise_xor(a, b))
+
+
+def logical_not(x, name=None):
+    return apply_op(lambda a: jnp.logical_not(a), (x,), name="logical_not")
+
+
+def bitwise_not(x, name=None):
+    return apply_op(lambda a: jnp.bitwise_not(a), (x,), name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), (x, y), name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (x, y),
+        name="allclose",
+    )
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan),
+        (x, y),
+        name="isclose",
+    )
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_empty(x):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_op(lambda v: jnp.all(v, axis=ax, keepdims=keepdim), (x,), name="all")
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+    return apply_op(lambda v: jnp.any(v, axis=ax, keepdims=keepdim), (x,), name="any")

@@ -1,0 +1,449 @@
+"""Shape/layout manipulation ops (ref: python/paddle/tensor/manipulation.py).
+
+All are jnp/lax compositions; reshape/transpose are free (layout changes) under XLA.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, apply_op, _unwrap
+from ..core import dtypes as _dt
+
+
+def _shape_arg(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    return tuple(int(s) if not isinstance(s, Tensor) else int(s.item()) for s in shape)
+
+
+def reshape(x, shape, name=None):
+    s = _shape_arg(shape)
+    return apply_op(lambda v: jnp.reshape(v, s), (x,), name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    s = _shape_arg(shape)
+    return x._rebind(jnp.reshape(x._value, s))
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def _f(v):
+        nd = v.ndim
+        sa = start_axis % nd if nd else 0
+        so = stop_axis % nd if nd else 0
+        new_shape = v.shape[:sa] + (-1,) + v.shape[so + 1:]
+        return jnp.reshape(v, new_shape)
+
+    return apply_op(_f, (x,), name="flatten")
+
+
+def squeeze(x, axis=None, name=None):
+    def _f(v):
+        if axis is None:
+            return jnp.squeeze(v)
+        axes = axis if isinstance(axis, (list, tuple)) else [axis]
+        axes = tuple(a % v.ndim for a in axes if v.shape[a % v.ndim] == 1)
+        return jnp.squeeze(v, axis=axes) if axes else v
+
+    return apply_op(_f, (x,), name="squeeze")
+
+
+def unsqueeze(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+
+    def _f(v):
+        out = v
+        for a in sorted([a % (out.ndim + len(axes)) if a < 0 else a for a in axes]):
+            out = jnp.expand_dims(out, a)
+        return out
+
+    return apply_op(_f, (x,), name="unsqueeze")
+
+
+def transpose(x, perm, name=None):
+    p = tuple(int(a) for a in perm)
+    return apply_op(lambda v: jnp.transpose(v, p), (x,), name="transpose")
+
+
+def moveaxis(x, source, destination):
+    return apply_op(lambda v: jnp.moveaxis(v, source, destination), (x,), name="moveaxis")
+
+
+def swapaxes(x, axis1, axis2):
+    return apply_op(lambda v: jnp.swapaxes(v, axis1, axis2), (x,), name="swapaxes")
+
+
+def t(x):
+    return apply_op(lambda v: v.T if v.ndim >= 2 else v, (x,), name="t")
+
+
+def concat(x, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    tensors = list(x)
+    return apply_op(lambda *vs: jnp.concatenate(vs, axis=axis), tuple(tensors), name="concat")
+
+
+def stack(x, axis=0, name=None):
+    tensors = list(x)
+    return apply_op(lambda *vs: jnp.stack(vs, axis=axis), tuple(tensors), name="stack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+
+    def _f(v):
+        ax = axis % v.ndim
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=ax))
+        sections = [
+            s if not isinstance(s, Tensor) else int(s.item()) for s in num_or_sections
+        ]
+        total = v.shape[ax]
+        known = builtins_sum(s for s in sections if s != -1)
+        sections = [s if s != -1 else total - known for s in sections]
+        idx = np.cumsum(sections)[:-1]
+        return tuple(jnp.split(v, idx, axis=ax))
+
+    return apply_op(_f, (x,), name="split")
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis=axis)
+
+
+def unbind(x, axis=0):
+    def _f(v):
+        ax = axis % v.ndim
+        return tuple(jnp.squeeze(s, axis=ax) for s in jnp.split(v, v.shape[ax], axis=ax))
+
+    return apply_op(_f, (x,), name="unbind")
+
+
+def unstack(x, axis=0, num=None):
+    return unbind(x, axis)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _shape_arg(repeat_times)
+    return apply_op(lambda v: jnp.tile(v, reps), (x,), name="tile")
+
+
+def expand(x, shape, name=None):
+    s = _shape_arg(shape)
+
+    def _f(v):
+        tgt = list(s)
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = v.shape[i - len(tgt) + v.ndim] if i - len(tgt) + v.ndim >= 0 else 1
+        return jnp.broadcast_to(v, tuple(tgt))
+
+    return apply_op(_f, (x,), name="expand")
+
+
+def expand_as(x, y, name=None):
+    return apply_op(lambda v, w: jnp.broadcast_to(v, w.shape), (x, y), name="expand_as")
+
+
+def broadcast_to(x, shape, name=None):
+    s = _shape_arg(shape)
+    return apply_op(lambda v: jnp.broadcast_to(v, s), (x,), name="broadcast_to")
+
+
+def broadcast_tensors(inputs):
+    shape = np.broadcast_shapes(*[tuple(t.shape) for t in inputs])
+    return [broadcast_to(t, shape) for t in inputs]
+
+
+def flip(x, axis, name=None):
+    axes = axis if isinstance(axis, (list, tuple)) else [axis]
+    return apply_op(lambda v: jnp.flip(v, axis=tuple(axes)), (x,), name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1)):
+    return apply_op(lambda v: jnp.rot90(v, k=k, axes=tuple(axes)), (x,), name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply_op(lambda v: jnp.roll(v, shifts, axis=axis), (x,), name="roll")
+
+
+def cast(x, dtype):
+    d = _dt.convert_dtype(dtype)
+    return apply_op(lambda v: v.astype(d), (x,), name="cast")
+
+
+def gather(x, index, axis=0, name=None):
+    if isinstance(axis, Tensor):
+        axis = int(axis.item())
+    return apply_op(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), (x, index), name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def _f(v, idx):
+        idx = idx.astype(jnp.int32)
+        k = idx.shape[-1]
+        out = v[tuple(jnp.moveaxis(idx, -1, 0))]
+        return out
+
+    return apply_op(_f, (x, index), name="gather_nd")
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), (x, index), name="index_select")
+
+
+def index_sample(x, index):
+    def _f(v, i):
+        return jnp.take_along_axis(v, i.astype(jnp.int32), axis=1)
+
+    return apply_op(_f, (x, index), name="index_sample")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def _f(v, i, u):
+        i = i.astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle semantics: non-overwrite zeroes target rows then adds
+        zeroed = v.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+
+    return apply_op(_f, (x, index, updates), name="scatter")
+
+
+def scatter_(x, index, updates, overwrite=True):
+    out = scatter(x, index, updates, overwrite)
+    return x._rebind(out._value)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def _f(v, i, u):
+        i = i.astype(jnp.int32)
+        return v.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op(_f, (x, index, updates), name="scatter_nd_add")
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _shape_arg(shape)
+
+    def _f(i, u):
+        i = i.astype(jnp.int32)
+        return jnp.zeros(s, u.dtype).at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+
+    return apply_op(_f, (index, updates), name="scatter_nd")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True):
+    return apply_op(
+        lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=axis),
+        (arr, indices),
+        name="take_along_axis",
+    )
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign"):
+    def _f(v, i, u):
+        i = i.astype(jnp.int32)
+        u = jnp.broadcast_to(u, i.shape) if jnp.ndim(u) else jnp.full(i.shape, u, v.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(v, i, u, axis=axis, inplace=False)
+        if reduce == "add":
+            dims = list(range(v.ndim))
+            onehot = None
+            out = v
+            # scatter-add along axis via .at
+            idx = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(v.ndim)]) for d, s in enumerate(i.shape)]
+            idx[axis] = i
+            return out.at[tuple(idx)].add(u)
+        if reduce in ("mul", "multiply"):
+            idx = [jnp.arange(s).reshape([-1 if d == k else 1 for k in range(v.ndim)]) for d, s in enumerate(i.shape)]
+            idx[axis] = i
+            return v.at[tuple(idx)].multiply(u)
+        raise ValueError(reduce)
+
+    return apply_op(_f, (arr, indices, values), name="put_along_axis")
+
+
+def take(x, index, mode="raise"):
+    return apply_op(lambda v, i: jnp.take(v.reshape(-1), i.astype(jnp.int32).reshape(-1)).reshape(i.shape), (x, index), name="take")
+
+
+def slice(input, axes, starts, ends):
+    def _f(v):
+        out = v
+        for ax, st, en in zip(axes, starts, ends):
+            st = int(st.item()) if isinstance(st, Tensor) else int(st)
+            en = int(en.item()) if isinstance(en, Tensor) else int(en)
+            n = v.shape[ax]
+            st = max(st + n, 0) if st < 0 else min(st, n)
+            en = max(en + n, 0) if en < 0 else min(en, n)
+            idx = [slice_builtin(None)] * out.ndim
+            idx[ax] = slice_builtin(st, en)
+            out = out[tuple(idx)]
+        return out
+
+    return apply_op(_f, (input,), name="slice")
+
+
+import builtins as _builtins
+
+slice_builtin = _builtins.slice
+
+
+def strided_slice(x, axes, starts, ends, strides):
+    def _f(v):
+        out = v
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx = [slice_builtin(None)] * out.ndim
+            idx[ax] = slice_builtin(st, en, sd)
+            out = out[tuple(idx)]
+        return out
+
+    return apply_op(_f, (x,), name="strided_slice")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ..nn import functional as F
+
+    return F.pad(x, pad, mode=mode, value=value, data_format=data_format)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    def _f(v, r):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        if np.ndim(r) == 0:
+            return jnp.repeat(v, int(r), axis=ax)
+        return jnp.repeat(v, r, axis=ax, total_repeat_length=int(np.sum(np.asarray(r))))
+
+    return apply_op(_f, (x, repeats), name="repeat_interleave")
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.tril(v, k=diagonal), (x,), name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda v: jnp.triu(v, k=diagonal), (x,), name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def _f(v):
+        if v.ndim == 1:
+            out = jnp.diag(v, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(v), k=offset) == 0
+                out = jnp.where(mask, padding_value, out)
+            return out
+        return jnp.diag(v, k=offset)
+
+    return apply_op(_f, (x,), name="diag")
+
+
+def diagflat(x, offset=0):
+    return apply_op(lambda v: jnp.diagflat(v, k=offset), (x,), name="diagflat")
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1):
+    def _f(v):
+        n = v.shape[-1] + builtins_abs(offset)
+        out = jnp.zeros(v.shape[:-1] + (n, n), v.dtype)
+        idx = jnp.arange(v.shape[-1])
+        if offset >= 0:
+            out = out.at[..., idx, idx + offset].set(v)
+        else:
+            out = out.at[..., idx - offset, idx].set(v)
+        return out
+
+    return apply_op(_f, (x,), name="diag_embed")
+
+
+def builtins_abs(v):
+    return v if v >= 0 else -v
+
+
+def masked_select(x, mask, name=None):
+    # dynamic output shape: executes eagerly on host (not jittable by design)
+    v = np.asarray(_unwrap(x))
+    m = np.asarray(_unwrap(mask))
+    return Tensor(jnp.asarray(v[m]))
+
+
+def masked_fill(x, mask, value):
+    return apply_op(lambda v, m, val: jnp.where(m, val, v), (x, mask, value), name="masked_fill")
+
+
+def index_put(x, indices, value, accumulate=False):
+    def _f(v, val, *idx):
+        idx = tuple(i.astype(jnp.int32) if jnp.issubdtype(i.dtype, jnp.integer) else i for i in idx)
+        if accumulate:
+            return v.at[idx].add(val)
+        return v.at[idx].set(val)
+
+    return apply_op(_f, (x, value, *indices), name="index_put")
+
+
+def as_real(x):
+    return apply_op(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), (x,), name="as_real")
+
+
+def as_complex(x):
+    return apply_op(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), (x,), name="as_complex")
+
+
+def tensordot(x, y, axes=2):
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=axes), (x, y), name="tensordot")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1):
+    from ..nn import functional as F
+
+    return F.unfold(x, kernel_sizes, strides, paddings, dilations)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False, axis=None):
+    # dynamic shape -> host eager
+    v = np.asarray(_unwrap(x))
+    res = np.unique(v, return_index=return_index, return_inverse=return_inverse, return_counts=return_counts, axis=axis)
+    if isinstance(res, tuple):
+        return tuple(Tensor(jnp.asarray(r)) for r in res)
+    return Tensor(jnp.asarray(res))
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None):
+    v = np.asarray(_unwrap(x)).reshape(-1) if axis is None else np.asarray(_unwrap(x))
+    keep = np.ones(len(v), bool)
+    keep[1:] = v[1:] != v[:-1]
+    out = [Tensor(jnp.asarray(v[keep]))]
+    if return_inverse:
+        out.append(Tensor(jnp.asarray(np.cumsum(keep) - 1)))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        out.append(Tensor(jnp.asarray(np.diff(np.append(idx, len(v))))))
+    return out[0] if len(out) == 1 else tuple(out)
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def _f(v):
+        size = (index_num + nshards - 1) // nshards
+        lo = shard_id * size
+        ok = (v >= lo) & (v < lo + size)
+        return jnp.where(ok, v - lo, ignore_value)
+
+    return apply_op(_f, (input,), name="shard_index")

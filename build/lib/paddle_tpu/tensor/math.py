@@ -1,0 +1,378 @@
+"""Math ops (paddle.tensor.math parity).
+
+Reference: `python/paddle/tensor/math.py` wrappers dispatching to phi kernels
+(`paddle/phi/kernels/*`).  TPU-native: each op is a pure jax.numpy composition that XLA
+fuses/tiles onto the VPU/MXU; autograd comes from `apply_op`'s jax.vjp (tensor.py).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .tensor import Tensor, apply_op, defop, _unwrap
+from ..core import dtypes as _dt
+
+
+def _op(name, fn):
+    g = defop(name, fn)
+    globals()[name] = g
+    return g
+
+
+# ----------------------------------------------------------------- binary arithmetic
+add = _op("add", lambda x, y: jnp.add(x, y))
+subtract = _op("subtract", lambda x, y: jnp.subtract(x, y))
+multiply = _op("multiply", lambda x, y: jnp.multiply(x, y))
+divide = _op("divide", lambda x, y: jnp.divide(x, y))
+floor_divide = _op("floor_divide", lambda x, y: jnp.floor_divide(x, y))
+mod = _op("mod", lambda x, y: jnp.mod(x, y))
+remainder = mod
+floor_mod = mod
+pow = _op("pow", lambda x, y: jnp.power(x, y))
+maximum = _op("maximum", lambda x, y: jnp.maximum(x, y))
+minimum = _op("minimum", lambda x, y: jnp.minimum(x, y))
+fmax = _op("fmax", lambda x, y: jnp.fmax(x, y))
+fmin = _op("fmin", lambda x, y: jnp.fmin(x, y))
+atan2 = _op("atan2", lambda x, y: jnp.arctan2(x, y))
+hypot = _op("hypot", lambda x, y: jnp.hypot(x, y))
+logaddexp = _op("logaddexp", lambda x, y: jnp.logaddexp(x, y))
+heaviside = _op("heaviside", lambda x, y: jnp.heaviside(x, y))
+copysign = _op("copysign", lambda x, y: jnp.copysign(x, y))
+nextafter = _op("nextafter", lambda x, y: jnp.nextafter(x, y))
+ldexp = _op("ldexp", lambda x, y: jnp.ldexp(x, y))
+gcd = _op("gcd", lambda x, y: jnp.gcd(x, y))
+lcm = _op("lcm", lambda x, y: jnp.lcm(x, y))
+
+# ----------------------------------------------------------------- unary
+abs = _op("abs", lambda x: jnp.abs(x))
+neg = _op("neg", lambda x: jnp.negative(x))
+exp = _op("exp", lambda x: jnp.exp(x))
+expm1 = _op("expm1", lambda x: jnp.expm1(x))
+log = _op("log", lambda x: jnp.log(x))
+log2 = _op("log2", lambda x: jnp.log2(x))
+log10 = _op("log10", lambda x: jnp.log10(x))
+log1p = _op("log1p", lambda x: jnp.log1p(x))
+sqrt = _op("sqrt", lambda x: jnp.sqrt(x))
+rsqrt = _op("rsqrt", lambda x: jax.lax.rsqrt(x))
+square = _op("square", lambda x: jnp.square(x))
+sin = _op("sin", lambda x: jnp.sin(x))
+cos = _op("cos", lambda x: jnp.cos(x))
+tan = _op("tan", lambda x: jnp.tan(x))
+asin = _op("asin", lambda x: jnp.arcsin(x))
+acos = _op("acos", lambda x: jnp.arccos(x))
+atan = _op("atan", lambda x: jnp.arctan(x))
+sinh = _op("sinh", lambda x: jnp.sinh(x))
+cosh = _op("cosh", lambda x: jnp.cosh(x))
+tanh = _op("tanh", lambda x: jnp.tanh(x))
+asinh = _op("asinh", lambda x: jnp.arcsinh(x))
+acosh = _op("acosh", lambda x: jnp.arccosh(x))
+atanh = _op("atanh", lambda x: jnp.arctanh(x))
+floor = _op("floor", lambda x: jnp.floor(x))
+ceil = _op("ceil", lambda x: jnp.ceil(x))
+round = _op("round", lambda x: jnp.round(x))
+trunc = _op("trunc", lambda x: jnp.trunc(x))
+frac = _op("frac", lambda x: x - jnp.trunc(x))
+sign = _op("sign", lambda x: jnp.sign(x))
+sgn = sign
+reciprocal = _op("reciprocal", lambda x: jnp.reciprocal(x))
+erf = _op("erf", lambda x: jax.scipy.special.erf(x))
+erfinv = _op("erfinv", lambda x: jax.scipy.special.erfinv(x))
+lgamma = _op("lgamma", lambda x: jax.scipy.special.gammaln(x))
+digamma = _op("digamma", lambda x: jax.scipy.special.digamma(x))
+polygamma = _op("polygamma", lambda x, n=1: jax.scipy.special.polygamma(n, x))
+i0 = _op("i0", lambda x: jax.scipy.special.i0(x))
+i1 = _op("i1", lambda x: jax.scipy.special.i1(x))
+deg2rad = _op("deg2rad", lambda x: jnp.deg2rad(x))
+rad2deg = _op("rad2deg", lambda x: jnp.rad2deg(x))
+angle = _op("angle", lambda x: jnp.angle(x))
+conj = _op("conj", lambda x: jnp.conj(x))
+real = _op("real", lambda x: jnp.real(x))
+imag = _op("imag", lambda x: jnp.imag(x))
+nan_to_num = _op("nan_to_num", lambda x, nan=0.0, posinf=None, neginf=None: jnp.nan_to_num(x, nan=nan, posinf=posinf, neginf=neginf))
+logit = _op("logit", lambda x, eps=None: jax.scipy.special.logit(jnp.clip(x, eps, 1 - eps) if eps else x))
+sigmoid = _op("sigmoid", lambda x: jax.nn.sigmoid(x))
+rint = _op("rint", lambda x: jnp.rint(x))
+exp2 = _op("exp2", lambda x: jnp.exp2(x))
+
+
+def clip(x, min=None, max=None):
+    return apply_op(lambda v, lo, hi: jnp.clip(v, lo, hi), (x, min, max), name="clip")
+
+
+def lerp(x, y, weight):
+    return apply_op(lambda a, b, w: a + w * (b - a), (x, y, weight), name="lerp")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159):
+    return apply_op(lambda v: scale_b * jnp.tanh(scale_a * v), (x,), name="stanh")
+
+
+# ----------------------------------------------------------------- matmul family
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    """Ref: python/paddle/tensor/linalg.py:128; phi MatmulKernel.  Feeds the MXU."""
+
+    def _mm(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim >= 2 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim >= 2 else b
+        return jnp.matmul(a, b)
+
+    return apply_op(_mm, (x, y), name="matmul")
+
+
+mm = matmul
+
+
+def bmm(x, y):
+    return apply_op(lambda a, b: jnp.matmul(a, b), (x, y), name="bmm")
+
+
+def dot(x, y):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), (x, y), name="dot")
+
+
+def inner(x, y):
+    return apply_op(lambda a, b: jnp.inner(a, b), (x, y), name="inner")
+
+
+def outer(x, y):
+    return apply_op(lambda a, b: jnp.outer(a, b), (x, y), name="outer")
+
+
+def kron(x, y):
+    return apply_op(lambda a, b: jnp.kron(a, b), (x, y), name="kron")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0):
+    return apply_op(lambda i, a, b: beta * i + alpha * jnp.matmul(a, b), (input, x, y), name="addmm")
+
+
+def cross(x, y, axis=9):
+    ax = axis if axis != 9 else -1
+    return apply_op(lambda a, b: jnp.cross(a, b, axis=ax), (x, y), name="cross")
+
+
+def multiply_(x, y):  # limited in-place parity
+    return x.set_value(jnp.multiply(x._value, _unwrap(y)))
+
+
+# ----------------------------------------------------------------- reductions
+def _norm_axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply_op(
+        lambda v: jnp.sum(v, axis=_norm_axis(axis), dtype=d, keepdims=keepdim),
+        (x,),
+        name="sum",
+    )
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.mean(v, axis=_norm_axis(axis), keepdims=keepdim), (x,), name="mean")
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = _dt.convert_dtype(dtype) if dtype is not None else None
+    return apply_op(lambda v: jnp.prod(v, axis=_norm_axis(axis), dtype=d, keepdims=keepdim), (x,), name="prod")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.max(v, axis=_norm_axis(axis), keepdims=keepdim), (x,), name="max")
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.min(v, axis=_norm_axis(axis), keepdims=keepdim), (x,), name="min")
+
+
+amax = max
+amin = min
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jnp.std(v, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        (x,),
+        name="std",
+    )
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jnp.var(v, axis=_norm_axis(axis), ddof=1 if unbiased else 0, keepdims=keepdim),
+        (x,),
+        name="var",
+    )
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply_op(lambda v: jnp.median(v, axis=_norm_axis(axis), keepdims=keepdim), (x,), name="median")
+
+
+def quantile(x, q, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.quantile(v, jnp.asarray(q), axis=_norm_axis(axis), keepdims=keepdim), (x,), name="quantile")
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False):
+    return apply_op(lambda v: jnp.nansum(v, axis=_norm_axis(axis), keepdims=keepdim), (x,), name="nansum")
+
+
+def nanmean(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.nanmean(v, axis=_norm_axis(axis), keepdims=keepdim), (x,), name="nanmean")
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply_op(
+        lambda v: jax.scipy.special.logsumexp(v, axis=_norm_axis(axis), keepdims=keepdim),
+        (x,),
+        name="logsumexp",
+    )
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    def _f(v):
+        if axis is None:
+            return jnp.cumsum(v.reshape(-1))
+        return jnp.cumsum(v, axis=axis)
+
+    return apply_op(_f, (x,), name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    return apply_op(lambda v: jnp.cumprod(v, axis=dim), (x,), name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64"):
+    def _f(v):
+        vals = jax.lax.associative_scan(jnp.maximum, v, axis=axis or 0)
+        return vals
+
+    return apply_op(_f, (x,), name="cummax")
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def _f(v):
+        if axis is None:
+            v = v.reshape(-1)
+            ax = 0
+        else:
+            ax = axis
+        return jax.lax.cumlogsumexp(v, axis=ax)
+
+    return apply_op(_f, (x,), name="logcumsumexp")
+
+
+def trace(x, offset=0, axis1=0, axis2=1):
+    return apply_op(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), (x,), name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1):
+    return apply_op(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), (x,), name="diagonal")
+
+
+def count_nonzero(x, axis=None, keepdim=False):
+    return apply_op(lambda v: jnp.count_nonzero(v, axis=_norm_axis(axis), keepdims=keepdim), (x,), name="count_nonzero")
+
+
+# ----------------------------------------------------------------- misc
+def assign(x, output=None):
+    out = apply_op(lambda v: v + 0, (x,), name="assign")
+    if output is not None:
+        output.set_value(out._value)
+        return output
+    return out
+
+
+def increment(x, value=1.0):
+    x.set_value(x._value + value)
+    return x
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def _f(v, s, b):
+        r = v * s + b if bias_after_scale else (v + b) * s
+        return r
+
+    out = apply_op(_f, (x, scale, bias), name="scale")
+    if act:
+        from ..nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+def isfinite(x):
+    return apply_op(lambda v: jnp.isfinite(v), (x,), name="isfinite")
+
+
+def isnan(x):
+    return apply_op(lambda v: jnp.isnan(v), (x,), name="isnan")
+
+
+def isinf(x):
+    return apply_op(lambda v: jnp.isinf(v), (x,), name="isinf")
+
+
+def isneginf(x):
+    return apply_op(lambda v: jnp.isneginf(v), (x,), name="isneginf")
+
+
+def isposinf(x):
+    return apply_op(lambda v: jnp.isposinf(v), (x,), name="isposinf")
+
+
+def isreal(x):
+    return apply_op(lambda v: jnp.isreal(v), (x,), name="isreal")
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None):
+    def _f(v, pre, app):
+        kw = {}
+        if pre is not None:
+            kw["prepend"] = pre
+        if app is not None:
+            kw["append"] = app
+        return jnp.diff(v, n=n, axis=axis, **kw)
+
+    return apply_op(_f, (x, prepend, append), name="diff")
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):
+    def _f(v):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (jnp.min(v), jnp.max(v))
+        h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+        return h
+
+    return apply_op(_f, (x,), name="histogram")
+
+
+def bincount(x, weights=None, minlength=0):
+    return apply_op(
+        lambda v, w: jnp.bincount(v, weights=w, minlength=minlength, length=None),
+        (x, weights),
+        name="bincount",
+    )
+
+
+def broadcast_shape(x_shape, y_shape):
+    import numpy as np
+
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def multiplex(inputs, index):
+    def _f(idx, *ins):
+        stacked = jnp.stack(ins, axis=0)  # [n, batch, ...]
+        sel = idx.reshape(-1)
+        return jnp.take_along_axis(
+            stacked, sel.reshape(1, -1, *([1] * (stacked.ndim - 2))), axis=0
+        )[0]
+
+    return apply_op(_f, (index, *inputs), name="multiplex")

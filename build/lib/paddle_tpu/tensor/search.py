@@ -1,0 +1,151 @@
+"""Search / sort ops (ref: python/paddle/tensor/search.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .tensor import Tensor, apply_op, _unwrap
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _f(v):
+        if axis is None:
+            return jnp.argmax(v.reshape(-1))
+        out = jnp.argmax(v, axis=axis)
+        if keepdim:
+            out = jnp.expand_dims(out, axis)
+        return out
+
+    return apply_op(_f, (x,), name="argmax")
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def _f(v):
+        if axis is None:
+            return jnp.argmin(v.reshape(-1))
+        out = jnp.argmin(v, axis=axis)
+        if keepdim:
+            out = jnp.expand_dims(out, axis)
+        return out
+
+    return apply_op(_f, (x,), name="argmin")
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def _f(v):
+        out = jnp.argsort(v, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+
+    return apply_op(_f, (x,), name="argsort")
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def _f(v):
+        out = jnp.sort(v, axis=axis)
+        if descending:
+            out = jnp.flip(out, axis=axis)
+        return out
+
+    return apply_op(_f, (x,), name="sort")
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    if isinstance(k, Tensor):
+        k = int(k.item())
+
+    def _f(v):
+        ax = v.ndim - 1 if axis is None else axis % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        src = moved if largest else -moved
+        vals, idx = jax.lax.top_k(src, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax).astype(jnp.int64)
+
+    return apply_op(_f, (x,), name="topk")
+
+
+def kthvalue(x, k, axis=None, keepdim=False):
+    def _f(v):
+        ax = v.ndim - 1 if axis is None else axis % v.ndim
+        moved = jnp.moveaxis(v, ax, -1)
+        vals = jnp.sort(moved, axis=-1)[..., k - 1]
+        idx = jnp.argsort(moved, axis=-1)[..., k - 1]
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, ax), jnp.expand_dims(idx, ax)
+        return vals, idx.astype(jnp.int64)
+
+    return apply_op(_f, (x,), name="kthvalue")
+
+
+def mode(x, axis=-1, keepdim=False):
+    def _f(v):
+        moved = jnp.moveaxis(v, axis, -1)
+        s = jnp.sort(moved, axis=-1)
+        # run-length trick: count equal runs
+        eq = (s[..., 1:] == s[..., :-1]).astype(jnp.int32)
+        run = jnp.concatenate([jnp.zeros_like(s[..., :1], jnp.int32), eq], -1)
+        run = jax.lax.associative_scan(lambda a, b: (a + b) * (b > 0) + b * (b == 0), run, axis=-1) if False else _runlen(run)
+        best = jnp.argmax(run, axis=-1)
+        vals = jnp.take_along_axis(s, best[..., None], axis=-1)[..., 0]
+        idx = jnp.argmax(jnp.moveaxis(v, axis, -1) == vals[..., None], axis=-1)
+        if keepdim:
+            vals, idx = jnp.expand_dims(vals, axis), jnp.expand_dims(idx, axis)
+        return vals, idx.astype(jnp.int64)
+
+    def _runlen(r):
+        def step(carry, x):
+            c = (carry + x) * x
+            return c, c
+
+        _, out = jax.lax.scan(step, jnp.zeros(r.shape[:-1], r.dtype), jnp.moveaxis(r, -1, 0))
+        return jnp.moveaxis(out, 0, -1)
+
+    return apply_op(_f, (x,), name="mode")
+
+
+def nonzero(x, as_tuple=False):
+    # dynamic output shape -> host eager
+    v = np.asarray(_unwrap(x))
+    res = np.nonzero(v)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(r[:, None])) for r in res)
+    return Tensor(jnp.asarray(np.stack(res, axis=1).astype(np.int64)))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), (condition, x, y), name="where")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False):
+    side = "right" if right else "left"
+
+    def _f(s, v):
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1])
+            ).reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+
+    return apply_op(_f, (sorted_sequence, values), name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def index_of_max(x):  # helper used by metrics
+    return argmax(x)
+
+
+def masked_select(x, mask, name=None):
+    from . import manipulation
+
+    return manipulation.masked_select(x, mask)

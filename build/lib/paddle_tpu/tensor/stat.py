@@ -1,0 +1,2 @@
+"""Statistics re-exports (ref: python/paddle/tensor/stat.py)."""
+from .math import mean, std, var, median, quantile, nanmean, nansum  # noqa: F401

@@ -1,0 +1,34 @@
+"""paddle.utils parity shims."""
+from __future__ import annotations
+
+
+def try_import(name):
+    import importlib
+
+    try:
+        return importlib.import_module(name)
+    except ImportError as e:
+        raise ImportError(f"module {name} not available: {e}") from e
+
+
+def run_check():
+    import jax
+
+    print("paddle_tpu is installed successfully!")
+    print(f"devices: {jax.devices()}")
+
+
+class unique_name:
+    _counters = {}
+
+    @classmethod
+    def generate(cls, key="tmp"):
+        cls._counters[key] = cls._counters.get(key, 0) + 1
+        return f"{key}_{cls._counters[key]}"
+
+
+def deprecated(update_to="", since="", reason=""):
+    def wrapper(fn):
+        return fn
+
+    return wrapper

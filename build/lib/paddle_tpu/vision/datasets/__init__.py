@@ -1,0 +1,215 @@
+"""vision datasets (ref: python/paddle/vision/datasets/mnist.py etc.).
+
+Zero-egress environment: datasets load from local files when present
+(PADDLE_TPU_DATA_HOME or ~/.cache/paddle_tpu) and otherwise fall back to a
+deterministic synthetic sample generator with the real shapes/dtypes — enough for
+pipelines, tests, and throughput benchmarking.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+
+def _data_home():
+    return os.environ.get("PADDLE_TPU_DATA_HOME", os.path.expanduser("~/.cache/paddle_tpu"))
+
+
+class MNIST(Dataset):
+    """Ref: vision/datasets/mnist.py.  Reads idx files if present, else synthesizes."""
+
+    NUM_TRAIN = 60000
+    NUM_TEST = 10000
+
+    def __init__(self, image_path=None, label_path=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.mode = mode
+        self.transform = transform
+        n = self.NUM_TRAIN if mode == "train" else self.NUM_TEST
+        img_file = image_path or os.path.join(_data_home(), "mnist", f"{mode}-images-idx3-ubyte.gz")
+        lbl_file = label_path or os.path.join(_data_home(), "mnist", f"{mode}-labels-idx1-ubyte.gz")
+        if os.path.exists(img_file) and os.path.exists(lbl_file):
+            self.images = self._read_images(img_file)
+            self.labels = self._read_labels(lbl_file)
+        else:
+            import warnings
+
+            warnings.warn(
+                f"{type(self).__name__}: '{img_file}' not found and this build "
+                "cannot download — using GENERATED stand-in digits (pipeline "
+                "smoke tests only; place the real idx files there for metrics)",
+                stacklevel=2)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n_syn = min(n, 4096)
+            self.labels = rng.randint(0, 10, n_syn).astype(np.int64)
+            base = rng.rand(10, 28, 28).astype(np.float32)
+            noise = rng.rand(n_syn, 28, 28).astype(np.float32) * 0.3
+            self.images = ((base[self.labels] * 0.7 + noise) * 255).astype(np.uint8)
+
+    @staticmethod
+    def _read_images(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+            return np.frombuffer(f.read(), np.uint8).reshape(num, rows, cols)
+
+    @staticmethod
+    def _read_labels(path):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            magic, num = struct.unpack(">II", f.read(8))
+            return np.frombuffer(f.read(), np.uint8).astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32)[None] / 255.0  # CHW in [0,1]
+        if self.transform is not None:
+            img = self.transform(self.images[idx])
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """Ref: vision/datasets/cifar.py — synthetic fallback with CIFAR shapes."""
+
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        if data_file is not None:
+            if not os.path.exists(data_file):
+                raise FileNotFoundError(
+                    f"{type(self).__name__}: data_file '{data_file}' does not "
+                    "exist (an explicitly given path never falls back to "
+                    "generated data)")
+            self._load_pickled(data_file, mode)
+        else:
+            import warnings
+
+            warnings.warn(
+                f"{type(self).__name__}: no data_file given and this build cannot "
+                "download — using GENERATED stand-in images (pipeline smoke tests "
+                "only; pass data_file=<cifar npz with images/labels> for metrics)",
+                stacklevel=2)
+            n = 2048
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.labels = rng.randint(0, 10, n).astype(np.int64)
+            self.images = (rng.rand(n, 3, 32, 32) * 255).astype(np.uint8)
+
+    def _load_pickled(self, data_file, mode):
+        data = np.load(data_file)
+        if f"{mode}_images" in data:         # mode-split archive
+            self.images = data[f"{mode}_images"].astype(np.uint8)
+            self.labels = data[f"{mode}_labels"].astype(np.int64)
+        else:                                # combined archive: 80/20 split
+            images = data["images"].astype(np.uint8)
+            labels = data["labels"].astype(np.int64)
+            split = int(len(labels) * 0.8)
+            sl = slice(0, split) if mode == "train" else slice(split, None)
+            self.images, self.labels = images[sl], labels[sl]
+
+    def __getitem__(self, idx):
+        img = self.images[idx].astype(np.float32) / 255.0
+        if self.transform is not None:
+            img = self.transform(self.images[idx].transpose(1, 2, 0))
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    def __init__(self, data_file=None, mode="train", transform=None, download=True, backend=None):
+        super().__init__(data_file, mode, transform, download, backend)
+        rng = np.random.RandomState(2)
+        self.labels = rng.randint(0, 100, len(self.labels)).astype(np.int64)
+
+
+class ImageFolder(Dataset):
+    """Ref: vision/datasets/folder.py — reads image files under root by class dir."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.samples = []
+        if os.path.isdir(root):
+            for dirpath, _, files in os.walk(root):
+                for fn in sorted(files):
+                    if fn.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".npy")):
+                        self.samples.append(os.path.join(dirpath, fn))
+
+    def __getitem__(self, idx):
+        path = self.samples[idx]
+        if path.endswith(".npy"):
+            img = np.load(path)
+        else:
+            img = _read_image(path)
+        if self.transform:
+            img = self.transform(img)
+        return (img,)
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class DatasetFolder(Dataset):
+    def __init__(self, root, loader=None, extensions=None, transform=None, is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        self.classes = sorted(
+            d for d in os.listdir(root) if os.path.isdir(os.path.join(root, d))
+        ) if os.path.isdir(root) else []
+        self.class_to_idx = {c: i for i, c in enumerate(self.classes)}
+        self.samples = []
+        for c in self.classes:
+            cdir = os.path.join(root, c)
+            for fn in sorted(os.listdir(cdir)):
+                if fn.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".npy")):
+                    self.samples.append((os.path.join(cdir, fn), self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = np.load(path) if path.endswith(".npy") else _read_image(path)
+        if self.transform:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+def _read_image(path):
+    try:
+        from PIL import Image
+
+        return np.asarray(Image.open(path).convert("RGB"))
+    except ImportError as e:
+        raise RuntimeError("PIL unavailable; provide .npy images") from e
+
+
+class FakeImageNet(Dataset):
+    """Synthetic ImageNet-shaped dataset for throughput benchmarking (224x224x3)."""
+
+    def __init__(self, n=8192, num_classes=1000, image_size=224, transform=None, seed=0):
+        rng = np.random.RandomState(seed)
+        self.n = n
+        self.num_classes = num_classes
+        self.image_size = image_size
+        self._rng_state = seed
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.rand(3, self.image_size, self.image_size).astype(np.float32)
+        label = np.asarray(idx % self.num_classes, np.int64)
+        return img, label
+
+    def __len__(self):
+        return self.n

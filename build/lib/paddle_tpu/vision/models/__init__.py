@@ -1,0 +1,45 @@
+"""vision model zoo (ref: python/paddle/vision/models/__init__.py — all 13
+families the reference ships, plus ViT).  Modules import lazily to keep the
+top-level `import paddle_tpu` light."""
+from .lenet import LeNet  # noqa: F401
+
+_LAZY = {
+    "resnet": ("ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
+               "resnet152", "resnext50_32x4d", "resnext50_64x4d",
+               "resnext101_32x4d", "resnext101_64x4d", "resnext152_32x4d",
+               "resnext152_64x4d", "wide_resnet50_2", "wide_resnet101_2"),
+    "vgg": ("VGG", "vgg11", "vgg13", "vgg16", "vgg19"),
+    "alexnet": ("AlexNet", "alexnet"),
+    "mobilenetv1": ("MobileNetV1", "mobilenet_v1"),
+    "mobilenetv2": ("MobileNetV2", "mobilenet_v2"),
+    "mobilenetv3": ("MobileNetV3", "MobileNetV3Small", "MobileNetV3Large",
+                    "mobilenet_v3_small", "mobilenet_v3_large"),
+    "densenet": ("DenseNet", "densenet121", "densenet161", "densenet169",
+                 "densenet201", "densenet264"),
+    "googlenet": ("GoogLeNet", "googlenet"),
+    "inceptionv3": ("InceptionV3", "inception_v3"),
+    "shufflenetv2": ("ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+                     "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+                     "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+                     "shufflenet_v2_swish"),
+    "squeezenet": ("SqueezeNet", "squeezenet1_0", "squeezenet1_1"),
+    "vit": ("VisionTransformer", "vit_b_16", "vit_b_32", "vit_l_16"),
+}
+_NAME_TO_MODULE = {name: mod for mod, names in _LAZY.items() for name in names}
+
+__all__ = ["LeNet", *_NAME_TO_MODULE]
+
+
+def __getattr__(name):
+    mod = _NAME_TO_MODULE.get(name)
+    if mod is None:
+        raise AttributeError(name)
+    import importlib
+
+    module = importlib.import_module(f".{mod}", __name__)
+    # cache ALL of the module's exported names: importing `.alexnet` binds the
+    # submodule as a package attribute, which would otherwise shadow the
+    # same-named `alexnet` factory whichever exported name is accessed first
+    for n in _LAZY[mod]:
+        globals()[n] = getattr(module, n)
+    return globals()[name]

@@ -1,0 +1,62 @@
+"""MobileNetV1 (ref: python/paddle/vision/models/mobilenetv1.py:56)."""
+from __future__ import annotations
+
+from ... import nn
+
+
+def _conv_bn(in_c, out_c, kernel, stride=1, padding=0, groups=1):
+    return nn.Sequential(
+        nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                  groups=groups, bias_attr=False),
+        nn.BatchNorm2D(out_c),
+        nn.ReLU(),
+    )
+
+
+class DepthwiseSeparable(nn.Layer):
+    """Depthwise 3x3 + pointwise 1x1 (ref mobilenetv1.py:30)."""
+
+    def __init__(self, in_c, out_c1, out_c2, stride, scale):
+        super().__init__()
+        c1, c2 = int(out_c1 * scale), int(out_c2 * scale)
+        self.depthwise = _conv_bn(int(in_c * scale), c1, 3, stride=stride,
+                                  padding=1, groups=int(in_c * scale))
+        self.pointwise = _conv_bn(c1, c2, 1)
+
+    def forward(self, x):
+        return self.pointwise(self.depthwise(x))
+
+
+class MobileNetV1(nn.Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv1 = _conv_bn(3, int(32 * scale), 3, stride=2, padding=1)
+        # (in, c1, c2, stride) per block — the standard 13-block stack
+        cfg = [(32, 32, 64, 1), (64, 64, 128, 2), (128, 128, 128, 1),
+               (128, 128, 256, 2), (256, 256, 256, 1), (256, 256, 512, 2),
+               *[(512, 512, 512, 1)] * 5,
+               (512, 512, 1024, 2), (1024, 1024, 1024, 1)]
+        self.blocks = nn.Sequential(*[DepthwiseSeparable(i, a, b, s, scale)
+                                      for i, a, b, s in cfg])
+        if with_pool:
+            self.pool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV1(scale=scale, **kwargs)

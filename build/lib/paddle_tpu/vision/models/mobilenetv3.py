@@ -1,0 +1,136 @@
+"""MobileNetV3 (ref: python/paddle/vision/models/mobilenetv3.py:166; also the
+OCR det/rec backbone in BASELINE config #3)."""
+from __future__ import annotations
+
+from ... import nn
+from .mobilenetv2 import _make_divisible
+
+
+class SqueezeExcitation(nn.Layer):
+    """SE block with hardsigmoid gate (ref mobilenetv3.py:39)."""
+
+    def __init__(self, channels, squeeze_channels):
+        super().__init__()
+        self.avgpool = nn.AdaptiveAvgPool2D(1)
+        self.fc1 = nn.Conv2D(channels, squeeze_channels, 1)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Conv2D(squeeze_channels, channels, 1)
+        self.hardsigmoid = nn.Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hardsigmoid(self.fc2(self.relu(self.fc1(self.avgpool(x)))))
+        return x * s
+
+
+def _conv_bn_act(in_c, out_c, kernel, stride=1, groups=1, act="hardswish"):
+    pad = (kernel - 1) // 2
+    layers = [nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=pad,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act == "relu":
+        layers.append(nn.ReLU())
+    elif act == "hardswish":
+        layers.append(nn.Hardswish())
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    """ref mobilenetv3.py:110: expand -> dw -> (SE) -> project."""
+
+    def __init__(self, in_c, expand_c, out_c, kernel, stride, use_se, act):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_c != in_c:
+            layers.append(_conv_bn_act(in_c, expand_c, 1, act=act))
+        layers.append(_conv_bn_act(expand_c, expand_c, kernel, stride=stride,
+                                   groups=expand_c, act=act))
+        if use_se:
+            layers.append(SqueezeExcitation(expand_c,
+                                            _make_divisible(expand_c // 4)))
+        layers.append(_conv_bn_act(expand_c, out_c, 1, act=None))
+        self.block = nn.Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, expand, out, use_se, act, stride) — ref mobilenetv3.py:251,302 configs
+_SMALL = [
+    (3, 16, 16, True, "relu", 2), (3, 72, 24, False, "relu", 2),
+    (3, 88, 24, False, "relu", 1), (5, 96, 40, True, "hardswish", 2),
+    (5, 240, 40, True, "hardswish", 1), (5, 240, 40, True, "hardswish", 1),
+    (5, 120, 48, True, "hardswish", 1), (5, 144, 48, True, "hardswish", 1),
+    (5, 288, 96, True, "hardswish", 2), (5, 576, 96, True, "hardswish", 1),
+    (5, 576, 96, True, "hardswish", 1),
+]
+_LARGE = [
+    (3, 16, 16, False, "relu", 1), (3, 64, 24, False, "relu", 2),
+    (3, 72, 24, False, "relu", 1), (5, 72, 40, True, "relu", 2),
+    (5, 120, 40, True, "relu", 1), (5, 120, 40, True, "relu", 1),
+    (3, 240, 80, False, "hardswish", 2), (3, 200, 80, False, "hardswish", 1),
+    (3, 184, 80, False, "hardswish", 1), (3, 184, 80, False, "hardswish", 1),
+    (3, 480, 112, True, "hardswish", 1), (3, 672, 112, True, "hardswish", 1),
+    (5, 672, 160, True, "hardswish", 2), (5, 960, 160, True, "hardswish", 1),
+    (5, 960, 160, True, "hardswish", 1),
+]
+
+
+class MobileNetV3(nn.Layer):
+    def __init__(self, config, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        blocks = [_conv_bn_act(3, in_c, 3, stride=2, act="hardswish")]
+        for kernel, expand, out, use_se, act, stride in config:
+            exp_c = _make_divisible(expand * scale)
+            out_c = _make_divisible(out * scale)
+            blocks.append(InvertedResidual(in_c, exp_c, out_c, kernel, stride,
+                                           use_se, act))
+            in_c = out_c
+        last_conv = _make_divisible(6 * in_c)
+        blocks.append(_conv_bn_act(in_c, last_conv, 1, act="hardswish"))
+        self.features = nn.Sequential(*blocks)
+        self._feat_channels = last_conv
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = nn.Sequential(
+                nn.Linear(last_conv, last_channel), nn.Hardswish(),
+                nn.Dropout(0.2), nn.Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Small(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL, _make_divisible(1024 * scale), scale,
+                         num_classes, with_pool)
+
+
+class MobileNetV3Large(MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE, _make_divisible(1280 * scale), scale,
+                         num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV3Large(scale=scale, **kwargs)

@@ -1,0 +1,141 @@
+"""ShuffleNetV2 (ref: python/paddle/vision/models/shufflenetv2.py:191)."""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+from ... import nn
+
+
+def channel_shuffle(x, groups):
+    """Interleave channel groups (ref shufflenetv2.py:72) — a reshape/transpose
+    pair XLA fuses into the surrounding ops."""
+    n, c, h, w = x.shape
+    x = paddle.reshape(x, [n, groups, c // groups, h, w])
+    x = paddle.transpose(x, [0, 2, 1, 3, 4])
+    return paddle.reshape(x, [n, c, h, w])
+
+
+def _act(act):
+    return nn.Swish() if act == "swish" else nn.ReLU()
+
+
+def _conv_bn(in_c, out_c, kernel, stride=1, groups=1, act="relu"):
+    pad = (kernel - 1) // 2
+    layers = [nn.Conv2D(in_c, out_c, kernel, stride=stride, padding=pad,
+                        groups=groups, bias_attr=False),
+              nn.BatchNorm2D(out_c)]
+    if act:
+        layers.append(_act(act))
+    return nn.Sequential(*layers)
+
+
+class InvertedResidual(nn.Layer):
+    """Stride-1 unit: split channels, transform one half, concat+shuffle
+    (ref shufflenetv2.py:88)."""
+
+    def __init__(self, channels, act="relu"):
+        super().__init__()
+        c = channels // 2
+        self.branch = nn.Sequential(
+            _conv_bn(c, c, 1, act=act),
+            _conv_bn(c, c, 3, groups=c, act=None),     # depthwise
+            _conv_bn(c, c, 1, act=act))
+
+    def forward(self, x):
+        c = x.shape[1] // 2
+        x1, x2 = x[:, :c], x[:, c:]
+        out = paddle.concat([x1, self.branch(x2)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class InvertedResidualDS(nn.Layer):
+    """Downsampling unit: both branches strided, channels double
+    (ref shufflenetv2.py:131)."""
+
+    def __init__(self, in_c, out_c, act="relu"):
+        super().__init__()
+        c = out_c // 2
+        self.branch1 = nn.Sequential(
+            _conv_bn(in_c, in_c, 3, stride=2, groups=in_c, act=None),
+            _conv_bn(in_c, c, 1, act=act))
+        self.branch2 = nn.Sequential(
+            _conv_bn(in_c, c, 1, act=act),
+            _conv_bn(c, c, 3, stride=2, groups=c, act=None),
+            _conv_bn(c, c, 1, act=act))
+
+    def forward(self, x):
+        out = paddle.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(nn.Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        out_channels = {
+            0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+            0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+            1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+        }.get(scale)
+        if out_channels is None:
+            raise ValueError(f"unsupported ShuffleNetV2 scale {scale}")
+        self.conv1 = _conv_bn(3, out_channels[0], 3, stride=2, act=act)
+        self.pool1 = nn.MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = out_channels[0]
+        for stage_i, repeats in enumerate(stage_repeats):
+            out_c = out_channels[stage_i + 1]
+            units = [InvertedResidualDS(in_c, out_c, act)]
+            units += [InvertedResidual(out_c, act) for _ in range(repeats - 1)]
+            stages.append(nn.Sequential(*units))
+            in_c = out_c
+        self.stages = nn.Sequential(*stages)
+        self.conv_last = _conv_bn(in_c, out_channels[-1], 1, act=act)
+        if with_pool:
+            self.avgpool = nn.AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = nn.Linear(out_channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.pool1(self.conv1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def _shufflenet(scale, act, pretrained, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return ShuffleNetV2(scale=scale, act=act, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return _shufflenet(0.25, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return _shufflenet(0.33, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return _shufflenet(0.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return _shufflenet(1.5, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return _shufflenet(2.0, "relu", pretrained, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return _shufflenet(1.0, "swish", pretrained, **kwargs)

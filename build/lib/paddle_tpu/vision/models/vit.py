@@ -1,0 +1,96 @@
+"""Vision Transformer (BASELINE config #2 ViT-base; ref: PaddleClas ViT and the
+reference's nn.TransformerEncoder building blocks)."""
+from __future__ import annotations
+
+from ... import nn
+from ...tensor.tensor import Parameter
+from ...tensor import manipulation as M
+import jax.numpy as jnp
+
+
+class PatchEmbed(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, embed_dim=768):
+        super().__init__()
+        self.num_patches = (img_size // patch_size) ** 2
+        self.proj = nn.Conv2D(in_chans, embed_dim, patch_size, stride=patch_size)
+
+    def forward(self, x):
+        x = self.proj(x)  # [B, C, H/p, W/p]
+        B, C = x.shape[0], x.shape[1]
+        x = M.reshape(x, [B, C, -1])
+        return M.transpose(x, [0, 2, 1])  # [B, N, C]
+
+
+class MLP(nn.Layer):
+    def __init__(self, dim, hidden, drop=0.0):
+        super().__init__()
+        self.fc1 = nn.Linear(dim, hidden)
+        self.act = nn.GELU()
+        self.fc2 = nn.Linear(hidden, dim)
+        self.drop = nn.Dropout(drop)
+
+    def forward(self, x):
+        return self.drop(self.fc2(self.drop(self.act(self.fc1(x)))))
+
+
+class Block(nn.Layer):
+    def __init__(self, dim, num_heads, mlp_ratio=4.0, drop=0.0, attn_drop=0.0):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim, epsilon=1e-6)
+        self.attn = nn.MultiHeadAttention(dim, num_heads, dropout=attn_drop)
+        self.norm2 = nn.LayerNorm(dim, epsilon=1e-6)
+        self.mlp = MLP(dim, int(dim * mlp_ratio), drop)
+
+    def forward(self, x):
+        y = self.norm1(x)
+        x = x + self.attn(y, y, y)
+        x = x + self.mlp(self.norm2(x))
+        return x
+
+
+class VisionTransformer(nn.Layer):
+    def __init__(self, img_size=224, patch_size=16, in_chans=3, num_classes=1000,
+                 embed_dim=768, depth=12, num_heads=12, mlp_ratio=4.0, drop_rate=0.0,
+                 attn_drop_rate=0.0, **kwargs):
+        super().__init__()
+        self.num_classes = num_classes
+        self.patch_embed = PatchEmbed(img_size, patch_size, in_chans, embed_dim)
+        n = self.patch_embed.num_patches
+        self.cls_token = Parameter(jnp.zeros([1, 1, embed_dim], jnp.float32))
+        # drawn from the framework RNG so paddle.seed() reproduces construction
+        import jax as _jax
+        from ...framework import random as _random
+
+        self.pos_embed = Parameter(
+            _jax.random.normal(_random.get_rng_key(), (1, n + 1, embed_dim), jnp.float32) * 0.02
+        )
+        self.pos_drop = nn.Dropout(drop_rate)
+        self.blocks = nn.LayerList([
+            Block(embed_dim, num_heads, mlp_ratio, drop_rate, attn_drop_rate)
+            for _ in range(depth)
+        ])
+        self.norm = nn.LayerNorm(embed_dim, epsilon=1e-6)
+        self.head = nn.Linear(embed_dim, num_classes) if num_classes > 0 else nn.Identity()
+
+    def forward(self, x):
+        x = self.patch_embed(x)
+        B = x.shape[0]
+        cls = M.expand(self.cls_token, [B, 1, x.shape[2]])
+        x = M.concat([cls, x], axis=1)
+        x = self.pos_drop(x + self.pos_embed)
+        for blk in self.blocks:
+            x = blk(x)
+        x = self.norm(x)
+        return self.head(x[:, 0])
+
+
+def vit_b_16(**kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=768, depth=12, num_heads=12, **kwargs)
+
+
+def vit_b_32(**kwargs):
+    return VisionTransformer(patch_size=32, embed_dim=768, depth=12, num_heads=12, **kwargs)
+
+
+def vit_l_16(**kwargs):
+    return VisionTransformer(patch_size=16, embed_dim=1024, depth=24, num_heads=16, **kwargs)

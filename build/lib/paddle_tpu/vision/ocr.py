@@ -1,0 +1,292 @@
+"""OCR pipeline building blocks (BASELINE config #3: PP-OCRv4-style det+rec).
+
+The reference framework repo carries only the primitives (warpctc kernel,
+conv/lstm ops); the det/rec model shapes follow the public PP-OCR design:
+DB (Differentiable Binarization) text detection over a MobileNetV3 FPN, and a
+CRNN-style CTC recognizer.  TPU-specific: variable-size images go through a
+width-bucketing policy (SURVEY §7.3.4) so XLA compiles one program per bucket,
+not per image size.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from .. import nn
+from ..nn import functional as F
+from .models.mobilenetv3 import MobileNetV3Small, MobileNetV3Large
+
+
+# --------------------------------------------------------------- det backbone
+class MobileNetV3Backbone(nn.Layer):
+    """MobileNetV3 trunk returning the 4 deepest scale features
+    (strides 4/8/16/32 for a /32-stride net)."""
+
+    def __init__(self, scale=0.5, arch="small"):
+        super().__init__()
+        cls = MobileNetV3Small if arch == "small" else MobileNetV3Large
+        self.blocks = cls(scale=scale, num_classes=0, with_pool=False).features
+
+    def forward(self, x):
+        feats = []
+        out = x
+        for block in self.blocks:
+            new = block(out)
+            if new.shape[2] != out.shape[2]:
+                feats.append(out)     # finest map at the previous stride
+            out = new
+        feats.append(out)
+        return feats[-4:]             # strides 4, 8, 16, 32
+
+    def out_channels(self, in_hw=64):
+        import jax.numpy as jnp
+        from ..tensor.tensor import Tensor
+        import jax
+
+        # eval mode: train-mode BN would write traced running stats into the
+        # buffers during the shape-only trace (a tracer leak)
+        was_training = self.training
+        self.eval()
+        try:
+            dummy = jax.eval_shape(
+                lambda v: [f._value for f in self.forward(Tensor(v))],
+                jax.ShapeDtypeStruct((1, 3, in_hw, in_hw), jnp.float32))
+        finally:
+            if was_training:
+                self.train()
+        return [s.shape[1] for s in dummy]
+
+
+class DBFPN(nn.Layer):
+    """DB neck: lateral 1x1 + top-down adds, smooth to out_ch//4, concat at
+    stride 4 (the public DBNet neck shape)."""
+
+    def __init__(self, in_channels, out_channels=96):
+        super().__init__()
+        self.laterals = nn.LayerList([
+            nn.Conv2D(c, out_channels, 1, bias_attr=False) for c in in_channels])
+        self.smooths = nn.LayerList([
+            nn.Conv2D(out_channels, out_channels // 4, 3, padding=1,
+                      bias_attr=False) for _ in in_channels])
+        self.out_channels = out_channels
+
+    def forward(self, feats):
+        laterals = [lat(f) for lat, f in zip(self.laterals, feats)]
+        for i in range(len(laterals) - 1, 0, -1):
+            up = F.interpolate(laterals[i], scale_factor=2, mode="nearest")
+            laterals[i - 1] = laterals[i - 1] + up
+        outs = []
+        for i, (smooth, lat) in enumerate(zip(self.smooths, laterals)):
+            o = smooth(lat)
+            if i > 0:
+                o = F.interpolate(o, scale_factor=2 ** i, mode="nearest")
+            outs.append(o)
+        return paddle.concat(outs, axis=1)
+
+
+class DBHead(nn.Layer):
+    """DB head: probability map P, threshold map T, and the differentiable
+    binarization  B = sigmoid(k * (P - T))  with k=50."""
+
+    def __init__(self, in_channels, k=50):
+        super().__init__()
+        self.k = k
+
+        def branch():
+            c = in_channels
+            return nn.Sequential(
+                nn.Conv2D(c, c // 4, 3, padding=1, bias_attr=False),
+                nn.BatchNorm2D(c // 4), nn.ReLU(),
+                nn.Conv2DTranspose(c // 4, c // 4, 2, stride=2),
+                nn.BatchNorm2D(c // 4), nn.ReLU(),
+                nn.Conv2DTranspose(c // 4, 1, 2, stride=2),
+                nn.Sigmoid())
+
+        self.prob = branch()
+        self.thresh = branch()
+
+    def forward(self, x):
+        p = self.prob(x)
+        t = self.thresh(x)
+        b = F.sigmoid(self.k * (p - t))
+        return {"maps": paddle.concat([p, t, b], axis=1),
+                "prob": p, "thresh": t, "binary": b}
+
+
+class DBNet(nn.Layer):
+    """Backbone + FPN + DB head; maps come out at input/1 resolution
+    (stride-4 fuse upsampled x4 by the head's transpose convs)."""
+
+    def __init__(self, backbone_scale=0.5, arch="small", neck_channels=96):
+        super().__init__()
+        self.backbone = MobileNetV3Backbone(scale=backbone_scale, arch=arch)
+        self.neck = DBFPN(self.backbone.out_channels(), neck_channels)
+        self.head = DBHead(neck_channels)
+
+    def forward(self, x):
+        return self.head(self.neck(self.backbone(x)))
+
+
+def _dice_loss(pred, gt, mask, eps=1e-6):
+    inter = paddle.sum(pred * gt * mask)
+    union = paddle.sum(pred * pred * mask) + paddle.sum(gt * gt * mask) + eps
+    return 1.0 - 2.0 * inter / union
+
+
+def db_loss(pred, shrink_map, shrink_mask, thresh_map=None, thresh_mask=None,
+            alpha=5.0, beta=10.0, ohem_ratio=3.0):
+    """DB training loss: balanced BCE on P, masked L1 on T, dice on B.
+
+    Balancing is by pos/neg weighting (a traced-shape-friendly stand-in for the
+    reference-era OHEM top-k, which needs dynamic k)."""
+    p = pred["prob"][:, 0]
+    b = pred["binary"][:, 0]
+    pos = shrink_map * shrink_mask
+    neg = (1.0 - shrink_map) * shrink_mask
+    n_pos = paddle.sum(pos) + 1.0
+    n_neg = paddle.sum(neg) + 1.0
+    w = pos * (1.0 / n_pos) + neg * (1.0 / paddle.maximum(
+        n_neg / ohem_ratio, n_pos))
+    eps = 1e-6
+    bce = -(shrink_map * paddle.log(p + eps)
+            + (1.0 - shrink_map) * paddle.log(1.0 - p + eps))
+    loss_p = paddle.sum(bce * w) / paddle.sum(w)
+    loss_b = _dice_loss(b, shrink_map, shrink_mask)
+    loss = alpha * loss_p + loss_b
+    if thresh_map is not None:
+        tm = thresh_mask if thresh_mask is not None else paddle.ones_like(thresh_map)
+        l1 = paddle.sum(paddle.abs(pred["thresh"][:, 0] - thresh_map) * tm) / (
+            paddle.sum(tm) + eps)
+        loss = loss + beta * l1
+    return loss
+
+
+# ------------------------------------------------------------------ rec model
+class CRNN(nn.Layer):
+    """CTC recognizer: conv trunk squeezing H to 1, BiLSTM neck, linear head.
+
+    Input (N, 3, 32, W) -> logits (N, W/4, num_classes); feed transposed
+    [T, N, C] into F.ctc_loss (ref phi WarpctcKernel layout)."""
+
+    def __init__(self, num_classes, hidden_size=48, channels=(32, 64, 128, 128)):
+        super().__init__()
+        c0, c1, c2, c3 = channels
+
+        def cbr(i, o):
+            return nn.Sequential(nn.Conv2D(i, o, 3, padding=1, bias_attr=False),
+                                 nn.BatchNorm2D(o), nn.ReLU())
+
+        self.conv = nn.Sequential(
+            cbr(3, c0), nn.MaxPool2D(2, stride=2),            # H/2,  W/2
+            cbr(c0, c1), nn.MaxPool2D(2, stride=2),           # H/4,  W/4
+            cbr(c1, c2), nn.MaxPool2D((2, 1), stride=(2, 1)),  # H/8,  W/4
+            cbr(c2, c3), nn.MaxPool2D((2, 1), stride=(2, 1)),  # H/16, W/4
+            nn.Conv2D(c3, c3, (2, 1), bias_attr=False),       # H/32 -> 1
+            nn.BatchNorm2D(c3), nn.ReLU(),
+        )
+        self.rnn = nn.LSTM(c3, hidden_size, direction="bidirect")
+        self.fc = nn.Linear(2 * hidden_size, num_classes)
+
+    def forward(self, x):
+        f = self.conv(x)                       # (N, C, 1, T)
+        f = paddle.squeeze(f, axis=2)          # (N, C, T)
+        f = paddle.transpose(f, [0, 2, 1])     # (N, T, C)
+        out, _ = self.rnn(f)
+        return self.fc(out)                    # (N, T, num_classes)
+
+
+def crnn_ctc_loss(logits, labels, label_lengths, blank=0):
+    """Convenience: (N, T, C) logits -> mean CTC loss (all T frames valid)."""
+    n, t, _ = logits.shape
+    log_probs = F.log_softmax(paddle.transpose(logits, [1, 0, 2]), axis=-1)
+    input_lengths = paddle.to_tensor(np.full((n,), t, np.int64))
+    return F.ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=blank)
+
+
+def ctc_greedy_decode(logits, blank=0):
+    """Best-path decode: argmax per frame, collapse repeats, drop blanks.
+    Host-side (numpy) — decoding is post-processing, not a traced op."""
+    ids = np.asarray(paddle.argmax(logits, axis=-1)._value)
+    out = []
+    for seq in ids:
+        collapsed = []
+        prev = -1
+        for s in seq:
+            if s != prev and s != blank:
+                collapsed.append(int(s))
+            prev = s
+        out.append(collapsed)
+    return out
+
+
+# ------------------------------------------------------------------ bucketing
+DEFAULT_WIDTH_BUCKETS = (64, 96, 128, 192, 256, 320, 480, 640)
+
+
+def bucket_width(w, buckets=DEFAULT_WIDTH_BUCKETS):
+    """Smallest bucket >= w (clamped to the largest) — bounds the number of
+    distinct compiled shapes for variable-width OCR crops."""
+    for b in buckets:
+        if w <= b:
+            return b
+    return buckets[-1]
+
+
+def pad_to_width(img, width):
+    """Right-pad (N)CHW or CHW image(s) to `width` with zeros; wider images are
+    resized down to fit (aspect preserved by the caller's resize policy)."""
+    arr = np.asarray(img)
+    w = arr.shape[-1]
+    if w == width:
+        return arr
+    if w > width:
+        idx = np.linspace(0, w - 1, width).round().astype(int)
+        return arr[..., idx]
+    pad = [(0, 0)] * (arr.ndim - 1) + [(0, width - w)]
+    return np.pad(arr, pad)
+
+
+class WidthBucketBatchSampler:
+    """Groups sample indices by bucketed width so every batch pads to ONE
+    width (one XLA program per bucket, ref §7.3.4 dynamic-shape policy).
+
+    `widths` is a sequence (or callable idx->width) of raw image widths."""
+
+    def __init__(self, widths, batch_size, buckets=DEFAULT_WIDTH_BUCKETS,
+                 shuffle=True, seed=0, drop_last=False):
+        n = len(widths)
+        self.batch_size = batch_size
+        self.buckets = tuple(buckets)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self._by_bucket: dict[int, list[int]] = {}
+        for i in range(n):
+            w = widths(i) if callable(widths) else widths[i]
+            self._by_bucket.setdefault(bucket_width(w, self.buckets), []).append(i)
+        self._epoch = 0
+
+    def __iter__(self):
+        rng = np.random.RandomState(self.seed + self._epoch)
+        self._epoch += 1
+        batches = []
+        for bucket, idxs in sorted(self._by_bucket.items()):
+            idxs = list(idxs)
+            if self.shuffle:
+                rng.shuffle(idxs)
+            for i in range(0, len(idxs), self.batch_size):
+                chunk = idxs[i:i + self.batch_size]
+                if self.drop_last and len(chunk) < self.batch_size:
+                    continue
+                batches.append((bucket, chunk))
+        if self.shuffle:
+            rng.shuffle(batches)
+        for bucket, chunk in batches:
+            yield bucket, chunk
+
+    def __len__(self):
+        total = 0
+        for idxs in self._by_bucket.values():
+            q, r = divmod(len(idxs), self.batch_size)
+            total += q + (0 if (self.drop_last or r == 0) else 1)
+        return total

@@ -29,42 +29,111 @@ from ..meta_parallel.mp_layers import (  # noqa: F401 (fleet.meta_parallel re-ex
 
 
 class DistributedStrategy:
-    """Ref distributed_strategy.py:110 — the single knob surface."""
+    """Ref distributed_strategy.py:110 over distributed_strategy.proto's 28
+    messages — the single knob surface.
+
+    Every config dict validates its keys (a typo'd knob raises instead of
+    being silently dropped), and every *accepted* knob is either consumed by
+    the compiled train step (`fleet.distributed_train_step` /
+    `PipelineParallel`) or documented inert below:
+
+    consumed: amp{level, init_loss_scaling, incr_every_n_steps,
+              decr_every_n_nan_or_inf, incr_ratio, decr_ratio},
+              recompute{checkpoints}, sharding{stage/sharding_degree},
+              gradient_merge{k_steps, avg}, pipeline{accumulate_steps,
+              micro_batch_size}, hybrid_configs (mesh axes),
+              gradient_scale_configs{scale_strategy}, tensor_parallel degree.
+    inert on TPU (GPU/NCCL mechanics XLA owns; accepted for script parity):
+              fuse_all_reduce_ops, fuse_grad_size_in_MB, nccl_comm_num,
+              find_unused_parameters, heter_ccl_mode,
+              without_graph_optimization.
+    unsupported (raise when enabled): dgc, localsgd (gradient compression /
+              local-SGD rewrites contradict the single-program SPMD step).
+    """
+
+    _CONFIG_KEYS = {
+        "amp_configs": {"init_loss_scaling", "incr_every_n_steps",
+                        "decr_every_n_nan_or_inf", "incr_ratio", "decr_ratio",
+                        "use_dynamic_loss_scaling", "custom_white_list",
+                        "custom_black_list", "use_pure_fp16", "level",
+                        "use_fp16_guard", "dtype"},
+        "recompute_configs": {"checkpoints", "enable_offload",
+                              "checkpoint_shape"},
+        "sharding_configs": {"stage", "sharding_degree", "segment_broadcast_MB",
+                             "mp_degree", "dp_degree", "offload",
+                             "segment_anchors", "gradient_merge_acc_step",
+                             "optimize_offload"},
+        "pipeline_configs": {"accumulate_steps", "micro_batch_size",
+                             "schedule_mode", "enable_partial_send_recv"},
+        "tensor_parallel_configs": {"tensor_parallel_degree", "tensor_init_seed"},
+        "gradient_merge_configs": {"k_steps", "avg"},
+        "gradient_scale_configs": {"scale_strategy"},
+        "hybrid_configs": {"dp_degree", "mp_degree", "pp_degree",
+                           "sharding_degree", "sep_degree"},
+    }
 
     def __init__(self):
-        self.amp = False
-        self.amp_configs = {}
-        self.recompute = False
-        self.recompute_configs = {}
-        self.sharding = False
-        self.sharding_configs = {}
-        self.pipeline = False
-        self.pipeline_configs = {"accumulate_steps": 1, "micro_batch_size": 1}
-        self.tensor_parallel = False
-        self.tensor_parallel_configs = {}
-        self.hybrid_configs = {
-            "dp_degree": 1,
-            "mp_degree": 1,
-            "pp_degree": 1,
-            "sharding_degree": 1,
-            "sep_degree": 1,
+        self.__dict__["_cfg"] = {
+            "amp": False,
+            "amp_configs": {},
+            "recompute": False,
+            "recompute_configs": {},
+            "sharding": False,
+            "sharding_configs": {},
+            "pipeline": False,
+            "pipeline_configs": {"accumulate_steps": 1, "micro_batch_size": 1},
+            "tensor_parallel": False,
+            "tensor_parallel_configs": {},
+            "hybrid_configs": {"dp_degree": 1, "mp_degree": 1, "pp_degree": 1,
+                               "sharding_degree": 1, "sep_degree": 1},
+            "gradient_merge": False,
+            "gradient_merge_configs": {"k_steps": 1, "avg": True},
+            "lamb": False,
+            "lars": False,
+            "dgc": False,
+            "localsgd": False,
+            "gradient_scale_configs": {"scale_strategy": "avg"},
+            "find_unused_parameters": False,
+            "fuse_all_reduce_ops": True,
+            "fuse_grad_size_in_MB": 32,
+            "nccl_comm_num": 1,
+            "heter_ccl_mode": False,
+            "without_graph_optimization": False,
         }
-        self.gradient_merge = False
-        self.gradient_merge_configs = {}
-        self.lamb = False
-        self.lars = False
-        self.dgc = False
-        self.localsgd = False
-        self.gradient_scale_configs = {"scale_strategy": "avg"}
-        self.find_unused_parameters = False
-        self.fuse_all_reduce_ops = True
-        self.fuse_grad_size_in_MB = 32
-        self.nccl_comm_num = 1
-        self.heter_ccl_mode = False
-        self.without_graph_optimization = False
+
+    def __getattr__(self, name):
+        cfg = self.__dict__.get("_cfg", {})
+        if name in cfg:
+            return cfg[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        cfg = self.__dict__["_cfg"]
+        if name not in cfg:
+            raise AttributeError(
+                f"DistributedStrategy has no knob {name!r} "
+                f"(known: {sorted(cfg)})")
+        if name in ("dgc", "localsgd") and value:
+            raise NotImplementedError(
+                f"DistributedStrategy.{name}: gradient compression / local-SGD "
+                f"program rewrites are not supported on the TPU build — the "
+                f"SPMD partitioner owns gradient communication")
+        allowed = self._CONFIG_KEYS.get(name)
+        if allowed is not None:
+            unknown = set(value) - allowed
+            if unknown:
+                raise ValueError(
+                    f"unknown key(s) {sorted(unknown)} in "
+                    f"DistributedStrategy.{name}; allowed: {sorted(allowed)}")
+            merged = dict(cfg[name])
+            merged.update(value)
+            value = merged
+        cfg[name] = value
 
     def __repr__(self):
-        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+        on = [k for k, v in self._cfg.items()
+              if isinstance(v, bool) and v]
+        return f"DistributedStrategy(hybrid={self.hybrid_configs}, enabled={on})"
 
 
 class _Fleet:
@@ -137,6 +206,69 @@ class _Fleet:
         from .hybrid_optimizer import HybridParallelOptimizer
 
         return HybridParallelOptimizer(optimizer, self._hcg, self._strategy)
+
+    def distributed_train_step(self, model, loss_fn, optimizer):
+        """TPU-native entry: ONE compiled step consuming every enabled
+        strategy knob (the reference spread these across meta-optimizers that
+        each rewrote the Program; here they are parameters of the jitted step).
+
+        amp -> in-graph GradScaler; gradient_merge -> accum_steps;
+        sharding -> ZeRO stage; recompute -> jax.checkpoint on the listed
+        layers; hybrid_configs -> the mesh ShardedTrainStep runs on.
+        """
+        if not self._is_initialized:
+            raise RuntimeError("call fleet.init(strategy=...) first")
+        s = self._strategy
+        inner_opt = getattr(optimizer, "_inner_opt", optimizer)
+
+        if s.recompute:
+            from .utils.recompute import apply_recompute
+
+            model = apply_recompute(model, s.recompute_configs.get("checkpoints"))
+
+        scaler = None
+        if s.amp:
+            from ...amp import GradScaler
+
+            c = s.amp_configs
+            scaler = GradScaler(
+                init_loss_scaling=c.get("init_loss_scaling", 2.0 ** 15),
+                incr_every_n_steps=c.get("incr_every_n_steps", 1000),
+                decr_every_n_nan_or_inf=c.get("decr_every_n_nan_or_inf", 2),
+                incr_ratio=c.get("incr_ratio", 2.0),
+                decr_ratio=c.get("decr_ratio", 0.5),
+                use_dynamic_loss_scaling=c.get("use_dynamic_loss_scaling", True))
+
+        accum = 1
+        if s.gradient_merge:
+            accum = int(s.gradient_merge_configs.get("k_steps", 1))
+        elif s.pipeline and self._hcg.get_pipe_parallel_world_size() <= 1:
+            accum = int(s.pipeline_configs.get("accumulate_steps", 1))
+
+        zero_stage = 0
+        if s.sharding:
+            zero_stage = int(s.sharding_configs.get("stage", 2))
+
+        if self._hcg.get_pipe_parallel_world_size() > 1:
+            if scaler is not None or (s.gradient_merge and accum > 1):
+                # don't silently drop enabled knobs: the compiled pipeline has
+                # its own microbatching and no loss-scaling hook yet
+                raise NotImplementedError(
+                    "amp / gradient_merge are not supported together with "
+                    "pipeline parallelism yet — pipeline microbatching "
+                    "(pipeline_configs.accumulate_steps) already accumulates, "
+                    "and bf16 needs no loss scaling on TPU")
+            from ..meta_parallel.pipeline_schedule import PipelineTrainStep
+
+            return PipelineTrainStep(
+                model, loss_fn, inner_opt, self._hcg.mesh,
+                n_microbatch=int(s.pipeline_configs.get("accumulate_steps", 1)))
+
+        from ..sharded_train_step import ShardedTrainStep
+
+        return ShardedTrainStep(model, loss_fn, inner_opt, self._hcg.mesh,
+                                zero_stage=zero_stage, accum_steps=accum,
+                                scaler=scaler)
 
     # PS-mode stubs (SURVEY.md §7.4: parameter-server stack is an explicit non-goal)
     def is_server(self):

@@ -145,3 +145,36 @@ def recompute_sequential(ctx, functions, *args, **kwargs):
         if not isinstance(out, tuple):
             out = (out,)
     return out[0] if len(out) == 1 else out
+
+
+def apply_recompute(model, checkpoints=None):
+    """Wrap sublayers of `model` so their forward runs under activation
+    recompute (ref meta_optimizers/recompute_optimizer.py: the static twin
+    rewrote the Program; here we wrap Layer.forward with `recompute`).
+
+    `checkpoints`: sublayer names from named_sublayers() to wrap; None wraps
+    every direct child that owns parameters.  Returns `model` (mutated).
+    """
+    named = dict(model.named_sublayers())
+    if checkpoints:
+        targets = []
+        for name in checkpoints:
+            if name not in named:
+                raise ValueError(
+                    f"recompute checkpoint {name!r} is not a sublayer of "
+                    f"{type(model).__name__}; known: {sorted(named)[:20]}...")
+            targets.append(named[name])
+    else:
+        targets = [l for _, l in model.named_children()
+                   if any(True for _ in l.parameters())]
+    for layer in targets:
+        if getattr(layer, "_recompute_wrapped", False):
+            continue
+        inner_forward = layer.forward
+
+        def wrapped(*args, _f=inner_forward, **kwargs):
+            return recompute(_f, *args, **kwargs)
+
+        layer.forward = wrapped
+        layer._recompute_wrapped = True
+    return model

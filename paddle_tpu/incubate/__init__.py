@@ -1,8 +1,21 @@
 """paddle.incubate parity surface (ref: python/paddle/incubate/)."""
 from . import autograd  # noqa: F401
 from . import moe  # noqa: F401
+from . import checkpoint  # noqa: F401
+from . import asp  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from ..autograd.tape import no_grad  # noqa: F401
+
+
+def __getattr__(name):
+    # sparse pulls jax.experimental.sparse (~2s import); load it lazily
+    if name == "sparse":
+        import importlib
+
+        mod = importlib.import_module(".sparse", __name__)
+        globals()["sparse"] = mod
+        return mod
+    raise AttributeError(name)
 
 
 class nn:  # incubate.nn fused layers namespace (fused == XLA-fused on TPU)

@@ -1,0 +1,123 @@
+"""ASP — automatic structured (n:m) sparsity (ref:
+python/paddle/fluid/contrib/sparsity/asp.py:39,125,214,300 + utils.py mask
+algorithms).
+
+Workflow parity with the reference: `prune_model` computes n:m masks for
+supported weights and zeroes them; `decorate(optimizer)` wraps the optimizer
+so masks are re-applied after every step (pruned weights stay zero through
+training).  On TPU the masked weights still run on the dense MXU — the win is
+model-size/regularization parity, and the masks are the artifact a
+sparsity-aware deployment consumes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["set_excluded_layers", "reset_excluded_layers", "decorate",
+           "prune_model", "calculate_density", "check_sparsity"]
+
+_EXCLUDED: set[str] = set()
+# the mask lives ON the Parameter (attribute _asp_mask): id()-keyed registries
+# can apply a dead parameter's mask to a new object reusing its address, and
+# Tensor.__eq__ is elementwise so Tensors cannot key dicts
+
+
+def set_excluded_layers(param_names, main_program=None):
+    """Ref asp.py:39 — names (prefix match) whose weights are never pruned."""
+    _EXCLUDED.update(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    """Ref asp.py:125."""
+    _EXCLUDED.clear()
+
+
+def _nm_mask_1d(w, n, m):
+    """Keep the n largest-|w| entries in every group of m along the REDUCTION
+    axis (ref sparsity/utils.py get_mask_1d; the reference transposes FC
+    weights first — hardware 2:4 sparsity is along the contraction dim).
+    Paddle Linear weights are [in, out], so groups run along axis 0."""
+    wt = w.T                                     # [out, in]
+    flat = wt.reshape(-1, m)
+    order = np.argsort(np.abs(flat), axis=1)     # ascending
+    mask = np.ones_like(flat, dtype=bool)
+    drop = order[:, : m - n]
+    rows = np.arange(flat.shape[0])[:, None]
+    mask[rows, drop] = False
+    return mask.reshape(wt.shape).T
+
+
+def _prunable(name, p):
+    if any(name.startswith(e) or e in name for e in _EXCLUDED):
+        return False
+    shape = tuple(p.shape)
+    # 2-D weights with input (reduction) dim divisible by m; biases/norms excluded
+    return len(shape) == 2 and "weight" in name.rsplit(".", 1)[-1]
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Ref asp.py:300 — compute masks, zero the pruned weights, return masks."""
+    if mask_algo not in ("mask_1d", "mask_2d_greedy", "mask_2d_best"):
+        raise ValueError(f"unknown mask_algo {mask_algo!r}")
+    masks = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        w = np.asarray(p._value)
+        if w.shape[0] % m:       # reduction dim of [in, out] Linear weights
+            continue
+        mask = _nm_mask_1d(w, n, m)
+        p._rebind(jnp.asarray(w * mask, dtype=p._value.dtype))
+        p._asp_mask = jnp.asarray(mask, p._value.dtype)
+        masks[name] = mask
+    return masks
+
+
+def calculate_density(x):
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float(np.count_nonzero(arr)) / max(arr.size, 1)
+
+
+def check_sparsity(x, n=2, m=4):
+    """True iff every m-group along the reduction axis (axis 0 of a 2-D
+    [in, out] weight) has <= n nonzeros."""
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    if arr.ndim == 2:
+        arr = arr.T
+    groups = arr.reshape(-1, m)
+    return bool((np.count_nonzero(groups, axis=1) <= n).all())
+
+
+class ASPOptimizerWrapper:
+    """Ref asp.py:214 OptimizerWithSparsityGuarantee: after every step,
+    re-apply the masks so pruned weights stay exactly zero."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, item):
+        return getattr(self._inner, item)
+
+    def _apply_masks(self):
+        for p in self._inner._params():
+            mask = getattr(p, "_asp_mask", None)
+            if mask is not None:
+                p._rebind(p._value * mask)
+
+    def step(self):
+        self._inner.step()
+        self._apply_masks()
+
+    def minimize(self, loss, *args, **kwargs):
+        out = self._inner.minimize(loss, *args, **kwargs)
+        self._apply_masks()
+        return out
+
+
+def decorate(optimizer):
+    """Ref asp.py:214."""
+    return ASPOptimizerWrapper(optimizer)

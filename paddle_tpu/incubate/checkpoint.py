@@ -1,0 +1,75 @@
+"""Auto-checkpoint epoch ranges (ref:
+fluid/incubate/checkpoint/auto_checkpoint.py:267,597 TrainEpochRange — an
+epoch-range context that periodically snapshots training state keyed for job
+restart; the reference wrote program+dataset position to HDFS).
+
+TPU-native: state snapshots go through distributed.checkpoint (sharded save,
+reshard-on-load), keyed by epoch.  On restart the range resumes from the last
+saved epoch — the elastic manager's scale events use the same mechanism.
+"""
+from __future__ import annotations
+
+import os
+
+from ..distributed import checkpoint as _ckpt
+
+__all__ = ["TrainEpochRange", "train_epoch_range"]
+
+
+class TrainEpochRange:
+    """for epoch in TrainEpochRange(30, path, model=m, optimizer=o): ...
+
+    Resumes at `latest_saved_epoch + 1` when `path` holds a checkpoint, and
+    saves model/optimizer (or train_step) state every `save_checkpoint_inter`
+    epochs plus once at the end.
+    """
+
+    def __init__(self, max_epoch_num, path=None, name=None,
+                 save_checkpoint_inter=1, model=None, optimizer=None,
+                 train_step=None, keep=3):
+        self.max_epoch_num = int(max_epoch_num)
+        self.path = path or os.environ.get("PADDLE_TPU_CHECKPOINT_PATH") \
+            or os.path.join(".", "auto_checkpoint", name or "default")
+        self.inter = max(1, int(save_checkpoint_inter))
+        # a train_step knows its model; either is enough to snapshot state
+        self.model = model if model is not None else getattr(train_step, "model", None)
+        self.optimizer = optimizer
+        self.train_step = train_step
+        self.manager = _ckpt.CheckpointManager(self.path, keep=keep)
+        self._start = 0
+        latest = self.manager.latest_step()
+        if latest is not None and self.model is not None:
+            meta = _ckpt.load_train_state(self.path, self.model,
+                                          optimizer=self.optimizer,
+                                          train_step=self.train_step)
+            self._start = int(meta.get("step", latest) or latest) + 1
+
+    @property
+    def restored_epoch(self):
+        """Last completed (saved) epoch, or -1 on a fresh start."""
+        return self._start - 1
+
+    def _save(self, epoch):
+        if self.model is None:
+            return
+        import jax
+
+        _ckpt.save_train_state(self.path, self.model, optimizer=self.optimizer,
+                               train_step=self.train_step, step=epoch)
+        if jax.process_index() == 0:   # retention is proc-0's job (see
+            self.manager._gc()         # CheckpointManager.save)
+
+    def __iter__(self):
+        epoch = self._start
+        for epoch in range(self._start, self.max_epoch_num):
+            yield epoch
+            if (epoch + 1) % self.inter == 0:
+                self._save(epoch)
+        if self._start < self.max_epoch_num and (epoch + 1) % self.inter != 0:
+            self._save(epoch)
+
+
+def train_epoch_range(max_epoch_num, save_checkpoint_inter=1, **kwargs):
+    """Ref auto_checkpoint.py train_epoch_range generator."""
+    return TrainEpochRange(max_epoch_num,
+                           save_checkpoint_inter=save_checkpoint_inter, **kwargs)

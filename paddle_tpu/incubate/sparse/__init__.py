@@ -1,0 +1,280 @@
+"""paddle.incubate.sparse (ref: python/paddle/incubate/sparse/ — creation,
+unary, binary; phi sparse COO/CSR tensors paddle/phi/core/sparse_coo_tensor.h,
+sparse_csr_tensor.h).
+
+TPU-native: sparse storage rides jax.experimental.sparse.BCOO — XLA lowers
+sparse contractions to gather/scatter + dense MXU tiles, which is the honest
+execution model on TPU (there is no sparse tensor core).  SparseCooTensor /
+SparseCsrTensor wrap BCOO with the reference's method surface
+(indices/values/crows/cols, to_dense, coalesce); ops below mirror the
+reference's unary/binary files.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ...tensor.tensor import Tensor
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+    "SparseCsrTensor", "is_sparse_coo", "is_sparse_csr",
+    # unary
+    "sin", "tan", "asin", "atan", "sinh", "asinh", "atanh", "tanh", "square",
+    "sqrt", "log1p", "abs", "neg", "pow", "cast", "coalesce", "relu",
+    # binary
+    "add", "subtract", "multiply", "divide", "matmul", "masked_matmul", "mv",
+]
+
+
+def _raw(x):
+    if isinstance(x, Tensor):
+        return x._value
+    return jnp.asarray(x)
+
+
+class SparseCooTensor:
+    """COO sparse tensor (ref sparse_coo_tensor.h): [sparse_dim, nnz] indices
+    + [nnz, ...] values."""
+
+    def __init__(self, bcoo):
+        self._bcoo = bcoo
+
+    # --- reference-shaped accessors
+    def indices(self):
+        return Tensor(jnp.swapaxes(self._bcoo.indices, 0, 1))  # [ndim, nnz]
+
+    def values(self):
+        return Tensor(self._bcoo.data)
+
+    def to_dense(self):
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_csr(self):
+        if self._bcoo.ndim != 2:
+            raise ValueError("to_sparse_csr needs a 2-D tensor")
+        return SparseCsrTensor(jsparse.BCSR.from_bcoo(self._bcoo.sum_duplicates()))
+
+    def nnz(self):
+        return int(self._bcoo.nse)
+
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return self._bcoo.dtype
+
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+class SparseCsrTensor:
+    """CSR sparse tensor (ref sparse_csr_tensor.h): crows/cols/values."""
+
+    def __init__(self, bcsr):
+        self._bcsr = bcsr
+
+    def crows(self):
+        return Tensor(self._bcsr.indptr)
+
+    def cols(self):
+        return Tensor(self._bcsr.indices)
+
+    def values(self):
+        return Tensor(self._bcsr.data)
+
+    def to_dense(self):
+        return Tensor(self._bcsr.todense())
+
+    def to_sparse_coo(self, sparse_dim=2):
+        return SparseCooTensor(self._bcsr.to_bcoo())
+
+    def nnz(self):
+        return int(self._bcsr.nse)
+
+    @property
+    def shape(self):
+        return list(self._bcsr.shape)
+
+    @property
+    def dtype(self):
+        return self._bcsr.dtype
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+
+def is_sparse_coo(x):
+    return isinstance(x, SparseCooTensor)
+
+
+def is_sparse_csr(x):
+    return isinstance(x, SparseCsrTensor)
+
+
+# ------------------------------------------------------------------ creation
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    """Ref creation.py:68.  indices: [sparse_dim, nnz]; values: [nnz, ...]."""
+    idx = np.asarray(_raw(indices))
+    vals = _raw(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in np.max(idx, axis=1)) + vals.shape[1:]
+    bcoo = jsparse.BCOO((vals, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseCooTensor(bcoo)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    """Ref creation.py:175."""
+    vals = _raw(values)
+    if dtype is not None:
+        vals = vals.astype(dtype)
+    bcsr = jsparse.BCSR((vals, jnp.asarray(_raw(cols), jnp.int32),
+                         jnp.asarray(_raw(crows), jnp.int32)),
+                        shape=tuple(shape))
+    return SparseCsrTensor(bcsr)
+
+
+# --------------------------------------------------------------------- unary
+def _unary(fn):
+    def op(x, name=None):
+        if isinstance(x, SparseCooTensor):
+            b = x._bcoo
+            return SparseCooTensor(jsparse.BCOO((fn(b.data), b.indices),
+                                                shape=b.shape))
+        if isinstance(x, SparseCsrTensor):
+            b = x._bcsr
+            return SparseCsrTensor(jsparse.BCSR((fn(b.data), b.indices, b.indptr),
+                                                shape=b.shape))
+        raise TypeError(f"expected a sparse tensor, got {type(x).__name__}")
+
+    return op
+
+
+sin = _unary(jnp.sin)
+tan = _unary(jnp.tan)
+asin = _unary(jnp.arcsin)
+atan = _unary(jnp.arctan)
+sinh = _unary(jnp.sinh)
+asinh = _unary(jnp.arcsinh)
+atanh = _unary(jnp.arctanh)
+tanh = _unary(jnp.tanh)
+square = _unary(jnp.square)
+sqrt = _unary(jnp.sqrt)
+log1p = _unary(jnp.log1p)
+abs = _unary(jnp.abs)
+neg = _unary(jnp.negative)
+relu = _unary(lambda v: jnp.maximum(v, 0))
+
+
+def pow(x, factor, name=None):
+    return _unary(lambda v: jnp.power(v, factor))(x)
+
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    def f(v):
+        return v.astype(value_dtype) if value_dtype else v
+
+    out = _unary(f)(x)
+    return out
+
+
+def coalesce(x):
+    """Ref unary.py:478: merge duplicate coordinates."""
+    if not isinstance(x, SparseCooTensor):
+        raise TypeError("coalesce expects a SparseCooTensor")
+    return SparseCooTensor(x._bcoo.sum_duplicates())
+
+
+# -------------------------------------------------------------------- binary
+def _b(x):
+    if isinstance(x, SparseCooTensor):
+        return x._bcoo
+    if isinstance(x, SparseCsrTensor):
+        return x._bcsr
+    return _raw(x)
+
+
+def add(x, y, name=None):
+    bx, by = _b(x), _b(y)
+    if isinstance(x, SparseCooTensor) and isinstance(y, SparseCooTensor):
+        out = jsparse.BCOO((jnp.concatenate([bx.data, by.data]),
+                            jnp.concatenate([bx.indices, by.indices])),
+                           shape=bx.shape).sum_duplicates()
+        return SparseCooTensor(out)
+    return Tensor(_dense(bx) + _dense(by))
+
+
+def subtract(x, y, name=None):
+    return add(x, neg(y) if isinstance(y, (SparseCooTensor, SparseCsrTensor))
+               else Tensor(-_raw(y)))
+
+
+def multiply(x, y, name=None):
+    """Elementwise product; sparse x dense keeps sparsity."""
+    if isinstance(x, SparseCooTensor) and not isinstance(y, (SparseCooTensor, SparseCsrTensor)):
+        b = x._bcoo
+        yv = _raw(y)
+        gathered = yv[tuple(b.indices[:, i] for i in range(b.indices.shape[1]))]
+        return SparseCooTensor(jsparse.BCOO((b.data * gathered, b.indices),
+                                            shape=b.shape))
+    return Tensor(_dense(_b(x)) * _dense(_b(y)))
+
+
+def divide(x, y, name=None):
+    return Tensor(_dense(_b(x)) / _dense(_b(y)))
+
+
+def _dense(b):
+    return b.todense() if hasattr(b, "todense") else b
+
+
+def matmul(x, y, name=None):
+    """Ref binary.py:31: sparse @ dense (and sparse @ sparse -> dense)."""
+    bx, by = _b(x), _b(y)
+    if hasattr(bx, "todense") and not hasattr(by, "todense"):
+        if isinstance(x, SparseCsrTensor):
+            bx = x._bcsr.to_bcoo()
+        out = bx @ by          # BCOO dot_general: gather + dense MXU tiles
+        return Tensor(out)
+    return Tensor(_dense(bx) @ _dense(by))
+
+
+def mv(x, vec, name=None):
+    """Ref binary.py:161: sparse matrix @ dense vector."""
+    return matmul(x, vec)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """Ref binary.py:101: dense @ dense, sampled at `mask`'s sparsity (SDDMM)."""
+    if not isinstance(mask, (SparseCooTensor, SparseCsrTensor)):
+        raise TypeError("mask must be sparse")
+    bm = mask._bcoo if isinstance(mask, SparseCooTensor) else mask._bcsr.to_bcoo()
+    xv, yv = _raw(x), _raw(y)
+    rows = bm.indices[:, 0]
+    cols = bm.indices[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], jnp.swapaxes(yv, 0, 1)[cols, :])
+    out = jsparse.BCOO((vals.astype(xv.dtype), bm.indices), shape=bm.shape)
+    return SparseCooTensor(out)

@@ -66,7 +66,11 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
 
             blocks_ok = supports_seq(seq) and supports_seq(seq_k)
             causal_ok = not is_causal or seq <= seq_k
-            use_flash = (backend == "flash" and no_drop and causal_ok) or (
+            # blocks_ok gates BOTH paths: an explicit backend='flash' request
+            # with an untileable length falls back to dense instead of raising
+            # deep inside _auto_block
+            use_flash = (backend == "flash" and no_drop and causal_ok
+                         and blocks_ok) or (
                 on_tpu and seq >= 1024 and blocks_ok and causal_ok
                 and hd in (64, 128, 256) and attn_mask is None and no_drop
             )

@@ -267,7 +267,8 @@ class Parameter(Tensor):
     stop_gradient defaults False; `trainable` toggles it.
     """
 
-    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed")
+    __slots__ = ("optimize_attr", "regularizer", "need_clip", "is_distributed",
+                 "_asp_mask")
 
     def __init__(self, value, stop_gradient: bool | None = None, name: str | None = None, trainable=None):
         if trainable is not None:

@@ -1,0 +1,36 @@
+"""Build script (ref: the reference's CMake superbuild, CMakeLists.txt:49-257).
+
+The TPU build's native surface is one host-side C++ library (TCPStore server,
+DataLoader ring, trace collector, host staging pool — see
+paddle_tpu/core/native/native.cc); the device side is XLA/PJRT, so there is no
+vendor-kernel build matrix.  `build_ext` compiles the library into the package
+at install time; at import time the package falls back to an mtime-cached g++
+build (dev checkouts) or pure-Python implementations (no toolchain).
+"""
+import subprocess
+
+from setuptools import setup
+from setuptools.command.build_py import build_py
+
+
+class BuildNative(build_py):
+    def run(self):
+        super().run()
+        try:
+            import sys, os
+
+            sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+            from paddle_tpu.core.native import build as build_native
+
+            lib = build_native(verbose=True)
+            # copy the built lib into the staged package
+            rel = os.path.relpath(lib, os.path.dirname(os.path.abspath(__file__)))
+            dst = os.path.join(self.build_lib, rel)
+            os.makedirs(os.path.dirname(dst), exist_ok=True)
+            self.copy_file(lib, dst)
+        except (ImportError, subprocess.CalledProcessError, OSError) as e:
+            print(f"[setup.py] native library build skipped ({e}); "
+                  f"pure-Python fallbacks will be used")
+
+
+setup(cmdclass={"build_py": BuildNative})

@@ -1,0 +1,62 @@
+"""ASP n:m structured sparsity (ref fluid/contrib/sparsity/asp.py)."""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.incubate import asp
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def test_prune_model_2_4():
+    asp.reset_excluded_layers()
+    paddle.seed(0)
+    m = Net()
+    masks = asp.prune_model(m, n=2, m=4)
+    assert set(masks) == {"fc1.weight", "fc2.weight"}
+    for name, p in m.named_parameters():
+        if name in masks:
+            assert asp.check_sparsity(p, 2, 4), name
+            assert abs(asp.calculate_density(p) - 0.5) < 0.05
+
+
+def test_excluded_layers():
+    asp.reset_excluded_layers()
+    asp.set_excluded_layers(["fc2"])
+    paddle.seed(0)
+    m = Net()
+    masks = asp.prune_model(m)
+    assert "fc1.weight" in masks and "fc2.weight" not in masks
+    asp.reset_excluded_layers()
+
+
+def test_decorated_optimizer_keeps_sparsity():
+    asp.reset_excluded_layers()
+    paddle.seed(1)
+    m = Net()
+    opt = asp.decorate(paddle.optimizer.Adam(learning_rate=0.05,
+                                             parameters=m.parameters()))
+    asp.prune_model(m)
+    rng = np.random.default_rng(0)
+    x = paddle.to_tensor(rng.standard_normal((8, 16)).astype(np.float32))
+    y = paddle.to_tensor(rng.standard_normal((8, 4)).astype(np.float32))
+    losses = []
+    for _ in range(6):
+        loss = paddle.nn.functional.mse_loss(m(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+    # sparsity survived training
+    for name, p in m.named_parameters():
+        if name.endswith("weight"):
+            assert asp.check_sparsity(p, 2, 4), name

