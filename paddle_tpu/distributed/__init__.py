@@ -26,6 +26,7 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from .store import Store, TCPStore  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import fleet_executor  # noqa: F401
 from . import launch  # noqa: F401
 
 

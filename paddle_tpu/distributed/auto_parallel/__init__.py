@@ -8,3 +8,8 @@ annotate with ProcessMesh + shard_tensor, and the Engine compiles one SPMD progr
 from .process_mesh import ProcessMesh, get_current_process_mesh  # noqa: F401
 from .interface import shard_tensor, shard_op, reshard  # noqa: F401
 from .engine import Engine  # noqa: F401
+
+from . import cost_model  # noqa: F401
+from . import planner  # noqa: F401
+from .planner import Planner, plan, model_spec_from_layer  # noqa: F401
+from .cost_model import ClusterSpec, ModelSpec, ParallelConfig  # noqa: F401

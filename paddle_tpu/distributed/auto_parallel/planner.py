@@ -1,0 +1,102 @@
+"""Parallel-plan search (ref: distributed/auto_parallel/planner.py + tuner/ —
+the reference searches dist-attr assignments over profiled costs; here the
+search space is the (dp, mp, pp, sharding, microbatches) factorization of the
+device count, ranked by the cost_model roofline and filtered by HBM).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from .cost_model import ClusterSpec, CostEstimate, ModelSpec, ParallelConfig, estimate
+
+__all__ = ["Planner", "plan", "model_spec_from_layer"]
+
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class Planner:
+    """Enumerate feasible configs, rank by estimated step time
+    (ref planner.py Planner.plan)."""
+
+    def __init__(self, model: ModelSpec, cluster: ClusterSpec | None = None,
+                 max_mp=8, max_pp=None, microbatch_options=(1, 2, 4, 8, 16, 32, 64)):
+        self.model = model
+        self.cluster = cluster or ClusterSpec()
+        self.max_mp = max_mp
+        self.max_pp = max_pp or model.n_layers
+        self.microbatch_options = microbatch_options
+
+    def candidates(self, n_devices: int):
+        out = []
+        for mp in _divisors(n_devices):
+            if mp > self.max_mp or self.model.hidden % mp:
+                continue
+            for pp in _divisors(n_devices // mp):
+                if pp > self.max_pp or self.model.n_layers % pp:
+                    continue
+                rest = n_devices // (mp * pp)
+                for sharding in _divisors(rest):
+                    dp = rest // sharding
+                    stages = (2, 3) if sharding > 1 else (0,)
+                    for m in self.microbatch_options:
+                        if self.model.global_batch % (dp * sharding * m):
+                            continue
+                        if pp == 1 and m > 1:
+                            continue  # microbatching only matters under pp here
+                        for stage in stages:
+                            out.append(ParallelConfig(dp=dp, mp=mp, pp=pp,
+                                                      sharding=sharding,
+                                                      microbatches=m,
+                                                      zero_stage=stage))
+        return out
+
+    def plan(self, n_devices: int, top_k: int = 1):
+        """Best config(s) by estimated step time; raises if nothing fits HBM."""
+        ests = [estimate(self.model, self.cluster, c)
+                for c in self.candidates(n_devices)]
+        feasible = [e for e in ests if e.feasible]
+        if not feasible:
+            tight = min(ests, key=lambda e: e.mem_bytes) if ests else None
+            raise RuntimeError(
+                "no parallel config fits in device memory for "
+                f"{n_devices} devices"
+                + (f" (closest: {tight.config} at {tight.mem_bytes/1e9:.1f} GB)"
+                   if tight else ""))
+        feasible.sort(key=lambda e: e.t_step)
+        return feasible[0] if top_k == 1 else feasible[:top_k]
+
+
+def model_spec_from_layer(model, seq_len, global_batch, vocab=32000,
+                          n_layers=None, hidden=None):
+    """Derive a ModelSpec from an nn.Layer (params counted exactly; layer
+    count/hidden taken from kwargs or guessed from the parameter shapes)."""
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    if hidden is None:
+        # most common square weight dim is a good hidden-size proxy
+        from collections import Counter
+
+        dims = Counter()
+        for p in model.parameters():
+            if len(p.shape) == 2 and p.shape[0] == p.shape[1]:
+                dims[int(p.shape[0])] += 1
+        hidden = dims.most_common(1)[0][0] if dims else max(
+            (int(s) for p in model.parameters() for s in p.shape), default=1024)
+    if n_layers is None:
+        names = [n for n, _ in model.named_parameters()]
+        idx = set()
+        for n in names:
+            for part in n.split("."):
+                if part.isdigit():
+                    idx.add(int(part))
+        n_layers = (max(idx) + 1) if idx else 1
+    return ModelSpec(n_params=float(n_params), n_layers=int(n_layers),
+                     hidden=int(hidden), seq_len=int(seq_len),
+                     global_batch=int(global_batch), vocab=vocab)
+
+
+def plan(model_spec: ModelSpec, n_devices: int, cluster: ClusterSpec | None = None,
+         top_k: int = 1):
+    """One-call entry: best ParallelConfig for `model_spec` on `n_devices`."""
+    return Planner(model_spec, cluster).plan(n_devices, top_k=top_k)

@@ -48,6 +48,12 @@ class _DictStore:
         with self._lock:
             return self._d.get(k)
 
+    def add(self, k, amount: int) -> int:
+        with self._lock:
+            cur = int(self._d.get(k, b"0").decode()) + int(amount)
+            self._d[k] = str(cur).encode()
+            return cur
+
     def delete_key(self, k):
         with self._lock:
             self._d.pop(k, None)

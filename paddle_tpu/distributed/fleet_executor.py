@@ -1,0 +1,279 @@
+"""FleetExecutor — actor-style multi-program runtime.
+
+Reference analog: `paddle/fluid/distributed/fleet_executor/` — `TaskNode`
+graphs (task_node.h:32) executed by `Interceptor` message loops
+(interceptor.h:46) owned by a per-rank `Carrier` (carrier.h:49), with a brpc
+`MessageBus` bridging ranks.  The reference used it for pipeline/heterogeneous
+cluster orchestration where one SPMD program can't express the job.
+
+TPU-native: the hot pipeline path is COMPILED (meta_parallel.pipeline_schedule
+— shard_map + ppermute), so this runtime serves the control-plane role:
+streaming task graphs around the compiled steps (data ingestion -> train ->
+eval/checkpoint side-tasks), and cross-process task graphs bridged by the
+TCPStore instead of brpc.  Interceptors are threads with mailboxes; credit
+messages bound buffering exactly like the reference's scheduling messages.
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import time
+
+__all__ = ["TaskNode", "Interceptor", "Carrier", "MessageBus", "FleetExecutor"]
+
+
+class _Stop:
+    """Termination sentinel that survives pickling across the message bus
+    (a bare object() would unpickle to a different identity)."""
+
+    def __reduce__(self):
+        return (_get_stop, ())
+
+
+def _get_stop():
+    return _STOP
+
+
+_STOP = _Stop()
+
+
+class TaskNode:
+    """Ref task_node.h:32 — one unit of the job graph.
+
+    `program` is any callable payload(batch) -> batch (the reference held a
+    ProgramDesc section; here the payload is usually a compiled step or host
+    IO fn).  max_run_times bounds how many microbatches stream through."""
+
+    def __init__(self, rank, task_id, program=None, max_run_times=None,
+                 node_type="Compute"):
+        self.rank = int(rank)
+        self.task_id = int(task_id)
+        self.program = program
+        self.max_run_times = max_run_times
+        self.node_type = node_type
+        self.upstream: list[int] = []
+        self.downstream: list[int] = []
+
+    def add_upstream_task(self, task_id, buffs_size=2):
+        self.upstream.append(int(task_id))
+
+    def add_downstream_task(self, task_id, buffs_size=2):
+        self.downstream.append(int(task_id))
+
+
+class MessageBus:
+    """Ref message_bus.cc — routes InterceptorMessages between carriers.
+
+    In-process: direct queue handoff.  Cross-process: messages serialize into
+    the control-plane KV store under {job}/msg/{dst_rank}/{seq} and a poller
+    thread drains them (the TCPStore replaces brpc)."""
+
+    def __init__(self, rank=0, store=None, job_id="fleet_exec", poll_interval=0.01):
+        self.rank = int(rank)
+        self.store = store
+        self.job_id = job_id
+        self.poll_interval = poll_interval
+        self._local: dict[int, "Carrier"] = {}
+        self._recv_seq = 0
+        self._stop = threading.Event()
+        self._poller = None
+
+    def register_carrier(self, carrier):
+        self._local[carrier.rank] = carrier
+        # start polling only once a carrier can consume — a message read
+        # before registration would be dropped and its sequence burned
+        if self.store is not None and self._poller is None:
+            self._poller = threading.Thread(target=self._poll_loop, daemon=True)
+            self._poller.start()
+
+    def send(self, dst_rank, task_id, payload):
+        if dst_rank in self._local:
+            self._local[dst_rank].deliver(task_id, payload)
+            return
+        if self.store is None:
+            raise RuntimeError(f"rank {dst_rank} is not local and no store "
+                               "was given to bridge processes")
+        import pickle
+
+        # per-destination ATOMIC sequence: multiple sender ranks must not
+        # overwrite each other's slots
+        seq = self.store.add(f"{self.job_id}/msgctr/{dst_rank}", 1) - 1
+        key = f"{self.job_id}/msg/{dst_rank}/{seq}"
+        self.store.set(key, pickle.dumps((task_id, payload), protocol=4))
+
+    def _poll_loop(self):
+        import pickle
+
+        # prefer the non-blocking read (TCPStore.get blocks until the key
+        # exists, which would stall the poll loop's stop check)
+        getter = getattr(self.store, "get_nb", None) or self.store.get
+        while not self._stop.wait(self.poll_interval):
+            key = f"{self.job_id}/msg/{self.rank}/{self._recv_seq}"
+            try:
+                raw = getter(key)
+            except Exception:
+                continue
+            if raw is None:
+                continue
+            self._recv_seq += 1
+            task_id, payload = pickle.loads(raw)
+            carrier = self._local.get(self.rank)
+            if carrier is not None:
+                carrier.deliver(task_id, payload)
+
+    def shutdown(self):
+        self._stop.set()
+        if self._poller is not None:
+            self._poller.join(timeout=1.0)
+
+
+class Interceptor(threading.Thread):
+    """Ref interceptor.h:46 — one actor: mailbox + handler loop.
+
+    Source nodes pull from the carrier feed; compute nodes apply
+    node.program; sink nodes collect into carrier.results."""
+
+    def __init__(self, carrier, node: TaskNode, mailbox_size=4):
+        super().__init__(daemon=True)
+        self.carrier = carrier
+        self.node = node
+        self.inbox: _queue.Queue = _queue.Queue(maxsize=mailbox_size)
+        self._n_done = 0
+        # fan-in: terminate only after EVERY upstream has sent its STOP
+        self._stops_needed = max(len(node.upstream), 1)
+
+    def enqueue(self, payload):
+        self.inbox.put(payload)
+
+    def _emit(self, payload):
+        for dst in self.node.downstream:
+            self.carrier.route(dst, payload)
+        if not self.node.downstream:
+            self.carrier.results.put((self.node.task_id, payload))
+
+    def run(self):
+        try:
+            if self.node.node_type == "Source":
+                for item in self.carrier.feed_iter():
+                    out = self.node.program(item) if self.node.program else item
+                    self._emit(out)
+                    self._n_done += 1
+                    if (self.node.max_run_times
+                            and self._n_done >= self.node.max_run_times):
+                        break
+                self._emit(_STOP)
+                return
+            stops = 0
+            while True:
+                item = self.inbox.get()
+                if item is _STOP:
+                    stops += 1
+                    if stops >= self._stops_needed:
+                        self._emit(_STOP)
+                        return
+                    continue
+                out = self.node.program(item) if self.node.program else item
+                if self.node.node_type != "Sink":
+                    self._emit(out)
+                else:
+                    self.carrier.results.put((self.node.task_id, out))
+                self._n_done += 1
+        except Exception as e:  # surface actor failures to the consumer
+            self.carrier.results.put((self.node.task_id, ("__error__", e)))
+            self._emit(_STOP)
+
+
+class Carrier:
+    """Ref carrier.h:49 — owns this rank's interceptors and routes messages."""
+
+    def __init__(self, rank=0, bus: MessageBus | None = None):
+        self.rank = int(rank)
+        self.bus = bus or MessageBus(rank)
+        self.bus.register_carrier(self)
+        self.interceptors: dict[int, Interceptor] = {}
+        self.results: _queue.Queue = _queue.Queue()
+        self._feed = None
+        self._task_ranks: dict[int, int] = {}
+
+    def add_task_node(self, node: TaskNode):
+        self._task_ranks[node.task_id] = node.rank
+        if node.rank == self.rank:
+            self.interceptors[node.task_id] = Interceptor(self, node)
+
+    def route(self, task_id, payload):
+        dst_rank = self._task_ranks.get(task_id, self.rank)
+        if dst_rank == self.rank:
+            self.deliver(task_id, payload)
+        else:
+            self.bus.send(dst_rank, task_id, payload)
+
+    def deliver(self, task_id, payload):
+        self.interceptors[task_id].enqueue(payload)
+
+    def feed_iter(self):
+        return iter(self._feed or [])
+
+    def start(self, feed=None):
+        self._feed = feed
+        for it in self.interceptors.values():
+            it.start()
+
+    def wait(self, timeout=60.0):
+        """Collect sink outputs until every interceptor finishes.
+
+        `timeout` is an IDLE timeout: it resets whenever a result arrives, so
+        a long-running but progressing graph never trips it."""
+        out = []
+        deadline = time.time() + timeout
+
+        def _collect(tid, payload):
+            if isinstance(payload, tuple) and len(payload) == 2 \
+                    and payload[0] == "__error__":
+                raise RuntimeError("task node failed") from payload[1]
+            if payload is not _STOP:
+                out.append((tid, payload))
+
+        live = list(self.interceptors.values())
+        while any(t.is_alive() for t in live):
+            try:
+                tid, payload = self.results.get(timeout=0.05)
+            except _queue.Empty:
+                if time.time() > deadline:
+                    raise TimeoutError("fleet executor made no progress "
+                                       f"for {timeout}s")
+                continue
+            deadline = time.time() + timeout   # progress resets the idle clock
+            _collect(tid, payload)
+        while not self.results.empty():
+            _collect(*self.results.get_nowait())
+        return out
+
+
+class FleetExecutor:
+    """Ref fleet_executor.h:35 — top-level: init with a task graph, run it.
+
+    `run(feed)` streams the feed through the graph and returns
+    {sink_task_id: [outputs in arrival order]}.
+    """
+
+    def __init__(self, rank=0, store=None, job_id="fleet_exec"):
+        self.bus = MessageBus(rank=rank, store=store, job_id=job_id)
+        self.carrier = Carrier(rank=rank, bus=self.bus)
+        self._nodes: list[TaskNode] = []
+
+    def init(self, task_nodes):
+        for node in task_nodes:
+            self._nodes.append(node)
+            self.carrier.add_task_node(node)
+        return self
+
+    def run(self, feed=None, timeout=60.0):
+        self.carrier.start(feed=feed)
+        pairs = self.carrier.wait(timeout=timeout)
+        out: dict[int, list] = {}
+        for tid, payload in pairs:
+            out.setdefault(tid, []).append(payload)
+        return out
+
+    def shutdown(self):
+        self.bus.shutdown()

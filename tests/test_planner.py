@@ -1,0 +1,88 @@
+"""Auto-parallel cost model + planner (ref planner.py / cost_model.py)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.auto_parallel import (
+    ClusterSpec, ModelSpec, ParallelConfig, Planner, plan, model_spec_from_layer,
+)
+from paddle_tpu.distributed.auto_parallel.cost_model import estimate
+
+
+def _llama7b(batch=256):
+    return ModelSpec(n_params=6.7e9, n_layers=32, hidden=4096, seq_len=2048,
+                     global_batch=batch)
+
+
+def _small(batch=64):
+    return ModelSpec(n_params=1.2e8, n_layers=12, hidden=768, seq_len=1024,
+                     global_batch=batch)
+
+
+def test_small_model_prefers_data_parallel():
+    best = plan(_small(), 8)
+    assert best.feasible
+    # a 120M model needs no model sharding on 16GB chips
+    assert best.config.mp == 1 and best.config.pp == 1
+    assert best.config.dp * best.config.sharding == 8
+
+
+def test_7b_on_8_chips_requires_model_sharding():
+    best = plan(_llama7b(), 8)
+    assert best.feasible
+    # AdamW state alone for 6.7B params is ~54GB; pure dp can't fit 16GB chips
+    assert best.config.mp * best.config.pp * best.config.sharding > 1
+    pure_dp = estimate(_llama7b(), ClusterSpec(),
+                       ParallelConfig(dp=8))
+    assert not pure_dp.feasible and "HBM" in pure_dp.reason
+
+
+def test_more_devices_not_slower():
+    t8 = plan(_llama7b(), 8).t_step
+    t32 = plan(_llama7b(), 32).t_step
+    t256 = plan(_llama7b(), 256).t_step
+    assert t32 < t8 and t256 < t32
+
+
+def test_infeasible_raises():
+    huge = ModelSpec(n_params=1e12, n_layers=96, hidden=12288, seq_len=4096,
+                     global_batch=64)
+    with pytest.raises(RuntimeError, match="no parallel config"):
+        plan(huge, 2)
+
+
+def test_bubble_penalizes_low_microbatch_pipeline():
+    m = _llama7b()
+    c = ClusterSpec()
+    lo = estimate(m, c, ParallelConfig(dp=1, pp=8, microbatches=1, sharding=1))
+    hi = estimate(m, c, ParallelConfig(dp=1, pp=8, microbatches=16, sharding=1))
+    assert lo.t_pp_bubble > hi.t_pp_bubble
+    assert hi.t_step < lo.t_step
+
+
+def test_model_spec_from_layer():
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)
+    spec = model_spec_from_layer(model, seq_len=128, global_batch=8)
+    n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
+    assert spec.n_params == n_params
+    assert spec.n_layers >= 1 and spec.hidden > 0
+    best = plan(spec, 8)
+    assert best.feasible
+
+
+def test_zero_stage_in_memory_model():
+    """Stage 2 replicates params; stage 3 shards them — the cost model must
+    distinguish (round-2 review: degree was conflated with stage)."""
+    m = _llama7b()
+    c = ClusterSpec()
+    s2 = estimate(m, c, ParallelConfig(sharding=8, zero_stage=2))
+    s3 = estimate(m, c, ParallelConfig(sharding=8, zero_stage=3))
+    assert s3.mem_bytes < s2.mem_bytes
+    # 6.7B bf16 params replicated = 13.4GB; sharded 8-way = 1.7GB
+    assert s2.mem_bytes - s3.mem_bytes > 10e9
+    best = plan(m, 8)
+    assert best.config.zero_stage >= 2  # picked a config that really fits
