@@ -69,6 +69,8 @@ from . import distributed  # noqa: F401
 from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import profiler  # noqa: F401
 from . import framework  # noqa: F401
 from . import device  # noqa: F401

@@ -82,5 +82,15 @@ def is_floating(dtype) -> bool:
     return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
 
 
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+def is_differentiable(dtype) -> bool:
+    """Dtypes gradients can flow through (float or complex — the fft family
+    produces complex intermediates on the tape)."""
+    return is_floating(dtype) or is_complex(dtype)
+
+
 def is_integer(dtype) -> bool:
     return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)

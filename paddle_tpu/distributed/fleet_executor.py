@@ -116,10 +116,17 @@ class MessageBus:
             if raw is None:
                 continue
             self._recv_seq += 1
-            task_id, payload = pickle.loads(raw)
-            carrier = self._local.get(self.rank)
-            if carrier is not None:
-                carrier.deliver(task_id, payload)
+            try:
+                task_id, payload = pickle.loads(raw)
+                carrier = self._local.get(self.rank)
+                if carrier is not None:
+                    carrier.deliver(task_id, payload)
+            except Exception as e:
+                # one bad message must not kill the poller; surface it to the
+                # consumer instead of silently hanging the graph
+                carrier = self._local.get(self.rank)
+                if carrier is not None:
+                    carrier.results.put((-1, ("__error__", e)))
 
     def shutdown(self):
         self._stop.set()

@@ -1,0 +1,84 @@
+"""paddle.fft (ref: python/paddle/fft.py — the full discrete-transform
+family).  Every transform is a differentiable apply_op over jnp.fft; XLA
+lowers FFTs to the TPU's native FFT HLO.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor.tensor import Tensor, apply_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2",
+    "fftn", "ifftn", "rfftn", "irfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    # paddle uses "backward"/"forward"/"ortho" like numpy
+    if norm not in (None, "backward", "forward", "ortho"):
+        raise ValueError(f"invalid norm {norm!r}")
+    return norm or "backward"
+
+
+def _wrap1(jfn, opname):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply_op(lambda v: jfn(v, n=n, axis=axis, norm=_norm(norm)),
+                        (x,), name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+def _wrap2(jfn, opname):
+    def op(x, s=None, axes=(-2, -1), norm="backward", name=None):
+        return apply_op(lambda v: jfn(v, s=s, axes=axes, norm=_norm(norm)),
+                        (x,), name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+def _wrapn(jfn, opname):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        return apply_op(lambda v: jfn(v, s=s, axes=axes, norm=_norm(norm)),
+                        (x,), name=opname)
+
+    op.__name__ = opname
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+fft2 = _wrap2(jnp.fft.fft2, "fft2")
+ifft2 = _wrap2(jnp.fft.ifft2, "ifft2")
+rfft2 = _wrap2(jnp.fft.rfft2, "rfft2")
+irfft2 = _wrap2(jnp.fft.irfft2, "irfft2")
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.fftshift(v, axes=axes), (x,), name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), (x,), name="ifftshift")

@@ -1,0 +1,116 @@
+"""paddle.signal (ref: python/paddle/signal.py — frame/overlap_add/stft/istft).
+
+All jnp compositions: framing is a strided gather, overlap-add a scatter-add,
+and STFT/iSTFT compose them with paddle.fft — everything fuses under jit.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .tensor.tensor import Tensor, apply_op
+from . import fft as _fft
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _frame(v, frame_length, hop_length, axis=-1):
+    if axis not in (-1, v.ndim - 1):
+        raise NotImplementedError(
+            "frame: only axis=-1 (time-last, the paddle default) is supported")
+    n = v.shape[-1]
+    n_frames = 1 + (n - frame_length) // hop_length
+    idx = (jnp.arange(frame_length)[None, :]
+           + hop_length * jnp.arange(n_frames)[:, None])   # [F, L]
+    out = v[..., idx]                                       # [..., F, L]
+    return jnp.swapaxes(out, -2, -1)                        # [..., L, F] (paddle layout)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Ref signal.py frame: slide a window of `frame_length` by `hop_length`;
+    returns [..., frame_length, num_frames]."""
+    if hop_length <= 0:
+        raise ValueError("hop_length must be positive")
+    return apply_op(lambda v: _frame(v, frame_length, hop_length, axis),
+                    (x,), name="frame")
+
+
+def _overlap_add(v, hop_length):
+    # v: [..., frame_length, n_frames]
+    L, F = v.shape[-2], v.shape[-1]
+    n = (F - 1) * hop_length + L
+    out = jnp.zeros(v.shape[:-2] + (n,), v.dtype)
+    for f in range(F):   # unrolled under jit: F is static and small for audio
+        out = out.at[..., f * hop_length: f * hop_length + L].add(v[..., :, f])
+    return out
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Ref signal.py overlap_add — inverse of frame."""
+    return apply_op(lambda v: _overlap_add(v, hop_length), (x,),
+                    name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+         pad_mode="reflect", normalized=False, onesided=True, name=None):
+    """Ref signal.py stft: returns [..., n_fft//2+1 (or n_fft), n_frames]."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def _f(v, w=None):
+        if center:
+            pad = n_fft // 2
+            v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)], mode=pad_mode)
+        frames = _frame(v, n_fft, hop_length)          # [..., n_fft, F]
+        if w is not None:
+            win = w
+            if win_length < n_fft:                      # center-pad the window
+                lp = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lp, n_fft - win_length - lp))
+            frames = frames * win[:, None]
+        spec = (jnp.fft.rfft(frames, axis=-2) if onesided
+                else jnp.fft.fft(frames, axis=-2))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    args = (x,) if window is None else (x, window)
+    return apply_op(_f, args, name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
+          normalized=False, onesided=True, length=None, return_complex=False,
+          name=None):
+    """Ref signal.py istft — least-squares inverse with window normalization."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+
+    def _f(v, w=None):
+        if normalized:
+            v = v * jnp.sqrt(jnp.asarray(n_fft, v.real.dtype))
+        if onesided:
+            frames = jnp.fft.irfft(v, n=n_fft, axis=-2)
+        else:
+            frames = jnp.fft.ifft(v, axis=-2)
+            if not return_complex:
+                frames = frames.real
+        if w is not None:
+            win = w
+            if win_length < n_fft:
+                lp = (n_fft - win_length) // 2
+                win = jnp.pad(win, (lp, n_fft - win_length - lp))
+        else:
+            win = jnp.ones((n_fft,), jnp.float32)
+        sig = _overlap_add(frames * win[:, None], hop_length)
+        # window envelope normalization (the least-squares denominator)
+        env = _overlap_add(jnp.broadcast_to((win * win)[:, None],
+                                            (n_fft, v.shape[-1])), hop_length)
+        sig = sig / jnp.maximum(env, 1e-11)
+        if center:
+            pad = n_fft // 2
+            sig = sig[..., pad: sig.shape[-1] - pad]
+        if length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    args = (x,) if window is None else (x, window)
+    return apply_op(_f, args, name="istft")

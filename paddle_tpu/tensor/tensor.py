@@ -329,7 +329,7 @@ def apply_op(fn: Callable, args: tuple, kwargs: dict | None = None, name: str = 
         for i, a in enumerate(args)
         if isinstance(a, Tensor)
         and not a.stop_gradient
-        and _dt.is_floating(a._value.dtype)
+        and _dt.is_differentiable(a._value.dtype)
     ]
 
     if not tape.is_grad_enabled() or not diff_idx:

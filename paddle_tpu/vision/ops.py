@@ -1,0 +1,199 @@
+"""paddle.vision.ops (ref: python/paddle/vision/ops.py — detection-pipeline
+primitives: nms:1515, roi_align:1301, roi_pool:1173, yolo_box:253).
+
+TPU split: box decode and ROI feature extraction are traced jnp (they sit
+inside jitted forward passes and roi_align is differentiable); greedy NMS is
+host-side numpy — it is sequential post-processing over a handful of boxes,
+exactly where the reference ran it relative to the hot path.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor, apply_op, _unwrap
+
+__all__ = ["nms", "roi_align", "roi_pool", "yolo_box", "box_iou"]
+
+
+def box_iou(boxes1, boxes2):
+    """IoU matrix between [N,4] and [M,4] xyxy boxes."""
+
+    def _f(a, b):
+        area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+        area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+        lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+        rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+        wh = jnp.clip(rb - lt, 0)
+        inter = wh[..., 0] * wh[..., 1]
+        return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+
+    return apply_op(_f, (boxes1, boxes2), name="box_iou")
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=None,
+        top_k=None):
+    """Ref ops.py:1515 — greedy NMS; returns kept indices (int64 Tensor).
+
+    Host-side: NMS is inherently sequential; it post-processes a few hundred
+    boxes after the jitted forward."""
+    b = np.asarray(_unwrap(boxes), np.float32)
+    n = b.shape[0]
+    s = (np.asarray(_unwrap(scores), np.float32) if scores is not None
+         else np.ones((n,), np.float32))
+    cats = (np.asarray(_unwrap(category_idxs)) if category_idxs is not None
+            else np.zeros((n,), np.int64))
+
+    keep_all = []
+    for c in np.unique(cats):
+        idx = np.nonzero(cats == c)[0]
+        order = idx[np.argsort(-s[idx])]
+        kept = []
+        suppressed = np.zeros(len(order), bool)
+        for i in range(len(order)):
+            if suppressed[i]:
+                continue
+            kept.append(order[i])
+            bi = b[order[i]]
+            for j in range(i + 1, len(order)):
+                if suppressed[j]:
+                    continue
+                bj = b[order[j]]
+                lt = np.maximum(bi[:2], bj[:2])
+                rb = np.minimum(bi[2:], bj[2:])
+                wh = np.clip(rb - lt, 0, None)
+                inter = wh[0] * wh[1]
+                a1 = (bi[2] - bi[0]) * (bi[3] - bi[1])
+                a2 = (bj[2] - bj[0]) * (bj[3] - bj[1])
+                if inter / (a1 + a2 - inter + 1e-10) > iou_threshold:
+                    suppressed[j] = True
+        keep_all += kept
+    keep_all = sorted(keep_all, key=lambda i: -s[i])
+    if top_k is not None:
+        keep_all = keep_all[:top_k]
+    return Tensor(jnp.asarray(np.asarray(keep_all, np.int64)))
+
+
+def _roi_sample(feat, rois, output_size, spatial_scale, sampling_ratio, mode):
+    """Shared bilinear ROI sampler: feat [C,H,W], rois [R,4] xyxy."""
+    ph, pw = output_size
+    sr = max(int(sampling_ratio), 1)
+
+    def one_roi(roi):
+        x1, y1, x2, y2 = roi * spatial_scale
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        bin_h = rh / ph
+        bin_w = rw / pw
+        # sr x sr sample points per bin (ref roi_align sampling_ratio)
+        iy = (jnp.arange(ph * sr) + 0.5) / sr
+        ix = (jnp.arange(pw * sr) + 0.5) / sr
+        ys = y1 + iy * bin_h
+        xs = x1 + ix * bin_w
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        coords = jnp.stack([jnp.broadcast_to(gy, gy.shape),
+                            jnp.broadcast_to(gx, gx.shape)])
+
+        def per_channel(ch):
+            samp = jax.scipy.ndimage.map_coordinates(ch, coords, order=1,
+                                                     mode="nearest")
+            samp = samp.reshape(ph, sr, pw, sr)
+            if mode == "max":
+                return samp.max(axis=(1, 3))
+            return samp.mean(axis=(1, 3))
+
+        return jax.vmap(per_channel)(feat)      # [C, ph, pw]
+
+    return jax.vmap(one_roi)(rois)              # [R, C, ph, pw]
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """Ref ops.py:1301 — differentiable bilinear ROI pooling.
+
+    x: [N,C,H,W]; boxes: [R,4] xyxy (concatenated over the batch);
+    boxes_num: [N] rois per image."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    sr = 2 if sampling_ratio in (-1, None) else sampling_ratio
+
+    def _f(feat, rois):
+        off = 0.5 if aligned else 0.0
+        rois = rois - off / spatial_scale
+        counts = np.asarray(_unwrap(boxes_num), np.int64)
+        outs = []
+        start = 0
+        for img, cnt in enumerate(counts):     # static per-image partition
+            r = rois[start:start + int(cnt)]
+            outs.append(_roi_sample(feat[img], r, output_size, spatial_scale,
+                                    sr, "avg"))
+            start += int(cnt)
+        return jnp.concatenate(outs, axis=0)
+
+    return apply_op(_f, (x, boxes), name="roi_align")
+
+
+def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Ref ops.py:1173 — max-pooled ROI features (dense 4x4-sample max per
+    bin; the reference's integer quantization is shape-dynamic and anti-TPU)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+
+    def _f(feat, rois):
+        counts = np.asarray(_unwrap(boxes_num), np.int64)
+        outs = []
+        start = 0
+        for img, cnt in enumerate(counts):
+            r = rois[start:start + int(cnt)]
+            outs.append(_roi_sample(feat[img], r, output_size, spatial_scale,
+                                    4, "max"))
+            start += int(cnt)
+        return jnp.concatenate(outs, axis=0)
+
+    return apply_op(_f, (x, boxes), name="roi_pool")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5, name=None):
+    """Ref ops.py:253 — decode a YOLO head [N, A*(5+C), H, W] into boxes+scores.
+
+    Returns (boxes [N, A*H*W, 4] xyxy in image coords, scores [N, A*H*W, C])."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = anchors.shape[0]
+
+    def _f(pred, imgs):
+        N, _, H, W = pred.shape
+        p = pred.reshape(N, A, 5 + class_num, H, W)
+        gx = jnp.arange(W, dtype=jnp.float32)
+        gy = jnp.arange(H, dtype=jnp.float32)
+        cx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx[None, None, None, :]) / W
+        cy = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy[None, None, :, None]) / H
+        in_w, in_h = W * downsample_ratio, H * downsample_ratio
+        bw = jnp.exp(p[:, :, 2]) * anchors[None, :, 0, None, None] / in_w
+        bh = jnp.exp(p[:, :, 3]) * anchors[None, :, 1, None, None] / in_h
+        obj = jax.nn.sigmoid(p[:, :, 4])
+        cls = jax.nn.sigmoid(p[:, :, 5:])
+        score = obj[:, :, None] * cls
+        score = jnp.where(score >= conf_thresh, score, 0.0)
+
+        imw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        imh = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * imw
+        y1 = (cy - bh / 2) * imh
+        x2 = (cx + bw / 2) * imw
+        y2 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1).reshape(N, -1, 4)
+        scores = jnp.moveaxis(score, 2, -1).reshape(N, -1, class_num)
+        return boxes, scores
+
+    return apply_op(_f, (x, img_size), name="yolo_box")

@@ -191,3 +191,344 @@ def hflip(img):
     arr = np.asarray(img)
     w_axis = 2 if (arr.ndim == 3 and arr.shape[0] in (1, 3, 4)) else 1
     return np.flip(arr, axis=w_axis).copy()
+
+
+# --------------------------------------------------------------- color/geom
+def _axes(arr):
+    """(channel_axis | None, h_axis, w_axis) for CHW/HWC/HW arrays."""
+    if arr.ndim == 2:
+        return None, 0, 1
+    if arr.shape[0] in (1, 3, 4):
+        return 0, 1, 2
+    return 2, 0, 1
+
+
+def _as_float(img):
+    return np.asarray(img, np.float32)
+
+
+def adjust_brightness(img, brightness_factor):
+    """Ref transforms/functional.py adjust_brightness: scale toward black."""
+    return _as_float(img) * float(brightness_factor)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _as_float(img)
+    mean = arr.mean()
+    return (arr - mean) * float(contrast_factor) + mean
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _as_float(img)
+    c, h, w = _axes(arr)
+    gray = arr.mean(axis=c, keepdims=True) if c is not None else arr
+    return (arr - gray) * float(saturation_factor) + gray
+
+
+def _rgb_to_hsv(r, g, b):
+    maxc = np.maximum(np.maximum(r, g), b)
+    minc = np.minimum(np.minimum(r, g), b)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    rc = (maxc - r) / np.maximum(delta, 1e-12)
+    gc = (maxc - g) / np.maximum(delta, 1e-12)
+    bc = (maxc - b) / np.maximum(delta, 1e-12)
+    h = np.where(r == maxc, bc - gc, np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = np.where(delta == 0, 0.0, h / 6.0 % 1.0)
+    return h, s, v
+
+
+def _hsv_to_rgb(h, s, v):
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(np.int32) % 6
+    r = np.choose(i, [v, q, p, p, t, v])
+    g = np.choose(i, [t, v, v, q, p, p])
+    b = np.choose(i, [p, p, t, v, v, q])
+    return r, g, b
+
+
+def adjust_hue(img, hue_factor):
+    """Ref adjust_hue: rotate the hue channel by hue_factor in [-0.5, 0.5]."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _as_float(img)
+    c, hax, wax = _axes(arr)
+    if c is None or arr.shape[c] == 1:
+        return arr
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    chw = np.moveaxis(arr, c, 0) / scale
+    h, s, v = _rgb_to_hsv(chw[0], chw[1], chw[2])
+    h = (h + hue_factor) % 1.0
+    r, g, b = _hsv_to_rgb(h, s, v)
+    planes = [r, g, b] + [chw[i] for i in range(3, chw.shape[0])]  # keep alpha
+    out = np.stack(planes) * scale
+    return np.moveaxis(out, 0, c)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _as_float(img)
+    c, _, _ = _axes(arr)
+    if c is None:   # (H, W): broadcast to the requested channel count (CHW)
+        return np.repeat(arr[None], num_output_channels, axis=0)
+    weights = np.asarray([0.299, 0.587, 0.114], np.float32)
+    chw = np.moveaxis(arr, c, 0)
+    gray = (chw[:3] * weights[:, None, None]).sum(0, keepdims=True)
+    gray = np.repeat(gray, num_output_channels, axis=0)
+    return np.moveaxis(gray, 0, c)
+
+
+def vflip(img):
+    arr = np.asarray(img)
+    _, h_axis, _ = _axes(arr)
+    return np.flip(arr, axis=h_axis).copy()
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = np.asarray(img)
+    c, hax, wax = _axes(arr)
+    if isinstance(padding, numbers.Number):
+        pl = pr = pt = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    spec = [(0, 0)] * arr.ndim
+    spec[hax] = (pt, pb)
+    spec[wax] = (pl, pr)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, spec, mode=mode, **kw)
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    _, hax, wax = _axes(arr)
+    sl = [slice(None)] * arr.ndim
+    sl[hax] = slice(top, top + height)
+    sl[wax] = slice(left, left + width)
+    return arr[tuple(sl)]
+
+
+def center_crop(img, output_size):
+    return CenterCrop(output_size)(img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None, fill=0):
+    """Ref functional.py rotate — inverse-mapped bilinear/nearest rotation.
+    expand=True grows the canvas to contain the whole rotated image."""
+    arr = _as_float(img)
+    c, hax, wax = _axes(arr)
+    h, w = arr.shape[hax], arr.shape[wax]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None else (center[1], center[0])
+    theta = np.deg2rad(angle)
+    cos, sin = np.cos(theta), np.sin(theta)
+    if expand:
+        oh = int(np.ceil(abs(h * cos) + abs(w * sin) - 1e-9))
+        ow = int(np.ceil(abs(w * cos) + abs(h * sin) - 1e-9))
+        ocy, ocx = (oh - 1) / 2.0, (ow - 1) / 2.0
+    else:
+        oh, ow, ocy, ocx = h, w, cy, cx
+    ys, xs = np.meshgrid(np.arange(oh), np.arange(ow), indexing="ij")
+    # inverse rotation: output pixel -> source location
+    sx = cos * (xs - ocx) + sin * (ys - ocy) + cx
+    sy = -sin * (xs - ocx) + cos * (ys - ocy) + cy
+    if interpolation == "nearest":
+        sxi = np.round(sx).astype(np.int64)
+        syi = np.round(sy).astype(np.int64)
+        valid = (sx >= -0.5) & (sx <= w - 0.5) & (sy >= -0.5) & (sy <= h - 0.5)
+        sxi = np.clip(sxi, 0, w - 1)
+        syi = np.clip(syi, 0, h - 1)
+
+        def sample(plane):
+            out = plane[syi, sxi]
+            return np.where(valid, out, fill)
+    else:  # bilinear
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        fx, fy = sx - x0, sy - y0
+        eps = 1e-3  # cos/sin roundoff must not invalidate border pixels
+        valid = (sx >= -eps) & (sx <= w - 1 + eps) & (sy >= -eps) & (sy <= h - 1 + eps)
+
+        def sample(plane):
+            def at(yy, xx):
+                return plane[np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)]
+
+            out = ((1 - fy) * (1 - fx) * at(y0, x0) + (1 - fy) * fx * at(y0, x0 + 1)
+                   + fy * (1 - fx) * at(y0 + 1, x0) + fy * fx * at(y0 + 1, x0 + 1))
+            return np.where(valid, out, fill)
+
+    if c is None:
+        return sample(arr)
+    chw = np.moveaxis(arr, c, 0)
+    out = np.stack([sample(p) for p in chw])
+    return np.moveaxis(out, 0, c)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    """Ref functional.py erase — fill a rectangle with value(s); a per-channel
+    value broadcasts along the channel axis."""
+    arr = np.asarray(img) if inplace else np.array(img)
+    c, hax, wax = _axes(arr)
+    sl = [slice(None)] * arr.ndim
+    sl[hax] = slice(i, i + h)
+    sl[wax] = slice(j, j + w)
+    val = np.asarray(v, arr.dtype)
+    if val.ndim == 1 and c is not None:
+        shape = [1] * arr.ndim
+        shape[c] = val.shape[0]
+        val = val.reshape(shape)
+    arr[tuple(sl)] = val
+    return arr
+
+
+class BrightnessTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_float(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_brightness(img, f)
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if value < 0:
+            raise ValueError("contrast value must be non-negative")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_float(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_float(img)
+        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return adjust_saturation(img, f)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        super().__init__(keys)
+        if not 0 <= value <= 0.5:
+            raise ValueError("hue value must be in [0, 0.5]")
+        self.value = float(value)
+
+    def _apply_image(self, img):
+        if self.value == 0:
+            return _as_float(img)
+        f = np.random.uniform(-self.value, self.value)
+        return adjust_hue(img, f)
+
+
+class ColorJitter(BaseTransform):
+    """Ref transforms.py ColorJitter: random brightness/contrast/saturation/hue
+    in random order."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0, keys=None):
+        super().__init__(keys)
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def _apply_image(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i]._apply_image(img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        super().__init__(keys)
+        self.num_output_channels = num_output_channels
+
+    def _apply_image(self, img):
+        return to_grayscale(img, self.num_output_channels)
+
+
+class Pad(BaseTransform):
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        super().__init__(keys)
+        self.padding, self.fill, self.mode = padding, fill, padding_mode
+
+    def _apply_image(self, img):
+        return pad(img, self.padding, self.fill, self.mode)
+
+
+class RandomVerticalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+
+    def _apply_image(self, img):
+        return vflip(img) if np.random.rand() < self.prob else np.asarray(img)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def _apply_image(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return rotate(img, angle, self.interpolation, expand=self.expand,
+                      center=self.center, fill=self.fill)
+
+
+class RandomErasing(BaseTransform):
+    """Ref transforms.py RandomErasing (Zhong et al.)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        super().__init__(keys)
+        self.prob, self.scale, self.ratio = prob, scale, ratio
+        self.value, self.inplace = value, inplace
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        _, hax, wax = _axes(arr)
+        h, w = arr.shape[hax], arr.shape[wax]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target * ar)))
+            ew = int(round(np.sqrt(target / ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                v = (np.random.standard_normal() if self.value == "random"
+                     else self.value)
+                return erase(arr, i, j, eh, ew, v, self.inplace)
+        return arr

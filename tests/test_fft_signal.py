@@ -1,0 +1,86 @@
+"""paddle.fft / paddle.signal vs numpy + torch oracles
+(ref python/paddle/fft.py, signal.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def _t(x, sg=True):
+    return paddle.to_tensor(np.asarray(x), stop_gradient=sg)
+
+
+class TestFFT:
+    def test_fft_roundtrip_and_values(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 32)).astype(np.float32)
+        out = np.asarray(paddle.fft.fft(_t(x))._value)
+        np.testing.assert_allclose(out, np.fft.fft(x), rtol=1e-4, atol=1e-5)
+        back = np.asarray(paddle.fft.ifft(paddle.fft.fft(_t(x)))._value)
+        np.testing.assert_allclose(back.real, x, rtol=1e-4, atol=1e-5)
+
+    def test_rfft_onesided(self):
+        x = np.random.default_rng(1).standard_normal(64).astype(np.float32)
+        out = np.asarray(paddle.fft.rfft(_t(x))._value)
+        assert out.shape == (33,)
+        np.testing.assert_allclose(out, np.fft.rfft(x), rtol=1e-4, atol=1e-5)
+        rec = np.asarray(paddle.fft.irfft(paddle.fft.rfft(_t(x)))._value)
+        np.testing.assert_allclose(rec, x, rtol=1e-4, atol=1e-5)
+
+    def test_fft2_norms(self):
+        x = np.random.default_rng(2).standard_normal((8, 8)).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            out = np.asarray(paddle.fft.fft2(_t(x), norm=norm)._value)
+            np.testing.assert_allclose(out, np.fft.fft2(x, norm=norm),
+                                       rtol=1e-4, atol=1e-5)
+        with pytest.raises(ValueError, match="norm"):
+            paddle.fft.fft(_t(x), norm="bogus")
+
+    def test_fftshift_freq(self):
+        np.testing.assert_allclose(np.asarray(paddle.fft.fftfreq(8, d=0.5)._value),
+                                   np.fft.fftfreq(8, 0.5))
+        x = np.arange(8.0, dtype=np.float32)
+        np.testing.assert_allclose(np.asarray(paddle.fft.fftshift(_t(x))._value),
+                                   np.fft.fftshift(x))
+
+    def test_rfft_grad(self):
+        x = _t(np.random.default_rng(3).standard_normal(16).astype(np.float32),
+               sg=False)
+        y = paddle.fft.rfft(x)
+        loss = paddle.sum(paddle.abs(y.real()) if hasattr(y, "real") else
+                          paddle.abs(y))
+        loss.backward()
+        assert x.grad is not None
+        assert np.isfinite(np.asarray(x.grad._value)).all()
+
+
+class TestSignal:
+    def test_frame_overlap_add_inverse(self):
+        x = np.arange(32.0, dtype=np.float32)
+        f = paddle.signal.frame(_t(x), frame_length=8, hop_length=8)
+        assert tuple(f.shape) == (8, 4)
+        back = paddle.signal.overlap_add(f, hop_length=8)
+        np.testing.assert_allclose(np.asarray(back._value), x)
+
+    def test_stft_matches_torch(self):
+        import torch
+
+        rng = np.random.default_rng(4)
+        x = rng.standard_normal((2, 256)).astype(np.float32)
+        win = np.hanning(64).astype(np.float32)
+        ours = np.asarray(paddle.signal.stft(
+            _t(x), n_fft=64, hop_length=16, window=_t(win))._value)
+        ref = torch.stft(torch.tensor(x), n_fft=64, hop_length=16,
+                         window=torch.tensor(win), center=True,
+                         pad_mode="reflect", return_complex=True).numpy()
+        np.testing.assert_allclose(ours, ref, rtol=1e-3, atol=1e-4)
+
+    def test_istft_roundtrip(self):
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((1, 400)).astype(np.float32)
+        win = np.hanning(100).astype(np.float32)
+        spec = paddle.signal.stft(_t(x), n_fft=100, hop_length=25, window=_t(win))
+        rec = paddle.signal.istft(spec, n_fft=100, hop_length=25, window=_t(win),
+                                  length=400)
+        np.testing.assert_allclose(np.asarray(rec._value), x, rtol=1e-3,
+                                   atol=1e-4)

@@ -1,0 +1,129 @@
+"""vision.transforms breadth (ref python/paddle/vision/transforms/)."""
+import numpy as np
+import pytest
+
+from paddle_tpu.vision import transforms as T
+
+
+def _img(h=16, w=16):
+    rng = np.random.default_rng(0)
+    return (rng.random((3, h, w)) * 255).astype(np.float32)
+
+
+def test_color_adjustments_identity():
+    img = _img()
+    np.testing.assert_allclose(T.adjust_brightness(img, 1.0), img)
+    np.testing.assert_allclose(T.adjust_contrast(img, 1.0), img, rtol=1e-5)
+    np.testing.assert_allclose(T.adjust_saturation(img, 1.0), img, rtol=1e-5)
+    np.testing.assert_allclose(T.adjust_hue(img, 0.0), img, rtol=1e-3, atol=0.5)
+
+
+def test_adjust_semantics():
+    img = _img()
+    assert T.adjust_brightness(img, 0.5).mean() < img.mean()
+    lo = T.adjust_contrast(img, 0.0)
+    np.testing.assert_allclose(lo, lo.mean(), rtol=1e-4)   # constant
+    gray = T.adjust_saturation(img, 0.0)
+    np.testing.assert_allclose(gray[0], gray[1], rtol=1e-5)  # channels equal
+
+
+def test_hue_rotation_roundtrip():
+    img = _img() / 255.0
+    shifted = T.adjust_hue(img, 0.25)
+    back = T.adjust_hue(shifted, -0.25)
+    np.testing.assert_allclose(back, img, rtol=1e-3, atol=1e-3)
+
+
+def test_grayscale():
+    img = _img()
+    g1 = T.to_grayscale(img, 1)
+    assert g1.shape == (1, 16, 16)
+    g3 = T.Grayscale(3)(img)
+    assert g3.shape == (3, 16, 16)
+    np.testing.assert_allclose(g3[0], g3[1])
+
+
+def test_pad_and_crop():
+    img = _img(8, 8)
+    p = T.Pad(2)(img)
+    assert p.shape == (3, 12, 12)
+    assert p[:, 0, 0].sum() == 0
+    c = T.crop(img, 2, 3, 4, 5)
+    assert c.shape == (3, 4, 5)
+    np.testing.assert_allclose(c, img[:, 2:6, 3:8])
+
+
+def test_vflip_and_random_vflip():
+    img = _img(4, 4)
+    np.testing.assert_allclose(T.vflip(img), img[:, ::-1, :])
+    out = T.RandomVerticalFlip(prob=1.0)(img)
+    np.testing.assert_allclose(out, img[:, ::-1, :])
+
+
+def test_rotate_90_nearest():
+    img = np.zeros((1, 5, 5), np.float32)
+    img[0, 0, 1] = 1.0       # a marker off-center
+    out = T.rotate(img, 90, interpolation="nearest")
+    assert out.shape == (1, 5, 5)
+    assert out.sum() == 1.0  # the marker moved, not duplicated/lost
+    assert out[0, 0, 1] != 1.0 or not np.allclose(out, img)
+
+
+def test_rotate_360_identity():
+    img = _img(9, 9)
+    out = T.rotate(img, 360, interpolation="bilinear")
+    np.testing.assert_allclose(out, img, rtol=1e-4, atol=1e-3)
+
+
+def test_random_erasing():
+    np.random.seed(0)
+    img = np.ones((3, 32, 32), np.float32)
+    out = T.RandomErasing(prob=1.0, value=0)(img)
+    assert (out == 0).any() and (out == 1).any()
+
+
+def test_color_jitter_runs():
+    np.random.seed(1)
+    img = _img()
+    out = T.ColorJitter(0.4, 0.4, 0.4, 0.1)(img)
+    assert out.shape == img.shape and np.isfinite(out).all()
+
+
+def test_compose_pipeline():
+    np.random.seed(2)
+    pipeline = T.Compose([
+        T.Resize(20), T.RandomCrop(16), T.RandomHorizontalFlip(),
+        T.ColorJitter(0.2, 0.2, 0.2, 0.1), T.Grayscale(3),
+        T.Normalize(mean=[127.5] * 3, std=[127.5] * 3),
+    ])
+    out = pipeline(_img(24, 24))
+    assert out.shape == (3, 16, 16) and np.isfinite(out).all()
+
+
+def test_rotate_expand_canvas():
+    img = np.ones((1, 10, 20), np.float32)
+    out = T.rotate(img, 90, interpolation="bilinear", expand=True)
+    assert out.shape == (1, 20, 10)   # canvas grew to fit
+    assert out.mean() > 0.95          # nearly all content preserved
+    rr = T.RandomRotation((90, 90), expand=True)(img)
+    assert rr.shape == (1, 20, 10)
+
+
+def test_erase_per_channel_value():
+    img = np.zeros((3, 8, 8), np.float32)
+    out = T.erase(img, 1, 1, 2, 2, [0.1, 0.2, 0.3])
+    np.testing.assert_allclose(out[:, 1, 1], [0.1, 0.2, 0.3], rtol=1e-6)
+
+
+def test_hue_preserves_alpha():
+    img = np.concatenate([_img(), np.full((1, 16, 16), 0.5, np.float32)])
+    out = T.adjust_hue(img, 0.2)
+    assert out.shape == (4, 16, 16)
+    np.testing.assert_allclose(out[3], 0.5)
+
+
+def test_grayscale_2d_input():
+    img = np.random.default_rng(0).random((8, 8)).astype(np.float32)
+    out = T.to_grayscale(img, 3)
+    assert out.shape == (3, 8, 8)
+    np.testing.assert_allclose(out[0], img)

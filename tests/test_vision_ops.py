@@ -1,0 +1,83 @@
+"""paddle.vision.ops (ref python/paddle/vision/ops.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops
+
+
+def test_box_iou():
+    a = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32))
+    b = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+    iou = np.asarray(ops.box_iou(a, b)._value)
+    np.testing.assert_allclose(iou[0, 0], 1.0, rtol=1e-6)
+    np.testing.assert_allclose(iou[1, 0], 25.0 / 175.0, rtol=1e-5)
+
+
+def test_nms_suppresses_overlaps():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [50, 50, 60, 60]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+    keep = np.asarray(ops.nms(boxes, iou_threshold=0.5, scores=scores)._value)
+    assert list(keep) == [0, 2]   # box 1 suppressed by box 0
+
+
+def test_nms_categories_and_topk():
+    boxes = paddle.to_tensor(np.array(
+        [[0, 0, 10, 10], [1, 1, 11, 11], [0, 0, 10, 10]], np.float32))
+    scores = paddle.to_tensor(np.array([0.9, 0.8, 0.95], np.float32))
+    cats = paddle.to_tensor(np.array([0, 0, 1], np.int64))
+    keep = np.asarray(ops.nms(boxes, 0.5, scores, category_idxs=cats,
+                              categories=[0, 1])._value)
+    # per-category: cat0 keeps box0 (suppresses 1), cat1 keeps box2
+    assert sorted(keep.tolist()) == [0, 2]
+    keep1 = np.asarray(ops.nms(boxes, 0.5, scores, category_idxs=cats,
+                               categories=[0, 1], top_k=1)._value)
+    assert keep1.tolist() == [2]  # highest score overall
+
+
+def test_roi_align_constant_field():
+    """On a constant feature map every aligned ROI bin equals the constant."""
+    feat = paddle.to_tensor(np.full((1, 3, 16, 16), 7.0, np.float32))
+    rois = paddle.to_tensor(np.array([[2.0, 2.0, 10.0, 10.0]], np.float32))
+    out = ops.roi_align(feat, rois, np.array([1]), output_size=4,
+                        spatial_scale=1.0)
+    assert tuple(out.shape) == (1, 3, 4, 4)
+    np.testing.assert_allclose(np.asarray(out._value), 7.0, rtol=1e-5)
+
+
+def test_roi_align_gradient_flows():
+    x = paddle.to_tensor(np.random.default_rng(0).standard_normal(
+        (1, 2, 8, 8)).astype(np.float32), stop_gradient=False)
+    rois = paddle.to_tensor(np.array([[1.0, 1.0, 6.0, 6.0]], np.float32))
+    out = ops.roi_align(x, rois, np.array([1]), output_size=2)
+    paddle.sum(out).backward()
+    g = np.asarray(x.grad._value)
+    assert g.shape == (1, 2, 8, 8) and np.abs(g).sum() > 0
+
+
+def test_roi_pool_max_semantics():
+    feat = np.zeros((1, 1, 8, 8), np.float32)
+    feat[0, 0, 2, 2] = 5.0
+    out = ops.roi_pool(paddle.to_tensor(feat),
+                       paddle.to_tensor(np.array([[0.0, 0.0, 4.0, 4.0]],
+                                                 np.float32)),
+                       np.array([1]), output_size=1)
+    assert float(np.asarray(out._value).max()) > 1.0  # the peak is visible
+
+
+def test_yolo_box_shapes_and_range():
+    rng = np.random.default_rng(1)
+    N, A, C, H, W = 2, 3, 5, 4, 4
+    x = paddle.to_tensor(rng.standard_normal((N, A * (5 + C), H, W))
+                         .astype(np.float32))
+    img_size = paddle.to_tensor(np.array([[64, 64], [32, 48]], np.int32))
+    boxes, scores = ops.yolo_box(x, img_size, anchors=[10, 13, 16, 30, 33, 23],
+                                 class_num=C, conf_thresh=0.0,
+                                 downsample_ratio=8)
+    assert tuple(boxes.shape) == (N, A * H * W, 4)
+    assert tuple(scores.shape) == (N, A * H * W, C)
+    b = np.asarray(boxes._value)
+    assert b[0].min() >= 0 and b[0].max() <= 63  # clipped to image 0
+    s = np.asarray(scores._value)
+    assert (s >= 0).all() and (s <= 1).all()
