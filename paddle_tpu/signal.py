@@ -30,6 +30,12 @@ def frame(x, frame_length, hop_length, axis=-1, name=None):
     returns [..., frame_length, num_frames]."""
     if hop_length <= 0:
         raise ValueError("hop_length must be positive")
+    sig_len = (x.shape[axis] if hasattr(x, "shape") else
+               __import__("numpy").asarray(x).shape[axis])
+    if frame_length > sig_len:
+        raise ValueError(
+            f"frame_length ({frame_length}) exceeds the signal length "
+            f"({sig_len}) along the framed axis")
     return apply_op(lambda v: _frame(v, frame_length, hop_length, axis),
                     (x,), name="frame")
 
@@ -45,9 +51,20 @@ def _overlap_add(v, hop_length):
 
 
 def overlap_add(x, hop_length, axis=-1, name=None):
-    """Ref signal.py overlap_add — inverse of frame."""
-    return apply_op(lambda v: _overlap_add(v, hop_length), (x,),
-                    name="overlap_add")
+    """Ref signal.py overlap_add — inverse of frame.  axis=-1 takes
+    [..., frame_length, n_frames]; axis=0 takes [n_frames, frame_length, ...]
+    (the two layouts paddle supports)."""
+    if axis in (-1, getattr(x, "ndim", None) and x.ndim - 1):
+        return apply_op(lambda v: _overlap_add(v, hop_length), (x,),
+                        name="overlap_add")
+    if axis == 0:
+        def _f(v):
+            # [n_frames, frame_length, ...] -> [..., frame_length, n_frames]
+            moved = jnp.moveaxis(jnp.moveaxis(v, 0, -1), 0, -2)
+            return _overlap_add(moved, hop_length)
+
+        return apply_op(_f, (x,), name="overlap_add")
+    raise NotImplementedError("overlap_add: axis must be -1 or 0")
 
 
 def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
@@ -61,12 +78,13 @@ def stft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
             pad = n_fft // 2
             v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(pad, pad)], mode=pad_mode)
         frames = _frame(v, n_fft, hop_length)          # [..., n_fft, F]
-        if w is not None:
-            win = w
-            if win_length < n_fft:                      # center-pad the window
-                lp = (n_fft - win_length) // 2
-                win = jnp.pad(win, (lp, n_fft - win_length - lp))
-            frames = frames * win[:, None]
+        # no window given: paddle uses a RECTANGULAR window of win_length
+        # zero-padded to n_fft (win_length < n_fft must not be a no-op)
+        win = w if w is not None else jnp.ones((win_length,), frames.dtype)
+        if win.shape[0] < n_fft:                        # center-pad the window
+            lp = (n_fft - win.shape[0]) // 2
+            win = jnp.pad(win, (lp, n_fft - win.shape[0] - lp))
+        frames = frames * win[:, None]
         spec = (jnp.fft.rfft(frames, axis=-2) if onesided
                 else jnp.fft.fft(frames, axis=-2))
         if normalized:
@@ -93,13 +111,10 @@ def istft(x, n_fft, hop_length=None, win_length=None, window=None, center=True,
             frames = jnp.fft.ifft(v, axis=-2)
             if not return_complex:
                 frames = frames.real
-        if w is not None:
-            win = w
-            if win_length < n_fft:
-                lp = (n_fft - win_length) // 2
-                win = jnp.pad(win, (lp, n_fft - win_length - lp))
-        else:
-            win = jnp.ones((n_fft,), jnp.float32)
+        win = w if w is not None else jnp.ones((win_length,), jnp.float32)
+        if win.shape[0] < n_fft:
+            lp = (n_fft - win.shape[0]) // 2
+            win = jnp.pad(win, (lp, n_fft - win.shape[0] - lp))
         sig = _overlap_add(frames * win[:, None], hop_length)
         # window envelope normalization (the least-squares denominator)
         env = _overlap_add(jnp.broadcast_to((win * win)[:, None],

@@ -15,7 +15,8 @@ import jax.numpy as jnp
 
 from ..tensor.tensor import Tensor, apply_op, _unwrap
 
-__all__ = ["nms", "roi_align", "roi_pool", "yolo_box", "box_iou"]
+__all__ = ["nms", "roi_align", "roi_pool", "yolo_box", "box_iou",
+           "deform_conv2d"]
 
 
 def box_iou(boxes1, boxes2):
@@ -46,30 +47,25 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None, categories=No
     cats = (np.asarray(_unwrap(category_idxs)) if category_idxs is not None
             else np.zeros((n,), np.int64))
 
+    areas = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
     keep_all = []
     for c in np.unique(cats):
         idx = np.nonzero(cats == c)[0]
         order = idx[np.argsort(-s[idx])]
-        kept = []
         suppressed = np.zeros(len(order), bool)
         for i in range(len(order)):
             if suppressed[i]:
                 continue
-            kept.append(order[i])
+            keep_all.append(order[i])
             bi = b[order[i]]
-            for j in range(i + 1, len(order)):
-                if suppressed[j]:
-                    continue
-                bj = b[order[j]]
-                lt = np.maximum(bi[:2], bj[:2])
-                rb = np.minimum(bi[2:], bj[2:])
-                wh = np.clip(rb - lt, 0, None)
-                inter = wh[0] * wh[1]
-                a1 = (bi[2] - bi[0]) * (bi[3] - bi[1])
-                a2 = (bj[2] - bj[0]) * (bj[3] - bj[1])
-                if inter / (a1 + a2 - inter + 1e-10) > iou_threshold:
-                    suppressed[j] = True
-        keep_all += kept
+            rest = order[i + 1:]
+            # vectorized IoU of the kept box vs all remaining candidates
+            lt = np.maximum(bi[:2], b[rest, :2])
+            rb = np.minimum(bi[2:], b[rest, 2:])
+            wh = np.clip(rb - lt, 0, None)
+            inter = wh[:, 0] * wh[:, 1]
+            iou = inter / (areas[order[i]] + areas[rest] - inter + 1e-10)
+            suppressed[i + 1:] |= iou > iou_threshold
     keep_all = sorted(keep_all, key=lambda i: -s[i])
     if top_k is not None:
         keep_all = keep_all[:top_k]
@@ -166,7 +162,12 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
 
     def _f(pred, imgs):
         N, _, H, W = pred.shape
-        p = pred.reshape(N, A, 5 + class_num, H, W)
+        if iou_aware:
+            # layout (ref yolo_box_op): first A iou channels, then A*(5+C)
+            iou_pred = pred[:, :A]
+            p = pred[:, A:].reshape(N, A, 5 + class_num, H, W)
+        else:
+            p = pred.reshape(N, A, 5 + class_num, H, W)
         gx = jnp.arange(W, dtype=jnp.float32)
         gy = jnp.arange(H, dtype=jnp.float32)
         cx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
@@ -177,6 +178,9 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
         bw = jnp.exp(p[:, :, 2]) * anchors[None, :, 0, None, None] / in_w
         bh = jnp.exp(p[:, :, 3]) * anchors[None, :, 1, None, None] / in_h
         obj = jax.nn.sigmoid(p[:, :, 4])
+        if iou_aware:
+            iou_q = jax.nn.sigmoid(iou_pred)
+            obj = obj ** (1.0 - iou_aware_factor) * iou_q ** iou_aware_factor
         cls = jax.nn.sigmoid(p[:, :, 5:])
         score = obj[:, :, None] * cls
         score = jnp.where(score >= conf_thresh, score, 0.0)
@@ -197,3 +201,96 @@ def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
         return boxes, scores
 
     return apply_op(_f, (x, img_size), name="yolo_box")
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
+                  deformable_groups=1, groups=1, mask=None, name=None):
+    """Ref ops.py:431 — deformable convolution v1/v2 (v2 when `mask` given).
+
+    Implemented as offset-shifted bilinear sampling (im2col with learned
+    offsets) + a dense matmul on the MXU: for each output position and kernel
+    tap, sample x at (base + offset), multiply by the modulation mask (v2),
+    then contract with the weights — the gather-heavy half runs on the VPU,
+    the contraction on the MXU.
+
+    x: [N, Cin, H, W]; offset: [N, 2*dg*kh*kw, Hout, Wout];
+    weight: [Cout, Cin//groups, kh, kw]; mask: [N, dg*kh*kw, Hout, Wout].
+    """
+    stride = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    padding = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dilation = (dilation, dilation) if isinstance(dilation, int) else tuple(dilation)
+
+    def _f(xv, off, w, *rest):
+        m = rest[0] if mask is not None else None
+        b = rest[-1] if bias is not None else None
+        N, Cin, H, W = xv.shape
+        Cout, Cin_g, kh, kw = w.shape
+        Hout, Wout = off.shape[2], off.shape[3]
+        dg = deformable_groups
+        ch_per_dg = Cin // dg
+
+        # base sampling grid per output position and tap
+        oy = jnp.arange(Hout) * stride[0] - padding[0]
+        ox = jnp.arange(Wout) * stride[1] - padding[1]
+        ky = jnp.arange(kh) * dilation[0]
+        kx = jnp.arange(kw) * dilation[1]
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]   # [Hout,1,kh,1]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]   # [1,Wout,1,kw]
+
+        off = off.reshape(N, dg, kh * kw, 2, Hout, Wout)
+        dy = jnp.moveaxis(off[:, :, :, 0], 2, -1).reshape(N, dg, Hout, Wout, kh, kw)
+        dx = jnp.moveaxis(off[:, :, :, 1], 2, -1).reshape(N, dg, Hout, Wout, kh, kw)
+        sy = base_y[None, None] + dy                                  # [N,dg,Hout,Wout,kh,kw]
+        sx = base_x[None, None] + dx
+
+        def sample_plane(plane, yy, xxc):
+            # bilinear with zero padding outside
+            y0 = jnp.floor(yy)
+            x0 = jnp.floor(xxc)
+            fy, fx = yy - y0, xxc - x0
+            out = 0.0
+            for ddy, wy in ((0, 1 - fy), (1, fy)):
+                for ddx, wx in ((0, 1 - fx), (1, fx)):
+                    yi = (y0 + ddy).astype(jnp.int32)
+                    xi = (x0 + ddx).astype(jnp.int32)
+                    valid = (yi >= 0) & (yi < H) & (xi >= 0) & (xi < W)
+                    v = plane[jnp.clip(yi, 0, H - 1), jnp.clip(xi, 0, W - 1)]
+                    out = out + jnp.where(valid, v, 0.0) * wy * wx
+            return out
+
+        # vmap: batch over N, then channels within each deformable group
+        def per_image(img, syi, sxi, mi):
+            cols = []
+            for g in range(dg):
+                ch = img[g * ch_per_dg:(g + 1) * ch_per_dg]
+                samp = jax.vmap(lambda p: sample_plane(p, syi[g], sxi[g]))(ch)
+                if mi is not None:
+                    samp = samp * mi[g][None]
+                cols.append(samp)                 # [ch_per_dg, Hout, Wout, kh, kw]
+            return jnp.concatenate(cols, 0)       # [Cin, Hout, Wout, kh, kw]
+
+        if m is not None:
+            m = jnp.moveaxis(m.reshape(N, dg, kh * kw, Hout, Wout), 2, -1) \
+                .reshape(N, dg, Hout, Wout, kh, kw)
+        if m is not None:
+            cols = jax.vmap(per_image)(xv, sy, sx, m)
+        else:
+            cols = jax.vmap(lambda img, syi, sxi: per_image(img, syi, sxi, None))(
+                xv, sy, sx)
+
+        # contract: out[n, co, ho, wo] = sum_{ci, kh, kw} w * cols
+        wg = w.reshape(groups, Cout // groups, Cin_g, kh, kw)
+        colsg = cols.reshape(N, groups, Cin // groups, Hout, Wout, kh, kw)
+        out = jnp.einsum("ngihwkl,goikl->ngohw", colsg, wg,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(N, Cout, Hout, Wout).astype(xv.dtype)
+        if b is not None:
+            out = out + b[None, :, None, None]
+        return out
+
+    extra = []
+    if mask is not None:
+        extra.append(mask)
+    if bias is not None:
+        extra.append(bias)
+    return apply_op(_f, (x, offset, weight, *extra), name="deform_conv2d")

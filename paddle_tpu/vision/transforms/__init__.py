@@ -214,7 +214,14 @@ def adjust_brightness(img, brightness_factor):
 
 def adjust_contrast(img, contrast_factor):
     arr = _as_float(img)
-    mean = arr.mean()
+    c, _, _ = _axes(arr)
+    if c is not None and arr.shape[c] >= 3:
+        # paddle blends toward the mean of the GRAYSCALE image, not the raw mean
+        w = np.asarray([0.299, 0.587, 0.114], np.float32)
+        chw = np.moveaxis(arr, c, 0)
+        mean = float((chw[:3] * w[:, None, None]).sum(0).mean())
+    else:
+        mean = arr.mean()
     return (arr - mean) * float(contrast_factor) + mean
 
 
@@ -388,56 +395,70 @@ def erase(img, i, j, h, w, v, inplace=False):
     return arr
 
 
+def _jitter_range(value, name):
+    """paddle accepts a non-negative float (-> [max(0,1-v), 1+v]) or an
+    explicit (min, max) pair."""
+    if isinstance(value, (list, tuple)):
+        lo, hi = float(value[0]), float(value[1])
+        if lo > hi or lo < 0:
+            raise ValueError(f"{name} range must satisfy 0 <= min <= max")
+        return lo, hi
+    value = float(value)
+    if value < 0:
+        raise ValueError(f"{name} value must be non-negative")
+    return max(0.0, 1.0 - value), 1.0 + value
+
+
 class BrightnessTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        self.value = float(value)
+        self.range = _jitter_range(value, "brightness")
 
     def _apply_image(self, img):
-        if self.value == 0:
+        if self.range == (1.0, 1.0):
             return _as_float(img)
-        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return adjust_brightness(img, f)
+        return adjust_brightness(img, np.random.uniform(*self.range))
 
 
 class ContrastTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        if value < 0:
-            raise ValueError("contrast value must be non-negative")
-        self.value = float(value)
+        self.range = _jitter_range(value, "contrast")
 
     def _apply_image(self, img):
-        if self.value == 0:
+        if self.range == (1.0, 1.0):
             return _as_float(img)
-        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return adjust_contrast(img, f)
+        return adjust_contrast(img, np.random.uniform(*self.range))
 
 
 class SaturationTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        self.value = float(value)
+        self.range = _jitter_range(value, "saturation")
 
     def _apply_image(self, img):
-        if self.value == 0:
+        if self.range == (1.0, 1.0):
             return _as_float(img)
-        f = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
-        return adjust_saturation(img, f)
+        return adjust_saturation(img, np.random.uniform(*self.range))
 
 
 class HueTransform(BaseTransform):
     def __init__(self, value, keys=None):
         super().__init__(keys)
-        if not 0 <= value <= 0.5:
-            raise ValueError("hue value must be in [0, 0.5]")
-        self.value = float(value)
+        if isinstance(value, (list, tuple)):
+            lo, hi = float(value[0]), float(value[1])
+            if not (-0.5 <= lo <= hi <= 0.5):
+                raise ValueError("hue range must be within [-0.5, 0.5]")
+            self.range = (lo, hi)
+        else:
+            if not 0 <= value <= 0.5:
+                raise ValueError("hue value must be in [0, 0.5]")
+            self.range = (-float(value), float(value))
 
     def _apply_image(self, img):
-        if self.value == 0:
+        if self.range == (0.0, 0.0):
             return _as_float(img)
-        f = np.random.uniform(-self.value, self.value)
-        return adjust_hue(img, f)
+        return adjust_hue(img, np.random.uniform(*self.range))
 
 
 class ColorJitter(BaseTransform):

@@ -43,6 +43,9 @@ class In:
             a = (rng.random(s) * (hi - lo) + lo).astype(np.float32)
         elif self.kind == "int":
             a = rng.integers(self.low or 0, self.high or 10, s).astype(self.dtype or np.int32)
+        elif self.kind == "wellcond":   # well-conditioned matrix (diag-dominant)
+            a = (rng.standard_normal(s) * 0.3).astype(np.float32)
+            a = a + 2.0 * np.eye(s[-2], s[-1], dtype=np.float32)
         elif self.kind == "bool":
             a = rng.random(s) > 0.5
         else:

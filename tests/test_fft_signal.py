@@ -84,3 +84,25 @@ class TestSignal:
                                   length=400)
         np.testing.assert_allclose(np.asarray(rec._value), x, rtol=1e-3,
                                    atol=1e-4)
+
+
+class TestSignalEdgeCases:
+    def test_win_length_rectangular_default(self):
+        """win_length < n_fft without an explicit window must NOT equal the
+        full-frame transform (paddle zero-pads a rectangular window)."""
+        x = _t(np.random.default_rng(6).standard_normal(128).astype(np.float32))
+        full = np.asarray(paddle.signal.stft(x, n_fft=16, hop_length=8)._value)
+        short = np.asarray(paddle.signal.stft(x, n_fft=16, hop_length=8,
+                                              win_length=8)._value)
+        assert not np.allclose(full, short)
+
+    def test_overlap_add_axis0(self):
+        frames = np.random.default_rng(7).standard_normal((7, 8)).astype(np.float32)
+        out0 = np.asarray(paddle.signal.overlap_add(_t(frames), 4, axis=0)._value)
+        assert out0.shape == ((7 - 1) * 4 + 8,)
+        ref = np.asarray(paddle.signal.overlap_add(_t(frames.T), 4)._value)
+        np.testing.assert_allclose(out0, ref, rtol=1e-6)
+
+    def test_frame_too_long_raises(self):
+        with pytest.raises(ValueError, match="exceeds the signal length"):
+            paddle.signal.frame(_t(np.zeros(10, np.float32)), 16, 4)

@@ -338,7 +338,76 @@ def _specs():
     add("increment", paddle.increment, [In(1)])
     add("as_complex_real", lambda x: paddle.as_real(paddle.as_complex(x)),
         [In(3, 4, 2)], bf16=False, grad=False)
+
+    # ------------------------------------------------------------- linalg extras
+    add("det", paddle.linalg.det, [In(3, 3)], bf16=False)
+    add("slogdet_logdet", lambda x: paddle.linalg.slogdet(x)[1], [In(3, 3)],
+        bf16=False)
+    add("inv", paddle.linalg.inv, [In(3, 3, kind="wellcond")], bf16=False,
+        grad_rtol=5e-2)
+    add("pinv", paddle.linalg.pinv, [In(4, 3, kind="wellcond")], bf16=False,
+        grad_rtol=5e-2)
+    add("solve", paddle.linalg.solve,
+        [In(3, 3, kind="wellcond"), In(3, 2)], bf16=False, grad_rtol=5e-2)
+    add("triangular_solve",
+        lambda a, b: paddle.linalg.triangular_solve(paddle.tril(a) +
+                                                    3.0 * paddle.eye(4), b,
+                                                    upper=False),
+        [In(4, 4), In(4, 2)], bf16=False, grad_rtol=5e-2)
+    add("matrix_power", lambda x: paddle.linalg.matrix_power(x, 3),
+        [In(3, 3, kind="unit")], bf16=False, grad_rtol=5e-2)
+    add("svd_vals", lambda x: paddle.linalg.svd(x)[1], [In(4, 3)], bf16=False,
+        grad=False)
+    add("qr_r", lambda x: paddle.linalg.qr(x)[1], [In(4, 3)], bf16=False,
+        grad=False)
+    add("eigvalsh", lambda x: paddle.linalg.eigvalsh(x + x.T + 4.0 * paddle.eye(3)),
+        [In(3, 3)], bf16=False, grad=False)
+    add("matrix_rank", paddle.linalg.matrix_rank, [In(4, 3)], grad=False,
+        bf16=False)
+    add("multi_dot", lambda a, b, c: paddle.linalg.multi_dot([a, b, c]),
+        [In(3, 4), In(4, 5), In(5, 2)], bf16=False)
+    add("cond_fro", lambda x: paddle.linalg.cond(x + 3.0 * paddle.eye(3), p="fro"),
+        [In(3, 3)], bf16=False, grad=False)
+    add("cov", paddle.linalg.cov, [In(3, 8)], bf16=False)
+    add("corrcoef", paddle.linalg.corrcoef, [In(3, 8)], bf16=False, grad=False)
+
+    # ----------------------------------------------------------------- fft ops
+    add("fft_abs", lambda x: paddle.abs(paddle.fft.fft(x)), [In(4, 16)],
+        bf16=False)
+    add("rfft_abs", lambda x: paddle.abs(paddle.fft.rfft(x)), [In(4, 16)],
+        bf16=False)
+    add("irfft_of_rfft", lambda x: paddle.fft.irfft(paddle.fft.rfft(x)),
+        [In(4, 16)], bf16=False)
+    add("fft2_abs", lambda x: paddle.abs(paddle.fft.fft2(x)), [In(6, 8)],
+        bf16=False)
+    add("fftshift", paddle.fft.fftshift, [In(8,)], bf16=False)
+
+    # ------------------------------------------------------------- signal ops
+    add("frame_op", lambda x: paddle.signal.frame(x, 8, 4), [In(2, 32)],
+        bf16=False)
+    add("overlap_add_op", lambda x: paddle.signal.overlap_add(x, 4),
+        [In(2, 8, 7)], bf16=False)
+    add("stft_power",
+        lambda x: paddle.abs(paddle.signal.stft(x, n_fft=16, hop_length=8)) ** 2,
+        [In(2, 64)], bf16=False, grad_rtol=3e-2)
+
+    # ------------------------------------------------------------- ctc + misc
+    add("ctc_loss",
+        lambda lp: F.ctc_loss(F.log_softmax(lp, axis=-1),
+                              paddle.to_tensor(np.array([[1, 2, 1], [2, 1, 1]],
+                                                        np.int64)),
+                              np.array([8, 8], np.int64),
+                              np.array([3, 2], np.int64), reduction="sum"),
+        [In(8, 2, 5)], bf16=False, grad_rtol=5e-2)
+    add("box_iou", __import__("paddle_tpu.vision.ops", fromlist=["box_iou"]).box_iou,
+        [In(4, 4, kind="pos"), In(3, 4, kind="pos")], bf16=False, grad=False)
+    if hasattr(paddle, "erfinv"):
+        add("erfinv", paddle.erfinv, [In(2, 3, kind="unit", low=-0.9, high=0.9)])
+    if hasattr(paddle, "polygamma"):
+        add("polygamma1", lambda x: paddle.polygamma(x, 1),
+            [In(2, 3, kind="pos", low=0.5, high=3.0)])
     return S
+
 
 
 SPECS = _specs()

@@ -127,3 +127,21 @@ def test_grayscale_2d_input():
     out = T.to_grayscale(img, 3)
     assert out.shape == (3, 8, 8)
     np.testing.assert_allclose(out[0], img)
+
+
+def test_jitter_tuple_ranges_and_validation():
+    np.random.seed(3)
+    img = _img()
+    out = T.ColorJitter(brightness=(0.5, 1.5), hue=(-0.1, 0.1))(img)
+    assert np.isfinite(out).all()
+    with pytest.raises(ValueError):
+        T.BrightnessTransform(-0.5)
+    with pytest.raises(ValueError):
+        T.HueTransform(0.9)
+
+
+def test_contrast_uses_grayscale_mean():
+    img = np.zeros((3, 4, 4), np.float32)
+    img[0] = 1.0   # pure red
+    lo = T.adjust_contrast(img, 0.0)
+    np.testing.assert_allclose(lo, 0.299, rtol=1e-5)  # not the raw mean 1/3

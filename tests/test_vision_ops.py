@@ -81,3 +81,63 @@ def test_yolo_box_shapes_and_range():
     assert b[0].min() >= 0 and b[0].max() <= 63  # clipped to image 0
     s = np.asarray(scores._value)
     assert (s >= 0).all() and (s <= 1).all()
+
+
+def test_deform_conv2d_zero_offsets_match_conv():
+    """With zero offsets (and no mask) deformable conv == ordinary conv."""
+    import paddle_tpu.nn.functional as F
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 4, 8, 8)).astype(np.float32)
+    w = rng.standard_normal((6, 4, 3, 3)).astype(np.float32)
+    off = np.zeros((2, 2 * 9, 6, 6), np.float32)
+    out = ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                            paddle.to_tensor(w))
+    ref = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w))
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref._value),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_deform_conv2d_mask_and_grad():
+    rng = np.random.default_rng(1)
+    x = paddle.to_tensor(rng.standard_normal((1, 2, 6, 6)).astype(np.float32),
+                         stop_gradient=False)
+    w = paddle.to_tensor(rng.standard_normal((3, 2, 3, 3)).astype(np.float32),
+                         stop_gradient=False)
+    off = paddle.to_tensor(
+        0.1 * rng.standard_normal((1, 18, 4, 4)).astype(np.float32),
+        stop_gradient=False)
+    mask = paddle.to_tensor(rng.random((1, 9, 4, 4)).astype(np.float32))
+    out = ops.deform_conv2d(x, off, w, mask=mask)
+    assert tuple(out.shape) == (1, 3, 4, 4)
+    paddle.sum(out).backward()
+    for t in (x, w, off):
+        assert t.grad is not None and np.isfinite(np.asarray(t.grad._value)).all()
+
+
+def test_deform_conv2d_half_pixel_shift():
+    """A 0.5-pixel x offset on a linear ramp shifts samples by half a step."""
+    x = np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6)
+    w = np.ones((1, 1, 1, 1), np.float32)
+    off = np.zeros((1, 2, 6, 6), np.float32)
+    off[0, 1] = 0.5  # dx
+    out = np.asarray(ops.deform_conv2d(paddle.to_tensor(x),
+                                       paddle.to_tensor(off),
+                                       paddle.to_tensor(w))._value)
+    ref = x[0, 0] + 0.5
+    np.testing.assert_allclose(out[0, 0, :, :-1], ref[:, :-1], rtol=1e-5)
+
+
+def test_yolo_box_iou_aware():
+    rng = np.random.default_rng(2)
+    N, A, C, H, W = 1, 3, 2, 4, 4
+    x = paddle.to_tensor(rng.standard_normal(
+        (N, A * (6 + C), H, W)).astype(np.float32))   # +A iou channels
+    img_size = paddle.to_tensor(np.array([[32, 32]], np.int32))
+    boxes, scores = ops.yolo_box(x, img_size, anchors=[10, 13, 16, 30, 33, 23],
+                                 class_num=C, conf_thresh=0.0,
+                                 downsample_ratio=8, iou_aware=True,
+                                 iou_aware_factor=0.5)
+    assert tuple(boxes.shape) == (N, A * H * W, 4)
+    s = np.asarray(scores._value)
+    assert (s >= 0).all() and (s <= 1).all()
