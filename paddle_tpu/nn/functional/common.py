@@ -304,3 +304,149 @@ def sequence_mask(x, maxlen=None, dtype="int64", name=None):
 
 def class_center_sample(label, num_classes, num_samples, group=None):
     raise NotImplementedError("class_center_sample pending")
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Ref nn/functional/vision.py affine_grid: theta [N,2,3] -> grid
+    [N,H,W,2] of (x,y) sampling locations in [-1,1]."""
+
+    if len(out_shape) != 4:
+        raise NotImplementedError(
+            "affine_grid: only 4-D NCHW out_shape (2x3 theta) is supported; "
+            "3-D volumetric warps (3x4 theta) are not implemented")
+
+    def _f(th):
+        N = th.shape[0]
+        H, W = int(out_shape[2]), int(out_shape[3])
+        if align_corners:
+            xs = jnp.linspace(-1.0, 1.0, W)
+            ys = jnp.linspace(-1.0, 1.0, H)
+        else:
+            xs = (jnp.arange(W) * 2 + 1) / W - 1.0
+            ys = (jnp.arange(H) * 2 + 1) / H - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)   # [H*W, 3]
+        out = jnp.einsum("nij,pj->npi", th.astype(jnp.float32), base)
+        return out.reshape(N, H, W, 2).astype(th.dtype)
+
+    return apply_op(_f, (theta,), name="affine_grid")
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Ref nn/functional/vision.py grid_sample: sample x [N,C,H,W] at grid
+    [N,Hg,Wg,2] of (x,y) in [-1,1].  Differentiable bilinear / nearest."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"unsupported grid_sample mode {mode!r}")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(f"unsupported padding_mode {padding_mode!r}")
+
+    def _unnormalize(coord, size):
+        if align_corners:
+            return (coord + 1.0) / 2.0 * (size - 1)
+        return ((coord + 1.0) * size - 1.0) / 2.0
+
+    def _reflect(c, size):
+        # align_corners=True reflects about the corner-pixel CENTERS
+        # (period 2*(size-1)); False reflects about the pixel EDGES
+        # (period 2*size, band [-0.5, size-0.5]) — torch/paddle semantics
+        if size == 1:
+            return jnp.zeros_like(c)
+        if align_corners:
+            span = float(size - 1)
+            c = jnp.abs(c) % (2.0 * span)
+            return jnp.where(c > span, 2.0 * span - c, c)
+        span = float(size)
+        c = jnp.abs(c + 0.5) % (2.0 * span)
+        c = jnp.where(c > span, 2.0 * span - c, c) - 0.5
+        return jnp.clip(c, 0.0, size - 1)
+
+    def _f(xv, gv):
+        N, C, H, W = xv.shape
+        gx = _unnormalize(gv[..., 0].astype(jnp.float32), W)
+        gy = _unnormalize(gv[..., 1].astype(jnp.float32), H)
+        if padding_mode == "reflection":
+            gx = _reflect(gx, W)
+            gy = _reflect(gy, H)
+
+        def gather(yy, xx, valid_mask):
+            yi = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            vals = jax.vmap(lambda img, yb, xb: img[:, yb, xb])(xv, yi, xi)
+            if padding_mode == "zeros":
+                vals = vals * valid_mask[:, None, :, :]
+            return vals  # [N, C, Hg, Wg]
+
+        if mode == "nearest":
+            yy = jnp.round(gy)
+            xx = jnp.round(gx)
+            valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0) & (xx <= W - 1)) \
+                .astype(xv.dtype)
+            return gather(yy, xx, valid).astype(xv.dtype)
+
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        fx = (gx - x0).astype(xv.dtype)
+        fy = (gy - y0).astype(xv.dtype)
+        out = 0.0
+        for dy, wy in ((0.0, 1 - fy), (1.0, fy)):
+            for dx, wx in ((0.0, 1 - fx), (1.0, fx)):
+                yy = y0 + dy
+                xx = x0 + dx
+                valid = ((yy >= 0) & (yy <= H - 1) & (xx >= 0)
+                         & (xx <= W - 1)).astype(xv.dtype)
+                out = out + gather(yy, xx, valid) * (wy * wx)[:, None]
+        return out.astype(xv.dtype)
+
+    return apply_op(_f, (x, grid), name="grid_sample")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    """Ref nn/functional/channel_shuffle — interleave channel groups."""
+
+    def _f(v):
+        if data_format == "NCHW":
+            n, c, h, w = v.shape
+            return v.reshape(n, groups, c // groups, h, w) \
+                .swapaxes(1, 2).reshape(n, c, h, w)
+        n, h, w, c = v.shape
+        return v.reshape(n, h, w, groups, c // groups) \
+            .swapaxes(3, 4).reshape(n, h, w, c)
+
+    return apply_op(_f, (x,), name="channel_shuffle")
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW", name=None):
+    """Ref nn/functional/temporal_shift (TSM): shift a fraction of channels
+    one step along the segment (time) axis."""
+
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+
+    def _f(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        nt, c, h, w = v.shape
+        n = nt // seg_num
+        v5 = v.reshape(n, seg_num, c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate([v5[:, 1:, :fold], jnp.zeros_like(v5[:, :1, :fold])], 1)
+        fwd = jnp.concatenate([jnp.zeros_like(v5[:, :1, fold:2 * fold]),
+                               v5[:, :-1, fold:2 * fold]], 1)
+        keep = v5[:, :, 2 * fold:]
+        out = jnp.concatenate([back, fwd, keep], axis=2).reshape(nt, c, h, w)
+        return jnp.moveaxis(out, 1, -1) if data_format == "NHWC" else out
+
+    return apply_op(_f, (x,), name="temporal_shift")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    """Ref nn/functional/distance.py pairwise_distance."""
+
+    def _f(a, b):
+        d = a - b + epsilon
+        return jnp.linalg.norm(d.astype(jnp.float32), ord=p, axis=-1,
+                               keepdims=keepdim).astype(a.dtype)
+
+    return apply_op(_f, (x, y), name="pairwise_distance")

@@ -6,12 +6,9 @@ from ... import nn
 
 
 def channel_shuffle(x, groups):
-    """Interleave channel groups (ref shufflenetv2.py:72) — a reshape/transpose
-    pair XLA fuses into the surrounding ops."""
-    n, c, h, w = x.shape
-    x = paddle.reshape(x, [n, groups, c // groups, h, w])
-    x = paddle.transpose(x, [0, 2, 1, 3, 4])
-    return paddle.reshape(x, [n, c, h, w])
+    """Interleave channel groups (ref shufflenetv2.py:72) — delegates to the
+    functional op so there is one implementation."""
+    return paddle.nn.functional.channel_shuffle(x, groups)
 
 
 def _act(act):

@@ -1,0 +1,83 @@
+"""grid_sample / affine_grid and friends vs torch oracle
+(ref nn/functional/vision.py, distance.py, temporal_shift)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+def _t(x, sg=True):
+    return paddle.to_tensor(np.asarray(x), stop_gradient=sg)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align", [True, False])
+def test_grid_sample_matches_torch(mode, pad, align):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 6, 7)).astype(np.float32)
+    grid = (rng.random((2, 4, 5, 2)).astype(np.float32) * 2.4 - 1.2)
+    ours = np.asarray(F.grid_sample(_t(x), _t(grid), mode=mode,
+                                    padding_mode=pad,
+                                    align_corners=align)._value)
+    ref = torch.nn.functional.grid_sample(
+        torch.tensor(x), torch.tensor(grid), mode=mode, padding_mode=pad,
+        align_corners=align).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_grid_sample_grad():
+    rng = np.random.default_rng(1)
+    x = _t(rng.standard_normal((1, 2, 5, 5)).astype(np.float32), sg=False)
+    grid = _t((rng.random((1, 3, 3, 2)).astype(np.float32) * 1.6 - 0.8), sg=False)
+    out = F.grid_sample(x, grid)
+    paddle.sum(out).backward()
+    assert x.grad is not None and grid.grad is not None
+    assert np.isfinite(np.asarray(grid.grad._value)).all()
+
+
+def test_affine_grid_identity_roundtrip():
+    """Identity theta: grid_sample(affine_grid(I)) == input."""
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32), (2, 1, 1))
+    grid = F.affine_grid(_t(theta), [2, 3, 8, 8], align_corners=True)
+    out = F.grid_sample(_t(x), grid, align_corners=True)
+    np.testing.assert_allclose(np.asarray(out._value), x, rtol=1e-4, atol=1e-4)
+
+
+def test_affine_grid_matches_torch():
+    theta = np.array([[[0.8, 0.2, 0.1], [-0.1, 0.9, -0.2]]], np.float32)
+    ours = np.asarray(F.affine_grid(_t(theta), [1, 3, 5, 6],
+                                    align_corners=False)._value)
+    ref = torch.nn.functional.affine_grid(torch.tensor(theta), [1, 3, 5, 6],
+                                          align_corners=False).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_channel_shuffle_f():
+    x = np.arange(2 * 8 * 2 * 2, dtype=np.float32).reshape(2, 8, 2, 2)
+    out = np.asarray(F.channel_shuffle(_t(x), 2)._value)
+    ref = torch.nn.functional.channel_shuffle(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(out, ref)
+
+
+def test_temporal_shift():
+    nt, c, h, w = 4, 8, 2, 2
+    x = np.random.default_rng(3).standard_normal((nt, c, h, w)).astype(np.float32)
+    out = np.asarray(F.temporal_shift(_t(x), seg_num=2, shift_ratio=0.25)._value)
+    assert out.shape == x.shape
+    # first quarter of channels shifted backward: segment 0 takes segment 1's data
+    np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[0, 0, :2],
+                               x.reshape(2, 2, c, h, w)[0, 1, :2])
+
+
+def test_pairwise_distance():
+    a = np.random.default_rng(4).standard_normal((5, 8)).astype(np.float32)
+    b = np.random.default_rng(5).standard_normal((5, 8)).astype(np.float32)
+    ours = np.asarray(F.pairwise_distance(_t(a), _t(b))._value)
+    ref = torch.nn.functional.pairwise_distance(torch.tensor(a),
+                                                torch.tensor(b)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-4)
