@@ -79,6 +79,8 @@ from .hapi import Model  # noqa: F401
 from . import models  # noqa: F401
 from . import sysconfig  # noqa: F401
 from .framework.io import save, load  # noqa: F401
+from .framework.param_attr import ParamAttr  # noqa: F401
+from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
 from .framework.flags import set_flags, get_flags  # noqa: F401
 from .jit import to_static  # noqa: F401
 from .nn.layer.container import Sequential  # noqa: F401
@@ -116,3 +118,67 @@ def set_printoptions(**kwargs):
     import numpy as np
 
     np.set_printoptions(**{k: v for k, v in kwargs.items() if k in ("precision", "threshold", "edgeitems", "linewidth")})
+
+
+# ---- small top-level parity shims (ref python/paddle/__init__.py __all__)
+from .core.dtypes import bool_ as bool  # noqa: F401,A001  (paddle.bool dtype)
+dtype = __import__('numpy').dtype  # paddle.dtype callable parity
+
+
+def is_complex(x):
+    from .core import dtypes as _dt
+
+    return _dt.is_complex(x.dtype)
+
+
+def is_floating_point(x):
+    from .core import dtypes as _dt
+
+    return _dt.is_floating(x.dtype)
+
+
+def is_integer(x):
+    from .core import dtypes as _dt
+
+    return _dt.is_integer(x.dtype)
+
+
+def complex(real, imag, name=None):
+    import jax.lax as _lax
+
+    from .tensor.tensor import apply_op as _ap
+
+    return _ap(_lax.complex, (real, imag), name="complex")
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Ref fluid.io.batch — legacy reader-decorator kept for script parity."""
+
+    def _gen():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return _gen
+
+
+def check_shape(*a, **k):  # static-graph debug helper: shapes are static here
+    pass
+
+
+def disable_signal_handler():
+    pass
+
+
+class CUDAPinnedPlace:  # GPU-era place shims (accepted, meaningless on TPU)
+    pass
+
+
+class NPUPlace:
+    def __init__(self, idx=0):
+        self.idx = idx

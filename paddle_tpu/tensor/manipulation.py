@@ -447,3 +447,62 @@ def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
         return jnp.where(ok, v - lo, ignore_value)
 
     return apply_op(_f, (input,), name="shard_index")
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    """Ref manipulation crop: static slice at `offsets` of size `shape`
+    (-1 in shape means to-the-end)."""
+    offs = [int(o) for o in (offsets or [0] * len(x.shape))]
+    tgt = [int(s) for s in (shape or [-1] * len(x.shape))]
+
+    for o, s, dim in zip(offs, tgt, x.shape):
+        stop = dim if s == -1 else o + s
+        if o < 0 or stop > dim:
+            raise ValueError(
+                f"crop out of range: offset {o} + size {s} exceeds dim {dim}")
+
+    def _f(v):
+        sl = []
+        for o, s, dim in zip(offs, tgt, v.shape):
+            stop = dim if s == -1 else o + s
+            sl.append(slice_builtin(o, stop))  # paddle.slice shadows builtins
+        return v[tuple(sl)]
+
+    return apply_op(_f, (x,), name="crop")
+
+
+def reverse(x, axis, name=None):
+    """Ref manipulation reverse — alias of flip."""
+    return flip(x, axis)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._rebind(out._value)
+    return x
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._rebind(out._value)
+    return x
+
+
+def shape(x, name=None):
+    """Ref paddle.shape: the runtime shape as an int32 Tensor."""
+    from .tensor import Tensor as _T
+    import jax.numpy as _jnp
+
+    return _T(_jnp.asarray(x.shape if isinstance(x, _T) else _jnp.asarray(x).shape,
+                           _jnp.int32))
+
+
+def rank(x, name=None):
+    from .tensor import Tensor as _T
+    import jax.numpy as _jnp
+
+    return _T(_jnp.asarray(len(x.shape), _jnp.int32))
+
+
+def tolist(x):
+    return x.tolist()

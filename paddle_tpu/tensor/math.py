@@ -376,3 +376,54 @@ def multiplex(inputs, index):
         )[0]
 
     return apply_op(_f, (index, *inputs), name="multiplex")
+
+
+def add_n(inputs, name=None):
+    """Ref math.py add_n: elementwise sum of a list of tensors."""
+    if isinstance(inputs, Tensor):
+        inputs = [inputs]  # still produce a NEW tensor, never an alias
+    if not inputs:
+        raise ValueError("add_n needs at least one input")
+
+    def _f(*vs):
+        out = vs[0]
+        for v in vs[1:]:
+            out = out + v
+        return out
+
+    return apply_op(_f, tuple(inputs), name="add_n")
+
+
+def mv(x, vec, name=None):
+    """Ref linalg mv: matrix @ vector."""
+    return apply_op(lambda m, v: jnp.matmul(m, v), (x, vec), name="mv")
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nanmedian(v, axis=axis, keepdims=keepdim),
+                    (x,), name="nanmedian")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, name=None):
+    return apply_op(lambda v: jnp.nanquantile(v, q, axis=axis, keepdims=keepdim),
+                    (x,), name="nanquantile")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Ref math.py renorm: clamp the p-norm of every slice along `axis`."""
+
+    def _f(v):
+        axes = tuple(i for i in range(v.ndim) if i != (axis % v.ndim))
+        norms = jnp.sum(jnp.abs(v.astype(jnp.float32)) ** p, axis=axes,
+                        keepdims=True) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm, max_norm / (norms + 1e-7), 1.0)
+        return (v * scale.astype(v.dtype))
+
+    return apply_op(_f, (x,), name="renorm")
+
+
+def tanh_(x, name=None):
+    """In-place tanh (ref inplace APIs): rebinds x's buffer."""
+    out = tanh(x)
+    x._rebind(out._value)
+    return x
