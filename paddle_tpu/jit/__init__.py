@@ -151,3 +151,55 @@ def enable_to_static(flag: bool = True):
 
 
 _to_static_enabled = True
+
+
+# ---- dy2static debug-surface shims (ref jit/__init__.py exports)
+class ProgramTranslator:
+    """Ref program_translator.py:991 — singleton toggling dy2static."""
+
+    _instance = None
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, flag=True):
+        enable_to_static(flag)
+
+    @property
+    def enable_to_static(self):
+        return _to_static_enabled
+
+
+class TracedLayer:
+    """Ref fluid/dygraph/jit.py TracedLayer — trace+save in one object."""
+
+    def __init__(self, layer, inputs):
+        self._layer = layer
+        self._inputs = inputs
+
+    @staticmethod
+    def trace(layer, inputs):
+        out = layer(*inputs)
+        return out, TracedLayer(layer, inputs)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        specs = [InputSpec(list(i.shape), str(i.dtype)) for i in self._inputs]
+        save(self._layer, path, input_spec=specs)
+
+    def __call__(self, *args):
+        return self._layer(*args)
+
+
+_VERBOSITY = 0
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    global _VERBOSITY
+    _VERBOSITY = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    set_verbosity(level, also_to_stdout)

@@ -5,7 +5,7 @@ from .layer.common import (  # noqa: F401
     Identity, Linear, Embedding, Dropout, Dropout2D, Dropout3D, AlphaDropout,
     Flatten, Pad1D, Pad2D, Pad3D, ZeroPad2D, Upsample, UpsamplingBilinear2D,
     UpsamplingNearest2D, PixelShuffle, PixelUnshuffle, Bilinear, CosineSimilarity,
-    Unfold, Fold,
+    Unfold, Fold, ChannelShuffle, PairwiseDistance, Softmax2D, SpectralNorm,
 )
 from .layer.conv import (  # noqa: F401
     Conv1D, Conv2D, Conv3D, Conv1DTranspose, Conv2DTranspose, Conv3DTranspose,
@@ -18,6 +18,7 @@ from .layer.norm import (  # noqa: F401
 from .layer.pooling import (  # noqa: F401
     MaxPool1D, MaxPool2D, MaxPool3D, AvgPool1D, AvgPool2D, AvgPool3D,
     AdaptiveAvgPool1D, AdaptiveAvgPool2D, AdaptiveMaxPool1D, AdaptiveMaxPool2D,
+    AdaptiveAvgPool3D, AdaptiveMaxPool3D, MaxUnPool1D, MaxUnPool2D, MaxUnPool3D,
 )
 from .layer.activation import (  # noqa: F401
     ReLU, ReLU6, GELU, Sigmoid, Tanh, Softmax, LogSoftmax, LogSigmoid, Softplus,
@@ -28,7 +29,8 @@ from .layer.activation import (  # noqa: F401
 from .layer.loss import (  # noqa: F401
     CrossEntropyLoss, MSELoss, L1Loss, NLLLoss, BCELoss, BCEWithLogitsLoss,
     KLDivLoss, SmoothL1Loss, MarginRankingLoss, CTCLoss, HingeEmbeddingLoss,
-    CosineEmbeddingLoss, TripletMarginLoss,
+    CosineEmbeddingLoss, TripletMarginLoss, MultiLabelSoftMarginLoss,
+    TripletMarginWithDistanceLoss, HSigmoidLoss,
 )
 from .layer.transformer import (  # noqa: F401
     MultiHeadAttention, TransformerEncoderLayer, TransformerEncoder,
@@ -36,6 +38,7 @@ from .layer.transformer import (  # noqa: F401
 )
 from .layer.rnn import (  # noqa: F401
     RNNCellBase, SimpleRNNCell, LSTMCell, GRUCell, RNN, BiRNN, SimpleRNN, LSTM, GRU,
+    BeamSearchDecoder, dynamic_decode,
 )
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401

@@ -28,6 +28,12 @@ from .pooling import (  # noqa: F401
     adaptive_avg_pool2d,
     adaptive_max_pool1d,
     adaptive_max_pool2d,
+    adaptive_avg_pool3d,
+    adaptive_max_pool3d,
+    max_unpool1d,
+    max_unpool2d,
+    max_unpool3d,
 )
 from .loss import *  # noqa: F401,F403
 from .attention import scaled_dot_product_attention, flash_attention  # noqa: F401
+from ...tensor.manipulation import diag_embed  # noqa: F401

@@ -133,3 +133,18 @@ def maxout(x, groups, axis=1, name=None):
 
 def thresholded_relu(x, threshold=1.0, name=None):
     return apply_op(lambda v: jnp.where(v > threshold, v, 0.0), (x,), name="thresholded_relu")
+
+
+def _inplace(fn):
+    def op(x, *a, **k):
+        out = fn(x, *a, **k)
+        x._rebind(out._value)
+        return x
+
+    return op
+
+
+relu_ = _inplace(relu)
+elu_ = _inplace(elu)
+softmax_ = _inplace(softmax)
+tanh_ = _inplace(tanh)

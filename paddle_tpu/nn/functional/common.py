@@ -450,3 +450,64 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
                                keepdims=keepdim).astype(a.dtype)
 
     return apply_op(_f, (x, y), name="pairwise_distance")
+
+
+def gather_tree(ids, parents, name=None):
+    """Ref gather_tree (beam search backtrace): ids/parents [T, B, W] ->
+    full beams re-threaded from the last step's parents."""
+
+    def _f(idv, pav):
+        T = idv.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [B, W] current beam slot per output beam
+            out = jnp.take_along_axis(idv[t], beams, axis=-1)
+            nxt = jnp.take_along_axis(pav[t], beams, axis=-1)
+            return nxt.astype(beams.dtype), out
+
+        init = jnp.broadcast_to(jnp.arange(idv.shape[2], dtype=idv.dtype),
+                                idv.shape[1:])
+        _, outs = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return outs[::-1]
+
+    return apply_op(_f, (ids, parents), name="gather_tree")
+
+
+def sparse_attention(query, key, value, sparse_csr_offset, sparse_csr_columns,
+                     key_padding_mask=None, attn_mask=None, name=None):
+    """Ref sparse_attention: attention restricted to a CSR block pattern.
+
+    TPU-native: the CSR pattern is densified into a [S, S] mask and the
+    attention runs on the MXU (structured-sparse SDPA hardware does not exist
+    on TPU; for long sequences prefer flash/ring attention instead)."""
+    import numpy as _np
+
+    offs = _np.asarray(_unwrap(sparse_csr_offset))
+    cols = _np.asarray(_unwrap(sparse_csr_columns))
+
+    def _f(q, k, v):
+        B, H, S, D = q.shape
+        # densify per-(batch, head) patterns; a single shared pattern
+        # ([S+1]-shaped offsets) broadcasts over every head
+        o2 = _np.broadcast_to(offs.reshape((-1, offs.shape[-1]))
+                              if offs.ndim > 1 else offs[None], None)             if False else (offs.reshape(-1, offs.shape[-1]))
+        c2 = cols.reshape(-1, cols.shape[-1])
+        n_pat = o2.shape[0]
+        masks = _np.zeros((n_pat, S, S), _np.bool_)
+        for i in range(n_pat):
+            for r in range(S):
+                masks[i, r, c2[i, o2[i, r]:o2[i, r + 1]]] = True
+        if n_pat == 1:
+            m = jnp.asarray(masks[0])[None, None]
+        elif n_pat == B * H:
+            m = jnp.asarray(masks).reshape(B, H, S, S)
+        else:
+            raise ValueError(
+                f"sparse_attention: {n_pat} CSR patterns for B*H={B*H} heads")
+        s = jnp.einsum("bhsd,bhtd->bhst", q, k) / jnp.sqrt(
+            jnp.asarray(D, q.dtype))
+        s = jnp.where(m, s, jnp.asarray(-1e30, s.dtype))
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhst,bhtd->bhsd", p, v)
+
+    return apply_op(_f, (query, key, value), name="sparse_attention")

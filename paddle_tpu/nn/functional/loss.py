@@ -272,3 +272,128 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0, red
         return _reduce(per, reduction)
 
     return apply_op(_f, (logit, label, normalizer), name="sigmoid_focal_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None, reduction="mean",
+                                 name=None):
+    """Ref nn/functional/loss.py multi_label_soft_margin_loss."""
+
+    def _f(x, y, *w):
+        lx = jax.nn.log_sigmoid(x)
+        lnx = jax.nn.log_sigmoid(-x)
+        loss = -(y * lx + (1.0 - y) * lnx)
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss.mean(axis=-1), reduction)
+
+    args = (input, label) if weight is None else (input, label, weight)
+    return apply_op(_f, args, name="multi_label_soft_margin_loss")
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean", name=None):
+    """Ref triplet_margin_with_distance_loss (custom metric triplet loss)."""
+    if distance_function is None:
+        from .common import pairwise_distance
+
+        distance_function = pairwise_distance
+
+    d_pos = distance_function(input, positive)
+    d_neg = distance_function(input, negative)
+    if swap:
+        from ...tensor.math import minimum as _min
+
+        d_neg = _min(d_neg, distance_function(positive, negative))
+
+    def _f(dp, dn):
+        return _reduce(jnp.maximum(dp - dn + margin, 0.0), reduction)
+
+    return apply_op(_f, (d_pos, d_neg), name="triplet_margin_with_distance_loss")
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002, name=None):
+    """Ref npair_loss: softmax CE over anchor-positive similarities + L2."""
+
+    def _f(a, p, y):
+        sim = a @ p.T                                   # [B, B]
+        yv = y.reshape(-1)
+        same = (yv[:, None] == yv[None, :]).astype(sim.dtype)
+        tgt = same / jnp.maximum(same.sum(-1, keepdims=True), 1.0)
+        logp = jax.nn.log_softmax(sim, axis=-1)
+        ce = -(tgt * logp).sum(-1).mean()
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, -1))
+                        + jnp.mean(jnp.sum(p * p, -1))) * 0.25
+        return ce + reg
+
+    return apply_op(_f, (anchor, positive, labels), name="npair_loss")
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None, path_table=None,
+                  path_code=None, is_sparse=False, name=None):
+    """Ref hsigmoid_loss — hierarchical sigmoid over the complete binary tree
+    with num_classes-1 internal nodes (heap layout: leaves occupy
+    [num_classes-1, 2*num_classes-2])."""
+    import numpy as _np
+
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "hsigmoid_loss: custom path_table/path_code trees are not "
+            "implemented — only the default complete-binary-tree mode")
+    # deepest possible path in a heap of 2*num_classes-1 nodes
+    n_codes = int(_np.ceil(_np.log2(max(num_classes, 2)))) + 1
+
+    def _f(x, y, w, *rest):
+        b = rest[0] if bias is not None else None
+        yv = y.reshape(-1).astype(jnp.int32)
+        # walk leaf -> root: parent=(cur-1)//2; code = "is right child";
+        # levels past the root are masked out (paths vary for non-pow2)
+        codes, nodes, valids = [], [], []
+        cur = yv + (num_classes - 1)
+        for _ in range(n_codes):
+            valid = cur > 0
+            parent = jnp.maximum((cur - 1) // 2, 0)
+            codes.append((cur == 2 * parent + 2).astype(jnp.float32))
+            nodes.append(parent)
+            valids.append(valid)
+            cur = jnp.where(valid, parent, 0)
+        node_idx = jnp.stack(nodes, 1)                    # [B, L]
+        code = jnp.stack(codes, 1)
+        vmask = jnp.stack(valids, 1).astype(jnp.float32)
+        logits = jnp.einsum("blh,bh->bl", w[node_idx], x)
+        if b is not None:
+            logits = logits + b.reshape(-1)[node_idx]
+        # p(path) = prod sigmoid(+/- logit); loss = -log p
+        loss = (jax.nn.softplus(logits) - code * logits) * vmask
+        return loss.sum(-1, keepdims=True)
+
+    args = [input, label, weight]
+    if bias is not None:
+        args.append(bias)
+    return apply_op(_f, tuple(args), name="hsigmoid_loss")
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5, margin3=0.0,
+                         scale=64.0, group=None, return_softmax=False,
+                         reduction="mean", name=None):
+    """Ref margin_cross_entropy (ArcFace/CosFace-style margin softmax).
+
+    cos(m1*theta + m2) - m3 applied to the target logit, then scaled CE.
+    Single-group version; model-parallel class sharding composes via the mp
+    mesh axis like ParallelCrossEntropy."""
+
+    def _f(lg, y):
+        yv = y.reshape(-1).astype(jnp.int32)
+        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+        tgt = jax.nn.one_hot(yv, lg.shape[-1], dtype=lg.dtype)
+        m_theta = margin1 * theta + margin2
+        margined = jnp.cos(m_theta) - margin3
+        out = jnp.where(tgt > 0, margined, lg) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -(tgt * logp).sum(-1)
+        loss = _reduce(loss, reduction)
+        if return_softmax:
+            return loss, jax.nn.softmax(out, -1)
+        return loss
+
+    return apply_op(_f, (logits, label), name="margin_cross_entropy")

@@ -59,8 +59,12 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
             # argmax within each window -> flattened HxW index (ref MaxPool2dWithIndexKernel)
             n, c, h, w = v.shape
             plist = [(0, 0), (0, 0)] + (list(pad) if not isinstance(pad, str) else None)
+            # shift values to be >= 1 so the zero-filled PAD slots of
+            # conv_general_dilated_patches can never win the argmax
+            vshift = v - jnp.min(jnp.where(jnp.isfinite(v), v, jnp.inf)) + 1.0
+            vshift = jnp.where(jnp.isfinite(v), vshift, 0.0)
             patches = jax.lax.conv_general_dilated_patches(
-                jnp.where(jnp.isfinite(v), v, neg), ks, st,
+                vshift, ks, st,
                 padding=pad if isinstance(pad, str) else list(pad),
                 dimension_numbers=("NCHW", "OIHW", "NCHW"),
             )  # [n, c*kh*kw, oh, ow]
@@ -109,6 +113,16 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
     st = _pair(stride, 1) if stride is not None else ks
     pad = _pool_pad(padding, 1)
 
+    if return_mask:
+        # delegate to the 2-D mask machinery on a height-1 image; the flat
+        # (gi*W + gj) index with H=1 IS the 1-D position
+        from ...tensor.manipulation import unsqueeze, squeeze
+
+        out, mask = max_pool2d(unsqueeze(x, 2), (1, ks[0]), (1, st[0]),
+                               padding=0 if padding == 0 else (0, padding),
+                               return_mask=True, ceil_mode=ceil_mode)
+        return squeeze(out, 2), squeeze(mask, 2)
+
     def _f(v):
         neg = -jnp.inf
         return _reduce_pool(v, ks, st, pad, 1, jax.lax.max, neg, ceil_mode)
@@ -137,8 +151,35 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
     st = _pair(stride, 3) if stride is not None else ks
     pad = _pool_pad(padding, 3)
 
+    if return_mask and ceil_mode:
+        raise NotImplementedError(
+            "max_pool3d(return_mask=True, ceil_mode=True) is not supported")
+
     def _f(v):
-        return _reduce_pool(v, ks, st, pad, 3, jax.lax.max, -jnp.inf, ceil_mode)
+        out = _reduce_pool(v, ks, st, pad, 3, jax.lax.max, -jnp.inf, ceil_mode)
+        if not return_mask:
+            return out
+        n, c, d, h, w = v.shape
+        vshift = v - jnp.min(jnp.where(jnp.isfinite(v), v, jnp.inf)) + 1.0
+        vshift = jnp.where(jnp.isfinite(v), vshift, 0.0)
+        patches = jax.lax.conv_general_dilated_patches(
+            vshift, ks, st, padding=pad if isinstance(pad, str) else list(pad),
+            dimension_numbers=("NCDHW", "OIDHW", "NCDHW"))
+        od, oh, ow = patches.shape[2:]
+        kd, kh, kw = ks
+        patches = patches.reshape(n, c, kd * kh * kw, od, oh, ow)
+        win = jnp.argmax(patches, axis=2)
+        wd = win // (kh * kw)
+        wh = (win // kw) % kh
+        ww = win % kw
+        pd_ = 0 if isinstance(pad, str) else pad[0][0]
+        ph = 0 if isinstance(pad, str) else pad[1][0]
+        pw = 0 if isinstance(pad, str) else pad[2][0]
+        gd = jnp.arange(od).reshape(1, 1, -1, 1, 1) * st[0] - pd_ + wd
+        gh = jnp.arange(oh).reshape(1, 1, 1, -1, 1) * st[1] - ph + wh
+        gw = jnp.arange(ow).reshape(1, 1, 1, 1, -1) * st[2] - pw + ww
+        mask = ((gd * h + gh) * w + gw).astype(jnp.int32)
+        return out, mask
 
     return apply_op(_f, (x,), name="max_pool3d")
 
@@ -233,3 +274,110 @@ def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
         return jnp.stack([v[:, :, s:e].max(axis=2) for s, e in zip(ss, es)], axis=-1)
 
     return apply_op(_f, (x,), name="adaptive_max_pool1d")
+
+
+def _triple(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v, v)
+
+
+def _adaptive_pool3d(v, os3, reduce):
+    n, c, d, h, w = v.shape
+    if d % os3[0] == 0 and h % os3[1] == 0 and w % os3[2] == 0:
+        r = v.reshape(n, c, os3[0], d // os3[0], os3[1], h // os3[1],
+                      os3[2], w // os3[2])
+        return reduce(r, (3, 5, 7))
+    ds, de = _adaptive_bins(d, os3[0])
+    hs, he = _adaptive_bins(h, os3[1])
+    ws, we = _adaptive_bins(w, os3[2])
+    planes = []
+    for k in range(os3[0]):
+        rows = []
+        for i in range(os3[1]):
+            cols = [reduce(v[:, :, ds[k]:de[k], hs[i]:he[i], ws[j]:we[j]],
+                           (2, 3, 4)) for j in range(os3[2])]
+            rows.append(jnp.stack(cols, axis=-1))
+        planes.append(jnp.stack(rows, axis=-2))
+    return jnp.stack(planes, axis=-3)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """Ref nn/functional/pooling.py adaptive_avg_pool3d."""
+    os3 = _triple(output_size)
+    return apply_op(lambda v: _adaptive_pool3d(v, os3, lambda a, ax: a.mean(axis=ax)),
+                    (x,), name="adaptive_avg_pool3d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    os3 = _triple(output_size)
+    return apply_op(lambda v: _adaptive_pool3d(v, os3, lambda a, ax: a.max(axis=ax)),
+                    (x,), name="adaptive_max_pool3d")
+
+
+def _unpool(v, mask, spatial_shape):
+    """Scatter pooled values back to `spatial_shape` via the flattened-index
+    mask max_pool(return_mask=True) produced (ref phi Unpool kernels)."""
+    n, c = v.shape[0], v.shape[1]
+    size = 1
+    for s in spatial_shape:
+        size *= s
+    flatv = v.reshape(n, c, -1)
+    flatm = mask.reshape(n, c, -1).astype(jnp.int32)
+    out = jnp.zeros((n, c, size), v.dtype)
+    out = jax.vmap(jax.vmap(lambda o, m, val: o.at[m].set(val)))(out, flatm, flatv)
+    return out.reshape((n, c) + tuple(spatial_shape))
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCHW", name=None):
+    """Ref nn/functional/pooling.py max_unpool2d — inverse of
+    max_pool2d(return_mask=True)."""
+    ks = _pair(kernel_size)
+    st = _pair(stride) if stride is not None else ks
+
+    def _f(v, m):
+        n, c, oh, ow = v.shape
+        pd = _pair(padding)
+        if output_size is not None:
+            hw = tuple(output_size[-2:])
+        else:
+            hw = ((oh - 1) * st[0] + ks[0] - 2 * pd[0],
+                  (ow - 1) * st[1] + ks[1] - 2 * pd[1])
+        return _unpool(v, m, hw)
+
+    return apply_op(_f, (x, indices), name="max_unpool2d")
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCL", name=None):
+    ks = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    st = (stride if isinstance(stride, int) else
+          (stride[0] if stride else ks)) or ks
+
+    pd = padding if isinstance(padding, int) else padding[0]
+
+    def _f(v, m):
+        n, c, ol = v.shape
+        length = (output_size[-1] if output_size is not None
+                  else (ol - 1) * st + ks - 2 * pd)
+        return _unpool(v, m, (length,))
+
+    return apply_op(_f, (x, indices), name="max_unpool1d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, data_format="NCDHW", name=None):
+    ks = _triple(kernel_size)
+    st = _triple(stride) if stride is not None else ks
+
+    def _f(v, m):
+        n, c, od, oh, ow = v.shape
+        pd = _triple(padding)
+        if output_size is not None:
+            dhw = tuple(output_size[-3:])
+        else:
+            dhw = ((od - 1) * st[0] + ks[0] - 2 * pd[0],
+                   (oh - 1) * st[1] + ks[1] - 2 * pd[1],
+                   (ow - 1) * st[2] + ks[2] - 2 * pd[2])
+        return _unpool(v, m, dhw)
+
+    return apply_op(_f, (x, indices), name="max_unpool3d")

@@ -1,13 +1,14 @@
 """Common layers: Linear, Embedding, Dropout, ... (ref: python/paddle/nn/layer/common.py)."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from .layers import Layer
 from .. import functional as F
 from ..initializer import XavierNormal, Normal, Constant
 from ...framework.param_attr import ParamAttr
-from ...tensor.tensor import Tensor
+from ...tensor.tensor import Tensor, apply_op
 
 
 class Identity(Layer):
@@ -225,3 +226,87 @@ class Fold(Layer):
 
     def forward(self, x):
         return F.fold(x, self.output_sizes, *self.args)
+
+
+class ChannelShuffle(Layer):
+    """Ref nn/layer/vision.py ChannelShuffle."""
+
+    def __init__(self, groups, data_format="NCHW", name=None):
+        super().__init__()
+        self.groups = groups
+        self.data_format = data_format
+
+    def forward(self, x):
+        return F.channel_shuffle(x, self.groups, self.data_format)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon, self.keepdim)
+
+
+class Softmax2D(Layer):
+    """Ref Softmax2D: softmax over the channel axis of NCHW."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class SpectralNorm(Layer):
+    """Ref nn/layer/norm.py SpectralNorm: power-iteration estimate of the
+    largest singular value; forward returns weight / sigma."""
+
+    def __init__(self, weight_shape, dim=0, power_iters=1, eps=1e-12,
+                 dtype="float32"):
+        super().__init__()
+        self.dim = dim
+        self.power_iters = power_iters
+        self.eps = eps
+        import numpy as _np
+
+        h = weight_shape[dim]
+        w = int(_np.prod(weight_shape)) // h
+        rng = _np.random.RandomState(0)
+        # u/v are BUFFERS updated every forward (the reference updates them in
+        # place so power_iters=1 converges over training steps, like BN stats)
+        self.register_buffer("weight_u",
+                             Tensor(jnp.asarray(rng.normal(size=h), jnp.float32)))
+        self.register_buffer("weight_v",
+                             Tensor(jnp.asarray(rng.normal(size=w), jnp.float32)))
+
+    def forward(self, weight):
+        dim = self.dim
+        iters = self.power_iters
+        eps = self.eps
+
+        def _power(wt, u, v):
+            perm = (dim,) + tuple(i for i in range(wt.ndim) if i != dim)
+            mat = jnp.transpose(wt, perm).reshape(wt.shape[dim], -1)
+
+            def it(c, _):
+                uu, vv = c
+                vv = mat.T @ uu
+                vv = vv / (jnp.linalg.norm(vv) + eps)
+                uu = mat @ vv
+                uu = uu / (jnp.linalg.norm(uu) + eps)
+                return (uu, vv), None
+
+            (u2, v2), _ = jax.lax.scan(it, (u, v), None, length=iters)
+            return mat, u2, v2
+
+        def _f(wt, u, v):
+            mat, u2, v2 = _power(jax.lax.stop_gradient(wt), u, v)
+            # persist the iterates (traced contexts capture this via the
+            # functional-buffer machinery, same as BN running stats)
+            self.weight_u.set_value(u2)
+            self.weight_v.set_value(v2)
+            perm = (dim,) + tuple(i for i in range(wt.ndim) if i != dim)
+            sigma = u2 @ (jnp.transpose(wt, perm).reshape(wt.shape[dim], -1) @ v2)
+            return wt / sigma
+
+        return apply_op(_f, (weight, self.weight_u, self.weight_v),
+                        name="spectral_norm")

@@ -124,3 +124,49 @@ class TripletMarginLoss(Layer):
 
     def forward(self, input, positive, negative):
         return F.triplet_margin_loss(input, positive, negative, *self.args)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.df, self.margin, self.swap = distance_function, margin, swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        return F.triplet_margin_with_distance_loss(
+            input, positive, negative, self.df, self.margin, self.swap,
+            self.reduction)
+
+
+class HSigmoidLoss(Layer):
+    """Ref nn/layer/loss.py HSigmoidLoss (hierarchical sigmoid)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False, name=None):
+        super().__init__()
+        import numpy as _np
+
+        self.num_classes = num_classes
+        n_nodes = max(num_classes - 1, 1) + num_classes  # heap internal bound
+        self.weight = self.create_parameter([n_nodes, feature_size],
+                                            attr=weight_attr)
+        self.bias = (self.create_parameter([n_nodes], attr=bias_attr,
+                                           is_bias=True)
+                     if bias_attr is not False else None)
+        self.is_sparse = is_sparse
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes, self.weight,
+                               self.bias, path_table, path_code,
+                               self.is_sparse)

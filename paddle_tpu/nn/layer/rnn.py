@@ -303,3 +303,113 @@ class BiRNN(Layer):
         fw, sf = self.rnn_fw(inputs, None if initial_states is None else initial_states[0])
         bw, sb = self.rnn_bw(inputs, None if initial_states is None else initial_states[1])
         return M.concat([fw, bw], axis=-1), (sf, sb)
+
+
+class BeamSearchDecoder:
+    """Ref nn/layer/rnn.py BeamSearchDecoder: beam search over an RNN cell.
+
+    The decode loop is host-driven (`dynamic_decode`); each step is jnp math
+    through the normal op layer, so it jits under to_static if wrapped.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None, vocab_size=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+        if embedding_fn is None and vocab_size is None:
+            raise ValueError(
+                "BeamSearchDecoder needs embedding_fn (or vocab_size for the "
+                "one-hot fallback) — token ids are not valid cell inputs")
+        self.vocab_size = vocab_size
+
+    # -- helpers operating on raw jnp values
+    def _merge(self, v):      # [B, W, ...] -> [B*W, ...]
+        return v.reshape((-1,) + tuple(v.shape[2:]))
+
+    def _split(self, v, B):   # [B*W, ...] -> [B, W, ...]
+        return v.reshape((B, self.beam_size) + tuple(v.shape[1:]))
+
+    def initialize(self, initial_cell_states):
+        """Tile cell states across beams; first input is start_token."""
+        states = jax.tree.map(
+            lambda s: jnp.repeat(s[:, None], self.beam_size, 1),
+            initial_cell_states)
+        B = jax.tree.leaves(initial_cell_states)[0].shape[0]
+        ids = jnp.full((B, self.beam_size), self.start_token, jnp.int64)
+        # only beam 0 is live initially (others -inf so beams diversify)
+        log_probs = jnp.tile(
+            jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32)[None],
+            (B, 1))
+        finished = jnp.zeros((B, self.beam_size), bool)
+        return ids, (states, log_probs, finished)
+
+    def step(self, inputs, beam_state):
+        from ...tensor.tensor import Tensor as _T
+
+        states, log_probs, finished = beam_state
+        B, W = inputs.shape
+        emb = (self.embedding_fn(_T(inputs.reshape(-1)))._value
+               if self.embedding_fn is not None
+               else jax.nn.one_hot(inputs.reshape(-1), self.vocab_size,
+                                   dtype=jnp.float32))
+        flat_states = jax.tree.map(self._merge, states)
+        out, new_states = self.cell(_T(emb), jax.tree.map(_T, flat_states))
+        logits = self.output_fn(out)._value if self.output_fn is not None else out._value
+        V = logits.shape[-1]
+        step_lp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        step_lp = self._split(step_lp, B)                     # [B, W, V]
+        # finished beams only extend with end_token at zero cost
+        mask = jnp.full((V,), -1e9).at[self.end_token].set(0.0)
+        step_lp = jnp.where(finished[..., None], mask[None, None], step_lp)
+        total = log_probs[..., None] + step_lp                # [B, W, V]
+        flat = total.reshape(B, W * V)
+        top_lp, top_idx = jax.lax.top_k(flat, W)
+        parent = (top_idx // V).astype(jnp.int64)             # [B, W]
+        token = (top_idx % V).astype(jnp.int64)
+        new_states = jax.tree.map(
+            lambda s: jnp.take_along_axis(
+                self._split(s, B), parent.reshape(
+                    (B, W) + (1,) * (s.ndim - 1)), 1),
+            jax.tree.map(lambda t: t._value, new_states))
+        finished = jnp.take_along_axis(finished, parent, 1) | (token == self.end_token)
+        return token, parent, (new_states, top_lp, finished)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=False,
+                   impute_finished=False, is_test=False, return_length=False,
+                   **kwargs):
+    """Ref nn/layer/rnn.py dynamic_decode: run decoder.initialize/step until
+    every beam finishes or max_step_num.  Returns (ids [B, T, W], final_states)
+    (+ lengths when return_length)."""
+    from ...tensor.tensor import Tensor as _T
+
+    ids0, state = decoder.initialize(jax.tree.map(
+        lambda t: t._value if isinstance(t, _T) else t, inits))
+    tokens, parents = [], []
+    inputs = ids0
+    for _ in range(int(max_step_num)):
+        token, parent, state = decoder.step(inputs, state)
+        tokens.append(token)
+        parents.append(parent)
+        inputs = token
+        if bool(state[2].all()):
+            break
+    import numpy as _np
+
+    idv = jnp.stack(tokens)                                  # [T, B, W]
+    pav = jnp.stack(parents)
+    from ..functional.common import gather_tree as _gt
+
+    beams = _gt(_T(idv), _T(pav))._value                     # [T, B, W]
+    out = beams if output_time_major else jnp.transpose(beams, (1, 0, 2))
+    T = beams.shape[0]
+    lengths = jnp.minimum(jnp.argmax(
+        jnp.concatenate([(beams == decoder.end_token),
+                         jnp.ones((1,) + beams.shape[1:], bool)], 0), 0) + 1, T)
+    if return_length:
+        return _T(out), state, _T(lengths.astype(jnp.int64))
+    return _T(out), state

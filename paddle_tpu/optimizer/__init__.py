@@ -1,5 +1,5 @@
 """paddle.optimizer parity surface."""
 from .optimizer import (  # noqa: F401
-    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, Adamax, RMSProp, Lamb, Lars,
+    Optimizer, SGD, Momentum, Adam, AdamW, Adagrad, Adadelta, Adamax, RMSProp, Lamb, Lars,
 )
 from . import lr  # noqa: F401

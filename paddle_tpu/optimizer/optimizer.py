@@ -354,3 +354,24 @@ class Lars(Momentum):
         g = g + self._lars_wd * p
         v = self._momentum * state["velocity"] + lr * local_lr * g
         return p - v, {"velocity": v}
+
+
+class Adadelta(Optimizer):
+    """Ref optimizer/adadelta.py."""
+
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip, name)
+        self._rho = rho
+        self._eps = epsilon
+
+    def _init_state(self, p):
+        return {"avg_squared_grad": jnp.zeros_like(p._value),
+                "avg_squared_update": jnp.zeros_like(p._value)}
+
+    def _update_rule(self, p, g, state, lr):
+        rho, eps = self._rho, self._eps
+        sq = rho * state["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        update = g * jnp.sqrt(state["avg_squared_update"] + eps) / jnp.sqrt(sq + eps)
+        su = rho * state["avg_squared_update"] + (1 - rho) * jnp.square(update)
+        return p - lr * update, {"avg_squared_grad": sq, "avg_squared_update": su}

@@ -1,0 +1,299 @@
+"""Tests for the final nn/functional/optimizer parity batch (unpool, 3-D
+adaptive pools, hierarchical sigmoid, margin softmax, spectral norm, beam
+search, Adadelta...)."""
+import numpy as np
+import pytest
+import torch
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def _t(x, sg=True):
+    return paddle.to_tensor(np.asarray(x), stop_gradient=sg)
+
+
+def test_max_unpool2d_roundtrip_torch():
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    out, mask = F.max_pool2d(_t(x), 2, stride=2, return_mask=True)
+    rec = F.max_unpool2d(out, mask, 2, stride=2)
+    to, tm = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2,
+                                            return_indices=True)
+    tr = torch.nn.functional.max_unpool2d(to, tm, 2, 2).numpy()
+    np.testing.assert_allclose(np.asarray(rec._value), tr, rtol=1e-6)
+
+
+def test_max_unpool1d_3d():
+    rng = np.random.default_rng(1)
+    x1 = rng.standard_normal((2, 3, 8)).astype(np.float32)
+    o1, m1 = F.max_pool1d(_t(x1), 2, stride=2, return_mask=True)
+    r1 = F.max_unpool1d(o1, m1, 2, stride=2)
+    assert tuple(r1.shape) == (2, 3, 8)
+    # every kept value appears at its original position
+    rec = np.asarray(r1._value)
+    kept = rec != 0
+    np.testing.assert_allclose(rec[kept], x1[kept])
+
+    x3 = rng.standard_normal((1, 2, 4, 4, 4)).astype(np.float32)
+    o3, m3 = F.max_pool3d(_t(x3), 2, stride=2, return_mask=True)
+    r3 = F.max_unpool3d(o3, m3, 2, stride=2)
+    assert tuple(r3.shape) == (1, 2, 4, 4, 4)
+
+
+def test_adaptive_pool3d_vs_torch():
+    rng = np.random.default_rng(2)
+    x = rng.standard_normal((2, 3, 6, 8, 10)).astype(np.float32)
+    ours = np.asarray(F.adaptive_avg_pool3d(_t(x), (2, 3, 4))._value)
+    ref = torch.nn.functional.adaptive_avg_pool3d(torch.tensor(x), (2, 3, 4)).numpy()
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+    ours_m = np.asarray(F.adaptive_max_pool3d(_t(x), 2)._value)
+    ref_m = torch.nn.functional.adaptive_max_pool3d(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(ours_m, ref_m, rtol=1e-5)
+
+
+def test_multilabel_and_triplet_losses_vs_torch():
+    rng = np.random.default_rng(3)
+    x = rng.standard_normal((4, 6)).astype(np.float32)
+    y = (rng.random((4, 6)) > 0.5).astype(np.float32)
+    ours = float(F.multi_label_soft_margin_loss(_t(x), _t(y)).item())
+    ref = float(torch.nn.functional.multilabel_soft_margin_loss(
+        torch.tensor(x), torch.tensor(y)))
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+    a, p, n = [rng.standard_normal((4, 8)).astype(np.float32) for _ in range(3)]
+    ours = float(F.triplet_margin_with_distance_loss(_t(a), _t(p), _t(n),
+                                                     margin=0.5).item())
+    ref = float(torch.nn.functional.triplet_margin_with_distance_loss(
+        torch.tensor(a), torch.tensor(p), torch.tensor(n), margin=0.5))
+    np.testing.assert_allclose(ours, ref, rtol=1e-4)
+
+
+def test_npair_loss_perfect_separation_low():
+    a = np.eye(4, 8, dtype=np.float32) * 10
+    labels = np.arange(4, dtype=np.int64)
+    tight = float(F.npair_loss(_t(a), _t(a), _t(labels), l2_reg=0.0).item())
+    rng = np.random.default_rng(4)
+    loose = float(F.npair_loss(_t(rng.standard_normal((4, 8)).astype(np.float32)),
+                               _t(rng.standard_normal((4, 8)).astype(np.float32)),
+                               _t(labels), l2_reg=0.0).item())
+    assert tight < loose
+
+
+def test_hsigmoid_loss_trains():
+    paddle.seed(0)
+    layer = nn.HSigmoidLoss(feature_size=8, num_classes=6)
+    opt = paddle.optimizer.Adam(learning_rate=0.1,
+                                parameters=layer.parameters())
+    rng = np.random.default_rng(5)
+    x = _t(rng.standard_normal((16, 8)).astype(np.float32))
+    y = _t(rng.integers(0, 6, (16, 1)))
+    losses = []
+    for _ in range(12):
+        loss = paddle.mean(layer(x, y))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+
+
+def test_margin_cross_entropy_zero_margin_is_scaled_ce():
+    rng = np.random.default_rng(6)
+    cos = (rng.random((4, 5)).astype(np.float32) * 1.6 - 0.8)
+    y = rng.integers(0, 5, (4,))
+    ours = float(F.margin_cross_entropy(_t(cos), _t(y), margin1=1.0,
+                                        margin2=0.0, margin3=0.0,
+                                        scale=16.0).item())
+    ref = float(torch.nn.functional.cross_entropy(torch.tensor(cos * 16.0),
+                                                  torch.tensor(y)))
+    np.testing.assert_allclose(ours, ref, rtol=1e-5)
+
+
+def test_spectral_norm_unit_sigma():
+    paddle.seed(1)
+    sn = nn.SpectralNorm([6, 4], dim=0, power_iters=30)
+    w = _t(np.random.default_rng(7).standard_normal((6, 4)).astype(np.float32) * 3)
+    out = np.asarray(sn(w)._value)
+    sigma = np.linalg.svd(out, compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+def test_adadelta_vs_torch():
+    rng = np.random.default_rng(8)
+    w0 = rng.standard_normal((4,)).astype(np.float32)
+    g = rng.standard_normal((4,)).astype(np.float32)
+
+    p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    opt = paddle.optimizer.Adadelta(learning_rate=0.5, rho=0.9, epsilon=1e-6,
+                                    parameters=[p])
+    for _ in range(3):
+        loss = paddle.sum(p * paddle.to_tensor(g))
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    tw = torch.tensor(w0.copy(), requires_grad=True)
+    topt = torch.optim.Adadelta([tw], lr=0.5, rho=0.9, eps=1e-6)
+    for _ in range(3):
+        tl = (tw * torch.tensor(g)).sum()
+        topt.zero_grad(); tl.backward(); topt.step()
+    np.testing.assert_allclose(np.asarray(p._value), tw.detach().numpy(),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_gather_tree():
+    # T=2, B=1, W=2: step1 parents select beam 1 for final beam 0
+    ids = np.array([[[10, 11]], [[20, 21]]], np.int64)
+    parents = np.array([[[0, 1]], [[1, 0]]], np.int64)
+    out = np.asarray(F.gather_tree(_t(ids), _t(parents))._value)
+    # final beam 0 came from parent 1 at t=1: path = ids[0][parent], ids[1][0]
+    assert out.shape == (2, 1, 2)
+    assert out[1, 0, 0] == 20 and out[0, 0, 0] == 11
+
+
+def test_sparse_attention_full_pattern_matches_dense():
+    rng = np.random.default_rng(9)
+    B, H, S, D = 1, 2, 4, 8
+    q, k, v = [rng.standard_normal((B, H, S, D)).astype(np.float32)
+               for _ in range(3)]
+    offs = np.arange(0, (S + 1) * S, S, dtype=np.int32).reshape(-1)[:S + 1]
+    cols = np.tile(np.arange(S, dtype=np.int32), S)
+    out = np.asarray(F.sparse_attention(_t(q), _t(k), _t(v), _t(offs),
+                                        _t(cols))._value)
+    ref = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q), torch.tensor(k), torch.tensor(v)).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_beam_search_decode():
+    """A cell whose logits always prefer token sequence 1,2,3,END must be
+    decoded by beam search."""
+    import jax.numpy as jnp
+
+    class ToyCell(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.table = self.create_parameter([16, 6])
+            self.step_n = [0]
+
+        def forward(self, emb, states):
+            # states: counter per flat beam [BW, 1]
+            cnt = states
+            seq = [1, 2, 3, 4]  # target tokens by step; 4 = end
+
+            def mk(c):
+                idx = jnp.clip(c.astype(jnp.int32), 0, 3)[..., 0]
+                return jax.nn.one_hot(jnp.asarray(seq)[idx], 6) * 8.0
+
+            import jax
+
+            logits = mk(cnt._value)
+            return paddle.to_tensor(logits), paddle.to_tensor(cnt._value + 1)
+
+    import jax
+
+    cell = ToyCell()
+    dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=4, beam_size=2,
+                               vocab_size=16)
+    inits = paddle.to_tensor(np.zeros((2, 1), np.float32))  # batch of 2
+    out, state, lens = nn.dynamic_decode(dec, inits, max_step_num=8,
+                                         return_length=True)
+    arr = np.asarray(out._value)     # [B, T, W]
+    assert arr.shape[0] == 2
+    np.testing.assert_array_equal(arr[0, :, 0], [1, 2, 3, 4])
+    np.testing.assert_array_equal(np.asarray(lens._value)[:, 0], 4)
+
+
+def test_layer_wrappers():
+    x = _t(np.random.default_rng(10).standard_normal((2, 4, 3, 3))
+           .astype(np.float32))
+    assert tuple(nn.ChannelShuffle(2)(x).shape) == (2, 4, 3, 3)
+    s = np.asarray(nn.Softmax2D()(x)._value)
+    np.testing.assert_allclose(s.sum(axis=1), 1.0, rtol=1e-5)
+    a = _t(np.ones((3, 4), np.float32))
+    b = _t(np.zeros((3, 4), np.float32))
+    d = nn.PairwiseDistance()(a, b)
+    np.testing.assert_allclose(np.asarray(d._value), 2.0, rtol=1e-4)
+
+
+def test_inplace_functional_variants():
+    x = _t(np.array([-1.0, 2.0], np.float32))
+    F.relu_(x)
+    np.testing.assert_allclose(np.asarray(x._value), [0.0, 2.0])
+    F.tanh_(x)
+    np.testing.assert_allclose(np.asarray(x._value), np.tanh([0.0, 2.0]),
+                               rtol=1e-6)
+
+
+def test_jit_shims():
+    pt = paddle.jit.ProgramTranslator.get_instance()
+    pt.enable(True)
+    paddle.jit.set_verbosity(3)
+    paddle.jit.set_code_level(50)
+
+    net = nn.Linear(4, 2)
+    x = _t(np.ones((2, 4), np.float32))
+    out, traced = paddle.jit.TracedLayer.trace(net, [x])
+    assert tuple(traced(x).shape) == (2, 2)
+
+
+def test_max_pool_mask_all_negative_with_padding():
+    """Zero-filled padding slots must never win the window argmax."""
+    x = np.full((1, 1, 4, 4), -5.0, np.float32)
+    out, mask = F.max_pool2d(_t(x), 2, stride=2, padding=1, return_mask=True)
+    m = np.asarray(mask._value)
+    assert (m >= 0).all() and (m < 16).all()
+    rec = F.max_unpool2d(out, mask, 2, stride=2, padding=1)
+    assert tuple(rec.shape) == (1, 1, 4, 4)
+    to, tm = torch.nn.functional.max_pool2d(torch.tensor(x), 2, 2, 1,
+                                            return_indices=True)
+    tr = torch.nn.functional.max_unpool2d(to, tm, 2, 2, 1).numpy()
+    np.testing.assert_allclose(np.asarray(rec._value), tr)
+
+
+def test_hsigmoid_non_power_of_two():
+    paddle.seed(2)
+    layer = nn.HSigmoidLoss(feature_size=4, num_classes=5)  # not a power of 2
+    rng = np.random.default_rng(11)
+    x = _t(rng.standard_normal((8, 4)).astype(np.float32))
+    y = _t(rng.integers(0, 5, (8, 1)))
+    loss = layer(x, y)
+    assert np.isfinite(np.asarray(loss._value)).all()
+    assert (np.asarray(loss._value) > 0).all()
+    with pytest.raises(NotImplementedError, match="path_table"):
+        F.hsigmoid_loss(x, y, 5, layer.weight, path_table=_t(np.zeros((1,))))
+
+
+def test_sparse_attention_per_head_patterns():
+    rng = np.random.default_rng(12)
+    B, H, S, D = 1, 2, 4, 8
+    q, k, v = [rng.standard_normal((B, H, S, D)).astype(np.float32)
+               for _ in range(3)]
+    # head 0: full attention; head 1: diagonal only
+    full_o = np.arange(0, (S + 1) * S, S, dtype=np.int32)
+    full_c = np.tile(np.arange(S, dtype=np.int32), S)
+    diag_o = np.arange(S + 1, dtype=np.int32)
+    diag_c = np.arange(S, dtype=np.int32)
+    offs = np.stack([full_o, np.pad(diag_o, (0, len(full_o) - len(diag_o)))])[None]
+    cols = np.stack([full_c, np.pad(diag_c, (0, len(full_c) - len(diag_c)))])[None]
+    out = np.asarray(F.sparse_attention(_t(q), _t(k), _t(v), _t(offs),
+                                        _t(cols))._value)
+    # diagonal-only head attends solely to itself -> output == v for head 1
+    np.testing.assert_allclose(out[0, 1], v[0, 1], rtol=1e-5)
+    ref0 = torch.nn.functional.scaled_dot_product_attention(
+        torch.tensor(q[:, :1]), torch.tensor(k[:, :1]),
+        torch.tensor(v[:, :1])).numpy()
+    np.testing.assert_allclose(out[0, 0], ref0[0, 0], rtol=1e-4, atol=1e-5)
+
+
+def test_spectral_norm_converges_with_one_iter():
+    """power_iters=1 must converge across calls because u/v persist."""
+    paddle.seed(3)
+    sn = nn.SpectralNorm([6, 4], dim=0, power_iters=1)
+    w = _t(np.random.default_rng(13).standard_normal((6, 4)).astype(np.float32) * 3)
+    for _ in range(40):
+        out = sn(w)
+    sigma = np.linalg.svd(np.asarray(out._value), compute_uv=False)[0]
+    np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
