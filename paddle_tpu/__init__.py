@@ -71,6 +71,7 @@ from . import incubate  # noqa: F401
 from . import inference  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
+from . import text  # noqa: F401
 from . import profiler  # noqa: F401
 from . import framework  # noqa: F401
 from . import device  # noqa: F401

@@ -138,7 +138,7 @@ def thresholded_relu(x, threshold=1.0, name=None):
 def _inplace(fn):
     def op(x, *a, **k):
         out = fn(x, *a, **k)
-        x._rebind(out._value)
+        x._assume(out)   # keep the tape node: in-place ops are differentiable
         return x
 
     return op

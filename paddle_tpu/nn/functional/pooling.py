@@ -118,8 +118,9 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
         # (gi*W + gj) index with H=1 IS the 1-D position
         from ...tensor.manipulation import unsqueeze, squeeze
 
+        pad1 = padding[0] if isinstance(padding, (list, tuple)) else int(padding)
         out, mask = max_pool2d(unsqueeze(x, 2), (1, ks[0]), (1, st[0]),
-                               padding=0 if padding == 0 else (0, padding),
+                               padding=(0, pad1),
                                return_mask=True, ceil_mode=ceil_mode)
         return squeeze(out, 2), squeeze(mask, 2)
 

@@ -155,10 +155,8 @@ class HSigmoidLoss(Layer):
     def __init__(self, feature_size, num_classes, weight_attr=None,
                  bias_attr=None, is_custom=False, is_sparse=False, name=None):
         super().__init__()
-        import numpy as _np
-
         self.num_classes = num_classes
-        n_nodes = max(num_classes - 1, 1) + num_classes  # heap internal bound
+        n_nodes = max(num_classes - 1, 1)  # internal nodes only (ref shape)
         self.weight = self.create_parameter([n_nodes, feature_size],
                                             attr=weight_attr)
         self.bias = (self.create_parameter([n_nodes], attr=bias_attr,

@@ -339,7 +339,7 @@ class BeamSearchDecoder:
             lambda s: jnp.repeat(s[:, None], self.beam_size, 1),
             initial_cell_states)
         B = jax.tree.leaves(initial_cell_states)[0].shape[0]
-        ids = jnp.full((B, self.beam_size), self.start_token, jnp.int64)
+        ids = jnp.full((B, self.beam_size), self.start_token, jnp.int32)
         # only beam 0 is live initially (others -inf so beams diversify)
         log_probs = jnp.tile(
             jnp.asarray([0.0] + [-1e9] * (self.beam_size - 1), jnp.float32)[None],
@@ -368,8 +368,8 @@ class BeamSearchDecoder:
         total = log_probs[..., None] + step_lp                # [B, W, V]
         flat = total.reshape(B, W * V)
         top_lp, top_idx = jax.lax.top_k(flat, W)
-        parent = (top_idx // V).astype(jnp.int64)             # [B, W]
-        token = (top_idx % V).astype(jnp.int64)
+        parent = (top_idx // V).astype(jnp.int32)             # [B, W]
+        token = (top_idx % V).astype(jnp.int32)
         new_states = jax.tree.map(
             lambda s: jnp.take_along_axis(
                 self._split(s, B), parent.reshape(
@@ -411,5 +411,5 @@ def dynamic_decode(decoder, inits=None, max_step_num=100, output_time_major=Fals
         jnp.concatenate([(beams == decoder.end_token),
                          jnp.ones((1,) + beams.shape[1:], bool)], 0), 0) + 1, T)
     if return_length:
-        return _T(out), state, _T(lengths.astype(jnp.int64))
+        return _T(out), state, _T(lengths.astype(jnp.int32))
     return _T(out), state

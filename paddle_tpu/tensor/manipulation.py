@@ -477,15 +477,11 @@ def reverse(x, axis, name=None):
 
 
 def squeeze_(x, axis=None, name=None):
-    out = squeeze(x, axis)
-    x._rebind(out._value)
-    return x
+    return x._assume(squeeze(x, axis))
 
 
 def unsqueeze_(x, axis, name=None):
-    out = unsqueeze(x, axis)
-    x._rebind(out._value)
-    return x
+    return x._assume(unsqueeze(x, axis))
 
 
 def shape(x, name=None):

@@ -423,7 +423,5 @@ def renorm(x, p, axis, max_norm, name=None):
 
 
 def tanh_(x, name=None):
-    """In-place tanh (ref inplace APIs): rebinds x's buffer."""
-    out = tanh(x)
-    x._rebind(out._value)
-    return x
+    """In-place tanh (ref inplace APIs) — differentiable like the reference."""
+    return x._assume(tanh(x))

@@ -224,6 +224,32 @@ class Tensor:
         self._out_index = 0
         return self
 
+    def _assume(self, other: "Tensor"):
+        """Internal: become `other` INCLUDING its tape node — the in-place-op
+        contract (relu_ etc. stay differentiable, unlike _rebind).
+
+        The op that produced `other` recorded `self` among its tape inputs; if
+        self simply adopted the new node, that recorded input would point at
+        the node's own output (a self-loop) and the cotangent would be lost.
+        So the recorded input is rewritten to a snapshot carrying self's OLD
+        tape position (the reference's TensorWrapper/version-counter dance
+        collapses to this under a functional tape)."""
+        if other._node is not None:
+            if self._node is None and not self.stop_gradient:
+                raise RuntimeError(
+                    "a leaf Tensor that requires grad is being used in an "
+                    "in-place operation")
+            snap = Tensor(self._value, stop_gradient=self.stop_gradient)
+            snap._node = self._node
+            snap._out_index = self._out_index
+            snap._grad_hooks = self._grad_hooks
+            other._node.inputs = [snap if i is self else i
+                                  for i in other._node.inputs]
+        self._value = other._value
+        self._node = other._node
+        self._out_index = other._out_index
+        return self
+
     # value access used throughout the framework
     @property
     def value(self):

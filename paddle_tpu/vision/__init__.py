@@ -5,10 +5,43 @@ from . import models  # noqa: F401
 from .models import LeNet  # noqa: F401
 
 
+_image_backend = "pil"
+
+
 def set_image_backend(backend):
-    pass
+    global _image_backend
+    if backend not in ("pil", "cv2", "tensor", "numpy"):
+        raise ValueError(f"unknown image backend {backend!r}")
+    _image_backend = backend
 
 
 def get_image_backend():
-    return "numpy"
+    return _image_backend
+
+
 from . import ops  # noqa: F401
+
+
+def image_load(path, backend=None):
+    """Ref vision/image.py image_load — reads an image file to an array
+    (PIL when available, else raw numpy formats)."""
+    import os
+
+    import numpy as np
+
+    ext = os.path.splitext(path)[1].lower()
+    if ext in (".npy",):
+        return np.load(path)
+    if ext in (".npz",):
+        data = np.load(path)
+        return data[list(data.keys())[0]]
+    try:
+        from PIL import Image
+
+        return Image.open(path)
+    except ImportError as e:
+        raise RuntimeError(
+            f"image_load: reading {ext} files needs Pillow, which is not "
+            "bundled — save arrays as .npy/.npz or install pillow") from e
+
+

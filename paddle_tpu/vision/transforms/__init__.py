@@ -553,3 +553,160 @@ class RandomErasing(BaseTransform):
                      else self.value)
                 return erase(arr, i, j, eh, ew, v, self.inplace)
         return arr
+
+
+def _affine_sample(arr, inv_mat, fill=0.0, interpolation="bilinear"):
+    """Inverse-map sampling with a 3x3 homography (shared by affine/perspective)."""
+    c, hax, wax = _axes(arr)
+    h, w = arr.shape[hax], arr.shape[wax]
+    ys, xs = np.meshgrid(np.arange(h, dtype=np.float64),
+                         np.arange(w, dtype=np.float64), indexing="ij")
+    ones = np.ones_like(xs)
+    pts = np.stack([xs, ys, ones])                       # [3, H, W]
+    src = np.tensordot(inv_mat, pts.reshape(3, -1), 1).reshape(3, h, w)
+    sx = src[0] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
+    sy = src[1] / np.maximum(np.abs(src[2]), 1e-9) * np.sign(src[2])
+    if interpolation == "nearest":
+        xi = np.round(sx).astype(np.int64)
+        yi = np.round(sy).astype(np.int64)
+        valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+        xi = np.clip(xi, 0, w - 1)
+        yi = np.clip(yi, 0, h - 1)
+
+        def sample(plane):
+            return np.where(valid, plane[yi, xi], fill)
+    else:
+        x0 = np.floor(sx).astype(np.int64)
+        y0 = np.floor(sy).astype(np.int64)
+        fx, fy = sx - x0, sy - y0
+        eps = 1e-3
+        valid = (sx >= -eps) & (sx <= w - 1 + eps) & (sy >= -eps) & (sy <= h - 1 + eps)
+
+        def sample(plane):
+            def at(yy, xx):
+                return plane[np.clip(yy, 0, h - 1), np.clip(xx, 0, w - 1)]
+
+            out = ((1 - fy) * (1 - fx) * at(y0, x0) + (1 - fy) * fx * at(y0, x0 + 1)
+                   + fy * (1 - fx) * at(y0 + 1, x0) + fy * fx * at(y0 + 1, x0 + 1))
+            return np.where(valid, out, fill)
+
+    if c is None:
+        return sample(arr).astype(np.float32)
+    chw = np.moveaxis(arr, c, 0)
+    out = np.stack([sample(p) for p in chw]).astype(np.float32)
+    return np.moveaxis(out, 0, c)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Ref functional.py affine(img, angle, translate, scale, shear, ...) —
+    the paddle signature; the forward map is composed like RandomAffine."""
+    arr = _as_float(img)
+    _, hax, wax = _axes(arr)
+    h, w = arr.shape[hax], arr.shape[wax]
+    if isinstance(shear, numbers.Number):
+        shear = (float(shear), 0.0)
+    ctr = center or ((w - 1) / 2.0, (h - 1) / 2.0)
+    fwd = _build_affine(angle, tuple(translate), float(scale),
+                        tuple(shear)[:2], ctr)
+    inv = np.linalg.inv(fwd)
+    return _affine_sample(arr, inv, fill=fill, interpolation=interpolation)
+
+
+def _build_affine(angle, translate, scale, shear, center):
+    cx, cy = center
+    rot = np.deg2rad(angle)
+    sx, sy = np.deg2rad(shear[0]), np.deg2rad(shear[1])
+    # torchvision/paddle composition: T * C * RotShearScale * C^-1
+    a = np.cos(rot - sy) / np.cos(sy)
+    b = -np.cos(rot - sy) * np.tan(sx) / np.cos(sy) - np.sin(rot)
+    c = np.sin(rot - sy) / np.cos(sy)
+    d = -np.sin(rot - sy) * np.tan(sx) / np.cos(sy) + np.cos(rot)
+    m = np.array([[a, b, 0.0], [c, d, 0.0], [0, 0, 1]]) * 1.0
+    m[:2, :] *= scale
+    m[0, 2] = cx + translate[0] - m[0, 0] * cx - m[0, 1] * cy
+    m[1, 2] = cy + translate[1] - m[1, 0] * cx - m[1, 1] * cy
+    return m
+
+
+class RandomAffine(BaseTransform):
+    """Ref transforms.py RandomAffine."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        if isinstance(degrees, numbers.Number):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        arr = _as_float(img)
+        _, hax, wax = _axes(arr)
+        h, w = arr.shape[hax], arr.shape[wax]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = np.random.uniform(-self.translate[0], self.translate[0]) * w
+            ty = np.random.uniform(-self.translate[1], self.translate[1]) * h
+        sc = np.random.uniform(*self.scale) if self.scale is not None else 1.0
+        sh = (0.0, 0.0)
+        if self.shear is not None:
+            shv = self.shear if isinstance(self.shear, (list, tuple)) else (-self.shear, self.shear)
+            sh = (np.random.uniform(shv[0], shv[1]), 0.0)
+        center = self.center or ((w - 1) / 2.0, (h - 1) / 2.0)
+        fwd = _build_affine(angle, (tx, ty), sc, sh, center)
+        inv = np.linalg.inv(fwd)
+        return _affine_sample(arr, inv, fill=self.fill,
+                              interpolation=self.interpolation)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest", fill=0):
+    """Ref functional.py perspective: map the quad `startpoints` onto
+    `endpoints` (each 4 [x, y] corners)."""
+    src = np.asarray(startpoints, np.float64)
+    dst = np.asarray(endpoints, np.float64)
+    # solve the homography dst -> src (inverse map for sampling)
+    A = []
+    for (xd, yd), (xs, ys) in zip(dst, src):
+        A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd])
+        A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd])
+    A = np.asarray(A)
+    b = src.reshape(-1)
+    coeffs = np.linalg.solve(A, b)
+    inv = np.vstack([coeffs.reshape(-1)[:6].reshape(2, 3),
+                     [coeffs[6], coeffs[7], 1.0]])
+    return _affine_sample(_as_float(img), inv, fill=fill,
+                          interpolation=interpolation)
+
+
+class RandomPerspective(BaseTransform):
+    """Ref transforms.py RandomPerspective."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5, interpolation="nearest",
+                 fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        arr = _as_float(img)
+        if np.random.rand() >= self.prob:
+            return arr
+        _, hax, wax = _axes(arr)
+        h, w = arr.shape[hax], arr.shape[wax]
+        d = self.distortion_scale
+        hw, hh = int(w * d / 2), int(h * d / 2)
+        start = [[0, 0], [w - 1, 0], [w - 1, h - 1], [0, h - 1]]
+        end = [[np.random.randint(0, hw + 1), np.random.randint(0, hh + 1)],
+               [w - 1 - np.random.randint(0, hw + 1), np.random.randint(0, hh + 1)],
+               [w - 1 - np.random.randint(0, hw + 1), h - 1 - np.random.randint(0, hh + 1)],
+               [np.random.randint(0, hw + 1), h - 1 - np.random.randint(0, hh + 1)]]
+        return perspective(arr, start, end, self.interpolation, self.fill)

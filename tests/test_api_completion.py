@@ -297,3 +297,28 @@ def test_spectral_norm_converges_with_one_iter():
         out = sn(w)
     sigma = np.linalg.svd(np.asarray(out._value), compute_uv=False)[0]
     np.testing.assert_allclose(sigma, 1.0, rtol=1e-3)
+
+
+def test_inplace_activations_keep_gradients():
+    """relu_ etc. must stay differentiable (round-2 review: _rebind severed
+    the tape and upstream grads silently vanished)."""
+    w = _t(np.ones((3,), np.float32) * 2.0, sg=False)
+    h = w * _t(np.array([1.0, -1.0, 3.0], np.float32))
+    F.relu_(h)
+    loss = paddle.sum(h)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(w.grad._value), [1.0, 0.0, 3.0])
+
+
+def test_exponential_decay_honors_decay_steps():
+    sched = paddle.static.exponential_decay(0.1, decay_steps=10,
+                                            decay_rate=0.5, staircase=True)
+    assert abs(sched.get_lr() - 0.1) < 1e-9
+    for _ in range(10):
+        sched.step()
+    np.testing.assert_allclose(sched.get_lr(), 0.05, rtol=1e-6)
+
+
+def test_hsigmoid_weight_shape_matches_reference():
+    layer = nn.HSigmoidLoss(feature_size=4, num_classes=10)
+    assert tuple(layer.weight.shape) == (9, 4)  # num_classes-1 internal nodes

@@ -145,3 +145,61 @@ def test_contrast_uses_grayscale_mean():
     img[0] = 1.0   # pure red
     lo = T.adjust_contrast(img, 0.0)
     np.testing.assert_allclose(lo, 0.299, rtol=1e-5)  # not the raw mean 1/3
+
+
+def test_affine_identity_and_shift():
+    img = _img(8, 8)
+    ident = T.affine(img, 0, (0, 0), 1.0, (0, 0), interpolation="bilinear")
+    np.testing.assert_allclose(ident, img, rtol=1e-4, atol=1e-3)
+    # forward translate +2 in x shifts content RIGHT by 2
+    shifted = T.affine(img, 0, (2, 0), 1.0, (0, 0), interpolation="nearest")
+    np.testing.assert_allclose(shifted[:, :, 2:], img[:, :, :-2])
+
+
+def test_random_affine_runs():
+    np.random.seed(4)
+    out = T.RandomAffine(degrees=15, translate=(0.1, 0.1), scale=(0.9, 1.1),
+                         shear=5)(_img())
+    assert out.shape == (3, 16, 16) and np.isfinite(out).all()
+
+
+def test_perspective_identity():
+    img = _img(8, 8)
+    corners = [[0, 0], [7, 0], [7, 7], [0, 7]]
+    out = T.perspective(img, corners, corners, interpolation="bilinear")
+    np.testing.assert_allclose(out, img, rtol=1e-4, atol=1e-3)
+    np.random.seed(5)
+    rp = T.RandomPerspective(prob=1.0, distortion_scale=0.3)(img)
+    assert rp.shape == img.shape
+
+
+def test_static_surface():
+    import paddle_tpu as paddle
+
+    with paddle.static.program_guard(paddle.static.default_main_program()):
+        with paddle.static.name_scope("blk"):
+            pass
+    assert paddle.static.cpu_places(2)
+    assert paddle.static.cuda_places() == []
+    v = paddle.static.create_global_var([2, 2], 1.5, "float32")
+    np.testing.assert_allclose(np.asarray(v._value), 1.5)
+    p = paddle.static.create_parameter([3, 3], "float32")
+    assert tuple(p.shape) == (3, 3)
+
+
+def test_static_ema():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+
+    paddle.seed(4)
+    m = nn.Linear(4, 2)
+    ema = paddle.static.ExponentialMovingAverage(decay=0.5)
+    w0 = np.asarray(m.weight._value).copy()
+    ema.update(m)
+    m.weight.set_value(np.asarray(m.weight._value) + 1.0)
+    ema.update(m)
+    with ema.apply():
+        applied = np.asarray(m.weight._value).copy()
+    restored = np.asarray(m.weight._value)
+    np.testing.assert_allclose(restored, w0 + 1.0)   # restore worked
+    assert np.all(applied < restored)                 # EMA lags the raw weight
