@@ -24,8 +24,7 @@ def reshape(x, shape, name=None):
 
 
 def reshape_(x, shape, name=None):
-    s = _shape_arg(shape)
-    return x._rebind(jnp.reshape(x._value, s))
+    return x._assume(reshape(x, shape))
 
 
 def flatten(x, start_axis=0, stop_axis=-1, name=None):
@@ -224,8 +223,7 @@ def scatter(x, index, updates, overwrite=True, name=None):
 
 
 def scatter_(x, index, updates, overwrite=True):
-    out = scatter(x, index, updates, overwrite)
-    return x._rebind(out._value)
+    return x._assume(scatter(x, index, updates, overwrite))
 
 
 def scatter_nd_add(x, index, updates, name=None):

@@ -153,8 +153,8 @@ def cross(x, y, axis=9):
     return apply_op(lambda a, b: jnp.cross(a, b, axis=ax), (x, y), name="cross")
 
 
-def multiply_(x, y):  # limited in-place parity
-    return x.set_value(jnp.multiply(x._value, _unwrap(y)))
+def multiply_(x, y):  # in-place parity, differentiable like the reference
+    return x._assume(multiply(x, y))
 
 
 # ----------------------------------------------------------------- reductions

@@ -23,25 +23,38 @@ from . import ops  # noqa: F401
 
 
 def image_load(path, backend=None):
-    """Ref vision/image.py image_load — reads an image file to an array
-    (PIL when available, else raw numpy formats)."""
+    """Ref vision/image.py image_load.  backend: 'pil' -> PIL.Image,
+    'numpy'/'cv2' -> HWC ndarray (cv2 flips RGB->BGR), 'tensor' -> Tensor."""
     import os
 
     import numpy as np
 
+    backend = backend or get_image_backend()
     ext = os.path.splitext(path)[1].lower()
     if ext in (".npy",):
-        return np.load(path)
-    if ext in (".npz",):
+        arr = np.load(path)
+    elif ext in (".npz",):
         data = np.load(path)
-        return data[list(data.keys())[0]]
-    try:
-        from PIL import Image
+        arr = data[list(data.keys())[0]]
+    else:
+        try:
+            from PIL import Image
+        except ImportError as e:
+            raise RuntimeError(
+                f"image_load: reading {ext} files needs Pillow, which is not "
+                "bundled — save arrays as .npy/.npz or install pillow") from e
+        img = Image.open(path)
+        if backend == "pil":
+            return img
+        arr = np.asarray(img)
+    if backend == "pil":
+        return arr          # array files have no PIL form; return the array
+    if backend == "cv2":
+        return arr[..., ::-1] if arr.ndim == 3 and arr.shape[-1] == 3 else arr
+    if backend == "tensor":
+        from ..tensor.tensor import Tensor
 
-        return Image.open(path)
-    except ImportError as e:
-        raise RuntimeError(
-            f"image_load: reading {ext} files needs Pillow, which is not "
-            "bundled — save arrays as .npy/.npz or install pillow") from e
+        return Tensor(arr)
+    return arr
 
 

@@ -657,8 +657,13 @@ class RandomAffine(BaseTransform):
         sc = np.random.uniform(*self.scale) if self.scale is not None else 1.0
         sh = (0.0, 0.0)
         if self.shear is not None:
-            shv = self.shear if isinstance(self.shear, (list, tuple)) else (-self.shear, self.shear)
-            sh = (np.random.uniform(shv[0], shv[1]), 0.0)
+            shv = (self.shear if isinstance(self.shear, (list, tuple))
+                   else (-self.shear, self.shear))
+            if len(shv) == 4:       # paddle's [x_min, x_max, y_min, y_max]
+                sh = (np.random.uniform(shv[0], shv[1]),
+                      np.random.uniform(shv[2], shv[3]))
+            else:
+                sh = (np.random.uniform(shv[0], shv[1]), 0.0)
         center = self.center or ((w - 1) / 2.0, (h - 1) / 2.0)
         fwd = _build_affine(angle, (tx, ty), sc, sh, center)
         inv = np.linalg.inv(fwd)

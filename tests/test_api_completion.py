@@ -322,3 +322,35 @@ def test_exponential_decay_honors_decay_steps():
 def test_hsigmoid_weight_shape_matches_reference():
     layer = nn.HSigmoidLoss(feature_size=4, num_classes=10)
     assert tuple(layer.weight.shape) == (9, 4)  # num_classes-1 internal nodes
+
+
+def test_all_inplace_ops_keep_gradients():
+    """reshape_/scatter_/multiply_ too (round-2 review: the first fix only
+    covered activations)."""
+    w = _t(np.ones((4,), np.float32), sg=False)
+    y = w * _t(np.full((4,), 2.0, np.float32))
+    paddle.reshape_(y, [2, 2])
+    paddle.multiply_(y, _t(np.full((2, 2), 3.0, np.float32)))
+    loss = paddle.sum(y)
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(w.grad._value), 6.0)
+
+
+def test_inplace_under_no_grad_keeps_history():
+    w = _t(np.ones((3,), np.float32), sg=False)
+    y = w * _t(np.full((3,), 2.0, np.float32))
+    with paddle.no_grad():
+        F.tanh_(y)
+    loss = paddle.sum(y)
+    loss.backward()
+    # history preserved: grads flow through the pre-tanh graph
+    np.testing.assert_allclose(np.asarray(w.grad._value), 2.0)
+
+
+def test_inplace_hook_fires_once():
+    w = _t(np.ones((3,), np.float32), sg=False)
+    y = w * _t(np.ones((3,), np.float32))
+    F.relu_(y)
+    y.register_hook(lambda g: g * 2)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(np.asarray(w.grad._value), 2.0)  # x2 once, not x4
