@@ -70,13 +70,53 @@ class CommunicateTopology:
 
 
 def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None) -> Mesh:
-    """Create the device mesh for a hybrid strategy.  Axis layout puts mp innermost so
-    tensor-parallel collectives ride the fastest ICI links (scaling-book recipe)."""
-    devices = devices if devices is not None else np.array(jax.devices())
-    need = pp * dp * sharding * sep * mp
+    """Create the device mesh for a hybrid strategy.  Axis layout puts mp
+    innermost so tensor-parallel collectives ride the fastest ICI links
+    (scaling-book recipe).
+
+    On real TPU topologies the assignment goes through
+    mesh_utils.create_device_mesh (single slice: ICI-nearest-neighbor
+    placement per axis) or create_hybrid_device_mesh (multi-host with DCN:
+    the outermost data axes span hosts, mp/sep stay inside a slice) instead
+    of a naive flat reshape — the reshape order is only correct by accident
+    on some topologies."""
+    shape = (pp, dp, sharding, sep, mp)
+    need = int(np.prod(shape))
+    if devices is None:
+        all_devs = jax.devices()
+        if len(all_devs) < need:
+            raise ValueError(f"need {need} devices, have {len(all_devs)}")
+        if all_devs[0].platform == "tpu" and len(all_devs) == need:
+            from jax.experimental import mesh_utils
+
+            try:
+                n_hosts = max(getattr(d, "process_index", 0) for d in all_devs) + 1
+                if n_hosts > 1:
+                    per_host = len(all_devs) // n_hosts
+                    # split each axis into a DCN (cross-host) and ICI part:
+                    # data-like axes absorb the host dimension outermost
+                    dcn = [1] * len(shape)
+                    ici = list(shape)
+                    rest = n_hosts
+                    for i in (1, 2, 0):        # dp, sharding, then pp over DCN
+                        g = int(np.gcd(ici[i], rest))
+                        dcn[i] *= g
+                        ici[i] //= g
+                        rest //= g
+                        if rest == 1:
+                            break
+                    if rest == 1 and per_host == int(np.prod(ici)):
+                        dev = mesh_utils.create_hybrid_device_mesh(
+                            tuple(ici), tuple(dcn), devices=all_devs)
+                        return Mesh(dev, AXIS_ORDER)
+                dev = mesh_utils.create_device_mesh(shape, devices=all_devs)
+                return Mesh(dev, AXIS_ORDER)
+            except Exception:
+                pass  # unusual topology: the flat reshape below still works
+        devices = np.array(all_devs)
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")
-    dev = np.asarray(devices)[:need].reshape(pp, dp, sharding, sep, mp)
+    dev = np.asarray(devices)[:need].reshape(shape)
     return Mesh(dev, AXIS_ORDER)
 
 

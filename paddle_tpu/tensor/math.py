@@ -154,7 +154,12 @@ def cross(x, y, axis=9):
 
 
 def multiply_(x, y):  # in-place parity, differentiable like the reference
-    return x._assume(multiply(x, y))
+    out = multiply(x, y)
+    if tuple(out.shape) != tuple(x.shape):
+        raise ValueError(
+            f"multiply_: in-place result shape {out.shape} must match "
+            f"x.shape {x.shape} (broadcasting may not resize the target)")
+    return x._assume(out)
 
 
 # ----------------------------------------------------------------- reductions

@@ -242,12 +242,17 @@ class Tensor:
             snap = Tensor(self._value, stop_gradient=self.stop_gradient)
             snap._node = self._node
             snap._out_index = self._out_index
-            snap._grad_hooks = list(self._grad_hooks)  # NOT shared: a hook
-            # registered later must fire at one tape position, not both
+            # hooks belong to the VARIABLE, which now lives at the new tape
+            # position — the snapshot edge must carry none or they fire twice
+            snap._grad_hooks = []
             other._node.inputs = [snap if i is self else i
                                   for i in other._node.inputs]
             self._node = other._node
             self._out_index = other._out_index
+            # the result of a differentiable op is differentiable, whatever
+            # the old flag said (e.g. scatter_ into a constant with tracked
+            # updates must pass gradients through)
+            self.stop_gradient = False
         # op recorded no node (e.g. under no_grad): keep the existing history —
         # backward uses the tape's saved values, matching reference semantics
         self._value = other._value

@@ -354,3 +354,27 @@ def test_inplace_hook_fires_once():
     y.register_hook(lambda g: g * 2)
     paddle.sum(y).backward()
     np.testing.assert_allclose(np.asarray(w.grad._value), 2.0)  # x2 once, not x4
+
+
+def test_inplace_preexisting_hook_fires_once():
+    w = _t(np.ones((3,), np.float32), sg=False)
+    y = w * _t(np.ones((3,), np.float32))
+    y.register_hook(lambda g: g * 2)   # registered BEFORE the in-place op
+    F.relu_(y)
+    paddle.sum(y).backward()
+    np.testing.assert_allclose(np.asarray(w.grad._value), 2.0)
+
+
+def test_inplace_into_stopgrad_target_links_updates():
+    x = _t(np.zeros((4,), np.float32))          # stop_gradient=True
+    upd = _t(np.ones((2,), np.float32), sg=False)
+    paddle.scatter_(x, _t(np.array([0, 2])), upd)
+    assert not x.stop_gradient
+    paddle.sum(x).backward()
+    np.testing.assert_allclose(np.asarray(upd.grad._value), 1.0)
+
+
+def test_multiply_inplace_rejects_resize():
+    x = _t(np.ones((3,), np.float32))
+    with pytest.raises(ValueError, match="resize"):
+        paddle.multiply_(x, _t(np.ones((2, 3), np.float32)))
