@@ -98,6 +98,36 @@ def _in_trace(x):
     return isinstance(x, jax.core.Tracer)
 
 
+def _n_procs():
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
+def _eager_allgather(v, group=None):
+    """Cross-process eager gather (jax.experimental.multihost_utils): stacks
+    each process's local value along a new axis 0 on every host.
+
+    WORLD group only: multihost_utils collectives are global, so a subgroup
+    here would silently mix values across groups (or hang when only some
+    processes participate) — subgroup communication belongs to the compiled
+    path, where mesh axes express it."""
+    _require_world_group(group)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(v)
+
+
+def _require_world_group(group):
+    if group is not None and getattr(group, "nranks", None) not in (None, _n_procs()):
+        raise NotImplementedError(
+            f"eager cross-process collectives support only the world group "
+            f"({_n_procs()} processes); got a {group.nranks}-rank subgroup. "
+            "Run subgroup collectives inside a jitted/shard_map step where "
+            "the mesh axis expresses the group.")
+
+
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
     """Ref collective.py:711.  In-jit w/ axis: lax.psum over ICI; eager 1-rank: identity."""
     ax = _axis(group)
@@ -113,6 +143,18 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
             if op == ReduceOp.AVG:
                 return jax.lax.pmean(v, ax)
             raise NotImplementedError("PROD all_reduce inside jit")
+        if not _in_trace(v) and _n_procs() > 1:
+            g = _eager_allgather(v, group)   # [n_procs, ...]
+            if op == ReduceOp.SUM:
+                return jnp.sum(g, 0)
+            if op == ReduceOp.MAX:
+                return jnp.max(g, 0)
+            if op == ReduceOp.MIN:
+                return jnp.min(g, 0)
+            if op == ReduceOp.AVG:
+                return jnp.mean(g, 0)
+            if op == ReduceOp.PROD:
+                return jnp.prod(g, 0)
         return v  # single-participant eager view
 
     out = apply_op(_f, (tensor,), name="all_reduce")
@@ -130,6 +172,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
     def _f(v):
         if ax is not None and _in_trace(v):
             return jax.lax.all_gather(v, ax)
+        if not _in_trace(v) and _n_procs() > 1:
+            return _eager_allgather(v, group)
         return v[None]
 
     out = apply_op(_f, (tensor,), name="all_gather")
@@ -146,7 +190,21 @@ def all_gather_object(object_list, obj, group=None):
 
 
 def broadcast(tensor, src, group=None, sync_op=True):
-    """In-jit SPMD: values are already consistent per sharding; eager: identity."""
+    """In-jit SPMD: values are already consistent per sharding; eager
+    multi-process: every rank adopts rank `src`'s value."""
+    v = tensor._value if isinstance(tensor, Tensor) else tensor
+    if not _in_trace(v) and _n_procs() > 1:
+        _require_world_group(group)
+        from jax.experimental import multihost_utils
+
+        # one-to-all primitive: ships ONE copy instead of allgathering
+        # n_procs copies and keeping a slice
+        out = multihost_utils.broadcast_one_to_all(
+            v, is_source=jax.process_index() == int(src))
+        if isinstance(tensor, Tensor):
+            tensor.set_value(out)
+            return tensor
+        return Tensor(out)
     return tensor
 
 

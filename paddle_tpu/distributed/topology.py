@@ -84,8 +84,6 @@ def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None) -> Mesh:
     need = int(np.prod(shape))
     if devices is None:
         all_devs = jax.devices()
-        if len(all_devs) < need:
-            raise ValueError(f"need {need} devices, have {len(all_devs)}")
         if all_devs[0].platform == "tpu" and len(all_devs) == need:
             from jax.experimental import mesh_utils
 
@@ -111,8 +109,13 @@ def build_mesh(dp=1, mp=1, pp=1, sharding=1, sep=1, devices=None) -> Mesh:
                         return Mesh(dev, AXIS_ORDER)
                 dev = mesh_utils.create_device_mesh(shape, devices=all_devs)
                 return Mesh(dev, AXIS_ORDER)
-            except Exception:
-                pass  # unusual topology: the flat reshape below still works
+            except Exception as e:
+                import warnings
+
+                warnings.warn(
+                    f"mesh_utils device assignment failed ({e!r}); falling "
+                    "back to flat reshape — axis-to-ICI placement may be "
+                    "suboptimal on this topology", stacklevel=2)
         devices = np.array(all_devs)
     if len(devices) < need:
         raise ValueError(f"need {need} devices, have {len(devices)}")

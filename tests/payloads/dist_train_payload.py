@@ -53,6 +53,15 @@ def main():
         y = rng.standard_normal((8, 4)).astype(np.float32)
         losses.append(float(step(x, y).item()))
 
+    # eager cross-process collectives (round-1 weak #6: these were identity
+    # stubs; they now ride multihost_utils over the distributed backend)
+    me = paddle.to_tensor(np.array([float(penv.rank + 1)], np.float32))
+    summed = dist.all_reduce(me)
+    gathered = dist.all_gather(None, paddle.to_tensor(
+        np.array([float(penv.rank)], np.float32)))
+    b = paddle.to_tensor(np.array([float(penv.rank)], np.float32))
+    dist.broadcast(b, src=1)
+
     with open(out_path, "w") as f:
         json.dump({
             "rank": penv.rank,
@@ -60,6 +69,9 @@ def main():
             "coord": list(hcg._coord()),
             "dp_rank": hcg.get_data_parallel_rank(),
             "losses": losses,
+            "allreduce_sum": float(np.asarray(summed._value)[0]),
+            "allgather": np.asarray(gathered._value).reshape(-1).tolist(),
+            "broadcast_from_1": float(np.asarray(b._value)[0]),
         }, f)
 
 

@@ -98,3 +98,12 @@ def test_process_coordinates_differ(dist_results):
     assert r0["dp_rank"] == 0
     assert r1["dp_rank"] == 1
     assert r0["coord"] != r1["coord"]
+
+
+def test_eager_collectives_cross_process(dist_results):
+    """Eager all_reduce/all_gather/broadcast perform real cross-process
+    communication (they were single-process identity stubs in round 1)."""
+    r0, r1 = sorted(dist_results, key=lambda r: r["rank"])
+    assert r0["allreduce_sum"] == 3.0 and r1["allreduce_sum"] == 3.0  # 1+2
+    assert r0["allgather"] == [0.0, 1.0] and r1["allgather"] == [0.0, 1.0]
+    assert r0["broadcast_from_1"] == 1.0 and r1["broadcast_from_1"] == 1.0
