@@ -119,13 +119,48 @@ def _eager_allgather(v, group=None):
     return multihost_utils.process_allgather(v)
 
 
+def _group_mode(group):
+    """'world' (communicate over all processes), 'skip' (1-rank group: no
+    communication), or raise for true subgroups — eager multihost collectives
+    are global, and silently mixing groups is the worst failure mode."""
+    if group is None:
+        return "world"
+    n = _n_procs()
+    nranks = getattr(group, "nranks", None)
+    if nranks == 1:
+        return "skip"
+    ax = getattr(group, "axis_name", None)
+    if ax is not None:
+        # axis groups are built with world-sized rank lists; the axis only
+        # covers the world when every OTHER mesh axis has size 1
+        from . import topology as _topo
+
+        hcg = _topo.get_hybrid_communicate_group()
+        mesh = getattr(hcg, "mesh", None) if hcg is not None else None
+        if mesh is not None and ax in mesh.axis_names:
+            import numpy as _nx
+
+            world = int(_nx.prod([mesh.shape[a] for a in mesh.axis_names]))
+            if int(mesh.shape[ax]) == world:
+                return "world"
+            if int(mesh.shape[ax]) == 1:
+                return "skip"
+            raise NotImplementedError(
+                f"eager cross-process collective over mesh axis {ax!r} "
+                f"(a subgroup of the {world}-device world): run it inside a "
+                "jitted/shard_map step where the mesh axis expresses the group")
+        return "world"
+    if nranks in (None, n):
+        return "world"
+    raise NotImplementedError(
+        f"eager cross-process collectives support only the world group "
+        f"({n} processes); got a {nranks}-rank subgroup. Run subgroup "
+        "collectives inside a jitted/shard_map step where the mesh axis "
+        "expresses the group.")
+
+
 def _require_world_group(group):
-    if group is not None and getattr(group, "nranks", None) not in (None, _n_procs()):
-        raise NotImplementedError(
-            f"eager cross-process collectives support only the world group "
-            f"({_n_procs()} processes); got a {group.nranks}-rank subgroup. "
-            "Run subgroup collectives inside a jitted/shard_map step where "
-            "the mesh axis expresses the group.")
+    return _group_mode(group)
 
 
 def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -144,6 +179,8 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                 return jax.lax.pmean(v, ax)
             raise NotImplementedError("PROD all_reduce inside jit")
         if not _in_trace(v) and _n_procs() > 1:
+            if _group_mode(group) == "skip":
+                return v
             g = _eager_allgather(v, group)   # [n_procs, ...]
             if op == ReduceOp.SUM:
                 return jnp.sum(g, 0)
@@ -155,6 +192,7 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True):
                 return jnp.mean(g, 0)
             if op == ReduceOp.PROD:
                 return jnp.prod(g, 0)
+            raise ValueError(f"unknown ReduceOp {op!r}")
         return v  # single-participant eager view
 
     out = apply_op(_f, (tensor,), name="all_reduce")
@@ -173,6 +211,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
         if ax is not None and _in_trace(v):
             return jax.lax.all_gather(v, ax)
         if not _in_trace(v) and _n_procs() > 1:
+            if _group_mode(group) == "skip":
+                return v[None]
             return _eager_allgather(v, group)
         return v[None]
 
@@ -194,7 +234,11 @@ def broadcast(tensor, src, group=None, sync_op=True):
     multi-process: every rank adopts rank `src`'s value."""
     v = tensor._value if isinstance(tensor, Tensor) else tensor
     if not _in_trace(v) and _n_procs() > 1:
-        _require_world_group(group)
+        if _group_mode(group) == "skip":
+            return tensor
+        if not 0 <= int(src) < _n_procs():
+            raise ValueError(
+                f"broadcast src={src} out of range for {_n_procs()} processes")
         from jax.experimental import multihost_utils
 
         # one-to-all primitive: ships ONE copy instead of allgathering

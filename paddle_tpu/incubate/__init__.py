@@ -3,6 +3,7 @@ from . import autograd  # noqa: F401
 from . import moe  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import asp  # noqa: F401
+from . import autotune  # noqa: F401
 from .moe import MoELayer  # noqa: F401
 from ..autograd.tape import no_grad  # noqa: F401
 
@@ -43,7 +44,4 @@ def segment_sum(data, segment_ids):
     return apply_op(_f, (data, segment_ids), name="segment_sum")
 
 
-class autotune:
-    @staticmethod
-    def set_config(config=None):
-        pass
+

@@ -293,6 +293,23 @@ def flash_attention(q, k, v, causal=False, scale=None, block_q=None, block_k=Non
             "use the dense SDPA path")
     if interpret is None:
         interpret = _interpret_default()
+    if block_q is None and block_k is None:
+        from ..incubate import autotune as _autotune
+
+        if _autotune.kernel_autotune_enabled():
+            key = (Sq, Sk, D, bool(causal))
+            cached = _autotune.flash_attention_block_cache.get(key)
+            if cached is None and not isinstance(q, jax.core.Tracer):
+                # first concrete call with this signature: measure candidates
+                # (one-time compile cost per config, the phi autotune contract)
+                sc = 1.0 / (D ** 0.5) if scale is None else float(scale)
+                cached = _autotune.tune_flash_attention(
+                    jnp.swapaxes(jnp.asarray(q), 1, 2).reshape(B * H, Sq, D),
+                    jnp.swapaxes(jnp.asarray(k), 1, 2).reshape(B * H, Sk, D),
+                    jnp.swapaxes(jnp.asarray(v), 1, 2).reshape(B * H, Sk, D),
+                    causal, sc)
+            if cached is not None:
+                block_q, block_k = cached
     bq = min(block_q, Sq) if block_q else _auto_block(Sq)
     bk = min(block_k, Sk) if block_k else _auto_block(Sk)
     if Sq % bq or Sk % bk:

@@ -1,0 +1,77 @@
+"""incubate.autotune (ref python/paddle/incubate/autotune.py + phi
+kernels/autotune cache)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autotune
+
+
+def test_set_config_validation(tmp_path):
+    autotune.set_config({"kernel": {"enable": True}})
+    assert autotune.kernel_autotune_enabled()
+    autotune.disable_autotune()
+    assert not autotune.kernel_autotune_enabled()
+    with pytest.raises(ValueError, match="unknown autotune section"):
+        autotune.set_config({"cudnn": {}})
+    import json
+
+    cfg = tmp_path / "c.json"
+    cfg.write_text(json.dumps({"kernel": {"enable": True}}))
+    autotune.set_config(str(cfg))
+    assert autotune.kernel_autotune_enabled()
+    autotune.disable_autotune()
+
+
+def test_tune_flash_attention_caches_choice():
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((2, 256, 16)), jnp.float32)
+    autotune.flash_attention_block_cache.clear()
+    choice = autotune.tune_flash_attention(q, q, q, causal=True, scale=0.25,
+                                           steps=1)
+    assert choice in [(128, 128), (256, 256)]
+    key = (256, 256, 16, True)
+    assert autotune.flash_attention_block_cache[key] == choice
+    # second call is a pure cache hit
+    again = autotune.tune_flash_attention(q, q, q, causal=True, scale=0.25)
+    assert again == choice
+
+
+def test_flash_attention_consumes_cached_blocks():
+    """With autotune enabled and a cached choice, flash_attention uses it."""
+    import importlib
+
+    fa = importlib.import_module("paddle_tpu.ops.flash_attention")
+
+    autotune.flash_attention_block_cache.clear()
+    autotune.flash_attention_block_cache[(256, 256, 16, True)] = (128, 128)
+    autotune.enable_autotune()
+    try:
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((1, 256, 2, 16)).astype(np.float32)
+        # runs through the cached (128,128) rather than _auto_block's 256
+        out = fa.flash_attention(x, x, x, causal=True)
+        assert tuple(out.shape) == (1, 256, 2, 16)
+    finally:
+        autotune.disable_autotune()
+
+
+def test_autotune_triggers_on_first_concrete_call():
+    """enable_autotune + eager call: the tuner populates the cache itself."""
+    import importlib
+
+    fa = importlib.import_module("paddle_tpu.ops.flash_attention")
+
+    autotune.flash_attention_block_cache.clear()
+    autotune.enable_autotune()
+    try:
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((1, 256, 2, 16)).astype(np.float32)
+        out = fa.flash_attention(x, x, x, causal=True)
+        assert tuple(out.shape) == (1, 256, 2, 16)
+        assert (256, 256, 16, True) in autotune.flash_attention_block_cache
+    finally:
+        autotune.disable_autotune()
+        autotune.flash_attention_block_cache.clear()
