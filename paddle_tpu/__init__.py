@@ -79,6 +79,13 @@ from . import hapi  # noqa: F401
 from .hapi import Model  # noqa: F401
 from . import models  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import utils  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import callbacks  # noqa: F401
+from . import version  # noqa: F401
+from . import hub  # noqa: F401
+from . import reader  # noqa: F401
+from .batch import batch  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401
 from .framework.random import get_cuda_rng_state, set_cuda_rng_state  # noqa: F401
@@ -150,22 +157,6 @@ def complex(real, imag, name=None):
     from .tensor.tensor import apply_op as _ap
 
     return _ap(_lax.complex, (real, imag), name="complex")
-
-
-def batch(reader, batch_size, drop_last=False):
-    """Ref fluid.io.batch — legacy reader-decorator kept for script parity."""
-
-    def _gen():
-        buf = []
-        for item in reader():
-            buf.append(item)
-            if len(buf) == batch_size:
-                yield buf
-                buf = []
-        if buf and not drop_last:
-            yield buf
-
-    return _gen
 
 
 def check_shape(*a, **k):  # static-graph debug helper: shapes are static here

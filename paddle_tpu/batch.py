@@ -1,0 +1,25 @@
+"""paddle.batch — wrap a sample reader into a batch reader.
+
+Ref: python/paddle/batch.py:18 (batch()).
+"""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Turn a reader of samples into a reader of lists of ``batch_size`` samples."""
+    if batch_size <= 0:
+        raise ValueError(f"batch_size should be a positive integer, got {batch_size}")
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

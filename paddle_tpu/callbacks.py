@@ -1,0 +1,16 @@
+"""paddle.callbacks — re-export of the hapi callback zoo.
+
+Ref: python/paddle/callbacks.py (pure re-export of hapi/callbacks.py).
+"""
+from .hapi.callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    ReduceLROnPlateau,
+    VisualDL,
+)
+
+__all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "VisualDL",
+           "LRScheduler", "EarlyStopping", "ReduceLROnPlateau"]

@@ -149,7 +149,8 @@ def _group_mode(group):
                 f"eager cross-process collective over mesh axis {ax!r} "
                 f"(a subgroup of the {world}-device world): run it inside a "
                 "jitted/shard_map step where the mesh axis expresses the group")
-        return "world"
+        # axis not resolvable against any mesh: fall through to the rank-count
+        # check below — never assume world for an unverified subgroup
     if nranks in (None, n):
         return "world"
     raise NotImplementedError(

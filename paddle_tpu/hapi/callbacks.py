@@ -138,6 +138,62 @@ class LRScheduler(Callback):
                 sch.step()
 
 
+class ReduceLROnPlateau(Callback):
+    """Shrink the LR when the monitored metric stops improving
+    (ref hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10, verbose=1,
+                 mode="auto", min_delta=1e-4, cooldown=0, min_lr=0):
+        if factor >= 1.0:
+            raise ValueError("ReduceLROnPlateau does not support a factor >= 1.0")
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        self.mode = "min" if mode == "auto" and "acc" not in monitor else (
+            "max" if mode == "auto" else mode)
+        self.best = None
+        self.wait = 0
+        self.cooldown_counter = 0
+
+    def _better(self, cur):
+        if self.best is None:
+            return True
+        if self.mode == "max":
+            return cur > self.best + self.min_delta
+        return cur < self.best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        if not logs or self.monitor not in logs:
+            return
+        cur = logs[self.monitor]
+        cur = cur[0] if isinstance(cur, (list, tuple)) else cur
+        cur = float(np.asarray(cur).reshape(-1)[0])
+        if self.cooldown_counter > 0:
+            self.cooldown_counter -= 1
+            self.wait = 0
+        if self._better(cur):
+            self.best = cur
+            self.wait = 0
+        elif self.cooldown_counter <= 0:
+            self.wait += 1
+            if self.wait >= self.patience:
+                opt = getattr(self.model, "_optimizer", None)
+                if opt is None:
+                    return
+                old = float(opt.get_lr())
+                new = max(old * self.factor, self.min_lr)
+                if old - new > 1e-12:
+                    opt.set_lr(new)
+                    if self.verbose:
+                        print(f"ReduceLROnPlateau: lr {old:.6g} -> {new:.6g}")
+                self.cooldown_counter = self.cooldown
+                self.wait = 0
+
+
 class VisualDL(Callback):
     def __init__(self, log_dir):
         self.log_dir = log_dir

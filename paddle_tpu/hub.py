@@ -1,0 +1,62 @@
+"""paddle.hub — load entrypoints from a hubconf.py.
+
+Ref: python/paddle/hub.py (list/help/load with github|gitee|local sources).
+This build has no network egress, so only ``source='local'`` is supported;
+remote sources raise with guidance rather than silently failing mid-download.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, _HUBCONF)
+    if not os.path.isfile(path):
+        raise FileNotFoundError(f"no {_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    return mod
+
+
+def _check_source(source):
+    if source not in ("local",):
+        raise RuntimeError(
+            f"hub source '{source}' needs network access, which this build does not "
+            f"have; clone the repo and use source='local' with repo_dir=<path>")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001 (paddle API name)
+    """List callable entrypoints defined by the repo's hubconf.py."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    return [n for n, v in vars(mod).items()
+            if callable(v) and not n.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    """Return the docstring of one entrypoint."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"entrypoint {model} not found in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model).__doc__
+
+
+def load(repo_dir, model, source="local", force_reload=False, **kwargs):
+    """Instantiate an entrypoint: ``hub.load('/path/to/repo', 'resnet50')``."""
+    _check_source(source)
+    mod = _load_hubconf(repo_dir)
+    if not hasattr(mod, model):
+        raise RuntimeError(f"entrypoint {model} not found in {repo_dir}/{_HUBCONF}")
+    return getattr(mod, model)(**kwargs)

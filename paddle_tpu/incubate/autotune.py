@@ -79,6 +79,10 @@ def tune_flash_attention(q, k, v, causal, scale, candidates=None, steps=3):
         opts = [b for b in (128, 256, 512) if Sq % b == 0 and Sk % b == 0]
         candidates = [(b, b) for b in opts] or [(fa._auto_block(Sq),
                                                 fa._auto_block(Sk))]
+    if len(candidates) == 1:
+        # nothing to choose between — skip the warmup compile + timed sync
+        flash_attention_block_cache[key] = candidates[0]
+        return candidates[0]
     best, best_t, last_err = None, float("inf"), None
     for bq, bk in candidates:
         try:

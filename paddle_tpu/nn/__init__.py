@@ -101,3 +101,4 @@ class utils:  # namespace shim: paddle.nn.utils
             n = int(np.prod(p._value.shape))
             p.set_value(vec._value[offset:offset + n].reshape(p._value.shape))
             offset += n
+from . import quant  # noqa: F401

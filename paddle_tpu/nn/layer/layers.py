@@ -134,6 +134,9 @@ class Layer:
             init = default_initializer or (Constant(0.0) if is_bias else XavierUniform())
         p = Parameter(jnp.zeros([int(s) for s in shape], dtype), trainable=trainable,
                       name=(attr.name if attr is not None else None))
+        if attr is not None:
+            p.regularizer = attr.regularizer  # ParamAttr regularizer outranks the optimizer's
+            p.optimize_attr = {"learning_rate": attr.learning_rate}
         init(p)
         return p
 

@@ -1,0 +1,13 @@
+"""paddle.nn.quant — quantization-aware training (ref: python/paddle/nn/quant)."""
+from . import functional_layers  # noqa: F401
+from .quant_layers import (  # noqa: F401
+    FakeQuantAbsMax,
+    FakeQuantChannelWiseAbsMax,
+    FakeQuantMAOutputScaleLayer,
+    FakeQuantMovingAverageAbsMax,
+    MAOutputScaleLayer,
+    MovingAverageAbsMaxScale,
+    QuantizedConv2D,
+    QuantizedConv2DTranspose,
+    QuantizedLinear,
+)

@@ -1,0 +1,52 @@
+"""Layer wrappers over tensor functionals, so elementwise ops appear as
+graph nodes a quantization pass can hook (ref: nn/quant/functional_layers.py)."""
+from __future__ import annotations
+
+from ...tensor import manipulation, math
+from ..layer.layers import Layer
+
+__all__ = []
+
+
+class FloatFunctionalLayer(Layer):
+    pass
+
+
+class add(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return math.add(x, y)
+
+
+class subtract(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return math.subtract(x, y)
+
+
+class multiply(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return math.multiply(x, y)
+
+
+class divide(FloatFunctionalLayer):
+    def forward(self, x, y, name=None):
+        return math.divide(x, y)
+
+
+class reshape(FloatFunctionalLayer):
+    def forward(self, x, shape, name=None):
+        return manipulation.reshape(x, shape)
+
+
+class transpose(FloatFunctionalLayer):
+    def forward(self, x, perm, name=None):
+        return manipulation.transpose(x, perm)
+
+
+class concat(FloatFunctionalLayer):
+    def forward(self, x, axis=0, name=None):
+        return manipulation.concat(x, axis)
+
+
+class flatten(FloatFunctionalLayer):
+    def forward(self, x, start_axis=0, stop_axis=-1, name=None):
+        return manipulation.flatten(x, start_axis, stop_axis)

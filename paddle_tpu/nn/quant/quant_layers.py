@@ -1,0 +1,303 @@
+"""Quantization-aware-training layers.
+
+Ref API: python/paddle/nn/quant/quant_layers.py (FakeQuantAbsMax:47,
+FakeQuantMovingAverageAbsMax:128, FakeQuantChannelWiseAbsMax:226,
+MovingAverageAbsMaxScale:310, QuantizedConv2D:398, QuantizedConv2DTranspose:486,
+QuantizedLinear:591, MAOutputScaleLayer:662, _get_fake_quant_type:722).
+
+TPU-native design: fake quantization is simulated in the compute dtype with a
+straight-through estimator expressed as ``x + stop_gradient(q(x) - x)`` — one
+fused XLA expression, no custom kernels; moving-average scale state lives in
+layer buffers updated functionally (same pattern as BatchNorm running stats).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, apply_op
+from .. import functional as F
+from ..layer.layers import Layer
+
+__all__ = [
+    "FakeQuantAbsMax",
+    "FakeQuantMovingAverageAbsMax",
+    "FakeQuantChannelWiseAbsMax",
+    "MovingAverageAbsMaxScale",
+    "QuantizedConv2D",
+    "QuantizedConv2DTranspose",
+    "QuantizedLinear",
+    "MAOutputScaleLayer",
+    "FakeQuantMAOutputScaleLayer",
+]
+
+
+def _fake_quant(v, scale, bits):
+    """Simulated quantize-dequantize with a straight-through gradient."""
+    bnt = float(2 ** (bits - 1) - 1)
+    s = jnp.maximum(jnp.abs(scale).astype(v.dtype), jnp.asarray(1e-9, v.dtype))
+    q = jnp.clip(jnp.round(v / s * bnt), -bnt, bnt) * s / bnt
+    return v + jax.lax.stop_gradient(q - v)
+
+
+class FakeQuantAbsMax(Layer):
+    """Dynamic per-tensor abs-max fake quant (scale recomputed every forward)."""
+
+    def __init__(self, name=None, quant_bits=8, dtype="float32", quant_on_weight=False):
+        super().__init__()
+        self._quant_bits = quant_bits
+        # exported so a deploy pass can read the calibrated scale (ref keeps a
+        # persistable scale var only for weights)
+        if quant_on_weight:
+            self.register_buffer("scale", Tensor(jnp.zeros([], jnp.float32)))
+        else:
+            self.scale = None
+
+    def forward(self, x):
+        def _f(v):
+            s = jnp.max(jnp.abs(v.astype(jnp.float32)))
+            return _fake_quant(v, s, self._quant_bits)
+
+        out = apply_op(_f, (x,), name="fake_quant_abs_max")
+        if isinstance(self.scale, Tensor):
+            self.scale.set_value(jnp.max(jnp.abs(x._value.astype(jnp.float32))))
+        return out
+
+
+class FakeQuantMovingAverageAbsMax(Layer):
+    """Activation fake quant with an EMA of the abs-max as the scale
+    (ref quant_layers.py:128: state/accum-corrected moving average)."""
+
+    def __init__(self, name=None, moving_rate=0.9, quant_bits=8, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = quant_bits
+        self.register_buffer("scale", Tensor(jnp.zeros([], jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.zeros([], jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.zeros([], jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            r = self._moving_rate
+            cur = jnp.max(jnp.abs(x._value.astype(jnp.float32)))
+            state = self.state._value * r + 1.0
+            accum = self.accum._value * r + cur
+            scale = accum / state
+            self.state.set_value(state)
+            self.accum.set_value(accum)
+            self.scale.set_value(scale)
+        scale = self.scale
+
+        def _f(v, s):
+            return _fake_quant(v, s, self._quant_bits)
+
+        return apply_op(_f, (x, scale), name="fake_quant_moving_avg_abs_max")
+
+
+class FakeQuantChannelWiseAbsMax(Layer):
+    """Per-output-channel abs-max fake quant for weights (ref :226)."""
+
+    def __init__(self, name=None, channel_num=None, quant_bits=8, quant_axis=0,
+                 dtype="float32", quant_on_weight=True):
+        super().__init__()
+        self._quant_bits = quant_bits
+        self._quant_axis = quant_axis
+        if quant_on_weight and channel_num is not None:
+            self.register_buffer("scale", Tensor(jnp.zeros([channel_num], jnp.float32)))
+        else:
+            self.scale = None
+
+    def forward(self, x):
+        axis = self._quant_axis
+
+        def _f(v):
+            red = tuple(i for i in range(v.ndim) if i != axis)
+            s = jnp.max(jnp.abs(v.astype(jnp.float32)), axis=red, keepdims=True)
+            return _fake_quant(v, s, self._quant_bits)
+
+        out = apply_op(_f, (x,), name="fake_quant_channel_wise_abs_max")
+        if isinstance(self.scale, Tensor):
+            red = tuple(i for i in range(x.ndim) if i != axis)
+            self.scale.set_value(jnp.max(jnp.abs(x._value.astype(jnp.float32)), axis=red))
+        return out
+
+
+class MovingAverageAbsMaxScale(Layer):
+    """Observer: records the EMA abs-max of whatever flows through, without
+    altering the value (ref :310 — used to calibrate output scales)."""
+
+    def __init__(self, name=None, moving_rate=0.9, dtype="float32"):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self.register_buffer("scale", Tensor(jnp.zeros([], jnp.float32)))
+        self.register_buffer("state", Tensor(jnp.zeros([], jnp.float32)))
+        self.register_buffer("accum", Tensor(jnp.zeros([], jnp.float32)))
+
+    def forward(self, x):
+        if self.training:
+            r = self._moving_rate
+            cur = jnp.max(jnp.abs(x._value.astype(jnp.float32)))
+            state = self.state._value * r + 1.0
+            accum = self.accum._value * r + cur
+            self.state.set_value(state)
+            self.accum.set_value(accum)
+            self.scale.set_value(accum / state)
+        return x
+
+
+def _get_fake_quant_type(quant_type, **kwargs):
+    """Factory keyed the same way as ref quant_layers.py:722."""
+    call = {
+        "abs_max": FakeQuantAbsMax,
+        "moving_average_abs_max": FakeQuantMovingAverageAbsMax,
+        "channel_wise_abs_max": FakeQuantChannelWiseAbsMax,
+    }
+    if quant_type not in call:
+        raise ValueError(
+            f"unsupported quant type {quant_type}; expected one of {sorted(call)}")
+    cls = call[quant_type]
+    accepted = {
+        FakeQuantAbsMax: ("name", "quant_bits", "dtype", "quant_on_weight"),
+        FakeQuantMovingAverageAbsMax: ("name", "moving_rate", "quant_bits", "dtype"),
+        FakeQuantChannelWiseAbsMax: ("name", "channel_num", "quant_bits",
+                                     "quant_axis", "dtype", "quant_on_weight"),
+    }[cls]
+    return cls(**{k: v for k, v in kwargs.items() if k in accepted})
+
+
+class _QuantizedLayerBase(Layer):
+    def _make_quanters(self, layer, weight_quantize_type, activation_quantize_type,
+                       weight_bits, activation_bits, moving_rate, channel_num,
+                       weight_quant_axis):
+        self._fake_quant_input = _get_fake_quant_type(
+            activation_quantize_type, moving_rate=moving_rate,
+            quant_bits=activation_bits, quant_on_weight=False)
+        self._fake_quant_weight = _get_fake_quant_type(
+            weight_quantize_type, moving_rate=moving_rate, quant_bits=weight_bits,
+            channel_num=channel_num, quant_axis=weight_quant_axis,
+            quant_on_weight=True)
+
+
+class QuantizedConv2D(_QuantizedLayerBase):
+    """Wrap an ``nn.Conv2D``: fake-quant input + weight, then convolve
+    (ref quant_layers.py:398)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_pre_layer=None, act_pre_layer=None,
+                 weight_quant_layer=None, act_quant_layer=None):
+        super().__init__()
+        self._conv = layer
+        if weight_quant_layer is not None or act_quant_layer is not None:
+            self._fake_quant_weight = (weight_quant_layer or (lambda: None))()
+            self._fake_quant_input = (act_quant_layer or (lambda: None))()
+        else:
+            self._make_quanters(layer, weight_quantize_type, activation_quantize_type,
+                                weight_bits, activation_bits, moving_rate,
+                                channel_num=layer.weight.shape[0], weight_quant_axis=0)
+
+    def forward(self, x):
+        if self._fake_quant_input is not None:
+            x = self._fake_quant_input(x)
+        w = self._conv.weight
+        if self._fake_quant_weight is not None:
+            w = self._fake_quant_weight(w)
+        c = self._conv
+        return F.conv2d(x, w, bias=c.bias, stride=c._stride, padding=c._padding,
+                        dilation=c._dilation, groups=c._groups,
+                        data_format=c._data_format)
+
+
+class QuantizedConv2DTranspose(_QuantizedLayerBase):
+    """Ref quant_layers.py:486."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_pre_layer=None, act_pre_layer=None,
+                 weight_quant_layer=None, act_quant_layer=None):
+        super().__init__()
+        self._conv = layer
+        if weight_quant_layer is not None or act_quant_layer is not None:
+            self._fake_quant_weight = (weight_quant_layer or (lambda: None))()
+            self._fake_quant_input = (act_quant_layer or (lambda: None))()
+        else:
+            # transpose-conv weight layout is (in, out/groups, kh, kw): per-
+            # channel scales go on axis 1
+            self._make_quanters(layer, weight_quantize_type, activation_quantize_type,
+                                weight_bits, activation_bits, moving_rate,
+                                channel_num=layer.weight.shape[1], weight_quant_axis=1)
+
+    def forward(self, x):
+        if self._fake_quant_input is not None:
+            x = self._fake_quant_input(x)
+        w = self._conv.weight
+        if self._fake_quant_weight is not None:
+            w = self._fake_quant_weight(w)
+        c = self._conv
+        return F.conv2d_transpose(x, w, bias=c.bias, stride=c._stride,
+                                  padding=c._padding, dilation=c._dilation,
+                                  groups=c._groups, data_format=c._data_format,
+                                  output_padding=getattr(c, "_output_padding", 0))
+
+
+class QuantizedLinear(_QuantizedLayerBase):
+    """Ref quant_layers.py:591."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_pre_layer=None, act_pre_layer=None,
+                 weight_quant_layer=None, act_quant_layer=None):
+        super().__init__()
+        self._linear = layer
+        if weight_quant_layer is not None or act_quant_layer is not None:
+            self._fake_quant_weight = (weight_quant_layer or (lambda: None))()
+            self._fake_quant_input = (act_quant_layer or (lambda: None))()
+        else:
+            # linear weight is (in, out): per-channel scales on the out axis
+            self._make_quanters(layer, weight_quantize_type, activation_quantize_type,
+                                weight_bits, activation_bits, moving_rate,
+                                channel_num=layer.weight.shape[1], weight_quant_axis=1)
+
+    def forward(self, x):
+        if self._fake_quant_input is not None:
+            x = self._fake_quant_input(x)
+        w = self._linear.weight
+        if self._fake_quant_weight is not None:
+            w = self._fake_quant_weight(w)
+        return F.linear(x, w, self._linear.bias)
+
+
+class MAOutputScaleLayer(Layer):
+    """Attach a MovingAverageAbsMaxScale observer to a layer's output (ref :662)."""
+
+    def __init__(self, layer=None, moving_rate=0.9, name=None, dtype="float32"):
+        super().__init__()
+        self._layer = layer
+        self._ma_output_scale = MovingAverageAbsMaxScale(name, moving_rate, dtype)
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, Tensor):
+            return self._ma_output_scale(out)
+        return out
+
+
+class FakeQuantMAOutputScaleLayer(Layer):
+    """Fake-quant a layer's output with a moving-average scale (ref :689)."""
+
+    def __init__(self, layer, weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 name=None, *args, **kwargs):
+        super().__init__()
+        self._layer = layer
+        self._fake_quant_output = _get_fake_quant_type(
+            "moving_average_abs_max", moving_rate=moving_rate,
+            quant_bits=activation_bits)
+
+    def forward(self, *inputs, **kwargs):
+        out = self._layer(*inputs, **kwargs)
+        if isinstance(out, Tensor):
+            return self._fake_quant_output(out)
+        return out

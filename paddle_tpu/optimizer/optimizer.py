@@ -86,9 +86,27 @@ class Optimizer:
         wd = self._weight_decay
         if wd is None:
             return 0.0
-        if hasattr(wd, "_coeff"):  # L2Decay regularizer
+        if hasattr(wd, "_coeff"):  # L1Decay/L2Decay regularizer
             return float(wd._coeff)
         return float(wd)
+
+    def _decay_spec(self, p):
+        """(coeff, mode, lr_scale) for one parameter.  A ``ParamAttr``
+        regularizer outranks the optimizer-level ``weight_decay`` (ref
+        regularizer.py priority rule); an L1Decay anywhere selects the l1
+        penalty; lr_scale is ParamAttr(learning_rate=...) (ref
+        optimizer.py _create_param_lr)."""
+        lr_scale = 1.0
+        oa = getattr(p, "optimize_attr", None)
+        if isinstance(oa, dict):
+            lr_scale = float(oa.get("learning_rate", 1.0))
+        reg = getattr(p, "regularizer", None)
+        if reg is not None and hasattr(reg, "_coeff"):
+            return float(reg._coeff), getattr(reg, "_mode", "l2"), lr_scale
+        wd = self._weight_decay
+        if wd is not None and hasattr(wd, "_mode") and wd._mode == "l1":
+            return float(wd._coeff), "l1", lr_scale
+        return self._decay_coeff(), self._decay_mode(), lr_scale
 
     def _clipped_grads(self, params_and_grads):
         clip = self._grad_clip
@@ -118,11 +136,19 @@ class Optimizer:
         un-donate the jitted step every call)."""
         if g.dtype != p_val.dtype:
             g = g.astype(p_val.dtype)
-        if decay and self._decay_mode() == "l2":
-            g = g + decay * p_val
+        if isinstance(decay, tuple):
+            coeff, mode, lr_scale = decay if len(decay) == 3 else (*decay, 1.0)
+        else:
+            coeff, mode, lr_scale = decay, self._decay_mode(), 1.0
+        if lr_scale != 1.0:
+            lr = lr * lr_scale
+        if coeff and mode == "l2":
+            g = g + coeff * p_val
+        elif coeff and mode == "l1":
+            g = g + coeff * jnp.sign(p_val)
         new_p, new_state = self._update_rule(p_val, g, state, lr)
-        if decay and self._decay_mode() == "decoupled":
-            new_p = new_p - lr * decay * p_val
+        if coeff and mode == "decoupled":
+            new_p = new_p - lr * coeff * p_val
         if new_p.dtype != p_val.dtype:
             new_p = new_p.astype(p_val.dtype)
         new_state = {
@@ -147,8 +173,9 @@ class Optimizer:
             self._accumulators[id(p)] = new_state
 
     def _param_decay_coeff(self, p):
-        """Per-parameter decay (overridden by AdamW's apply_decay_param_fun)."""
-        return self._decay_coeff()
+        """Per-parameter (coeff, mode) decay spec (overridden by AdamW's
+        apply_decay_param_fun)."""
+        return self._decay_spec(p)
 
     def _decay_mode(self):
         return "l2"
@@ -242,8 +269,9 @@ class AdamW(Adam):
 
     def _param_decay_coeff(self, p):
         if self._apply_decay_param_fun is not None and not self._apply_decay_param_fun(p.name):
-            return 0.0
-        return self._decay_coeff()
+            _, _, lr_scale = self._decay_spec(p)
+            return 0.0, "decoupled", lr_scale
+        return self._decay_spec(p)
 
 
 class Adagrad(Optimizer):

@@ -1,0 +1,191 @@
+"""paddle.reader — composable sample-reader decorators.
+
+Ref: python/paddle/reader/decorator.py (cache/map_readers/shuffle/chain/
+compose/buffered/firstn/xmap_readers).  A "reader" is a zero-arg callable
+returning an iterable of samples; these helpers wrap readers into new readers.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import random as _random
+import threading
+
+__all__ = ["cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+           "firstn", "xmap_readers", "ComposeNotAligned"]
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def cache(reader):
+    """Eagerly read every sample once, then replay from memory."""
+    all_data = tuple(reader())
+
+    def cached_reader():
+        return iter(all_data)
+
+    return cached_reader
+
+
+def map_readers(func, *readers):
+    """Yield func(*one_sample_from_each_reader)."""
+
+    def reader():
+        for items in zip(*[r() for r in readers]):
+            yield func(*items)
+
+    return reader
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of buf_size samples."""
+
+    def shuffled_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                _random.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            _random.shuffle(buf)
+            yield from buf
+
+    return shuffled_reader
+
+
+def chain(*readers):
+    """Concatenate readers back to back."""
+
+    def chained_reader():
+        return itertools.chain(*[r() for r in readers])
+
+    return chained_reader
+
+
+def compose(*readers, **kwargs):
+    """Zip readers sample-wise into flat tuples; check_alignment (default True)
+    raises ComposeNotAligned when one reader runs short."""
+    check_alignment = kwargs.pop("check_alignment", True)
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed_reader():
+        iters = [iter(r()) for r in readers]
+        while True:
+            outputs = []
+            done = 0
+            for it in iters:
+                try:
+                    outputs.append(next(it))
+                except StopIteration:
+                    done += 1
+                    outputs.append(None)
+            if done == len(iters):
+                return
+            if done > 0:
+                if check_alignment:
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned (some ended early)")
+                return
+            yield sum((make_tuple(o) for o in outputs), ())
+
+    return composed_reader
+
+
+def buffered(reader, size):
+    """Prefetch up to `size` samples on a producer thread."""
+
+    class _End:
+        pass
+
+    def buffered_reader():
+        q = queue.Queue(maxsize=size)
+
+        def produce():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            finally:
+                q.put(_End)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is _End:
+                return
+            yield sample
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """Limit the reader to its first n samples."""
+
+    def firstn_reader():
+        return itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Apply `mapper` over samples with `process_num` worker threads.
+    With order=True results keep the source order (index-tagged reorder,
+    same contract as the reference's ordered XmapEndSignal pipeline)."""
+
+    class _End:
+        pass
+
+    def xreader():
+        in_q = queue.Queue(buffer_size)
+        out_q = queue.Queue(buffer_size)
+
+        def feed():
+            for i, sample in enumerate(reader()):
+                in_q.put((i, sample))
+            for _ in range(process_num):
+                in_q.put(_End)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _End:
+                    out_q.put(_End)
+                    return
+                i, sample = item
+                out_q.put((i, mapper(sample)))
+
+        threading.Thread(target=feed, daemon=True).start()
+        workers = [threading.Thread(target=work, daemon=True) for _ in range(process_num)]
+        for w in workers:
+            w.start()
+
+        finished = 0
+        if not order:
+            while finished < process_num:
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                yield item[1]
+        else:
+            pending = {}
+            next_idx = 0
+            while finished < process_num or pending:
+                if next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+                    continue
+                item = out_q.get()
+                if item is _End:
+                    finished += 1
+                    continue
+                i, mapped = item
+                pending[i] = mapped
+
+    return xreader

@@ -1,0 +1,37 @@
+"""paddle.regularizer — L1/L2 weight decay regularizers.
+
+Ref: python/paddle/regularizer.py (L1Decay/L2Decay over fluid.regularizer).
+Semantics: a regularizer set on a ``ParamAttr`` takes priority over one set on
+the optimizer's ``weight_decay``; the optimizer folds the penalty gradient into
+each parameter's gradient before the update rule
+(see Optimizer._param_decay_coeff / _apply_update).
+"""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    _mode = "l2"
+
+    def __init__(self, coeff=0.0):
+        self._coeff = float(coeff)
+
+    @property
+    def coeff(self):
+        return self._coeff
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """loss += coeff * 0.5 * sum(x^2)  =>  grad += coeff * x."""
+
+    _mode = "l2"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """loss += coeff * sum(|x|)  =>  grad += coeff * sign(x)."""
+
+    _mode = "l1"

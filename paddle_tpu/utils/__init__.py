@@ -1,6 +1,8 @@
 """paddle.utils parity shims."""
 from __future__ import annotations
 
+from . import dlpack  # noqa: F401
+
 
 def try_import(name):
     import importlib

@@ -1,0 +1,38 @@
+"""paddle.version parity (ref: python/paddle/version.py, generated at build time
+from setup.py; here maintained by hand alongside pyproject.toml)."""
+from __future__ import annotations
+
+full_version = "2.3.0"
+major = "2"
+minor = "3"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+cuda_version = "False"
+cudnn_version = "False"
+
+
+def show():
+    """Print the version info (ref version.py show())."""
+    print("full_version:", full_version)
+    print("major:", major)
+    print("minor:", minor)
+    print("patch:", patch)
+    print("rc:", rc)
+    print("commit:", commit)
+
+
+def mkl():
+    return with_mkl
+
+
+def cuda():
+    """TPU build: no CUDA. Kept for scripts that branch on it."""
+    return cuda_version
+
+
+def cudnn():
+    return cudnn_version

@@ -1,0 +1,148 @@
+"""paddle.regularizer / utils / reader / batch / hub / callbacks / version.
+
+Ref shapes: python/paddle/regularizer.py, reader/decorator.py, batch.py,
+hub.py, utils/dlpack.py, callbacks.py, version.py.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.framework.param_attr import ParamAttr
+
+
+def test_version():
+    assert paddle.version.full_version.count(".") == 2
+    assert paddle.version.cuda() == "False"
+    paddle.version.show()
+
+
+def test_batch():
+    r = paddle.batch(lambda: iter(range(10)), 3)
+    assert [b for b in r()] == [[0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    r = paddle.batch(lambda: iter(range(10)), 3, drop_last=True)
+    assert [b for b in r()] == [[0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    with pytest.raises(ValueError):
+        paddle.batch(lambda: iter([]), 0)
+
+
+def test_dlpack_roundtrip():
+    t = paddle.to_tensor(np.arange(6.0).reshape(2, 3).astype(np.float32))
+    t2 = paddle.utils.dlpack.from_dlpack(paddle.utils.dlpack.to_dlpack(t))
+    np.testing.assert_allclose(np.asarray(t2._value), np.arange(6.0).reshape(2, 3))
+
+
+def test_l1_decay_optimizer_level():
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters(),
+                               weight_decay=paddle.regularizer.L1Decay(0.5))
+    lin(paddle.ones([2, 4])).sum().backward()
+    w0 = np.asarray(lin.weight._value).copy()
+    g0 = np.asarray(lin.weight._grad).copy()
+    opt.step()
+    np.testing.assert_allclose(np.asarray(lin.weight._value),
+                               w0 - 0.1 * (g0 + 0.5 * np.sign(w0)), atol=1e-6)
+
+
+def test_param_attr_regularizer_outranks_optimizer():
+    paddle.seed(0)
+    lin = nn.Linear(4, 4, weight_attr=ParamAttr(
+        regularizer=paddle.regularizer.L2Decay(0.3)))
+    # the optimizer's 0.9 must be ignored for the weight (ParamAttr priority)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[lin.weight],
+                               weight_decay=0.9)
+    lin(paddle.ones([2, 4])).sum().backward()
+    w0 = np.asarray(lin.weight._value).copy()
+    g0 = np.asarray(lin.weight._grad).copy()
+    opt.step()
+    np.testing.assert_allclose(np.asarray(lin.weight._value),
+                               w0 - 0.1 * (g0 + 0.3 * w0), atol=1e-6)
+
+
+def test_param_attr_learning_rate_scales_update():
+    """ParamAttr(learning_rate=0.1) must scale that parameter's effective LR
+    (ref optimizer.py _create_param_lr)."""
+    paddle.seed(0)
+    lin = nn.Linear(4, 4, weight_attr=ParamAttr(learning_rate=0.1))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=lin.parameters())
+    lin(paddle.ones([2, 4])).sum().backward()
+    w0 = np.asarray(lin.weight._value).copy()
+    g0 = np.asarray(lin.weight._grad).copy()
+    b0 = np.asarray(lin.bias._value).copy()
+    gb = np.asarray(lin.bias._grad).copy()
+    opt.step()
+    np.testing.assert_allclose(np.asarray(lin.weight._value), w0 - 0.1 * g0, atol=1e-6)
+    # bias has no ParamAttr: full LR
+    np.testing.assert_allclose(np.asarray(lin.bias._value), b0 - 1.0 * gb, atol=1e-6)
+
+
+def test_adamw_with_param_regularizer_in_trainstep():
+    """The (coeff, mode) spec must survive the jitted TrainStep path too."""
+    paddle.seed(0)
+    lin = nn.Linear(4, 4, weight_attr=ParamAttr(
+        regularizer=paddle.regularizer.L1Decay(0.1)))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-2, parameters=lin.parameters())
+    step = paddle.jit.TrainStep(lin, lambda x, y: ((lin(x) - y) ** 2).mean(), opt)
+    x = paddle.ones([2, 4])
+    y = paddle.zeros([2, 4])
+    l0 = float(step(x, y).item())
+    l1 = float(step(x, y).item())
+    assert np.isfinite(l0) and np.isfinite(l1) and l1 < l0
+
+
+def test_reader_decorators():
+    src = lambda: iter(range(12))
+    assert list(paddle.reader.firstn(src, 5)()) == [0, 1, 2, 3, 4]
+    assert list(paddle.reader.cache(src)()) == list(range(12))
+    assert list(paddle.reader.chain(src, src)()) == list(range(12)) * 2
+    assert list(paddle.reader.buffered(src, 4)()) == list(range(12))
+    assert sorted(paddle.reader.shuffle(src, 6)()) == list(range(12))
+    m = paddle.reader.map_readers(lambda a, b: a + b, src, src)
+    assert list(m()) == [2 * i for i in range(12)]
+    c = paddle.reader.compose(src, src)
+    assert list(c())[:2] == [(0, 0), (1, 1)]
+    with pytest.raises(paddle.reader.ComposeNotAligned):
+        list(paddle.reader.compose(src, lambda: iter(range(3)))())
+    xm = paddle.reader.xmap_readers(lambda s: s * 2, src, 4, 8, order=True)
+    assert list(xm()) == [2 * i for i in range(12)]
+    xm = paddle.reader.xmap_readers(lambda s: s * 2, src, 4, 8, order=False)
+    assert sorted(xm()) == [2 * i for i in range(12)]
+
+
+def test_hub(tmp_path):
+    hc = tmp_path / "hubconf.py"
+    hc.write_text("def lenet(num_classes=10):\n"
+                  "    'tiny lenet entrypoint'\n"
+                  "    import paddle_tpu as p\n"
+                  "    return p.vision.models.LeNet(num_classes=num_classes)\n")
+    d = str(tmp_path)
+    assert paddle.hub.list(d) == ["lenet"]
+    assert "lenet" in paddle.hub.help(d, "lenet") or "tiny" in paddle.hub.help(d, "lenet")
+    m = paddle.hub.load(d, "lenet", num_classes=7)
+    assert type(m).__name__ == "LeNet"
+    with pytest.raises(RuntimeError):
+        paddle.hub.load(d, "missing")
+    with pytest.raises(RuntimeError):
+        paddle.hub.list(d, source="github")
+
+
+def test_reduce_lr_on_plateau():
+    paddle.seed(0)
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=lin.parameters())
+
+    class M:  # minimal hapi-model stand-in
+        _optimizer = opt
+
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=1, verbose=0)
+    cb.set_model(M())
+    cb.on_eval_end({"loss": 1.0})   # sets best
+    cb.on_eval_end({"loss": 1.0})   # 1 bad epoch >= patience -> shrink
+    assert abs(opt.get_lr() - 0.5) < 1e-9
+    cb.on_eval_end({"loss": 0.1})   # improvement resets the wait counter
+    cb.on_eval_end({"loss": 0.2})   # bad again -> shrink once more
+    assert abs(opt.get_lr() - 0.25) < 1e-9

@@ -1,0 +1,75 @@
+"""paddle.nn.quant QAT layers (ref: python/paddle/nn/quant/quant_layers.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.nn.quant.quant_layers import _fake_quant, _get_fake_quant_type
+
+
+def test_fake_quant_levels():
+    """8-bit fake quant snaps values onto the 255-level abs-max grid."""
+    import jax.numpy as jnp
+
+    v = jnp.asarray(np.linspace(-1.0, 1.0, 17, dtype=np.float32))
+    out = np.asarray(_fake_quant(v, jnp.asarray(1.0), 8))
+    levels = np.round(np.asarray(v) * 127) / 127
+    np.testing.assert_allclose(out, levels, atol=1e-6)
+
+
+def test_quantized_linear_close_and_differentiable():
+    paddle.seed(0)
+    lin = nn.Linear(16, 8)
+    q = nn.quant.QuantizedLinear(lin, weight_quantize_type="channel_wise_abs_max")
+    x = paddle.to_tensor(np.random.RandomState(0).randn(4, 16).astype(np.float32))
+    err = float(paddle.abs(q(x) - lin(x)).max().item())
+    assert err < 0.05
+    (q(x) ** 2).mean().backward()
+    g = np.asarray(lin.weight._grad)
+    assert np.isfinite(g).all() and np.abs(g).max() > 0
+
+
+def test_quantized_conv2d_and_transpose():
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 6, 3, padding=1)
+    qc = nn.quant.QuantizedConv2D(conv)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 3, 8, 8).astype(np.float32))
+    assert float(paddle.abs(qc(x) - conv(x)).max().item()) < 0.2
+    qc(x).sum().backward()
+    assert conv.weight._grad is not None
+
+    ct = nn.Conv2DTranspose(3, 6, 3)
+    qt = nn.quant.QuantizedConv2DTranspose(ct)
+    assert qt(x).shape == ct(x).shape
+
+
+def test_moving_average_scale_converges():
+    paddle.seed(0)
+    fq = nn.quant.FakeQuantMovingAverageAbsMax(moving_rate=0.5)
+    x = paddle.ones([4, 4]) * 2.0
+    for _ in range(8):
+        fq(x)
+    # EMA of a constant abs-max converges to that abs-max
+    assert abs(float(fq.scale._value) - 2.0) < 1e-3
+    fq.eval()
+    s_before = float(fq.scale._value)
+    fq(x * 100)  # eval mode must not move the scale
+    assert float(fq.scale._value) == s_before
+
+
+def test_observer_and_output_quant_wrappers():
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    obs = nn.quant.MAOutputScaleLayer(lin)
+    x = paddle.ones([2, 4])
+    out = obs(x)
+    np.testing.assert_allclose(np.asarray(out._value), np.asarray(lin(x)._value))
+    assert float(obs._ma_output_scale.scale._value) > 0
+
+    fq = nn.quant.FakeQuantMAOutputScaleLayer(lin)
+    assert fq(x).shape == [2, 4]
+
+
+def test_factory_rejects_unknown():
+    with pytest.raises(ValueError):
+        _get_fake_quant_type("int4_exotic")
