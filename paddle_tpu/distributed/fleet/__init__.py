@@ -47,8 +47,9 @@ class DistributedStrategy:
               fuse_all_reduce_ops, fuse_grad_size_in_MB, nccl_comm_num,
               find_unused_parameters, heter_ccl_mode,
               without_graph_optimization.
-    unsupported (raise when enabled): dgc, localsgd (gradient compression /
-              local-SGD rewrites contradict the single-program SPMD step).
+    localsgd{k_steps} / dgc{rampup_begin_step, sparsity} select the
+              shard_map meta-optimizer steps in meta_optimizers.py (per-worker
+              param copies / compressed gradient sync over the dp axis).
     """
 
     _CONFIG_KEYS = {
@@ -67,6 +68,8 @@ class DistributedStrategy:
                              "schedule_mode", "enable_partial_send_recv"},
         "tensor_parallel_configs": {"tensor_parallel_degree", "tensor_init_seed"},
         "gradient_merge_configs": {"k_steps", "avg"},
+        "localsgd_configs": {"k_steps", "begin_step"},
+        "dgc_configs": {"rampup_begin_step", "rampup_step", "sparsity"},
         "gradient_scale_configs": {"scale_strategy"},
         "hybrid_configs": {"dp_degree", "mp_degree", "pp_degree",
                            "sharding_degree", "sep_degree"},
@@ -91,7 +94,9 @@ class DistributedStrategy:
             "lamb": False,
             "lars": False,
             "dgc": False,
+            "dgc_configs": {"rampup_begin_step": 0, "sparsity": 0.999},
             "localsgd": False,
+            "localsgd_configs": {"k_steps": 4, "begin_step": 1},
             "gradient_scale_configs": {"scale_strategy": "avg"},
             "find_unused_parameters": False,
             "fuse_all_reduce_ops": True,
@@ -114,10 +119,9 @@ class DistributedStrategy:
                 f"DistributedStrategy has no knob {name!r} "
                 f"(known: {sorted(cfg)})")
         if name in ("dgc", "localsgd") and value:
-            raise NotImplementedError(
-                f"DistributedStrategy.{name}: gradient compression / local-SGD "
-                f"program rewrites are not supported on the TPU build — the "
-                f"SPMD partitioner owns gradient communication")
+            other = "localsgd" if name == "dgc" else "dgc"
+            if cfg.get(other):
+                raise ValueError("dgc and localsgd are mutually exclusive")
         allowed = self._CONFIG_KEYS.get(name)
         if allowed is not None:
             unknown = set(value) - allowed
@@ -248,6 +252,30 @@ class _Fleet:
         zero_stage = 0
         if s.sharding:
             zero_stage = int(s.sharding_configs.get("stage", 2))
+
+        if s.localsgd or s.dgc:
+            bad = [k for k, on in (("amp", s.amp), ("sharding", s.sharding),
+                                   ("gradient_merge", s.gradient_merge),
+                                   ("pipeline", self._hcg.get_pipe_parallel_world_size() > 1))
+                   if on]
+            if bad:
+                raise NotImplementedError(
+                    f"localsgd/dgc cannot be combined with {bad} — they own the "
+                    f"dp-axis gradient schedule")
+            from .meta_optimizers import DGCTrainStep, LocalSGDTrainStep
+
+            if s.localsgd:
+                return LocalSGDTrainStep(
+                    model, loss_fn, inner_opt, self._hcg.mesh,
+                    k_steps=int(s.localsgd_configs.get("k_steps", 4)))
+            c = s.dgc_configs
+            sparsity = c.get("sparsity", 0.999)
+            if isinstance(sparsity, (list, tuple)):
+                sparsity = sparsity[-1]
+            return DGCTrainStep(
+                model, loss_fn, inner_opt, self._hcg.mesh,
+                sparsity=float(sparsity),
+                rampup_begin_step=int(c.get("rampup_begin_step", 0)))
 
         if self._hcg.get_pipe_parallel_world_size() > 1:
             if scaler is not None or (s.gradient_merge and accum > 1):

@@ -30,12 +30,16 @@ def test_unknown_knob_raises():
     assert s.amp_configs["init_loss_scaling"] == 1024
 
 
-def test_unsupported_rewrites_raise():
+def test_localsgd_dgc_knobs_accepted_but_exclusive():
+    # both knobs are now real (meta_optimizers.py) — only the combination raises
     s = DistributedStrategy()
-    with pytest.raises(NotImplementedError, match="dgc"):
-        s.dgc = True
-    with pytest.raises(NotImplementedError, match="localsgd"):
+    s.dgc = True
+    with pytest.raises(ValueError, match="mutually exclusive"):
         s.localsgd = True
+    s2 = DistributedStrategy()
+    s2.localsgd = True
+    s2.localsgd_configs = {"k_steps": 8}
+    assert s2.localsgd_configs["k_steps"] == 8
 
 
 def test_strategy_consumed_by_train_step():

@@ -1,0 +1,148 @@
+"""LocalSGD + DGC meta-optimizer train steps
+(ref fleet/meta_optimizers/localsgd_optimizer.py, dgc_optimizer.py).
+
+Oracle (SURVEY §4): numeric parity vs the dense single-program step —
+LocalSGD with k=1 and DGC with sparsity=0 must both equal dense DP SGD.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+from paddle_tpu.distributed.fleet.meta_optimizers import DGCTrainStep, LocalSGDTrainStep
+
+rng = np.random.RandomState(0)
+X = rng.randn(32, 16).astype(np.float32)
+Y = X @ rng.randn(16, 4).astype(np.float32)
+
+
+def _model():
+    paddle.seed(42)
+    return nn.Sequential(nn.Linear(16, 16), nn.Tanh(), nn.Linear(16, 4))
+
+
+def _mse(model):
+    return lambda a, b: ((model(a) - b) ** 2).mean()
+
+
+def _dense_reference(steps=5):
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = paddle.jit.TrainStep(m, _mse(m), opt)
+    for _ in range(steps):
+        step(paddle.to_tensor(X), paddle.to_tensor(Y))
+    return {k: np.asarray(p._value) for k, p in m.named_parameters()}
+
+
+def test_localsgd_k1_equals_dense_dp():
+    ref = _dense_reference()
+    mesh = dist.build_mesh(dp=4)
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    ls = LocalSGDTrainStep(m, _mse(m), opt, mesh, k_steps=1)
+    for _ in range(5):
+        ls(paddle.to_tensor(X), paddle.to_tensor(Y))
+    for k, p in m.named_parameters():
+        np.testing.assert_allclose(np.asarray(p._value), ref[k], atol=2e-5)
+
+
+def test_localsgd_diverges_then_syncs():
+    mesh = dist.build_mesh(dp=4)
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    ls = LocalSGDTrainStep(m, _mse(m), opt, mesh, k_steps=3)
+    l0 = float(ls(paddle.to_tensor(X), paddle.to_tensor(Y)).item())
+    key = next(iter(ls._pstk))
+    rows = np.asarray(ls._pstk[key])
+    assert not np.allclose(rows[0], rows[1]), "replicas must diverge between syncs"
+    for _ in range(2):
+        l = float(ls(paddle.to_tensor(X), paddle.to_tensor(Y)).item())
+    rows = np.asarray(ls._pstk[key])
+    np.testing.assert_allclose(rows[0], rows[1], atol=1e-6)
+    assert l < l0
+    # sync_params mid-interval averages and writes back into the model
+    ls(paddle.to_tensor(X), paddle.to_tensor(Y))
+    ls.sync_params()
+    rows = np.asarray(ls._pstk[key])
+    np.testing.assert_allclose(rows[0], rows[-1], atol=1e-6)
+
+
+def test_dgc_dense_mode_equals_dense_dp():
+    ref = _dense_reference()
+    mesh = dist.build_mesh(dp=4)
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    dg = DGCTrainStep(m, _mse(m), opt, mesh, sparsity=0.0, momentum=0.9)
+    for _ in range(5):
+        dg(paddle.to_tensor(X), paddle.to_tensor(Y))
+    for k, p in m.named_parameters():
+        np.testing.assert_allclose(np.asarray(p._value), ref[k], atol=2e-5)
+
+
+def test_dgc_sparse_trains_and_accumulates_residual():
+    mesh = dist.build_mesh(dp=4)
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    dg = DGCTrainStep(m, _mse(m), opt, mesh, sparsity=0.9, momentum=0.9)
+    losses = [float(dg(paddle.to_tensor(X), paddle.to_tensor(Y)).item())
+              for _ in range(20)]
+    assert losses[-1] < 0.5 * losses[0]
+    e = np.asarray(dg._e[next(iter(dg._e))])
+    assert np.abs(e).max() > 0, "unsent residual must accumulate"
+
+
+def test_dgc_rampup_dense_until_begin_step():
+    mesh = dist.build_mesh(dp=4)
+    ref = _dense_reference(steps=2)
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    dg = DGCTrainStep(m, _mse(m), opt, mesh, sparsity=0.9, momentum=0.9,
+                      rampup_begin_step=2)
+    for _ in range(2):
+        dg(paddle.to_tensor(X), paddle.to_tensor(Y))
+    for k, p in m.named_parameters():
+        np.testing.assert_allclose(np.asarray(p._value), ref[k], atol=2e-5)
+
+
+def test_fleet_strategy_routes_to_meta_optimizers():
+    s = DistributedStrategy()
+    s.localsgd = True
+    s.localsgd_configs = {"k_steps": 2}
+    s.hybrid_configs = {"dp_degree": 4}
+    fleet.init(is_collective=True, strategy=s)
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    step = fleet.distributed_train_step(m, _mse(m), opt)
+    assert isinstance(step, LocalSGDTrainStep) and step.k_steps == 2
+    l0 = float(step(paddle.to_tensor(X), paddle.to_tensor(Y)).item())
+    for _ in range(3):
+        l = float(step(paddle.to_tensor(X), paddle.to_tensor(Y)).item())
+    assert l < l0
+
+    s2 = DistributedStrategy()
+    s2.dgc = True
+    s2.dgc_configs = {"sparsity": 0.5, "rampup_begin_step": 1}
+    s2.hybrid_configs = {"dp_degree": 4}
+    fleet.init(is_collective=True, strategy=s2)
+    m2 = _model()
+    opt2 = paddle.optimizer.SGD(learning_rate=0.05, parameters=m2.parameters())
+    step2 = fleet.distributed_train_step(m2, _mse(m2), opt2)
+    assert isinstance(step2, DGCTrainStep) and step2.sparsity == 0.5
+
+
+def test_mutually_exclusive_and_incompatible():
+    s = DistributedStrategy()
+    s.localsgd = True
+    with pytest.raises(ValueError):
+        s.dgc = True
+    s2 = DistributedStrategy()
+    s2.dgc = True
+    s2.amp = True
+    s2.hybrid_configs = {"dp_degree": 4}
+    fleet.init(is_collective=True, strategy=s2)
+    m = _model()
+    opt = paddle.optimizer.SGD(learning_rate=0.05, parameters=m.parameters())
+    with pytest.raises(NotImplementedError):
+        fleet.distributed_train_step(m, _mse(m), opt)
