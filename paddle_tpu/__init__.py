@@ -85,6 +85,9 @@ from . import callbacks  # noqa: F401
 from . import version  # noqa: F401
 from . import hub  # noqa: F401
 from . import reader  # noqa: F401
+from . import dataset  # noqa: F401
+from . import compat  # noqa: F401
+from . import cost_model  # noqa: F401
 from .batch import batch  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401

@@ -1,0 +1,79 @@
+"""paddle.compat — py2/3 string + math compatibility helpers
+(ref: python/paddle/compat.py:25,121,206,232,249)."""
+from __future__ import annotations
+
+import math
+
+__all__ = []
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """Decode bytes (recursively through list/set/dict) to str."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [to_text(x, encoding) for x in obj]
+            return obj
+        return [to_text(x, encoding) for x in obj]
+    if isinstance(obj, set):
+        if inplace:
+            new = {to_text(x, encoding) for x in obj}
+            obj.clear()
+            obj.update(new)
+            return obj
+        return {to_text(x, encoding) for x in obj}
+    if isinstance(obj, dict):
+        if inplace:
+            for k in list(obj):
+                obj[to_text(k, encoding)] = to_text(obj.pop(k), encoding)
+            return obj
+        return {to_text(k, encoding): to_text(v, encoding) for k, v in obj.items()}
+    if isinstance(obj, bytes):
+        return obj.decode(encoding)
+    return obj
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """Encode str (recursively through list/set/dict) to bytes."""
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [to_bytes(x, encoding) for x in obj]
+            return obj
+        return [to_bytes(x, encoding) for x in obj]
+    if isinstance(obj, set):
+        if inplace:
+            new = {to_bytes(x, encoding) for x in obj}
+            obj.clear()
+            obj.update(new)
+            return obj
+        return {to_bytes(x, encoding) for x in obj}
+    if isinstance(obj, dict):
+        if inplace:
+            for k in list(obj):
+                obj[to_bytes(k, encoding)] = to_bytes(obj.pop(k), encoding)
+            return obj
+        return {to_bytes(k, encoding): to_bytes(v, encoding) for k, v in obj.items()}
+    if isinstance(obj, str):
+        return obj.encode(encoding)
+    return obj
+
+
+def round(x, d=0):  # noqa: A001 — paddle API name
+    """Python-2-style round (half away from zero)."""
+    p = 10 ** d
+    if x > 0:
+        return float(math.floor((x * p) + math.copysign(0.5, x))) / p
+    if x < 0:
+        return float(math.ceil((x * p) + math.copysign(0.5, x))) / p
+    return math.copysign(0.0, x)
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

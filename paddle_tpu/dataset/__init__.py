@@ -1,0 +1,11 @@
+"""paddle.dataset — the legacy reader-creator namespace
+(ref: python/paddle/dataset/{mnist,cifar,uci_housing,imdb,imikolov}.py).
+
+Each submodule exposes zero-arg reader creators (`train()`, `test()`) that
+yield legacy sample tuples.  Backed by the modern `paddle.vision.datasets` /
+`paddle.text` Dataset classes, which warn + fall back to generated stand-in
+data when the real corpus files are absent (this build cannot download).
+"""
+from . import cifar, imdb, imikolov, mnist, uci_housing  # noqa: F401
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov"]

@@ -1,0 +1,37 @@
+"""Legacy CIFAR readers (ref: python/paddle/dataset/cifar.py — train10()/
+test10()/train100()/test100() yield (3072-float32 image in [0,1], int label))."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader(cls_name, mode):
+    def reader():
+        from ..vision import datasets as vd
+
+        ds = getattr(vd, cls_name)(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            # the Dataset already yields [0,1], which is the legacy contract
+            img = np.asarray(img, np.float32).reshape(-1)
+            yield img, int(np.asarray(label).reshape(-1)[0])
+
+    return reader
+
+
+def train10():
+    return _reader("Cifar10", "train")
+
+
+def test10():
+    return _reader("Cifar10", "test")
+
+
+def train100():
+    return _reader("Cifar100", "train")
+
+
+def test100():
+    return _reader("Cifar100", "test")

@@ -1,0 +1,40 @@
+"""Legacy IMDB readers (ref: python/paddle/dataset/imdb.py — word_dict(),
+train(word_idx)/test(word_idx) yield (list-of-word-ids, 0/1 label))."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["word_dict", "train", "test"]
+
+
+def _ds(mode, cutoff=150):
+    from ..text import Imdb
+
+    return Imdb(mode=mode, cutoff=cutoff, synthetic=True)
+
+
+def word_dict(cutoff=150):
+    """Word -> id map.  With synthetic data the vocabulary is the id space
+    itself (the corpus loader builds the real map when given data_file)."""
+    ds = _ds("train", cutoff)
+    if ds.word_idx:
+        return ds.word_idx
+    vocab = int(max(int(np.max(d)) for d in ds.docs)) + 1
+    return {str(i): i for i in range(vocab)}
+
+
+def _reader(mode):
+    def reader():
+        ds = _ds(mode)
+        for doc, label in zip(ds.docs, ds.labels):
+            yield list(np.asarray(doc, np.int64)), int(label)
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader("train")
+
+
+def test(word_idx=None):
+    return _reader("test")

@@ -1,0 +1,53 @@
+"""Legacy imikolov (PTB-style) n-gram readers (ref: python/paddle/dataset/
+imikolov.py — build_dict(), train(word_idx, n)/test(word_idx, n) yield n-gram
+tuples of word ids).  Without the real tarball this build serves a generated
+Zipf-distributed corpus (warned once), same contract as the other datasets.
+"""
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+__all__ = ["build_dict", "train", "test"]
+
+_VOCAB = 2048
+_warned = False
+
+
+def _corpus(mode):
+    global _warned
+    if not _warned:
+        warnings.warn(
+            "imikolov: no local PTB corpus and this build cannot download — "
+            "using GENERATED Zipf text (pipeline smoke tests only)", stacklevel=3)
+        _warned = True
+    rng = np.random.RandomState(0 if mode == "train" else 1)
+    n_sent = 512 if mode == "train" else 64
+    # Zipf-ish over the vocab, sentences of 5-30 tokens
+    for _ in range(n_sent):
+        ln = rng.randint(5, 30)
+        yield list((rng.zipf(1.3, ln) % (_VOCAB - 2)).astype(np.int64) + 2)
+
+
+def build_dict(min_word_freq=50):
+    return {str(i): i for i in range(_VOCAB)}
+
+
+def _ngram_reader(mode, word_idx, n):
+    def reader():
+        for sent in _corpus(mode):
+            s = [1] + sent + [2]  # <s> ... <e>
+            if len(s) >= n:
+                for i in range(n, len(s) + 1):
+                    yield tuple(s[i - n:i])
+
+    return reader
+
+
+def train(word_idx, n, data_type=1):
+    return _ngram_reader("train", word_idx, n)
+
+
+def test(word_idx, n, data_type=1):
+    return _ngram_reader("test", word_idx, n)

@@ -1,0 +1,29 @@
+"""Legacy MNIST readers (ref: python/paddle/dataset/mnist.py — train()/test()
+yield (784-float32 image in [-1, 1], int label))."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode):
+    def reader():
+        from ..vision.datasets import MNIST
+
+        ds = MNIST(mode=mode)
+        for i in range(len(ds)):
+            img, label = ds[i]
+            # the Dataset yields [0,1]; the legacy reader contract is [-1,1]
+            img = np.asarray(img, np.float32).reshape(-1) * 2.0 - 1.0
+            yield img, int(np.asarray(label).reshape(-1)[0])
+
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

@@ -1,0 +1,27 @@
+"""Legacy UCI-Housing readers (ref: python/paddle/dataset/uci_housing.py —
+train()/test() yield (13-float32 features, 1-float32 price))."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader(mode):
+    def reader():
+        from ..text import UCIHousing
+
+        ds = UCIHousing(mode=mode, synthetic=True)
+        for i in range(len(ds)):
+            x, y = ds[i]
+            yield np.asarray(x, np.float32), np.asarray(y, np.float32).reshape(-1)
+
+    return reader
+
+
+def train():
+    return _reader("train")
+
+
+def test():
+    return _reader("test")

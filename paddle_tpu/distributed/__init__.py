@@ -28,6 +28,7 @@ from .store import Store, TCPStore  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import fleet_executor  # noqa: F401
 from . import launch  # noqa: F401
+from . import utils  # noqa: F401
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

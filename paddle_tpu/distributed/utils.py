@@ -1,0 +1,72 @@
+"""paddle.distributed.utils (ref: python/paddle/distributed/utils.py).
+
+global_scatter/global_gather are the reference's MoE dispatch ops
+(operators/collective/global_scatter_op.cc): rows of `x` are routed to
+(expert, rank) buckets by count tensors.  On TPU the compiled MoE path is
+`incubate.MoELayer`'s dense-capacity `lax.all_to_all` (SURVEY §7.1); these
+functions cover the eager/debug path.
+"""
+from __future__ import annotations
+
+import logging
+import socket
+
+import numpy as np
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["global_scatter", "global_gather", "get_logger", "get_host_name_ip"]
+
+
+def _world(group):
+    from .env import get_world_size
+
+    return get_world_size() if group is None else getattr(group, "nranks", 1)
+
+
+def global_scatter(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Route rows of x to experts by counts (ref distributed/utils.py:57).
+
+    Single-process: every destination is local, so the op is the identity on
+    the row payload (rows are already expert-ordered by construction).
+    Multi-process eager dispatch is not supported — use incubate.MoELayer,
+    whose all_to_all compiles onto ICI."""
+    n = _world(group)
+    if n > 1:
+        raise NotImplementedError(
+            "eager multi-process global_scatter is not supported on the TPU "
+            "build; use paddle.incubate.MoELayer (compiled all_to_all) instead")
+    lc = np.asarray(local_count._value if isinstance(local_count, Tensor) else local_count)
+    if int(lc.sum()) != int(x.shape[0]):
+        raise ValueError(
+            f"local_count sums to {int(lc.sum())} but x has {x.shape[0]} rows")
+    return x
+
+
+def global_gather(x, local_count, global_count, group=None, use_calc_stream=True):
+    """Inverse of global_scatter (ref distributed/utils.py:180)."""
+    n = _world(group)
+    if n > 1:
+        raise NotImplementedError(
+            "eager multi-process global_gather is not supported on the TPU "
+            "build; use paddle.incubate.MoELayer (compiled all_to_all) instead")
+    return x
+
+
+def get_logger(log_level=20, name="root"):
+    logger = logging.getLogger(name)
+    logger.setLevel(log_level)
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(name)s %(levelname)s: %(message)s"))
+        logger.addHandler(h)
+    return logger
+
+
+def get_host_name_ip():
+    try:
+        host = socket.gethostname()
+        return host, socket.gethostbyname(host)
+    except OSError:
+        return None

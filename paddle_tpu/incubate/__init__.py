@@ -5,6 +5,8 @@ from . import checkpoint  # noqa: F401
 from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
 from .moe import MoELayer  # noqa: F401
+from . import optimizer  # noqa: F401
+from .optimizer import LookAhead, ModelAverage  # noqa: F401
 from ..autograd.tape import no_grad  # noqa: F401
 
 
@@ -24,6 +26,49 @@ class nn:  # incubate.nn fused layers namespace (fused == XLA-fused on TPU)
         MultiHeadAttention as FusedMultiHeadAttention,
         TransformerEncoderLayer as FusedTransformerEncoderLayer,
     )
+
+    class FusedFeedForward:
+        """linear -> activation -> dropout -> linear -> dropout -> residual+LN
+        (ref incubate/nn/layer/fused_transformer.py FusedFeedForward) — on TPU
+        "fused" means XLA fuses the chain; one Layer keeps the API."""
+
+        def __new__(cls, d_model, dim_feedforward, dropout_rate=0.1,
+                    epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                    normalize_before=False, linear1_weight_attr=None,
+                    linear1_bias_attr=None, linear2_weight_attr=None,
+                    linear2_bias_attr=None, ln1_scale_attr=None,
+                    ln1_bias_attr=None, ln2_scale_attr=None,
+                    ln2_bias_attr=None, name=None):
+            from .. import nn as _nn
+            from ..nn import functional as _F
+
+            class _FFN(_nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.linear1 = _nn.Linear(d_model, dim_feedforward,
+                                              weight_attr=linear1_weight_attr,
+                                              bias_attr=linear1_bias_attr)
+                    self.linear2 = _nn.Linear(dim_feedforward, d_model,
+                                              weight_attr=linear2_weight_attr,
+                                              bias_attr=linear2_bias_attr)
+                    self.norm = _nn.LayerNorm(d_model, epsilon=epsilon)
+                    self.dropout1 = _nn.Dropout(
+                        dropout_rate if act_dropout_rate is None else act_dropout_rate)
+                    self.dropout2 = _nn.Dropout(dropout_rate)
+                    self._act = getattr(_F, activation)
+                    self._pre = normalize_before
+
+                def forward(self, x):
+                    residual = x
+                    if self._pre:
+                        x = self.norm(x)
+                    x = self.dropout2(self.linear2(self.dropout1(self._act(self.linear1(x)))))
+                    x = residual + x
+                    if not self._pre:
+                        x = self.norm(x)
+                    return x
+
+            return _FFN()
 
 
 def graph_send_recv(*args, **kwargs):

@@ -1,0 +1,157 @@
+"""paddle.incubate.optimizer — LookAhead and ModelAverage.
+
+Ref: python/paddle/incubate/optimizer/lookahead.py (LookAhead:48),
+modelaverage.py (ModelAverage:29, over the average_accumulates op).
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..tensor.tensor import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """k fast steps with the inner optimizer, then pull the slow weights
+    alpha of the way toward the fast ones and restart from there.
+
+    slow = slow + alpha * (fast - slow);  fast = slow   (every k steps)
+    """
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        if inner_optimizer is None:
+            raise ValueError("inner_optimizer can not be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError(f"alpha should be in [0, 1], got {alpha}")
+        if k < 1:
+            raise ValueError(f"k should be a positive integer, got {k}")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+        self._slow = None
+        self._k_count = 0
+
+    def _params(self):
+        return self.inner_optimizer._params()
+
+    @tape.no_grad()
+    def step(self):
+        if self._slow is None:
+            self._slow = {id(p): p._value for p in self._params()}
+        self.inner_optimizer.step()
+        self._k_count += 1
+        if self._k_count % self.k == 0:
+            for p in self._params():
+                slow = self._slow[id(p)]
+                new_slow = slow + self.alpha * (p._value - slow)
+                p._rebind(new_slow.astype(p._value.dtype))
+                self._slow[id(p)] = new_slow
+
+    def clear_grad(self, set_to_zero=False):
+        self.inner_optimizer.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def set_lr(self, value):
+        self.inner_optimizer.set_lr(value)
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, None
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        sd["@LookAhead.k_count"] = self._k_count
+        for i, p in enumerate(self._params()):
+            if self._slow is not None:
+                sd[f"@LookAhead.slow_{p.name or i}"] = Tensor(self._slow[id(p)])
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._k_count = int(state_dict.pop("@LookAhead.k_count", 0))
+        slow = {}
+        for i, p in enumerate(self._params()):
+            key = f"@LookAhead.slow_{p.name or i}"
+            if key in state_dict:
+                v = state_dict.pop(key)
+                slow[id(p)] = v._value if isinstance(v, Tensor) else jnp.asarray(v)
+        if slow:
+            self._slow = slow
+        self.inner_optimizer.set_state_dict(state_dict)
+
+
+class ModelAverage:
+    """Running average of parameter values over a trailing window; `apply()`
+    swaps the averages in for evaluation, `restore()` swaps back.
+
+    Window semantics follow the reference accumulator scheme: the target
+    window is W = clip(num_updates * average_window_rate, min_average_window,
+    max_average_window); a two-chunk (previous + current) accumulator bounds
+    the actual averaged span to [W, 2W).
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000000,
+                 name=None):
+        if parameters is None:
+            raise ValueError("ModelAverage needs an explicit parameters list")
+        self.rate = float(average_window_rate)
+        self.min_w = int(min_average_window)
+        self.max_w = int(max_average_window)
+        self._parameters = [p for p in parameters if not p.stop_gradient]
+        self._old = {id(p): jnp.zeros_like(p._value, jnp.float32) for p in self._parameters}
+        self._old_n = 0
+        self._cur = {id(p): jnp.zeros_like(p._value, jnp.float32) for p in self._parameters}
+        self._cur_n = 0
+        self._updates = 0
+        self._backup = None
+
+    @tape.no_grad()
+    def step(self):
+        """Accumulate the current parameter values (call after optimizer.step())."""
+        self._updates += 1
+        for p in self._parameters:
+            self._cur[id(p)] = self._cur[id(p)] + p._value.astype(jnp.float32)
+        self._cur_n += 1
+        window = int(min(max(self._updates * self.rate, self.min_w), self.max_w))
+        if self._cur_n >= window:
+            self._old, self._old_n = self._cur, self._cur_n
+            self._cur = {id(p): jnp.zeros_like(p._value, jnp.float32)
+                         for p in self._parameters}
+            self._cur_n = 0
+
+    def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
+        self.step()
+        return None, None
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged params in (ref modelaverage.py apply())."""
+        n = self._old_n + self._cur_n
+        if n == 0:
+            yield
+            return
+        self._backup = {id(p): p._value for p in self._parameters}
+        for p in self._parameters:
+            avg = (self._old[id(p)] + self._cur[id(p)]) / n
+            p._rebind(avg.astype(p._value.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p in self._parameters:
+            p._rebind(self._backup[id(p)])
+        self._backup = None

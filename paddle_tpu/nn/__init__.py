@@ -67,38 +67,11 @@ class ClipGradByValue:
 
 
 def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
-    """paddle.nn.utils.clip_grad_norm_ parity (eager)."""
-    import jax.numpy as jnp
+    """paddle.nn.utils.clip_grad_norm_ (delegates to the nn.utils impl)."""
+    from .utils import clip_grad_norm_ as _impl
 
-    params = [p for p in parameters if p._grad is not None]
-    if not params:
-        return None
-    total = jnp.sqrt(sum(jnp.sum(jnp.square(p._grad.astype(jnp.float32))) for p in params))
-    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
-    for p in params:
-        p._grad = (p._grad.astype(jnp.float32) * scale).astype(p._grad.dtype)
-    from ..tensor.tensor import Tensor
-
-    return Tensor(total)
+    return _impl(parameters, max_norm, norm_type, error_if_nonfinite)
 
 
-class utils:  # namespace shim: paddle.nn.utils
-    clip_grad_norm_ = staticmethod(clip_grad_norm_)
-
-    @staticmethod
-    def parameters_to_vector(parameters):
-        import jax.numpy as jnp
-        from ..tensor.tensor import Tensor
-
-        return Tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
-
-    @staticmethod
-    def vector_to_parameters(vec, parameters):
-        import numpy as np
-
-        offset = 0
-        for p in parameters:
-            n = int(np.prod(p._value.shape))
-            p.set_value(vec._value[offset:offset + n].reshape(p._value.shape))
-            offset += n
-from . import quant  # noqa: F401
+from . import utils  # noqa: F401,E402
+from . import quant  # noqa: F401,E402

@@ -1,0 +1,201 @@
+"""paddle.nn.utils — gradient clipping, param (de)flattening, and the
+weight/spectral norm reparametrization hooks.
+
+Ref: python/paddle/nn/utils/{clip_grad_norm_.py, transform_parameters.py,
+weight_norm_hook.py:158, spectral_norm_hook.py:130}.
+
+TPU-native: the reparametrized weight is recomputed from (g, v) inside the
+forward pre-hook, so it is part of the traced graph — gradients flow to g/v
+through jax.vjp exactly like any other op; no custom kernels.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...tensor.tensor import Tensor, Parameter, apply_op
+
+__all__ = ["clip_grad_norm_", "parameters_to_vector", "vector_to_parameters",
+           "weight_norm", "remove_weight_norm", "spectral_norm"]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    """Scale grads in place so the global norm is <= max_norm."""
+    params = [p for p in parameters if p._grad is not None]
+    if not params:
+        return None
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(p._grad.astype(jnp.float32))) for p in params]))
+    else:
+        total = sum(jnp.sum(jnp.abs(p._grad.astype(jnp.float32)) ** norm_type)
+                    for p in params) ** (1.0 / norm_type)
+    if error_if_nonfinite and not bool(jnp.isfinite(total)):
+        raise RuntimeError(
+            f"the total norm of gradients is non-finite ({float(total)}); set "
+            f"error_if_nonfinite=False to clip anyway")
+    scale = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    for p in params:
+        p._grad = (p._grad.astype(jnp.float32) * scale).astype(p._grad.dtype)
+    return Tensor(total)
+
+
+def parameters_to_vector(parameters, name=None):
+    return Tensor(jnp.concatenate([p._value.reshape(-1) for p in parameters]))
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    import numpy as np
+
+    offset = 0
+    for p in parameters:
+        n = int(np.prod(p._value.shape))
+        p.set_value(vec._value[offset:offset + n].reshape(p._value.shape))
+        offset += n
+
+
+# --------------------------------------------------------------- weight norm
+
+def _norm_except(v, dim):
+    """L2 norm over every axis except `dim` (dim=None/-1: whole-tensor norm)."""
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32))))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v.astype(jnp.float32)), axis=axes, keepdims=True))
+
+
+class _WeightNormHook:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+
+        def _f(gv, vv):
+            n = _norm_except(vv, self.dim)
+            return (vv.astype(jnp.float32) / (n + 1e-12) * gv.astype(jnp.float32)).astype(vv.dtype)
+
+        return apply_op(_f, (g, v), name="weight_norm")
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute(layer))
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Reparametrize layer.<name> as g * v / ||v|| (ref weight_norm_hook.py:158).
+
+    Replaces the parameter with <name>_g (the per-slice norms along `dim`)
+    and <name>_v (the direction); the effective weight is rebuilt every
+    forward inside the trace."""
+    if hasattr(layer, "_weight_norm_hooks") and name in layer._weight_norm_hooks:
+        raise RuntimeError(f"weight_norm already applied to {name!r}")
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    if dim is not None:
+        dim = dim % w._value.ndim  # negative dims: paddle allows -1 for last
+    hook = _WeightNormHook(name, dim)
+    g0 = _norm_except(w._value, dim)
+    layer.add_parameter(name + "_g", Parameter(g0.astype(w._value.dtype)))
+    layer.add_parameter(name + "_v", Parameter(w._value))
+    del layer._parameters[name]
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        object.__setattr__(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, handle)
+    object.__setattr__(layer, name, hook.compute(layer))
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Fold g*v/||v|| back into a plain parameter (ref weight_norm_hook.py:208)."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"weight_norm was not applied to {name!r}")
+    hook, handle = hooks.pop(name)
+    w = hook.compute(layer)
+    handle.remove()
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    if hasattr(layer, name):
+        try:
+            object.__delattr__(layer, name)
+        except AttributeError:
+            pass
+    layer.add_parameter(name, Parameter(w._value))
+    return layer
+
+
+# -------------------------------------------------------------- spectral norm
+
+class _SpectralNormHook:
+    def __init__(self, name, n_power_iterations, eps, dim):
+        self.name = name
+        self.n_power_iterations = n_power_iterations
+        self.eps = eps
+        self.dim = dim
+
+    def _mat(self, w):
+        if self.dim != 0:
+            perm = [self.dim] + [i for i in range(w.ndim) if i != self.dim]
+            w = jnp.transpose(w, perm)
+        return w.reshape(w.shape[0], -1)
+
+    def compute(self, layer, update_u):
+        w = getattr(layer, self.name + "_orig")
+        u = getattr(layer, self.name + "_u")
+
+        wv = w._value
+        mat = self._mat(wv.astype(jnp.float32))
+        uv = u._value
+        if update_u:
+            for _ in range(self.n_power_iterations):
+                v = mat.T @ uv
+                v = v / (jnp.linalg.norm(v) + self.eps)
+                uv = mat @ v
+                uv = uv / (jnp.linalg.norm(uv) + self.eps)
+            u.set_value(uv)
+        v = mat.T @ uv
+        v = v / (jnp.linalg.norm(v) + self.eps)
+
+        def _f(wval):
+            m = self._mat(wval.astype(jnp.float32))
+            sigma = uv @ (m @ v)
+            return (wval.astype(jnp.float32) / sigma).astype(wval.dtype)
+
+        return apply_op(_f, (w,), name="spectral_norm")
+
+    def __call__(self, layer, inputs):
+        object.__setattr__(layer, self.name, self.compute(layer, layer.training))
+        return None
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12, dim=None):
+    """Normalize layer.<name> by its largest singular value, estimated with
+    power iteration on a persistent `u` buffer (ref spectral_norm_hook.py:130)."""
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"layer has no parameter {name!r}")
+    if dim is None:
+        # Linear keeps out_features on axis 1, and transpose convs keep them
+        # on axis 1 of their [in, out/groups, *k] weights (ref
+        # spectral_norm_hook.py:158); plain convs use axis 0
+        dim = 1 if type(layer).__name__ in (
+            "Linear", "Conv1DTranspose", "Conv2DTranspose", "Conv3DTranspose",
+        ) else 0
+    dim = dim % w._value.ndim
+    hook = _SpectralNormHook(name, n_power_iterations, eps, dim)
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    h = w._value.shape[dim]
+    u0 = rng.randn(h).astype(np.float32)
+    u0 /= (np.linalg.norm(u0) + eps)
+    layer.add_parameter(name + "_orig", Parameter(w._value))
+    layer.register_buffer(name + "_u", Tensor(jnp.asarray(u0)))
+    del layer._parameters[name]
+    layer.register_forward_pre_hook(hook)
+    object.__setattr__(layer, name, hook.compute(layer, update_u=False))
+    return layer

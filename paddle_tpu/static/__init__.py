@@ -436,3 +436,67 @@ class IpuStrategy:
 class IpuCompiledProgram:
     def __init__(self, *a, **k):
         raise NotImplementedError("IPU support is out of scope for the TPU build")
+
+
+# paddle.static.amp — the static-graph mixed-precision surface maps onto the
+# same autocast/GradScaler machinery (ref static/amp re-exports
+# fluid/contrib/mixed_precision; on TPU one amp implementation serves both
+# eager and traced programs since @to_static traces through autocast).
+# `decorate` keeps the STATIC signature (optimizer-first), unlike eager
+# amp.decorate(models, ...).
+import types as _types  # noqa: E402
+
+from .. import amp as _amp_mod  # noqa: E402
+
+amp = _types.ModuleType("paddle_tpu.static.amp")
+amp.__dict__.update({k: v for k, v in _amp_mod.__dict__.items()
+                     if not k.startswith("_")})
+
+
+def _static_amp_decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
+                         incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+                         incr_ratio=2.0, decr_ratio=0.8,
+                         use_dynamic_loss_scaling=True, use_pure_fp16=False,
+                         use_fp16_guard=None):
+    """Static-graph decorate (ref static/amp/decorator.py): wraps the optimizer
+    so step() runs under autocast with a GradScaler.  Returns an object with
+    the optimizer interface plus .amp_init (a no-op on TPU: bf16 needs no
+    master-weight cast pass)."""
+    scaler = _amp_mod.GradScaler(
+        init_loss_scaling=init_loss_scaling,
+        incr_every_n_steps=incr_every_n_steps,
+        decr_every_n_nan_or_inf=decr_every_n_nan_or_inf,
+        incr_ratio=incr_ratio, decr_ratio=decr_ratio,
+        use_dynamic_loss_scaling=use_dynamic_loss_scaling)
+
+    class _DecoratedOptimizer:
+        def __init__(self, inner):
+            self._inner = inner
+            self._scaler = scaler
+            self._level = "O2" if use_pure_fp16 else "O1"
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def backward(self, loss, **kw):
+            self._scaler.scale(loss).backward()
+            return []
+
+        def apply_gradients(self, params_grads=None):
+            self._scaler.step(self._inner)
+            self._scaler.update()
+
+        def minimize(self, loss, startup_program=None, parameter_list=None,
+                     no_grad_set=None):
+            self.backward(loss)
+            self.apply_gradients()
+            return None, None
+
+        def amp_init(self, place=None, scope=None, test_program=None,
+                     use_fp16_test=False):
+            pass
+
+    return _DecoratedOptimizer(optimizer)
+
+
+amp.decorate = _static_amp_decorate

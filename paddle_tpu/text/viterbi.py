@@ -1,0 +1,101 @@
+"""Viterbi decoding for linear-chain CRFs.
+
+Ref: python/paddle/text/viterbi_decode.py:24 (viterbi_decode op + ViterbiDecoder
+layer; kernel at paddle/phi/kernels/cpu/viterbi_decode_kernel.cc).
+
+TPU-native: one lax.scan forward pass carrying (alpha, final_alpha) and
+emitting backpointers, one reverse scan for the path — static shapes, no
+host loop; padding steps (t >= length) carry identity backpointers so the
+backtrack needs no special casing.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer.layers import Layer
+from ..tensor.tensor import Tensor
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _viterbi(pot, trans, lengths, with_bos_eos):
+    B, L, T = pot.shape
+    pot = pot.astype(jnp.float32)
+    trans = trans.astype(jnp.float32)
+    lengths = lengths.astype(jnp.int32)
+
+    alpha0 = pot[:, 0]
+    if with_bos_eos:
+        # last row of transitions = scores out of the start tag
+        alpha0 = alpha0 + trans[-1][None, :]
+    # sequences shorter than 1 don't occur; final_alpha snapshots alpha at t==len-1
+    final0 = alpha0
+
+    idx_bp = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+
+    def fwd(carry, t):
+        (alpha, final) = carry
+        # scores[b, i, j] = alpha[b, i] + trans[i, j]
+        scores = alpha[:, :, None] + trans[None, :, :]
+        best = jnp.max(scores, axis=1) + pot[:, t]
+        bp = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        active = (t < lengths)[:, None]
+        alpha = jnp.where(active, best, alpha)
+        bp = jnp.where(active, bp, idx_bp)
+        final = jnp.where((t == lengths - 1)[:, None], alpha, final)
+        return (alpha, final), bp
+
+    (alpha, final), bps = jax.lax.scan(fwd, (alpha0, final0), jnp.arange(1, L))
+
+    if with_bos_eos:
+        # second-to-last column = scores into the stop tag
+        final = final + trans[:, -2][None, :]
+
+    scores = jnp.max(final, axis=-1)
+    last_tag = jnp.argmax(final, axis=-1).astype(jnp.int32)
+
+    # backtrack: bps[s] holds the argmax of the transition t=s -> t=s+1
+    def bwd(tag, bp_t):
+        prev = jnp.take_along_axis(bp_t, tag[:, None], axis=1)[:, 0]
+        return prev, prev
+
+    _, rev_tags = jax.lax.scan(bwd, last_tag, bps, reverse=True)
+    path = jnp.concatenate([rev_tags, last_tag[None, :]], axis=0).T  # [B, L]
+    # zero out padding region (t >= length), matching fixed-shape output
+    tpos = jnp.arange(L, dtype=jnp.int32)[None, :]
+    path = jnp.where(tpos < lengths[:, None], path, 0)
+    return scores, path.astype(jnp.int64)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Highest-scoring tag sequence under emission `potentials` [B, L, T] and
+    `transition_params` [T, T] (ref viterbi_decode.py:24).
+
+    Returns (scores [B] float32, path [B, L] int64); positions past each
+    sequence's `lengths` are 0 in the path.
+    """
+    pot = potentials._value if isinstance(potentials, Tensor) else jnp.asarray(potentials)
+    trans = (transition_params._value if isinstance(transition_params, Tensor)
+             else jnp.asarray(transition_params))
+    lens = lengths._value if isinstance(lengths, Tensor) else jnp.asarray(lengths)
+    scores, path = _viterbi(pot, trans, lens, bool(include_bos_eos_tag))
+    s = Tensor(scores)
+    p = Tensor(path)
+    s.stop_gradient = True
+    p.stop_gradient = True
+    return s, p
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper (ref viterbi_decode.py:92)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

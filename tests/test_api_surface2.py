@@ -1,0 +1,189 @@
+"""Round-2 API surface batch: viterbi_decode, nn.utils weight/spectral norm,
+incubate.optimizer LookAhead/ModelAverage, cost_model, compat, legacy
+paddle.dataset readers, distributed.utils, static.amp, FusedFeedForward.
+"""
+import itertools
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+# ------------------------------------------------------------------ viterbi
+
+def _brute_viterbi(pot, trans, length, bos_eos):
+    T = trans.shape[0]
+    best, path = -1e30, None
+    for tags in itertools.product(range(T), repeat=length):
+        s = pot[0, tags[0]] + (trans[-1, tags[0]] if bos_eos else 0.0)
+        for t in range(1, length):
+            s += trans[tags[t - 1], tags[t]] + pot[t, tags[t]]
+        if bos_eos:
+            s += trans[tags[length - 1], -2]
+        if s > best:
+            best, path = s, tags
+    return best, path
+
+
+@pytest.mark.parametrize("bos_eos", [False, True])
+def test_viterbi_decode_matches_brute_force(bos_eos):
+    rng = np.random.RandomState(0)
+    B, L, T = 3, 5, 4
+    pot = rng.randn(B, L, T).astype(np.float32)
+    trans = rng.randn(T, T).astype(np.float32)
+    lengths = np.array([5, 3, 4], np.int64)
+    scores, paths = paddle.text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lengths), include_bos_eos_tag=bos_eos)
+    s, p = np.asarray(scores._value), np.asarray(paths._value)
+    for b in range(B):
+        bs, bp = _brute_viterbi(pot[b], trans, int(lengths[b]), bos_eos)
+        assert abs(s[b] - bs) < 1e-4
+        assert tuple(p[b, :lengths[b]]) == bp
+        assert (p[b, lengths[b]:] == 0).all()
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(1)
+    pot = rng.randn(2, 4, 3).astype(np.float32)
+    trans = rng.randn(3, 3).astype(np.float32)
+    dec = paddle.text.ViterbiDecoder(paddle.to_tensor(trans))
+    s, p = dec(paddle.to_tensor(pot), paddle.to_tensor(np.array([4, 4], np.int64)))
+    assert s.shape == [2] and p.shape == [2, 4]
+
+
+# -------------------------------------------------------------- weight norm
+
+def test_weight_norm_roundtrip_and_grads():
+    paddle.seed(0)
+    lin = nn.Linear(8, 16)
+    w0 = np.asarray(lin.weight._value).copy()
+    nn.utils.weight_norm(lin, "weight", dim=1)
+    assert "weight" not in lin._parameters
+    assert {"weight_g", "weight_v"} <= set(lin._parameters)
+    out = lin(paddle.ones([2, 8]))
+    np.testing.assert_allclose(np.asarray(lin.weight._value), w0, atol=1e-5)
+    out.sum().backward()
+    assert lin.weight_g._grad is not None and lin.weight_v._grad is not None
+    nn.utils.remove_weight_norm(lin, "weight")
+    assert "weight" in lin._parameters
+    np.testing.assert_allclose(np.asarray(lin.weight._value), w0, atol=1e-5)
+    with pytest.raises(ValueError):
+        nn.utils.remove_weight_norm(lin, "weight")
+
+
+def test_spectral_norm_unit_sigma():
+    paddle.seed(0)
+    conv = nn.Conv2D(3, 8, 3)
+    nn.utils.spectral_norm(conv, "weight", n_power_iterations=4)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32))
+    for _ in range(5):  # let the power iteration converge across forwards
+        y = conv(x)
+    W = np.asarray(conv.weight._value).reshape(8, -1)
+    sigma = np.linalg.svd(W, compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.1
+    y.sum().backward()
+    assert conv.weight_orig._grad is not None
+
+
+# ------------------------------------------------- incubate optimizers
+
+def test_lookahead_pulls_toward_slow():
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    inner = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    la = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+    x = paddle.ones([2, 4])
+    w0 = np.asarray(lin.weight._value).copy()
+    (lin(x) ** 2).mean().backward()
+    la.step(); la.clear_grad()
+    w_fast1 = np.asarray(lin.weight._value).copy()
+    (lin(x) ** 2).mean().backward()
+    la.step(); la.clear_grad()
+    # after the k=2 sync: w = slow + 0.5*(fast2 - slow), strictly between
+    w_now = np.asarray(lin.weight._value)
+    assert not np.allclose(w_now, w_fast1)
+    losses = []
+    for _ in range(6):
+        loss = (lin(x) ** 2).mean()
+        loss.backward(); la.step(); la.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0]
+    with pytest.raises(ValueError):
+        paddle.incubate.LookAhead(inner, alpha=1.5)
+
+
+def test_model_average_apply_restore():
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    ma = paddle.incubate.ModelAverage(0.15, parameters=lin.parameters(),
+                                      min_average_window=2, max_average_window=10)
+    x = paddle.ones([2, 4])
+    for _ in range(3):
+        (lin(x) ** 2).mean().backward()
+        opt.step(); opt.clear_grad(); ma.step()
+    before = np.asarray(lin.weight._value).copy()
+    with ma.apply():
+        inside = np.asarray(lin.weight._value).copy()
+    after = np.asarray(lin.weight._value)
+    assert not np.allclose(before, inside)
+    np.testing.assert_allclose(before, after)
+
+
+# ----------------------------------------------------------- small surfaces
+
+def test_cost_model():
+    cm = paddle.cost_model.CostModel()
+    c = cm.static_cost(lambda a, b: a @ b, paddle.ones([64, 64]), paddle.ones([64, 64]))
+    assert c["flops"] >= 2 * 64 * 64 * 64 * 0.5  # backend counts macs or flops
+    r = cm.profile_measure(lambda a, b: a @ b, paddle.ones([64, 64]),
+                           paddle.ones([64, 64]), steps=2, warmup=1)
+    assert r["time_s"] > 0
+
+
+def test_compat():
+    assert paddle.compat.to_text(b"abc") == "abc"
+    assert paddle.compat.to_bytes("abc") == b"abc"
+    assert paddle.compat.to_text([b"a", {b"k": b"v"}]) == ["a", {"k": "v"}]
+    assert paddle.compat.round(2.5) == 3.0
+    assert paddle.compat.round(-2.5) == -3.0
+    assert paddle.compat.floor_division(7, 2) == 3
+
+
+def test_legacy_dataset_readers():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        img, label = next(paddle.dataset.mnist.train()())
+        assert img.shape == (784,) and img.min() >= -1.0 and img.max() <= 1.0
+        x, y = next(paddle.dataset.uci_housing.train()())
+        assert x.shape == (13,) and y.shape == (1,)
+        wd = paddle.dataset.imdb.word_dict()
+        doc, lab = next(paddle.dataset.imdb.train(wd)())
+        assert len(doc) > 0 and lab in (0, 1)
+        ng = next(paddle.dataset.imikolov.train(
+            paddle.dataset.imikolov.build_dict(), 5)())
+        assert len(ng) == 5
+
+
+def test_distributed_utils():
+    x = paddle.ones([4, 8])
+    counts = paddle.to_tensor(np.array([2, 2], np.int64))
+    out = paddle.distributed.utils.global_scatter(x, counts, counts)
+    assert out.shape == [4, 8]
+    with pytest.raises(ValueError):
+        paddle.distributed.utils.global_scatter(
+            x, paddle.to_tensor(np.array([1, 1], np.int64)), counts)
+    log = paddle.distributed.utils.get_logger(20, "t")
+    assert log.name == "t"
+
+
+def test_static_amp_alias_and_ffn():
+    assert paddle.static.amp.GradScaler is paddle.amp.GradScaler
+    ffn = paddle.incubate.nn.FusedFeedForward(16, 32, normalize_before=True)
+    out = ffn(paddle.ones([2, 4, 16]))
+    assert out.shape == [2, 4, 16]
+    out.sum().backward()
