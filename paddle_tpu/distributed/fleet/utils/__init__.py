@@ -6,3 +6,4 @@ from .hybrid_parallel_util import (  # noqa: F401
     broadcast_input_data, broadcast_mp_parameters, broadcast_dp_parameters,
     broadcast_sharding_parameters, fused_allreduce_gradients,
 )
+from . import fs  # noqa: F401

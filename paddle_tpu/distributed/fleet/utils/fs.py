@@ -1,0 +1,161 @@
+"""Filesystem abstraction for checkpoint storage.
+
+Ref: python/paddle/distributed/fleet/utils/fs.py (FS base, LocalFS,
+HDFSClient over the hadoop CLI).  The checkpoint saver (SURVEY §5.4) writes
+through this interface so HDFS-backed clusters and local disks share a code
+path; this build implements LocalFS fully and keeps HDFSClient's surface
+with an actionable error (no hadoop binary in the TPU image).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+__all__ = ["FS", "LocalFS", "HDFSClient", "FSFileExistsError", "FSFileNotExistsError"]
+
+
+class FSFileExistsError(Exception):
+    pass
+
+
+class FSFileNotExistsError(Exception):
+    pass
+
+
+class FS:
+    def ls_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_file(self, fs_path):
+        raise NotImplementedError
+
+    def is_dir(self, fs_path):
+        raise NotImplementedError
+
+    def is_exist(self, fs_path):
+        raise NotImplementedError
+
+    def upload(self, local_path, fs_path):
+        raise NotImplementedError
+
+    def download(self, fs_path, local_path):
+        raise NotImplementedError
+
+    def mkdirs(self, fs_path):
+        raise NotImplementedError
+
+    def delete(self, fs_path):
+        raise NotImplementedError
+
+    def need_upload_download(self):
+        raise NotImplementedError
+
+    def rename(self, fs_src_path, fs_dst_path):
+        raise NotImplementedError
+
+    def mv(self, fs_src_path, fs_dst_path, overwrite=False):
+        raise NotImplementedError
+
+    def upload_dir(self, local_dir, dest_dir):
+        raise NotImplementedError
+
+    def list_dirs(self, fs_path):
+        raise NotImplementedError
+
+    def touch(self, fs_path, exist_ok=True):
+        raise NotImplementedError
+
+    def cat(self, fs_path=None):
+        raise NotImplementedError
+
+
+class LocalFS(FS):
+    """Local-disk implementation (ref fs.py LocalFS)."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            if os.path.isdir(os.path.join(fs_path, name)):
+                dirs.append(name)
+            else:
+                files.append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def delete(self, fs_path):
+        if self.is_file(fs_path):
+            os.remove(fs_path)
+        elif self.is_dir(fs_path):
+            shutil.rmtree(fs_path)
+
+    def need_upload_download(self):
+        return False
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if self.is_exist(fs_path):
+            if exist_ok:
+                return
+            raise FSFileExistsError(fs_path)
+        with open(fs_path, "a"):
+            pass
+
+    def mv(self, src_path, dst_path, overwrite=False, test_exists=False):
+        if not self.is_exist(src_path):
+            raise FSFileNotExistsError(src_path)
+        if not overwrite and self.is_exist(dst_path):
+            raise FSFileExistsError(dst_path)
+        shutil.move(src_path, dst_path)
+
+    def list_dirs(self, fs_path):
+        if not self.is_exist(fs_path):
+            return []
+        return [d for d in sorted(os.listdir(fs_path))
+                if os.path.isdir(os.path.join(fs_path, d))]
+
+    def cat(self, fs_path=None):
+        with open(fs_path) as f:
+            return f.read()
+
+
+class HDFSClient(FS):
+    """Surface parity for the hadoop-CLI client (ref fs.py HDFSClient).
+    The TPU image ships no hadoop binary; construction works (so configs
+    that instantiate it still import) but any operation raises with
+    guidance to use LocalFS or a mounted path."""
+
+    def __init__(self, hadoop_home=None, configs=None, time_out=300000, sleep_inter=1000):
+        self._hadoop_home = hadoop_home
+
+    def _unavailable(self):
+        raise RuntimeError(
+            "HDFSClient: no hadoop CLI in this environment. Point the "
+            "checkpoint dir at a mounted/network filesystem and use LocalFS "
+            "instead — the saver only needs the FS interface.")
+
+    def __getattribute__(self, name):
+        if name.startswith("_") or name in ("need_upload_download",):
+            return object.__getattribute__(self, name)
+        if name in ("ls_dir", "is_file", "is_dir", "is_exist", "upload",
+                    "download", "mkdirs", "delete", "rename", "mv",
+                    "upload_dir", "list_dirs", "touch", "cat"):
+            self._unavailable()
+        return object.__getattribute__(self, name)
+
+    def need_upload_download(self):
+        return True

@@ -1,6 +1,7 @@
 """paddle.incubate parity surface (ref: python/paddle/incubate/)."""
 from . import autograd  # noqa: F401
 from . import moe  # noqa: F401
+from . import distributed  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import asp  # noqa: F401
 from . import autotune  # noqa: F401
@@ -26,6 +27,78 @@ class nn:  # incubate.nn fused layers namespace (fused == XLA-fused on TPU)
         MultiHeadAttention as FusedMultiHeadAttention,
         TransformerEncoderLayer as FusedTransformerEncoderLayer,
     )
+
+    class functional:
+        """incubate.nn.functional fused ops (ref incubate/nn/functional/
+        fused_transformer.py) — on TPU the fusion is XLA's job, so these
+        compose the unfused primitives and compile to the same kernels."""
+
+        @staticmethod
+        def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                              linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                              ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                              dropout2_rate=0.5, activation="relu",
+                              ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                              pre_layer_norm=False, training=True, mode="upscale_in_train",
+                              name=None):
+            from ..nn import functional as F
+
+            residual = x
+            if pre_layer_norm:
+                x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+            x = getattr(F, activation)(F.linear(x, linear1_weight, linear1_bias))
+            x = F.dropout(x, dropout1_rate, training=training, mode=mode)
+            x = F.linear(x, linear2_weight, linear2_bias)
+            x = F.dropout(x, dropout2_rate, training=training, mode=mode)
+            x = residual + x
+            if not pre_layer_norm:
+                x = F.layer_norm(x, [x.shape[-1]], ln2_scale, ln2_bias, ln2_epsilon)
+            return x
+
+        @staticmethod
+        def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                                       pre_layer_norm=False, pre_ln_scale=None,
+                                       pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                                       pre_ln_epsilon=1e-5, qkv_bias=None,
+                                       linear_bias=None, cache_kv=None,
+                                       attn_mask=None, dropout_rate=0.5,
+                                       attn_dropout_rate=0.5, ln_epsilon=1e-5,
+                                       training=True, mode="upscale_in_train",
+                                       ring_id=-1, name=None):
+            """qkv_weight: [3, n_heads, head_dim, hidden]; linear_weight:
+            [hidden, hidden] (the fused_attention_op layout)."""
+            import jax.numpy as jnp
+
+            from ..nn import functional as F
+            from ..tensor.tensor import Tensor, apply_op
+
+            residual = x
+            if pre_layer_norm:
+                x = F.layer_norm(x, [x.shape[-1]], pre_ln_scale, pre_ln_bias,
+                                 pre_ln_epsilon)
+            three, n_heads, head_dim, hidden = tuple(qkv_weight.shape)
+
+            def _qkv(v, w, b):
+                w2 = w.reshape(3 * n_heads * head_dim, hidden).T
+                out = v @ w2.astype(v.dtype)
+                if b is not None:
+                    out = out + b.reshape(-1).astype(v.dtype)
+                return out
+
+            qkv = apply_op(_qkv, (x, qkv_weight, qkv_bias), name="fused_qkv")
+            B, S = x.shape[0], x.shape[1]
+            qkv = qkv.reshape([B, S, 3, n_heads, head_dim])
+            q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask,
+                dropout_p=attn_dropout_rate if training else 0.0)
+            out = out.reshape([B, S, n_heads * head_dim])
+            out = F.linear(out, linear_weight, linear_bias)
+            out = F.dropout(out, dropout_rate, training=training, mode=mode)
+            out = residual + out
+            if not pre_layer_norm:
+                out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias, ln_epsilon)
+            return out
 
     class FusedFeedForward:
         """linear -> activation -> dropout -> linear -> dropout -> residual+LN

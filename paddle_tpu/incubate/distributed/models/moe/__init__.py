@@ -1,0 +1,4 @@
+"""Path-parity alias: the reference exposes MoELayer at
+paddle.incubate.distributed.models.moe (moe_layer.py:244); the implementation
+lives in paddle_tpu/incubate/moe.py."""
+from ....moe import MoELayer  # noqa: F401

@@ -1,0 +1,19 @@
+"""paddle.onnx — ONNX export surface.
+
+Ref: python/paddle/onnx/export.py (thin shim over the external paddle2onnx
+package).  This build has no paddle2onnx and no network egress; the portable
+AOT artifact on TPU is StableHLO via `paddle.jit.save` (loadable by
+`paddle.jit.load` and `paddle.inference`).  `export()` raises with that
+guidance instead of writing a file that silently is not ONNX.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "ONNX export requires paddle2onnx, which is not available in this "
+        "build. For a deployable AOT artifact on TPU use paddle.jit.save"
+        f"(layer, {path!r}, input_spec=...) — it serializes StableHLO that "
+        "paddle.jit.load / paddle.inference.create_predictor can run.")

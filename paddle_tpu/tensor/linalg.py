@@ -101,6 +101,13 @@ def eig(x, name=None):
     return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(vec))
 
 
+def eigvals(x, name=None):
+    """Eigenvalues of a general (non-symmetric) matrix (ref linalg.py eigvals).
+    Host round-trip like eig: XLA has no general-eig kernel on TPU."""
+    v = np.asarray(_unwrap(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(v)))
+
+
 def solve(x, y, name=None):
     return apply_op(lambda a, b: jnp.linalg.solve(a, b), (x, y), name="solve")
 
@@ -160,6 +167,35 @@ def lu(x, pivot=True, get_infos=False):
 
         return (*out, zeros([1], "int32"))
     return out
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Split packed LU factors + pivot rows into (P, L, U)
+    (ref tensor/linalg.py lu_unpack over the lu_unpack op).
+
+    `x` is the [.., n, n] packed LU from `lu()`, `y` the pivot-row indices
+    (LAPACK ipiv convention: row i was swapped with row y[i])."""
+    def _plu(lu_v, piv):
+        if lu_v.ndim > 2:
+            return jax.vmap(_plu)(lu_v, piv)
+        n = lu_v.shape[-1]
+        L = jnp.tril(lu_v, -1) + jnp.eye(n, dtype=lu_v.dtype)
+        U = jnp.triu(lu_v)
+        # ipiv -> permutation: apply the row swaps in order to the identity
+        def swap(p, i):
+            j = piv[i]
+            row_i, row_j = p[i], p[j]
+            p = p.at[i].set(row_j).at[j].set(row_i)
+            return p, ()
+        perm, _ = jax.lax.scan(swap, jnp.arange(n, dtype=jnp.int32),
+                               jnp.arange(piv.shape[-1], dtype=jnp.int32))
+        # rows were permuted as A[perm] = L @ U  =>  A = P @ L @ U with
+        # P[i, perm[i]] = 1 (the inverse permutation as a matrix)
+        P = jnp.zeros((n, n), lu_v.dtype).at[perm, jnp.arange(n)].set(1.0)
+        return P, L, U
+
+    P, L, U = apply_op(lambda a, b: _plu(a, b), (x, y), name="lu_unpack")
+    return P, L, U
 
 
 def corrcoef(x, rowvar=True):

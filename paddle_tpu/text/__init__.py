@@ -113,3 +113,152 @@ class UCIHousing(Dataset):
     def __len__(self):
         return len(self.y)
 from .viterbi import viterbi_decode, ViterbiDecoder  # noqa: F401
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram LM dataset (ref text/datasets/imikolov.py: yields
+    n-gram tuples, data_type 'NGRAM' or 'SEQ')."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, synthetic=False):
+        _require_source("Imikolov", data_file, synthetic, "the simple-examples tarball")
+        self.window = int(window_size)
+        self.data_type = data_type
+        if data_file is not None:
+            with open(data_file, encoding="utf8") as f:
+                sents = [ln.split() for ln in f if ln.strip()]
+            from collections import Counter
+            freq = Counter(w for s in sents for w in s)
+            vocab = [w for w, c in sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+                     if c >= min_word_freq]
+            self.word_idx = {w: i + 3 for i, w in enumerate(vocab)}
+            corpus = [[self.word_idx.get(w, 0) for w in s] for s in sents]
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.word_idx = {str(i): i for i in range(2048)}
+            corpus = [list((rng.zipf(1.3, rng.randint(5, 30)) % 2046).astype(np.int64) + 2)
+                      for _ in range(512 if mode == "train" else 64)]
+        self.samples = []
+        for s in corpus:
+            s = [1] + list(s) + [2]
+            if self.data_type.upper() == "SEQ":
+                self.samples.append(np.asarray(s, np.int64))
+            else:
+                n = self.window
+                for i in range(n, len(s) + 1):
+                    self.samples.append(np.asarray(s[i - n:i], np.int64))
+
+    def __getitem__(self, idx):
+        return self.samples[idx]
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class Movielens(Dataset):
+    """MovieLens-1M rating triples (ref text/datasets/movielens.py: yields
+    (user features, movie features, rating))."""
+
+    def __init__(self, data_file=None, mode="train", test_ratio=0.1, rand_seed=0,
+                 synthetic=False):
+        _require_source("Movielens", data_file, synthetic, "ml-1m ratings.dat")
+        rng = np.random.RandomState(rand_seed)
+        if data_file is not None:
+            rows = []
+            with open(data_file, encoding="latin1") as f:
+                for ln in f:
+                    parts = ln.strip().split("::")
+                    if len(parts) >= 3:
+                        rows.append((int(parts[0]), int(parts[1]), float(parts[2])))
+            rows = np.asarray(rows, np.float32)
+        else:
+            n = 2048
+            rows = np.stack([rng.randint(1, 6041, n), rng.randint(1, 3953, n),
+                             rng.randint(1, 6, n)], 1).astype(np.float32)
+        mask = rng.rand(len(rows)) < test_ratio
+        rows = rows[mask] if mode == "test" else rows[~mask]
+        self.user = rows[:, 0].astype(np.int64)
+        self.movie = rows[:, 1].astype(np.int64)
+        self.rating = rows[:, 2:3]
+
+    def __getitem__(self, idx):
+        return self.user[idx], self.movie[idx], self.rating[idx]
+
+    def __len__(self):
+        return len(self.rating)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL dataset (ref text/datasets/conll05.py: yields word ids,
+    predicate/context features, and BIO label ids)."""
+
+    def __init__(self, data_file=None, mode="train", synthetic=False):
+        _require_source("Conll05st", data_file, synthetic, "the conll05st test.wsj files")
+        if data_file is not None:
+            raise NotImplementedError(
+                "Conll05st real-corpus parsing (propbank column format) is not "
+                "implemented; pass synthetic=True for pipeline tests")
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        n = 256
+        self.sents = [rng.randint(2, 5000, rng.randint(5, 40)).astype(np.int64)
+                      for _ in range(n)]
+        self.labels = [rng.randint(0, 67, len(s)).astype(np.int64) for s in self.sents]
+
+    def __getitem__(self, idx):
+        return self.sents[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.sents)
+
+
+class _WMTBase(Dataset):
+    def __init__(self, cls_name, artifact, data_file, mode, src_dict_size,
+                 trg_dict_size, synthetic):
+        _require_source(cls_name, data_file, synthetic, artifact)
+        self.src_dict_size = src_dict_size
+        self.trg_dict_size = trg_dict_size
+        if data_file is not None:
+            pairs = []
+            with open(data_file, encoding="utf8") as f:
+                for ln in f:
+                    parts = ln.rstrip("\n").split("\t")
+                    if len(parts) == 2:
+                        src = [hash(w) % (src_dict_size - 3) + 3 for w in parts[0].split()]
+                        trg = [hash(w) % (trg_dict_size - 3) + 3 for w in parts[1].split()]
+                        pairs.append((src, trg))
+            self.pairs = pairs
+        else:
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            self.pairs = [
+                (list(rng.randint(3, src_dict_size, rng.randint(4, 30)).astype(np.int64)),
+                 list(rng.randint(3, trg_dict_size, rng.randint(4, 30)).astype(np.int64)))
+                for _ in range(512 if mode == "train" else 64)]
+
+    def __getitem__(self, idx):
+        src, trg = self.pairs[idx]
+        # (source ids, target ids shifted in, target ids shifted out) — the
+        # seq2seq training triple the reference yields
+        s = np.asarray(src, np.int64)
+        t = np.asarray([1] + list(trg), np.int64)
+        lbl = np.asarray(list(trg) + [2], np.int64)
+        return s, t, lbl
+
+    def __len__(self):
+        return len(self.pairs)
+
+
+class WMT14(_WMTBase):
+    """WMT'14 en-fr translation pairs (ref text/datasets/wmt14.py)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000, synthetic=False):
+        super().__init__("WMT14", "a tab-separated en\\tfr file", data_file, mode,
+                         dict_size, dict_size, synthetic)
+
+
+class WMT16(_WMTBase):
+    """WMT'16 en-de translation pairs (ref text/datasets/wmt16.py)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", synthetic=False):
+        super().__init__("WMT16", "a tab-separated en\\tde file", data_file, mode,
+                         src_dict_size, trg_dict_size, synthetic)

@@ -213,3 +213,104 @@ class FakeImageNet(Dataset):
 
     def __len__(self):
         return self.n
+
+
+class Flowers(Dataset):
+    """Oxford-102 flowers (ref vision/datasets/flowers.py).  Reads an extracted
+    layout `<root>/jpg/*.jpg` + `imagelabels.npy` if present; else warns and
+    synthesizes (this build cannot download)."""
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True, backend=None):
+        self.transform = transform
+        self.backend = backend
+        root = data_file or os.path.join(_data_home(), "flowers")
+        labels_np = os.path.join(root, "imagelabels.npy")
+        jpg_dir = os.path.join(root, "jpg")
+        if os.path.isdir(jpg_dir) and os.path.exists(labels_np):
+            names = sorted(n for n in os.listdir(jpg_dir) if n.endswith(".jpg"))
+            labels = np.load(labels_np).astype(np.int64)
+            split = int(len(names) * 0.8)
+            sel = slice(0, split) if mode == "train" else slice(split, None)
+            self.files = [os.path.join(jpg_dir, n) for n in names[sel]]
+            self.labels = labels[sel]
+            self.images = None
+        else:
+            import warnings
+
+            warnings.warn(
+                f"Flowers: '{jpg_dir}' not found and this build cannot download "
+                "— using GENERATED stand-in images (pipeline smoke tests only)",
+                stacklevel=2)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 512 if mode == "train" else 128
+            self.labels = rng.randint(0, 102, n).astype(np.int64)
+            self.images = (rng.rand(n, 64, 64, 3) * 255).astype(np.uint8)
+            self.files = None
+
+    def __getitem__(self, idx):
+        if self.images is not None:
+            img = self.images[idx]
+        else:
+            from PIL import Image
+
+            img = np.asarray(Image.open(self.files[idx]).convert("RGB"))
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, np.asarray(self.labels[idx], np.int64)
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation pairs (ref vision/datasets/voc2012.py:
+    yields (image CHW float, label mask HW int64)).  Reads the extracted
+    VOCdevkit layout if present; else warns and synthesizes."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        self.transform = transform
+        root = data_file or os.path.join(_data_home(), "voc2012", "VOCdevkit", "VOC2012")
+        img_dir = os.path.join(root, "JPEGImages")
+        seg_dir = os.path.join(root, "SegmentationClass")
+        lst = os.path.join(root, "ImageSets", "Segmentation",
+                           ("train.txt" if mode == "train" else "val.txt"))
+        if os.path.isdir(img_dir) and os.path.isdir(seg_dir) and os.path.exists(lst):
+            with open(lst) as f:
+                ids = [ln.strip() for ln in f if ln.strip()]
+            self.pairs = [(os.path.join(img_dir, i + ".jpg"),
+                           os.path.join(seg_dir, i + ".png")) for i in ids]
+            self.images = self.masks = None
+        else:
+            import warnings
+
+            warnings.warn(
+                f"VOC2012: '{root}' not found and this build cannot download "
+                "— using GENERATED stand-in segmentation pairs (pipeline "
+                "smoke tests only)", stacklevel=2)
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 128 if mode == "train" else 32
+            self.images = (rng.rand(n, 64, 64, 3) * 255).astype(np.uint8)
+            self.masks = rng.randint(0, 21, (n, 64, 64)).astype(np.int64)
+            self.pairs = None
+
+    def __getitem__(self, idx):
+        if self.images is not None:
+            img, mask = self.images[idx], self.masks[idx]
+        else:
+            from PIL import Image
+
+            ip, mp = self.pairs[idx]
+            img = np.asarray(Image.open(ip).convert("RGB"))
+            mask = np.asarray(Image.open(mp)).astype(np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        else:
+            img = img.astype(np.float32).transpose(2, 0, 1) / 255.0
+        return img, mask
+
+    def __len__(self):
+        return len(self.images) if self.images is not None else len(self.pairs)

@@ -181,6 +181,58 @@ def test_distributed_utils():
     assert log.name == "t"
 
 
+def test_eigvals_and_lu_unpack():
+    rng = np.random.RandomState(0)
+    A = rng.randn(4, 4).astype(np.float32)
+    ev = np.asarray(paddle.linalg.eigvals(paddle.to_tensor(A))._value)
+    np.testing.assert_allclose(sorted(ev.real), sorted(np.linalg.eigvals(A).real),
+                               atol=1e-4)
+    lu, piv = paddle.linalg.lu(paddle.to_tensor(A))
+    P, L, U = paddle.linalg.lu_unpack(lu, piv)
+    np.testing.assert_allclose(
+        np.asarray(P._value) @ np.asarray(L._value) @ np.asarray(U._value), A,
+        atol=1e-4)
+    B = rng.randn(3, 4, 4).astype(np.float32)
+    lub, pivb = paddle.linalg.lu(paddle.to_tensor(B))
+    Pb, Lb, Ub = paddle.linalg.lu_unpack(lub, pivb)
+    np.testing.assert_allclose(
+        np.einsum("bij,bjk,bkl->bil", np.asarray(Pb._value),
+                  np.asarray(Lb._value), np.asarray(Ub._value)), B, atol=1e-4)
+
+
+def test_moe_path_alias_and_fleet_fs(tmp_path):
+    from paddle_tpu.incubate.distributed.models.moe import MoELayer
+
+    assert MoELayer is paddle.incubate.MoELayer
+
+    from paddle_tpu.distributed.fleet.utils.fs import HDFSClient, LocalFS
+
+    fs = LocalFS()
+    d = str(tmp_path)
+    fs.mkdirs(d + "/a/b")
+    fs.touch(d + "/x.txt")
+    assert fs.ls_dir(d) == (["a"], ["x.txt"])
+    assert fs.list_dirs(d) == ["a"]
+    fs.mv(d + "/x.txt", d + "/y.txt")
+    assert fs.is_file(d + "/y.txt")
+    fs.delete(d + "/a")
+    assert not fs.is_exist(d + "/a")
+    h = HDFSClient()
+    assert h.need_upload_download()
+    with pytest.raises(RuntimeError, match="hadoop"):
+        h.ls_dir("/x")
+
+
+def test_static_amp_decorate_static_signature():
+    paddle.seed(0)
+    lin = nn.Linear(4, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    dec = paddle.static.amp.decorate(opt, init_loss_scaling=128.0)
+    loss = (lin(paddle.ones([2, 4])) ** 2).mean()
+    dec.minimize(loss)
+    dec.amp_init(None)  # no-op, must exist
+
+
 def test_static_amp_alias_and_ffn():
     assert paddle.static.amp.GradScaler is paddle.amp.GradScaler
     ffn = paddle.incubate.nn.FusedFeedForward(16, 32, normalize_before=True)

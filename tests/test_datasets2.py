@@ -1,0 +1,78 @@
+"""Round-2 dataset additions: text Imikolov/Movielens/Conll05st/WMT14/WMT16
+and vision Flowers/VOC2012 (ref python/paddle/text/datasets/, vision/datasets/).
+All follow the download-or-error-or-synthetic contract.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_text_datasets_require_source():
+    for cls in (paddle.text.Imikolov, paddle.text.Movielens,
+                paddle.text.Conll05st, paddle.text.WMT14, paddle.text.WMT16):
+        with pytest.raises(RuntimeError, match="no data source"):
+            cls()
+
+
+def test_imikolov_ngram_and_seq():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ng = paddle.text.Imikolov(synthetic=True, window_size=5)
+        assert ng[0].shape == (5,)
+        seq = paddle.text.Imikolov(synthetic=True, data_type="SEQ")
+        assert seq[0].ndim == 1 and seq[0][0] == 1  # <s> token leads
+
+
+def test_movielens_split():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        tr = paddle.text.Movielens(synthetic=True, mode="train")
+        te = paddle.text.Movielens(synthetic=True, mode="test")
+    u, m, r = tr[0]
+    assert r.shape == (1,) and 1 <= float(r[0]) <= 5
+    assert len(tr) + len(te) == 2048
+
+
+def test_wmt_training_triple():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        w = paddle.text.WMT14(synthetic=True)
+    s, t, lbl = w[0]
+    assert len(t) == len(lbl)
+    assert t[0] == 1 and lbl[-1] == 2  # <s> in, <e> out
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        w16 = paddle.text.WMT16(synthetic=True, mode="test")
+    assert len(w16) == 64
+
+
+def test_conll_srl_pairs():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        c = paddle.text.Conll05st(synthetic=True)
+    words, labels = c[0]
+    assert words.shape == labels.shape and labels.max() < 67
+
+
+def test_flowers_and_voc():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f = paddle.vision.datasets.Flowers()
+        img, lab = f[0]
+        assert img.shape[0] == 3 and 0 <= int(lab) < 102
+        v = paddle.vision.datasets.VOC2012()
+        img, mask = v[0]
+        assert img.ndim == 3 and mask.ndim == 2 and mask.max() < 21
+
+    # transforms compose
+    from paddle_tpu.vision import transforms as T
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        f2 = paddle.vision.datasets.Flowers(
+            transform=T.Compose([T.Resize(32), T.ToTensor()]))
+    img, _ = f2[0]
+    assert tuple(np.asarray(img).shape[-2:]) == (32, 32)
