@@ -1,0 +1,101 @@
+"""Compiled autoregressive generation.
+
+Ref surface: PaddleNLP's `model.generate(...)` (greedy / sampling); the
+reference repo itself stops at fused attention ops, so the decode loop is
+designed TPU-first: ONE `jax.jit` containing the prefill plus a
+`lax.scan` over decode steps on a STATIC kv-cache (fixed-size buffers +
+`dynamic_update_slice`), so nothing recompiles per token and the whole
+generation is a single device program.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..framework import random as _random
+from ..tensor.tensor import Tensor
+
+__all__ = ["generate"]
+
+
+def _select(logits, key, do_sample, temperature, top_k, top_p):
+    """logits [B, V] -> token ids [B, 1]."""
+    if not do_sample:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
+    V = logits.shape[-1]
+    if top_k and top_k > 0:
+        kth = jax.lax.top_k(logits, min(int(top_k), V))[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p (always >= 1 tok)
+        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
+        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)[:, None]
+
+
+def generate(model, input_ids, max_new_tokens=32, do_sample=False,
+             temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+             pad_token_id=0):
+    """Generate `max_new_tokens` continuations of `input_ids` [B, S0].
+
+    Returns int32 ids [B, max_new_tokens]; once a row emits `eos_token_id`
+    the rest of that row is `pad_token_id`.  The model must expose
+    `generate_step(ids, caches)` (prefill/decode) — LlamaForCausalLM does.
+    """
+    ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    B, S0 = ids.shape
+    total = S0 + int(max_new_tokens)
+    params, buffers = model.functional_state()
+    eos = -1 if eos_token_id is None else int(eos_token_id)
+
+    def run(params, ids, key):
+        restore = model.bind_functional_state(params, buffers)
+        try:
+            with tape.no_grad():
+                logits, caches = model.generate_step(Tensor(ids))
+                # convert the prefill's concat-caches into static buffers
+                static = []
+                for (k, v) in caches:
+                    kv_pad = [(0, 0), (0, total - S0), (0, 0), (0, 0)]
+                    static.append((jnp.pad(k._value, kv_pad),
+                                   jnp.pad(v._value, kv_pad),
+                                   jnp.asarray(S0, jnp.int32)))
+                key, sub = jax.random.split(key)
+                tok = _select(logits._value[:, -1], sub, do_sample, temperature,
+                              top_k, top_p)
+                done = (tok[:, 0] == eos)
+
+                def body(carry, key_t):
+                    caches, tok, done = carry
+                    t_caches = [(Tensor(k), Tensor(v), p) for k, v, p in caches]
+                    logits, new_caches = model.generate_step(
+                        Tensor(tok), caches=t_caches)
+                    nxt = _select(logits._value[:, -1], key_t, do_sample,
+                                  temperature, top_k, top_p)
+                    nxt = jnp.where(done[:, None], jnp.asarray(pad_token_id, jnp.int32), nxt)
+                    new_done = done | (nxt[:, 0] == eos)
+                    raw = [(k._value, v._value, p) for k, v, p in new_caches]
+                    return (raw, nxt, new_done), tok[:, 0]
+
+                if max_new_tokens > 1:
+                    keys = jax.random.split(key, max_new_tokens - 1)
+                    (_, last, _), toks = jax.lax.scan(body, (static, tok, done), keys)
+                    out = jnp.concatenate([toks.T, last], axis=1)
+                else:
+                    out = tok
+        finally:
+            restore()
+        return out
+
+    key = _random.get_rng_key()
+    out = jax.jit(run)(params, ids, key)
+    t = Tensor(out)
+    t.stop_gradient = True
+    return t

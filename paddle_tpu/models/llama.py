@@ -64,10 +64,19 @@ def _rope_cache(head_dim, max_pos, theta):
 
 
 def apply_rope(x, cos, sin, position_offset=0):
-    """x: [B, S, H, D] raw array; rotate pairs (x1,x2) per RoPE."""
+    """x: [B, S, H, D] raw array; rotate pairs (x1,x2) per RoPE.
+    position_offset may be a traced scalar (static-cache decode)."""
     S, D = x.shape[1], x.shape[-1]
-    c = cos[position_offset:position_offset + S][None, :, None, :]  # [1,S,1,D/2]
-    s = sin[position_offset:position_offset + S][None, :, None, :]
+    if isinstance(position_offset, (int, np.integer)):
+        c = cos[position_offset:position_offset + S]
+        s = sin[position_offset:position_offset + S]
+    else:
+        import jax
+
+        c = jax.lax.dynamic_slice_in_dim(cos, position_offset, S, 0)
+        s = jax.lax.dynamic_slice_in_dim(sin, position_offset, S, 0)
+    c = c[None, :, None, :]  # [1,S,1,D/2]
+    s = s[None, :, None, :]
     x1, x2 = x[..., 0::2], x[..., 1::2]
     o1 = x1 * c - x2 * s
     o2 = x2 * c + x1 * s
@@ -106,14 +115,37 @@ class LlamaAttention(nn.Layer):
         k = self.k_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
         v = self.v_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
 
-        offset = cache[0].shape[1] if cache is not None else 0
+        # a 3-tuple cache (k_buf, v_buf, pos) is the STATIC layout used by the
+        # compiled generate() loop: fixed-size buffers + in-place scatter, so
+        # every decode step has identical shapes and compiles once
+        static_cache = cache is not None and len(cache) == 3
+        if static_cache:
+            offset = cache[2]
+        else:
+            offset = cache[0].shape[1] if cache is not None else 0
         q = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (q, rope_cos, rope_sin), name="rope")
         k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, rope_cos, rope_sin), name="rope")
 
-        if cache is not None:
-            k = M.concat([cache[0], k], axis=1)
-            v = M.concat([cache[1], v], axis=1)
-        new_cache = (k, v) if use_cache else None
+        if static_cache:
+            import jax
+
+            upd = lambda buf, kv: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+                buf, kv.astype(buf.dtype), offset, 1)
+            k_buf = apply_op(upd, (cache[0], k), name="kv_scatter")
+            v_buf = apply_op(upd, (cache[1], v), name="kv_scatter")
+            new_cache = (k_buf, v_buf, offset + S)
+            L = k_buf.shape[1]
+            if attn_mask is None:
+                # queries at pos offset+i see keys j <= offset+i; padding masked
+                jpos = jnp.arange(L)[None, :]
+                qpos = jnp.arange(S)[:, None] + offset
+                attn_mask = Tensor(jnp.where(jpos <= qpos, 0.0, -1e9)[None, None])
+            k, v = k_buf, v_buf
+        else:
+            if cache is not None:
+                k = M.concat([cache[0], k], axis=1)
+                v = M.concat([cache[1], v], axis=1)
+            new_cache = (k, v) if use_cache else None
 
         # GQA: repeat kv heads to match q heads
         if self.num_kv_heads != self.num_heads:
@@ -240,3 +272,13 @@ class LlamaForCausalLM(nn.Layer):
         """Prefill (caches=None) or single-token decode step (inference path)."""
         hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)
         return self.lm_head(hidden[:, -1:]), caches
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 pad_token_id=0):
+        """Compiled autoregressive decoding on a static kv-cache — one XLA
+        program for prefill + the whole token scan (models/generation.py)."""
+        from .generation import generate as _gen
+
+        return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
+                    top_k, top_p, eos_token_id, pad_token_id)
