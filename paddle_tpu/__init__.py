@@ -89,6 +89,7 @@ from . import dataset  # noqa: F401
 from . import compat  # noqa: F401
 from . import cost_model  # noqa: F401
 from . import onnx  # noqa: F401
+from . import quantization  # noqa: F401
 from .batch import batch  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .framework.param_attr import ParamAttr  # noqa: F401

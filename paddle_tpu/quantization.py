@@ -1,0 +1,155 @@
+"""Model-level quantization workflows over nn.quant.
+
+Ref: python/paddle/fluid/contrib/slim/quantization/imperative/qat.py:45
+(ImperativeQuantAware.quantize swaps quantizable sublayers for Quantized*
+wrappers) and ptq.py (ImperativePTQ: observe activations on sample data,
+then freeze scales).
+
+TPU-native: the fake-quant math is the STE expression in nn/quant
+(one fused XLA expression per tensor); this module only does the model
+surgery and calibration bookkeeping.
+"""
+from __future__ import annotations
+
+from .nn.layer.layers import Layer
+from .nn.quant.quant_layers import (
+    MovingAverageAbsMaxScale,
+    QuantizedConv2D,
+    QuantizedConv2DTranspose,
+    QuantizedLinear,
+)
+
+__all__ = ["ImperativeQuantAware", "ImperativePTQ", "PTQConfig"]
+
+_WRAPPERS = {
+    "Conv2D": QuantizedConv2D,
+    "Conv2DTranspose": QuantizedConv2DTranspose,
+    "Linear": QuantizedLinear,
+}
+
+
+def _swap_sublayers(model, should_swap, make_wrapper):
+    """Replace matching sublayers in place; returns the (mutated) model."""
+    for layer in model.sublayers(include_self=True):
+        for name, sub in list(layer._sub_layers.items()):
+            if sub is None or isinstance(sub, (QuantizedConv2D,
+                                               QuantizedConv2DTranspose,
+                                               QuantizedLinear)):
+                continue
+            if should_swap(sub):
+                layer._sub_layers[name] = make_wrapper(sub)
+    return model
+
+
+class ImperativeQuantAware:
+    """Swap every quantizable sublayer for its fake-quant wrapper (QAT).
+
+    After training, `save_quantized_model` exports via jit.save — the fake
+    quant ops are part of the traced graph, so the saved artifact carries
+    the calibrated scales.
+    """
+
+    def __init__(self, quantizable_layer_type=("Conv2D", "Linear", "Conv2DTranspose"),
+                 weight_quantize_type="abs_max",
+                 activation_quantize_type="moving_average_abs_max",
+                 weight_bits=8, activation_bits=8, moving_rate=0.9,
+                 fuse_conv_bn=False, weight_preprocess_layer=None,
+                 act_preprocess_layer=None, weight_quantize_layer=None,
+                 act_quantize_layer=None, onnx_format=False):
+        unknown = [t for t in quantizable_layer_type if t not in _WRAPPERS]
+        if unknown:
+            raise ValueError(
+                f"unsupported quantizable_layer_type {unknown}; "
+                f"supported: {sorted(_WRAPPERS)}")
+        self._types = tuple(quantizable_layer_type)
+        self._kw = dict(weight_quantize_type=weight_quantize_type,
+                        activation_quantize_type=activation_quantize_type,
+                        weight_bits=weight_bits, activation_bits=activation_bits,
+                        moving_rate=moving_rate)
+
+    def quantize(self, model):
+        """In-place sublayer swap (ref qat.py quantize)."""
+        def should(sub):
+            return type(sub).__name__ in self._types
+
+        def wrap(sub):
+            return _WRAPPERS[type(sub).__name__](sub, **self._kw)
+
+        return _swap_sublayers(model, should, wrap)
+
+    def save_quantized_model(self, model, path, input_spec=None, **config):
+        from . import jit
+
+        jit.save(model, path, input_spec=input_spec, **config)
+
+
+class PTQConfig:
+    """(ref ptq_config.py) — which observers to use for activations/weights."""
+
+    def __init__(self, activation_quantizer="moving_average_abs_max",
+                 weight_quantizer="abs_max", moving_rate=0.9,
+                 quant_bits=8):
+        self.activation_quantizer = activation_quantizer
+        self.weight_quantizer = weight_quantizer
+        self.moving_rate = moving_rate
+        self.quant_bits = quant_bits
+
+
+class _ObservedLayer(Layer):
+    """Wrap a layer with an output observer during PTQ calibration."""
+
+    def __init__(self, inner, moving_rate):
+        super().__init__()
+        self._inner = inner
+        self._observer = MovingAverageAbsMaxScale(moving_rate=moving_rate)
+
+    def forward(self, *args, **kwargs):
+        out = self._inner(*args, **kwargs)
+        from .tensor.tensor import Tensor
+
+        if isinstance(out, Tensor):
+            return self._observer(out)
+        return out
+
+
+class ImperativePTQ:
+    """Post-training quantization: run sample batches through an observed
+    model (`quantize`), then `convert` swaps in fake-quant wrappers whose
+    activation scales are FROZEN to the observed values (ref ptq.py)."""
+
+    def __init__(self, quant_config=None):
+        self.cfg = quant_config or PTQConfig()
+
+    def quantize(self, model, inplace=True):
+        def should(sub):
+            return type(sub).__name__ in _WRAPPERS
+
+        def wrap(sub):
+            return _ObservedLayer(sub, self.cfg.moving_rate)
+
+        return _swap_sublayers(model, should, wrap)
+
+    def convert(self, model, inplace=True):
+        """Replace observers with fixed-scale fake-quant wrappers."""
+        for layer in model.sublayers(include_self=True):
+            for name, sub in list(layer._sub_layers.items()):
+                if isinstance(sub, _ObservedLayer):
+                    inner = sub._inner
+                    wrapper = _WRAPPERS[type(inner).__name__](
+                        inner,
+                        weight_quantize_type=self.cfg.weight_quantizer,
+                        activation_quantize_type=self.cfg.activation_quantizer,
+                        weight_bits=self.cfg.quant_bits,
+                        activation_bits=self.cfg.quant_bits,
+                        moving_rate=self.cfg.moving_rate)
+                    # freeze the calibrated activation scale into the input
+                    # quanter and put it in eval mode so it stops moving
+                    fq = wrapper._fake_quant_input
+                    if fq is not None and hasattr(fq, "scale"):
+                        fq.scale.set_value(sub._observer.scale._value)
+                        if hasattr(fq, "state"):
+                            fq.state.set_value(sub._observer.state._value)
+                            fq.accum.set_value(sub._observer.accum._value)
+                        fq.eval()
+                    layer._sub_layers[name] = wrapper
+        return model

@@ -1,0 +1,68 @@
+"""Model-level quantization workflows (ref slim/quantization/imperative/
+qat.py ImperativeQuantAware, ptq.py ImperativePTQ)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.quantization import ImperativePTQ, ImperativeQuantAware, PTQConfig
+
+
+def _model():
+    paddle.seed(0)
+    return nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(),
+                         nn.Flatten(), nn.Linear(8 * 8 * 8, 10))
+
+
+X = paddle.to_tensor(np.random.RandomState(0).randn(4, 3, 8, 8).astype(np.float32))
+
+
+def test_qat_swaps_and_stays_close():
+    m = _model()
+    ref = np.asarray(m(X)._value)
+    ImperativeQuantAware().quantize(m)
+    names = {type(l).__name__ for l in m.sublayers()}
+    assert "QuantizedConv2D" in names and "QuantizedLinear" in names
+    out = np.asarray(m(X)._value)
+    assert np.abs(out - ref).max() < 0.2
+    (m(X) ** 2).mean().backward()  # STE gradients flow to the fp weights
+    conv = next(l for l in m.sublayers() if type(l).__name__ == "QuantizedConv2D")
+    assert conv._conv.weight._grad is not None
+
+
+def test_qat_trains_to_lower_loss():
+    m = _model()
+    ImperativeQuantAware().quantize(m)
+    opt = paddle.optimizer.Adam(learning_rate=1e-2, parameters=m.parameters())
+    y = paddle.to_tensor(np.random.RandomState(1).randn(4, 10).astype(np.float32))
+    losses = []
+    for _ in range(10):
+        loss = ((m(X) - y) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < 0.6 * losses[0]
+
+
+def test_qat_rejects_unknown_type():
+    with pytest.raises(ValueError):
+        ImperativeQuantAware(quantizable_layer_type=("LSTM",))
+
+
+def test_ptq_calibrate_then_convert():
+    m = _model()
+    ref = np.asarray(m(X)._value)
+    ptq = ImperativePTQ(PTQConfig(moving_rate=0.5))
+    ptq.quantize(m)
+    for _ in range(6):
+        m(X)
+    ptq.convert(m)
+    conv = next(l for l in m.sublayers() if type(l).__name__ == "QuantizedConv2D")
+    fq = conv._fake_quant_input
+    s0 = float(fq.scale._value)
+    assert s0 > 0 and not fq.training  # frozen observer
+    out = np.asarray(m(X)._value)
+    assert np.abs(out - ref).max() < 0.6
+    m(X * 100)  # frozen scale must not move even for outlier inputs
+    assert float(fq.scale._value) == s0
