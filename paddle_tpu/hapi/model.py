@@ -33,13 +33,30 @@ class Model:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) else [metrics]
         self._use_jit = jit
         if jit and optimizer is not None and loss is not None:
-            from ..jit.train_step import TrainStep
-
             def loss_fn(x, y):
                 out = self.network(x)
                 return self._loss(out, y), out
 
-            self._train_step = TrainStep(self.network, loss_fn, optimizer)
+            # multi-process run (paddle.distributed.launch) with fleet
+            # initialized: route through the strategy-consuming distributed
+            # step, mirroring ref hapi's nranks>1 auto-DataParallel.  A
+            # single-process mesh does NOT reroute implicitly — call
+            # fleet.distributed_train_step explicitly for SPMD-on-one-host,
+            # so an initialized fleet elsewhere never changes hapi behavior.
+            import jax
+
+            from ..distributed.fleet import fleet as _fleet
+
+            step = None
+            if _fleet._is_initialized and _fleet._hcg is not None \
+                    and jax.process_count() > 1:
+                step = _fleet.distributed_train_step(
+                    self.network, loss_fn, optimizer)
+            if step is None:
+                from ..jit.train_step import TrainStep
+
+                step = TrainStep(self.network, loss_fn, optimizer)
+            self._train_step = step
 
     def train_batch(self, inputs, labels=None, update=True):
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]

@@ -54,17 +54,54 @@ class GPTBlock(nn.Layer):
         self.drop = nn.Dropout(config.hidden_dropout_prob)
         self.attn_drop = config.attention_probs_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache=None, use_cache=False):
         B, S = x.shape[0], x.shape[1]
         h = self.ln_1(x)
         qkv = self.qkv(h).reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn_mask = None
+        if cache is not None and len(cache) == 3:
+            # static (k_buf, v_buf, pos) layout for the compiled generate loop
+            import jax
+            import jax.numpy as jnp
+
+            from ..tensor.tensor import Tensor, apply_op
+
+            offset = cache[2]
+            upd = lambda buf, kv: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+                buf, kv.astype(buf.dtype), offset, 1)
+            k = apply_op(upd, (cache[0], k), name="kv_scatter")
+            v = apply_op(upd, (cache[1], v), name="kv_scatter")
+            new_cache = (k, v, offset + S)
+            L = k.shape[1]
+            jpos = jnp.arange(L)[None, :]
+            qpos = jnp.arange(S)[:, None] + offset
+            attn_mask = Tensor(jnp.where(jpos <= qpos, 0.0, -1e9)[None, None])
+        elif cache is not None:
+            from ..tensor import manipulation as M
+
+            k = M.concat([cache[0], k], axis=1)
+            v = M.concat([cache[1], v], axis=1)
+            new_cache = (k, v)
+            # queries are the last S positions of the concatenated sequence
+            import jax.numpy as jnp
+
+            from ..tensor.tensor import Tensor
+
+            L = k.shape[1]
+            jpos = jnp.arange(L)[None, :]
+            qpos = jnp.arange(S)[:, None] + (L - S)
+            attn_mask = Tensor(jnp.where(jpos <= qpos, 0.0, -1e9)[None, None])
+        else:
+            new_cache = (k, v) if use_cache else None
         attn = F.scaled_dot_product_attention(
-            q, k, v, is_causal=True,
+            q, k, v, is_causal=attn_mask is None, attn_mask=attn_mask,
             dropout_p=self.attn_drop if self.training else 0.0,
         )
         x = x + self.drop(self.proj(attn.reshape([B, S, -1])))
         x = x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+        if use_cache or cache is not None:
+            return x, new_cache
         return x
 
 
@@ -79,13 +116,34 @@ class GPTModel(nn.Layer):
         self.h = nn.LayerList([GPTBlock(config) for _ in range(config.num_hidden_layers)])
         self.ln_f = nn.LayerNorm(config.hidden_size, epsilon=config.layer_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, use_cache=False):
         S = input_ids.shape[1]
-        pos = creation.arange(S, dtype="int32").unsqueeze(0)
+        use_cache = use_cache or caches is not None
+        if use_cache and caches is None:
+            caches = [None] * len(self.h)
+        if caches is not None and caches[0] is not None and len(caches[0]) == 3:
+            import jax.numpy as jnp
+
+            from ..tensor.tensor import Tensor
+
+            pos = Tensor(jnp.arange(S, dtype=jnp.int32)[None, :] + caches[0][2])
+        elif caches is not None and caches[0] is not None:
+            off = caches[0][0].shape[1]
+            pos = creation.arange(off, off + S, dtype="int32").unsqueeze(0)
+        else:
+            pos = creation.arange(S, dtype="int32").unsqueeze(0)
         x = self.drop(self.wte(input_ids) + self.wpe(pos))
-        for block in self.h:
-            x = block(x)
-        return self.ln_f(x)
+        new_caches = [] if use_cache else None
+        for i, block in enumerate(self.h):
+            if use_cache:
+                x, c = block(x, cache=caches[i], use_cache=True)
+                new_caches.append(c)
+            else:
+                x = block(x)
+        x = self.ln_f(x)
+        if use_cache:
+            return x, new_caches
+        return x
 
 
 class GPTForCausalLM(nn.Layer):
@@ -108,3 +166,17 @@ class GPTForCausalLM(nn.Layer):
             )
             return loss, logits
         return logits
+
+    def generate_step(self, input_ids, caches=None):
+        """Prefill (caches=None) or single-token decode step."""
+        hidden, caches = self.gpt(input_ids, caches=caches, use_cache=True)
+        return self.lm_head(hidden[:, -1:]), caches
+
+    def generate(self, input_ids, max_new_tokens=32, do_sample=False,
+                 temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
+                 pad_token_id=0):
+        """Compiled decode loop on a static kv-cache (models/generation.py)."""
+        from .generation import generate as _gen
+
+        return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
+                    top_k, top_p, eos_token_id, pad_token_id)

@@ -49,6 +49,26 @@ def test_sampling_and_eos():
     assert row[0] == greedy[0, 0] and (row[1:] == 99).all()
 
 
+def test_gpt_generate_matches_oracle():
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_hidden_layers=2,
+                    num_attention_heads=4, intermediate_size=128,
+                    max_position_embeddings=64, hidden_dropout_prob=0.0,
+                    attention_probs_dropout_prob=0.0)
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    prompt = np.random.RandomState(0).randint(0, 128, (2, 5)).astype(np.int32)
+    out = m.generate(paddle.to_tensor(prompt), max_new_tokens=6)
+    ids = prompt.copy()
+    for _ in range(6):
+        logits = m(paddle.to_tensor(ids))
+        nxt = np.asarray(logits._value)[:, -1].argmax(-1).astype(np.int32)
+        ids = np.concatenate([ids, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out._value), ids[:, 5:])
+
+
 def test_single_token_path():
     model = _model()
     prompt = np.random.RandomState(1).randint(0, 128, (1, 4)).astype(np.int32)
