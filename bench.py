@@ -48,11 +48,13 @@ def _measure_gemm_peak():
 
     r = chain(x, w)
     float(jnp.sum(r[:1, :1].astype(jnp.float32)))
-    t0 = time.perf_counter()
-    r = chain(x, w)
-    float(jnp.sum(r[:1, :1].astype(jnp.float32)))
-    dt = time.perf_counter() - t0
-    return 2 * n * n * n * iters / dt / 1e12
+    best = float("inf")
+    for _ in range(3):  # a ceiling: keep the best window (run-to-run ~10%)
+        t0 = time.perf_counter()
+        r = chain(x, w)
+        float(jnp.sum(r[:1, :1].astype(jnp.float32)))
+        best = min(best, time.perf_counter() - t0)
+    return 2 * n * n * n * iters / best / 1e12
 
 
 def _bench_llama(on_accel):
@@ -115,6 +117,43 @@ def _bench_llama(on_accel):
             "llama_mfu": round(mfu, 4),
             "llama_n_params": n_params,
             "llama_step_ms": round(1000 * dt / steps, 1)}
+
+
+def _bench_decode(on_accel):
+    """Autoregressive decode throughput: compiled static-cache generate()
+    (prefill + lax.scan over steps in ONE program)."""
+    import time
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=12, num_attention_heads=16, num_key_value_heads=16,
+            max_position_embeddings=2048, dtype="bfloat16",
+            tensor_parallel=False, use_flash_attention=False,
+        )
+        batch, prompt_len, new_tokens = 8, 1024, 128
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False, use_flash_attention=False)
+        batch, prompt_len, new_tokens = 2, 16, 8
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+    ids = paddle.to_tensor(
+        np.random.randint(0, cfg.vocab_size, (batch, prompt_len), np.int32))
+    out = model.generate(ids, max_new_tokens=new_tokens)  # compile
+    _ = np.asarray(out._value)
+    t0 = time.perf_counter()
+    out = model.generate(ids, max_new_tokens=new_tokens)
+    _ = np.asarray(out._value)
+    dt = time.perf_counter() - t0
+    return {"llama_decode_tokens_per_sec": round(batch * new_tokens / dt, 1),
+            "llama_decode_batch": batch, "llama_decode_prompt_len": prompt_len}
 
 
 def _bench_resnet(on_accel):
@@ -182,6 +221,10 @@ def main():
         out.update(_bench_resnet(on_accel))
     except Exception as e:
         out["resnet_error"] = repr(e)[:300]
+    try:
+        out.update(_bench_decode(on_accel))
+    except Exception as e:
+        out["decode_error"] = repr(e)[:300]
 
     if on_accel and out.get("hw_gemm_tfs_measured") and out.get("llama_mfu"):
         out["llama_mfu_vs_measured_peak"] = round(

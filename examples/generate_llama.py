@@ -1,0 +1,31 @@
+"""Compiled text generation: prefill + decode scan in one XLA program.
+
+    python examples/generate_llama.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    paddle.seed(0)
+    model = LlamaForCausalLM(LlamaConfig.tiny(tensor_parallel=False,
+                                              use_flash_attention=False))
+    model.eval()
+    prompt = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 1000, (2, 8), np.int32))
+    greedy = model.generate(prompt, max_new_tokens=16)
+    sampled = model.generate(prompt, max_new_tokens=16, do_sample=True,
+                             temperature=0.8, top_p=0.9)
+    print("greedy :", np.asarray(greedy._value))
+    print("sampled:", np.asarray(sampled._value))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""BASELINE config #5: LLaMA hybrid parallel pretrain (tp x dp x ZeRO-2).
+
+One compiled XLA program per step; the mesh axes express the parallelism and
+XLA's SPMD partitioner inserts the collectives.  Runs on a virtual CPU mesh:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 JAX_PLATFORMS=cpu \
+        python examples/pretrain_llama_hybrid.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.distributed.fleet import DistributedStrategy, fleet
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+
+def main():
+    s = DistributedStrategy()
+    s.hybrid_configs = {"dp_degree": 2, "mp_degree": 2, "sharding_degree": 2}
+    s.sharding = True
+    s.sharding_configs = {"stage": 2}
+    fleet.init(is_collective=True, strategy=s)
+
+    paddle.seed(0)
+    cfg = LlamaConfig.tiny(tensor_parallel=True, use_flash_attention=False)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(
+        learning_rate=3e-4, weight_decay=0.01, parameters=model.parameters(),
+        grad_clip=nn.ClipGradByGlobalNorm(1.0))
+
+    def loss_fn(ids, labels):
+        loss, _ = model(ids, labels=labels)
+        return loss
+
+    step = fleet.distributed_train_step(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    for it in range(5):
+        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (8, 64), np.int32))
+        loss = step(ids, ids)
+        print(f"step {it}: loss={float(loss.item()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""BASELINE config #1: LeNet on MNIST, dygraph + compiled step.
+
+Run on anything (CPU/TPU):
+    python examples/train_lenet_mnist.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision.datasets import MNIST
+from paddle_tpu.vision.models import LeNet
+
+
+def main():
+    paddle.seed(0)
+    model = paddle.Model(LeNet(num_classes=10))
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=model.network.parameters()),
+        nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy(),
+    )
+    model.fit(MNIST(mode="train"), batch_size=64, epochs=1, verbose=1)
+    print(model.evaluate(MNIST(mode="test"), batch_size=256, verbose=0))
+
+
+if __name__ == "__main__":
+    main()

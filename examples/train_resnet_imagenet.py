@@ -1,0 +1,46 @@
+"""BASELINE config #2: ResNet-50 training throughput path.
+
+    python examples/train_resnet_imagenet.py          # synthetic data
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.vision.models import resnet50
+
+
+def main():
+    import jax
+
+    on_accel = jax.default_backend() != "cpu"
+    batch, img = (128, 224) if on_accel else (8, 64)
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    if on_accel:
+        model.bfloat16()
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                                    weight_decay=paddle.regularizer.L2Decay(1e-4),
+                                    parameters=model.parameters())
+    ce = nn.CrossEntropyLoss()
+
+    def loss_fn(x, y):
+        return ce(model(x).astype("float32"), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    for it in range(5):
+        x = paddle.to_tensor(rng.rand(batch, 3, img, img).astype(np.float32) * 2 - 1,
+                             dtype="bfloat16" if on_accel else "float32")
+        y = paddle.to_tensor(rng.randint(0, 1000, (batch,), np.int32))
+        loss = step(x, y)
+        print(f"step {it}: loss={float(loss.item()):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -55,6 +55,18 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     params, buffers = model.functional_state()
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
+    # one compiled program per generation signature, cached on the model —
+    # a fresh jax.jit per call would recompile the whole prefill+scan
+    cache_key = (B, S0, int(max_new_tokens), bool(do_sample), float(temperature),
+                 int(top_k), float(top_p), eos, int(pad_token_id))
+    gen_cache = model.__dict__.setdefault("_generate_cache", {})
+    if cache_key in gen_cache:
+        key = _random.get_rng_key()
+        out = gen_cache[cache_key](params, ids, key)
+        t = Tensor(out)
+        t.stop_gradient = True
+        return t
+
     def run(params, ids, key):
         restore = model.bind_functional_state(params, buffers)
         try:
@@ -94,8 +106,10 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             restore()
         return out
 
+    jitted = jax.jit(run)
+    gen_cache[cache_key] = jitted
     key = _random.get_rng_key()
-    out = jax.jit(run)(params, ids, key)
+    out = jitted(params, ids, key)
     t = Tensor(out)
     t.stop_gradient = True
     return t
