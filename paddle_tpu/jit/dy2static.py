@@ -1,0 +1,412 @@
+"""dy2static: AST conversion of data-dependent Python control flow.
+
+Ref: the dygraph_to_static transformer suite
+(fluid/dygraph/dygraph_to_static/ast_transformer.py, ifelse_transformer.py,
+loop_transformer.py, convert_operators.py) — `@to_static` functions get their
+`if`/`while` statements rewritten so a Tensor-valued condition becomes graph
+control flow instead of a silent single-branch trace.
+
+TPU-native translation (SURVEY §7.1): the rewrite targets jax.lax.cond /
+lax.while_loop directly.  The generated code uses the reference's
+get_args/set_args closure pattern: branch bodies mutate the enclosing
+function's locals through `nonlocal`, and the runtime converter snapshots /
+restores them around each branch trace so both branches see the pre-branch
+state.  Gradients flow natively: inside jit/to_static the whole program is
+differentiated by jax.vjp, which understands lax.cond/while_loop.
+
+Supported: `if`/`elif`/`else` and `while` over Tensor conditions, nested
+arbitrarily, with Python-valued conditions keeping exact Python semantics.
+Not converted (left as plain Python, which errors loudly on a traced
+condition): branches containing `return`/`yield`, loops containing
+`break`/`continue`, and `for` loops (trace-unrolled as before).
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+import types
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor
+
+__all__ = ["convert_control_flow", "convert_ifelse", "convert_while"]
+
+_HELPER = "__pt_jst__"
+_PREFIX = "_pt_jst_"
+
+
+class _Undefined:
+    __slots__ = ()
+
+    def __repr__(self):
+        return "<undefined local>"
+
+
+UNDEFINED = _Undefined()
+
+
+# --------------------------------------------------------------------- runtime
+
+def _raw(v):
+    return v._value if isinstance(v, Tensor) else v
+
+
+def _is_traced(v):
+    return isinstance(_raw(v), jax.core.Tracer)
+
+
+def _kind(v):
+    if isinstance(v, Tensor):
+        return "tensor"
+    if isinstance(v, (bool, int, float, complex)) or hasattr(v, "dtype"):
+        return "raw"
+    return "static"
+
+
+def _pack(vals, kinds):
+    """Numeric leaves only, as raw arrays (the lax carry/branch output)."""
+    return tuple(_raw(v) for v, k in zip(vals, kinds) if k != "static")
+
+
+def _unpack(packed, kinds, statics):
+    out = []
+    it = iter(packed)
+    st = iter(statics)
+    for k in kinds:
+        if k == "static":
+            out.append(next(st))
+        elif k == "tensor":
+            out.append(Tensor(next(it)))
+        else:
+            out.append(next(it))
+    return tuple(out)
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
+    """Generated-code entry for a rewritten `if` (ref convert_operators.py
+    convert_ifelse)."""
+    pv = _raw(pred)
+    if not isinstance(pv, jax.core.Tracer):
+        if (bool(jnp.all(pv)) if hasattr(pv, "dtype") else bool(pv)):
+            true_fn()
+        else:
+            false_fn()
+        return
+
+    init = get_args()
+    observed = {}
+
+    def _branch(fn, tag):
+        def run():
+            set_args(init)
+            fn()
+            out = get_args()
+            if any(isinstance(v, _Undefined) for v in out):
+                raise ValueError(
+                    "dy2static: a variable is assigned in only one branch "
+                    "of a Tensor-condition `if`; assign it in both branches "
+                    "(or before the if)")
+            kinds = [_kind(v) for v in out]
+            observed[tag] = (kinds, [v for v, k in zip(out, kinds) if k == "static"])
+            return _pack(out, kinds)
+
+        return run
+
+    # branches trace sequentially; jax enforces matching output structures
+    out = jax.lax.cond(jnp.all(pv), _branch(true_fn, "t"), _branch(false_fn, "f"))
+    if not isinstance(out, tuple):
+        out = (out,)
+    kinds, statics = observed["t"]
+    kinds_f, statics_f = observed["f"]
+    if kinds != kinds_f or any(a is not b for a, b in zip(statics, statics_f)):
+        raise ValueError(
+            "dy2static: the two branches of a Tensor-condition `if` produce "
+            "different variable kinds/objects — both must assign the same "
+            "tensor/python structure")
+    set_args(_unpack(out, kinds, statics))
+
+
+def convert_while(test_fn, body_fn, get_args, set_args):
+    """Generated-code entry for a rewritten `while` (ref convert_while_loop)."""
+    first = _raw(test_fn())
+    if not isinstance(first, jax.core.Tracer):
+        # Python semantics: the loop unrolls under trace if the BODY produces
+        # tracers while the test stays concrete — exactly like before
+        while (bool(jnp.all(first)) if hasattr(first, "dtype") else bool(first)):
+            body_fn()
+            first = _raw(test_fn())
+        return
+
+    init_vals = get_args()
+    # vars undefined before the loop are loop-local temporaries: each
+    # iteration reassigns them before use, so they are not carried (their
+    # UNDEFINED placeholder classifies as "static" and round-trips untouched)
+    kinds = [_kind(v) for v in init_vals]
+    statics = [v for v, k in zip(init_vals, kinds) if k == "static"]
+
+    def cond(carry):
+        set_args(_unpack(carry, kinds, statics))
+        return jnp.all(_raw(test_fn()))
+
+    def body(carry):
+        set_args(_unpack(carry, kinds, statics))
+        body_fn()
+        return _pack(get_args(), kinds)
+
+    out = jax.lax.while_loop(cond, body, _pack(init_vals, kinds))
+    set_args(_unpack(out, kinds, statics))
+
+
+# ----------------------------------------------------------------- AST rewrite
+
+class _AssignedNames(ast.NodeVisitor):
+    """Names bound by a statement list, excluding nested scopes' internals."""
+
+    def __init__(self):
+        self.names = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)) and not node.id.startswith(_PREFIX):
+            self.names.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        if not node.name.startswith(_PREFIX):
+            self.names.add(node.name)
+        # don't descend: its body is a new scope
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self.names.add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _assigned(stmts):
+    v = _AssignedNames()
+    for s in stmts:
+        v.visit(s)
+    return v.names
+
+
+class _BlockersFound(Exception):
+    pass
+
+
+class _FindBlockers(ast.NodeVisitor):
+    """Return/Yield anywhere (excluding nested scopes); Break/Continue not
+    enclosed in a nested loop."""
+
+    def __init__(self):
+        self.loop_depth = 0
+
+    def visit_Return(self, node):
+        raise _BlockersFound
+
+    def visit_Yield(self, node):
+        raise _BlockersFound
+
+    visit_YieldFrom = visit_Return
+
+    def visit_Break(self, node):
+        if self.loop_depth == 0:
+            raise _BlockersFound
+
+    visit_Continue = visit_Break
+
+    def visit_While(self, node):
+        self.loop_depth += 1
+        self.generic_visit(node)
+        self.loop_depth -= 1
+
+    visit_For = visit_While
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+def _has_blockers(stmts, in_loop=False):
+    f = _FindBlockers()
+    if in_loop:
+        # break/continue at this level belong to the loop being transformed
+        f.loop_depth = 0
+    try:
+        for s in stmts:
+            f.visit(s)
+    except _BlockersFound:
+        return True
+    return False
+
+
+def _name(n, ctx=None):
+    return ast.Name(id=n, ctx=ctx or ast.Load())
+
+
+def _guard_init(var):
+    """try: var \n except NameError: var = __pt_jst__.UNDEFINED — creates a
+    local binding (so `nonlocal` resolves) without clobbering live values."""
+    return ast.Try(
+        body=[ast.Expr(value=_name(var))],
+        handlers=[ast.ExceptHandler(
+            type=_name("NameError"),
+            name=None,
+            body=[ast.Assign(
+                targets=[_name(var, ast.Store())],
+                value=ast.Attribute(value=_name(_HELPER), attr="UNDEFINED",
+                                    ctx=ast.Load()))])],
+        orelse=[], finalbody=[])
+
+
+def _fn_def(name, body, args=()):
+    node = ast.FunctionDef(
+        name=name,
+        args=ast.arguments(posonlyargs=[], args=[ast.arg(arg=a) for a in args],
+                           vararg=None, kwonlyargs=[], kw_defaults=[],
+                           kwarg=None, defaults=[]),
+        body=body, decorator_list=[], returns=None)
+    node.type_params = []  # py3.12 ast field
+    return node
+
+
+def _get_set_defs(idx, varlist):
+    tup = ast.Tuple(elts=[_name(v) for v in varlist], ctx=ast.Load())
+    get = _fn_def(f"{_PREFIX}get_{idx}", [ast.Return(value=tup)])
+    set_body = [ast.Nonlocal(names=list(varlist)),
+                ast.Assign(
+                    targets=[ast.Tuple(elts=[_name(v, ast.Store()) for v in varlist],
+                                       ctx=ast.Store())],
+                    value=_name(f"{_PREFIX}v"))]
+    set_ = _fn_def(f"{_PREFIX}set_{idx}", set_body, args=(f"{_PREFIX}v",))
+    return get, set_
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.idx = 0
+
+    def _helper_call(self, fn_name, args):
+        return ast.Expr(value=ast.Call(
+            func=ast.Attribute(value=_name(_HELPER), attr=fn_name, ctx=ast.Load()),
+            args=args, keywords=[]))
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        if _has_blockers(node.body) or _has_blockers(node.orelse):
+            return node
+        varlist = sorted(_assigned(node.body) | _assigned(node.orelse))
+        if not varlist:
+            return node
+        i = self.idx
+        self.idx += 1
+        inits = [_guard_init(v) for v in varlist]
+        nl = ast.Nonlocal(names=list(varlist))
+        true_fn = _fn_def(f"{_PREFIX}true_{i}", [nl] + node.body)
+        false_fn = _fn_def(f"{_PREFIX}false_{i}",
+                           [ast.Nonlocal(names=list(varlist))]
+                           + (node.orelse or [ast.Pass()]))
+        get, set_ = _get_set_defs(i, varlist)
+        call = self._helper_call("convert_ifelse", [
+            node.test,
+            _name(true_fn.name), _name(false_fn.name),
+            _name(get.name), _name(set_.name)])
+        return inits + [true_fn, false_fn, get, set_, call]
+
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if node.orelse or _has_blockers(node.body, in_loop=True):
+            return node
+        varlist = sorted(_assigned(node.body))
+        if not varlist:
+            return node
+        i = self.idx
+        self.idx += 1
+        inits = [_guard_init(v) for v in varlist]
+        test_fn = _fn_def(f"{_PREFIX}test_{i}", [ast.Return(value=node.test)])
+        body_fn = _fn_def(f"{_PREFIX}body_{i}",
+                          [ast.Nonlocal(names=list(varlist))] + node.body)
+        get, set_ = _get_set_defs(i, varlist)
+        call = self._helper_call("convert_while", [
+            _name(test_fn.name), _name(body_fn.name),
+            _name(get.name), _name(set_.name)])
+        return inits + [test_fn, body_fn, get, set_, call]
+
+
+def _needs_conversion(tree):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.If, ast.While)):
+            return True
+    return False
+
+
+def convert_control_flow(fn):
+    """Rewrite `fn`'s if/while statements for graph capture.  Falls back to
+    the original function when the source is unavailable or the transform
+    does not apply (no control flow, lambdas, builtins)."""
+    if isinstance(fn, functools.partial) or not isinstance(
+            fn, (types.FunctionType, types.MethodType)):
+        return fn
+    inner = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    if getattr(inner, "_pt_dy2static_converted", False):
+        return fn
+    try:
+        src = textwrap.dedent(inspect.getsource(inner))
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError, IndentationError):
+        return fn
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return fn
+    if not _needs_conversion(fdef):
+        return fn
+    fdef.decorator_list = []  # don't re-apply @to_static etc. on exec
+    new_body = _ControlFlowTransformer().visit(fdef)
+    ast.fix_missing_locations(tree)
+
+    from . import dy2static as _self_mod
+
+    class _LiveGlobals(dict):
+        """Overlay over the function's REAL globals: unknown names resolve
+        live (so later-defined helpers / monkeypatching keep working),
+        while the overlay carries the helper module + closure snapshot."""
+
+        def __missing__(self, key):
+            return inner.__globals__[key]
+
+    glb = _LiveGlobals()
+    # the import machinery reads these via raw dict lookups (no __missing__)
+    for dunder in ("__name__", "__package__", "__spec__", "__loader__",
+                   "__builtins__", "__file__"):
+        if dunder in inner.__globals__:
+            glb[dunder] = inner.__globals__[dunder]
+    if inner.__closure__:
+        try:
+            glb.update({name: cell.cell_contents
+                        for name, cell in zip(inner.__code__.co_freevars,
+                                              inner.__closure__)})
+        except ValueError:
+            # an empty cell (recursive/forward-referencing nested function):
+            # the snapshot can't represent it — leave the function alone
+            return fn
+    glb[_HELPER] = _self_mod
+    try:
+        code = compile(tree, filename=f"<dy2static {inner.__qualname__}>",
+                       mode="exec")
+        exec(code, glb)
+    except SyntaxError:
+        return fn
+    new_fn = glb[fdef.name]
+    new_fn.__defaults__ = inner.__defaults__
+    new_fn.__kwdefaults__ = inner.__kwdefaults__
+    new_fn._pt_dy2static_converted = True
+    functools.update_wrapper(new_fn, inner, updated=())
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(new_fn, fn.__self__)
+    return new_fn

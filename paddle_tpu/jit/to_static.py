@@ -99,6 +99,11 @@ class StaticFunction:
     STORM_WARN_EVERY = 16   # warn every N fresh compiles (recompilation storm)
 
     def __init__(self, function, input_spec=None, build_strategy=None, layer=None, backend=None):
+        if not getattr(function, "_paddle_not_to_static", False):
+            # dy2static AST pass: Tensor-condition if/while -> lax control flow
+            from .dy2static import convert_control_flow
+
+            function = convert_control_flow(function)
         self._function = function
         self._layer = layer
         self._input_spec = input_spec

@@ -56,18 +56,22 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     eos = -1 if eos_token_id is None else int(eos_token_id)
 
     # one compiled program per generation signature, cached on the model —
-    # a fresh jax.jit per call would recompile the whole prefill+scan
+    # a fresh jax.jit per call would recompile the whole prefill+scan.
+    # params AND buffers are explicit jit arguments, so weight/buffer updates
+    # (set_state_dict, dtype casts) flow into cached programs; a dtype change
+    # simply retraces under the same jit object.
     cache_key = (B, S0, int(max_new_tokens), bool(do_sample), float(temperature),
-                 int(top_k), float(top_p), eos, int(pad_token_id))
+                 int(top_k), float(top_p), eos, int(pad_token_id),
+                 bool(model.training))
     gen_cache = model.__dict__.setdefault("_generate_cache", {})
     if cache_key in gen_cache:
         key = _random.get_rng_key()
-        out = gen_cache[cache_key](params, ids, key)
+        out = gen_cache[cache_key](params, buffers, ids, key)
         t = Tensor(out)
         t.stop_gradient = True
         return t
 
-    def run(params, ids, key):
+    def run(params, buffers, ids, key):
         restore = model.bind_functional_state(params, buffers)
         try:
             with tape.no_grad():
@@ -109,7 +113,7 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     jitted = jax.jit(run)
     gen_cache[cache_key] = jitted
     key = _random.get_rng_key()
-    out = jitted(params, ids, key)
+    out = jitted(params, buffers, ids, key)
     t = Tensor(out)
     t.stop_gradient = True
     return t

@@ -69,6 +69,21 @@ def test_gpt_generate_matches_oracle():
     np.testing.assert_array_equal(np.asarray(out._value), ids[:, 5:])
 
 
+def test_generate_cache_sees_weight_updates():
+    """A cached generate program must consume CURRENT params/buffers."""
+    model = _model()
+    prompt = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, 128, (1, 4), np.int32))
+    out1 = np.asarray(model.generate(prompt, max_new_tokens=3)._value)
+    sd = model.state_dict()
+    for k, v in sd.items():
+        if "lm_head" in k:
+            sd[k] = paddle.Tensor(v._value * -1.0)
+    model.set_state_dict(sd)
+    out2 = np.asarray(model.generate(prompt, max_new_tokens=3)._value)
+    assert not np.array_equal(out1, out2)
+
+
 def test_single_token_path():
     model = _model()
     prompt = np.random.RandomState(1).randint(0, 128, (1, 4)).astype(np.int32)
