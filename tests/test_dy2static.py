@@ -148,8 +148,11 @@ def test_recursive_nested_function_does_not_crash_decoration():
 
         return g
 
-    g = outer()  # empty closure cell: conversion falls back, no crash
-    np.testing.assert_allclose(np.asarray(g(A)._value), [1.0, 2.0])
+    g = outer()  # empty closure cell: conversion falls back, no crash at
+    # decoration; the unconverted Tensor-condition then raises the honest
+    # tracer-bool error at call time instead of silently mistracing
+    with pytest.raises(Exception):
+        g(A)
 
 
 def test_while_state_machine_matches_python():
