@@ -319,8 +319,48 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             _name(get.name), _name(set_.name)])
         return inits + [true_fn, false_fn, get, set_, call]
 
-    def visit_While(self, node):
+    def visit_For(self, node):
+        """`for i in range(...)` desugars to a while (then converts like one);
+        any other iterable keeps Python semantics (trace-unrolled)."""
         self.generic_visit(node)
+        if (node.orelse
+                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or not 1 <= len(node.iter.args) <= 3
+                or _has_blockers(node.body, in_loop=True)):
+            return node
+        i = self.idx  # unique temp-name suffix (shared counter)
+        self.idx += 1
+        a = node.iter.args
+        start = a[0] if len(a) >= 2 else ast.Constant(value=0)
+        stop = a[1] if len(a) >= 2 else a[0]
+        step = a[2] if len(a) == 3 else ast.Constant(value=1)
+        it = node.target.id
+        stop_n, step_n = f"{_PREFIX}stop{i}", f"{_PREFIX}step{i}"
+        assigns = [
+            ast.Assign(targets=[_name(it, ast.Store())], value=start),
+            ast.Assign(targets=[_name(stop_n, ast.Store())], value=stop),
+            ast.Assign(targets=[_name(step_n, ast.Store())], value=step),
+        ]
+        test = ast.IfExp(
+            test=ast.Compare(left=_name(step_n), ops=[ast.Gt()],
+                             comparators=[ast.Constant(value=0)]),
+            body=ast.Compare(left=_name(it), ops=[ast.Lt()],
+                             comparators=[_name(stop_n)]),
+            orelse=ast.Compare(left=_name(it), ops=[ast.Gt()],
+                               comparators=[_name(stop_n)]))
+        incr = ast.AugAssign(target=_name(it, ast.Store()), op=ast.Add(),
+                             value=_name(step_n))
+        loop = ast.While(test=test, body=node.body + [incr], orelse=[])
+        out = self.visit_While(loop, skip_children=True)
+        return assigns + (out if isinstance(out, list) else [out])
+
+    def visit_While(self, node, skip_children=False):
+        if not skip_children:
+            self.generic_visit(node)
         if node.orelse or _has_blockers(node.body, in_loop=True):
             return node
         varlist = sorted(_assigned(node.body))
@@ -341,7 +381,7 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
 def _needs_conversion(tree):
     for node in ast.walk(tree):
-        if isinstance(node, (ast.If, ast.While)):
+        if isinstance(node, (ast.If, ast.While, ast.For)):
             return True
     return False
 

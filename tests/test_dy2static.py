@@ -119,6 +119,43 @@ def test_return_in_branch_falls_back_to_python():
         r(A)
 
 
+def test_tensor_range_for_dynamic_trip_count():
+    """for i in range(tensor_n) desugars to lax.while_loop — the trip count
+    is a runtime value, one compiled program serves every n."""
+    @paddle.jit.to_static
+    def f(x, n):
+        s = paddle.zeros([], "float32")
+        for i in range(n):
+            s = s + x.sum() + i
+        return s
+
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    assert float(f(x, paddle.to_tensor(np.asarray(4, np.int32))).item()) == 14.0
+    assert float(f(x, paddle.to_tensor(np.asarray(6, np.int32))).item()) == 27.0
+    assert f._compile_count == 1  # same program, different trip count
+
+    @paddle.jit.to_static
+    def h(x, n):
+        s = paddle.zeros([], "float32")
+        for i in range(1, n, 2):
+            s = s + i
+        return s
+
+    assert float(h(x, paddle.to_tensor(np.asarray(8, np.int32))).item()) == 16.0
+
+
+def test_python_range_for_unchanged():
+    @paddle.jit.to_static
+    def g(x):
+        s = x * 0
+        for i in range(3):
+            s = s + x * i
+        return s
+
+    np.testing.assert_allclose(
+        np.asarray(g(paddle.to_tensor(np.ones(2, np.float32)))._value), [3.0, 3.0])
+
+
 def test_late_bound_globals_resolve_live():
     """Names defined AFTER decoration must still resolve (live globals)."""
     @paddle.jit.to_static
