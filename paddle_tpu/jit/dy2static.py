@@ -130,6 +130,36 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
     set_args(_unpack(out, kinds, statics))
 
 
+_NO_CONVERT_MODULE_PREFIXES = ("paddle_tpu", "jax", "numpy", "builtins",
+                               "functools", "itertools", "math", "typing")
+
+
+def convert_call(fn):
+    """Resolve a callee at runtime (ref convert_operators.py convert_call):
+    plain user-defined functions get the same control-flow conversion as the
+    decorated function (cached on the function object); framework/builtin
+    callables pass through untouched."""
+    inner = fn.__func__ if isinstance(fn, types.MethodType) else fn
+    if not isinstance(inner, types.FunctionType):
+        return fn
+    mod = inner.__module__ or ""
+    if any(mod == p or mod.startswith(p + ".") for p in _NO_CONVERT_MODULE_PREFIXES):
+        return fn
+    cached = getattr(inner, "_pt_d2s_converted_fn", None)
+    if cached is None:
+        try:
+            cached = convert_control_flow(inner)
+        except Exception:
+            cached = inner
+        try:
+            inner._pt_d2s_converted_fn = cached
+        except (AttributeError, TypeError):
+            cached = inner
+    if isinstance(fn, types.MethodType):
+        return types.MethodType(cached, fn.__self__)
+    return cached
+
+
 def convert_while(test_fn, body_fn, get_args, set_args):
     """Generated-code entry for a rewritten `while` (ref convert_while_loop)."""
     first = _raw(test_fn())
@@ -288,9 +318,32 @@ def _get_set_defs(idx, varlist):
     return get, set_
 
 
+_BUILTIN_SKIP = {"range", "super", "len", "print", "isinstance", "type",
+                 "getattr", "setattr", "hasattr", "enumerate", "zip", "list",
+                 "tuple", "dict", "set", "int", "float", "bool", "str", "max",
+                 "min", "sum", "abs", "sorted"}
+
+
 class _ControlFlowTransformer(ast.NodeTransformer):
     def __init__(self):
         self.idx = 0
+
+    def visit_Call(self, node):
+        """Route callees through convert_call so helper functions get the
+        same conversion (ref convert_call in convert_operators.py)."""
+        self.generic_visit(node)
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == _HELPER:
+            return node
+        if isinstance(f, ast.Name) and (f.id.startswith(_PREFIX)
+                                        or f.id in _BUILTIN_SKIP):
+            return node
+        node.func = ast.Call(
+            func=ast.Attribute(value=_name(_HELPER), attr="convert_call",
+                               ctx=ast.Load()),
+            args=[f], keywords=[])
+        return node
 
     def _helper_call(self, fn_name, args):
         return ast.Expr(value=ast.Call(

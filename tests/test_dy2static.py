@@ -144,6 +144,51 @@ def test_tensor_range_for_dynamic_trip_count():
     assert float(h(x, paddle.to_tensor(np.asarray(8, np.int32))).item()) == 16.0
 
 
+def _branchy_helper(x):
+    if x.sum() > 0:
+        y = x * 2
+    else:
+        y = x * -3
+    return y
+
+
+class _Decider:
+    def pick(self, x):
+        if x.sum() > 0:
+            r = x + 100
+        else:
+            r = x - 100
+        return r
+
+
+def test_convert_call_spreads_to_helpers_and_methods():
+    """Callees get the same conversion (ref convert_call)."""
+    @paddle.jit.to_static
+    def f(x):
+        if x.sum() < 1000:
+            z = _branchy_helper(x)
+        else:
+            z = x
+        return z
+
+    np.testing.assert_allclose(np.asarray(f(A)._value), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(f(B)._value), [3.0, 6.0])
+    assert f._compile_count == 1
+
+    d = _Decider()
+
+    @paddle.jit.to_static
+    def g(x):
+        if x.sum() < 1000:
+            out = d.pick(x)
+        else:
+            out = x
+        return out
+
+    np.testing.assert_allclose(np.asarray(g(A)._value), [101.0, 102.0])
+    np.testing.assert_allclose(np.asarray(g(B)._value), [-101.0, -102.0])
+
+
 def test_python_range_for_unchanged():
     @paddle.jit.to_static
     def g(x):
