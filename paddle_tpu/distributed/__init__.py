@@ -29,6 +29,18 @@ from . import checkpoint  # noqa: F401
 from . import fleet_executor  # noqa: F401
 from . import launch  # noqa: F401
 from . import utils  # noqa: F401
+from .parallel_mode import (  # noqa: F401
+    CountFilterEntry,
+    InMemoryDataset,
+    ParallelMode,
+    ProbabilityEntry,
+    QueueDataset,
+    ShowClickEntry,
+    gloo_barrier,
+    gloo_init_parallel_env,
+    gloo_release,
+    split,
+)
 
 
 def spawn(func, args=(), nprocs=-1, join=True, daemon=False, **options):

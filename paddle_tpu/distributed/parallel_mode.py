@@ -1,0 +1,126 @@
+"""ParallelMode + distributed.split + gloo CPU-barrier helpers + PS dataset
+stubs (ref: python/paddle/distributed/parallel.py ParallelMode,
+collective.py split:?, parallel.py gloo_init_parallel_env; fleet dataset
+classes are parameter-server ingestion — an explicit non-goal, SURVEY §7.4).
+"""
+from __future__ import annotations
+
+import warnings
+
+
+class ParallelMode:
+    """Ref distributed/parallel.py ParallelMode constants."""
+
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+_split_layer_cache = {}
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style split linear/embedding (ref distributed/collective.py
+    split): builds the Column/Row-parallel layer and applies it.
+
+    The created parameters are cached by `name` so repeated forward calls
+    train ONE set of weights; pass a unique name per call site (an automatic
+    shape-derived key is used otherwise, which collides for two same-shaped
+    splits — hence the warning)."""
+    from .meta_parallel.mp_layers import (
+        ColumnParallelLinear,
+        RowParallelLinear,
+        VocabParallelEmbedding,
+    )
+
+    if operation not in ("linear", "embedding"):
+        raise ValueError(f"operation must be 'linear' or 'embedding', got {operation}")
+    key = name
+    if key is None:
+        key = f"{operation}:{tuple(size)}:{axis}:{num_partitions}"
+        warnings.warn(
+            "distributed.split called without `name`: parameters are cached "
+            "by an automatic shape key, which collides if two same-shaped "
+            "splits exist — pass a unique name per call site", stacklevel=2)
+    layer = _split_layer_cache.get(key)
+    if layer is None:
+        if operation == "embedding":
+            layer = VocabParallelEmbedding(size[0], size[1], weight_attr=weight_attr)
+        elif axis == 1:
+            layer = RowParallelLinear(size[0], size[1], has_bias=bias_attr is not False,
+                                      input_is_parallel=False, weight_attr=weight_attr)
+        else:
+            layer = ColumnParallelLinear(size[0], size[1],
+                                         has_bias=bias_attr is not False,
+                                         gather_output=gather_out,
+                                         weight_attr=weight_attr)
+        _split_layer_cache[key] = layer
+    return layer(x)
+
+
+# ------------------------------------------------------------- gloo helpers
+
+_gloo_store = None
+_gloo_rank = None
+_gloo_n = None
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU rendezvous over the TCPStore (the reference uses a gloo HTTP
+    store; same contract: rank 0 hosts, everyone meets)."""
+    global _gloo_store, _gloo_rank, _gloo_n
+    from .store import TCPStore
+
+    host, port = server_endpoint.rsplit(":", 1)
+    _gloo_store = TCPStore(host, int(port), is_master=(int(rank_id) == 0),
+                           world_size=int(rank_num))
+    _gloo_rank, _gloo_n = int(rank_id), int(rank_num)
+    _gloo_store.add("gloo/init", 1)
+    _gloo_store.wait(["gloo/init"])
+
+
+def gloo_barrier():
+    """Block until every rank arrives (counter on the shared store)."""
+    if _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    n = _gloo_store.add("gloo/barrier", 1)
+    gen = (n - 1) // _gloo_n  # barrier generation this arrival belongs to
+    import time
+
+    deadline = time.time() + 300
+    while _gloo_store.add("gloo/barrier", 0) < (gen + 1) * _gloo_n:
+        if time.time() > deadline:
+            raise TimeoutError("gloo_barrier timed out")
+        time.sleep(0.01)
+
+
+def gloo_release():
+    global _gloo_store
+    if _gloo_store is not None:
+        close = getattr(_gloo_store, "close", None)
+        if close:
+            close()
+        _gloo_store = None
+
+
+# ------------------------------------------------- PS dataset stubs (§7.4)
+
+def _ps_stub(cls_name):
+    class _Stub:
+        def __init__(self, *a, **k):
+            raise NotImplementedError(
+                f"{cls_name} belongs to the parameter-server ingestion stack, "
+                f"an explicit non-goal of the TPU build (SURVEY §7.4); use "
+                f"paddle.io.Dataset/DataLoader for input pipelines")
+
+    _Stub.__name__ = cls_name
+    return _Stub
+
+
+QueueDataset = _ps_stub("QueueDataset")
+InMemoryDataset = _ps_stub("InMemoryDataset")
+ProbabilityEntry = _ps_stub("ProbabilityEntry")
+CountFilterEntry = _ps_stub("CountFilterEntry")
+ShowClickEntry = _ps_stub("ShowClickEntry")

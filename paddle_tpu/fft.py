@@ -82,3 +82,67 @@ def fftshift(x, axes=None, name=None):
 
 def ifftshift(x, axes=None, name=None):
     return apply_op(lambda v: jnp.fft.ifftshift(v, axes=axes), (x,), name="ifftshift")
+
+
+def _split_axes(x, s, axes, nd_default):
+    if axes is None:
+        axes = tuple(range(-nd_default, 0)) if nd_default else tuple(range(x.ndim))
+    axes = tuple(axes)
+    if s is not None:
+        s = tuple(s)
+    return s, axes
+
+
+def _hfftn_impl(v, s, axes, norm):
+    """FFT of Hermitian-symmetric input -> real output: full ffts over the
+    leading axes, hermitian fft over the LAST axis (the truncated one) —
+    ref python/paddle/fft.py hfftn composition."""
+    lead, last = axes[:-1], axes[-1]
+    if lead:
+        v = jnp.fft.fftn(v, s=(s[:-1] if s else None), axes=lead, norm=norm)
+    return jnp.fft.hfft(v, n=(s[-1] if s else None), axis=last, norm=norm)
+
+
+def _ihfftn_impl(v, s, axes, norm):
+    lead, last = axes[:-1], axes[-1]
+    v = jnp.fft.ihfft(v, n=(s[-1] if s else None), axis=last, norm=norm)
+    if lead:
+        v = jnp.fft.ifftn(v, s=(s[:-1] if s else None), axes=lead, norm=norm)
+    return v
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    from .tensor.tensor import apply_op
+
+    return apply_op(lambda v: _hfftn_impl(v, s, tuple(axes), _norm(norm)),
+                    (x,), name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    from .tensor.tensor import apply_op
+
+    return apply_op(lambda v: _ihfftn_impl(v, s, tuple(axes), _norm(norm)),
+                    (x,), name="ihfft2")
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    from .tensor.tensor import apply_op
+
+    def _f(v):
+        s2, ax = _split_axes(v, s, axes, 0)
+        return _hfftn_impl(v, s2, ax, _norm(norm))
+
+    return apply_op(_f, (x,), name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    from .tensor.tensor import apply_op
+
+    def _f(v):
+        s2, ax = _split_axes(v, s, axes, 0)
+        return _ihfftn_impl(v, s2, ax, _norm(norm))
+
+    return apply_op(_f, (x,), name="ihfftn")
+
+
+__all__ += ["hfft2", "ihfft2", "hfftn", "ihfftn"]

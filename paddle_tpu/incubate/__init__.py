@@ -144,8 +144,36 @@ class nn:  # incubate.nn fused layers namespace (fused == XLA-fused on TPU)
             return _FFN()
 
 
-def graph_send_recv(*args, **kwargs):
-    raise NotImplementedError
+def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
+                    name=None):
+    """Gather rows at src_index, scatter-reduce into dst_index (ref
+    incubate/operators/graph_send_recv.py — the message-passing primitive).
+    TPU-native: one gather + one scatter-reduce, both XLA-native."""
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import apply_op
+
+    if pool_type not in ("sum", "mean", "max", "min"):
+        raise ValueError(f"pool_type must be sum/mean/max/min, got {pool_type}")
+
+    def _f(v, src, dst):
+        n = int(out_size) if out_size is not None else v.shape[0]
+        msgs = v[src.astype(jnp.int32)]
+        shape = (n,) + v.shape[1:]
+        dst = dst.astype(jnp.int32)
+        if pool_type == "sum":
+            return jnp.zeros(shape, v.dtype).at[dst].add(msgs)
+        if pool_type == "mean":
+            tot = jnp.zeros(shape, v.dtype).at[dst].add(msgs)
+            cnt = jnp.zeros((n,), v.dtype).at[dst].add(1.0)
+            return tot / jnp.maximum(cnt, 1.0).reshape((n,) + (1,) * (v.ndim - 1))
+        if pool_type == "max":
+            out = jnp.full(shape, -jnp.inf, v.dtype).at[dst].max(msgs)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+        out = jnp.full(shape, jnp.inf, v.dtype).at[dst].min(msgs)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return apply_op(_f, (x, src_index, dst_index), name="graph_send_recv")
 
 
 def segment_sum(data, segment_ids):
@@ -163,3 +191,178 @@ def segment_sum(data, segment_ids):
 
 
 
+
+
+def _segment_reduce(data, segment_ids, mode):
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import apply_op
+
+    def _f(d, s):
+        s = s.astype(jnp.int32)
+        # static segment-count bound = number of rows (XLA needs a static
+        # shape; ids are sorted per the reference contract, callers slice)
+        n = d.shape[0]
+        shape = (n,) + d.shape[1:]
+        if mode == "sum":
+            return jnp.zeros(shape, d.dtype).at[s].add(d)
+        if mode == "mean":
+            tot = jnp.zeros(shape, d.dtype).at[s].add(d)
+            cnt = jnp.zeros((n,), d.dtype).at[s].add(1.0)
+            return tot / jnp.maximum(cnt, 1.0).reshape((n,) + (1,) * (d.ndim - 1))
+        if mode == "max":
+            out = jnp.full(shape, -jnp.inf, d.dtype).at[s].max(d)
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+        out = jnp.full(shape, jnp.inf, d.dtype).at[s].min(d)
+        return jnp.where(jnp.isfinite(out), out, 0.0)
+
+    return apply_op(_f, (data, segment_ids), name=f"segment_{mode}")
+
+
+def segment_mean(data, segment_ids, name=None):
+    """Ref incubate/tensor/math.py segment_mean."""
+    return _segment_reduce(data, segment_ids, "mean")
+
+
+def segment_max(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "max")
+
+
+def segment_min(data, segment_ids, name=None):
+    return _segment_reduce(data, segment_ids, "min")
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """softmax(x + mask) — the reference fuses these into one CUDA kernel
+    (incubate/operators/softmax_mask_fuse.py); XLA fuses the composition."""
+    import jax
+
+    from ..tensor.tensor import apply_op
+
+    return apply_op(lambda v, m: jax.nn.softmax(v + m.astype(v.dtype), axis=-1),
+                    (x, mask), name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with the causal (upper-triangular) mask fused
+    (ref incubate/operators/softmax_mask_fuse_upper_triangle.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor.tensor import apply_op
+
+    def _f(v):
+        S1, S2 = v.shape[-2], v.shape[-1]
+        mask = jnp.tril(jnp.ones((S1, S2), bool))
+        return jax.nn.softmax(jnp.where(mask, v, -1e9), axis=-1)
+
+    return apply_op(_f, (x,), name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as the loss (IPU-era helper, ref incubate/nn/functional/
+    identity_loss): reduces per `reduction` and stops nothing."""
+    if reduction in (0, "sum"):
+        return x.sum()
+    if reduction in (1, "mean"):
+        return x.mean()
+    if reduction in (2, "none"):
+        return x
+    raise ValueError(f"reduction must be sum/mean/none, got {reduction}")
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbor sampling on a CSC graph (ref incubate/operators/
+    graph_khop_sampler.py).  Sampling is data-dependent (ragged) — an eager
+    host-side op by design, like the reference's CPU kernel."""
+    import numpy as np
+
+    from ..tensor.tensor import Tensor
+
+    rowv = np.asarray(_t2np(row))
+    colptrv = np.asarray(_t2np(colptr))
+    nodes = np.asarray(_t2np(input_nodes)).reshape(-1)
+    rng = np.random.RandomState(0)
+    edge_src, edge_dst = [], []
+    layer_nodes = nodes
+    seen = list(nodes)
+    for k in sample_sizes:
+        nxt = []
+        for dst in layer_nodes:
+            beg, end = int(colptrv[dst]), int(colptrv[dst + 1])
+            neigh = rowv[beg:end]
+            if len(neigh) > k:
+                neigh = rng.choice(neigh, size=k, replace=False)
+            for srcn in neigh:
+                edge_src.append(int(srcn))
+                edge_dst.append(int(dst))
+                nxt.append(int(srcn))
+        layer_nodes = np.unique(np.asarray(nxt, np.int64)) if nxt else np.empty(0, np.int64)
+        seen.extend(layer_nodes.tolist())
+    # reindex: unique nodes, input nodes first
+    uniq, idx = np.unique(np.asarray(seen, np.int64), return_index=True)
+    order = uniq[np.argsort(idx)]
+    remap = {int(n): i for i, n in enumerate(order)}
+    r_src = np.asarray([remap[s] for s in edge_src], np.int64)
+    r_dst = np.asarray([remap[d] for d in edge_dst], np.int64)
+    return (Tensor(_np2j(r_src)), Tensor(_np2j(r_dst)), Tensor(_np2j(order)),
+            Tensor(_np2j(np.asarray([len(edge_src)], np.int64))))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1, return_eids=False,
+                           flag_perm_buffer=False, name=None):
+    """One-hop neighbor sampling (ref incubate/operators/graph_sample_neighbors.py)."""
+    import numpy as np
+
+    from ..tensor.tensor import Tensor
+
+    rowv = np.asarray(_t2np(row))
+    colptrv = np.asarray(_t2np(colptr))
+    nodes = np.asarray(_t2np(input_nodes)).reshape(-1)
+    rng = np.random.RandomState(0)
+    out, counts = [], []
+    for dst in nodes:
+        beg, end = int(colptrv[dst]), int(colptrv[dst + 1])
+        neigh = rowv[beg:end]
+        if sample_size > 0 and len(neigh) > sample_size:
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out.extend(int(x) for x in neigh)
+        counts.append(len(neigh))
+    return (Tensor(_np2j(np.asarray(out, np.int64))),
+            Tensor(_np2j(np.asarray(counts, np.int64))))
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  flag_buffer_hashtable=False, name=None):
+    """Reindex a sampled subgraph to contiguous local ids
+    (ref incubate/operators/graph_reindex.py)."""
+    import numpy as np
+
+    from ..tensor.tensor import Tensor
+
+    xs = np.asarray(_t2np(x)).reshape(-1)
+    nb = np.asarray(_t2np(neighbors)).reshape(-1)
+    cnt = np.asarray(_t2np(count)).reshape(-1)
+    order = list(dict.fromkeys(list(xs) + list(nb)))
+    remap = {int(n): i for i, n in enumerate(order)}
+    re_nb = np.asarray([remap[int(n)] for n in nb], np.int64)
+    re_src = np.repeat(np.asarray([remap[int(n)] for n in xs], np.int64), cnt)
+    return (Tensor(_np2j(re_nb)), Tensor(_np2j(re_src)),
+            Tensor(_np2j(np.asarray(order, np.int64))))
+
+
+def _t2np(t):
+    import jax
+    import numpy as np
+
+    from ..tensor.tensor import Tensor
+
+    return np.asarray(jax.device_get(t._value)) if isinstance(t, Tensor) else np.asarray(t)
+
+
+def _np2j(a):
+    import jax.numpy as jnp
+
+    return jnp.asarray(a)

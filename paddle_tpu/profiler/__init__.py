@@ -211,3 +211,27 @@ def start_profiler(state="All", tracer_option="Default"):
 
 def stop_profiler(sorted_key=None, profile_path="/tmp/profile"):
     jax.profiler.stop_trace()
+
+
+class SortedKeys:
+    """Summary sort orders (ref profiler/profiler_statistic.py SortedKeys)."""
+
+    CPUTotal = 0
+    CPUAvg = 1
+    CPUMax = 2
+    CPUMin = 3
+    GPUTotal = 4
+    GPUAvg = 5
+    GPUMax = 6
+    GPUMin = 7
+
+
+def export_protobuf(dir_name, worker_name=None):
+    """The reference serializes its own profiler protobuf; this build's
+    native trace format is the Chrome trace (and XPlane via jax.profiler) —
+    raise with that guidance instead of writing a file that is not the
+    advertised format."""
+    raise NotImplementedError(
+        "protobuf profiler export is not supported on the TPU build; use "
+        "export_chrome_tracing(dir_name) (Perfetto/chrome://tracing-ready) "
+        "or jax.profiler.trace for XPlane/TensorBoard")

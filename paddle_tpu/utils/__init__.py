@@ -34,3 +34,20 @@ def deprecated(update_to="", since="", reason=""):
         return fn
 
     return wrapper
+
+
+def require_version(min_version, max_version=None):
+    """Raise unless the installed (parity) version is inside the range
+    (ref utils/__init__.py require_version)."""
+    from .. import version as _v
+
+    def parse(s):
+        return tuple(int(p) for p in str(s).split(".")[:3] if p.isdigit())
+
+    cur = parse(_v.full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"installed version {_v.full_version} < required min {min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"installed version {_v.full_version} > allowed max {max_version}")

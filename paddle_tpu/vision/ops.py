@@ -294,3 +294,235 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0, dilation=1,
     if bias is not None:
         extra.append(bias)
     return apply_op(_f, (x, offset, weight, *extra), name="deform_conv2d")
+
+
+class RoIAlign:
+    """Layer wrapper over roi_align (ref vision/ops.py RoIAlign:1398)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num, aligned=True):
+        return roi_align(x, boxes, boxes_num, self.output_size,
+                         self.spatial_scale, aligned=aligned)
+
+
+class RoIPool:
+    """Layer wrapper over roi_pool (ref vision/ops.py RoIPool:1251)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return roi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0, name=None):
+    """Position-sensitive ROI pooling (ref vision/ops.py psroi_pool:1073):
+    input channels C = out_channels*h*w; bin (i, j) reads its OWN channel
+    group — average-pooled per bin."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+    C = x.shape[1]
+    if C % (oh * ow) != 0:
+        raise ValueError(
+            f"psroi_pool: input channels {C} must be divisible by "
+            f"output_size^2 {oh * ow}")
+    oc = C // (oh * ow)
+
+    # reuse the bilinear ROI sampler per channel-group: sample a fine grid,
+    # then average within each bin, taking bin (i,j)'s group of channels
+    feats = roi_align(x, boxes, boxes_num, (oh, ow), spatial_scale,
+                      sampling_ratio=2, aligned=False)  # [R, C, oh, ow]
+
+    def _f(v):
+        R = v.shape[0]
+        v = v.reshape(R, oc, oh, ow, oh, ow)  # [R, oc, bin_i, bin_j, i, j]
+        idx_i = jnp.arange(oh)
+        idx_j = jnp.arange(ow)
+        # select the diagonal: output[i, j] from channel group (i, j)
+        v = v[:, :, idx_i[:, None], idx_j[None, :], idx_i[:, None], idx_j[None, :]]
+        return v
+
+    return apply_op(_f, (feats,), name="psroi_pool")
+
+
+class PSRoIPool:
+    """Layer wrapper over psroi_pool (ref vision/ops.py PSRoIPool:1137)."""
+
+    def __init__(self, output_size, spatial_scale=1.0):
+        self.output_size = output_size
+        self.spatial_scale = spatial_scale
+
+    def __call__(self, x, boxes, boxes_num):
+        return psroi_pool(x, boxes, boxes_num, self.output_size, self.spatial_scale)
+
+
+class DeformConv2D:
+    """Layer wrapper over deform_conv2d (ref vision/ops.py DeformConv2D:694)."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        from .. import nn
+        from ..nn.initializer import Constant, XavierUniform
+
+        ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) else tuple(kernel_size)
+        self.stride = stride
+        self.padding = padding
+        self.dilation = dilation
+        self.deformable_groups = deformable_groups
+        self.groups = groups
+        helper = nn.Layer()
+        self.weight = helper.create_parameter(
+            [out_channels, in_channels // groups, *ks], attr=weight_attr,
+            default_initializer=XavierUniform())
+        self.bias = (None if bias_attr is False else helper.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=Constant(0.0)))
+        self._helper_layer = helper  # keeps the params registered/trainable
+
+    def parameters(self):
+        return [p for p in (self.weight, self.bias) if p is not None]
+
+    def __call__(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, bias=self.bias,
+                             stride=self.stride, padding=self.padding,
+                             dilation=self.dilation, mask=mask,
+                             deformable_groups=self.deformable_groups,
+                             groups=self.groups)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False, rois_num=None,
+                             name=None):
+    """Assign ROIs to FPN levels by scale (ref vision/ops.py
+    distribute_fpn_proposals:60): level = floor(log2(sqrt(area)/refer_scale)
+    + refer_level), clamped.  Ragged per-level outputs -> eager host op."""
+    rois = np.asarray(jax.device_get(_unwrap(fpn_rois)))
+    off = 1.0 if pixel_offset else 0.0
+    w = rois[:, 2] - rois[:, 0] + off
+    h = rois[:, 3] - rois[:, 1] + off
+    scale = np.sqrt(np.maximum(w * h, 1e-12))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(np.int64)
+
+    multi_rois, restore_parts = [], []
+    for level in range(min_level, max_level + 1):
+        idx = np.nonzero(lvl == level)[0]
+        multi_rois.append(Tensor(jnp.asarray(rois[idx])))
+        restore_parts.append(idx)
+    order = np.concatenate(restore_parts) if restore_parts else np.empty(0, np.int64)
+    restore = np.empty_like(order)
+    restore[order] = np.arange(len(order))
+    rois_num_per_level = None
+    if rois_num is not None:
+        rois_num_per_level = [Tensor(jnp.asarray(np.asarray([len(p)], np.int64)))
+                              for p in restore_parts]
+    return multi_rois, Tensor(jnp.asarray(restore.astype(np.int32)[:, None])), rois_num_per_level
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    """YOLOv3 loss (ref vision/ops.py yolo_loss:392).
+
+    Target assignment (which anchor owns which gt box) is data-dependent
+    bookkeeping — built on host from the (stop-gradient) gt boxes, exactly
+    like the reference kernel's precompute; the differentiable loss over the
+    prediction tensor is traced jnp."""
+    xv = _unwrap(x)
+    B, _, H, W = x.shape
+    an_mask = list(anchor_mask)
+    n_anch = len(an_mask)
+    anchors_xy = [(anchors[2 * i], anchors[2 * i + 1]) for i in range(len(anchors) // 2)]
+    masked_anchors = [anchors_xy[i] for i in an_mask]
+    gt = np.asarray(jax.device_get(_unwrap(gt_box)))      # [B, M, 4] cx,cy,w,h (normalized)
+    gl = np.asarray(jax.device_get(_unwrap(gt_label)))    # [B, M]
+    gs = (np.asarray(jax.device_get(_unwrap(gt_score)))
+          if gt_score is not None else np.ones(gl.shape, np.float32))
+
+    in_w = W * downsample_ratio
+    in_h = H * downsample_ratio
+    tobj = np.zeros((B, n_anch, H, W), np.float32)
+    tscale = np.zeros((B, n_anch, H, W), np.float32)
+    txy = np.zeros((B, n_anch, H, W, 2), np.float32)
+    twh = np.zeros((B, n_anch, H, W, 2), np.float32)
+    tcls = np.zeros((B, n_anch, H, W, class_num), np.float32)
+    for b in range(B):
+        for m in range(gt.shape[1]):
+            gw, gh = gt[b, m, 2] * in_w, gt[b, m, 3] * in_h
+            if gw <= 0 or gh <= 0:
+                continue
+            # best anchor across ALL anchors by wh-IoU at the origin
+            best_iou, best_a = 0.0, -1
+            for ai, (aw, ah) in enumerate(anchors_xy):
+                inter = min(gw, aw) * min(gh, ah)
+                iou = inter / (gw * gh + aw * ah - inter)
+                if iou > best_iou:
+                    best_iou, best_a = iou, ai
+            if best_a not in an_mask:
+                continue
+            a = an_mask.index(best_a)
+            gi = min(int(gt[b, m, 0] * W), W - 1)
+            gj = min(int(gt[b, m, 1] * H), H - 1)
+            aw, ah = masked_anchors[a]
+            tobj[b, a, gj, gi] = gs[b, m]
+            tscale[b, a, gj, gi] = 2.0 - gt[b, m, 2] * gt[b, m, 3]
+            txy[b, a, gj, gi] = [gt[b, m, 0] * W - gi, gt[b, m, 1] * H - gj]
+            twh[b, a, gj, gi] = [np.log(max(gw / aw, 1e-9)), np.log(max(gh / ah, 1e-9))]
+            smooth = 1.0 / class_num if use_label_smooth else 0.0
+            tcls[b, a, gj, gi, :] = smooth
+            tcls[b, a, gj, gi, int(gl[b, m])] = 1.0 - smooth
+
+    def _f(v):
+        p = v.reshape(B, n_anch, 5 + class_num, H, W)
+        px = jax.nn.sigmoid(p[:, :, 0])
+        py = jax.nn.sigmoid(p[:, :, 1])
+        pw = p[:, :, 2]
+        ph = p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:].transpose(0, 1, 3, 4, 2)
+        obj = jnp.asarray(tobj)
+        sc = jnp.asarray(tscale)
+        loss_xy = (sc * obj * ((px - txy[..., 0]) ** 2 + (py - txy[..., 1]) ** 2)).sum((1, 2, 3))
+        loss_wh = (sc * obj * ((pw - twh[..., 0]) ** 2 + (ph - twh[..., 1]) ** 2)).sum((1, 2, 3))
+        bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))  # noqa: E731
+        loss_obj = (bce(pobj, obj) * jnp.where(obj > 0, 1.0, 1.0)).sum((1, 2, 3))
+        loss_cls = (obj[..., None] * bce(pcls, jnp.asarray(tcls))).sum((1, 2, 3, 4))
+        return loss_xy + loss_wh + loss_obj + loss_cls
+
+    return apply_op(_f, (x,), name="yolo_loss")
+
+
+def read_file(filename, name=None):
+    """Read raw bytes into a uint8 tensor (ref vision/ops.py read_file)."""
+    with open(filename, "rb") as f:
+        data = f.read()
+    return Tensor(jnp.asarray(np.frombuffer(data, np.uint8)))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode an encoded JPEG byte tensor to CHW uint8 (ref decode_jpeg;
+    host-side via PIL — image decode stays on CPU feeding the device)."""
+    import io
+
+    from PIL import Image
+
+    raw = np.asarray(jax.device_get(_unwrap(x))).astype(np.uint8).tobytes()
+    img = Image.open(io.BytesIO(raw))
+    if mode not in ("unchanged",):
+        img = img.convert(mode.upper() if mode != "gray" else "L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+__all__ += ["RoIAlign", "RoIPool", "psroi_pool", "PSRoIPool", "DeformConv2D",
+            "distribute_fpn_proposals", "yolo_loss", "read_file", "decode_jpeg"]

@@ -1,0 +1,143 @@
+"""Round-2 API surface batch 3: graph ops, segment reductions, softmax fuse,
+hermitian FFTs, distributed split/ParallelMode/gloo, vision.ops detection
+zoo completion, profiler/utils odds and ends.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.vision import ops as V
+
+I = paddle.incubate
+rng = np.random.RandomState(0)
+
+
+def test_graph_send_recv_all_pool_types():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    src = paddle.to_tensor(np.array([0, 1, 2, 3], np.int64))
+    dst = paddle.to_tensor(np.array([1, 1, 0, 0], np.int64))
+    np.testing.assert_allclose(
+        np.asarray(I.graph_send_recv(x, src, dst, "sum")._value)[0], [15., 17., 19.])
+    np.testing.assert_allclose(
+        np.asarray(I.graph_send_recv(x, src, dst, "mean")._value)[1], [1.5, 2.5, 3.5])
+    np.testing.assert_allclose(
+        np.asarray(I.graph_send_recv(x, src, dst, "max")._value)[0], [9., 10., 11.])
+    np.testing.assert_allclose(
+        np.asarray(I.graph_send_recv(x, src, dst, "min")._value)[1], [0., 1., 2.])
+    with pytest.raises(ValueError):
+        I.graph_send_recv(x, src, dst, "prod")
+
+
+def test_segment_reductions_and_softmax_fuse():
+    x = paddle.to_tensor(np.arange(12, dtype=np.float32).reshape(4, 3))
+    seg = paddle.to_tensor(np.array([0, 0, 1, 1], np.int64))
+    np.testing.assert_allclose(np.asarray(I.segment_mean(x, seg)._value)[0], [1.5, 2.5, 3.5])
+    np.testing.assert_allclose(np.asarray(I.segment_max(x, seg)._value)[1], [9., 10., 11.])
+    np.testing.assert_allclose(np.asarray(I.segment_min(x, seg)._value)[0], [0., 1., 2.])
+
+    s = paddle.to_tensor(rng.randn(2, 2, 4, 4).astype(np.float32))
+    tri = np.asarray(I.softmax_mask_fuse_upper_triangle(s)._value)
+    assert tri[0, 0, 0, 1] == 0 and abs(tri.sum(-1).mean() - 1.0) < 1e-5
+    m = paddle.to_tensor(np.zeros((2, 1, 4, 4), np.float32))
+    o = np.asarray(I.softmax_mask_fuse(s, m)._value)
+    assert abs(o.sum(-1).mean() - 1.0) < 1e-5
+    assert float(I.identity_loss(x, "mean").item()) == 5.5
+
+
+def test_graph_sampling_ops():
+    row = paddle.to_tensor(np.array([1, 2, 0], np.int64))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+    nodes = paddle.to_tensor(np.array([0], np.int64))
+    nb, cnt = I.graph_sample_neighbors(row, colptr, nodes)
+    assert sorted(np.asarray(nb._value)) == [1, 2]
+    assert list(np.asarray(cnt._value)) == [2]
+    e_src, e_dst, idx, n_edges = I.graph_khop_sampler(row, colptr, nodes, [2])
+    assert int(np.asarray(n_edges._value)[0]) == 2
+    rnb, rsrc, order = I.graph_reindex(nodes, nb, cnt)
+    assert list(np.asarray(order._value))[0] == 0
+
+
+def test_hermitian_fft_roundtrips():
+    y = rng.randn(4, 8).astype(np.float32)
+    t = paddle.to_tensor(y)
+    back = paddle.fft.hfft2(paddle.fft.ihfft2(t), s=(4, 8))
+    np.testing.assert_allclose(np.asarray(back._value), y, atol=1e-4)
+    back = paddle.fft.hfftn(paddle.fft.ihfftn(t, axes=(0, 1)), s=(4, 8), axes=(0, 1))
+    np.testing.assert_allclose(np.asarray(back._value), y, atol=1e-4)
+
+
+def test_distributed_split_and_parallel_mode():
+    assert dist.ParallelMode.DATA_PARALLEL == 0
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+        out1 = dist.split(x, (8, 4), "linear", name="t_fc")
+        out2 = dist.split(x, (8, 4), "linear", name="t_fc")  # cached weights
+    np.testing.assert_allclose(np.asarray(out1._value), np.asarray(out2._value))
+    with pytest.raises(ValueError):
+        dist.split(x, (8, 4), "conv")
+    with pytest.raises(NotImplementedError):
+        dist.QueueDataset()
+    with pytest.raises(NotImplementedError):
+        dist.InMemoryDataset()
+
+
+def test_vision_ops_layers_and_psroi():
+    x = paddle.to_tensor(rng.randn(1, 8, 16, 16).astype(np.float32))
+    boxes = paddle.to_tensor(np.array([[1., 1., 9., 9.], [2., 2., 12., 12.]], np.float32))
+    bn = paddle.to_tensor(np.array([2], np.int32))
+    assert V.RoIAlign(4)(x, boxes, bn).shape == [2, 8, 4, 4]
+    assert V.RoIPool(4)(x, boxes, bn).shape == [2, 8, 4, 4]
+    xps = paddle.to_tensor(rng.randn(1, 8, 16, 16).astype(np.float32))
+    assert V.psroi_pool(xps, boxes, bn, 2).shape == [2, 2, 2, 2]
+    with pytest.raises(ValueError):
+        V.psroi_pool(x, boxes, bn, 3)
+
+    dc = V.DeformConv2D(8, 6, 3, padding=1)
+    off = paddle.to_tensor(np.zeros((1, 18, 16, 16), np.float32))
+    out = dc(x, off)
+    assert out.shape == [1, 6, 16, 16]
+    out.sum().backward()
+    assert dc.weight._grad is not None
+
+
+def test_distribute_fpn_and_yolo_loss():
+    rois = paddle.to_tensor(np.array(
+        [[0., 0., 10., 10.], [0., 0., 100., 100.], [0., 0., 300., 300.]], np.float32))
+    multi, restore, _ = V.distribute_fpn_proposals(rois, 2, 5, 4, 224)
+    assert sum(m.shape[0] for m in multi) == 3
+    assert sorted(np.asarray(restore._value).reshape(-1)) == [0, 1, 2]
+
+    pred = paddle.to_tensor(rng.randn(1, 3 * 7, 4, 4).astype(np.float32) * 0.1)
+    pred.stop_gradient = False
+    gtb = paddle.to_tensor(np.array([[[0.5, 0.5, 0.3, 0.4]]], np.float32))
+    gtl = paddle.to_tensor(np.array([[1]], np.int64))
+    loss = V.yolo_loss(pred, gtb, gtl, anchors=[10, 13, 16, 30, 33, 23],
+                       anchor_mask=[0, 1, 2], class_num=2, ignore_thresh=0.7,
+                       downsample_ratio=8)
+    loss.sum().backward()
+    assert np.isfinite(float(loss.sum().item()))
+    assert np.isfinite(np.asarray(pred._grad)).all()
+
+
+def test_read_decode_jpeg(tmp_path):
+    from PIL import Image
+
+    p = str(tmp_path / "t.jpg")
+    Image.fromarray((rng.rand(8, 8, 3) * 255).astype(np.uint8)).save(p)
+    raw = V.read_file(p)
+    assert raw.shape[0] > 0
+    img = V.decode_jpeg(raw)
+    assert img.shape == [3, 8, 8]
+
+
+def test_profiler_and_utils_extras():
+    assert paddle.profiler.SortedKeys.GPUTotal == 4
+    with pytest.raises(NotImplementedError, match="chrome"):
+        paddle.profiler.export_protobuf("/tmp/x")
+    paddle.utils.require_version("1.0.0")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("99.0.0")
