@@ -500,3 +500,11 @@ def _static_amp_decorate(optimizer, amp_lists=None, init_loss_scaling=2.0 ** 15,
 
 
 amp.decorate = _static_amp_decorate
+
+
+# static.nn layer builders (name-keyed parameter cache; see nn_builders.py)
+from . import nn_builders as _nnb  # noqa: E402
+
+for _n in _nnb.__all__:
+    setattr(nn, _n, staticmethod(getattr(_nnb, _n)))
+del _n

@@ -1,0 +1,691 @@
+"""paddle.static.nn layer builders.
+
+Ref: python/paddle/static/nn/__init__.py (fc, conv2d, batch_norm, the
+sequence_* ops, StaticRNN...).  The reference appends ops + persistable
+parameters to a ProgramDesc; the legacy graph stack is a non-goal here
+(SURVEY §7.4), so these builders follow the TPU-native translation:
+
+- parameters are created once and cached by `name` (pass a unique name per
+  call site — an automatic shape key is used otherwise), so repeated calls
+  train one set of weights, whether eager or inside a @to_static trace;
+- the reference's LoD (ragged) sequence ops operate on the PADDED dense
+  layout [B, T, ...] with an optional `seq_len` — the standard TPU-ification
+  of variable-length sequences (static shapes for XLA, masks for semantics).
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "fc", "batch_norm", "embedding", "bilinear_tensor_product", "conv2d",
+    "conv2d_transpose", "conv3d", "conv3d_transpose", "crf_decoding",
+    "data_norm", "deform_conv2d", "group_norm", "instance_norm", "layer_norm",
+    "multi_box_head", "nce", "prelu", "row_conv", "spectral_norm",
+    "sparse_embedding", "case",
+    "sequence_conv", "sequence_softmax", "sequence_pool", "sequence_concat",
+    "sequence_first_step", "sequence_last_step", "sequence_slice",
+    "sequence_expand", "sequence_expand_as", "sequence_pad", "sequence_unpad",
+    "sequence_reshape", "sequence_scatter", "sequence_enumerate",
+    "sequence_reverse", "StaticRNN",
+]
+
+_layer_registry = {}
+
+
+def _cached(name, default_key, factory):
+    key = name
+    if key is None:
+        key = default_key
+        warnings.warn(
+            f"static.nn builder called without `name`: parameters cached by "
+            f"the automatic key {key!r}, which collides for two identical "
+            f"call signatures — pass a unique name per call site", stacklevel=3)
+    layer = _layer_registry.get(key)
+    if layer is None:
+        layer = factory()
+        _layer_registry[key] = layer
+    return layer
+
+
+# ------------------------------------------------------------------ builders
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    """Ref static/nn/common.py fc: flatten trailing dims, project, activate."""
+    from .. import nn
+    from ..nn import functional as F
+
+    in_dim = 1
+    for d in x.shape[num_flatten_dims:]:
+        in_dim *= int(d)
+    lin = _cached(name, f"fc:{in_dim}:{size}",
+                  lambda: nn.Linear(in_dim, size, weight_attr=weight_attr,
+                                    bias_attr=bias_attr))
+    lead = tuple(int(d) for d in x.shape[:num_flatten_dims])
+    out = lin(x.reshape(list(lead) + [in_dim]))
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32", name=None):
+    from .. import nn
+
+    emb = _cached(name, f"emb:{tuple(size)}",
+                  lambda: nn.Embedding(size[0], size[1], padding_idx=padding_idx,
+                                       weight_attr=param_attr))
+    return emb(input)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """Ref static/nn/common.py sparse_embedding — a parameter-server sparse
+    table.  On TPU embeddings are dense HBM arrays sharded over the mesh
+    (VocabParallelEmbedding for big vocabularies); this maps to the dense
+    embedding so scripts run, which is the whole difference."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype,
+                     name=getattr(param_attr, "name", None))
+
+
+def _conv_nd(x, num_filters, filter_size, stride, padding, dilation, groups,
+             param_attr, bias_attr, name, nd, transpose=False, output_size=None):
+    from .. import nn
+
+    cls = {(2, False): nn.Conv2D, (2, True): nn.Conv2DTranspose,
+           (3, False): nn.Conv3D, (3, True): nn.Conv3DTranspose}[(nd, transpose)]
+    in_ch = int(x.shape[1])
+    conv = _cached(name,
+                   f"conv{nd}{'t' if transpose else ''}:{in_ch}:{num_filters}:"
+                   f"{filter_size}:{stride}:{padding}",
+                   lambda: cls(in_ch, num_filters, filter_size, stride=stride,
+                               padding=padding, dilation=dilation,
+                               groups=groups or 1, weight_attr=param_attr,
+                               bias_attr=bias_attr))
+    return conv(x)
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCHW"):
+    from ..nn import functional as F
+
+    out = _conv_nd(input, num_filters, filter_size, stride, padding, dilation,
+                   groups, param_attr, bias_attr, name, 2)
+    return getattr(F, act)(out) if act else out
+
+
+def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                     name=None, data_format="NCHW"):
+    from ..nn import functional as F
+
+    out = _conv_nd(input, num_filters, filter_size or 3, stride, padding,
+                   dilation, groups, param_attr, bias_attr, name, 2,
+                   transpose=True, output_size=output_size)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
+           groups=None, param_attr=None, bias_attr=None, use_cudnn=True,
+           act=None, name=None, data_format="NCDHW"):
+    from ..nn import functional as F
+
+    out = _conv_nd(input, num_filters, filter_size, stride, padding, dilation,
+                   groups, param_attr, bias_attr, name, 3)
+    return getattr(F, act)(out) if act else out
+
+
+def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
+                     stride=1, padding=0, dilation=1, groups=None,
+                     param_attr=None, bias_attr=None, use_cudnn=True, act=None,
+                     name=None, data_format="NCDHW"):
+    from ..nn import functional as F
+
+    out = _conv_nd(input, num_filters, filter_size or 3, stride, padding,
+                   dilation, groups, param_attr, bias_attr, name, 3,
+                   transpose=True, output_size=output_size)
+    return getattr(F, act)(out) if act else out
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               in_place=False, name=None, moving_mean_name=None,
+               moving_variance_name=None, do_model_average_for_mean_and_var=True,
+               use_global_stats=False):
+    from .. import nn
+    from ..nn import functional as F
+
+    ch = int(input.shape[1 if data_layout.startswith("NC") else -1])
+    bn = _cached(name or moving_mean_name, f"bn:{ch}",
+                 lambda: nn.BatchNorm(ch, momentum=momentum, epsilon=epsilon,
+                                      param_attr=param_attr, bias_attr=bias_attr,
+                                      data_layout=data_layout,
+                                      use_global_stats=use_global_stats))
+    bn.training = not is_test
+    out = bn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1, epsilon=1e-5,
+               param_attr=None, bias_attr=None, act=None, name=None):
+    from .. import nn
+    from ..nn import functional as F
+
+    shape = [int(d) for d in input.shape[begin_norm_axis:]]
+    ln = _cached(name, f"ln:{shape}",
+                 lambda: nn.LayerNorm(shape, epsilon=epsilon,
+                                      weight_attr=param_attr if scale else False,
+                                      bias_attr=bias_attr if shift else False))
+    out = ln(input)
+    return getattr(F, act)(out) if act else out
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    from .. import nn
+    from ..nn import functional as F
+
+    ch = int(input.shape[1])
+    gn = _cached(name, f"gn:{groups}:{ch}",
+                 lambda: nn.GroupNorm(groups, ch, epsilon=epsilon,
+                                      weight_attr=param_attr, bias_attr=bias_attr))
+    out = gn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    from .. import nn
+
+    ch = int(input.shape[1])
+    inorm = _cached(name, f"in:{ch}",
+                    lambda: nn.InstanceNorm2D(ch, epsilon=epsilon,
+                                              weight_attr=param_attr,
+                                              bias_attr=bias_attr))
+    return inorm(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None, enable_scale_and_shift=False,
+              name=None, moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999, in_place=False):
+    """Ref static/nn/common.py data_norm — normalization by accumulated
+    batch statistics (no learned gamma/beta unless enabled); implemented as
+    BatchNorm in global-stats mode over the feature axis."""
+    from .. import nn
+    from ..nn import functional as F
+
+    ch = int(input.shape[-1])
+    bn = _cached(name, f"dn:{ch}",
+                 lambda: nn.BatchNorm1D(ch, momentum=summary_decay_rate,
+                                        epsilon=epsilon,
+                                        weight_attr=(param_attr if enable_scale_and_shift else False),
+                                        bias_attr=(None if enable_scale_and_shift else False)))
+    out = bn(input)
+    return getattr(F, act)(out) if act else out
+
+
+def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
+    from .. import nn
+
+    n = {"all": 1, "channel": int(x.shape[1]), "element": None}[mode]
+    if n is None:
+        n = 1
+        for d in x.shape[1:]:
+            n *= int(d)
+    pr = _cached(name, f"prelu:{mode}:{n}",
+                 lambda: nn.PReLU(num_parameters=n, weight_attr=param_attr,
+                                  data_format=data_format))
+    return pr(x)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None, param_attr=None,
+                            bias_attr=None):
+    from .. import nn
+    from ..nn import functional as F
+
+    bl = _cached(name, f"btp:{int(x.shape[-1])}:{int(y.shape[-1])}:{size}",
+                 lambda: nn.Bilinear(int(x.shape[-1]), int(y.shape[-1]), size,
+                                     weight_attr=param_attr, bias_attr=bias_attr))
+    out = bl(x, y)
+    return getattr(F, act)(out) if act else out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Functional spectral normalization of a weight tensor (ref
+    static/nn/common.py spectral_norm — power iteration, fresh u/v)."""
+    def _f(w):
+        mat = jnp.moveaxis(w.astype(jnp.float32), dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((mat.shape[0],), jnp.float32) / jnp.sqrt(mat.shape[0] * 1.0)
+        for _ in range(max(1, power_iters)):
+            v = mat.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = mat @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ (mat @ v)
+        return (w.astype(jnp.float32) / sigma).astype(w.dtype)
+
+    return apply_op(_f, (weight,), name="spectral_norm")
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=None, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  modulated=True, name=None):
+    from ..vision.ops import DeformConv2D
+
+    in_ch = int(input.shape[1])
+    dc = _cached(name, f"dconv:{in_ch}:{num_filters}:{filter_size}",
+                 lambda: DeformConv2D(in_ch, num_filters, filter_size,
+                                      stride=stride, padding=padding,
+                                      dilation=dilation,
+                                      deformable_groups=deformable_groups,
+                                      groups=groups or 1,
+                                      weight_attr=param_attr,
+                                      bias_attr=bias_attr))
+    return dc(input, offset, mask if modulated else None)
+
+
+def crf_decoding(input, param_attr, length=None, label=None, name=None):
+    """Viterbi decode with a learned transition matrix (ref crf_decoding op):
+    the transitions are a cached parameter addressed by param_attr/name."""
+    from ..text import viterbi_decode
+    from ..nn.layer.layers import Layer
+    from ..nn.initializer import Normal
+
+    T = int(input.shape[-1])
+    key = getattr(param_attr, "name", None) or name
+
+    def make():
+        holder = Layer()
+        return holder.create_parameter([T + 2, T + 2], attr=param_attr,
+                                       default_initializer=Normal(0.0, 0.1))
+
+    trans = _cached(key, f"crfw:{T}", make)
+    if length is None:
+        B, L = int(input.shape[0]), int(input.shape[1])
+        length = Tensor(jnp.full((B,), L, jnp.int64))
+    # pad emissions to T+2 tags (bos/eos rows of the transition matrix)
+    pot = apply_op(lambda v: jnp.pad(v, [(0, 0), (0, 0), (0, 2)],
+                                     constant_values=-1e4), (input,),
+                   name="crf_pad")
+    scores, path = viterbi_decode(pot, trans, length, include_bos_eos_tag=True)
+    return path
+
+
+def nce(input, label, num_total_classes, sample_weight=None, param_attr=None,
+        bias_attr=None, num_neg_samples=5, name=None, sampler="uniform",
+        custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (ref static/nn/loss.py nce):
+    logistic loss on the true class + `num_neg_samples` sampled noise
+    classes.  Negatives are drawn with jax PRNG inside the op."""
+    from ..nn.layer.layers import Layer
+    from ..nn.initializer import Normal, Constant
+
+    D = int(input.shape[-1])
+
+    def make():
+        holder = Layer()
+        w = holder.create_parameter([num_total_classes, D], attr=param_attr,
+                                    default_initializer=Normal(0.0, 0.05))
+        b = holder.create_parameter([num_total_classes], attr=bias_attr,
+                                    is_bias=True,
+                                    default_initializer=Constant(0.0))
+        return (w, b)
+
+    w, b = _cached(name, f"nce:{num_total_classes}:{D}", make)
+
+    from ..framework import random as _random
+
+    key = _random.get_rng_key()
+
+    def _f(x, lbl, wv, bv):
+        B = x.shape[0]
+        lbl = lbl.reshape(-1).astype(jnp.int32)
+        neg = jax.random.randint(key, (B, num_neg_samples), 0, num_total_classes)
+        pos_logit = jnp.sum(x * wv[lbl], -1) + bv[lbl]
+        neg_logit = jnp.einsum("bd,bkd->bk", x, wv[neg]) + bv[neg]
+        softplus = lambda z: jnp.maximum(z, 0) + jnp.log1p(jnp.exp(-jnp.abs(z)))  # noqa: E731
+        loss = softplus(-pos_logit) + softplus(neg_logit).sum(-1)
+        return loss[:, None]
+
+    return apply_op(_f, (input, label, w, b), name="nce")
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None, name=None):
+    """Lookahead row convolution (ref static/nn/common.py row_conv):
+    out[t] = sum_{k=0..K} w[k] * in[t+k], per feature channel."""
+    from ..nn.layer.layers import Layer
+    from ..nn.initializer import Normal
+    from ..nn import functional as F
+
+    D = int(input.shape[-1])
+    K = future_context_size + 1
+
+    def make():
+        holder = Layer()
+        return holder.create_parameter([K, D], attr=param_attr,
+                                       default_initializer=Normal(0.0, 0.1))
+
+    w = _cached(name, f"rowconv:{K}:{D}", make)
+
+    def _f(v, wv):
+        pad = jnp.pad(v, [(0, 0), (0, K - 1), (0, 0)])
+        out = jnp.zeros_like(v)
+        for k in range(K):  # K is small and static: unrolled adds fuse
+            out = out + pad[:, k:k + v.shape[1]] * wv[k][None, None, :]
+        return out
+
+    out = apply_op(_f, (input, w), name="row_conv")
+    return getattr(F, act)(out) if act else out
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2), flip=True,
+                   clip=False, kernel_size=1, pad=0, stride=1, name=None,
+                   min_max_aspect_ratios_order=False):
+    """SSD detection head (ref static/nn/common.py multi_box_head): per
+    feature map, conv predictors for box offsets + class scores and the
+    matching prior boxes."""
+    import numpy as np
+
+    from ..nn import functional as F
+
+    n_in = len(inputs)
+    if min_sizes is None:
+        # the reference's ratio schedule
+        min_ratio, max_ratio = min_ratio or 20, max_ratio or 90
+        step = int((max_ratio - min_ratio) / max(n_in - 2, 1))
+        min_sizes, max_sizes = [base_size * 0.1], [base_size * 0.2]
+        for r in range(min_ratio, max_ratio + 1, step):
+            min_sizes.append(base_size * r / 100.0)
+            max_sizes.append(base_size * (r + step) / 100.0)
+        min_sizes = min_sizes[:n_in]
+        max_sizes = max_sizes[:n_in]
+
+    img_h, img_w = int(image.shape[2]), int(image.shape[3])
+    locs, confs, priors, pvars = [], [], [], []
+    for i, feat in enumerate(inputs):
+        ars = aspect_ratios[i] if isinstance(aspect_ratios[i], (list, tuple)) \
+            else [aspect_ratios[i]]
+        n_prior = 1 + len(ars) * (2 if flip else 1) + (1 if max_sizes else 0)
+        loc = conv2d(feat, n_prior * 4, kernel_size, stride=stride, padding=pad,
+                     name=f"{name or 'mbh'}_loc{i}")
+        conf = conv2d(feat, n_prior * num_classes, kernel_size, stride=stride,
+                      padding=pad, name=f"{name or 'mbh'}_conf{i}")
+        H, W = int(feat.shape[2]), int(feat.shape[3])
+        locs.append(loc.transpose([0, 2, 3, 1]).reshape([int(feat.shape[0]), -1, 4]))
+        confs.append(conf.transpose([0, 2, 3, 1]).reshape(
+            [int(feat.shape[0]), -1, num_classes]))
+        # prior boxes (host precompute — static per shape, like the reference op)
+        sw = (steps[i] if steps else (step_w[i] if step_w else img_w / W))
+        sh = (steps[i] if steps else (step_h[i] if step_h else img_h / H))
+        sizes = [float(min_sizes[i])]
+        if max_sizes:
+            sizes.append(float(np.sqrt(min_sizes[i] * max_sizes[i])))
+        boxes = []
+        for y in range(H):
+            for x_ in range(W):
+                cx, cy = (x_ + offset) * sw, (y + offset) * sh
+                for s in sizes:
+                    boxes.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
+                for ar in ars:
+                    for a in ([ar, 1.0 / ar] if flip else [ar]):
+                        w_ = min_sizes[i] * np.sqrt(a)
+                        h_ = min_sizes[i] / np.sqrt(a)
+                        boxes.append([cx - w_ / 2, cy - h_ / 2,
+                                      cx + w_ / 2, cy + h_ / 2])
+        pb = np.asarray(boxes, np.float32) / [img_w, img_h, img_w, img_h]
+        if clip:
+            pb = np.clip(pb, 0.0, 1.0)
+        priors.append(Tensor(jnp.asarray(pb)))
+        pvars.append(Tensor(jnp.broadcast_to(
+            jnp.asarray(np.asarray(variance, np.float32)), pb.shape)))
+
+    from ..tensor import manipulation as M
+
+    return (M.concat(locs, 1), M.concat(confs, 1),
+            M.concat(priors, 0), M.concat(pvars, 0))
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """Ref static/nn/control_flow.py case: first true predicate wins."""
+    for pred, fn in pred_fn_pairs:
+        v = _unwrap(pred)
+        if isinstance(v, jax.core.Tracer):
+            raise NotImplementedError(
+                "static.nn.case with traced predicates: nest static.nn.cond "
+                "instead (case is sugar over sequential conds)")
+        if bool(v):
+            return fn()
+    return default() if default is not None else None
+
+
+# -------------------------------------------------------- sequence ops (LoD
+# -> padded-dense translation: [B, T, ...] plus seq_len, SURVEY §7.3.4)
+
+def _mask(x, seq_len):
+    if seq_len is None:
+        return None
+    lens = _unwrap(seq_len)
+    T = x.shape[1]
+    return (jnp.arange(T)[None, :] < lens.reshape(-1, 1)).astype(jnp.float32)
+
+
+def sequence_softmax(input, seq_len=None, use_cudnn=False, name=None):
+    def _f(v, *rest):
+        m = _mask(v, seq_len)
+        logits = v if m is None else jnp.where(m[..., None] > 0 if v.ndim == 3
+                                               else m > 0, v, -1e9)
+        return jax.nn.softmax(logits, axis=1)
+
+    return apply_op(_f, (input,), name="sequence_softmax")
+
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0, seq_len=None):
+    def _f(v):
+        m = _mask(v, seq_len)
+        if m is None:
+            m = jnp.ones(v.shape[:2], jnp.float32)
+        me = m[..., None] if v.ndim == 3 else m
+        pt = pool_type.lower()
+        if pt == "sum":
+            return (v * me).sum(1)
+        if pt in ("average", "mean"):
+            return (v * me).sum(1) / jnp.maximum(me.sum(1), 1.0)
+        if pt == "sqrt":
+            return (v * me).sum(1) / jnp.sqrt(jnp.maximum(me.sum(1), 1.0))
+        if pt == "max":
+            return jnp.where(me > 0, v, -jnp.inf).max(1)
+        if pt == "first":
+            return v[:, 0]
+        if pt == "last":
+            idx = jnp.maximum(me.sum(1)[..., 0] if me.ndim == 3 else me.sum(1), 1
+                              ).astype(jnp.int32) - 1
+            return jnp.take_along_axis(v, idx[:, None, None].astype(jnp.int32),
+                                       1)[:, 0]
+        raise ValueError(f"unknown pool_type {pool_type}")
+
+    return apply_op(_f, (input,), name="sequence_pool")
+
+
+def sequence_first_step(input, seq_len=None):
+    return sequence_pool(input, "first", seq_len=seq_len)
+
+
+def sequence_last_step(input, seq_len=None):
+    return sequence_pool(input, "last", seq_len=seq_len)
+
+
+def sequence_concat(input, name=None):
+    from ..tensor import manipulation as M
+
+    return M.concat(input, axis=1)
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None, name=None):
+    """Context-window projection over time (ref sequence_conv): implemented
+    as a same-padded 1-D convolution over the padded layout."""
+    from .. import nn
+    from ..nn import functional as F
+
+    D = int(input.shape[-1])
+    conv = _cached(name, f"seqconv:{D}:{num_filters}:{filter_size}",
+                   lambda: nn.Conv1D(D, num_filters, filter_size,
+                                     padding=(filter_size - 1) // 2,
+                                     weight_attr=param_attr, bias_attr=bias_attr))
+    out = conv(input.transpose([0, 2, 1])).transpose([0, 2, 1])
+    return getattr(F, act)(out) if act else out
+
+
+def sequence_slice(input, offset, length, name=None):
+    def _f(v, off, ln):
+        T = v.shape[1]
+        idx = jnp.arange(T)
+        keep = (idx[None, :] >= off.reshape(-1, 1)) & \
+               (idx[None, :] < (off + ln).reshape(-1, 1))
+        # static output length = max length (padded-dense contract)
+        gath = jnp.where(keep[..., None] if v.ndim == 3 else keep, v, 0)
+        # roll each row so the slice starts at 0
+        return jax.vmap(lambda row, o: jnp.roll(row, -o, axis=0))(
+            gath, off.reshape(-1).astype(jnp.int32))
+
+    return apply_op(_f, (input, offset, length), name="sequence_slice")
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat each row of x to y's time dimension (padded-dense analog)."""
+    def _f(xv, yv):
+        return jnp.broadcast_to(xv[:, None], (xv.shape[0], yv.shape[1]) + xv.shape[1:])
+
+    return apply_op(_f, (x, y), name="sequence_expand")
+
+
+def sequence_expand_as(x, y, name=None):
+    return sequence_expand(x, y)
+
+
+def sequence_pad(x, pad_value, maxlen=None, name=None):
+    """Already-padded layout: optionally extend to maxlen; returns (data, len)."""
+    def _f(v, pv):
+        if maxlen is None or maxlen <= v.shape[1]:
+            return v
+        extra = maxlen - v.shape[1]
+        pads = [(0, 0), (0, extra)] + [(0, 0)] * (v.ndim - 2)
+        return jnp.pad(v, pads, constant_values=0) + 0 * pv.astype(v.dtype)
+
+    out = apply_op(_f, (x, pad_value), name="sequence_pad")
+    B, T = int(x.shape[0]), int(out.shape[1])
+    return out, Tensor(jnp.full((B,), int(x.shape[1]), jnp.int32))
+
+
+def sequence_unpad(x, length, name=None):
+    """Mask out positions past each row's length (shape stays static)."""
+    def _f(v, ln):
+        m = (jnp.arange(v.shape[1])[None, :] < ln.reshape(-1, 1))
+        return jnp.where(m[..., None] if v.ndim == 3 else m, v, 0)
+
+    return apply_op(_f, (x, length), name="sequence_unpad")
+
+
+def sequence_reshape(input, new_dim):
+    def _f(v):
+        B = v.shape[0]
+        return v.reshape(B, -1, new_dim)
+
+    return apply_op(_f, (input,), name="sequence_reshape")
+
+
+def sequence_scatter(input, index, updates, name=None):
+    def _f(v, idx, upd):
+        B = v.shape[0]
+        b = jnp.arange(B)[:, None].repeat(idx.shape[1], 1).reshape(-1)
+        return v.at[b, idx.reshape(-1).astype(jnp.int32)].add(upd.reshape(b.shape[0], *v.shape[2:]))
+
+    return apply_op(_f, (input, index, updates), name="sequence_scatter")
+
+
+def sequence_enumerate(input, win_size, pad_value=0, name=None):
+    def _f(v):
+        T = v.shape[-1] if v.ndim == 2 else v.shape[1]
+        v2 = v.reshape(v.shape[0], T)
+        cols = []
+        for k in range(win_size):
+            shifted = jnp.concatenate(
+                [v2[:, k:], jnp.full((v2.shape[0], k), pad_value, v2.dtype)], 1)
+            cols.append(shifted)
+        return jnp.stack(cols, -1)
+
+    return apply_op(_f, (input,), name="sequence_enumerate")
+
+
+def sequence_reverse(x, seq_len=None, name=None):
+    def _f(v, *rest):
+        if seq_len is None:
+            return v[:, ::-1]
+        lens = _unwrap(seq_len).reshape(-1).astype(jnp.int32)
+
+        def rev_row(row, n):
+            idx = jnp.where(jnp.arange(row.shape[0]) < n,
+                            n - 1 - jnp.arange(row.shape[0]),
+                            jnp.arange(row.shape[0]))
+            return row[idx]
+
+        return jax.vmap(rev_row)(v, lens)
+
+    return apply_op(_f, (x,), name="sequence_reverse")
+
+
+class StaticRNN:
+    """Ref static/nn/control_flow.py StaticRNN — a recurrent step builder.
+
+    The reference RECORDS ops appended inside `with rnn.step():` into a
+    ProgramDesc block and replays them per timestep — exactly the legacy
+    graph mechanism this build does not rebuild (SURVEY §7.4).  The
+    TPU-native form is functional: pass the step as a function and it runs
+    under ONE lax.scan:
+
+        out = StaticRNN.run(step_fn, x, h0)
+        # step_fn(x_t, h) -> (out_t, new_h);  x: [B, T, D] -> out: [B, T, H]
+    """
+
+    def step(self):
+        raise NotImplementedError(
+            "StaticRNN op-recording replays a ProgramDesc block — the legacy "
+            "graph path (SURVEY §7.4). Use the functional form: "
+            "StaticRNN.run(step_fn, x, init_states), which compiles the "
+            "recurrence as one lax.scan.")
+
+    step_input = memory = update_memory = step_output = output = step
+    __call__ = step
+
+    @staticmethod
+    def run(step_fn, x, init_states):
+        """Scan `step_fn(x_t, *states) -> (out_t, *new_states)` over the
+        time axis of x [B, T, D]; returns outputs stacked [B, T, ...]."""
+        inits = init_states if isinstance(init_states, (list, tuple)) else [init_states]
+
+        def _f(v, *st):
+            def body(carry, xt):
+                out = step_fn(Tensor(xt), *[Tensor(c) for c in carry])
+                out = out if isinstance(out, (list, tuple)) else (out, out)
+                o, *new = out
+                return tuple(_unwrap(n) for n in new), _unwrap(o)
+
+            _, ys = jax.lax.scan(body, st, jnp.moveaxis(v, 0, 1))
+            return jnp.moveaxis(ys, 0, 1)
+
+        return apply_op(_f, (x, *inits), name="static_rnn")

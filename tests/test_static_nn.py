@@ -1,0 +1,141 @@
+"""static.nn layer builders (ref python/paddle/static/nn/__init__.py).
+Name-keyed parameter cache + padded-dense sequence-op translation."""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+S = paddle.static.nn
+rng = np.random.RandomState(0)
+
+
+def test_fc_param_cache_and_training():
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    o1 = S.fc(x, 4, name="tfc")
+    o2 = S.fc(x, 4, name="tfc")
+    np.testing.assert_allclose(np.asarray(o1._value), np.asarray(o2._value))
+    # weights are trainable through the builder
+    from paddle_tpu.static.nn_builders import _layer_registry
+
+    lin = _layer_registry["tfc"]
+    (S.fc(x, 4, name="tfc") ** 2).mean().backward()
+    assert lin.weight._grad is not None
+
+
+def test_builders_shapes():
+    img = paddle.to_tensor(rng.randn(2, 3, 8, 8).astype(np.float32))
+    c = S.conv2d(img, 6, 3, padding=1, act="relu", name="tc1")
+    assert c.shape == [2, 6, 8, 8]
+    assert S.conv2d_transpose(img, 6, filter_size=3, name="tct").shape[1] == 6
+    vol = paddle.to_tensor(rng.randn(1, 2, 4, 4, 4).astype(np.float32))
+    assert S.conv3d(vol, 3, 3, padding=1, name="tc3").shape == [1, 3, 4, 4, 4]
+    assert S.batch_norm(c, name="tbn").shape == c.shape
+    assert S.group_norm(c, 2, name="tgn").shape == c.shape
+    assert S.instance_norm(c, name="tin").shape == c.shape
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    assert S.layer_norm(x, name="tln").shape == [2, 8]
+    assert S.data_norm(x, name="tdn").shape == [2, 8]
+    ids = paddle.to_tensor(rng.randint(0, 10, (2, 5)).astype(np.int64))
+    assert S.embedding(ids, (10, 4), name="temb").shape == [2, 5, 4]
+    assert S.prelu(c, "channel", name="tpr").shape == c.shape
+    a = paddle.to_tensor(rng.randn(2, 3).astype(np.float32))
+    b = paddle.to_tensor(rng.randn(2, 5).astype(np.float32))
+    assert S.bilinear_tensor_product(a, b, 4, name="tbtp").shape == [2, 4]
+
+
+def test_spectral_norm_functional():
+    w = paddle.to_tensor(rng.randn(6, 10).astype(np.float32))
+    sn = S.spectral_norm(w, power_iters=8)
+    sigma = np.linalg.svd(np.asarray(sn._value), compute_uv=False)[0]
+    assert abs(sigma - 1.0) < 0.05
+
+
+def test_crf_decoding_uses_learned_transitions():
+    pot = paddle.to_tensor(rng.randn(2, 5, 4).astype(np.float32))
+    path = S.crf_decoding(pot, paddle.ParamAttr(name="tcrf"))
+    assert path.shape == [2, 5]
+    assert int(np.asarray(path._value).max()) < 4 + 2
+
+
+def test_nce_and_row_conv():
+    x = paddle.to_tensor(rng.randn(4, 8).astype(np.float32))
+    lbl = paddle.to_tensor(rng.randint(0, 20, (4, 1)).astype(np.int64))
+    loss = S.nce(x, lbl, 20, num_neg_samples=3, name="tnce")
+    assert loss.shape == [4, 1]
+    loss.sum().backward()
+
+    seq = paddle.to_tensor(rng.randn(2, 6, 4).astype(np.float32))
+    out = S.row_conv(seq, 2, name="trc")
+    assert out.shape == [2, 6, 4]
+
+
+def test_sequence_ops_padded_dense():
+    seq = paddle.to_tensor(rng.randn(2, 5, 4).astype(np.float32))
+    lens = paddle.to_tensor(np.array([3, 5], np.int64))
+    sm = np.asarray(S.sequence_softmax(seq, seq_len=lens)._value)
+    assert sm.shape == (2, 5, 4)
+    # masked mean only counts the first 3 steps of row 0
+    mean = np.asarray(S.sequence_pool(seq, "average", seq_len=lens)._value)
+    np.testing.assert_allclose(mean[0], np.asarray(seq._value)[0, :3].mean(0),
+                               atol=1e-5)
+    last = np.asarray(S.sequence_last_step(seq, seq_len=lens)._value)
+    np.testing.assert_allclose(last[0], np.asarray(seq._value)[0, 2], atol=1e-6)
+    rv = np.asarray(S.sequence_reverse(seq, seq_len=lens)._value)
+    np.testing.assert_allclose(rv[0, :3], np.asarray(seq._value)[0, :3][::-1],
+                               atol=1e-6)
+    assert S.sequence_conv(seq, 6, 3, name="tsc").shape == [2, 5, 6]
+    assert S.sequence_concat([seq, seq]).shape == [2, 10, 4]
+    padded, plens = S.sequence_pad(seq, paddle.zeros([]), maxlen=8)
+    assert padded.shape == [2, 8, 4]
+    unp = S.sequence_unpad(padded, lens)
+    assert np.asarray(unp._value)[0, 3:].sum() == 0
+    assert S.sequence_reshape(seq, 2).shape == [2, 10, 2]
+    ids = paddle.to_tensor(rng.randint(0, 9, (2, 5)).astype(np.int64))
+    en = S.sequence_enumerate(ids, 3)
+    assert en.shape == [2, 5, 3]
+    ex = S.sequence_expand(paddle.to_tensor(rng.randn(2, 4).astype(np.float32)), seq)
+    assert ex.shape == [2, 5, 4]
+
+
+def test_static_rnn_functional_scan():
+    x = paddle.to_tensor(rng.randn(2, 6, 4).astype(np.float32))
+    h0 = paddle.to_tensor(np.zeros((2, 4), np.float32))
+
+    def step(xt, h):
+        nh = paddle.tanh(xt + h)
+        return nh, nh
+
+    out = S.StaticRNN.run(step, x, h0)
+    assert out.shape == [2, 6, 4]
+    # oracle: python loop
+    ref_h = np.zeros((2, 4), np.float32)
+    refs = []
+    for t in range(6):
+        ref_h = np.tanh(np.asarray(x._value)[:, t] + ref_h)
+        refs.append(ref_h)
+    np.testing.assert_allclose(np.asarray(out._value),
+                               np.stack(refs, 1), atol=1e-5)
+    rnn = S.StaticRNN()
+    with pytest.raises(NotImplementedError):
+        rnn.step()
+
+
+def test_multi_box_head():
+    feats = [paddle.to_tensor(rng.randn(1, 8, 4, 4).astype(np.float32)),
+             paddle.to_tensor(rng.randn(1, 8, 2, 2).astype(np.float32))]
+    img = paddle.to_tensor(rng.randn(1, 3, 64, 64).astype(np.float32))
+    locs, confs, priors, pvars = S.multi_box_head(
+        feats, img, base_size=64, num_classes=3,
+        aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90,
+        name="tmbh")
+    n = locs.shape[1]
+    assert confs.shape == [1, n, 3]
+    assert priors.shape[0] == n and pvars.shape[0] == n
+
+
+def test_auto_key_warns():
+    x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
+    with pytest.warns(UserWarning, match="automatic key"):
+        S.fc(x, 3)
