@@ -26,7 +26,7 @@ __all__ = [
     "conv2d_transpose", "conv3d", "conv3d_transpose", "crf_decoding",
     "data_norm", "deform_conv2d", "group_norm", "instance_norm", "layer_norm",
     "multi_box_head", "nce", "prelu", "row_conv", "spectral_norm",
-    "sparse_embedding", "case",
+    "sparse_embedding",
     "sequence_conv", "sequence_softmax", "sequence_pool", "sequence_concat",
     "sequence_first_step", "sequence_last_step", "sequence_slice",
     "sequence_expand", "sequence_expand_as", "sequence_pad", "sequence_unpad",
@@ -96,19 +96,36 @@ def sparse_embedding(input, size, padding_idx=None, is_test=False,
 
 
 def _conv_nd(x, num_filters, filter_size, stride, padding, dilation, groups,
-             param_attr, bias_attr, name, nd, transpose=False, output_size=None):
+             param_attr, bias_attr, name, nd, transpose=False, output_size=None,
+             data_format="NCHW"):
     from .. import nn
 
     cls = {(2, False): nn.Conv2D, (2, True): nn.Conv2DTranspose,
            (3, False): nn.Conv3D, (3, True): nn.Conv3DTranspose}[(nd, transpose)]
-    in_ch = int(x.shape[1])
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    in_ch = int(x.shape[ch_axis])
+    if transpose and filter_size is None:
+        if output_size is None:
+            raise ValueError("conv transpose needs filter_size or output_size")
+        # k = out - (in-1)*stride + 2*pad (ref conv2d_transpose filter-size
+        # derivation; symmetric padding, dilation 1)
+        sp_axis = 2 if ch_axis == 1 else 1
+        out0 = output_size[0] if isinstance(output_size, (list, tuple)) else output_size
+        st0 = stride[0] if isinstance(stride, (list, tuple)) else stride
+        pd0 = padding[0] if isinstance(padding, (list, tuple)) else padding
+        filter_size = int(out0) - (int(x.shape[sp_axis]) - 1) * st0 + 2 * pd0
+        if filter_size < 1:
+            raise ValueError(
+                f"derived filter_size {filter_size} < 1 from output_size "
+                f"{output_size}; check stride/padding")
     conv = _cached(name,
                    f"conv{nd}{'t' if transpose else ''}:{in_ch}:{num_filters}:"
-                   f"{filter_size}:{stride}:{padding}",
+                   f"{filter_size}:{stride}:{padding}:{dilation}:{groups}:"
+                   f"{data_format}",
                    lambda: cls(in_ch, num_filters, filter_size, stride=stride,
                                padding=padding, dilation=dilation,
                                groups=groups or 1, weight_attr=param_attr,
-                               bias_attr=bias_attr))
+                               bias_attr=bias_attr, data_format=data_format))
     return conv(x)
 
 
@@ -118,7 +135,8 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     from ..nn import functional as F
 
     out = _conv_nd(input, num_filters, filter_size, stride, padding, dilation,
-                   groups, param_attr, bias_attr, name, 2)
+                   groups, param_attr, bias_attr, name, 2,
+                   data_format=data_format)
     return getattr(F, act)(out) if act else out
 
 
@@ -128,9 +146,10 @@ def conv2d_transpose(input, num_filters, output_size=None, filter_size=None,
                      name=None, data_format="NCHW"):
     from ..nn import functional as F
 
-    out = _conv_nd(input, num_filters, filter_size or 3, stride, padding,
+    out = _conv_nd(input, num_filters, filter_size, stride, padding,
                    dilation, groups, param_attr, bias_attr, name, 2,
-                   transpose=True, output_size=output_size)
+                   transpose=True, output_size=output_size,
+                   data_format=data_format)
     return getattr(F, act)(out) if act else out
 
 
@@ -140,7 +159,8 @@ def conv3d(input, num_filters, filter_size, stride=1, padding=0, dilation=1,
     from ..nn import functional as F
 
     out = _conv_nd(input, num_filters, filter_size, stride, padding, dilation,
-                   groups, param_attr, bias_attr, name, 3)
+                   groups, param_attr, bias_attr, name, 3,
+                   data_format="NCHW" if data_format == "NCDHW" else data_format)
     return getattr(F, act)(out) if act else out
 
 
@@ -150,9 +170,10 @@ def conv3d_transpose(input, num_filters, output_size=None, filter_size=None,
                      name=None, data_format="NCDHW"):
     from ..nn import functional as F
 
-    out = _conv_nd(input, num_filters, filter_size or 3, stride, padding,
+    out = _conv_nd(input, num_filters, filter_size, stride, padding,
                    dilation, groups, param_attr, bias_attr, name, 3,
-                   transpose=True, output_size=output_size)
+                   transpose=True, output_size=output_size,
+                   data_format="NCHW" if data_format == "NCDHW" else data_format)
     return getattr(F, act)(out) if act else out
 
 
@@ -194,10 +215,11 @@ def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
     from .. import nn
     from ..nn import functional as F
 
-    ch = int(input.shape[1])
-    gn = _cached(name, f"gn:{groups}:{ch}",
+    ch = int(input.shape[1 if data_layout.startswith("NC") else -1])
+    gn = _cached(name, f"gn:{groups}:{ch}:{data_layout}",
                  lambda: nn.GroupNorm(groups, ch, epsilon=epsilon,
-                                      weight_attr=param_attr, bias_attr=bias_attr))
+                                      weight_attr=param_attr, bias_attr=bias_attr,
+                                      data_format=data_layout))
     out = gn(input)
     return getattr(F, act)(out) if act else out
 
@@ -237,11 +259,24 @@ def data_norm(input, act=None, epsilon=1e-5, param_attr=None, enable_scale_and_s
 def prelu(x, mode="all", param_attr=None, data_format="NCHW", name=None):
     from .. import nn
 
-    n = {"all": 1, "channel": int(x.shape[1]), "element": None}[mode]
-    if n is None:
-        n = 1
-        for d in x.shape[1:]:
-            n *= int(d)
+    if mode == "element":
+        # per-element slope: weight shaped like one sample (ref prelu op
+        # element mode); F.prelu only broadcasts per-channel, so compute here
+        from ..nn.layer.layers import Layer
+        from ..nn.initializer import Constant
+
+        shape = [int(d) for d in x.shape[1:]]
+
+        def make():
+            holder = Layer()
+            return holder.create_parameter(shape, attr=param_attr,
+                                           default_initializer=Constant(0.25))
+
+        w = _cached(name, f"prelu:element:{shape}", make)
+        return apply_op(lambda v, wv: jnp.where(v > 0, v, wv[None] * v),
+                        (x, w), name="prelu_element")
+    ch_axis = 1 if data_format.startswith("NC") else -1
+    n = 1 if mode == "all" else int(x.shape[ch_axis])
     pr = _cached(name, f"prelu:{mode}:{n}",
                  lambda: nn.PReLU(num_parameters=n, weight_attr=param_attr,
                                   data_format=data_format))
@@ -434,19 +469,18 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
         sizes = [float(min_sizes[i])]
         if max_sizes:
             sizes.append(float(np.sqrt(min_sizes[i] * max_sizes[i])))
-        boxes = []
-        for y in range(H):
-            for x_ in range(W):
-                cx, cy = (x_ + offset) * sw, (y + offset) * sh
-                for s in sizes:
-                    boxes.append([cx - s / 2, cy - s / 2, cx + s / 2, cy + s / 2])
-                for ar in ars:
-                    for a in ([ar, 1.0 / ar] if flip else [ar]):
-                        w_ = min_sizes[i] * np.sqrt(a)
-                        h_ = min_sizes[i] / np.sqrt(a)
-                        boxes.append([cx - w_ / 2, cy - h_ / 2,
-                                      cx + w_ / 2, cy + h_ / 2])
-        pb = np.asarray(boxes, np.float32) / [img_w, img_h, img_w, img_h]
+        # vectorized prior grid: centers [H, W] x per-cell (w, h) variants
+        wh = [(s, s) for s in sizes]
+        for ar in ars:
+            for a in ([ar, 1.0 / ar] if flip else [ar]):
+                wh.append((min_sizes[i] * np.sqrt(a), min_sizes[i] / np.sqrt(a)))
+        wh = np.asarray(wh, np.float32)                      # [P, 2]
+        cx = (np.arange(W, dtype=np.float32) + offset) * sw
+        cy = (np.arange(H, dtype=np.float32) + offset) * sh
+        cxy = np.stack(np.meshgrid(cx, cy), -1).reshape(-1, 1, 2)  # [H*W, 1, 2]
+        half = wh[None] / 2                                   # [1, P, 2]
+        pb = np.concatenate([cxy - half, cxy + half], -1).reshape(-1, 4)
+        pb = pb / [img_w, img_h, img_w, img_h]
         if clip:
             pb = np.clip(pb, 0.0, 1.0)
         priors.append(Tensor(jnp.asarray(pb)))
@@ -457,19 +491,6 @@ def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
 
     return (M.concat(locs, 1), M.concat(confs, 1),
             M.concat(priors, 0), M.concat(pvars, 0))
-
-
-def case(pred_fn_pairs, default=None, name=None):
-    """Ref static/nn/control_flow.py case: first true predicate wins."""
-    for pred, fn in pred_fn_pairs:
-        v = _unwrap(pred)
-        if isinstance(v, jax.core.Tracer):
-            raise NotImplementedError(
-                "static.nn.case with traced predicates: nest static.nn.cond "
-                "instead (case is sugar over sequential conds)")
-        if bool(v):
-            return fn()
-    return default() if default is not None else None
 
 
 # -------------------------------------------------------- sequence ops (LoD
@@ -513,8 +534,8 @@ def sequence_pool(input, pool_type, is_test=False, pad_value=0.0, seq_len=None):
         if pt == "last":
             idx = jnp.maximum(me.sum(1)[..., 0] if me.ndim == 3 else me.sum(1), 1
                               ).astype(jnp.int32) - 1
-            return jnp.take_along_axis(v, idx[:, None, None].astype(jnp.int32),
-                                       1)[:, 0]
+            idx = idx.reshape((-1,) + (1,) * (v.ndim - 1))
+            return jnp.take_along_axis(v, idx, 1)[:, 0]
         raise ValueError(f"unknown pool_type {pool_type}")
 
     return apply_op(_f, (input,), name="sequence_pool")
@@ -584,8 +605,9 @@ def sequence_pad(x, pad_value, maxlen=None, name=None):
         if maxlen is None or maxlen <= v.shape[1]:
             return v
         extra = maxlen - v.shape[1]
-        pads = [(0, 0), (0, extra)] + [(0, 0)] * (v.ndim - 2)
-        return jnp.pad(v, pads, constant_values=0) + 0 * pv.astype(v.dtype)
+        fill = jnp.broadcast_to(pv.astype(v.dtype),
+                                (v.shape[0], extra) + tuple(v.shape[2:]))
+        return jnp.concatenate([v, fill], axis=1)
 
     out = apply_op(_f, (x, pad_value), name="sequence_pad")
     B, T = int(x.shape[0]), int(out.shape[1])

@@ -135,6 +135,54 @@ def test_multi_box_head():
     assert priors.shape[0] == n and pvars.shape[0] == n
 
 
+def test_sequence_pad_honors_pad_value():
+    seq = paddle.to_tensor(rng.randn(2, 3, 4).astype(np.float32))
+    padded, _ = S.sequence_pad(seq, paddle.full([], -7.0), maxlen=5)
+    assert (np.asarray(padded._value)[:, 3:] == -7.0).all()
+
+
+def test_prelu_element_mode():
+    x = paddle.to_tensor(rng.randn(2, 3, 4, 4).astype(np.float32))
+    out = S.prelu(x, "element", name="tpe")
+    xv = np.asarray(x._value)
+    np.testing.assert_allclose(np.asarray(out._value)[xv > 0], xv[xv > 0])
+    assert not np.allclose(np.asarray(out._value)[xv < 0], xv[xv < 0])
+
+
+def test_sequence_last_step_2d():
+    seq2 = paddle.to_tensor(rng.randn(2, 5).astype(np.float32))
+    lens = paddle.to_tensor(np.array([3, 5], np.int64))
+    last = np.asarray(S.sequence_last_step(seq2, seq_len=lens)._value)
+    np.testing.assert_allclose(last[0], np.asarray(seq2._value)[0, 2], atol=1e-6)
+
+
+def test_conv_transpose_output_size_derives_kernel():
+    x = paddle.to_tensor(rng.randn(1, 4, 8, 8).astype(np.float32))
+    out = S.conv2d_transpose(x, 6, output_size=[16, 16], stride=2, name="tcto")
+    assert out.shape[-2:] == [16, 16]
+    with pytest.raises(ValueError):
+        S.conv2d_transpose(x, 6, name="tcto2")
+
+
+def test_conv_nhwc_channel_axis():
+    x = paddle.to_tensor(rng.randn(1, 8, 8, 3).astype(np.float32))
+    out = S.conv2d(x, 6, 3, padding=1, data_format="NHWC", name="tnhwc")
+    assert out.shape == [1, 8, 8, 6]
+
+
+def test_auto_key_includes_dilation():
+    x = paddle.to_tensor(rng.randn(1, 3, 8, 8).astype(np.float32))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        a = S.conv2d(x, 4, 3, padding=1, dilation=1)
+        b = S.conv2d(x, 4, 3, padding=2, dilation=2)
+    assert a.shape[1] == b.shape[1] == 4
+    from paddle_tpu.static.nn_builders import _layer_registry
+
+    dil_keys = [k for k in _layer_registry if ":3:1:1:" in str(k) or ":2:2:" in str(k)]
+    assert len([k for k in _layer_registry if str(k).startswith("conv2:3:4:3")]) >= 2
+
+
 def test_auto_key_warns():
     x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
     with pytest.warns(UserWarning, match="automatic key"):
