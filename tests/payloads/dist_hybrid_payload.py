@@ -1,0 +1,73 @@
+"""4-process hybrid-parallel trainer payload: dp=4 (across processes) x
+mp=2 (intra-process devices) — the multi-host shape of BASELINE config #5
+scaled down (ref pattern: unittests/hybrid_parallel_mp_layers.py run under
+the launcher).
+
+Each process owns TWO virtual CPU devices, so the 8-device global mesh
+spans process boundaries exactly like hosts in a pod; collectives over the
+mp axis stay intra-process ("ICI"), dp gradient reduction crosses processes
+("DCN")."""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, os.environ["REPO_ROOT"])
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed.meta_parallel.mp_layers import (  # noqa: E402
+    ColumnParallelLinear,
+    RowParallelLinear,
+)
+
+
+class TPNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.col = ColumnParallelLinear(16, 32, gather_output=False)
+        self.row = RowParallelLinear(32, 4, input_is_parallel=True)
+
+    def forward(self, x):
+        return self.row(paddle.nn.functional.relu(self.col(x)))
+
+
+def main():
+    out_path = sys.argv[1]
+    penv = dist.init_parallel_env()
+    assert jax.process_count() == 4, jax.process_count()
+    assert jax.device_count() == 8, jax.device_count()
+
+    paddle.seed(42)
+    model = TPNet()
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+    hcg = dist.HybridCommunicateGroup(dp=4, mp=2, pp=1, sharding=1)
+    dist.set_hybrid_communicate_group(hcg)
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    step = dist.ShardedTrainStep(model, loss_fn, opt, hcg.mesh)
+    rng = np.random.default_rng(7)
+    losses = []
+    for _ in range(5):
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 4)).astype(np.float32)
+        losses.append(float(step(x, y).item()))
+
+    with open(out_path, "w") as f:
+        json.dump({
+            "rank": penv.rank,
+            "mp_rank": hcg.get_model_parallel_rank(),
+            "dp_rank": hcg.get_data_parallel_rank(),
+            "losses": losses,
+        }, f)
+
+
+if __name__ == "__main__":
+    main()

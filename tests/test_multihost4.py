@@ -1,0 +1,102 @@
+"""4-process hybrid parallelism on localhost: dp crosses process boundaries,
+mp stays intra-process — the multi-host mesh shape, simulated the way the
+reference simulates multi-node (test_dist_base.py:786 subprocess launch).
+
+Oracle: the same model/data on a single-process 8-device mesh.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAYLOAD = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "payloads", "dist_hybrid_payload.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def hybrid_results(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("dist4")
+    port = _free_port()
+    outs = [str(tmp / f"rank{r}.json") for r in range(4)]
+    procs = []
+    for r in range(4):
+        env = dict(os.environ)
+        env.update({
+            "PADDLE_TRAINER_ID": str(r),
+            "PADDLE_TRAINERS_NUM": "4",
+            "PADDLE_MASTER": f"127.0.0.1:{port}",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+            "JAX_PLATFORMS": "cpu",
+            "REPO_ROOT": REPO_ROOT,
+        })
+        procs.append(subprocess.Popen([sys.executable, PAYLOAD, outs[r]],
+                                      env=env, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT))
+    logs = []
+    for p in procs:
+        stdout, _ = p.communicate(timeout=420)
+        logs.append(stdout.decode(errors="replace"))
+    for p, log in zip(procs, logs):
+        assert p.returncode == 0, f"trainer failed:\n{log[-3000:]}"
+    return [json.load(open(o)) for o in outs]
+
+
+def _single_process_oracle():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.meta_parallel.mp_layers import (
+        ColumnParallelLinear, RowParallelLinear)
+
+    class TPNet(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.col = ColumnParallelLinear(16, 32, gather_output=False)
+            self.row = RowParallelLinear(32, 4, input_is_parallel=True)
+
+        def forward(self, x):
+            return self.row(paddle.nn.functional.relu(self.col(x)))
+
+    paddle.seed(42)
+    model = TPNet()
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+    hcg = dist.HybridCommunicateGroup(dp=4, mp=2, pp=1, sharding=1)
+    dist.set_hybrid_communicate_group(hcg)
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    step = dist.ShardedTrainStep(model, loss_fn, opt, hcg.mesh)
+    rng = np.random.default_rng(7)
+    losses = []
+    for _ in range(5):
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        y = rng.standard_normal((8, 4)).astype(np.float32)
+        losses.append(float(step(x, y).item()))
+    return losses
+
+
+def test_four_process_hybrid_matches_single_process(hybrid_results):
+    ref = _single_process_oracle()
+    for r in hybrid_results:
+        np.testing.assert_allclose(r["losses"], ref, rtol=2e-4, atol=2e-4)
+    assert ref[-1] < ref[0]
+
+
+def test_topology_coords_span_processes(hybrid_results):
+    pairs = sorted((r["dp_rank"], r["mp_rank"]) for r in hybrid_results)
+    # 4 processes x 2 local devices: each process hosts one (dp, mp=both)
+    # stripe -> process-level dp ranks 0..3, mp rank 0 reported per process
+    assert len(set(pairs)) == 4
+    assert {p[0] for p in pairs} == {0, 1, 2, 3}
