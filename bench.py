@@ -57,6 +57,42 @@ def _measure_gemm_peak():
     return 2 * n * n * n * iters / best / 1e12
 
 
+def _measure_conv_peak():
+    """Measured bf16 conv ceiling (TF/s): a 30-deep chain of ResNet-stage-2
+    3x3 convs.  Context for the ResNet MFU: this chip's convolutions run at
+    a small fraction of its matmul rate (observed ~10 vs ~128 TF/s), so the
+    train step's effective rate should be read against THIS number."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    B, H, W, C, iters = 128, 56, 56, 64, 30
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, C, H, W) * 0.1, jnp.bfloat16)
+    w = jnp.asarray(rng.randn(C, C, 3, 3) * 0.1, jnp.bfloat16)
+    dn = lax.conv_dimension_numbers(x.shape, w.shape, ("NCHW", "OIHW", "NCHW"))
+
+    @jax.jit
+    def chain(x, w):
+        def body(c, _):
+            c = lax.conv_general_dilated(c, w, (1, 1), "SAME", dimension_numbers=dn)
+            c = c * jax.lax.rsqrt(jnp.mean(c.astype(jnp.float32) ** 2) + 1e-6).astype(jnp.bfloat16)
+            return c, ()
+        return jax.lax.scan(body, x, None, length=iters)[0]
+
+    r = chain(x, w)
+    float(jnp.sum(r[:1, :1, :1, :1].astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        r = chain(x, w)
+        float(jnp.sum(r[:1, :1, :1, :1].astype(jnp.float32)))
+        best = min(best, time.perf_counter() - t0)
+    return 2 * B * H * W * C * C * 9 * iters / best / 1e12
+
+
 def _bench_llama(on_accel):
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -211,6 +247,7 @@ def main():
         # model benches the number is polluted by allocator state
         try:
             out["hw_gemm_tfs_measured"] = round(_measure_gemm_peak(), 1)
+            out["hw_conv_tfs_measured"] = round(_measure_conv_peak(), 1)
         except Exception as e:
             out["hw_peak_error"] = repr(e)[:200]
     try:
