@@ -108,14 +108,22 @@ def _flash_fwd(q, k, v, causal, scale, bq, bk, interpret):
 
 
 # -------------------------------------------------------------------- backward
-def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
-               *, scale, causal, bq, bk, seq_q, seq_k):
+def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
+               scale, causal, bq, bk, seq_q, seq_k):
+    # rest = (dlse_ref, dq_ref) for the lse-returning variant (ring combine
+    # backprop), else (dq_ref,): the lse cotangent adds p * dlse to ds
+    if len(rest) == 2:
+        dlse_ref, dq_ref = rest
+        dlse = dlse_ref[0][:, :1]
+    else:
+        (dq_ref,) = rest
+        dlse = 0.0
     qi = pl.program_id(1)
     q = q_ref[0]
     do = do_ref[0]
     lse = lse_ref[0][:, :1]     # [bq, 1] (lanes-broadcast residual)
     dsum = jnp.sum(do.astype(jnp.float32) * o_ref[0].astype(jnp.float32),
-                   axis=-1, keepdims=True)
+                   axis=-1, keepdims=True) - dlse
     nkb = pl.cdiv(seq_k, bk)
     off = seq_k - seq_q
     if causal:
@@ -142,8 +150,13 @@ def _dq_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dq_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
-                *, scale, causal, bq, bk, seq_q, seq_k):
+def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, *rest,
+                scale, causal, bq, bk, seq_q, seq_k):
+    if len(rest) == 3:
+        dlse_ref, dk_ref, dv_ref = rest
+    else:
+        dlse_ref = None
+        dk_ref, dv_ref = rest
     kj = pl.program_id(1)
     k = k_ref[0]   # [bk, D]
     v = v_ref[0]
@@ -159,6 +172,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
         lse = lse_ref[0, pl.ds(qi * bq, bq), :1]
         dsum = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                        axis=-1, keepdims=True)
+        if dlse_ref is not None:
+            dsum = dsum - dlse_ref[0, pl.ds(qi * bq, bq), :1]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -184,9 +199,13 @@ def _dkv_kernel(q_ref, k_ref, v_ref, o_ref, do_ref, lse_ref, dk_ref, dv_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
+def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret, dlse=None):
     BH, Sq, D = q.shape
     Sk = k.shape[1]
+    lse_spec = pl.BlockSpec((1, bq, LANES), lambda bh, qi: (bh, qi, 0))
+    lse_full = pl.BlockSpec((1, Sq, LANES), lambda bh, kj: (bh, 0, 0))
+    dq_extra_in = [lse_spec] if dlse is not None else []
+    dq_args = (q, k, v, o, do, lse) + ((dlse,) if dlse is not None else ())
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
@@ -198,14 +217,15 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             pl.BlockSpec((1, Sk, D), lambda bh, qi: (bh, 0, 0)),
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
             pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((1, bq, LANES), lambda bh, qi: (bh, qi, 0)),
-        ],
+            lse_spec,
+        ] + dq_extra_in,
         out_specs=pl.BlockSpec((1, bq, D), lambda bh, qi: (bh, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, D), q.dtype),
         interpret=interpret,
         compiler_params=_compiler_params(interpret),
-    )(q, k, v, o, do, lse)
+    )(*dq_args)
 
+    dkv_extra_in = [lse_full] if dlse is not None else []
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, causal=causal, bq=bq, bk=bk,
                           seq_q=Sq, seq_k=Sk),
@@ -216,8 +236,8 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
             pl.BlockSpec((1, bk, D), lambda bh, kj: (bh, kj, 0)),
             pl.BlockSpec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),
             pl.BlockSpec((1, Sq, D), lambda bh, kj: (bh, 0, 0)),
-            pl.BlockSpec((1, Sq, LANES), lambda bh, kj: (bh, 0, 0)),
-        ],
+            lse_full,
+        ] + dkv_extra_in,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda bh, kj: (bh, kj, 0)),
             pl.BlockSpec((1, bk, D), lambda bh, kj: (bh, kj, 0)),
@@ -228,7 +248,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret):
         ],
         interpret=interpret,
         compiler_params=_compiler_params(interpret),
-    )(q, k, v, o, do, lse)
+    )(*dq_args)
     return dq, dk, dv
 
 
@@ -250,6 +270,49 @@ def _flash_bhsd_bwd(causal, scale, bq, bk, interpret, res, do):
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+# lse-returning entry for blockwise/ring combines: (o, lse) with a backward
+# that honors the lse cotangent (d s_ij += p_ij * dlse_i, folded into dsum)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_bhsd_lse(q, k, v, causal, scale, bq, bk, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
+    return o, lse[..., 0]
+
+
+def _flash_bhsd_lse_fwd(q, k, v, causal, scale, bq, bk, interpret):
+    o, lse = _flash_fwd(q, k, v, causal, scale, bq, bk, interpret)
+    return (o, lse[..., 0]), (q, k, v, o, lse)
+
+
+def _flash_bhsd_lse_bwd(causal, scale, bq, bk, interpret, res, cts):
+    q, k, v, o, lse = res
+    do, dlse0 = cts
+    dlse = jnp.broadcast_to(dlse0[..., None].astype(jnp.float32), lse.shape)
+    return _flash_bwd(q, k, v, o, lse, do, causal, scale, bq, bk, interpret,
+                      dlse=dlse)
+
+
+_flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None, block_q=None,
+                             block_k=None, interpret=None):
+    """Like flash_attention but also returns the per-query logsumexp
+    [B, H, S] — the hook for blockwise combines (ring attention)."""
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    if interpret is None:
+        interpret = _interpret_default()
+    bq = min(block_q, Sq) if block_q else _auto_block(Sq)
+    bk = min(block_k, Sk) if block_k else _auto_block(Sk)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    to_bhsd = lambda x: jnp.swapaxes(x, 1, 2).reshape(B * H, x.shape[1], D)  # noqa: E731
+    o, lse = _flash_bhsd_lse(to_bhsd(q), to_bhsd(k), to_bhsd(v),
+                             causal, float(scale), bq, bk, interpret)
+    return (jnp.swapaxes(o.reshape(B, H, Sq, D), 1, 2),
+            lse.reshape(B, H, Sq))
 
 
 def supports_seq(seq):

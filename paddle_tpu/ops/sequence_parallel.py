@@ -37,9 +37,16 @@ def _block_scores(q, k, scale):
                       k.astype(jnp.float32)) * scale
 
 
-def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
+def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None,
+                   use_flash: bool = False):
     """Blockwise ring attention.  q/k/v: local shards [B, S/n, H, D] inside
-    shard_map over `axis_name`.  Returns the local output shard [B, S/n, H, D]."""
+    shard_map over `axis_name`.  Returns the local output shard [B, S/n, H, D].
+
+    use_flash=True computes each visited block with the Pallas flash kernel
+    (O(block) memory instead of materializing [B,H,S/n,S/n] scores) and
+    combines blocks by their logsumexp — the long-context configuration."""
+    if use_flash:
+        return _ring_attention_flash(q, k, v, axis_name, causal, scale)
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     B, Sl, H, D = q.shape
@@ -91,6 +98,73 @@ def ring_attention(q, k, v, axis_name: str, causal: bool = False, scale=None):
     m, l, acc, _, _ = carry
     out = acc / l  # [B, H, Sq, D]
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)  # [B, Sq, H, D]
+
+
+def _ring_attention_flash(q, k, v, axis_name, causal, scale):
+    """Ring attention with the Pallas flash kernel per block.
+
+    Each visited block yields (o_b, lse_b) from flash_attention_with_lse;
+    blocks combine by the standard unnormalized online-softmax update keyed
+    on lse (contribution o_b * exp(lse_b - m)).  Hidden blocks contribute
+    lse=-1e30, whose weight underflows to exactly 0 once any real block has
+    been seen — and causal rings always see the diagonal block.  Gradients
+    flow through the flash kernel's lse-aware backward and the reverse
+    ppermute automatically."""
+    from .flash_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    B, Sl, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def flash_block(kb, vb, block_causal):
+        o_b, lse_b = flash_attention_with_lse(q, kb, vb, causal=block_causal,
+                                              scale=scale)
+        # [B, Sl, H, D] / [B, H, Sl] -> combine layout [B, H, Sl, *]
+        return jnp.swapaxes(o_b, 1, 2).astype(jnp.float32), lse_b[..., None]
+
+    def step(t, carry):
+        m, l, acc, kb, vb = carry
+        src = (me - t) % n
+
+        def full(_):
+            return flash_block(kb, vb, False)
+
+        def diag(_):
+            return flash_block(kb, vb, True)
+
+        def hidden(_):
+            return (jnp.zeros((B, H, Sl, D), jnp.float32),
+                    jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32))
+
+        if causal:
+            case = jnp.where(src == me, 1, jnp.where(src < me, 2, 0))
+            o_b, lse_b = lax.switch(case, [hidden, diag, full], None)
+        else:
+            o_b, lse_b = full(None)
+
+        m_new = jnp.maximum(m, lse_b)
+        corr = jnp.exp(m - m_new)
+        w_b = jnp.exp(lse_b - m_new)
+        l = l * corr + w_b
+        acc = acc * corr + o_b * w_b
+        m = m_new
+
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return m, l, acc, kb, vb
+
+    m0 = jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, Sl, D), jnp.float32)
+    carry = (m0, l0, acc0, k, v)
+    for t in range(n):
+        carry = step(t, carry)
+    m, l, acc, _, _ = carry
+    out = acc / jnp.maximum(l, 1e-30)
+    return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale=None,
