@@ -153,10 +153,21 @@ class LlamaAttention(nn.Layer):
             k = apply_op(lambda a: jnp.repeat(a, rep, axis=2), (k,), name="gqa_repeat")
             v = apply_op(lambda a: jnp.repeat(a, rep, axis=2), (v,), name="gqa_repeat")
 
-        backend = "auto" if self.config.use_flash_attention else "math"
-        out = F.scaled_dot_product_attention(
-            q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None, backend=backend,
-        )
+        if self.config.sequence_parallel and attn_mask is None and cache is None:
+            # context parallelism (§5.7): ring attention across the 'sep' mesh
+            # axis — the sequence stays sharded through the whole layer stack
+            from ..ops.sequence_parallel import ring_attention_global
+
+            out = apply_op(
+                lambda a, b, c: ring_attention_global(
+                    a, b, c, causal=True,
+                    use_flash=self.config.use_flash_attention),
+                (q, k, v), name="ring_attention")
+        else:
+            backend = "auto" if self.config.use_flash_attention else "math"
+            out = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None, backend=backend,
+            )
         out = out.reshape([B, S, self.num_heads * self.head_dim])
         out = self.o_proj(out)
         if use_cache:

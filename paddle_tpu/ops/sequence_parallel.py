@@ -167,6 +167,42 @@ def _ring_attention_flash(q, k, v, axis_name, causal, scale):
     return jnp.swapaxes(out, 1, 2).astype(q.dtype)
 
 
+def ring_attention_global(q, k, v, causal=False, scale=None, sep_axis="sep",
+                          use_flash=False):
+    """Global-array entry point: q/k/v are [B, S, H, D] GLOBAL tracers inside
+    a jitted step with an active mesh (sharding_ctx.mesh_scope — what
+    ShardedTrainStep installs).  Shards S over `sep_axis` with a shard_map
+    that is manual ONLY over that axis (axis_names={sep}), so dp/mp/sharding
+    stay with the SPMD partitioner, and runs ring attention across the
+    sequence shards.  Falls back to local dense attention when there is no
+    mesh, no sep axis, or sep size 1 — same numerics, no communication."""
+    mesh = None
+    if isinstance(q, jax.core.Tracer):
+        from ..distributed.sharding_ctx import current_mesh
+
+        mesh = current_mesh()
+    if mesh is None or sep_axis not in mesh.axis_names \
+            or mesh.shape[sep_axis] == 1:
+        B, S, H, D = q.shape
+        sc = 1.0 / (D ** 0.5) if scale is None else scale
+        s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) * sc
+        if causal:
+            mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+            s = jnp.where(mask, s, NEG_INF)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p,
+                          v.astype(jnp.float32)).astype(q.dtype)
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, sep_axis, None, None)
+    fn = lambda a, b, c: ring_attention(a, b, c, sep_axis, causal=causal,  # noqa: E731
+                                        scale=scale, use_flash=use_flash)
+    return jax.shard_map(fn, mesh=mesh, in_specs=(spec, spec, spec),
+                         out_specs=spec, axis_names={sep_axis},
+                         check_vma=False)(q, k, v)
+
+
 def ulysses_attention(q, k, v, axis_name: str, causal: bool = False, scale=None,
                       attn_fn=None):
     """Ulysses alltoall attention.  q/k/v: local shards [B, S/n, H, D] inside

@@ -96,6 +96,46 @@ def test_llama_hybrid_tp_dp_zero2_matches_single_device():
     np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
 
 
+def test_llama_sequence_parallel_matches_single_device():
+    """Long-context config (§5.7): LlamaConfig(sequence_parallel=True) runs
+    ring attention across the 'sep' mesh axis inside the jitted step; the
+    loss trajectory must match the dense single-device oracle."""
+    from jax.sharding import PartitionSpec as P
+
+    rng2 = np.random.RandomState(7)
+    ids = rng2.randint(0, 256, (4, 32)).astype(np.int32)
+
+    def make(seq_par):
+        paddle.seed(5)
+        cfg = LlamaConfig.tiny(tensor_parallel=False, use_flash_attention=False,
+                               num_hidden_layers=2, hidden_size=64,
+                               intermediate_size=128, num_attention_heads=4,
+                               num_key_value_heads=4, vocab_size=256,
+                               max_position_embeddings=64,
+                               sequence_parallel=seq_par)
+        model = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                     parameters=model.parameters())
+
+        def loss_fn(a, b):
+            loss, _ = model(a, labels=b)
+            return loss
+
+        return model, loss_fn, opt
+
+    m1, lf1, o1 = make(False)
+    step1 = paddle.jit.TrainStep(m1, lf1, o1)
+    ref = [float(step1(paddle.to_tensor(ids), paddle.to_tensor(ids)).item())
+           for _ in range(3)]
+
+    m2, lf2, o2 = make(True)
+    mesh = dist.build_mesh(dp=2, sep=4)
+    step2 = dist.ShardedTrainStep(m2, lf2, o2, mesh, batch_spec=P("dp", "sep"))
+    got = [float(step2(paddle.to_tensor(ids), paddle.to_tensor(ids)).item())
+           for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=3e-4, atol=3e-4)
+
+
 def test_fused_functional_and_onnx_guidance():
     import pytest
 
