@@ -2,9 +2,10 @@
 
 Ref: the dygraph_to_static transformer suite
 (fluid/dygraph/dygraph_to_static/ast_transformer.py, ifelse_transformer.py,
-loop_transformer.py, convert_operators.py) — `@to_static` functions get their
-`if`/`while` statements rewritten so a Tensor-valued condition becomes graph
-control flow instead of a silent single-branch trace.
+loop_transformer.py, break_continue_transformer.py, return_transformer.py,
+convert_operators.py) — `@to_static` functions get their `if`/`while`
+statements rewritten so a Tensor-valued condition becomes graph control flow
+instead of a silent single-branch trace.
 
 TPU-native translation (SURVEY §7.1): the rewrite targets jax.lax.cond /
 lax.while_loop directly.  The generated code uses the reference's
@@ -15,14 +16,22 @@ state.  Gradients flow natively: inside jit/to_static the whole program is
 differentiated by jax.vjp, which understands lax.cond/while_loop.
 
 Supported: `if`/`elif`/`else` and `while` over Tensor conditions, nested
-arbitrarily, with Python-valued conditions keeping exact Python semantics.
+arbitrarily, with Python-valued conditions keeping exact Python semantics;
+`break`/`continue` in converted loops (compiled to carried flags — the lax
+analog of the reference's BreakContinueTransformer: the loop test gains
+`and not break_flag`, statements after a flag-set are guarded); early
+`return` in Tensor-condition branches (the reference's ReturnTransformer,
+done by restructuring: trailing code is pushed into the non-returning arm so
+both lax.cond branches produce the return value).
+
 Not converted (left as plain Python, which errors loudly on a traced
-condition): branches containing `return`/`yield`, loops containing
-`break`/`continue`, and `for` loops (trace-unrolled as before).
+condition): `yield`, `return` inside a converted *loop* body, and `for`
+over non-range iterables (trace-unrolled as before).
 """
 from __future__ import annotations
 
 import ast
+import copy
 import functools
 import inspect
 import textwrap
@@ -47,6 +56,37 @@ class _Undefined:
 
 
 UNDEFINED = _Undefined()
+
+
+class _PoisonedLocal:
+    """Placeholder for a local whose value cannot escape compiled control
+    flow (assigned in only one lax.cond branch, or first assigned inside a
+    lax.while_loop body).  Any USE afterwards raises a targeted error naming
+    the variable, instead of a confusing failure far from the cause —
+    while legal branch-/loop-local temporaries stay silent."""
+
+    __slots__ = ("name", "reason")
+
+    def __init__(self, name, reason):
+        object.__setattr__(self, "name", name)
+        object.__setattr__(self, "reason", reason)
+
+    def _err(self, *a, **k):
+        raise ValueError(
+            f"dy2static: variable '{self.name}' {self.reason}, so its value "
+            "does not exist here; assign it on every path (or before the "
+            "control flow) if you need it afterwards")
+
+    def __getattr__(self, attr):
+        self._err()
+
+    def __repr__(self):
+        return f"<local '{self.name}' (unavailable: {self.reason})>"
+
+    __call__ = __bool__ = __len__ = __iter__ = __float__ = __int__ = _err
+    __add__ = __radd__ = __sub__ = __rsub__ = __mul__ = __rmul__ = _err
+    __truediv__ = __rtruediv__ = __getitem__ = __array__ = __index__ = _err
+    __eq__ = __ne__ = __lt__ = __le__ = __gt__ = __ge__ = __neg__ = _err
 
 
 # --------------------------------------------------------------------- runtime
@@ -86,48 +126,114 @@ def _unpack(packed, kinds, statics):
     return tuple(out)
 
 
-def convert_ifelse(pred, true_fn, false_fn, get_args, set_args):
+def _truthy(v):
+    return bool(jnp.all(v)) if hasattr(v, "dtype") else bool(v)
+
+
+def and_not(test, flag):
+    """`test and not flag` that stays Python when both are concrete — the
+    rewritten loop test for loops containing `break`."""
+    t, f = _raw(test), _raw(flag)
+    if isinstance(t, jax.core.Tracer) or isinstance(f, jax.core.Tracer):
+        return jnp.logical_and(jnp.all(t), jnp.logical_not(jnp.all(f)))
+    return _truthy(t) and not _truthy(f)
+
+
+def neither(a, b):
+    """`not (a or b)` — the guard over statements following a possible
+    break/continue flag-set."""
+    av, bv = _raw(a), _raw(b)
+    if isinstance(av, jax.core.Tracer) or isinstance(bv, jax.core.Tracer):
+        return jnp.logical_not(jnp.logical_or(jnp.any(av), jnp.any(bv)))
+    return not (_truthy(av) or _truthy(bv))
+
+
+def not_flag(a):
+    av = _raw(a)
+    if isinstance(av, jax.core.Tracer):
+        return jnp.logical_not(jnp.any(av))
+    return not _truthy(av)
+
+
+def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names=()):
     """Generated-code entry for a rewritten `if` (ref convert_operators.py
     convert_ifelse)."""
     pv = _raw(pred)
     if not isinstance(pv, jax.core.Tracer):
-        if (bool(jnp.all(pv)) if hasattr(pv, "dtype") else bool(pv)):
+        if _truthy(pv):
             true_fn()
         else:
             false_fn()
         return
 
     init = get_args()
-    observed = {}
 
-    def _branch(fn, tag):
+    def _probe(fn):
+        """Trace the branch once in the OUTER trace to learn each slot's
+        fate (the produced ops are dead code XLA removes).  Restores the
+        pre-branch locals."""
+        set_args(init)
+        fn()
+        out = get_args()
+        set_args(init)
+        return out
+
+    out_t, out_f = _probe(true_fn), _probe(false_fn)
+    kinds_t = [_kind(v) for v in out_t]
+    kinds_f = [_kind(v) for v in out_f]
+    carried, out_kind, dead, final_static = [], [], [], {}
+    for i, (vt, vf, kt, kf) in enumerate(zip(out_t, out_f, kinds_t, kinds_f)):
+        nm = names[i] if i < len(names) else f"#{i}"
+        t_un, f_un = isinstance(vt, _Undefined), isinstance(vf, _Undefined)
+        if t_un and f_un:
+            final_static[i] = vt  # untouched by either branch
+        elif t_un or f_un:
+            dead.append(i)  # branch-local temp: poisoned, errors only on use
+        elif kt == "static" and kf == "static":
+            if vt is not vf:
+                raise ValueError(
+                    f"dy2static: variable '{nm}' is bound to different "
+                    "Python objects by the two branches of a "
+                    "Tensor-condition `if`; only Tensor/numeric values can "
+                    "be merged through compiled control flow")
+            final_static[i] = vt
+        elif kt != "static" and kf != "static":
+            st, sf = jnp.shape(_raw(vt)), jnp.shape(_raw(vf))
+            if st != sf:
+                raise ValueError(
+                    f"dy2static: variable '{nm}' has shape {st} in the true "
+                    f"branch but {sf} in the false branch of a "
+                    "Tensor-condition `if`; both branches must produce the "
+                    "same shape")
+            carried.append(i)
+            out_kind.append("tensor" if "tensor" in (kt, kf) else "raw")
+        else:
+            raise ValueError(
+                f"dy2static: variable '{nm}' is a Tensor/numeric in one "
+                "branch of a Tensor-condition `if` but a plain Python object "
+                "in the other; both branches must assign the same kind")
+
+    def _branch(fn):
         def run():
             set_args(init)
             fn()
             out = get_args()
-            if any(isinstance(v, _Undefined) for v in out):
-                raise ValueError(
-                    "dy2static: a variable is assigned in only one branch "
-                    "of a Tensor-condition `if`; assign it in both branches "
-                    "(or before the if)")
-            kinds = [_kind(v) for v in out]
-            observed[tag] = (kinds, [v for v, k in zip(out, kinds) if k == "static"])
-            return _pack(out, kinds)
-
+            return tuple(_raw(out[i]) for i in carried)
         return run
 
-    # branches trace sequentially; jax enforces matching output structures
-    out = jax.lax.cond(jnp.all(pv), _branch(true_fn, "t"), _branch(false_fn, "f"))
-    if not isinstance(out, tuple):
-        out = (out,)
-    kinds, statics = observed["t"]
-    kinds_f, statics_f = observed["f"]
-    if kinds != kinds_f or any(a is not b for a, b in zip(statics, statics_f)):
-        raise ValueError(
-            "dy2static: the two branches of a Tensor-condition `if` produce "
-            "different variable kinds/objects — both must assign the same "
-            "tensor/python structure")
-    set_args(_unpack(out, kinds, statics))
+    res = jax.lax.cond(jnp.all(pv), _branch(true_fn), _branch(false_fn))
+    if not isinstance(res, tuple):
+        res = (res,)
+    final = list(init)
+    for j, i in enumerate(carried):
+        final[i] = Tensor(res[j]) if out_kind[j] == "tensor" else res[j]
+    for i, v in final_static.items():
+        final[i] = v
+    for i in dead:
+        final[i] = _PoisonedLocal(
+            names[i] if i < len(names) else f"#{i}",
+            "is assigned in only one branch of a Tensor-condition `if`")
+    set_args(tuple(final))
 
 
 _NO_CONVERT_MODULE_PREFIXES = ("paddle_tpu", "jax", "numpy", "builtins",
@@ -160,16 +266,20 @@ def convert_call(fn):
     return cached
 
 
-def convert_while(test_fn, body_fn, get_args, set_args):
+def convert_while(test_fn, body_fn, get_args, set_args, names=()):
     """Generated-code entry for a rewritten `while` (ref convert_while_loop)."""
+    # Python semantics while the test stays concrete: iterate eagerly (the
+    # loop unrolls under trace).  If the test BECOMES traced mid-loop (e.g.
+    # `for i in range(10)` or `while True:` with a Tensor-condition break —
+    # the flag enters the test), the executed iterations are already peeled
+    # into the outer trace; compile the remainder as a lax.while_loop from
+    # the current locals.
     first = _raw(test_fn())
-    if not isinstance(first, jax.core.Tracer):
-        # Python semantics: the loop unrolls under trace if the BODY produces
-        # tracers while the test stays concrete — exactly like before
-        while (bool(jnp.all(first)) if hasattr(first, "dtype") else bool(first)):
-            body_fn()
-            first = _raw(test_fn())
-        return
+    while not isinstance(first, jax.core.Tracer):
+        if not _truthy(first):
+            return
+        body_fn()
+        first = _raw(test_fn())
 
     init_vals = get_args()
     # vars undefined before the loop are loop-local temporaries: each
@@ -177,6 +287,7 @@ def convert_while(test_fn, body_fn, get_args, set_args):
     # UNDEFINED placeholder classifies as "static" and round-trips untouched)
     kinds = [_kind(v) for v in init_vals]
     statics = [v for v, k in zip(init_vals, kinds) if k == "static"]
+    promoted = set()  # static-slot indices that held tensors inside the body
 
     def cond(carry):
         set_args(_unpack(carry, kinds, statics))
@@ -185,10 +296,20 @@ def convert_while(test_fn, body_fn, get_args, set_args):
     def body(carry):
         set_args(_unpack(carry, kinds, statics))
         body_fn()
-        return _pack(get_args(), kinds)
+        out = get_args()
+        for j, (v, k) in enumerate(zip(out, kinds)):
+            if k == "static" and isinstance(init_vals[j], _Undefined) \
+                    and _kind(v) != "static":
+                promoted.add(j)
+        return _pack(out, kinds)
 
     out = jax.lax.while_loop(cond, body, _pack(init_vals, kinds))
-    set_args(_unpack(out, kinds, statics))
+    final = list(_unpack(out, kinds, statics))
+    for j in promoted:
+        final[j] = _PoisonedLocal(
+            names[j] if j < len(names) else f"<local {j}>",
+            "is first assigned inside a compiled Tensor-condition loop")
+    set_args(tuple(final))
 
 
 # ----------------------------------------------------------------- AST rewrite
@@ -276,6 +397,18 @@ def _has_blockers(stmts, in_loop=False):
     return False
 
 
+def _has_ret_yield(stmts):
+    """Return/Yield only — break/continue are convertible now."""
+    f = _FindBlockers()
+    f.loop_depth = 1 << 30  # break/continue never trip
+    try:
+        for s in stmts:
+            f.visit(s)
+    except _BlockersFound:
+        return True
+    return False
+
+
 def _name(n, ctx=None):
     return ast.Name(id=n, ctx=ctx or ast.Load())
 
@@ -318,6 +451,149 @@ def _get_set_defs(idx, varlist):
     return get, set_
 
 
+def _names_const(varlist):
+    return ast.Tuple(elts=[ast.Constant(value=v) for v in varlist], ctx=ast.Load())
+
+
+def _helper_expr(fn_name, args):
+    return ast.Call(
+        func=ast.Attribute(value=_name(_HELPER), attr=fn_name, ctx=ast.Load()),
+        args=args, keywords=[])
+
+
+def _flag_set(name, val=True):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(value=val))
+
+
+# ---- break/continue rewrite (ref break_continue_transformer.py, compiled
+# into carried boolean flags instead of fill-constant variables)
+
+def _rewrite_bc(stmts, brk, cnt):
+    """Replace this loop's break/continue with flag-sets; guard statements
+    that follow a possible flag-set with `if neither(brk, cnt):`.  Returns
+    the rewritten list.  Nested loops own their break/continue (Python binds
+    them to the innermost loop), so they are not descended into."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.Break):
+            out.append(_flag_set(brk))
+            return out  # rest of the block is unreachable
+        if isinstance(s, ast.Continue):
+            out.append(_flag_set(cnt))
+            return out
+        if isinstance(s, ast.If):
+            body = _rewrite_bc(s.body, brk, cnt)
+            orelse = _rewrite_bc(s.orelse, brk, cnt)
+            changed = body != s.body or orelse != s.orelse
+            out.append(ast.If(test=s.test, body=body, orelse=orelse))
+            if changed:
+                rest = _rewrite_bc(stmts[idx + 1:], brk, cnt)
+                if rest:
+                    out.append(ast.If(
+                        test=_helper_expr("neither", [_name(brk), _name(cnt)]),
+                        body=rest, orelse=[]))
+                return out
+            continue
+        out.append(s)
+    return out
+
+
+class _HasBC(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+        self.depth = 0
+
+    def visit_Break(self, node):
+        if self.depth == 0:
+            self.found = True
+
+    visit_Continue = visit_Break
+
+    def visit_While(self, node):
+        self.depth += 1
+        self.generic_visit(node)
+        self.depth -= 1
+
+    visit_For = visit_While
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_FunctionDef
+
+
+def _has_bc(stmts):
+    v = _HasBC()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+# ---- early-return restructuring (ref return_transformer.py, done by
+# pushing trailing code into the non-returning arm so both lax.cond
+# branches produce the return value)
+
+class _HasReturn(ast.NodeVisitor):
+    def __init__(self):
+        self.found = False
+
+    def visit_Return(self, node):
+        self.found = True
+
+    def visit_FunctionDef(self, node):
+        pass
+
+    visit_AsyncFunctionDef = visit_Lambda = visit_ClassDef = visit_FunctionDef
+
+
+def _contains_return(stmts):
+    v = _HasReturn()
+    for s in stmts:
+        v.visit(s)
+    return v.found
+
+
+def _always_returns(stmts):
+    if not stmts:
+        return False
+    last = stmts[-1]
+    if isinstance(last, (ast.Return, ast.Raise)):
+        return True
+    if isinstance(last, ast.If):
+        return bool(last.orelse) and _always_returns(last.body) \
+            and _always_returns(last.orelse)
+    return False
+
+
+def _restructure_returns(stmts):
+    """Push statements following a return-containing `if` into its arms, so
+    every such `if` ends (in all arms) with an explicit Return.  The control
+    flow transformer then merges the arms' return values through lax.cond.
+    Semantics-preserving for plain Python too (fall-off-the-end == explicit
+    `return None`)."""
+    out = []
+    for idx, s in enumerate(stmts):
+        if isinstance(s, ast.If) and _contains_return([s]):
+            rest = stmts[idx + 1:]
+            body = list(s.body)
+            if not _always_returns(body):
+                body = body + copy.deepcopy(rest)
+            orelse = list(s.orelse)
+            if not _always_returns(orelse):
+                orelse = orelse + copy.deepcopy(rest)
+            body = _restructure_returns(body)
+            orelse = _restructure_returns(orelse)
+            if not _always_returns(body):
+                body.append(ast.Return(value=None))
+            if not _always_returns(orelse):
+                orelse.append(ast.Return(value=None))
+            out.append(ast.If(test=s.test, body=body, orelse=orelse))
+            return out  # rest was absorbed into the arms
+        out.append(s)
+    return out
+
+
 _BUILTIN_SKIP = {"range", "super", "len", "print", "isinstance", "type",
                  "getattr", "setattr", "hasattr", "enumerate", "zip", "list",
                  "tuple", "dict", "set", "int", "float", "bool", "str", "max",
@@ -346,14 +622,10 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return node
 
     def _helper_call(self, fn_name, args):
-        return ast.Expr(value=ast.Call(
-            func=ast.Attribute(value=_name(_HELPER), attr=fn_name, ctx=ast.Load()),
-            args=args, keywords=[]))
+        return ast.Expr(value=_helper_expr(fn_name, args))
 
-    def visit_If(self, node):
-        self.generic_visit(node)
-        if _has_blockers(node.body) or _has_blockers(node.orelse):
-            return node
+    def _convert_if(self, node):
+        """The core if-conversion; `node`'s arms must be blocker-free."""
         varlist = sorted(_assigned(node.body) | _assigned(node.orelse))
         if not varlist:
             return node
@@ -369,13 +641,53 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         call = self._helper_call("convert_ifelse", [
             node.test,
             _name(true_fn.name), _name(false_fn.name),
-            _name(get.name), _name(set_.name)])
+            _name(get.name), _name(set_.name), _names_const(varlist)])
         return inits + [true_fn, false_fn, get, set_, call]
+
+    def visit_If(self, node):
+        self.generic_visit(node)
+        # terminal-return if (produced by _restructure_returns): both arms
+        # end with Return — strip them into a merged return variable
+        if (node.body and isinstance(node.body[-1], ast.Return)
+                and node.orelse and isinstance(node.orelse[-1], ast.Return)
+                and not _has_blockers(node.body[:-1])
+                and not _has_blockers(node.orelse[:-1])):
+            retv = f"_pt_ret{self.idx}"
+            def _arm(stmts):
+                val = stmts[-1].value or ast.Constant(value=None)
+                return stmts[:-1] + [ast.Assign(
+                    targets=[_name(retv, ast.Store())], value=val)]
+            node2 = ast.If(test=node.test, body=_arm(node.body),
+                           orelse=_arm(node.orelse))
+            out = self._convert_if(node2)
+            out = out if isinstance(out, list) else [out]
+            return out + [ast.Return(value=_name(retv))]
+        if _has_blockers(node.body) or _has_blockers(node.orelse):
+            return node
+        return self._convert_if(node)
+
+    def _prep_loop(self, node, extra_tail=None):
+        """Rewrite this loop's break/continue into carried flags.  Returns
+        (loop_node, pre_stmts).  `extra_tail` (the for-range increment) runs
+        at the end of every non-broken iteration — including `continue`d
+        ones, matching Python's for semantics."""
+        if not _has_bc(node.body):
+            body = list(node.body) + list(extra_tail or [])
+            return ast.While(test=node.test, body=body, orelse=[]), []
+        i = self.idx
+        self.idx += 1
+        brk, cnt = f"_pt_brk{i}", f"_pt_cnt{i}"
+        body = _rewrite_bc(node.body, brk, cnt)
+        body = [_flag_set(cnt, False)] + body
+        if extra_tail:
+            body.append(ast.If(test=_helper_expr("not_flag", [_name(brk)]),
+                               body=list(extra_tail), orelse=[]))
+        test = _helper_expr("and_not", [node.test, _name(brk)])
+        return ast.While(test=test, body=body, orelse=[]), [_flag_set(brk, False)]
 
     def visit_For(self, node):
         """`for i in range(...)` desugars to a while (then converts like one);
         any other iterable keeps Python semantics (trace-unrolled)."""
-        self.generic_visit(node)
         if (node.orelse
                 or not isinstance(node.target, ast.Name)
                 or not isinstance(node.iter, ast.Call)
@@ -383,7 +695,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                 or node.iter.func.id != "range"
                 or node.iter.keywords
                 or not 1 <= len(node.iter.args) <= 3
-                or _has_blockers(node.body, in_loop=True)):
+                or _has_ret_yield(node.body)):
+            self.generic_visit(node)
             return node
         i = self.idx  # unique temp-name suffix (shared counter)
         self.idx += 1
@@ -407,18 +720,23 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                                comparators=[_name(stop_n)]))
         incr = ast.AugAssign(target=_name(it, ast.Store()), op=ast.Add(),
                              value=_name(step_n))
-        loop = ast.While(test=test, body=node.body + [incr], orelse=[])
+        loop = ast.While(test=test, body=node.body, orelse=[])
+        loop, pre = self._prep_loop(loop, extra_tail=[incr])
+        self.generic_visit(loop)
         out = self.visit_While(loop, skip_children=True)
-        return assigns + (out if isinstance(out, list) else [out])
+        return assigns + pre + (out if isinstance(out, list) else [out])
 
     def visit_While(self, node, skip_children=False):
+        pre = []
         if not skip_children:
+            if node.orelse or _has_ret_yield(node.body):
+                self.generic_visit(node)
+                return node
+            node, pre = self._prep_loop(node)
             self.generic_visit(node)
-        if node.orelse or _has_blockers(node.body, in_loop=True):
-            return node
         varlist = sorted(_assigned(node.body))
         if not varlist:
-            return node
+            return pre + [node] if pre else node
         i = self.idx
         self.idx += 1
         inits = [_guard_init(v) for v in varlist]
@@ -428,8 +746,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         get, set_ = _get_set_defs(i, varlist)
         call = self._helper_call("convert_while", [
             _name(test_fn.name), _name(body_fn.name),
-            _name(get.name), _name(set_.name)])
-        return inits + [test_fn, body_fn, get, set_, call]
+            _name(get.name), _name(set_.name), _names_const(varlist)])
+        return pre + inits + [test_fn, body_fn, get, set_, call]
 
 
 def _needs_conversion(tree):
@@ -460,6 +778,7 @@ def convert_control_flow(fn):
     if not _needs_conversion(fdef):
         return fn
     fdef.decorator_list = []  # don't re-apply @to_static etc. on exec
+    fdef.body = _restructure_returns(fdef.body)
     new_body = _ControlFlowTransformer().visit(fdef)
     ast.fix_missing_locations(tree)
 

@@ -72,12 +72,15 @@ class FakeQuantMovingAverageAbsMax(Layer):
         super().__init__()
         self._moving_rate = moving_rate
         self._quant_bits = quant_bits
+        # set by PTQ convert(): a frozen scale never resumes its EMA, even if
+        # the model is put back into train() mode for QAT fine-tuning
+        self._frozen = False
         self.register_buffer("scale", Tensor(jnp.zeros([], jnp.float32)))
         self.register_buffer("state", Tensor(jnp.zeros([], jnp.float32)))
         self.register_buffer("accum", Tensor(jnp.zeros([], jnp.float32)))
 
     def forward(self, x):
-        if self.training:
+        if self.training and not self._frozen:
             r = self._moving_rate
             cur = jnp.max(jnp.abs(x._value.astype(jnp.float32)))
             state = self.state._value * r + 1.0

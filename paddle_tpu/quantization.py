@@ -96,17 +96,22 @@ class PTQConfig:
 
 
 class _ObservedLayer(Layer):
-    """Wrap a layer with an output observer during PTQ calibration."""
+    """Wrap a layer with input AND output observers during PTQ calibration
+    (ref ptq_hooks.py: in_act_quantizer / out_act_quantizer are sampled
+    separately — the frozen input scale must reflect input statistics)."""
 
     def __init__(self, inner, moving_rate):
         super().__init__()
         self._inner = inner
+        self._in_observer = MovingAverageAbsMaxScale(moving_rate=moving_rate)
         self._observer = MovingAverageAbsMaxScale(moving_rate=moving_rate)
 
     def forward(self, *args, **kwargs):
-        out = self._inner(*args, **kwargs)
         from .tensor.tensor import Tensor
 
+        if args and isinstance(args[0], Tensor):
+            args = (self._in_observer(args[0]),) + args[1:]
+        out = self._inner(*args, **kwargs)
         if isinstance(out, Tensor):
             return self._observer(out)
         return out
@@ -142,14 +147,18 @@ class ImperativePTQ:
                         weight_bits=self.cfg.quant_bits,
                         activation_bits=self.cfg.quant_bits,
                         moving_rate=self.cfg.moving_rate)
-                    # freeze the calibrated activation scale into the input
-                    # quanter and put it in eval mode so it stops moving
+                    # freeze the INPUT-observed scale into the input quanter
+                    # (ref ptq.py uses in_act_quantizer thresholds for input
+                    # quantization — output stats are the wrong tensor) and
+                    # mark it frozen so a later model.train() (QAT fine-tune
+                    # after PTQ) cannot resume the EMA over it
                     fq = wrapper._fake_quant_input
                     if fq is not None and hasattr(fq, "scale"):
-                        fq.scale.set_value(sub._observer.scale._value)
+                        fq.scale.set_value(sub._in_observer.scale._value)
                         if hasattr(fq, "state"):
-                            fq.state.set_value(sub._observer.state._value)
-                            fq.accum.set_value(sub._observer.accum._value)
+                            fq.state.set_value(sub._in_observer.state._value)
+                            fq.accum.set_value(sub._in_observer.accum._value)
+                        fq._frozen = True
                         fq.eval()
                     layer._sub_layers[name] = wrapper
         return model

@@ -159,7 +159,9 @@ def multi_dot(tensors, name=None):
 def lu(x, pivot=True, get_infos=False):
     def _f(v):
         lu_, piv = jax.scipy.linalg.lu_factor(v)
-        return lu_, piv.astype(jnp.int32)
+        # LAPACK 1-based ipiv (the reference lu op's documented convention);
+        # scipy returns 0-based, shift up so saved pivots interop with Paddle
+        return lu_, piv.astype(jnp.int32) + 1
 
     out = apply_op(_f, (x,), name="lu")
     if get_infos:
@@ -174,7 +176,8 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
     (ref tensor/linalg.py lu_unpack over the lu_unpack op).
 
     `x` is the [.., n, n] packed LU from `lu()`, `y` the pivot-row indices
-    (LAPACK ipiv convention: row i was swapped with row y[i])."""
+    (LAPACK **1-based** ipiv convention, as `lu()` returns: row i was swapped
+    with row y[i]-1)."""
     def _plu(lu_v, piv):
         if lu_v.ndim > 2:
             return jax.vmap(_plu)(lu_v, piv)
@@ -194,7 +197,9 @@ def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
         P = jnp.zeros((n, n), lu_v.dtype).at[perm, jnp.arange(n)].set(1.0)
         return P, L, U
 
-    P, L, U = apply_op(lambda a, b: _plu(a, b), (x, y), name="lu_unpack")
+    # 1-based LAPACK ipiv (lu()'s convention) -> 0-based row indices, once,
+    # outside the batch recursion
+    P, L, U = apply_op(lambda a, b: _plu(a, b - 1), (x, y), name="lu_unpack")
     return P, L, U
 
 

@@ -478,20 +478,49 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
             tcls[b, a, gj, gi, :] = smooth
             tcls[b, a, gj, gi, int(gl[b, m])] = 1.0 - smooth
 
+    gtb = jnp.asarray(gt.astype(np.float32))          # [B, M, 4] normalized cx,cy,w,h
+    gt_valid = jnp.asarray((gt[:, :, 2] > 0) & (gt[:, :, 3] > 0))  # [B, M]
+    aw_m = jnp.asarray(np.asarray([a for a, _ in masked_anchors], np.float32))
+    ah_m = jnp.asarray(np.asarray([a for _, a in masked_anchors], np.float32))
+
     def _f(v):
         p = v.reshape(B, n_anch, 5 + class_num, H, W)
-        px = jax.nn.sigmoid(p[:, :, 0])
-        py = jax.nn.sigmoid(p[:, :, 1])
+        x_logit, y_logit = p[:, :, 0], p[:, :, 1]
         pw = p[:, :, 2]
         ph = p[:, :, 3]
         pobj = p[:, :, 4]
         pcls = p[:, :, 5:].transpose(0, 1, 3, 4, 2)
         obj = jnp.asarray(tobj)
         sc = jnp.asarray(tscale)
-        loss_xy = (sc * obj * ((px - txy[..., 0]) ** 2 + (py - txy[..., 1]) ** 2)).sum((1, 2, 3))
-        loss_wh = (sc * obj * ((pw - twh[..., 0]) ** 2 + (ph - twh[..., 1]) ** 2)).sum((1, 2, 3))
         bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(jnp.exp(-jnp.abs(z)))  # noqa: E731
-        loss_obj = (bce(pobj, obj) * jnp.where(obj > 0, 1.0, 1.0)).sum((1, 2, 3))
+        # xy: sigmoid cross-entropy on the raw logits vs the [0,1] cell offset
+        # (ref kernel SigmoidCrossEntropy); wh: L1 (ref kernel's abs-diff term)
+        loss_xy = (sc * obj * (bce(x_logit, txy[..., 0]) + bce(y_logit, txy[..., 1]))).sum((1, 2, 3))
+        loss_wh = (sc * obj * (jnp.abs(pw - twh[..., 0]) + jnp.abs(ph - twh[..., 1]))).sum((1, 2, 3))
+        # objectness ignore mask (ref CalcObjnessLoss): decode every predicted
+        # box (stop-gradient — target assignment is bookkeeping, not a grad
+        # path), IoU it against all gt boxes; negatives whose best IoU exceeds
+        # ignore_thresh are excluded from the no-object loss.
+        sg = jax.lax.stop_gradient
+        bx = (jnp.arange(W, dtype=jnp.float32) + jax.nn.sigmoid(sg(x_logit))) / W
+        by = (jnp.arange(H, dtype=jnp.float32)[:, None] + jax.nn.sigmoid(sg(y_logit))) / H
+        bw = jnp.exp(jnp.clip(sg(pw), -20, 20)) * aw_m[None, :, None, None] / in_w
+        bh = jnp.exp(jnp.clip(sg(ph), -20, 20)) * ah_m[None, :, None, None] / in_h
+        px1, px2 = bx - bw / 2, bx + bw / 2
+        py1, py2 = by - bh / 2, by + bh / 2
+        gx1 = (gtb[:, :, 0] - gtb[:, :, 2] / 2)[:, None, None, None, :]  # [B,1,1,1,M]
+        gx2 = (gtb[:, :, 0] + gtb[:, :, 2] / 2)[:, None, None, None, :]
+        gy1 = (gtb[:, :, 1] - gtb[:, :, 3] / 2)[:, None, None, None, :]
+        gy2 = (gtb[:, :, 1] + gtb[:, :, 3] / 2)[:, None, None, None, :]
+        iw = jnp.maximum(jnp.minimum(px2[..., None], gx2) - jnp.maximum(px1[..., None], gx1), 0.0)
+        ih = jnp.maximum(jnp.minimum(py2[..., None], gy2) - jnp.maximum(py1[..., None], gy1), 0.0)
+        inter = iw * ih
+        union = (bw * bh)[..., None] + (gtb[:, :, 2] * gtb[:, :, 3])[:, None, None, None, :] - inter
+        iou = jnp.where(gt_valid[:, None, None, None, :], inter / jnp.maximum(union, 1e-10), 0.0)
+        best_iou = iou.max(-1) if gt.shape[1] else jnp.zeros_like(obj)
+        pos = obj > 0
+        keep = pos | (best_iou <= ignore_thresh)
+        loss_obj = (bce(pobj, obj) * keep.astype(pobj.dtype)).sum((1, 2, 3))
         loss_cls = (obj[..., None] * bce(pcls, jnp.asarray(tcls))).sum((1, 2, 3, 4))
         return loss_xy + loss_wh + loss_obj + loss_cls
 

@@ -96,27 +96,142 @@ def test_gradients_flow_through_converted_if():
 
 
 def test_one_sided_assignment_raises_clearly():
+    # a var assigned in only one branch has no merged value after the cond:
+    # using it raises an error naming the variable (branch-local temps that
+    # are never used afterwards stay legal)
     @paddle.jit.to_static
     def bad(x):
         if x.sum() > 0:
             y = x * 2
-        return x if "y" not in dir() else y  # never reached under trace
+        return y + 1  # noqa: F821 — the point of the test
 
-    with pytest.raises(Exception, match="only one branch|not defined|ambiguous|assigned"):
+    with pytest.raises(Exception, match="'y'.*only one branch|only one branch.*'y'"):
         bad(A)
 
 
-def test_return_in_branch_falls_back_to_python():
-    # a `return` inside the branch blocks conversion; a traced condition then
-    # raises the honest Tensor-bool error instead of silently mistracing
+def test_branch_local_temp_is_legal():
+    # the same one-sided assignment is fine when the temp is consumed
+    # INSIDE the branch only
+    @paddle.jit.to_static
+    def ok(x):
+        out = x
+        if x.sum() > 0:
+            t = x * 2
+            out = t + 1
+        return out
+
+    np.testing.assert_allclose(np.asarray(ok(A)._value), np.asarray(A._value) * 2 + 1)
+
+
+def test_return_in_branch_converts():
+    """Early `return` in a Tensor-condition branch compiles to a lax.cond
+    merge (ref return_transformer.py shapes)."""
     @paddle.jit.to_static
     def r(x):
         if x.sum() > 0:
             return x * 2
         return x - 1
 
-    with pytest.raises(Exception):
-        r(A)
+    pos = paddle.to_tensor(np.asarray([1.0, 2.0], np.float32))
+    neg = paddle.to_tensor(np.asarray([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(np.asarray(r(pos)._value), [2.0, 4.0])
+    np.testing.assert_allclose(np.asarray(r(neg)._value), [-2.0, -3.0])
+    assert r._compile_count == 1  # one program serves both predicates
+
+
+def test_return_followed_by_code():
+    """Code after the returning `if` is pushed into the non-returning arm."""
+    @paddle.jit.to_static
+    def r(x):
+        if x.sum() < 0:
+            return x * 0
+        y = x + 1
+        if y.sum() > 10:
+            return y * 10
+        return y
+
+    small = paddle.to_tensor(np.asarray([1.0], np.float32))
+    big = paddle.to_tensor(np.asarray([100.0], np.float32))
+    neg = paddle.to_tensor(np.asarray([-5.0], np.float32))
+    np.testing.assert_allclose(np.asarray(r(small)._value), [2.0])
+    np.testing.assert_allclose(np.asarray(r(big)._value), [1010.0])
+    np.testing.assert_allclose(np.asarray(r(neg)._value), [0.0])
+
+
+def test_while_break():
+    """`break` under a Tensor condition compiles to a carried flag
+    (ref break_continue_transformer.py)."""
+    @paddle.jit.to_static
+    def f(x, limit):
+        i = paddle.zeros([], "float32")
+        s = paddle.zeros([], "float32")
+        while i < 100.0:
+            s = s + x.sum()
+            i = i + 1.0
+            if s > limit:
+                break
+        return s, i
+
+    x = paddle.to_tensor(np.asarray([2.0], np.float32))
+    s, i = f(x, paddle.to_tensor(np.asarray(7.0, np.float32)))
+    assert float(s.item()) == 8.0 and float(i.item()) == 4.0
+    s, i = f(x, paddle.to_tensor(np.asarray(3.0, np.float32)))
+    assert float(s.item()) == 4.0 and float(i.item()) == 2.0
+    assert f._compile_count == 1
+
+
+def test_while_continue():
+    @paddle.jit.to_static
+    def f(n):
+        i = paddle.zeros([], "float32")
+        s = paddle.zeros([], "float32")
+        while i < n:
+            i = i + 1.0
+            if i % 2.0 == 0.0:
+                continue
+            s = s + i
+        return s
+
+    # 1+3+5+7+9 = 25
+    out = f(paddle.to_tensor(np.asarray(10.0, np.float32)))
+    assert float(out.item()) == 25.0
+
+
+def test_for_range_break_continue():
+    """break+continue in a converted for-range: the increment still runs on
+    `continue` (Python for semantics) and the loop exits on `break`."""
+    @paddle.jit.to_static
+    def f(x, stop_at):
+        s = paddle.zeros([], "float32")
+        for i in range(10):
+            if x.sum() * 0 + i == 3.0:   # tensor condition
+                continue
+            if s > stop_at:
+                break
+            s = s + 1.0
+        return s
+
+    x = paddle.to_tensor(np.asarray([1.0], np.float32))
+    # skips i==3; breaks once s exceeds stop_at
+    out = f(x, paddle.to_tensor(np.asarray(100.0, np.float32)))
+    assert float(out.item()) == 9.0
+    out = f(x, paddle.to_tensor(np.asarray(4.5, np.float32)))
+    assert float(out.item()) == 5.0
+
+
+def test_loop_local_use_after_loop_raises_clearly():
+    """A var first assigned inside a compiled while has no post-loop value;
+    using it afterwards names the variable in the error."""
+    @paddle.jit.to_static
+    def f(x):
+        i = paddle.zeros([], "float32")
+        while i < 3.0:
+            tmp = x * 2
+            i = i + tmp.sum() * 0 + 1.0
+        return tmp * 1  # noqa: F821 — the point of the test
+
+    with pytest.raises(Exception, match="tmp"):
+        f(paddle.to_tensor(np.asarray([1.0], np.float32)))
 
 
 def test_tensor_range_for_dynamic_trip_count():
