@@ -530,6 +530,25 @@ def _has_bc(stmts):
     return v.found
 
 
+def _bc_rewritable(stmts):
+    """True when every break/continue of THIS loop sits under plain
+    If-chains only — the shapes _rewrite_bc handles.  A break inside
+    try/with/except stays un-rewritten (it would be a SyntaxError in the
+    extracted body function), so such loops keep Python semantics."""
+    for s in stmts:
+        if isinstance(s, (ast.Break, ast.Continue)):
+            continue
+        if isinstance(s, ast.If):
+            if not _bc_rewritable(s.body) or not _bc_rewritable(s.orelse):
+                return False
+            continue
+        if isinstance(s, (ast.While, ast.For)):
+            continue  # nested loop owns its break/continue
+        if _has_bc([s]):  # try/with/match... containing this loop's b/c
+            return False
+    return True
+
+
 # ---- early-return restructuring (ref return_transformer.py, done by
 # pushing trailing code into the non-returning arm so both lax.cond
 # branches produce the return value)
@@ -674,6 +693,8 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if not _has_bc(node.body):
             body = list(node.body) + list(extra_tail or [])
             return ast.While(test=node.test, body=body, orelse=[]), []
+        if not _bc_rewritable(node.body):
+            return None, None  # caller leaves the loop as plain Python
         i = self.idx
         self.idx += 1
         brk, cnt = f"_pt_brk{i}", f"_pt_cnt{i}"
@@ -722,6 +743,9 @@ class _ControlFlowTransformer(ast.NodeTransformer):
                              value=_name(step_n))
         loop = ast.While(test=test, body=node.body, orelse=[])
         loop, pre = self._prep_loop(loop, extra_tail=[incr])
+        if loop is None:  # break/continue in a non-rewritable position
+            self.generic_visit(node)
+            return node
         self.generic_visit(loop)
         out = self.visit_While(loop, skip_children=True)
         return assigns + pre + (out if isinstance(out, list) else [out])
@@ -732,7 +756,11 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             if node.orelse or _has_ret_yield(node.body):
                 self.generic_visit(node)
                 return node
-            node, pre = self._prep_loop(node)
+            new_node, pre = self._prep_loop(node)
+            if new_node is None:  # break/continue in a non-rewritable position
+                self.generic_visit(node)
+                return node
+            node = new_node
             self.generic_visit(node)
         varlist = sorted(_assigned(node.body))
         if not varlist:

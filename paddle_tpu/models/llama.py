@@ -111,9 +111,31 @@ class LlamaAttention(nn.Layer):
         this call's own k/v."""
         rope_cos, rope_sin = rope
         B, S = hidden_states.shape[0], hidden_states.shape[1]
-        q = self.q_proj(hidden_states).reshape([B, S, self.num_heads, self.head_dim])
-        k = self.k_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
-        v = self.v_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
+        fusable = (type(self.q_proj) is nn.Linear and type(self.k_proj) is nn.Linear
+                   and type(self.v_proj) is nn.Linear)  # not wrapped (quant etc.)
+        if S == 1 and fusable:
+            # decode step: ONE fused qkv gemv instead of three — at batch<<128
+            # each projection is weight-streaming-bound and per-op latency
+            # dominates; the concat of the (loop-invariant) weights is hoisted
+            # out of the decode scan by XLA LICM, so the fusion costs nothing
+            nq = self.num_heads * self.head_dim
+            nkv = self.num_kv_heads * self.head_dim
+
+            def _fused_qkv(h, wq, wk, wv):
+                w = jnp.concatenate([wq, wk, wv], axis=1)
+                return h @ w.astype(h.dtype)
+
+            qkv = apply_op(_fused_qkv,
+                           (hidden_states, self.q_proj.weight,
+                            self.k_proj.weight, self.v_proj.weight),
+                           name="fused_qkv")
+            q = qkv[:, :, :nq].reshape([B, S, self.num_heads, self.head_dim])
+            k = qkv[:, :, nq:nq + nkv].reshape([B, S, self.num_kv_heads, self.head_dim])
+            v = qkv[:, :, nq + nkv:].reshape([B, S, self.num_kv_heads, self.head_dim])
+        else:
+            q = self.q_proj(hidden_states).reshape([B, S, self.num_heads, self.head_dim])
+            k = self.k_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
+            v = self.v_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
 
         # a 3-tuple cache (k_buf, v_buf, pos) is the STATIC layout used by the
         # compiled generate() loop: fixed-size buffers + in-place scatter, so
@@ -190,6 +212,17 @@ class LlamaMLP(nn.Layer):
             self.down_proj = nn.Linear(inter, h, bias_attr=False)
 
     def forward(self, x):
+        if x.shape[1] == 1 and type(self.gate_proj) is nn.Linear \
+                and type(self.up_proj) is nn.Linear:
+            # decode step: fuse gate+up into one gemv (see fused_qkv note)
+            def _fused_gu(h, wg, wu):
+                w = jnp.concatenate([wg, wu], axis=1)
+                return h @ w.astype(h.dtype)
+
+            gu = apply_op(_fused_gu, (x, self.gate_proj.weight, self.up_proj.weight),
+                          name="fused_gate_up")
+            inter = self.gate_proj.weight.shape[1]
+            return self.down_proj(F.silu(gu[:, :, :inter]) * gu[:, :, inter:])
         return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
 
 

@@ -16,36 +16,47 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     ch_axis = 1 if data_format.startswith("NC") else -1
     use_batch_stats = training and not use_global_stats
 
-    def _f(v, rm, rv, w, b):
+    if use_batch_stats:
+        # batch stats computed ONCE, in f32 (bf16 mean/var loses precision),
+        # shared by the normalization, the backward, and the running-stat
+        # update — the reference kernel's saved_mean/saved_variance contract
+        # (phi BatchNormKernel), and one HBM pass instead of three
+        def _stats(v):
+            ch = ch_axis % v.ndim
+            axes = tuple(i for i in range(v.ndim) if i != ch)
+            vf = v.astype(jnp.float32)
+            return jnp.mean(vf, axis=axes), jnp.var(vf, axis=axes)
+
+        mean_t, var_t = apply_op(_stats, (x,), name="batch_norm_stats")
+    else:
+        mean_t, var_t = running_mean, running_var
+
+    def _f(v, m, s, w, b):
+        # collapse to a per-channel affine in f32, then one fused
+        # multiply-add over the activation in its own dtype
+        scale = jax.lax.rsqrt(s.astype(jnp.float32) + epsilon)
+        if w is not None:
+            scale = scale * w.astype(jnp.float32)
+        offset = -m.astype(jnp.float32) * scale
+        if b is not None:
+            offset = offset + b.astype(jnp.float32)
         shape = [1] * v.ndim
         shape[ch_axis] = v.shape[ch_axis]
-        axes = tuple(i for i in range(v.ndim) if i != (ch_axis % v.ndim))
-        if use_batch_stats:
-            mean = jnp.mean(v, axis=axes)
-            var = jnp.var(v, axis=axes)
-        else:
-            mean, var = rm, rv
-        inv = jax.lax.rsqrt(var.reshape(shape).astype(v.dtype) + epsilon)
-        out = (v - mean.reshape(shape).astype(v.dtype)) * inv
-        if w is not None:
-            out = out * w.reshape(shape).astype(v.dtype)
-        if b is not None:
-            out = out + b.reshape(shape).astype(v.dtype)
-        return out
+        return v * scale.reshape(shape).astype(v.dtype) \
+            + offset.reshape(shape).astype(v.dtype)
 
-    out = apply_op(_f, (x, running_mean, running_var, weight, bias), name="batch_norm")
+    out = apply_op(_f, (x, mean_t, var_t, weight, bias), name="batch_norm")
 
     if use_batch_stats and isinstance(running_mean, Tensor):
         # functional stat update written back to the buffers (ref BatchNormKernel saved stats)
         v = _unwrap(x)
         ch = ch_axis % v.ndim
-        axes = tuple(i for i in range(v.ndim) if i != ch)
-        mean = jnp.mean(v.astype(jnp.float32), axis=axes)
-        var = jnp.var(v.astype(jnp.float32), axis=axes)
         n = 1
-        for i in axes:
-            n *= v.shape[i]
-        unbiased = var * (n / max(n - 1, 1))
+        for i in range(v.ndim):
+            if i != ch:
+                n *= v.shape[i]
+        mean = _unwrap(mean_t)
+        unbiased = _unwrap(var_t) * (n / max(n - 1, 1))
         running_mean.set_value(momentum * _unwrap(running_mean) + (1 - momentum) * mean)
         running_var.set_value(momentum * _unwrap(running_var) + (1 - momentum) * unbiased)
     return out

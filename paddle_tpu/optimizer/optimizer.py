@@ -190,7 +190,16 @@ class Optimizer:
     clear_gradients = clear_grad
 
     def minimize(self, loss, startup_program=None, parameters=None, no_grad_set=None):
-        """Ref optimizer.py:1167 — backward + step."""
+        """Ref optimizer.py:1167 — backward + step.  Under static-graph
+        capture this RECORDS the training objective on the current Program
+        instead of stepping eagerly (the reference appends backward + update
+        ops to the ProgramDesc here); Executor.run then compiles
+        forward+grad+update as one XLA program."""
+        from ..static import program as _prog
+
+        if _prog.capture_active():
+            _prog.current_program()._set_objective(loss, self)
+            return None, None
         loss.backward()
         self.step()
         return None, None

@@ -109,9 +109,21 @@ class _ObservedLayer(Layer):
     def forward(self, *args, **kwargs):
         from .tensor.tensor import Tensor
 
-        if args and isinstance(args[0], Tensor):
-            args = (self._in_observer(args[0]),) + args[1:]
-        out = self._inner(*args, **kwargs)
+        # observe the first Tensor however it was passed (positional or
+        # kwarg) — a missed observation would freeze a 0.0 input scale
+        observed = False
+        new_args = []
+        for a in args:
+            if not observed and isinstance(a, Tensor):
+                a = self._in_observer(a)
+                observed = True
+            new_args.append(a)
+        if not observed:
+            for k, v in kwargs.items():
+                if isinstance(v, Tensor):
+                    kwargs[k] = self._in_observer(v)
+                    break
+        out = self._inner(*new_args, **kwargs)
         if isinstance(out, Tensor):
             return self._observer(out)
         return out

@@ -15,56 +15,90 @@ import jax.numpy as jnp
 
 from ..tensor.tensor import Tensor, apply_op
 from ..jit import InputSpec  # noqa: F401
+from .program import (  # noqa: F401
+    Program,
+    program_guard,
+    default_main_program,
+    default_startup_program,
+    _activate,
+    capture_active,
+    current_program,
+    static_data,
+)
 
 _static_mode = False
 
 
 def enable_static():
+    """Enter static-graph mode: ops now RECORD onto the default main program
+    while executing eagerly on placeholder values (ref enable_static switches
+    the global tracer into ProgramDesc capture)."""
     global _static_mode
+    from . import program as _prog_mod
+
     _static_mode = True
+    _prog_mod._static_mode_on = True
+    _activate(default_main_program())
 
 
 def disable_static():
     global _static_mode
+    from . import program as _prog_mod
+
     _static_mode = False
+    _prog_mod._static_mode_on = False
+    _activate(None)
 
 
 def in_static_mode():
     return _static_mode
 
 
-class Program:  # minimal placeholder graph object
-    def __init__(self):
-        self.ops = []
-
-    def global_block(self):
-        return self
-
-    def clone(self, for_test=False):
-        return self
-
-
-def default_main_program():
-    return Program()
-
-
-def default_startup_program():
-    return Program()
-
-
 def data(name, shape, dtype="float32", lod_level=0):
+    """A feed placeholder.  Under static mode / program_guard it becomes a
+    feed node of the current Program; otherwise it degrades to an InputSpec
+    for the to_static path."""
+    if _static_mode or capture_active():
+        return static_data(name, shape, dtype)
     return InputSpec(shape, dtype, name)
 
 
+class _LoadedProgram:
+    """The triple returned by load_inference_model, runnable by Executor."""
+
+    def __init__(self, layer, feed_names, fetch_count):
+        self.layer = layer
+        self.feed_names = list(feed_names)
+        self.fetch_count = fetch_count
+
+
 class Executor:
+    """Compile-and-run front end (ref executor.py:1104 Executor.run)."""
+
     def __init__(self, place=None):
         self.place = place
 
     def run(self, program=None, feed=None, fetch_list=None, **kwargs):
-        if callable(program):
+        import numpy as _np2
+
+        if isinstance(program, CompiledProgram):
+            program = program.program
+        if callable(program) and not isinstance(program, Program):
             out = program(**(feed or {}))
             return out if isinstance(out, (list, tuple)) else [out]
-        return []
+        if isinstance(program, _LoadedProgram):
+            args = [Tensor(jnp.asarray(_np2.asarray((feed or {})[n])))
+                    for n in program.feed_names]
+            out = program.layer(*args)
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            return [_np2.asarray(o._value) for o in outs]
+        if program is None:
+            program = default_main_program()
+        if not isinstance(program, Program):
+            return []
+        if not program._nodes and not (fetch_list or []):
+            return []  # e.g. exe.run(startup): params initialize eagerly
+        return program.run(feed=feed, fetch_list=fetch_list)
 
 
 class nn:
@@ -117,28 +151,119 @@ def _raw(x):
 
 
 def save(program, model_path, **kwargs):
-    raise NotImplementedError(
-        "paddle.static.save: static Programs have no serialized form on the TPU "
-        "build (a 'program' is a jitted function) — save the Layer with "
-        "paddle.jit.save(layer, path, input_spec=...) or its state with "
-        "paddle.save(layer.state_dict(), path)")
+    """Persist a Program's parameter values (ref static/io.py save)."""
+    import pickle
+
+    import numpy as _np2
+
+    state = {f"param_{j}": _np2.asarray(t._value)
+             for j, t in enumerate(program._lives) if not t.stop_gradient}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(state, f)
 
 
 def load(program, model_path, **kwargs):
-    raise NotImplementedError(
-        "paddle.static.load: use paddle.jit.load(path) for deployed programs or "
-        "paddle.load(path) for state dicts")
+    """Restore parameter values saved by static.save into the Program's
+    live parameter leaves (matched positionally, the save-time order)."""
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = pickle.load(f)
+    for j, t in enumerate(program._lives):
+        if not t.stop_gradient and f"param_{j}" in state:
+            t._rebind(jnp.asarray(state[f"param_{j}"]))
 
 
-def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, **kwargs):
-    raise NotImplementedError(
-        "paddle.static.save_inference_model: use paddle.jit.save(layer, "
-        "path_prefix, input_spec=[...]) — the AOT-exported program is the TPU "
-        "inference artifact (loaded by paddle.jit.load or inference.Predictor)")
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, *,
+                         program=None, **kwargs):
+    """AOT-export the captured forward graph (ref static/io.py
+    save_inference_model -> serialized inference ProgramDesc; here the
+    artifact is jax.export StableHLO in the jit.save format, so
+    paddle.jit.load and inference.Predictor both load it)."""
+    import os
+    import pickle
+
+    import numpy as _np2
+
+    feed_vars = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetch_vars = fetch_vars if isinstance(fetch_vars, (list, tuple)) else [fetch_vars]
+    prog = program
+    if prog is None:
+        sym = getattr(fetch_vars[0], "_st_sym", None)
+        if sym is None:
+            raise ValueError("fetch_vars were not built under a static Program")
+        prog = sym[0]
+    from jax import export as jax_export
+
+    feed_names, feed_specs = [], []
+    for fv in feed_vars:
+        name = getattr(fv, "name", None)
+        if name not in prog._feeds:
+            raise ValueError(f"feed var {name!r} is not a static.data of this program")
+        sym_id, shape, dtype = prog._feeds[name]
+        feed_names.append(name)
+        # None dims export shape-polymorphic (one shared batch symbol 'b' —
+        # the jax.export analog of the reference's -1 feed dims)
+        spec = tuple("b" if (d is None or (isinstance(d, int) and d < 0)) else int(d)
+                     for d in shape)
+        feed_specs.append((sym_id, spec, dtype))
+    fetch_syms = prog._resolve_fetch(fetch_vars)
+
+    lives = prog._lives
+    params = {f"v{j}": lives[j]._value for j in range(len(lives))}
+
+    def infer_fn(params, buffers, *feeds):
+        live_vals = [params[f"v{j}"] for j in range(len(lives))]
+        env = {sym_id: f for (sym_id, _, _), f in zip(feed_specs, feeds)}
+        prog._replay(env, live_vals)
+        return tuple(live_vals[s[1]] if isinstance(s, tuple) else env[s]
+                     for s in fetch_syms)
+
+    shapes = []
+    for (_, spec, d) in feed_specs:
+        if any(isinstance(s, str) for s in spec):
+            dims = jax_export.symbolic_shape(
+                ",".join(str(s) for s in spec))
+            shapes.append(jax.ShapeDtypeStruct(dims, jnp.dtype(d)))
+        else:
+            shapes.append(jax.ShapeDtypeStruct(spec, jnp.dtype(d)))
+    exported = jax_export.export(jax.jit(infer_fn))(params, {}, *shapes)
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        f.write(exported.serialize())
+    with open(path_prefix + ".pdiparams", "wb") as f:
+        pickle.dump({k: _np2.asarray(v) for k, v in params.items()}, f)
+    with open(path_prefix + ".pdiparams.info", "wb") as f:
+        pickle.dump({
+            "param_keys": sorted(params, key=lambda k: int(k[1:])),
+            "buffer_keys": [],
+            "inputs": [{"name": n,
+                        "shape": [None if isinstance(s, str) else s for s in c],
+                        "dtype": d}
+                       for n, (_, c, d) in zip(feed_names, feed_specs)],
+            "feed_names": feed_names,
+        }, f)
 
 
 def load_inference_model(path_prefix, executor, **kwargs):
-    raise NotImplementedError("use paddle.jit.load for deployed programs")
+    """Returns [program, feed_names, fetch_count-sized target list] (ref
+    static/io.py load_inference_model); the program runs under
+    Executor.run(program, feed=..., fetch_list=None)."""
+    import pickle
+
+    from ..jit import load as _jit_load
+
+    layer = _jit_load(path_prefix)
+    info = {}
+    try:
+        with open(path_prefix + ".pdiparams.info", "rb") as f:
+            info = pickle.load(f)
+    except OSError:
+        pass
+    feed_names = info.get("feed_names") or [
+        i["name"] for i in info.get("inputs") or []]
+    prog = _LoadedProgram(layer, feed_names, None)
+    return [prog, feed_names, []]
 
 
 # --------------------------------------------------------------- shim surface
@@ -151,11 +276,6 @@ import contextlib as _ctx
 import numpy as _np
 
 from ..tensor.tensor import Tensor as Variable  # noqa: F401  (alias)
-
-
-@_ctx.contextmanager
-def program_guard(main_program=None, startup_program=None):
-    yield
 
 
 @_ctx.contextmanager
@@ -182,8 +302,16 @@ def set_ipu_shard(layer, index=-1, stage=-1):
     return layer
 
 
+class _Scope:
+    def find_var(self, name):
+        return None
+
+    def var(self, name):
+        return None
+
+
 def global_scope():
-    return Program()
+    return _Scope()
 
 
 def cpu_places(device_count=None):

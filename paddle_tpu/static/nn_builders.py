@@ -66,8 +66,10 @@ def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
     lin = _cached(name, f"fc:{in_dim}:{size}",
                   lambda: nn.Linear(in_dim, size, weight_attr=weight_attr,
                                     bias_attr=bias_attr))
-    lead = tuple(int(d) for d in x.shape[:num_flatten_dims])
-    out = lin(x.reshape(list(lead) + [in_dim]))
+    # -1 in the batch position keeps the recorded reshape polymorphic over
+    # the fed batch size (static.data placeholders carry batch=1)
+    lead = [-1] + [int(d) for d in x.shape[1:num_flatten_dims]]
+    out = lin(x.reshape(lead + [in_dim]))
     if activation:
         out = getattr(F, activation)(out)
     return out

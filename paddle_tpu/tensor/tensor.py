@@ -37,6 +37,7 @@ class Tensor:
         "_grad_hooks",
         "sharding_spec",
         "process_mesh",
+        "_st_sym",  # (program, sym_id) when produced under static capture
         "__weakref__",
     )
 
@@ -334,6 +335,9 @@ class Parameter(Tensor):
 
 # AMP autocast hook, registered by paddle_tpu.amp on import (avoids an import cycle).
 _amp_cast_hook = None
+# set by static.program._activate while a Program capture is live: records
+# (pure_fn, tensor_args, raw_kwargs, outputs, name) onto the active Program
+_static_capture_hook = None
 _amp_state_ref = None
 
 
@@ -368,7 +372,10 @@ def apply_op(fn: Callable, args: tuple, kwargs: dict | None = None, name: str = 
 
     if not tape.is_grad_enabled() or not diff_idx:
         out = fn(*raw_args, **raw_kwargs)
-        return _wrap_outputs(out, None, name)
+        res = _wrap_outputs(out, None, name)
+        if _static_capture_hook is not None:
+            _static_capture_hook(fn, args, raw_kwargs, res, name)
+        return res
 
     def closed(*diff_arrays):
         full = list(raw_args)
@@ -383,7 +390,10 @@ def apply_op(fn: Callable, args: tuple, kwargs: dict | None = None, name: str = 
     out_avals = [(o.shape, o.dtype) for o in outs_flat]
     node = tape.TapeNode(vjp_fn, node_inputs, out_avals, name=name, out_is_tuple=is_tuple,
                          primal_fn=closed)
-    return _wrap_outputs(out, node, name)
+    res = _wrap_outputs(out, node, name)
+    if _static_capture_hook is not None:
+        _static_capture_hook(fn, args, raw_kwargs, res, name)
+    return res
 
 
 def _host_nan_check(name, arr):

@@ -1,0 +1,123 @@
+"""The reference's canonical static-graph workflow (ref executor.py:1104,
+framework.py Program): program_guard capture -> per-batch Executor.run with
+feed/fetch -> save_inference_model -> load and serve.  The TPU build records
+the op tape under capture and replays it as one compiled XLA program per
+feed signature (static/program.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def _build_mlp(x, y):
+    """A reference-shaped builder: static.nn.fc layers + loss."""
+    hidden = static.nn.fc(x, size=32, activation="relu")
+    logits = static.nn.fc(hidden, size=4)
+    loss = paddle.nn.functional.cross_entropy(logits, y)
+    return logits, paddle.mean(loss)
+
+
+def test_static_train_loop_converges():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    # 4-class linearly-separable blobs
+    xs = rng.randn(256, 8).astype(np.float32)
+    ys = (xs[:, :4].sum(1) > 0).astype(np.int64) + 2 * (xs[:, 4:].sum(1) > 0).astype(np.int64)
+
+    main = static.Program()
+    startup = static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 8], "float32")
+        y = static.data("y", [None, 1], "int64")
+        logits, loss = _build_mlp(x, y)
+        opt = paddle.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss)
+
+    exe = static.Executor()
+    assert exe.run(startup) == []
+
+    losses = []
+    for step in range(60):
+        i = (step * 64) % 256
+        lv, = exe.run(main, feed={"x": xs[i:i + 64], "y": ys[i:i + 64, None]},
+                      fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < losses[0] * 0.5, losses[::20]
+
+    # a second feed SHAPE compiles a second program, same parameters
+    lv, = exe.run(main, feed={"x": xs[:32], "y": ys[:32, None]}, fetch_list=[loss])
+    assert np.isfinite(lv)
+
+    # clone(for_test=True) shares weights but drops the update
+    test_prog = main.clone(for_test=True)
+    before = np.asarray(main.all_parameters()[0]._value).copy()
+    out1, = exe.run(test_prog, feed={"x": xs[:64], "y": ys[:64, None]},
+                    fetch_list=[logits])
+    after = np.asarray(main.all_parameters()[0]._value)
+    np.testing.assert_array_equal(before, after)
+    assert out1.shape == (64, 4)
+
+
+def test_static_fetch_by_feed_name_and_missing_feed():
+    with static.program_guard(static.Program(), static.Program()):
+        x = static.data("x", [None, 3], "float32")
+        y2 = x * 2.0
+    exe = static.Executor()
+    out, = exe.run(static.default_main_program() if False else y2._st_sym[0],
+                   feed={"x": np.ones((5, 3), np.float32)}, fetch_list=[y2])
+    np.testing.assert_allclose(out, 2.0 * np.ones((5, 3)))
+    with pytest.raises(KeyError, match="missing feed"):
+        y2._st_sym[0].run(feed={}, fetch_list=[y2])
+
+
+def test_static_save_load_inference_model(tmp_path):
+    paddle.seed(1)
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 6], "float32")
+        out = static.nn.fc(x, size=3)
+    exe = static.Executor()
+    xv = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+    ref, = exe.run(main, feed={"x": xv}, fetch_list=[out])
+
+    prefix = str(tmp_path / "inf" / "model")
+    try:
+        static.save_inference_model(prefix, [x], [out], exe)
+    except Exception as e:  # pragma: no cover - platform without export
+        pytest.skip(f"jax.export unavailable: {e!r}")
+    prog, feed_names, _ = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    got, = exe.run(prog, feed={"x": xv})
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_static_program_state_save_load(tmp_path):
+    main = static.Program()
+    with static.program_guard(main, static.Program()):
+        x = static.data("x", [None, 5], "float32")
+        out = static.nn.fc(x, size=2)
+        loss = paddle.mean(out)
+        paddle.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    exe = static.Executor()
+    xv = np.ones((3, 5), np.float32)
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])
+    p = str(tmp_path / "st")
+    static.save(main, p)
+    snap = [np.asarray(t._value).copy() for t in main.all_parameters()]
+    exe.run(main, feed={"x": xv}, fetch_list=[loss])  # mutates params
+    static.load(main, p)
+    for t, s in zip(main.all_parameters(), snap):
+        np.testing.assert_array_equal(np.asarray(t._value), s)
+
+
+def test_enable_static_mode_default_program():
+    paddle.enable_static()
+    try:
+        x = static.data("xx", [None, 2], "float32")
+        y = x + 1.0
+        exe = static.Executor()
+        out, = exe.run(feed={"xx": np.zeros((2, 2), np.float32)}, fetch_list=[y])
+        np.testing.assert_allclose(out, 1.0)
+    finally:
+        paddle.disable_static()
