@@ -135,31 +135,52 @@ class PipelineTrainStep:
 
     # ---------------------------------------------------------------- stacking
     def _try_stack_info(self, chunks, items, named):
-        """Per-stage [(rel_name, flat_name)] if every stage chunk has the same layer
-        structure (param names, shapes, dtypes, trainability) and no buffers."""
+        """(per_stage_params, per_stage_buffers, None) if every stage chunk
+        has the same layer structure (param/buffer names, shapes, dtypes,
+        per-slot trainability); otherwise (None, None, reason).
+
+        Frozen body params ARE stackable (they ride along without grads);
+        body-layer buffers ARE stackable read-only (in-trace buffer writes
+        are dropped, matching the replicated pipeline's semantics).  Tied
+        params ACROSS body stages are the one true fallback — stacking would
+        un-tie them (tying prologue<->epilogue, e.g. embedding<->lm_head,
+        lives outside the body and stacks fine: the shared leaf stays
+        replicated and its shard_map cotangent is psum'd over 'pp', the
+        compiled analog of allreduce_shared_weight_gradients, ref
+        pp_layers.py:162 SharedLayerDesc)."""
         id2flat = {id(p): k for k, p in named.items()}
-        per_stage = []
+        buf_named = dict(self.model.named_buffers())
+        id2buf = {id(b): k for k, b in buf_named.items()}
+        per_stage, per_stage_buf = [], []
         for c in chunks:
-            plist = []
+            plist, blist = [], []
             for j, i in enumerate(c):
                 layer = items[i][0]
                 if not callable(layer) or not hasattr(layer, "named_parameters"):
-                    return None
-                if list(layer.named_buffers()):
-                    return None  # stateful body layers: fall back to replicated
+                    return None, None, (
+                        f"body item {i} is not a Layer with parameters")
                 for pn, p in layer.named_parameters():
                     if id(p) not in id2flat:
-                        return None
+                        return None, None, (
+                            f"body param {pn} not registered on the model")
                     plist.append((f"{j}.{pn}", id2flat[id(p)]))
+                for bn, b in layer.named_buffers():
+                    if id(b) not in id2buf:
+                        return None, None, (
+                            f"body buffer {bn} not registered on the model")
+                    blist.append((f"{j}.{bn}", id2buf[id(b)]))
             per_stage.append(plist)
+            per_stage_buf.append(blist)
         all_flats = [f for plist in per_stage for _, f in plist]
         if len(set(all_flats)) != len(all_flats):
-            return None  # a parameter is shared across stages (tied weights):
-            # stacking would un-tie it; keep the replicated path
+            return None, None, (
+                "a parameter is shared across body stages (intra-body tied "
+                "weights): stacking would un-tie it")
         rels0 = [r for r, _ in per_stage[0]]
-        for plist in per_stage[1:]:
-            if [r for r, _ in plist] != rels0:
-                return None
+        brels0 = [r for r, _ in per_stage_buf[0]]
+        for plist, blist in zip(per_stage[1:], per_stage_buf[1:]):
+            if [r for r, _ in plist] != rels0 or [r for r, _ in blist] != brels0:
+                return None, None, "stage chunks have different layer structures"
         for i in range(len(rels0)):
             p0 = named[per_stage[0][i][1]]
             for plist in per_stage[1:]:
@@ -167,10 +188,18 @@ class PipelineTrainStep:
                 if (p._value.shape != p0._value.shape
                         or p._value.dtype != p0._value.dtype
                         or p.stop_gradient != p0.stop_gradient):
-                    return None
-            if p0.stop_gradient:
-                return None  # frozen body params unsupported in stacked mode
-        return per_stage
+                    return None, None, (
+                        f"param slot {rels0[i]} differs across stages "
+                        "(shape/dtype/trainability)")
+        for i in range(len(brels0)):
+            b0 = buf_named[per_stage_buf[0][i][1]]
+            for blist in per_stage_buf[1:]:
+                b = buf_named[blist[i][1]]
+                if (b._value.shape != b0._value.shape
+                        or b._value.dtype != b0._value.dtype):
+                    return None, None, (
+                        f"buffer slot {brels0[i]} differs across stages")
+        return per_stage, per_stage_buf, None
 
     def sync_model(self):
         """Write the stacked [pp, ...] body weights back into the model's Tensors
@@ -205,10 +234,18 @@ class PipelineTrainStep:
         hid = out_shapes[chunks[-1][-1]]  # [mb, *hidden]
 
         named = dict(model.named_parameters())
-        self._stack_info = self._try_stack_info(chunks, items, named)
+        self._stack_info, self._stack_buf_info, reason = \
+            self._try_stack_info(chunks, items, named)
         if self._stack_info is not None:
             return self._init_stacked(items, prologue, chunks, epilogue, hid,
                                       named, mb, M, S)
+        import warnings
+
+        warnings.warn(
+            "pipeline: falling back to FULLY REPLICATED body weights "
+            f"(per-device bytes = total, not total/pp): {reason}. "
+            "Make the stage chunks homogeneous to restore the stacked "
+            "memory contract.", stacklevel=3)
         trainable = {k for k, p in named.items() if not p.stop_gradient}
         self._opt_state = {k: opt._init_state(named[k]) for k in trainable}
 
@@ -320,12 +357,21 @@ class PipelineTrainStep:
         remat = self._remat
         self.stacked_mode = True
         info = self._stack_info
+        buf_info = self._stack_buf_info
         flat0 = {rel: flat for rel, flat in info[0]}   # template (chunk-0) names
+        bflat0 = {rel: flat for rel, flat in buf_info[0]}
         body_flats = {flat for plist in info for _, flat in plist}
+        body_buf_flats = {flat for blist in buf_info for _, flat in blist}
+        self._body_flats = body_flats
+        self._body_buf_flats = body_buf_flats
+        buf_named = dict(model.named_buffers())
 
         pp_shard = NamedSharding(mesh, P("pp"))
         rep = NamedSharding(mesh, P())
         stacked = {}
+        # rels whose slot is frozen ride along stacked but take no grads/updates
+        self._frozen_rels = {rel for rel, flat in info[0]
+                             if named[flat].stop_gradient}
         for idx, (rel, _) in enumerate(info[0]):
             # stack on host, then place sharded: the full [pp, ...] array never
             # materializes in one device's HBM
@@ -337,13 +383,22 @@ class PipelineTrainStep:
             for s in range(S):
                 named[info[s][idx][1]]._rebind(arrs[s])
         self._stacked = stacked
+        stacked_buf = {}
+        for idx, (rel, _) in enumerate(buf_info[0]):
+            arrs = [np.asarray(buf_named[buf_info[s][idx][1]]._value)
+                    for s in range(S)]
+            stacked_buf[rel] = jax.device_put(np.stack(arrs), pp_shard)
+            for s in range(S):
+                buf_named[buf_info[s][idx][1]]._rebind(arrs[s])
+        self._stacked_buf = stacked_buf
 
         rep_keys = [k for k in named if k not in body_flats]
         trainable = {k for k in rep_keys if not named[k].stop_gradient}
         for k in rep_keys:
             named[k]._rebind(jax.device_put(named[k]._value, rep))
-        for _, b in model.named_buffers():
-            b._rebind(jax.device_put(b._value, rep))
+        for bk, b in buf_named.items():
+            if bk not in body_buf_flats:
+                b._rebind(jax.device_put(b._value, rep))
 
         class _Shim:  # _init_state only reads ._value
             def __init__(self, v):
@@ -361,7 +416,7 @@ class PipelineTrainStep:
         self._opt_state = {
             **{k: jax.device_put(opt._init_state(named[k]), rep) for k in trainable},
             **{"·stack·" + rel: _place_stacked_state(opt._init_state(_Shim(v)))
-               for rel, v in stacked.items()},
+               for rel, v in stacked.items() if rel not in self._frozen_rels},
         }
 
         data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names
@@ -371,9 +426,11 @@ class PipelineTrainStep:
         T = M + S - 1
         body = chunks[0]  # every stage runs the template chunk's program
 
-        def pipeline_loss(rep_params, stk, buffers, xv, yv, key):
+        def pipeline_loss(rep_params, stk, stk_buf, buffers, xv, yv, key):
             local = {flat0[rel]: v[0] for rel, v in stk.items()}  # local [1,...] slice
-            restore = model.bind_functional_state({**rep_params, **local}, buffers)
+            local_buf = {bflat0[rel]: v[0] for rel, v in stk_buf.items()}
+            restore = model.bind_functional_state({**rep_params, **local},
+                                                  {**buffers, **local_buf})
             try:
                 with _random.rng_key_scope(key), tape.no_grad():
                     t = Tensor(xv, stop_gradient=True)
@@ -428,39 +485,43 @@ class PipelineTrainStep:
 
         sharded_loss = jax.shard_map(
             pipeline_loss, mesh=mesh,
-            in_specs=(P(), P("pp"), P(), P(), P(), P()),
+            in_specs=(P(), P("pp"), P("pp"), P(), P(), P(), P()),
             out_specs=P(),
             axis_names={"pp"},
             check_vma=False,
         )
+        frozen_rels = self._frozen_rels
 
-        def step(rep_params, stk, buffers, opt_state, lr, key, xv, yv):
+        def step(rep_params, stk, stk_buf, buffers, opt_state, lr, key, xv, yv):
             t_rep = {k: v for k, v in rep_params.items() if k in trainable}
             frozen = {k: v for k, v in rep_params.items() if k not in trainable}
+            stk_t = {r: v for r, v in stk.items() if r not in frozen_rels}
+            stk_f = {r: v for r, v in stk.items() if r in frozen_rels}
 
             def pure_loss(tp, tstk):
-                return sharded_loss({**tp, **frozen}, tstk, buffers, xv, yv, key)
+                return sharded_loss({**tp, **frozen}, {**tstk, **stk_f},
+                                    stk_buf, buffers, xv, yv, key)
 
             loss, (g_rep, g_stk) = jax.value_and_grad(pure_loss, argnums=(0, 1))(
-                t_rep, stk)
+                t_rep, stk_t)
             pairs = list(g_rep.items()) + [("·stack·" + rel, g)
                                            for rel, g in g_stk.items()]
             clipped = dict(opt._clipped_grads(pairs))
             new_rep = dict(frozen)
-            new_stk = {}
+            new_stk = dict(stk_f)
             new_opt = {}
             for k in trainable:
                 new_rep[k], new_opt[k] = opt._apply_update(
                     rep_params[k], clipped[k], opt_state[k], lr,
                     opt._param_decay_coeff(named[k]))
-            for rel in stk:
+            for rel in stk_t:
                 sk = "·stack·" + rel
                 new_stk[rel], new_opt[sk] = opt._apply_update(
                     stk[rel], clipped[sk], opt_state[sk], lr,
                     opt._param_decay_coeff(named[flat0[rel]]))
             return new_rep, new_stk, new_opt, loss
 
-        donate = (0, 1, 3) if self._donate else ()
+        donate = (0, 1, 4) if self._donate else ()
         self._jitted = jax.jit(step, donate_argnums=donate)
         # any external state read (state_dict / functional_state / checkpoint save)
         # transparently writes the trained stacked weights back first
@@ -479,9 +540,12 @@ class PipelineTrainStep:
         if self.stacked_mode:
             params, buffers = self.model.functional_state(_sync=False)
             rep_params = {k: v for k, v in params.items()
-                          if k not in {f for pl in self._stack_info for _, f in pl}}
+                          if k not in self._body_flats}
+            buffers = {k: v for k, v in buffers.items()
+                       if k not in self._body_buf_flats}
             new_rep, new_stk, new_opt, loss = self._jitted(
-                rep_params, self._stacked, buffers, self._opt_state, lr, key, xv, yv)
+                rep_params, self._stacked, self._stacked_buf, buffers,
+                self._opt_state, lr, key, xv, yv)
             self._stacked = new_stk
             self._opt_state = new_opt
             self.model.load_functional_state(new_rep)

@@ -63,8 +63,21 @@ def _rope_cache(head_dim, max_pos, theta):
     return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
 
 
+def _static_decode_mask(offset, S, L):
+    """Additive causal+padding mask for a static-cache step: queries at
+    pos offset+i see keys j <= offset+i; the padded tail is masked."""
+    jpos = jnp.arange(L)[None, :]
+    qpos = jnp.arange(S)[:, None] + offset
+    return jnp.where(jpos <= qpos, 0.0, -1e9)[None, None]
+
+
 def apply_rope(x, cos, sin, position_offset=0):
-    """x: [B, S, H, D] raw array; rotate pairs (x1,x2) per RoPE.
+    """x: [B, S, H, D] raw array; rotate-half RoPE — pairs (x_i, x_{i+D/2}).
+    Contiguous half-splits instead of stride-2 interleaving: on TPU the
+    lane-dim strided gather + stack materializes [., D/2, 2] copies in the
+    decode scan body (each one a serial kernel dispatch); the half-split
+    form fuses clean.  Attention scores are identical under either pairing
+    since q and k share the permutation.
     position_offset may be a traced scalar (static-cache decode)."""
     S, D = x.shape[1], x.shape[-1]
     if isinstance(position_offset, (int, np.integer)):
@@ -77,11 +90,8 @@ def apply_rope(x, cos, sin, position_offset=0):
         s = jax.lax.dynamic_slice_in_dim(sin, position_offset, S, 0)
     c = c[None, :, None, :]  # [1,S,1,D/2]
     s = s[None, :, None, :]
-    x1, x2 = x[..., 0::2], x[..., 1::2]
-    o1 = x1 * c - x2 * s
-    o2 = x2 * c + x1 * s
-    out = jnp.stack([o1, o2], axis=-1).reshape(x.shape)
-    return out
+    x1, x2 = x[..., :D // 2], x[..., D // 2:]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
 class LlamaAttention(nn.Layer):
@@ -158,10 +168,7 @@ class LlamaAttention(nn.Layer):
             new_cache = (k_buf, v_buf, offset + S)
             L = k_buf.shape[1]
             if attn_mask is None:
-                # queries at pos offset+i see keys j <= offset+i; padding masked
-                jpos = jnp.arange(L)[None, :]
-                qpos = jnp.arange(S)[:, None] + offset
-                attn_mask = Tensor(jnp.where(jpos <= qpos, 0.0, -1e9)[None, None])
+                attn_mask = Tensor(_static_decode_mask(offset, S, L))
             k, v = k_buf, v_buf
         else:
             if cache is not None:
@@ -270,6 +277,12 @@ class LlamaModel(nn.Layer):
             caches = [None] * len(self.layers)
         x = self.embed_tokens(input_ids)
         rope = (self.rope_cos, self.rope_sin)
+        if (attn_mask is None and caches is not None and caches[0] is not None
+                and len(caches[0]) == 3):
+            # static-cache decode: the causal/padding mask is identical for
+            # every layer — build it ONCE per step, not 12x in the scan body
+            attn_mask = Tensor(_static_decode_mask(
+                caches[0][2], input_ids.shape[1], caches[0][0].shape[1]))
         new_caches = [] if use_cache else None
         for i, layer in enumerate(self.layers):
             if use_cache:
