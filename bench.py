@@ -20,6 +20,34 @@ import numpy as np
 
 V5E_PEAK_FLOPS = 197e12  # bf16, one v5e chip (nominal)
 
+_RTT_S = 0.0  # measured dispatch+sync round-trip of the attached chip
+
+
+def _measure_rtt():
+    """The tunneled chip pays ~100ms dispatch+sync latency PER HOST SYNC —
+    every single-sync timing window is inflated by this constant.  Measure
+    it once (tiny jit call) and subtract it from every window below;
+    otherwise small probes read as latency, not compute (the r2 conv
+    'ceiling' of 7.5 TF/s was exactly this artifact)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((8, 8), jnp.float32)
+
+    @jax.jit
+    def f(x):
+        return x + 1.0
+
+    _ = np.asarray(f(x))
+    samples = []
+    for _i in range(5):
+        t0 = time.perf_counter()
+        _ = np.asarray(f(x))
+        samples.append(time.perf_counter() - t0)
+    return sorted(samples)[len(samples) // 2]
+
 
 def _measure_gemm_peak():
     """Measured bf16 gemm ceiling of the attached chip (TF/s): a 30-deep
@@ -54,6 +82,7 @@ def _measure_gemm_peak():
         r = chain(x, w)
         float(jnp.sum(r[:1, :1].astype(jnp.float32)))
         best = min(best, time.perf_counter() - t0)
+    best = max(best - _RTT_S, 1e-6)  # remove the per-sync tunnel latency
     return 2 * n * n * n * iters / best / 1e12
 
 
@@ -70,7 +99,7 @@ def _measure_conv_peak():
     import jax.numpy as jnp
     from jax import lax
 
-    B, iters = 128, 12
+    B, iters = 128, 30
     rng = np.random.RandomState(0)
     total_flops = 0.0
     total_dt = 0.0
@@ -95,7 +124,7 @@ def _measure_conv_peak():
             float(jnp.sum(r[:1, :1, :1, :1].astype(jnp.float32)))
             best = min(best, time.perf_counter() - t0)
         total_flops += 2 * B * H * H * C * C * 9 * iters
-        total_dt += best
+        total_dt += max(best - _RTT_S, 1e-6)  # remove per-sync tunnel latency
     return total_flops / total_dt / 1e12
 
 
@@ -145,7 +174,8 @@ def _bench_llama(on_accel):
             loss = step(ids, labels)
         float(loss.item())
         windows.append(time.perf_counter() - t0)
-    dt = sorted(windows)[1]
+    # median window minus the ONE host sync's tunnel latency it contains
+    dt = max(sorted(windows)[1] - _RTT_S, 1e-6)
 
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tokens = batch * seq
@@ -198,7 +228,7 @@ def _bench_decode(on_accel):
             out = model.generate(ids, max_new_tokens=ntok)
             _ = np.asarray(out._value)
             best = min(best, time.perf_counter() - t0)
-        return best
+        return max(best - _RTT_S, 1e-6)
 
     dt = timed(new_tokens)
     res = {"llama_decode_tokens_per_sec": round(batch * new_tokens / dt, 1),
@@ -208,7 +238,9 @@ def _bench_decode(on_accel):
         # weight+kv-streaming roofline at the chip's MEASURED stream rate
         dt_half = timed(new_tokens // 2)
         per_tok = (dt - dt_half) / (new_tokens - new_tokens // 2)
-        res["llama_decode_ms_per_token"] = round(per_tok * 1000, 2)
+        if per_tok > 1e-6:  # RTT subtraction can floor tiny windows
+            res["llama_decode_ms_per_token"] = round(per_tok * 1000, 2)
+            res["llama_decode_steady_tokens_per_sec"] = round(batch / per_tok, 1)
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
         kv_bytes = (2 * cfg.num_hidden_layers * batch
                     * (prompt_len + new_tokens)
@@ -217,6 +249,65 @@ def _bench_decode(on_accel):
         res["llama_decode_stream_gb_per_tok"] = round(
             (2 * n_params + kv_bytes) / 1e9, 3)
     return res
+
+
+def _bench_llama7b_layer(on_accel):
+    """One LLaMA-2-7B-dimension decoder layer (h=4096, ffn=11008, 32 heads)
+    fwd+bwd at seq 2048 — anchors per-layer ms for BASELINE config #5 (the
+    7B tp+pp+sharding run a single chip cannot hold; 32 layers x this
+    number ~= the per-chip compute slice).  Ref: BASELINE.md:30."""
+    if not on_accel:
+        return {}
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig
+    from paddle_tpu.models.llama import LlamaDecoderLayer, _rope_cache
+    from paddle_tpu.tensor.tensor import Tensor
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+        num_hidden_layers=1, num_attention_heads=32, num_key_value_heads=32,
+        max_position_embeddings=2048, dtype="bfloat16",
+        tensor_parallel=False, use_flash_attention=True)
+    paddle.seed(0)
+    layer = LlamaDecoderLayer(cfg)
+    layer.bfloat16()
+    params, buffers = layer.functional_state()
+    cos, sin = _rope_cache(128, 2048, cfg.rope_theta)
+    B, S = 1, 2048
+
+    def fwd_loss(params, x):
+        from paddle_tpu.autograd import tape as _tape
+
+        restore = layer.bind_functional_state(params, buffers)
+        try:
+            with _tape.no_grad():  # whole-function AD, the TrainStep pattern
+                out = layer(Tensor(x), (Tensor(cos), Tensor(sin)))
+        finally:
+            restore()
+        return jnp.sum(out._value.astype(jnp.float32) ** 2)
+
+    step = jax.jit(jax.grad(fwd_loss, argnums=1))
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(B, S, 4096) * 0.02, jnp.bfloat16)
+    g = step(params, x)
+    float(jnp.sum(g[:1, :1, :1].astype(jnp.float32)))
+    iters = 20
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            g = step(params, g)  # chain to keep the device busy
+        float(jnp.sum(g[:1, :1, :1].astype(jnp.float32)))
+        best = min(best, time.perf_counter() - t0)
+    dt = max(best - _RTT_S, 1e-6) / iters
+    n_params = sum(int(np.prod(p.shape)) for p in layer.parameters())
+    # fwd 2N + bwd 4N per token + attention 3*(2*2*B*S^2*h)/2 causal
+    flops = 6 * n_params * B * S + 3 * 2 * B * S * S * 4096
+    return {"llama7b_layer_ms": round(dt * 1000, 2),
+            "llama7b_layer_tfs": round(flops / dt / 1e12, 1)}
 
 
 def _bench_resnet(on_accel):
@@ -256,7 +347,7 @@ def _bench_resnet(on_accel):
             loss = step(x, y)
         float(loss.item())
         windows.append(time.perf_counter() - t0)
-    dt = sorted(windows)[1]
+    dt = max(sorted(windows)[1] - _RTT_S, 1e-6)
 
     ips = batch * steps / dt
     # ResNet-50 fwd ~= 4.1 GFLOP/img at 224^2 (2*MACs); train ~= 3x fwd
@@ -273,6 +364,9 @@ def main():
         # measure the chip's gemm ceiling FIRST, on a clean HBM — after the
         # model benches the number is polluted by allocator state
         try:
+            global _RTT_S
+            _RTT_S = _measure_rtt()
+            out["hw_rtt_ms_measured"] = round(_RTT_S * 1000, 1)
             out["hw_gemm_tfs_measured"] = round(_measure_gemm_peak(), 1)
             out["hw_conv_tfs_measured"] = round(_measure_conv_peak(), 1)
         except Exception as e:
@@ -289,6 +383,10 @@ def main():
         out.update(_bench_decode(on_accel))
     except Exception as e:
         out["decode_error"] = repr(e)[:300]
+    try:
+        out.update(_bench_llama7b_layer(on_accel))
+    except Exception as e:
+        out["llama7b_layer_error"] = repr(e)[:300]
 
     if on_accel and out.get("hw_gemm_tfs_measured") and out.get("llama_mfu"):
         out["llama_mfu_vs_measured_peak"] = round(

@@ -44,20 +44,42 @@ def build(verbose: bool = False) -> str:
 
 
 def load_library():
-    """Load (building if needed).  Returns the CDLL or None if unavailable."""
+    """Load (building if needed).  Returns the CDLL or None if unavailable.
+
+    Resolution order: env kill-switch -> fresh build (dev checkout with a
+    toolchain) -> PREBUILT .so even if stale (wheel install on a
+    compiler-less host) -> pure-Python fallbacks (AVAILABLE=False)."""
     global _lib, AVAILABLE
     if _lib is not None or AVAILABLE is False:
         return _lib
     with _lib_lock:
         if _lib is not None or AVAILABLE is False:
             return _lib
+        if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
+            AVAILABLE = False
+            return None
         try:
             path = build()
             lib = ctypes.CDLL(path)
         except Exception:
+            # no toolchain: a prebuilt library (shipped in the wheel) still
+            # loads — staleness only matters in dev checkouts, which have g++
+            if os.path.exists(_LIB_PATH):
+                try:
+                    lib = ctypes.CDLL(_LIB_PATH)
+                except OSError:
+                    AVAILABLE = False
+                    return None
+            else:
+                AVAILABLE = False
+                return None
+        try:
+            _declare(lib)
+        except AttributeError:
+            # a stale prebuilt .so missing newly-bound symbols: honor the
+            # "CDLL or None" contract and degrade to pure Python
             AVAILABLE = False
             return None
-        _declare(lib)
         _lib = lib
         AVAILABLE = True
     return _lib

@@ -81,6 +81,9 @@ class CostEstimate:
     mem_bytes: float
     feasible: bool
     reason: str = ""
+    # filled by Planner.plan_measured: wall time of one real step (seconds)
+    t_measured: float | None = None
+    measure_error: str = ""
 
     @property
     def t_step(self):

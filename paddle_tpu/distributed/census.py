@@ -1,0 +1,72 @@
+"""Collective-traffic census of a compiled step (perf evidence for meshes
+the attached hardware cannot run).
+
+Ref analog: the reference's cost model + profiler count NCCL bytes per step
+(fleet/meta_optimizers' cost models); here the numbers come straight from
+the optimized HLO: every cross-device collective op's output bytes, per
+device, per step.  Used by the driver dryrun to record
+{bytes_allreduce, bytes_ppermute, ...} for the hybrid LLaMA step.
+"""
+from __future__ import annotations
+
+import re
+
+_DT_BYTES = {"pred": 1, "s8": 1, "u8": 1, "bf16": 2, "f16": 2, "s16": 2,
+             "u16": 2, "f32": 4, "s32": 4, "u32": 4, "f64": 8, "s64": 8,
+             "u64": 8, "c64": 8, "c128": 16}
+
+_COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
+                "collective-permute", "all-to-all")
+
+
+def _shape_bytes(text):
+    """Sum bytes of every `dtype[d0,d1,...]` group in `text`."""
+    total = 0
+    for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", text):
+        if dt not in _DT_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DT_BYTES[dt]
+    return total
+
+
+def collective_census(compiled):
+    """{op: {"count": n, "bytes": per-device output bytes}} + est_flops.
+
+    `compiled` is a jax Compiled (jitted.lower(*args).compile()).  Bytes are
+    the collectives' OUTPUT payloads summed over the program — the per-step,
+    per-device traffic the interconnect must carry (a while-loop body is
+    counted once; multiply by trip count externally if needed).
+    """
+    txt = compiled.as_text()
+    out = {op: {"count": 0, "bytes": 0} for op in _COLLECTIVES}
+    for line in txt.splitlines():
+        for op in _COLLECTIVES:
+            # match the sync opcode OR the async -start form (XLA's default
+            # on TPU); -done carries the same payload and is skipped so each
+            # collective is counted once
+            m = re.search(rf"=\s*(.*?)\s{re.escape(op)}(?:-start)?\(", line)
+            if m and f"{op}-done" not in line:
+                out[op]["count"] += 1
+                out[op]["bytes"] += _shape_bytes(m.group(1))
+                break
+    flops = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+    except Exception:
+        pass
+    return {
+        "bytes_allreduce": out["all-reduce"]["bytes"],
+        "bytes_allgather": out["all-gather"]["bytes"],
+        "bytes_reducescatter": out["reduce-scatter"]["bytes"],
+        "bytes_ppermute": out["collective-permute"]["bytes"],
+        "bytes_alltoall": out["all-to-all"]["bytes"],
+        "counts": {op: v["count"] for op, v in out.items()},
+        "est_step_flops": flops,
+    }

@@ -528,6 +528,33 @@ class PipelineTrainStep:
         model._pre_state_hook = self.sync_model
 
     # ------------------------------------------------------------------ call
+    def compiled_stats(self, x, y):
+        """Collective census of the compiled pipeline step (census.py) —
+        the ppermute bytes are the stage-boundary activations crossing ICI
+        per step (while-body counted once; x T ticks for totals)."""
+        from ..census import collective_census
+
+        xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        if self._jitted is None:
+            self._init(xv, yv)
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _random.get_rng_key()
+        if self.stacked_mode:
+            params, buffers = self.model.functional_state(_sync=False)
+            rep_params = {k: v for k, v in params.items()
+                          if k not in self._body_flats}
+            buffers = {k: v for k, v in buffers.items()
+                       if k not in self._body_buf_flats}
+            compiled = self._jitted.lower(
+                rep_params, self._stacked, self._stacked_buf, buffers,
+                self._opt_state, lr, key, xv, yv).compile()
+        else:
+            params, buffers = self.model.functional_state()
+            compiled = self._jitted.lower(
+                params, buffers, self._opt_state, lr, key, xv, yv).compile()
+        return collective_census(compiled)
+
     def __call__(self, x, y):
         xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
         yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)

@@ -142,6 +142,23 @@ class ShardedTrainStep:
         self._jitted = jax.jit(traced, in_shardings=in_shardings, out_shardings=out_shardings,
                                donate_argnums=donate)
 
+    def compiled_stats(self, *batch):
+        """Collective-traffic census of the compiled step (census.py):
+        per-device bytes for all-reduce / all-gather / reduce-scatter /
+        ppermute / all-to-all plus HLO-estimated FLOPs."""
+        from .census import collective_census
+
+        raw = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
+        if self._jitted is None:
+            self._init(raw)
+        params, buffers = self.model.functional_state()
+        lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
+        key = _random.get_rng_key()
+        compiled = self._jitted.lower(
+            params, buffers, self._opt_state, self._scaler_state, lr, key, *raw
+        ).compile()
+        return collective_census(compiled)
+
     def __call__(self, *batch):
         raw = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
         if self._jitted is None:

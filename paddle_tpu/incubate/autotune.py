@@ -54,6 +54,20 @@ def kernel_autotune_enabled():
     return _CONFIG["kernel"]["enable"]
 
 
+def measure_callable(fn, steps=3, warmup=1):
+    """Best-of-`steps` wall time of `fn()` after `warmup` calls — the shared
+    measuring primitive behind kernel autotune and the auto-parallel
+    planner's measured rerank (ref tuner/profiler.py measuring candidates)."""
+    for _ in range(warmup):
+        fn()
+    best = float("inf")
+    for _ in range(steps):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def tune_flash_attention(q, k, v, causal, scale, candidates=None, steps=3):
     """Measure candidate (block_q, block_k) configs for this attention
     signature and cache the fastest (phi AlgorithmsCache analog).

@@ -15,7 +15,8 @@ import numpy as np
 
 from ..tensor.tensor import Tensor
 
-__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType"]
+__all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
+           "DynamicBatcher"]
 
 
 class PrecisionType:
@@ -102,14 +103,15 @@ class _IOHandle:
 class Predictor:
     """Ref analysis_predictor.h: named I/O handles around the loaded program."""
 
-    def __init__(self, config: Config):
+    def __init__(self, config: Config, _shared_layer=None):
         from .. import jit as _jit
 
-        if config.model_path() is None:
+        if _shared_layer is None and config.model_path() is None:
             raise ValueError("inference.Config needs a model path prefix "
                              "(artifacts written by paddle.jit.save)")
         self._config = config
-        self._layer = _jit.load(config.model_path())
+        self._layer = _shared_layer if _shared_layer is not None \
+            else _jit.load(config.model_path())
         specs = self._layer._info.get("inputs") or []
         if specs:
             self._input_names = [s["name"] for s in specs]
@@ -120,6 +122,13 @@ class Predictor:
         self._inputs = {n: _IOHandle(n) for n in self._input_names}
         self._outputs: dict[str, _IOHandle] = {}
         self._output_names: list[str] = []
+
+    def clone(self):
+        """A predictor sharing THIS predictor's loaded program and weights
+        (zero-copy — the exported program and its parameter arrays are
+        immutable) with independent I/O handles, safe to drive from another
+        thread (ref analysis_predictor.h Clone: one engine, N streams)."""
+        return Predictor(self._config, _shared_layer=self._layer)
 
     def get_input_names(self):
         return list(self._input_names)
@@ -210,6 +219,108 @@ class Predictor:
             h.copy_from_cpu(arr)
             self._outputs[name] = h
         return out_arrays
+
+
+class DynamicBatcher:
+    """Concurrent-request micro-batching over one Predictor (the TPU analog
+    of the reference's multi-stream AnalysisPredictor serving: one compiled
+    fixed-batch program, many callers).
+
+    Callers `submit()` single-sample (or small-batch) requests from any
+    thread; a background worker coalesces up to `max_batch_size` samples or
+    `timeout_ms` of queue age into ONE padded program execution and fans the
+    rows back to each caller's Future.  `infer()` is the blocking wrapper.
+    """
+
+    def __init__(self, predictor: Predictor, max_batch_size=32, timeout_ms=5.0):
+        import queue
+        import threading
+
+        self._pred = predictor
+        self._max = int(max_batch_size)
+        self._timeout = float(timeout_ms) / 1000.0
+        self._q: "queue.Queue" = queue.Queue()
+        self._closed = False
+        self._worker = threading.Thread(target=self._loop, daemon=True)
+        self._worker.start()
+
+    def submit(self, *arrays):
+        """Enqueue one request ([1_or_k, ...] per input); returns a Future of
+        the output list (rows matching the request's batch).  Shape/arity
+        are validated HERE so one malformed request cannot poison the
+        co-batched requests of other callers."""
+        from concurrent.futures import Future
+
+        if self._closed:
+            raise RuntimeError("DynamicBatcher is closed")
+        arrays = [np.asarray(a) for a in arrays]
+        if len(arrays) != len(self._pred.get_input_names()):
+            raise ValueError(
+                f"expected {len(self._pred.get_input_names())} inputs, "
+                f"got {len(arrays)}")
+        if any(a.ndim == 0 for a in arrays):
+            raise ValueError("batcher inputs need a leading batch dim")
+        n = arrays[0].shape[0]
+        if any(a.shape[0] != n for a in arrays):
+            raise ValueError("all inputs must share the leading batch dim")
+        specs = self._pred._input_specs or []
+        for a, s in zip(arrays, specs):
+            want = tuple(s.get("shape") or [])[1:]
+            if want and tuple(a.shape[1:]) != tuple(
+                    d for d in want if d is not None) and None not in want:
+                raise ValueError(
+                    f"input {s.get('name')}: trailing shape {a.shape[1:]} "
+                    f"does not match the exported {tuple(want)}")
+        fut = Future()
+        self._q.put((arrays, n, fut))
+        return fut
+
+    def infer(self, *arrays):
+        return self.submit(*arrays).result()
+
+    def close(self):
+        self._closed = True
+        self._q.put(None)
+        self._worker.join(timeout=10)
+
+    def _loop(self):
+        import queue
+        import time as _time
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            batch = [item]
+            total = item[1]
+            deadline = _time.monotonic() + self._timeout
+            while total < self._max:
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._q.put(None)  # propagate shutdown after this batch
+                    break
+                batch.append(nxt)
+                total += nxt[1]
+            try:
+                ins = [np.concatenate([req[0][i] for req in batch])
+                       for i in range(len(batch[0][0]))]
+                outs = self._pred.run(ins)
+                sliced = [bool(o.ndim) and o.shape[0] == total for o in outs]
+                off = 0
+                for arrays, n, fut in batch:
+                    fut.set_result([o[off:off + n] if s else o
+                                    for o, s in zip(outs, sliced)])
+                    off += n
+            except Exception as e:
+                for _, _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
 
 
 def create_predictor(config: Config) -> Predictor:

@@ -15,3 +15,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "quick: fast core tier (op sweep + parallelism oracles; "
+        "run with -m quick, or -m quick -n 4 for <5 min)")
+    config.addinivalue_line(
+        "markers", "slow: heavyweight (wheel builds, large compiles)")

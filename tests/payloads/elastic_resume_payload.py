@@ -1,0 +1,96 @@
+"""Trainer payload for the elastic scale-down resume test (ref
+fleet/elastic/manager.py:131 + auto_checkpoint: a preempted job restarts
+with fewer workers and resumes from the sharded checkpoint).
+
+Phases (PHASE_START/PHASE_STEPS env):
+  A: world=4 trains steps [0..5] with per-step sharded checkpoints; the
+     designated CRASH_RANK exits(1) at the phase boundary — the preemption
+     the watcher detects.
+  B: world=2 restores the LATEST world-4 checkpoint (reshard-on-load onto
+     the halved mesh, including the zero-2 sharded optimizer state) and
+     continues steps [6..9].
+Data per global step is derived from the step index, so every world size
+sees the identical global batch and the loss curve must CONTINUE.
+"""
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+# REPO_ROOT is set by the launching test; when imported in-process for the
+# oracle, the repo is already on sys.path
+sys.path.insert(0, os.environ.get(
+    "REPO_ROOT", os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))))
+
+import numpy as np  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+import paddle_tpu.distributed as dist  # noqa: E402
+from paddle_tpu.distributed import checkpoint as ckpt  # noqa: E402
+
+
+class Net(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def batch_for(gstep):
+    rng = np.random.default_rng(1000 + gstep)
+    return (rng.standard_normal((8, 16)).astype(np.float32),
+            rng.standard_normal((8, 4)).astype(np.float32))
+
+
+def main():
+    out_path = sys.argv[1]
+    ckpt_dir = os.environ["CKPT_DIR"]
+    start = int(os.environ["PHASE_START"])
+    nsteps = int(os.environ["PHASE_STEPS"])
+    crash_rank = int(os.environ.get("CRASH_RANK", "-1"))
+
+    penv = dist.init_parallel_env()
+    nproc = int(os.environ["PADDLE_TRAINERS_NUM"])
+    assert jax.process_count() == nproc
+
+    paddle.seed(42)
+    model = Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=model.parameters())
+    hcg = dist.HybridCommunicateGroup(dp=nproc, mp=1, pp=1, sharding=1)
+    dist.set_hybrid_communicate_group(hcg)
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    step = dist.ShardedTrainStep(model, loss_fn, opt, hcg.mesh, zero_stage=2)
+    mgr = ckpt.CheckpointManager(ckpt_dir, keep=3)
+
+    meta = {}
+    if start > 0:
+        # resume: the sharded world-4 checkpoint reshards onto THIS world
+        meta = ckpt.load_train_state(ckpt_dir, model, train_step=step)
+        assert int(meta.get("step", -1)) == start - 1, meta
+
+    losses = []
+    for g in range(start, start + nsteps):
+        x, y = batch_for(g)
+        losses.append(float(step(x, y).item()))
+        ckpt.save_train_state(ckpt_dir, model, train_step=step, step=g)
+
+    with open(out_path, "w") as f:
+        json.dump({"rank": penv.rank, "world_size": penv.world_size,
+                   "losses": losses, "resumed_from": meta.get("step")}, f)
+    if penv.rank == crash_rank:
+        sys.stdout.flush()
+        os._exit(1)  # simulated preemption at the phase boundary
+
+
+if __name__ == "__main__":
+    main()

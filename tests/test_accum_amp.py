@@ -179,3 +179,6 @@ def test_accum_indivisible_batch_errors():
     y = np.ones((16, 4), np.float32)
     with pytest.raises(ValueError, match="accum_steps"):
         s(x, y)
+
+
+

@@ -108,3 +108,6 @@ def test_engine_evaluate_and_predict():
     assert np.isfinite(res["loss"])
     preds = eng.predict(ds, batch_size=16)
     assert len(preds) == 2 and preds[0].shape == (16, 4)
+
+
+

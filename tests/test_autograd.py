@@ -181,3 +181,6 @@ class TestLayerGrads:
         for p in net.parameters():
             assert p.grad is not None
             assert np.isfinite(p.grad.numpy()).all()
+
+
+pytestmark = [*globals().get("pytestmark", []), pytest.mark.quick]

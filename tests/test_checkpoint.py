@@ -230,3 +230,6 @@ def test_train_epoch_range_resume(tmp_path):
     for _ in cont:
         pass
     assert np.isfinite(train_one(m2, o2))
+
+
+

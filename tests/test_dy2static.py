@@ -378,3 +378,6 @@ def test_while_state_machine_matches_python():
 
     for n in (6, 7, 27):
         assert collatz_steps(n) == oracle(n)
+
+
+pytestmark = [*globals().get("pytestmark", []), pytest.mark.quick]

@@ -101,3 +101,6 @@ def test_recompute_bad_checkpoint_name():
     with pytest.raises(ValueError, match="not_a_layer"):
         fleet.distributed_train_step(
             model, lambda x, y: paddle.nn.functional.mse_loss(model(x), y), opt)
+
+
+

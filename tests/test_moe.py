@@ -99,3 +99,6 @@ def test_expert_parallel_matches_dense_dispatch():
             out_dense.append(dense_moe(paddle.to_tensor(x[r * 2:(r + 1) * 2])).numpy())
     out_dense = np.concatenate([np.asarray(o) for o in out_dense], axis=0)
     np.testing.assert_allclose(np.asarray(out_ep), out_dense, rtol=2e-4, atol=2e-5)
+
+
+

@@ -1,0 +1,81 @@
+"""Native-library packaging contract (ref: the CMake superbuild ships
+libpaddle so consumers never need a toolchain):
+
+1. wheel builds via the CI-shape command and CONTAINS the prebuilt .so
+2. a compiler-less host still loads the prebuilt library (ctypes path)
+3. with the native layer disabled entirely, the package imports and the
+   native-backed features run on their pure-Python fallbacks
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+
+
+def _run_py(code, extra_env):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu",
+                "XLA_FLAGS": "--xla_force_host_platform_device_count=1",
+                **extra_env})
+    return subprocess.run([sys.executable, "-c", code], env=env, cwd=REPO,
+                          capture_output=True, text=True, timeout=240)
+
+
+_PROBE = """
+import sys
+sys.path.insert(0, {repo!r})
+import paddle_tpu as paddle
+from paddle_tpu.core import native
+native.load_library()
+print("AVAILABLE", native.AVAILABLE)
+# native-backed features must work either way
+from paddle_tpu.distributed.store import TCPStore
+m = TCPStore(is_master=True)
+c = TCPStore(host="127.0.0.1", port=m.port, timeout=10)
+c.set("k", b"v")
+assert c.get("k") == b"v"
+m.close()
+import numpy as np
+t = paddle.to_tensor(np.ones(4, np.float32))
+assert float((t + 1).sum().item()) == 8.0
+print("OK")
+""".format(repo=REPO)
+
+
+def test_pure_python_degraded_mode():
+    """PADDLE_TPU_DISABLE_NATIVE=1: no native lib, everything still works."""
+    r = _run_py(_PROBE, {"PADDLE_TPU_DISABLE_NATIVE": "1"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "AVAILABLE False" in r.stdout and "OK" in r.stdout
+
+
+def test_prebuilt_lib_loads_without_compiler():
+    """With g++ unreachable (empty PATH) the prebuilt .so still loads."""
+    from paddle_tpu.core import native
+
+    native.build()  # ensure the prebuilt exists (dev checkout)
+    r = _run_py(_PROBE, {"PATH": "/nonexistent"})
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "AVAILABLE True" in r.stdout and "OK" in r.stdout
+
+
+@pytest.mark.slow
+def test_wheel_builds_and_contains_native_lib(tmp_path):
+    """CI-shape wheel build; the artifact ships the compiled library."""
+    out = str(tmp_path / "whl")
+    r = subprocess.run(
+        [sys.executable, "-m", "pip", "wheel", REPO, "--no-deps",
+         "--no-build-isolation", "-w", out],
+        capture_output=True, text=True, timeout=600)
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    import zipfile
+
+    whl = [f for f in os.listdir(out) if f.endswith(".whl")]
+    assert whl, os.listdir(out)
+    names = zipfile.ZipFile(os.path.join(out, whl[0])).namelist()
+    assert any(n.endswith("libpaddle_tpu_native.so") for n in names), names[:20]

@@ -423,3 +423,6 @@ def test_op(spec):
 def test_sweep_size():
     # the VERDICT bar: >=150 ops under systematic output/grad/bf16 checks
     assert len(SPECS) >= 150, len(SPECS)
+
+
+pytestmark = [*globals().get("pytestmark", []), pytest.mark.quick]

@@ -122,3 +122,6 @@ def test_grad_scaler():
     scaler.step(opt)
     scaler.update()
     assert np.isclose(w.numpy()[0], 1.0 - 0.1 * 2.0, rtol=1e-5)  # unscaled correctly
+
+
+pytestmark = [*globals().get("pytestmark", []), pytest.mark.quick]

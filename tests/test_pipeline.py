@@ -307,3 +307,6 @@ def test_pipeline_fallback_warns(data):
     with pytest.warns(UserWarning, match="REPLICATED"):
         step(paddle.to_tensor(x), paddle.to_tensor(y))
     assert not step.stacked_mode
+
+
+

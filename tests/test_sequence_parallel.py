@@ -118,3 +118,6 @@ def test_ulysses_head_divisibility_check(mesh):
     q = jnp.asarray(rng.randn(B, S, 3, D).astype(np.float32))  # 3 heads, n=4
     with pytest.raises(Exception):
         _sharded(lambda q, k, v: ulysses_attention(q, k, v, "sep"), mesh)(q, q, q)
+
+
+

@@ -187,3 +187,6 @@ def test_auto_key_warns():
     x = paddle.to_tensor(rng.randn(2, 8).astype(np.float32))
     with pytest.warns(UserWarning, match="automatic key"):
         S.fc(x, 3)
+
+
+

@@ -121,3 +121,6 @@ def test_enable_static_mode_default_program():
         np.testing.assert_allclose(out, 1.0)
     finally:
         paddle.disable_static()
+
+
+pytestmark = [*globals().get("pytestmark", []), pytest.mark.quick]

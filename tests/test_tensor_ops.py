@@ -153,3 +153,6 @@ class TestCreation:
         assert np.allclose(a.numpy(), b.numpy())
         r = paddle.uniform([100], min=0.0, max=1.0)
         assert 0 <= r.numpy().min() and r.numpy().max() <= 1
+
+
+pytestmark = [*globals().get("pytestmark", []), pytest.mark.quick]
