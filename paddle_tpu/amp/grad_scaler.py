@@ -3,6 +3,13 @@ loss_scaler.py:40, using check_finite_and_unscale + update_loss_scaling ops).
 
 On TPU bf16 training needs no loss scaling; the scaler still implements the full
 dynamic-scaling contract for fp16 parity (scale/unscale/found-inf bookkeeping in jnp).
+
+Sync semantics: THIS eager path pulls the found-inf bool to the host every
+step (the isfinite check in `_unscale_and_check` — fine for interactive
+use).  The fast path is `jit.TrainStep(..., scaler=scaler)`, which keeps
+the (scale, good, bad) counters device-resident and does the
+skip-update-on-overflow select inside the compiled step with NO per-step
+host sync (jit/_step_impl.py — the in-graph twin of update_loss_scaling).
 """
 from __future__ import annotations
 

@@ -58,6 +58,7 @@ def load_library():
         if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
             AVAILABLE = False
             return None
+        from_stale_prebuilt = False
         try:
             path = build()
             lib = ctypes.CDLL(path)
@@ -67,6 +68,7 @@ def load_library():
             if os.path.exists(_LIB_PATH):
                 try:
                     lib = ctypes.CDLL(_LIB_PATH)
+                    from_stale_prebuilt = True
                 except OSError:
                     AVAILABLE = False
                     return None
@@ -76,6 +78,8 @@ def load_library():
         try:
             _declare(lib)
         except AttributeError:
+            if not from_stale_prebuilt:
+                raise  # fresh build missing a symbol IS a bug: fail loudly
             # a stale prebuilt .so missing newly-bound symbols: honor the
             # "CDLL or None" contract and degrade to pure Python
             AVAILABLE = False

@@ -19,9 +19,12 @@ _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
                 "collective-permute", "all-to-all")
 
 
-def _shape_bytes(text):
-    """Sum bytes of every `dtype[d0,d1,...]` group in `text`."""
-    total = 0
+def _shape_bytes(text, reduce="sum"):
+    """Bytes of the `dtype[d0,d1,...]` groups in `text`.  reduce='max' takes
+    the largest single group — the payload convention for async `-start`
+    tuples, whose result aliases the operand buffer(s) alongside the output
+    (summing would double-count the wire traffic)."""
+    sizes = []
     for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", text):
         if dt not in _DT_BYTES:
             continue
@@ -29,8 +32,10 @@ def _shape_bytes(text):
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DT_BYTES[dt]
-    return total
+        sizes.append(n * _DT_BYTES[dt])
+    if not sizes:
+        return 0
+    return max(sizes) if reduce == "max" else sum(sizes)
 
 
 def collective_census(compiled):
@@ -48,10 +53,11 @@ def collective_census(compiled):
             # match the sync opcode OR the async -start form (XLA's default
             # on TPU); -done carries the same payload and is skipped so each
             # collective is counted once
-            m = re.search(rf"=\s*(.*?)\s{re.escape(op)}(?:-start)?\(", line)
+            m = re.search(rf"=\s*(.*?)\s{re.escape(op)}(-start)?\(", line)
             if m and f"{op}-done" not in line:
                 out[op]["count"] += 1
-                out[op]["bytes"] += _shape_bytes(m.group(1))
+                out[op]["bytes"] += _shape_bytes(
+                    m.group(1), reduce="max" if m.group(2) else "sum")
                 break
     flops = None
     try:

@@ -68,13 +68,16 @@ def measure_callable(fn, steps=3, warmup=1):
     return best
 
 
-def tune_flash_attention(q, k, v, causal, scale, candidates=None, steps=3):
+def tune_flash_attention(q, k, v, causal, scale, candidates=None, steps=20):
     """Measure candidate (block_q, block_k) configs for this attention
     signature and cache the fastest (phi AlgorithmsCache analog).
 
     Returns the chosen (bq, bk).  Called by ops.flash_attention when kernel
     autotune is enabled; measurement uses the real kernel on the attached
-    backend and blocks on a scalar readback per window."""
+    backend and blocks on ONE scalar readback per window.  `steps` kernels
+    run per window so candidate deltas dwarf the tunneled chip's ~100 ms
+    per-sync latency (at steps=3 every candidate measured ~= the sync
+    constant and the choice was effectively random)."""
     import importlib
 
     import jax

@@ -287,8 +287,10 @@ class DynamicBatcher:
         import queue
         import time as _time
 
+        pending = None  # a dequeued request deferred to the next batch
         while True:
-            item = self._q.get()
+            item = pending or self._q.get()
+            pending = None
             if item is None:
                 return
             batch = [item]
@@ -304,6 +306,14 @@ class DynamicBatcher:
                     break
                 if nxt is None:
                     self._q.put(None)  # propagate shutdown after this batch
+                    break
+                if total + nxt[1] > self._max or any(
+                        a.shape[1:] != b.shape[1:]
+                        for a, b in zip(batch[0][0], nxt[0])):
+                    # would overshoot the cap, or (dynamic-dim exports)
+                    # trailing shapes differ and cannot concatenate: defer
+                    # to its own batch instead of poisoning this one
+                    pending = nxt
                     break
                 batch.append(nxt)
                 total += nxt[1]

@@ -37,8 +37,10 @@ def _conv_padding(padding, nd):
 # When True, channel-first convs are internally rewritten to channel-last
 # ("NHWC"/"HWIO") with boundary transposes; when False the NCHW dimension numbers
 # are handed to XLA directly (its layout assignment picks physical layouts anyway).
-# Benchmarked on v5e (bench.py): direct NCHW wins (~2394 vs ~2279 img/s on
-# ResNet-50), so the default is False; kept as a switch for future autotuning.
+# Benchmarked on v5e (bench.py, r3 RTT-corrected timing): direct NCHW wins
+# (2245 vs 2198 img/s on ResNet-50 train; XLA's layout assignment already
+# picks physical layouts), so the default is False; kept as a switch for
+# future autotuning.
 _INTERNAL_CHANNEL_LAST = False
 
 

@@ -11,6 +11,56 @@ import jax.numpy as jnp
 from ...tensor.tensor import Tensor, apply_op, _unwrap
 
 
+import functools
+
+
+def _ce_lse_picked(x, safe, axis):
+    """f32 logsumexp + picked-logit from possibly-bf16 logits.  The f32
+    upcast stays INSIDE producer-fused elementwise/reduction kernels — the
+    [N, V] f32 logits array is never materialized in HBM (for a 32k-vocab
+    LLaMA step that array is 2.1 GB per pass)."""
+    xf = x.astype(jnp.float32)
+    m = jnp.max(xf, axis=axis, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(xf - m), axis=axis, keepdims=True))
+    picked = jnp.take_along_axis(x, jnp.expand_dims(safe, axis),
+                                 axis=axis).astype(jnp.float32)
+    return lse, picked
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _fused_softmax_ce(x, idx, axis, ignore_index):
+    """Per-example hard-label CE with a hand-written backward: the bwd
+    emits d_logits = (softmax - onehot) * d_per directly in the logits
+    dtype, so neither pass materializes f32 [N, V] (ref phi
+    CrossEntropyWithSoftmax fused kernel — same motivation, MXU edition)."""
+    safe = jnp.where(idx == ignore_index, 0, idx)
+    lse, picked = _ce_lse_picked(x, safe, axis)
+    valid = (idx != ignore_index)
+    return jnp.squeeze(lse, axis) - jnp.squeeze(picked, axis), valid
+
+
+def _fused_softmax_ce_fwd(x, idx, axis, ignore_index):
+    safe = jnp.where(idx == ignore_index, 0, idx)
+    lse, picked = _ce_lse_picked(x, safe, axis)
+    valid = (idx != ignore_index)
+    per = jnp.squeeze(lse, axis) - jnp.squeeze(picked, axis)
+    return (per, valid), (x, jnp.squeeze(lse, axis), safe, valid)
+
+
+def _fused_softmax_ce_bwd(axis, ignore_index, res, cts):
+    x, lse, safe, valid = res
+    d_per = cts[0] * valid.astype(cts[0].dtype)
+    xf = x.astype(jnp.float32)
+    probs = jnp.exp(xf - jnp.expand_dims(lse, axis))
+    nclass = x.shape[axis]
+    onehot = jax.nn.one_hot(safe, nclass, axis=axis, dtype=jnp.float32)
+    dx = (probs - onehot) * jnp.expand_dims(d_per, axis)
+    return dx.astype(x.dtype), None
+
+
+_fused_softmax_ce.defvjp(_fused_softmax_ce_fwd, _fused_softmax_ce_bwd)
+
+
 def _reduce(v, reduction, weight_sum=None):
     if reduction == "mean":
         if weight_sum is not None:
@@ -24,6 +74,24 @@ def _reduce(v, reduction, weight_sum=None):
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, label_smoothing=0.0, name=None):
     def _f(logits, lbl, w):
+        if (use_softmax and not soft_label and w is None
+                and label_smoothing == 0 and jnp.issubdtype(
+                    jnp.asarray(lbl).dtype, jnp.integer)):
+            # hard-label fast path: fused softmax-CE (f32 math without
+            # materializing f32 logits — see _fused_softmax_ce)
+            idx = lbl.astype(jnp.int32)
+            if idx.ndim == logits.ndim:
+                idx = jnp.squeeze(idx, axis=axis)
+            per, valid = _fused_softmax_ce(logits, idx, axis, ignore_index)
+            per = per * valid.astype(per.dtype)
+            if reduction == "mean":
+                out = jnp.sum(per) / jnp.maximum(
+                    jnp.sum(valid.astype(per.dtype)), 1.0)
+            else:
+                out = _reduce(per, reduction)
+            # internal math is f32; the OUTPUT keeps the reference dtype
+            # contract (loss dtype == logits dtype, as log_softmax gave)
+            return out.astype(logits.dtype)
         if use_softmax:
             logp = jax.nn.log_softmax(logits, axis=axis)
         else:

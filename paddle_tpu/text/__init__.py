@@ -195,14 +195,93 @@ class Conll05st(Dataset):
     def __init__(self, data_file=None, mode="train", synthetic=False):
         _require_source("Conll05st", data_file, synthetic, "the conll05st test.wsj files")
         if data_file is not None:
-            raise NotImplementedError(
-                "Conll05st real-corpus parsing (propbank column format) is not "
-                "implemented; pass synthetic=True for pipeline tests")
+            self._load_real(data_file, mode)
+            return
         rng = np.random.RandomState(0 if mode == "train" else 1)
         n = 256
         self.sents = [rng.randint(2, 5000, rng.randint(5, 40)).astype(np.int64)
                       for _ in range(n)]
         self.labels = [rng.randint(0, 67, len(s)).astype(np.int64) for s in self.sents]
+
+    def _load_real(self, root, mode="train"):
+        """Parse the conll05st propbank column files: `*.words` (one token
+        per line, blank line between sentences) + `*.props` (column 0 the
+        predicate lemma or '-', one bracketed-span column per predicate:
+        '(A0*', '*', '*)' ...).  Yields one (word_ids, BIO label_ids) item
+        per (sentence, predicate) pair — the reference conll05.py reader's
+        shape (ref text/datasets/conll05.py).  File pairs whose stem
+        contains `mode` are preferred (train.words vs test.wsj.words);
+        with no mode match, ONE pair must exist (ambiguity raises)."""
+        stems: dict = {}
+        for name in sorted(os.listdir(root)):
+            for ext in (".words", ".props"):
+                if name.endswith(ext):
+                    stems.setdefault(name[: -len(ext)], {})[ext] = \
+                        os.path.join(root, name)
+        pairs = {s: f for s, f in stems.items()
+                 if ".words" in f and ".props" in f}
+        if not pairs:
+            raise FileNotFoundError(
+                f"Conll05st: expected a *.words + *.props pair in '{root}'")
+        matching = {s: f for s, f in pairs.items() if mode in s}
+        if matching:
+            pairs = matching
+        elif len(pairs) > 1:
+            raise ValueError(
+                f"Conll05st: multiple corpus pairs {sorted(pairs)} and none "
+                f"matches mode={mode!r}; point data_file at one split")
+        stem = sorted(pairs)[0]
+        words_f, props_f = pairs[stem][".words"], pairs[stem][".props"]
+
+        def read_blocks(path):
+            blocks, cur = [], []
+            with open(path, encoding="utf8") as f:
+                for ln in f:
+                    ln = ln.rstrip("\n")
+                    if not ln.strip():
+                        if cur:
+                            blocks.append(cur)
+                            cur = []
+                    else:
+                        cur.append(ln.split())
+                if cur:
+                    blocks.append(cur)
+            return blocks
+
+        word_blocks = read_blocks(words_f)
+        prop_blocks = read_blocks(props_f)
+        if len(word_blocks) != len(prop_blocks):
+            raise ValueError(
+                f"Conll05st: {len(word_blocks)} sentences in words vs "
+                f"{len(prop_blocks)} in props")
+        freq: dict = {}
+        for blk in word_blocks:
+            for row in blk:
+                freq[row[0].lower()] = freq.get(row[0].lower(), 0) + 1
+        vocab = sorted(freq.items(), key=lambda kv: (-kv[1], kv[0]))
+        self.word_idx = {w: i + 2 for i, (w, _) in enumerate(vocab)}
+        self.label_idx = {"O": 0}
+        self.sents, self.labels = [], []
+        for wblk, pblk in zip(word_blocks, prop_blocks):
+            toks = [row[0].lower() for row in wblk]
+            ids = np.asarray([self.word_idx.get(w, 1) for w in toks], np.int64)
+            n_preds = max(len(row) for row in pblk) - 1
+            for k in range(n_preds):
+                bio, open_tag = [], None
+                for row in pblk:
+                    span = row[k + 1] if len(row) > k + 1 else "*"
+                    tag = "O"
+                    if span.startswith("("):
+                        open_tag = span[1:].split("*")[0].rstrip(")")
+                        tag = "B-" + open_tag
+                    elif open_tag is not None:
+                        tag = "I-" + open_tag
+                    if span.endswith(")"):
+                        open_tag = None
+                    bio.append(self.label_idx.setdefault(
+                        tag, len(self.label_idx)))
+                self.sents.append(ids)
+                self.labels.append(np.asarray(bio, np.int64))
 
     def __getitem__(self, idx):
         return self.sents[idx], self.labels[idx]
