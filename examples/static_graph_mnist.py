@@ -1,0 +1,63 @@
+"""The reference's canonical static-graph workflow, unmodified (ref
+executor.py:1104 docs): program_guard capture -> per-batch Executor.run ->
+save_inference_model -> serve with paddle.inference.
+
+    JAX_PLATFORMS=cpu python examples/static_graph_mnist.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), os.pardir))
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.static as static
+
+
+def main():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    # synthetic MNIST-shaped data
+    xs = rng.randn(512, 784).astype(np.float32)
+    ys = rng.randint(0, 10, (512, 1)).astype(np.int64)
+
+    main_prog, startup = static.Program(), static.Program()
+    with static.program_guard(main_prog, startup):
+        x = static.data("img", [None, 784], "float32")
+        y = static.data("label", [None, 1], "int64")
+        h = static.nn.fc(x, size=128, activation="relu", name="fc1")
+        logits = static.nn.fc(h, size=10, name="fc2")
+        loss = paddle.mean(paddle.nn.functional.cross_entropy(logits, y))
+        paddle.optimizer.Adam(learning_rate=1e-3).minimize(loss)
+
+    exe = static.Executor()
+    exe.run(startup)
+    for step in range(30):
+        i = (step * 64) % 512
+        lv, = exe.run(main_prog,
+                      feed={"img": xs[i:i + 64], "label": ys[i:i + 64]},
+                      fetch_list=[loss])
+        if step % 10 == 0:
+            print(f"step {step:3d}  loss {float(lv):.4f}")
+
+    # export the inference graph (batch-polymorphic) and serve it
+    prefix = "/tmp/static_mnist/model"
+    static.save_inference_model(prefix, [x], [logits], exe)
+    from paddle_tpu import inference as infer
+
+    pred = infer.create_predictor(infer.Config(prefix))
+    probs, = pred.run([xs[:5]])
+    print("served logits shape:", probs.shape)
+
+    # concurrent serving: clones for threads, micro-batching for requests
+    batcher = infer.DynamicBatcher(pred.clone(), max_batch_size=64,
+                                   timeout_ms=5)
+    futs = [batcher.submit(xs[i:i + 1]) for i in range(8)]
+    outs = [f.result()[0] for f in futs]
+    batcher.close()
+    print("micro-batched", len(outs), "requests, each ->", outs[0].shape)
+
+
+if __name__ == "__main__":
+    main()

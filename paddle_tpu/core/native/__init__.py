@@ -58,7 +58,10 @@ def load_library():
         if os.environ.get("PADDLE_TPU_DISABLE_NATIVE"):
             AVAILABLE = False
             return None
-        from_stale_prebuilt = False
+        # a lib loaded without a fresh compile THIS call may be a stale
+        # artifact (copied build dir, docker layer with equal mtimes) — any
+        # missing symbol then degrades instead of raising
+        from_stale_prebuilt = not _needs_rebuild()
         try:
             path = build()
             lib = ctypes.CDLL(path)

@@ -20,10 +20,11 @@ _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
 
 
 def _shape_bytes(text, reduce="sum"):
-    """Bytes of the `dtype[d0,d1,...]` groups in `text`.  reduce='max' takes
-    the largest single group — the payload convention for async `-start`
-    tuples, whose result aliases the operand buffer(s) alongside the output
-    (summing would double-count the wire traffic)."""
+    """Bytes of the `dtype[d0,d1,...]` groups in `text`.  reduce='half_sum'
+    is the payload convention for async `-start` tuples, which print the
+    aliased operand group(s) alongside the result group(s) — including for
+    VARIADIC combined collectives (N operands + N results), where sum/2 is
+    the payload and a max would undercount."""
     sizes = []
     for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", text):
         if dt not in _DT_BYTES:
@@ -35,7 +36,9 @@ def _shape_bytes(text, reduce="sum"):
         sizes.append(n * _DT_BYTES[dt])
     if not sizes:
         return 0
-    return max(sizes) if reduce == "max" else sum(sizes)
+    if reduce == "half_sum":
+        return sizes[0] if len(sizes) == 1 else sum(sizes) // 2
+    return sum(sizes)
 
 
 def collective_census(compiled):
@@ -57,7 +60,7 @@ def collective_census(compiled):
             if m and f"{op}-done" not in line:
                 out[op]["count"] += 1
                 out[op]["bytes"] += _shape_bytes(
-                    m.group(1), reduce="max" if m.group(2) else "sum")
+                    m.group(1), reduce="half_sum" if m.group(2) else "sum")
                 break
     flops = None
     try:

@@ -63,6 +63,16 @@ def _rope_cache(head_dim, max_pos, theta):
     return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
 
 
+def _quantize_kv(kv):
+    """Per-(token, head) absmax int8 quantization of a [B, S, H, D] slice:
+    returns (int8 values, f32 scale [B, S, H, 1])."""
+    f = kv.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(f), axis=-1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
 def _static_decode_mask(offset, S, L):
     """Additive causal+padding mask for a static-cache step: queries at
     pos offset+i see keys j <= offset+i; the padded tail is masked."""
@@ -149,8 +159,13 @@ class LlamaAttention(nn.Layer):
 
         # a 3-tuple cache (k_buf, v_buf, pos) is the STATIC layout used by the
         # compiled generate() loop: fixed-size buffers + in-place scatter, so
-        # every decode step has identical shapes and compiles once
-        static_cache = cache is not None and len(cache) == 3
+        # every decode step has identical shapes and compiles once.  A 5-tuple
+        # (k_q, v_q, pos, k_scale, v_scale) is the int8-quantized variant:
+        # per-(token, head) absmax scales, HALF the cache HBM footprint
+        # (capacity lever; on current XLA the dequant materializes, so it
+        # costs ms/token — see generation.generate).
+        static_cache = cache is not None and len(cache) in (3, 5)
+        quant_cache = cache is not None and len(cache) == 5
         if static_cache:
             offset = cache[2]
         else:
@@ -158,7 +173,27 @@ class LlamaAttention(nn.Layer):
         q = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (q, rope_cos, rope_sin), name="rope")
         k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, rope_cos, rope_sin), name="rope")
 
-        if static_cache:
+        if quant_cache:
+            import jax
+
+            def upd_q(buf, sbuf, kv):
+                kv_q, scale = _quantize_kv(kv)
+                return (jax.lax.dynamic_update_slice_in_dim(buf, kv_q, offset, 1),
+                        jax.lax.dynamic_update_slice_in_dim(sbuf, scale, offset, 1))
+
+            k_buf, k_sc = apply_op(upd_q, (cache[0], cache[3], k), name="kv_scatter_q")
+            v_buf, v_sc = apply_op(upd_q, (cache[1], cache[4], v), name="kv_scatter_q")
+            new_cache = (k_buf, v_buf, offset + S, k_sc, v_sc)
+            L = k_buf.shape[1]
+            if attn_mask is None:
+                attn_mask = Tensor(_static_decode_mask(offset, S, L))
+            # dequantize for the attention ops (measured: XLA
+            # materializes this — the capacity/speed trade noted above)
+            deq = lambda b, s, dt=hidden_states.dtype: (  # noqa: E731
+                b.astype(dt) * s.astype(dt))
+            k = apply_op(deq, (k_buf, k_sc), name="kv_dequant")
+            v = apply_op(deq, (v_buf, v_sc), name="kv_dequant")
+        elif static_cache:
             import jax
 
             upd = lambda buf, kv: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
@@ -297,6 +332,8 @@ class LlamaModel(nn.Layer):
 
 
 class LlamaForCausalLM(nn.Layer):
+    _supports_quant_cache = True  # LlamaAttention understands the 5-tuple
+
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.config = config
@@ -332,10 +369,12 @@ class LlamaForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 pad_token_id=0):
+                 pad_token_id=0, cache_dtype=None):
         """Compiled autoregressive decoding on a static kv-cache — one XLA
-        program for prefill + the whole token scan (models/generation.py)."""
+        program for prefill + the whole token scan (models/generation.py).
+        cache_dtype='int8' halves the kv-cache HBM footprint."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
-                    top_k, top_p, eos_token_id, pad_token_id)
+                    top_k, top_p, eos_token_id, pad_token_id,
+                    cache_dtype=cache_dtype)

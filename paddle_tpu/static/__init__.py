@@ -212,10 +212,13 @@ def save_inference_model(path_prefix, feed_vars, fetch_vars, executor, *,
     lives = prog._lives
     params = {f"v{j}": lives[j]._value for j in range(len(lives))}
 
+    nodes, _ = prog._prune(fetch_syms)  # drop loss/label subgraphs the
+    # exported forward does not need (their feeds are not inputs here)
+
     def infer_fn(params, buffers, *feeds):
         live_vals = [params[f"v{j}"] for j in range(len(lives))]
         env = {sym_id: f for (sym_id, _, _), f in zip(feed_specs, feeds)}
-        prog._replay(env, live_vals)
+        prog._replay(env, live_vals, nodes)
         return tuple(live_vals[s[1]] if isinstance(s, tuple) else env[s]
                      for s in fetch_syms)
 

@@ -122,10 +122,27 @@ class Program:
     def _trainable_live_idx(self):
         return [j for j, t in enumerate(self._lives) if not t.stop_gradient]
 
-    def _replay(self, env, live_vals):
+    def _prune(self, target_syms):
+        """The sub-tape producing `target_syms` (backward slice over the op
+        list — the reference's Program pruning before execution, ref
+        framework.py Program._prune).  Feeds that only feed pruned-away
+        nodes become unnecessary, so e.g. save_inference_model([x], [logits])
+        on a training program drops the loss/label subgraph."""
+        needed: set = set(s for s in target_syms if not isinstance(s, tuple))
+        keep = []
+        for node in reversed(self._nodes):
+            if any(o in needed for o in node.out_ids):
+                keep.append(node)
+                for kind, v in node.in_refs:
+                    if kind == "sym":
+                        needed.add(v)
+        keep.reverse()
+        return keep, needed
+
+    def _replay(self, env, live_vals, nodes=None):
         """Execute the tape; env maps sym -> raw array (seeded with feeds and
         trainable overrides come in through live_vals)."""
-        for node in self._nodes:
+        for node in (self._nodes if nodes is None else nodes):
             raws = []
             for kind, v in node.in_refs:
                 if kind == "sym":
@@ -201,9 +218,11 @@ class Program:
         return [np.asarray(f) for f in fetched]
 
     def _compile_infer(self, fetch_syms):
+        nodes, _ = self._prune(fetch_syms)
+
         def fn(feed_arrays, live_vals):
             env = dict(feed_arrays)
-            self._replay(env, live_vals)
+            self._replay(env, live_vals, nodes)
             return tuple(live_vals[s[1]] if isinstance(s, tuple) else env[s]
                          for s in fetch_syms)
 
@@ -213,13 +232,15 @@ class Program:
         # per-param decay specs are static python values — close over them
         decays = {j: opt._param_decay_coeff(self._lives[j]) for j in tr_idx}
 
+        nodes, _ = self._prune(tuple(fetch_syms) + (loss_sym,))
+
         def fn(feed_arrays, live_vals, opt_state, lr):
             def loss_of(train_vals):
                 lv = list(live_vals)
                 for j, v in train_vals.items():
                     lv[j] = v
                 env = dict(feed_arrays)
-                self._replay(env, lv)
+                self._replay(env, lv, nodes)
                 return env[loss_sym].astype(jnp.float32), env
 
             train_vals = {j: live_vals[j] for j in tr_idx}
