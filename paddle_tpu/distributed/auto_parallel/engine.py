@@ -37,6 +37,9 @@ class Engine:
 
     # ------------------------------------------------------------------ mesh
     def _jax_mesh(self) -> Mesh:
+        tuned = getattr(self, "_tuned_mesh", None)
+        if tuned is not None:
+            return tuned
         if self._process_mesh is not None:
             return self._process_mesh.to_jax_mesh()
         hc = getattr(self.strategy, "hybrid_configs", None) if self.strategy else None
@@ -63,6 +66,32 @@ class Engine:
     def prepare(self, inputs_spec=None, labels_spec=None, mode="train"):
         """Ref engine.py:378 — build the compiled step lazily; kept for API parity."""
         return self
+
+    def tune(self, seq_len, global_batch, n_devices=None, top_k=3,
+             measure=True):
+        """Pick the parallel plan for THIS engine's model (ref
+        auto_parallel/tuner/): analytic shortlist from the cost model, then
+        — with measure=True — each candidate compiled + timed on the
+        attached devices and the measured winner adopted as the engine's
+        process mesh.  Returns the winning CostEstimate."""
+        from .planner import Planner, model_spec_from_layer
+
+        n = n_devices or len(jax.devices())
+        spec = model_spec_from_layer(self.model, seq_len=seq_len,
+                                     global_batch=global_batch)
+        planner = Planner(spec)
+        best = (planner.plan_measured(n, top_k=top_k) if measure
+                else planner.plan(n))
+        c = best.config
+        from .. import build_mesh
+
+        self._process_mesh = None
+        self._tuned_mesh = build_mesh(dp=c.dp, mp=c.mp, pp=c.pp,
+                                      sharding=c.sharding)
+        # compiled steps are mesh-bound: force a rebuild on the tuned mesh
+        self._train_step = None
+        self._eval_fn = None
+        return best
 
     def _ensure_train_step(self):
         if self._train_step is None:

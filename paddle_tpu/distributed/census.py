@@ -20,11 +20,16 @@ _COLLECTIVES = ("all-reduce", "all-gather", "reduce-scatter",
 
 
 def _shape_bytes(text, reduce="sum"):
-    """Bytes of the `dtype[d0,d1,...]` groups in `text`.  reduce='half_sum'
-    is the payload convention for async `-start` tuples, which print the
-    aliased operand group(s) alongside the result group(s) — including for
-    VARIADIC combined collectives (N operands + N results), where sum/2 is
-    the payload and a max would undercount."""
+    """Bytes of the `dtype[d0,d1,...]` groups in `text`.
+
+    Async `-start` tuples print the aliased operand group(s) alongside the
+    result group(s), so per-op conventions recover the payload:
+    - 'half_sum' (all-reduce / permute / all-to-all: operand size == result
+      size, possibly VARIADIC combined): sum/2 — a max would undercount the
+      combined case.
+    - 'max' (all-gather / reduce-scatter: operand and result sizes differ):
+      the larger group is the full participating buffer, i.e. the payload.
+    """
     sizes = []
     for dt, dims in re.findall(r"(\w+)\[([0-9,]*)\]", text):
         if dt not in _DT_BYTES:
@@ -38,6 +43,8 @@ def _shape_bytes(text, reduce="sum"):
         return 0
     if reduce == "half_sum":
         return sizes[0] if len(sizes) == 1 else sum(sizes) // 2
+    if reduce == "max":
+        return max(sizes)
     return sum(sizes)
 
 
@@ -59,8 +66,12 @@ def collective_census(compiled):
             m = re.search(rf"=\s*(.*?)\s{re.escape(op)}(-start)?\(", line)
             if m and f"{op}-done" not in line:
                 out[op]["count"] += 1
-                out[op]["bytes"] += _shape_bytes(
-                    m.group(1), reduce="half_sum" if m.group(2) else "sum")
+                if m.group(2):  # async form: tuple aliases operands
+                    red = ("max" if op in ("all-gather", "reduce-scatter")
+                           else "half_sum")
+                else:
+                    red = "sum"
+                out[op]["bytes"] += _shape_bytes(m.group(1), reduce=red)
                 break
     flops = None
     try:

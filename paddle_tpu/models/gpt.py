@@ -60,19 +60,23 @@ class GPTBlock(nn.Layer):
         qkv = self.qkv(h).reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn_mask = None
-        if cache is not None and len(cache) == 3:
-            # static (k_buf, v_buf, pos) layout for the compiled generate loop
+        if cache is not None and len(cache) in (3, 5):
+            # static (k_buf, v_buf, pos) layout for the compiled generate
+            # loop; the 5-tuple adds (k_scale, v_scale) for the int8 cache
+            # (see llama.py _quantize_kv — capacity lever)
             import jax
             import jax.numpy as jnp
 
             from ..tensor.tensor import Tensor, apply_op
 
+            from .kv_cache import update_plain_cache, update_quant_cache
+
             offset = cache[2]
-            upd = lambda buf, kv: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
-                buf, kv.astype(buf.dtype), offset, 1)
-            k = apply_op(upd, (cache[0], k), name="kv_scatter")
-            v = apply_op(upd, (cache[1], v), name="kv_scatter")
-            new_cache = (k, v, offset + S)
+            if len(cache) == 5:
+                new_cache, k, v = update_quant_cache(cache, k, v, offset,
+                                                     x.dtype)
+            else:
+                new_cache, k, v = update_plain_cache(cache, k, v, offset)
             L = k.shape[1]
             jpos = jnp.arange(L)[None, :]
             qpos = jnp.arange(S)[:, None] + offset
@@ -147,6 +151,8 @@ class GPTModel(nn.Layer):
 
 
 class GPTForCausalLM(nn.Layer):
+    _supports_quant_cache = True  # GPTBlock understands the 5-tuple
+
     def __init__(self, config: GPTConfig):
         super().__init__()
         self.config = config
@@ -174,9 +180,10 @@ class GPTForCausalLM(nn.Layer):
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 pad_token_id=0):
+                 pad_token_id=0, cache_dtype=None):
         """Compiled decode loop on a static kv-cache (models/generation.py)."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
-                    top_k, top_p, eos_token_id, pad_token_id)
+                    top_k, top_p, eos_token_id, pad_token_id,
+                    cache_dtype=cache_dtype)

@@ -1,0 +1,56 @@
+"""Shared static kv-cache layouts for the compiled generate() loop.
+
+Two layouts, distinguished by tuple length (see generation.generate):
+  (k_buf, v_buf, pos)                      — plain, cache dtype = kv dtype
+  (k_q, v_q, pos, k_scale, v_scale)        — int8 + per-(token, head) absmax
+                                             scales: HALF the HBM footprint
+Both LlamaAttention and GPTBlock call the helpers here so the quantization
+contract lives in one place.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor.tensor import apply_op
+
+
+def _quantize_kv(kv):
+    """Per-(token, head) absmax int8 quantization of a [B, S, H, D] slice:
+    returns (int8 values, f32 scale [B, S, H, 1])."""
+    f = kv.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(f), axis=-1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-8)
+    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def update_plain_cache(cache, k, v, offset):
+    """Scatter new k/v into the (k_buf, v_buf, pos) layout.
+    Returns (new_cache, k_full, v_full)."""
+    S = k.shape[1]
+    upd = lambda buf, kv: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
+        buf, kv.astype(buf.dtype), offset, 1)
+    k_buf = apply_op(upd, (cache[0], k), name="kv_scatter")
+    v_buf = apply_op(upd, (cache[1], v), name="kv_scatter")
+    return (k_buf, v_buf, offset + S), k_buf, v_buf
+
+
+def update_quant_cache(cache, k, v, offset, out_dtype):
+    """Quantize + scatter new k/v into the 5-tuple int8 layout and
+    dequantize the full buffers for this step's attention.  Measured on
+    v5e: XLA materializes the dequant (capacity lever, costs ms/token —
+    see generation.generate).  Returns (new_cache, k_deq, v_deq)."""
+    S = k.shape[1]
+
+    def upd_q(buf, sbuf, kv):
+        kv_q, scale = _quantize_kv(kv)
+        return (jax.lax.dynamic_update_slice_in_dim(buf, kv_q, offset, 1),
+                jax.lax.dynamic_update_slice_in_dim(sbuf, scale, offset, 1))
+
+    k_buf, k_sc = apply_op(upd_q, (cache[0], cache[3], k), name="kv_scatter_q")
+    v_buf, v_sc = apply_op(upd_q, (cache[1], cache[4], v), name="kv_scatter_q")
+    deq = lambda b, s: b.astype(out_dtype) * s.astype(out_dtype)  # noqa: E731
+    k_deq = apply_op(deq, (k_buf, k_sc), name="kv_dequant")
+    v_deq = apply_op(deq, (v_buf, v_sc), name="kv_dequant")
+    return (k_buf, v_buf, offset + S, k_sc, v_sc), k_deq, v_deq

@@ -63,14 +63,11 @@ def _rope_cache(head_dim, max_pos, theta):
     return jnp.asarray(np.cos(freqs)), jnp.asarray(np.sin(freqs))
 
 
-def _quantize_kv(kv):
-    """Per-(token, head) absmax int8 quantization of a [B, S, H, D] slice:
-    returns (int8 values, f32 scale [B, S, H, 1])."""
-    f = kv.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(f), axis=-1, keepdims=True) / 127.0
-    scale = jnp.maximum(scale, 1e-8)
-    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
-    return q, scale.astype(jnp.float32)
+from .kv_cache import (  # noqa: E402  (shared cache layouts; re-exported
+    _quantize_kv,         # for backward compat — tests import from here)
+    update_plain_cache,
+    update_quant_cache,
+)
 
 
 def _static_decode_mask(offset, S, L):
@@ -174,37 +171,14 @@ class LlamaAttention(nn.Layer):
         k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, rope_cos, rope_sin), name="rope")
 
         if quant_cache:
-            import jax
-
-            def upd_q(buf, sbuf, kv):
-                kv_q, scale = _quantize_kv(kv)
-                return (jax.lax.dynamic_update_slice_in_dim(buf, kv_q, offset, 1),
-                        jax.lax.dynamic_update_slice_in_dim(sbuf, scale, offset, 1))
-
-            k_buf, k_sc = apply_op(upd_q, (cache[0], cache[3], k), name="kv_scatter_q")
-            v_buf, v_sc = apply_op(upd_q, (cache[1], cache[4], v), name="kv_scatter_q")
-            new_cache = (k_buf, v_buf, offset + S, k_sc, v_sc)
-            L = k_buf.shape[1]
+            new_cache, k, v = update_quant_cache(cache, k, v, offset,
+                                                 hidden_states.dtype)
             if attn_mask is None:
-                attn_mask = Tensor(_static_decode_mask(offset, S, L))
-            # dequantize for the attention ops (measured: XLA
-            # materializes this — the capacity/speed trade noted above)
-            deq = lambda b, s, dt=hidden_states.dtype: (  # noqa: E731
-                b.astype(dt) * s.astype(dt))
-            k = apply_op(deq, (k_buf, k_sc), name="kv_dequant")
-            v = apply_op(deq, (v_buf, v_sc), name="kv_dequant")
+                attn_mask = Tensor(_static_decode_mask(offset, S, k.shape[1]))
         elif static_cache:
-            import jax
-
-            upd = lambda buf, kv: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
-                buf, kv.astype(buf.dtype), offset, 1)
-            k_buf = apply_op(upd, (cache[0], k), name="kv_scatter")
-            v_buf = apply_op(upd, (cache[1], v), name="kv_scatter")
-            new_cache = (k_buf, v_buf, offset + S)
-            L = k_buf.shape[1]
+            new_cache, k, v = update_plain_cache(cache, k, v, offset)
             if attn_mask is None:
-                attn_mask = Tensor(_static_decode_mask(offset, S, L))
-            k, v = k_buf, v_buf
+                attn_mask = Tensor(_static_decode_mask(offset, S, k.shape[1]))
         else:
             if cache is not None:
                 k = M.concat([cache[0], k], axis=1)

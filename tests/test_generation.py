@@ -115,9 +115,47 @@ def test_int8_kv_cache_decode_tracks_fp():
     m = LlamaForCausalLM(cfg)
     m.eval()
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32))
-    fp = np.asarray(m.generate(ids, max_new_tokens=8)._value)
+    # a random-init model has near-tied logits, so greedy-token agreement is
+    # a fragile oracle; compare the DECODE-STEP LOGITS under fp vs int8
+    # caches built from the same prefill instead
+    fp_logits, fp_caches = m.generate_step(ids)
+    def to_static(caches, quant):
+        out = []
+        for (k, v) in caches:
+            pos = jnp.asarray(k.shape[1], jnp.int32)
+            if quant:
+                kq, ks = _quantize_kv(k._value)
+                vq, vs = _quantize_kv(v._value)
+                out.append((paddle.Tensor(kq), paddle.Tensor(vq), pos,
+                            paddle.Tensor(ks), paddle.Tensor(vs)))
+            else:
+                out.append((k, v, pos))
+        return out
+    nxt = paddle.to_tensor(np.argmax(np.asarray(fp_logits._value)[:, -1], -1)
+                           .astype(np.int32)[:, None])
+    l_fp, _ = m.generate_step(nxt, caches=to_static(fp_caches, False))
+    l_q8, _ = m.generate_step(nxt, caches=to_static(fp_caches, True))
+    a, b = np.asarray(l_fp._value), np.asarray(l_q8._value)
+    denom = np.abs(a).max() + 1e-6
+    assert np.abs(a - b).max() / denom < 0.05, np.abs(a - b).max() / denom
+    # and the e2e int8 generate runs with the right output shape
     q8 = np.asarray(m.generate(ids, max_new_tokens=8, cache_dtype="int8")._value)
-    # greedy tokens may diverge once a near-tie flips; require strong
-    # agreement on the early steps where errors have not compounded
-    agree = (fp[:, :4] == q8[:, :4]).mean()
-    assert agree >= 0.75, (fp, q8)
+    assert q8.shape == (2, 8)
+
+
+def test_gpt_int8_kv_cache_decode():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(1)
+    cfg = GPTConfig.tiny()
+    m = GPTForCausalLM(cfg)
+    m.eval()
+    rng = np.random.RandomState(1)
+    ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (2, 10)).astype(np.int32))
+    fp = np.asarray(m.generate(ids, max_new_tokens=6)._value)
+    q8 = np.asarray(m.generate(ids, max_new_tokens=6, cache_dtype="int8")._value)
+    assert fp.shape == q8.shape == (2, 6)
+    assert q8.min() >= 0 and q8.max() < cfg.vocab_size

@@ -121,3 +121,23 @@ def test_measured_rerank_default_proxy_runs_real_steps():
 
 
 
+
+
+def test_engine_tune_adopts_measured_plan():
+    """Engine.tune() profiles the shortlist and ADOPTS the winner's mesh
+    (the reference tuner feeding the Engine)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.distributed.auto_parallel import Engine
+
+    paddle.seed(0)
+    model = nn.Sequential(nn.Linear(16, 32), nn.ReLU(), nn.Linear(32, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=model.parameters())
+    eng = Engine(model=model, loss=paddle.nn.functional.mse_loss, optimizer=opt)
+    best = eng.tune(seq_len=64, global_batch=32, n_devices=8, top_k=2)
+    assert best.feasible
+    mesh = eng._jax_mesh()
+    c = best.config
+    assert mesh.shape.get("dp", 1) * mesh.shape.get("mp", 1) \
+        * mesh.shape.get("pp", 1) * mesh.shape.get("sharding", 1) == 8
+    assert mesh.shape.get("dp", 1) == c.dp
