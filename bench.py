@@ -246,6 +246,26 @@ def _bench_decode(on_accel):
         if per_tok > 1e-6:  # RTT subtraction can floor tiny windows
             res["llama_decode_ms_per_token"] = round(per_tok * 1000, 2)
             res["llama_decode_steady_tokens_per_sec"] = round(batch / per_tok, 1)
+        # throughput scaling: weights amortize over a bigger decode batch
+        ids32 = paddle.to_tensor(
+            np.random.randint(0, cfg.vocab_size, (32, prompt_len), np.int32))
+
+        def timed32(ntok):
+            out = model.generate(ids32, max_new_tokens=ntok)
+            _ = np.asarray(out._value)
+            best = float("inf")
+            for _ in range(2):
+                t0 = time.perf_counter()
+                out = model.generate(ids32, max_new_tokens=ntok)
+                _ = np.asarray(out._value)
+                best = min(best, time.perf_counter() - t0)
+            return max(best - _RTT_S, 1e-6)
+
+        d32 = timed32(new_tokens)
+        d32_half = timed32(new_tokens // 2)
+        per32 = (d32 - d32_half) / (new_tokens - new_tokens // 2)
+        if per32 > 1e-6:
+            res["llama_decode_b32_steady_tokens_per_sec"] = round(32 / per32, 1)
         n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
         kv_bytes = (2 * cfg.num_hidden_layers * batch
                     * (prompt_len + new_tokens)
