@@ -130,6 +130,40 @@ def _measure_conv_peak():
     return total_flops / total_dt / 1e12
 
 
+def _measure_hbm_bw():
+    """Measured streaming HBM bandwidth (GB/s): a deep in-jit chain of
+    fused elementwise passes over a 512 MB buffer (each pass = one read +
+    one write).  The denominator for the decode roofline
+    (llama_decode_stream_gb_per_tok / this = the floor ms/token)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    n = 256 * 1024 * 1024  # 512 MB of bf16
+    iters = 30
+    x = jnp.ones((n,), jnp.bfloat16)
+
+    @jax.jit
+    def chain(x):
+        def body(c, _):
+            # NB: the multiplier must NOT round to 1.0 in bf16 (1.0000001
+            # does!) or XLA folds the whole loop to identity
+            return c * jnp.bfloat16(1.0078125), ()
+        return jax.lax.scan(body, x, None, length=iters)[0]
+
+    r = chain(x)
+    float(jnp.sum(r[:2].astype(jnp.float32)))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = chain(x)
+        float(jnp.sum(r[:2].astype(jnp.float32)))
+        best = min(best, time.perf_counter() - t0)
+    best = max(best - _RTT_S, 1e-6)
+    return 2 * 2 * n * iters / best / 1e9  # read+write per pass
+
+
 def _bench_llama(on_accel):
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
@@ -394,6 +428,7 @@ def main():
             out["hw_rtt_ms_measured"] = round(_RTT_S * 1000, 1)
             out["hw_gemm_tfs_measured"] = round(_measure_gemm_peak(), 1)
             out["hw_conv_tfs_measured"] = round(_measure_conv_peak(), 1)
+            out["hw_hbm_gbs_measured"] = round(_measure_hbm_bw(), 0)
         except Exception as e:
             out["hw_peak_error"] = repr(e)[:200]
     try:
