@@ -265,9 +265,16 @@ class DynamicBatcher:
             raise ValueError("all inputs must share the leading batch dim")
         specs = self._pred._input_specs or []
         for a, s in zip(arrays, specs):
-            want = tuple(s.get("shape") or [])[1:]
-            if want and tuple(a.shape[1:]) != tuple(
-                    d for d in want if d is not None) and None not in want:
+            shp = s.get("shape")
+            if not shp:
+                continue
+            want = tuple(shp)[1:]
+            # validate rank and every STATIC trailing dim positionally —
+            # dynamic dims (None/-1) are wildcards, but their presence must
+            # not disable the check for the static dims around them
+            if len(a.shape) - 1 != len(want) or any(
+                    w is not None and int(w) >= 0 and int(d) != int(w)
+                    for d, w in zip(a.shape[1:], want)):
                 raise ValueError(
                     f"input {s.get('name')}: trailing shape {a.shape[1:]} "
                     f"does not match the exported {tuple(want)}")
@@ -324,13 +331,27 @@ class DynamicBatcher:
                 sliced = [bool(o.ndim) and o.shape[0] == total for o in outs]
                 off = 0
                 for arrays, n, fut in batch:
-                    fut.set_result([o[off:off + n] if s else o
-                                    for o, s in zip(outs, sliced)])
+                    if not fut.done():  # a caller may have cancelled
+                        fut.set_result([o[off:off + n] if s else o
+                                        for o, s in zip(outs, sliced)])
                     off += n
             except Exception as e:
-                for _, _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+                if len(batch) > 1:
+                    # one request may be poisoning the co-batch: retry each
+                    # request individually so healthy callers still get
+                    # results and only the bad one sees the exception
+                    for arrays, n, fut in batch:
+                        if fut.done():
+                            continue
+                        try:
+                            fut.set_result(list(self._pred.run(list(arrays))))
+                        except Exception as ee:
+                            if not fut.done():
+                                fut.set_exception(ee)
+                else:
+                    for _, _, fut in batch:
+                        if not fut.done():
+                            fut.set_exception(e)
 
 
 def create_predictor(config: Config) -> Predictor:

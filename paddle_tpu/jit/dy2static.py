@@ -171,11 +171,19 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names=()):
     def _probe(fn):
         """Trace the branch once in the OUTER trace to learn each slot's
         fate (the produced ops are dead code XLA removes).  Restores the
-        pre-branch locals."""
+        pre-branch locals AND the framework RNG position: branch bodies
+        execute twice at trace time (probe + lax.cond trace), so without
+        the snapshot a dropout/randn inside a branch would consume an
+        extra key split and silently shift the random stream."""
+        from ..framework import random as _fr
+
+        gen = _fr.default_generator()
+        rng_snapshot = gen._key
         set_args(init)
         fn()
         out = get_args()
         set_args(init)
+        gen._key = rng_snapshot
         return out
 
     out_t, out_f = _probe(true_fn), _probe(false_fn)

@@ -125,7 +125,10 @@ class GPTModel(nn.Layer):
         use_cache = use_cache or caches is not None
         if use_cache and caches is None:
             caches = [None] * len(self.h)
-        if caches is not None and caches[0] is not None and len(caches[0]) == 3:
+        if caches is not None and caches[0] is not None and len(caches[0]) in (3, 5):
+            # static cache (plain 3-tuple or int8 5-tuple): the live offset is
+            # at [2] in both layouts; the legacy growing (k, v) pair falls to
+            # the elif, where the past length IS the k buffer's axis-1 extent
             import jax.numpy as jnp
 
             from ..tensor.tensor import Tensor

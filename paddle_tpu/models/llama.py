@@ -129,7 +129,9 @@ class LlamaAttention(nn.Layer):
         rope_cos, rope_sin = rope
         B, S = hidden_states.shape[0], hidden_states.shape[1]
         fusable = (type(self.q_proj) is nn.Linear and type(self.k_proj) is nn.Linear
-                   and type(self.v_proj) is nn.Linear)  # not wrapped (quant etc.)
+                   and type(self.v_proj) is nn.Linear  # not wrapped (quant etc.)
+                   and all(getattr(p, "bias", None) is None
+                           for p in (self.q_proj, self.k_proj, self.v_proj)))
         if S == 1 and fusable:
             # decode step: ONE fused qkv gemv instead of three — at batch<<128
             # each projection is weight-streaming-bound and per-op latency
@@ -229,7 +231,9 @@ class LlamaMLP(nn.Layer):
 
     def forward(self, x):
         if x.shape[1] == 1 and type(self.gate_proj) is nn.Linear \
-                and type(self.up_proj) is nn.Linear:
+                and type(self.up_proj) is nn.Linear \
+                and getattr(self.gate_proj, "bias", None) is None \
+                and getattr(self.up_proj, "bias", None) is None:
             # decode step: fuse gate+up into one gemv (see fused_qkv note)
             def _fused_gu(h, wg, wu):
                 w = jnp.concatenate([wg, wu], axis=1)
@@ -287,9 +291,11 @@ class LlamaModel(nn.Layer):
         x = self.embed_tokens(input_ids)
         rope = (self.rope_cos, self.rope_sin)
         if (attn_mask is None and caches is not None and caches[0] is not None
-                and len(caches[0]) == 3):
-            # static-cache decode: the causal/padding mask is identical for
-            # every layer — build it ONCE per step, not 12x in the scan body
+                and len(caches[0]) in (3, 5)):
+            # static-cache decode (plain 3-tuple or int8 5-tuple — offset and
+            # buffer length sit at the same tuple positions in both layouts):
+            # the causal/padding mask is identical for every layer — build it
+            # ONCE per step, not num_layers times in the scan body
             attn_mask = Tensor(_static_decode_mask(
                 caches[0][2], input_ids.shape[1], caches[0][0].shape[1]))
         new_caches = [] if use_cache else None

@@ -87,8 +87,18 @@ class Program:
         """Classify one op argument for replay."""
         if isinstance(a, Tensor):
             sym = getattr(a, "_st_sym", None)
-            if sym is not None and sym[0] is self:
-                return ("sym", sym[1])
+            if sym is not None:
+                if sym[0] is self or sym[0]._nodes is self._nodes:
+                    return ("sym", sym[1])
+                # A var built under a DIFFERENT program_guard: capturing it as
+                # a "live" leaf would silently bake in its build-time
+                # placeholder value (zeros).  The reference errors on
+                # cross-program variable use (fluid/framework.py Operator
+                # input checks); so do we.
+                raise ValueError(
+                    f"static: tensor '{getattr(a, 'name', '?')}' was built "
+                    "under a different Program and cannot be used here — "
+                    "rebuild it inside this program_guard")
             j = self._live_ids.get(id(a))
             if j is None:
                 j = len(self._lives)
@@ -111,7 +121,7 @@ class Program:
 
     def _set_objective(self, loss, optimizer):
         sym = getattr(loss, "_st_sym", None)
-        if sym is None or sym[0] is not self:
+        if sym is None or sym[0]._nodes is not self._nodes:
             raise ValueError(
                 "static: minimize() got a loss that was not built under this "
                 "program_guard — construct the loss inside the guarded block")
