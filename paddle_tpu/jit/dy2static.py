@@ -22,11 +22,16 @@ analog of the reference's BreakContinueTransformer: the loop test gains
 `and not break_flag`, statements after a flag-set are guarded); early
 `return` in Tensor-condition branches (the reference's ReturnTransformer,
 done by restructuring: trailing code is pushed into the non-returning arm so
-both lax.cond branches produce the return value).
+both lax.cond branches produce the return value); `return` inside converted
+loop bodies (rewritten to a carried flag + zero-seeded value slot + break,
+then merged after the loop — see _convert_loop_returns); and `for x in
+tensor`, which compiles to an index-scan while (ONE lax.while_loop body
+instead of S unrolled copies — the reference's ForNodeVisitor
+canonicalization, loop_transformer.py).
 
 Not converted (left as plain Python, which errors loudly on a traced
-condition): `yield`, `return` inside a converted *loop* body, and `for`
-over non-range iterables (trace-unrolled as before).
+condition): `yield`, and `for` over non-range non-Tensor iterables
+(trace-unrolled as before).
 """
 from __future__ import annotations
 
@@ -39,6 +44,7 @@ import types
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..tensor.tensor import Tensor
 
@@ -155,6 +161,69 @@ def not_flag(a):
     return not _truthy(av)
 
 
+# ---- for-over-Tensor runtime (ref loop_transformer.py ForNodeVisitor: the
+# reference canonicalizes `for x in tensor` to an indexed while; here the
+# generated while compiles to ONE lax.while_loop body instead of S unrolled
+# copies when traced, and stays a plain Python loop in eager mode)
+
+def is_tensor_seq(x):
+    return isinstance(x, Tensor) and getattr(x, "ndim", 0) >= 1
+
+
+def index0():
+    # a RAW numpy scalar, deliberately not a jax array: jnp constants created
+    # inside a trace are tracers on this JAX version, which would hide the
+    # static trip count from seq_trips
+    return np.int32(0)
+
+
+def index_lt(i, seq):
+    return _raw(i) < seq.shape[0]
+
+
+def index_get(seq, i):
+    return seq[i]
+
+
+def index_incr(i):
+    v = _raw(i)
+    if isinstance(v, jax.core.Tracer):
+        return v + 1
+    return np.int32(v + 1)
+
+
+def _is_loop_ret_name(nm):
+    """Slots created by the return-in-loop rewrite (`_pt_lretv*`): their value
+    is only ever READ under the paired flag, so zero-filling the not-assigned
+    path is safe — it lets the value escape lax.cond/while_loop carries."""
+    return isinstance(nm, str) and nm.startswith("_pt_lretv")
+
+
+def trip_count(i, stop, step=1):
+    """Remaining trip count of a desugared for-range/for-tensor loop, or None
+    when any bound is traced.  A concrete count lets convert_while compile the
+    loop as a masked lax.scan — which reverse-differentiates — instead of
+    lax.while_loop (forward-only in JAX)."""
+    vals = [_raw(i), _raw(stop), _raw(step)]
+    if any(isinstance(v, jax.core.Tracer) for v in vals):
+        return None
+    iv, sv, st = (int(np.asarray(v)) for v in vals)
+    if st == 0:
+        return None
+    import math as _math
+
+    return max(0, _math.ceil((sv - iv) / st))
+
+
+def seq_trips(i, seq):
+    """Trip count for `for x in tensor`: the (static) leading dim minus the
+    already-peeled prefix."""
+    iv = _raw(i)
+    if isinstance(iv, jax.core.Tracer):
+        return None
+    return max(0, seq.shape[0] - int(np.asarray(iv)))
+
+
 def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names=()):
     """Generated-code entry for a rewritten `if` (ref convert_operators.py
     convert_ifelse)."""
@@ -190,11 +259,21 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names=()):
     kinds_t = [_kind(v) for v in out_t]
     kinds_f = [_kind(v) for v in out_f]
     carried, out_kind, dead, final_static = [], [], [], {}
+    zero_fill = {}  # slot -> raw zeros for the branch that leaves it unset
     for i, (vt, vf, kt, kf) in enumerate(zip(out_t, out_f, kinds_t, kinds_f)):
         nm = names[i] if i < len(names) else f"#{i}"
         t_un, f_un = isinstance(vt, _Undefined), isinstance(vf, _Undefined)
         if t_un and f_un:
             final_static[i] = vt  # untouched by either branch
+        elif (t_un or f_un) and _is_loop_ret_name(nm) \
+                and _kind(vf if t_un else vt) != "static":
+            # return-in-loop value slot assigned by one branch only: the
+            # unassigned side carries zeros (never read — the paired flag
+            # stays False on that path)
+            defined = vf if t_un else vt
+            zero_fill[i] = jnp.zeros_like(_raw(defined))
+            carried.append(i)
+            out_kind.append("tensor" if isinstance(defined, Tensor) else "raw")
         elif t_un or f_un:
             dead.append(i)  # branch-local temp: poisoned, errors only on use
         elif kt == "static" and kf == "static":
@@ -226,7 +305,8 @@ def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names=()):
             set_args(init)
             fn()
             out = get_args()
-            return tuple(_raw(out[i]) for i in carried)
+            return tuple(zero_fill[i] if isinstance(out[i], _Undefined)
+                         else _raw(out[i]) for i in carried)
         return run
 
     res = jax.lax.cond(jnp.all(pv), _branch(true_fn), _branch(false_fn))
@@ -274,22 +354,75 @@ def convert_call(fn):
     return cached
 
 
-def convert_while(test_fn, body_fn, get_args, set_args, names=()):
-    """Generated-code entry for a rewritten `while` (ref convert_while_loop)."""
+def convert_while(test_fn, body_fn, get_args, set_args, names=(), bound_fn=None,
+                  force_compile=False):
+    """Generated-code entry for a rewritten `while` (ref convert_while_loop).
+
+    bound_fn (for-range / for-tensor desugar only) returns the loop's
+    remaining trip count when it is statically known, else None.  A known
+    bound compiles the loop as a masked lax.scan — reverse-differentiable —
+    instead of lax.while_loop (which JAX cannot transpose).
+
+    force_compile (for-tensor only): when the loop data is traced, go
+    straight to the scan without eager peeling even though the index test is
+    concrete — ONE compiled body instead of seq-len unrolled copies.  Plain
+    `for i in range(n)` keeps unroll semantics on purpose: user bodies often
+    index Python structures with the loop variable (layers[i])."""
     # Python semantics while the test stays concrete: iterate eagerly (the
     # loop unrolls under trace).  If the test BECOMES traced mid-loop (e.g.
     # `for i in range(10)` or `while True:` with a Tensor-condition break —
     # the flag enters the test), the executed iterations are already peeled
     # into the outer trace; compile the remainder as a lax.while_loop from
     # the current locals.
-    first = _raw(test_fn())
-    while not isinstance(first, jax.core.Tracer):
-        if not _truthy(first):
-            return
-        body_fn()
+    # the trip bound must be read at LOOP ENTRY: once the body runs, carried
+    # flags/index can become traced (lax.cond merges) and the count is lost.
+    # Peeled iterations decrement it so the compiled remainder is exact.
+    trips = bound_fn() if bound_fn is not None else None
+    if not (force_compile and trips is not None and trips > 0
+            and any(_is_traced(v) for v in get_args())):
         first = _raw(test_fn())
+        while not isinstance(first, jax.core.Tracer):
+            if not _truthy(first):
+                return
+            body_fn()
+            if trips is not None:
+                trips = max(0, trips - 1)
+            first = _raw(test_fn())
+        if trips is not None and trips <= 0:
+            # bound exhausted while the test stayed concrete — but flags are
+            # now traced; fall through to compile a zero-trip scan is wrong,
+            # so recompute: the remaining-count is 0 only if the bound was
+            # exact; guard against a stale bound by keeping the while path
+            trips = None
 
     init_vals = get_args()
+    # return-in-loop value slots (`_pt_lretv*`) start UNDEFINED but must be
+    # carried: probe the body ONCE in the outer trace (dead code to XLA) to
+    # learn their shape/dtype, then seed the carry with zeros — the paired
+    # flag guards every read, so the zeros are never observed
+    ret_slots = [j for j, v in enumerate(init_vals)
+                 if isinstance(v, _Undefined) and j < len(names)
+                 and _is_loop_ret_name(names[j])]
+    if ret_slots:
+        from ..framework import random as _fr
+
+        gen = _fr.default_generator()
+        rng_snapshot = gen._key
+        set_args(init_vals)
+        body_fn()
+        probe_out = get_args()
+        set_args(init_vals)
+        gen._key = rng_snapshot
+        init_list = list(init_vals)
+        for j in ret_slots:
+            pv = probe_out[j]
+            if isinstance(pv, _Undefined) or _kind(pv) == "static":
+                raise ValueError(
+                    "dy2static: `return` inside a compiled Tensor-condition "
+                    "loop must return a Tensor/numeric value")
+            z = jnp.zeros_like(_raw(pv))
+            init_list[j] = Tensor(z) if isinstance(pv, Tensor) else z
+        init_vals = tuple(init_list)
     # vars undefined before the loop are loop-local temporaries: each
     # iteration reassigns them before use, so they are not carried (their
     # UNDEFINED placeholder classifies as "static" and round-trips untouched)
@@ -297,12 +430,8 @@ def convert_while(test_fn, body_fn, get_args, set_args, names=()):
     statics = [v for v, k in zip(init_vals, kinds) if k == "static"]
     promoted = set()  # static-slot indices that held tensors inside the body
 
-    def cond(carry):
-        set_args(_unpack(carry, kinds, statics))
-        return jnp.all(_raw(test_fn()))
-
-    def body(carry):
-        set_args(_unpack(carry, kinds, statics))
+    def _run_body_collect(carry_vals):
+        set_args(_unpack(carry_vals, kinds, statics))
         body_fn()
         out = get_args()
         for j, (v, k) in enumerate(zip(out, kinds)):
@@ -311,7 +440,29 @@ def convert_while(test_fn, body_fn, get_args, set_args, names=()):
                 promoted.add(j)
         return _pack(out, kinds)
 
-    out = jax.lax.while_loop(cond, body, _pack(init_vals, kinds))
+    if trips is not None:
+        # bounded loop: masked scan.  Each step evaluates the (traced) test;
+        # once it goes false the carry stops updating.  Runs exactly `trips`
+        # steps — iterations past a break/early-exit are masked no-ops.
+        def step(carry, _):
+            done, vals = carry
+            set_args(_unpack(vals, kinds, statics))
+            t = jnp.all(_raw(test_fn()))
+            active = jnp.logical_and(jnp.logical_not(done), t)
+            new = _run_body_collect(vals)
+            merged = tuple(jnp.where(active, n, o) for n, o in zip(new, vals))
+            return (jnp.logical_or(done, jnp.logical_not(t)), merged), None
+
+        (_, out), _ = jax.lax.scan(
+            step, (jnp.asarray(False), _pack(init_vals, kinds)), None,
+            length=int(trips))
+    else:
+        def cond(carry):
+            set_args(_unpack(carry, kinds, statics))
+            return jnp.all(_raw(test_fn()))
+
+        out = jax.lax.while_loop(cond, _run_body_collect,
+                                 _pack(init_vals, kinds))
     final = list(_unpack(out, kinds, statics))
     for j in promoted:
         final[j] = _PoisonedLocal(
@@ -434,6 +585,15 @@ def _guard_init(var):
                 value=ast.Attribute(value=_name(_HELPER), attr="UNDEFINED",
                                     ctx=ast.Load()))])],
         orelse=[], finalbody=[])
+
+
+def _lambda0(body_expr):
+    """A zero-arg lambda AST node wrapping `body_expr`."""
+    return ast.Lambda(
+        args=ast.arguments(posonlyargs=[], args=[], vararg=None,
+                           kwonlyargs=[], kw_defaults=[], kwarg=None,
+                           defaults=[]),
+        body=body_expr)
 
 
 def _fn_def(name, body, args=()):
@@ -621,6 +781,71 @@ def _restructure_returns(stmts):
     return out
 
 
+# ---- return-in-loop rewrite (ref return_transformer.py): `return V` inside
+# a loop becomes  _pt_lretvN = V; _pt_lretfN = True; break  — riding the
+# existing break machinery — and the loop gains `if _pt_lretfN: return
+# _pt_lretvN` right after it.  _restructure_returns (which runs AFTER this
+# pre-pass) then pushes trailing code into that if's arms so a traced flag
+# merges through lax.cond.  Nested loops compose bottom-up: the inner loop's
+# after-if return is itself a return inside the outer loop's body.
+
+def _replace_returns(stmts, flag, val):
+    """Rewrite Return at this loop's own level (descending plain If chains
+    only).  Returns under try/with or other compound statements are left —
+    the caller detects the leftover and abandons the rewrite."""
+    out = []
+    for s in stmts:
+        if isinstance(s, ast.Return):
+            out.append(ast.Assign(targets=[_name(val, ast.Store())],
+                                  value=s.value or ast.Constant(value=None)))
+            out.append(_flag_set(flag))
+            out.append(ast.Break())
+            return out  # rest of the block is unreachable
+        if isinstance(s, ast.If):
+            out.append(ast.If(test=s.test,
+                              body=_replace_returns(s.body, flag, val),
+                              orelse=_replace_returns(s.orelse, flag, val)))
+            continue
+        out.append(s)  # nested loops were already cleaned (bottom-up)
+    return out
+
+
+def _convert_loop_returns(stmts, counter=None):
+    """Pre-pass over a statement list: eliminate `return` from loop bodies
+    (bottom-up) so the loop transformer can convert those loops."""
+    counter = counter if counter is not None else [0]
+    out = []
+    for s in stmts:
+        if isinstance(s, (ast.While, ast.For)) and not s.orelse:
+            body = _convert_loop_returns(s.body, counter)
+            if _contains_return(body):
+                i = counter[0]
+                flag, val = f"_pt_lretf{i}", f"_pt_lretv{i}"
+                new_body = _replace_returns(body, flag, val)
+                if not _contains_return(new_body):
+                    counter[0] += 1
+                    s2 = copy.copy(s)
+                    s2.body = new_body
+                    out.append(_flag_set(flag, False))
+                    out.append(s2)
+                    out.append(ast.If(test=_name(flag),
+                                      body=[ast.Return(value=_name(val))],
+                                      orelse=[]))
+                    continue
+            s2 = copy.copy(s)
+            s2.body = body
+            out.append(s2)
+            continue
+        if isinstance(s, ast.If):
+            s2 = ast.If(test=s.test,
+                        body=_convert_loop_returns(s.body, counter),
+                        orelse=_convert_loop_returns(s.orelse, counter))
+            out.append(s2)
+            continue
+        out.append(s)
+    return out
+
+
 _BUILTIN_SKIP = {"range", "super", "len", "print", "isinstance", "type",
                  "getattr", "setattr", "hasattr", "enumerate", "zip", "list",
                  "tuple", "dict", "set", "int", "float", "bool", "str", "max",
@@ -716,17 +941,21 @@ class _ControlFlowTransformer(ast.NodeTransformer):
 
     def visit_For(self, node):
         """`for i in range(...)` desugars to a while (then converts like one);
-        any other iterable keeps Python semantics (trace-unrolled)."""
+        `for x in <expr>` gets a runtime dispatch: a Tensor iterable runs an
+        index-scan while (ONE compiled body — ref loop_transformer.py
+        ForNodeVisitor), anything else keeps Python semantics
+        (trace-unrolled)."""
         if (node.orelse
                 or not isinstance(node.target, ast.Name)
-                or not isinstance(node.iter, ast.Call)
-                or not isinstance(node.iter.func, ast.Name)
-                or node.iter.func.id != "range"
-                or node.iter.keywords
-                or not 1 <= len(node.iter.args) <= 3
                 or _has_ret_yield(node.body)):
             self.generic_visit(node)
             return node
+        if (not isinstance(node.iter, ast.Call)
+                or not isinstance(node.iter.func, ast.Name)
+                or node.iter.func.id != "range"
+                or node.iter.keywords
+                or not 1 <= len(node.iter.args) <= 3):
+            return self._convert_for_iterable(node)
         i = self.idx  # unique temp-name suffix (shared counter)
         self.idx += 1
         a = node.iter.args
@@ -754,9 +983,60 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         if loop is None:  # break/continue in a non-rewritable position
             self.generic_visit(node)
             return node
+        loop._pt_bound_expr = _lambda0(_helper_expr(
+            "trip_count", [_name(it), _name(stop_n), _name(step_n)]))
         self.generic_visit(loop)
         out = self.visit_While(loop, skip_children=True)
         return assigns + pre + (out if isinstance(out, list) else [out])
+
+    def _convert_for_iterable(self, node):
+        """`for x in seq`: emit a runtime type dispatch —
+
+            _pt_seqN = seq
+            if __pt_jst__.is_tensor_seq(_pt_seqN):   # concrete Python test
+                <index-scan while over rows, convertible to lax.while_loop>
+            else:
+                <the original Python for, trace-unrolled>
+
+        Only the Tensor arm pays the while-conversion machinery; lists,
+        dicts, generators take the untouched Python loop."""
+        i = self.idx
+        self.idx += 1
+        # the index must be a CARRIED loop var (plain `_pt_` prefix — the
+        # `_pt_jst_` machinery prefix is excluded from carry varlists); the
+        # sequence is read-only and resolves through the closure
+        seq_n, idx_n, it = f"{_PREFIX}seq{i}", f"_pt_ti{i}", node.target.id
+        seq_assign = ast.Assign(targets=[_name(seq_n, ast.Store())],
+                                value=node.iter)
+        body_t = copy.deepcopy(node.body)
+        get_row = ast.Assign(
+            targets=[_name(it, ast.Store())],
+            value=_helper_expr("index_get", [_name(seq_n), _name(idx_n)]))
+        incr = ast.Assign(targets=[_name(idx_n, ast.Store())],
+                          value=_helper_expr("index_incr", [_name(idx_n)]))
+        loop = ast.While(
+            test=_helper_expr("index_lt", [_name(idx_n), _name(seq_n)]),
+            body=[get_row] + body_t, orelse=[])
+        loop, pre_bc = self._prep_loop(loop, extra_tail=[incr])
+        if loop is None:  # break/continue in a non-rewritable position
+            self.generic_visit(node)
+            return node
+        loop._pt_bound_expr = _lambda0(_helper_expr(
+            "seq_trips", [_name(idx_n), _name(seq_n)]))
+        loop._pt_force_compile = True
+        self.generic_visit(loop)
+        out_t = self.visit_While(loop, skip_children=True)
+        tensor_arm = (
+            [ast.Assign(targets=[_name(idx_n, ast.Store())],
+                        value=_helper_expr("index0", []))]
+            + pre_bc + (out_t if isinstance(out_t, list) else [out_t]))
+        py_for = ast.For(target=node.target, iter=_name(seq_n),
+                         body=node.body, orelse=[])
+        self.generic_visit(py_for)
+        dispatch = ast.If(
+            test=_helper_expr("is_tensor_seq", [_name(seq_n)]),
+            body=tensor_arm, orelse=[py_for])
+        return [seq_assign, dispatch]
 
     def visit_While(self, node, skip_children=False):
         pre = []
@@ -780,9 +1060,15 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         body_fn = _fn_def(f"{_PREFIX}body_{i}",
                           [ast.Nonlocal(names=list(varlist))] + node.body)
         get, set_ = _get_set_defs(i, varlist)
-        call = self._helper_call("convert_while", [
+        call_args = [
             _name(test_fn.name), _name(body_fn.name),
-            _name(get.name), _name(set_.name), _names_const(varlist)])
+            _name(get.name), _name(set_.name), _names_const(varlist)]
+        bound_expr = getattr(node, "_pt_bound_expr", None)
+        if bound_expr is not None:  # for-range / for-tensor: static trip count
+            call_args.append(bound_expr)
+            if getattr(node, "_pt_force_compile", False):
+                call_args.append(ast.Constant(value=True))
+        call = self._helper_call("convert_while", call_args)
         return pre + inits + [test_fn, body_fn, get, set_, call]
 
 
@@ -814,6 +1100,7 @@ def convert_control_flow(fn):
     if not _needs_conversion(fdef):
         return fn
     fdef.decorator_list = []  # don't re-apply @to_static etc. on exec
+    fdef.body = _convert_loop_returns(fdef.body)
     fdef.body = _restructure_returns(fdef.body)
     new_body = _ControlFlowTransformer().visit(fdef)
     ast.fix_missing_locations(tree)

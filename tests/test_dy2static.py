@@ -381,3 +381,182 @@ def test_while_state_machine_matches_python():
 
 
 pytestmark = [*globals().get("pytestmark", []), pytest.mark.quick]
+
+
+# ---- return inside converted loops (ref return_transformer.py: returns in
+# loop bodies become a carried flag + value slot + break, merged after the
+# loop through lax.cond)
+
+def test_return_inside_for_range_loop():
+    @paddle.jit.to_static
+    def f(x):
+        for i in range(10):
+            x = x + 1
+            if x.sum() > 5:
+                return x * 10
+        return x - 1
+
+    def oracle(x):
+        for i in range(10):
+            x = x + 1
+            if x.sum() > 5:
+                return x * 10
+        return x - 1
+
+    for start in (np.zeros(2, np.float32), np.full(2, -100.0, np.float32)):
+        got = np.asarray(f(paddle.to_tensor(start))._value)
+        want = np.asarray(oracle(paddle.to_tensor(start))._value)
+        np.testing.assert_allclose(got, want)
+
+
+def test_return_inside_while_loop():
+    @paddle.jit.to_static
+    def f(x):
+        while x.sum() < 100:
+            x = x * 2 + 1
+            if x.max() > 20:
+                return x + 0.5
+        return x
+
+    def oracle(v):
+        x = np.full(3, v, np.float32)
+        while x.sum() < 100:
+            x = x * 2 + 1
+            if x.max() > 20:
+                return x + 0.5
+        return x
+
+    for v in (1.0, 200.0):
+        got = np.asarray(f(paddle.to_tensor(np.full(3, v, np.float32)))._value)
+        np.testing.assert_allclose(got, oracle(v))
+
+
+def test_return_from_nested_loop_propagates():
+    @paddle.jit.to_static
+    def f(x):
+        for i in range(3):
+            for j in range(4):
+                x = x + 1
+                if x.sum() > 6:
+                    return x * 1000
+        return x
+
+    got = np.asarray(f(paddle.to_tensor(np.zeros(2, np.float32)))._value)
+    np.testing.assert_allclose(got, [4000.0, 4000.0])
+
+
+def test_return_in_loop_gradient_flows():
+    # bounded loops compile to masked lax.scan, which reverse-differentiates
+    @paddle.jit.to_static
+    def f(x):
+        for i in range(5):
+            x = x * 2
+            if x.sum() > 4:
+                return x * 3
+        return x
+
+    t = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+    f(t).sum().backward()
+    # 1 -> *2 (sum 4, not >4) -> *2 (sum 8 >4) -> *3 : dy/dx = 12
+    np.testing.assert_allclose(np.asarray(t.grad._value), [12.0, 12.0])
+
+
+# ---- for-over-Tensor index scan (ref loop_transformer.py ForNodeVisitor)
+
+def test_for_over_tensor_index_scan():
+    @paddle.jit.to_static
+    def f(t):
+        acc = t[0] * 0
+        for row in t:
+            acc = acc + row * 2
+        return acc
+
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+    got = np.asarray(f(paddle.to_tensor(x))._value)
+    np.testing.assert_allclose(got, (x * 2).sum(0))
+
+
+def test_for_over_tensor_compiles_one_body():
+    import jax
+    import jax.numpy as jnp
+
+    def f(t):
+        acc = t[0] * 0
+        for row in t:
+            acc = acc + row
+        return acc
+
+    conv = convert_control_flow(f)
+
+    def raw(arr):
+        return conv(paddle.to_tensor(arr))._value
+
+    small = jax.make_jaxpr(raw)(jnp.zeros((4, 3)))
+    big = jax.make_jaxpr(raw)(jnp.zeros((64, 3)))
+    assert len(small.eqns) == len(big.eqns), "body must not unroll with rows"
+    prims = {str(e.primitive) for e in big.eqns}
+    assert "scan" in prims  # differentiable index scan, not while_loop
+
+
+def test_for_over_tensor_break_and_return():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+
+    @paddle.jit.to_static
+    def f_break(t):
+        acc = t[0] * 0
+        for row in t:
+            if row.sum() > 10:
+                break
+            acc = acc + row
+        return acc
+
+    # rows sum 3, 12, ... -> break before adding row 1: acc == row 0
+    np.testing.assert_allclose(np.asarray(f_break(paddle.to_tensor(x))._value),
+                               x[0])
+
+    @paddle.jit.to_static
+    def f_ret(t):
+        acc = t[0] * 0
+        for row in t:
+            acc = acc + row
+            if acc.sum() > 10:
+                return acc * 100
+        return acc
+
+    def oracle(t):
+        acc = t[0] * 0
+        for i in range(t.shape[0]):
+            acc = acc + t[i]
+            if acc.sum() > 10:
+                return acc * 100
+        return acc
+
+    np.testing.assert_allclose(np.asarray(f_ret(paddle.to_tensor(x))._value),
+                               oracle(x))
+
+
+def test_for_over_tensor_gradient():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+
+    @paddle.jit.to_static
+    def f(t):
+        acc = t[0] * 0
+        for row in t:
+            acc = acc + row * row
+        return acc
+
+    t = paddle.to_tensor(x, stop_gradient=False)
+    f(t).sum().backward()
+    np.testing.assert_allclose(np.asarray(t.grad._value), 2 * x)
+
+
+def test_for_over_python_list_still_unrolls():
+    def f(xs, y):
+        for x in xs:
+            y = y + x
+        return y
+
+    conv = convert_control_flow(f)
+    ts = [paddle.to_tensor(np.float32(i)) for i in range(3)]
+    out = float(np.asarray(conv(ts, paddle.to_tensor(np.float32(10)))._value))
+    assert out == 13.0
