@@ -103,7 +103,13 @@ from .hapi import summary, flops  # noqa: F401
 # DataParallel at top level (ref: paddle.DataParallel)
 from .distributed.parallel import DataParallel  # noqa: F401
 
-disable_static = lambda place=None: None  # dygraph is the default and only eager mode
+def disable_static(place=None):
+    """Leave static-graph capture and return to eager dygraph (the default).
+    Must actually deactivate the capture hooks — a no-op here would leave
+    every subsequent op silently recording onto the default main program."""
+    static.disable_static()
+
+
 enable_static = static.enable_static
 
 __version__ = "0.1.0"

@@ -48,17 +48,26 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None, training=Fa
     out = apply_op(_f, (x, mean_t, var_t, weight, bias), name="batch_norm")
 
     if use_batch_stats and isinstance(running_mean, Tensor):
-        # functional stat update written back to the buffers (ref BatchNormKernel saved stats)
+        # functional stat update written back to the buffers (ref
+        # BatchNormKernel saved stats).  Routed through apply_op so a static
+        # Program capture records it — set_value then promotes the write to
+        # live program state (MeanOut/VarianceOut analog) instead of baking
+        # the build-time placeholder stats.
         v = _unwrap(x)
         ch = ch_axis % v.ndim
         n = 1
         for i in range(v.ndim):
             if i != ch:
                 n *= v.shape[i]
-        mean = _unwrap(mean_t)
-        unbiased = _unwrap(var_t) * (n / max(n - 1, 1))
-        running_mean.set_value(momentum * _unwrap(running_mean) + (1 - momentum) * mean)
-        running_var.set_value(momentum * _unwrap(running_var) + (1 - momentum) * unbiased)
+        factor = n / max(n - 1, 1)
+        new_mean = apply_op(
+            lambda rm, m: momentum * rm + (1 - momentum) * m,
+            (running_mean, mean_t.detach()), name="bn_moving_mean")
+        new_var = apply_op(
+            lambda rv, s: momentum * rv + (1 - momentum) * (s * factor),
+            (running_var, var_t.detach()), name="bn_moving_var")
+        running_mean.set_value(new_mean)
+        running_var.set_value(new_var)
     return out
 
 

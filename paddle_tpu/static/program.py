@@ -63,6 +63,10 @@ class Program:
         self._objective = None               # (loss_sym, optimizer)
         self._opt_state = None
         self._compiled: dict = {}
+        # buffer mutations promoted to program state (ref batch_norm_op.cc
+        # MeanOut/VarianceOut): live index -> sym of the latest recorded write;
+        # the compiled train step outputs these and run() rebinds the buffers
+        self._state_writes: dict[int, int] = {}
         self.random_seed = None
 
     # ---- capture ----------------------------------------------------------
@@ -104,6 +108,11 @@ class Program:
                 j = len(self._lives)
                 self._lives.append(a)
                 self._live_ids[id(a)] = j
+            w = self._state_writes.get(j)
+            if w is not None:
+                # the buffer was already written in this program: later reads
+                # see the written value (Python read-after-write semantics)
+                return ("sym", w)
             return ("live", j)
         return ("const", a)
 
@@ -118,6 +127,24 @@ class Program:
                 o._st_sym = (self, sym)
         self._nodes.append(_Node(fn, dict(kwargs or {}), in_refs, out_ids,
                                  isinstance(out, (tuple, list)), name))
+
+    def _record_state_write(self, target, value):
+        """set_value(captured_tensor) during capture: promote the mutation to
+        program state instead of baking the build-time placeholder value (the
+        analog of the reference's in-graph MeanOut/VarianceOut outputs,
+        fluid/operators/batch_norm_op.cc).  Returns True when recorded (the
+        caller then skips the eager rebind so the buffer keeps its
+        pre-capture value as the step-1 input)."""
+        sym = getattr(value, "_st_sym", None)
+        if sym is None or sym[0]._nodes is not self._nodes:
+            return False
+        j = self._live_ids.get(id(target))
+        if j is None:
+            j = len(self._lives)
+            self._lives.append(target)
+            self._live_ids[id(target)] = j
+        self._state_writes[j] = sym[1]
+        return True
 
     def _set_objective(self, loss, optimizer):
         sym = getattr(loss, "_st_sym", None)
@@ -214,9 +241,11 @@ class Program:
                     loss_sym, opt, tr_idx, fetch_syms)
             live_vals = [t._value for t in self._lives]
             lr = jnp.asarray(opt.get_lr(), jnp.float32)
-            fetched, new_train, new_opt = self._compiled[key](
+            fetched, new_train, new_opt, new_state = self._compiled[key](
                 feed_arrays, live_vals, self._opt_state, lr)
             for j, v in new_train.items():
+                self._lives[j]._rebind(v)
+            for j, v in new_state.items():
                 self._lives[j]._rebind(v)
             self._opt_state = new_opt
             opt._step_count += 1
@@ -224,25 +253,31 @@ class Program:
             if key not in self._compiled:
                 self._compiled[key] = self._compile_infer(fetch_syms)
             live_vals = [t._value for t in self._lives]
-            fetched = self._compiled[key](feed_arrays, live_vals)
+            fetched, new_state = self._compiled[key](feed_arrays, live_vals)
+            for j, v in new_state.items():
+                self._lives[j]._rebind(v)
         return [np.asarray(f) for f in fetched]
 
     def _compile_infer(self, fetch_syms):
-        nodes, _ = self._prune(fetch_syms)
+        writes = dict(self._state_writes)
+        nodes, _ = self._prune(tuple(fetch_syms) + tuple(writes.values()))
 
         def fn(feed_arrays, live_vals):
             env = dict(feed_arrays)
             self._replay(env, live_vals, nodes)
-            return tuple(live_vals[s[1]] if isinstance(s, tuple) else env[s]
-                         for s in fetch_syms)
+            fetched = tuple(live_vals[s[1]] if isinstance(s, tuple) else env[s]
+                            for s in fetch_syms)
+            return fetched, {j: env[s] for j, s in writes.items()}
 
         return jax.jit(fn)
 
     def _compile_train(self, loss_sym, opt, tr_idx, fetch_syms):
         # per-param decay specs are static python values — close over them
         decays = {j: opt._param_decay_coeff(self._lives[j]) for j in tr_idx}
+        writes = dict(self._state_writes)
 
-        nodes, _ = self._prune(tuple(fetch_syms) + (loss_sym,))
+        nodes, _ = self._prune(tuple(fetch_syms) + (loss_sym,)
+                               + tuple(writes.values()))
 
         def fn(feed_arrays, live_vals, opt_state, lr):
             def loss_of(train_vals):
@@ -263,7 +298,10 @@ class Program:
             fetched = tuple(
                 live_vals[s[1]] if isinstance(s, tuple) else env[s]
                 for s in fetch_syms)
-            return fetched, new_train, new_opt
+            # buffer-state outputs (BN running stats): jax.lax.stop_gradient
+            # is unnecessary — grads flow only to train_vals
+            new_state = {j: env[s] for j, s in writes.items()}
+            return fetched, new_train, new_opt, new_state
 
         return jax.jit(fn)
 
@@ -294,6 +332,9 @@ class Program:
         p._objective = None if for_test else self._objective
         p._opt_state = None
         p._compiled = {}
+        # a for_test clone must not update buffer state (BN running stats
+        # stay frozen at evaluation — ref Program.clone is_test rewrite)
+        p._state_writes = {} if for_test else dict(self._state_writes)
         p.random_seed = self.random_seed
         return p
 
@@ -345,10 +386,18 @@ def _capture_hook(fn, args, kwargs, out, name):
         _active._record(fn, args, kwargs, out, name)
 
 
+def _state_write_hook(target, value):
+    if _active is not None:
+        return _active._record_state_write(target, value)
+    return False
+
+
 def _activate(program):
     global _active
     _active = program
     _tensor_mod._static_capture_hook = _capture_hook if program is not None else None
+    _tensor_mod._static_state_write_hook = _state_write_hook if program is not None else None
+    _tensor_mod._static_active_program = program
 
 
 def capture_active():

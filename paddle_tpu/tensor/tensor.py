@@ -108,13 +108,16 @@ class Tensor:
 
     # ------------------------------------------------------------------ numpy bridge
     def numpy(self):
+        self._guard_static_inspect("numpy()")
         return np.asarray(self._value)
 
     def __array__(self, dtype=None):
+        self._guard_static_inspect("np.asarray()")
         arr = np.asarray(self._value)
         return arr.astype(dtype) if dtype is not None else arr
 
     def item(self, *args):
+        self._guard_static_inspect("item()")
         return self._value.item(*args)
 
     def tolist(self):
@@ -132,15 +135,33 @@ class Tensor:
             f"       {np.array2string(np.asarray(jax.device_get(self._value)), prefix='       ')})"
         )
 
+    def _guard_static_inspect(self, what):
+        """Raise when build-time code inspects the VALUE of a symbolic tensor
+        during static capture: builders execute on zero placeholders, so any
+        Python branching on the value would silently bake in the zero branch.
+        (The reference's static Variable cannot be value-inspected at all.)"""
+        sym = getattr(self, "_st_sym", None)
+        if sym is not None and _static_active_program is not None \
+                and sym[0] is _static_active_program:
+            raise RuntimeError(
+                f"static capture: {what} called on a symbolic tensor during "
+                "program build — its value here is a zero placeholder, not "
+                "runtime data.  Use static.nn.cond/while_loop for "
+                "value-dependent control flow, or fetch the value via "
+                "Executor.run")
+
     def __bool__(self):
+        self._guard_static_inspect("bool()")
         if self.size != 1:
             raise ValueError("truth value of multi-element Tensor is ambiguous")
         return bool(self._value)
 
     def __int__(self):
+        self._guard_static_inspect("int()")
         return int(self._value)
 
     def __float__(self):
+        self._guard_static_inspect("float()")
         return float(self._value)
 
     def __hash__(self):
@@ -174,6 +195,13 @@ class Tensor:
 
     def detach(self) -> "Tensor":
         t = Tensor(self._value, stop_gradient=True, name=self.name)
+        sym = getattr(self, "_st_sym", None)
+        if sym is not None:
+            # detach is identity on the value: under static capture the
+            # detached view keeps the symbolic identity (otherwise it would
+            # be mis-classified as an external live leaf holding its
+            # build-time placeholder value)
+            t._st_sym = sym
         return t
 
     def detach_(self):
@@ -204,7 +232,14 @@ class Tensor:
     # ------------------------------------------------------------------ mutation
     def set_value(self, value):
         """In-place value swap (rebind; the old autograd history is kept for grads
-        already recorded — matches reference set_value semantics for parameters)."""
+        already recorded — matches reference set_value semantics for parameters).
+
+        Under static capture, setting a captured value records a program
+        STATE WRITE (the analog of batch_norm's MeanOut in-graph output) and
+        leaves the eager value untouched — the compiled step updates it."""
+        if _static_state_write_hook is not None and isinstance(value, Tensor):
+            if _static_state_write_hook(self, value):
+                return self
         v = _unwrap(value)
         if not isinstance(v, (jax.Array, jax.core.Tracer)):
             v = jnp.asarray(v, dtype=self._value.dtype)
@@ -338,6 +373,11 @@ _amp_cast_hook = None
 # set by static.program._activate while a Program capture is live: records
 # (pure_fn, tensor_args, raw_kwargs, outputs, name) onto the active Program
 _static_capture_hook = None
+# set alongside: set_value(captured) promotes buffer mutations to program
+# state (BN running stats); the active program enables the value-inspection
+# guard on placeholder-derived tensors
+_static_state_write_hook = None
+_static_active_program = None
 _amp_state_ref = None
 
 

@@ -124,3 +124,92 @@ def test_enable_static_mode_default_program():
 
 
 pytestmark = [*globals().get("pytestmark", []), pytest.mark.quick]
+
+
+def test_static_bn_running_stats_update_and_train_parity():
+    """BN moving mean/var are LIVE program state in static mode: the compiled
+    train step updates them exactly like dygraph (the analog of the
+    reference's in-graph MeanOut/VarianceOut, fluid/operators/batch_norm_op.cc)."""
+    rng = np.random.RandomState(7)
+    xs = rng.randn(4 * 16, 1, 8, 8).astype(np.float32) * 3 + 1
+    ys = rng.randint(0, 3, size=(4 * 16,)).astype(np.int64)
+
+    def make_net():
+        paddle.seed(123)
+        return paddle.nn.Sequential(
+            paddle.nn.Conv2D(1, 4, 3, padding=1),
+            paddle.nn.BatchNorm2D(4),
+            paddle.nn.ReLU(),
+            paddle.nn.Flatten(),
+            paddle.nn.Linear(4 * 8 * 8, 3),
+        )
+
+    # ---- dygraph oracle
+    dy_net = make_net()
+    dy_opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=dy_net.parameters())
+    dy_losses = []
+    for step in range(4):
+        xb = paddle.to_tensor(xs[step * 16:(step + 1) * 16])
+        yb = paddle.to_tensor(ys[step * 16:(step + 1) * 16])
+        loss = paddle.nn.functional.cross_entropy(dy_net(xb), yb)
+        loss.backward()
+        dy_opt.step()
+        dy_opt.clear_grad()
+        dy_losses.append(float(np.asarray(loss._value)))
+    dy_bn = dy_net[1]
+    dy_mean = np.asarray(dy_bn._mean._value)
+    dy_var = np.asarray(dy_bn._variance._value)
+    assert not np.allclose(dy_mean, 0.0)  # stats actually moved
+
+    # ---- static twin
+    st_net = make_net()
+    st_bn = st_net[1]
+    init_mean = np.asarray(st_bn._mean._value).copy()
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [16, 1, 8, 8], "float32")
+        y = static.data("y", [16], "int64")
+        loss = paddle.nn.functional.cross_entropy(st_net(x), y)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    # capture must not have touched the buffers eagerly
+    np.testing.assert_array_equal(np.asarray(st_bn._mean._value), init_mean)
+
+    exe = static.Executor()
+    exe.run(startup)
+    st_losses = []
+    for step in range(4):
+        lv, = exe.run(main, feed={"x": xs[step * 16:(step + 1) * 16],
+                                  "y": ys[step * 16:(step + 1) * 16]},
+                      fetch_list=[loss])
+        st_losses.append(float(lv))
+
+    np.testing.assert_allclose(st_losses, dy_losses, rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(st_bn._mean._value), dy_mean,
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(st_bn._variance._value), dy_var,
+                               rtol=2e-4, atol=2e-5)
+
+    # for_test clone: frozen stats, no update on run
+    test_prog = main.clone(for_test=True)
+    mean_before = np.asarray(st_bn._mean._value).copy()
+    exe.run(test_prog, feed={"x": xs[:16], "y": ys[:16]}, fetch_list=[loss])
+    np.testing.assert_array_equal(np.asarray(st_bn._mean._value), mean_before)
+
+
+def test_static_capture_guard_on_value_inspection():
+    """Python-level value inspection of a symbolic tensor during capture
+    raises instead of silently baking the placeholder branch (the reference's
+    static Variable cannot be value-inspected at all)."""
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [4], "float32")
+        h = x * 2
+        with pytest.raises(RuntimeError, match="static capture"):
+            bool(h.sum() > 0)
+        with pytest.raises(RuntimeError, match="static capture"):
+            h.item(0)
+        with pytest.raises(RuntimeError, match="static capture"):
+            h.numpy()
+        with pytest.raises(RuntimeError, match="static capture"):
+            float(h.sum())
