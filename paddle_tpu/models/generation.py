@@ -48,12 +48,11 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     the rest of that row is `pad_token_id`.  The model must expose
     `generate_step(ids, caches)` (prefill/decode) — LlamaForCausalLM does.
 
-    cache_dtype="int8" stores the kv-cache quantized (per-token-head
-    absmax scales), HALVING the cache's HBM footprint — the lever for
-    longer contexts / bigger decode batches on a full chip.  Measured on
-    v5e: the dequant does NOT stay fused into the attention reads (XLA
-    materializes the bf16 cache per step), so int8 currently trades
-    ms/token for capacity; prefer the default cache when HBM fits.
+    cache_dtype="int8" stores the kv-cache quantized (per-head-token
+    absmax scales), HALVING the cache's HBM footprint AND the kv bytes the
+    decode step streams: the Pallas decode-attention kernel
+    (ops/decode_attention.py) reads the int8 buffers directly and
+    dequantizes in VMEM — capacity and speed lever in one.
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
@@ -90,15 +89,19 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         try:
             with tape.no_grad():
                 logits, caches = model.generate_step(Tensor(ids))
-                # convert the prefill's concat-caches into static buffers
+                # convert the prefill's concat-caches into HEAD-MAJOR static
+                # buffers [B, H, L, D]; L is padded up to a multiple of 128 so
+                # the Pallas decode kernel's key blocks tile cleanly (the
+                # padded tail is never valid, the kernel masks by position)
+                L_pad = ((total + 127) // 128) * 128
                 static = []
                 for (k, v) in caches:
-                    kv_pad = [(0, 0), (0, total - S0), (0, 0), (0, 0)]
-                    kp = jnp.pad(k._value, kv_pad)
-                    vp = jnp.pad(v._value, kv_pad)
+                    pad = [(0, 0), (0, 0), (0, L_pad - S0), (0, 0)]
+                    kp = jnp.pad(jnp.transpose(k._value, (0, 2, 1, 3)), pad)
+                    vp = jnp.pad(jnp.transpose(v._value, (0, 2, 1, 3)), pad)
                     pos = jnp.asarray(S0, jnp.int32)
                     if cache_dtype == "int8":
-                        from .llama import _quantize_kv
+                        from .kv_cache import _quantize_kv
 
                         kq, ks = _quantize_kv(kp)
                         vq, vs = _quantize_kv(vp)

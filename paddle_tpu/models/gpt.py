@@ -61,26 +61,31 @@ class GPTBlock(nn.Layer):
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn_mask = None
         if cache is not None and len(cache) in (3, 5):
-            # static (k_buf, v_buf, pos) layout for the compiled generate
-            # loop; the 5-tuple adds (k_scale, v_scale) for the int8 cache
-            # (see llama.py _quantize_kv — capacity lever)
-            import jax
-            import jax.numpy as jnp
+            # static head-major (k_buf, v_buf, pos) layout for the compiled
+            # generate loop; the 5-tuple adds (k_scale, v_scale) for the int8
+            # cache (kv_cache._quantize_kv) — the decode-attention kernel
+            # dequantizes in VMEM and masks by the carried valid length
+            from ..tensor.tensor import apply_op
 
-            from ..tensor.tensor import Tensor, apply_op
-
+            from ..ops.decode_attention import decode_attention
             from .kv_cache import update_plain_cache, update_quant_cache
 
             offset = cache[2]
             if len(cache) == 5:
-                new_cache, k, v = update_quant_cache(cache, k, v, offset,
-                                                     x.dtype)
+                new_cache, k_q, v_q, k_sc, v_sc = update_quant_cache(
+                    cache, k, v, offset, x.dtype)
+                attn = apply_op(
+                    lambda qq, kk, vv, ks, vs: decode_attention(
+                        qq, kk, vv, offset, ks, vs),
+                    (q, k_q, v_q, k_sc, v_sc), name="decode_attention")
             else:
-                new_cache, k, v = update_plain_cache(cache, k, v, offset)
-            L = k.shape[1]
-            jpos = jnp.arange(L)[None, :]
-            qpos = jnp.arange(S)[:, None] + offset
-            attn_mask = Tensor(jnp.where(jpos <= qpos, 0.0, -1e9)[None, None])
+                new_cache, k_b, v_b = update_plain_cache(cache, k, v, offset)
+                attn = apply_op(
+                    lambda qq, kk, vv: decode_attention(qq, kk, vv, offset),
+                    (q, k_b, v_b), name="decode_attention")
+            x = x + self.drop(self.proj(attn.reshape([B, S, -1])))
+            x = x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+            return x, new_cache
         elif cache is not None:
             from ..tensor import manipulation as M
 

@@ -2,10 +2,21 @@
 
 Two layouts, distinguished by tuple length (see generation.generate):
   (k_buf, v_buf, pos)                      — plain, cache dtype = kv dtype
-  (k_q, v_q, pos, k_scale, v_scale)        — int8 + per-(token, head) absmax
+  (k_q, v_q, pos, k_scale, v_scale)        — int8 + per-(head, token) absmax
                                              scales: HALF the HBM footprint
-Both LlamaAttention and GPTBlock call the helpers here so the quantization
-contract lives in one place.
+                                             AND half the decode stream when
+                                             the Pallas decode kernel runs
+                                             (ops/decode_attention.py
+                                             dequantizes in VMEM)
+
+Buffers are HEAD-MAJOR [B, H, L, D] (scales [B, H, L]): each (batch, head)
+streams contiguous [L, D] keys/values — the layout the decode kernel and the
+flash prefill kernel both want, with no per-step relayout.  New k/v arrive
+from the projections as [B, S, H, D] and are transposed (cheap: S is 1 in
+the decode loop) before the scatter at axis 2.
+
+Both LlamaAttention and GPTBlock call the helpers here so the layout and
+quantization contracts live in one place.
 """
 from __future__ import annotations
 
@@ -16,41 +27,44 @@ from ..tensor.tensor import apply_op
 
 
 def _quantize_kv(kv):
-    """Per-(token, head) absmax int8 quantization of a [B, S, H, D] slice:
-    returns (int8 values, f32 scale [B, S, H, 1])."""
+    """Per-(head, token) absmax int8 quantization of a HEAD-MAJOR
+    [B, H, S, D] slice: returns (int8 values, f32 scale [B, H, S])."""
     f = kv.astype(jnp.float32)
-    scale = jnp.max(jnp.abs(f), axis=-1, keepdims=True) / 127.0
+    scale = jnp.max(jnp.abs(f), axis=-1) / 127.0
     scale = jnp.maximum(scale, 1e-8)
-    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+    q = jnp.clip(jnp.round(f / scale[..., None]), -127, 127).astype(jnp.int8)
     return q, scale.astype(jnp.float32)
 
 
+def _to_head_major(kv):
+    """[B, S, H, D] (projection layout) -> [B, H, S, D] (cache layout)."""
+    return jnp.transpose(kv, (0, 2, 1, 3))
+
+
 def update_plain_cache(cache, k, v, offset):
-    """Scatter new k/v into the (k_buf, v_buf, pos) layout.
-    Returns (new_cache, k_full, v_full)."""
+    """Scatter new k/v [B, S, H, D] into the head-major (k_buf, v_buf, pos)
+    layout.  Returns (new_cache, k_full, v_full) with the full buffers in
+    head-major [B, H, L, D]."""
     S = k.shape[1]
     upd = lambda buf, kv: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
-        buf, kv.astype(buf.dtype), offset, 1)
+        buf, _to_head_major(kv.astype(buf.dtype)), offset, 2)
     k_buf = apply_op(upd, (cache[0], k), name="kv_scatter")
     v_buf = apply_op(upd, (cache[1], v), name="kv_scatter")
     return (k_buf, v_buf, offset + S), k_buf, v_buf
 
 
 def update_quant_cache(cache, k, v, offset, out_dtype):
-    """Quantize + scatter new k/v into the 5-tuple int8 layout and
-    dequantize the full buffers for this step's attention.  Measured on
-    v5e: XLA materializes the dequant (capacity lever, costs ms/token —
-    see generation.generate).  Returns (new_cache, k_deq, v_deq)."""
+    """Quantize + scatter new k/v [B, S, H, D] into the head-major 5-tuple
+    int8 layout.  Returns (new_cache, k_q, v_q, k_scale, v_scale) — the
+    int8 buffers and scales go STRAIGHT to the decode kernel, which
+    dequantizes in VMEM (no bf16 cache materialization in HBM)."""
     S = k.shape[1]
 
     def upd_q(buf, sbuf, kv):
-        kv_q, scale = _quantize_kv(kv)
-        return (jax.lax.dynamic_update_slice_in_dim(buf, kv_q, offset, 1),
-                jax.lax.dynamic_update_slice_in_dim(sbuf, scale, offset, 1))
+        kv_q, scale = _quantize_kv(_to_head_major(kv))
+        return (jax.lax.dynamic_update_slice_in_dim(buf, kv_q, offset, 2),
+                jax.lax.dynamic_update_slice_in_dim(sbuf, scale, offset, 2))
 
     k_buf, k_sc = apply_op(upd_q, (cache[0], cache[3], k), name="kv_scatter_q")
     v_buf, v_sc = apply_op(upd_q, (cache[1], cache[4], v), name="kv_scatter_q")
-    deq = lambda b, s: b.astype(out_dtype) * s.astype(out_dtype)  # noqa: E731
-    k_deq = apply_op(deq, (k_buf, k_sc), name="kv_dequant")
-    v_deq = apply_op(deq, (v_buf, v_sc), name="kv_dequant")
-    return (k_buf, v_buf, offset + S, k_sc, v_sc), k_deq, v_deq
+    return (k_buf, v_buf, offset + S, k_sc, v_sc), k_buf, v_buf, k_sc, v_sc

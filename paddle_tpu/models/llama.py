@@ -157,12 +157,12 @@ class LlamaAttention(nn.Layer):
             v = self.v_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
 
         # a 3-tuple cache (k_buf, v_buf, pos) is the STATIC layout used by the
-        # compiled generate() loop: fixed-size buffers + in-place scatter, so
-        # every decode step has identical shapes and compiles once.  A 5-tuple
-        # (k_q, v_q, pos, k_scale, v_scale) is the int8-quantized variant:
-        # per-(token, head) absmax scales, HALF the cache HBM footprint
-        # (capacity lever; on current XLA the dequant materializes, so it
-        # costs ms/token — see generation.generate).
+        # compiled generate() loop: fixed-size HEAD-MAJOR [B, H, L, D] buffers
+        # + in-place scatter, so every decode step has identical shapes and
+        # compiles once.  A 5-tuple (k_q, v_q, pos, k_scale, v_scale) is the
+        # int8-quantized variant: per-(head, token) absmax scales — HALF the
+        # cache HBM footprint AND half the decode stream (the Pallas decode
+        # kernel dequantizes in VMEM; ops/decode_attention.py).
         static_cache = cache is not None and len(cache) in (3, 5)
         quant_cache = cache is not None and len(cache) == 5
         if static_cache:
@@ -172,15 +172,44 @@ class LlamaAttention(nn.Layer):
         q = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (q, rope_cos, rope_sin), name="rope")
         k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, rope_cos, rope_sin), name="rope")
 
-        if quant_cache:
-            new_cache, k, v = update_quant_cache(cache, k, v, offset,
-                                                 hidden_states.dtype)
-            if attn_mask is None:
-                attn_mask = Tensor(_static_decode_mask(offset, S, k.shape[1]))
-        elif static_cache:
-            new_cache, k, v = update_plain_cache(cache, k, v, offset)
-            if attn_mask is None:
-                attn_mask = Tensor(_static_decode_mask(offset, S, k.shape[1]))
+        if static_cache and attn_mask is None:
+            # decode hot path: single-query attention straight off the
+            # head-major static cache (Pallas on TPU, dense math elsewhere)
+            from ..ops.decode_attention import decode_attention
+
+            if quant_cache:
+                new_cache, k_q, v_q, k_sc, v_sc = update_quant_cache(
+                    cache, k, v, offset, hidden_states.dtype)
+                out = apply_op(
+                    lambda qq, kk, vv, ks, vs: decode_attention(
+                        qq, kk, vv, offset, ks, vs),
+                    (q, k_q, v_q, k_sc, v_sc), name="decode_attention")
+            else:
+                new_cache, k_b, v_b = update_plain_cache(cache, k, v, offset)
+                out = apply_op(
+                    lambda qq, kk, vv: decode_attention(qq, kk, vv, offset),
+                    (q, k_b, v_b), name="decode_attention")
+            out = out.reshape([B, S, self.num_heads * self.head_dim])
+            out = self.o_proj(out)
+            if use_cache:
+                return out, new_cache
+            return out
+
+        if static_cache:
+            # external mask with a static cache: dense path over the
+            # head-major buffers brought back to [B, L, H, D]
+            if quant_cache:
+                new_cache, k_q, v_q, k_sc, v_sc = update_quant_cache(
+                    cache, k, v, offset, hidden_states.dtype)
+                deq = lambda b, s, dt=hidden_states.dtype: jnp.transpose(  # noqa: E731
+                    b.astype(dt) * s.astype(dt)[..., None], (0, 2, 1, 3))
+                k = apply_op(deq, (k_q, k_sc), name="kv_dequant")
+                v = apply_op(deq, (v_q, v_sc), name="kv_dequant")
+            else:
+                new_cache, k_b, v_b = update_plain_cache(cache, k, v, offset)
+                tohm = lambda b: jnp.transpose(b, (0, 2, 1, 3))  # noqa: E731
+                k = apply_op(tohm, (k_b,), name="kv_unpack")
+                v = apply_op(tohm, (v_b,), name="kv_unpack")
         else:
             if cache is not None:
                 k = M.concat([cache[0], k], axis=1)
@@ -290,14 +319,8 @@ class LlamaModel(nn.Layer):
             caches = [None] * len(self.layers)
         x = self.embed_tokens(input_ids)
         rope = (self.rope_cos, self.rope_sin)
-        if (attn_mask is None and caches is not None and caches[0] is not None
-                and len(caches[0]) in (3, 5)):
-            # static-cache decode (plain 3-tuple or int8 5-tuple — offset and
-            # buffer length sit at the same tuple positions in both layouts):
-            # the causal/padding mask is identical for every layer — build it
-            # ONCE per step, not num_layers times in the scan body
-            attn_mask = Tensor(_static_decode_mask(
-                caches[0][2], input_ids.shape[1], caches[0][0].shape[1]))
+        # static-cache decode needs NO mask tensor: the decode-attention
+        # kernel masks by the carried valid length (ops/decode_attention.py)
         new_caches = [] if use_cache else None
         for i, layer in enumerate(self.layers):
             if use_cache:

@@ -1,0 +1,186 @@
+"""Pallas TPU single-query (decode) attention over a static kv-cache.
+
+Reference gap: the snapshot has no decode-path attention at all (its
+AnalysisPredictor era predates kv-cache serving); the XLA-composed decode
+attention this replaces reads the head-minor [B, L, H, D] cache through
+strided gathers and realizes well under half of the chip's streaming
+bandwidth.  This kernel owns the decode hot loop instead:
+
+- the static cache is HEAD-MAJOR [B, H, L, D]: each (batch, head) grid point
+  streams its keys/values as one contiguous [L, D] block (minor dims satisfy
+  the (8, 128) Mosaic tile) — no relayout between HBM and the VPU;
+- online softmax over key blocks (the flash recipe at query-length 1);
+- optional int8 cache: the kernel dequantizes INSIDE VMEM against
+  per-(head, token) scales, so the int8 cache HALVES the HBM bytes decode
+  actually streams — on XLA the dequantized bf16 buffer materializes to HBM
+  and int8 was a capacity-only lever (models/kv_cache.py history);
+- GQA folds into the BlockSpec index map (query head h reads kv head
+  h // rep) — kv blocks are fetched once per query head with no repeated
+  materialization;
+- the valid-length mask rides a scalar-prefetch argument, replacing the
+  [1, 1, S, L] additive-mask tensor the composed path rebuilt every step.
+
+Forward-only by design: decode runs under no_grad inside the compiled
+generate() loop (models/generation.py).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret_default():
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, bk, L, scale, quant,
+                   ks_ref=None, vs_ref=None):
+    """One (batch, head) grid point: q [D] against k/v [L, D].  Scales ride
+    as [L // 128, 128] f32 views (the Mosaic lane-tiling shape for a
+    per-token vector)."""
+    q = q_ref[0, 0]  # [1, D], storage dtype (bf16 MXU inputs)
+    valid = len_ref[0]  # keys 0..valid-1 are attendable
+    nkb = L // bk
+
+    def body(kj, carry):
+        m, l, acc = carry
+        k = k_ref[0, 0, pl.ds(kj * bk, bk), :]  # [bk, D]
+        v = v_ref[0, 0, pl.ds(kj * bk, bk), :]
+        if quant:
+            k = k.astype(jnp.bfloat16)  # int8 payload exact in bf16
+            v = v.astype(jnp.bfloat16)
+        # lane-major scores: [1, D] @ [bk, D]^T on the MXU
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale  # [1, bk]
+        if quant:
+            rows = bk // 128
+            ks = ks_ref[0, 0, pl.ds(kj * rows, rows), :].reshape(1, bk)
+            s = s * ks
+        kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
+        s = jnp.where(kpos < valid, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s))
+        p = jnp.exp(s - m_new)  # [1, bk] f32
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p)  # normalizer BEFORE any value scaling
+        if quant:
+            vs = vs_ref[0, 0, pl.ds(kj * rows, rows), :].reshape(1, bk)
+            p = p * vs  # fold the value scales into the probs
+        acc = acc * corr + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)  # [1, D]
+        return m_new, l, acc
+
+    m0 = jnp.float32(NEG_INF)
+    l0 = jnp.float32(0.0)
+    acc0 = jnp.zeros((1, q.shape[1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, acc0))
+    o_ref[0, 0, 0] = (acc[0] / l).astype(o_ref.dtype)
+
+
+def _decode_pallas(q, k, v, offset, k_scale, v_scale, scale, bk, interpret):
+    B, S, H, D = q.shape
+    Hkv, L = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    quant = k_scale is not None
+    valid = jnp.reshape(jnp.asarray(offset, jnp.int32) + S, (1,))
+    # head-major query so every block's trailing dims are tile-clean
+    q = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, 1, D]
+
+    # index maps receive the prefetched scalar ref as a trailing argument
+    in_specs = [
+        pl.BlockSpec((1, 1, 1, D), lambda b, h, _len: (b, h, 0, 0)),
+        pl.BlockSpec((1, 1, L, D), lambda b, h, _len: (b, h // rep, 0, 0)),
+        pl.BlockSpec((1, 1, L, D), lambda b, h, _len: (b, h // rep, 0, 0)),
+    ]
+    args = [q, k, v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, 1, L // 128, 128),
+                         lambda b, h, _len: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, 1, L // 128, 128),
+                         lambda b, h, _len: (b, h // rep, 0, 0)),
+        ]
+        args += [k_scale.reshape(B, Hkv, L // 128, 128),
+                 v_scale.reshape(B, Hkv, L // 128, 128)]
+
+    kernel = functools.partial(_decode_kernel, bk=bk, L=L, scale=scale,
+                               quant=quant)
+    if quant:
+        def kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref):
+            return _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
+                                  bk=bk, L=L, scale=scale, quant=True,
+                                  ks_ref=ks_ref, vs_ref=vs_ref)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(B, H),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, 1, 1, D), lambda b, h, _len: (b, h, 0, 0)),
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")),
+    )(valid, *args)
+    return jnp.transpose(out, (0, 2, 1, 3))  # back to [B, S=1, H, D]
+
+
+def _decode_dense(q, k, v, offset, k_scale, v_scale, scale):
+    """XLA fallback (CPU tests, S > 1, odd shapes): same math, dense."""
+    B, S, H, D = q.shape
+    Hkv, L = k.shape[1], k.shape[2]
+    rep = H // Hkv
+    if k_scale is not None:
+        k = k.astype(q.dtype) * k_scale.astype(q.dtype)[..., None]
+        v = v.astype(q.dtype) * v_scale.astype(q.dtype)[..., None]
+    if rep > 1:
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    s = jnp.einsum("bshd,bhld->bhsl", q, k).astype(jnp.float32) * scale
+    kpos = jnp.arange(L)[None, None, None, :]
+    qpos = jnp.asarray(offset, jnp.int32) + jnp.arange(S)[None, None, :, None]
+    s = jnp.where(kpos <= qpos, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhsl,bhld->bshd", p, v)
+
+
+def decode_attention(q, k, v, offset, k_scale=None, v_scale=None, scale=None,
+                     block_k=None, interpret=None):
+    """Attention of q [B, S, H, D] against a head-major static cache
+    k/v [B, Hkv, L, D] whose first `offset + s` positions are valid for
+    query position s.  int8 caches pass per-(head, token) scales [B, Hkv, L].
+    Returns [B, S, H, D] in q's dtype."""
+    B, S, H, D = q.shape
+    L = k.shape[2]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+    bk = block_k
+    if bk is None:
+        for cand in (512, 384, 256, 128):
+            if L % cand == 0:
+                bk = cand
+                break
+    shapes_ok = (S == 1 and D % 128 == 0 and bk is not None
+                 and L % bk == 0 and H % k.shape[1] == 0
+                 and (k_scale is None or L % 128 == 0))
+    # Measured on v5e (same-session A/B, 12-layer 738M decode, P=1024):
+    #   int8:  kernel 3.7 ms/tok vs dense-XLA 6.8 (the XLA path materializes
+    #          the dequantized bf16 cache in HBM) -> kernel always.
+    #   bf16:  kernel 3.5 vs dense 3.8 at B=8, but dense 6.8 vs kernel 9.6 at
+    #          B=32 (the per-(b,h) DMA grid stops amortizing) -> kernel only
+    #          while the grid stays small.
+    use_kernel = shapes_ok and (k_scale is not None or B * H <= 192)
+    if use_kernel:
+        return _decode_pallas(q, k, v, offset, k_scale, v_scale, scale, bk,
+                              interpret)
+    return _decode_dense(q, k, v, offset, k_scale, v_scale, scale)

@@ -1,0 +1,78 @@
+"""Pallas decode-attention kernel vs the dense oracle (interpret mode on CPU).
+
+The kernel owns the generate() hot loop (ops/decode_attention.py): single
+query against a head-major static cache, online softmax over key blocks,
+valid-length masking via scalar prefetch, optional in-VMEM int8 dequant,
+GQA through the BlockSpec index map."""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.decode_attention import (
+    _decode_dense, _decode_pallas, decode_attention)
+from paddle_tpu.models.kv_cache import _quantize_kv
+
+pytestmark = [pytest.mark.quick]
+
+
+def _mk(B=2, H=8, Hkv=8, L=256, D=128, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, 1, H, D).astype(dtype) * 0.3)
+    k = jnp.asarray(rng.randn(B, Hkv, L, D).astype(dtype) * 0.3)
+    v = jnp.asarray(rng.randn(B, Hkv, L, D).astype(dtype) * 0.3)
+    return q, k, v
+
+
+def test_kernel_matches_dense():
+    q, k, v = _mk()
+    offset = 100
+    got = _decode_pallas(q, k, v, offset, None, None, scale=1 / 128 ** 0.5,
+                         bk=128, interpret=True)
+    want = _decode_dense(q, k, v, offset, None, None, scale=1 / 128 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_masks_by_valid_length():
+    q, k, v = _mk()
+    # poison the invalid tail: it must not leak into the output
+    k = k.at[:, :, 120:, :].set(1e4)
+    v = v.at[:, :, 120:, :].set(1e4)
+    got = decode_attention(q, k, v, offset=119, interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+    assert np.abs(np.asarray(got)).max() < 1e2
+
+
+def test_kernel_gqa_head_mapping():
+    q, k, v = _mk(H=8, Hkv=2)
+    got = _decode_pallas(q, k, v, 200, None, None, scale=0.1, bk=128,
+                         interpret=True)
+    want = _decode_dense(q, k, v, 200, None, None, scale=0.1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_kernel_int8_dequant_in_kernel():
+    q, k, v = _mk()
+    kq, ks = _quantize_kv(k)
+    vq, vs = _quantize_kv(v)
+    got = _decode_pallas(q, kq, vq, 180, ks, vs, scale=1 / 128 ** 0.5,
+                         bk=128, interpret=True)
+    # oracle: dense attention on the DEQUANTIZED cache
+    kd = kq.astype(q.dtype) * ks[..., None]
+    vd = vq.astype(q.dtype) * vs[..., None]
+    want = _decode_dense(q, kd, vd, 180, None, None, scale=1 / 128 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_dispatcher_falls_back_for_multi_query():
+    q, k, v = _mk()
+    q2 = jnp.concatenate([q, q], axis=1)  # S=2 -> dense path
+    out = decode_attention(q2, k, v, offset=10, interpret=True)
+    assert out.shape == (2, 2, 8, 128)
+    # rows see strictly growing prefixes: position 1 attends one more key
+    o0 = decode_attention(q, k, v, offset=10, interpret=True)
+    np.testing.assert_allclose(np.asarray(out[:, :1]), np.asarray(o0),
+                               rtol=2e-5, atol=2e-5)

@@ -103,11 +103,12 @@ def test_int8_kv_cache_decode_tracks_fp():
     import jax.numpy as jnp
 
     # roundtrip bound: |x - dq(q(x))| <= scale/2 = absmax/254
+    # (_quantize_kv takes HEAD-MAJOR [B, H, S, D], returns scale [B, H, S])
     rng = np.random.RandomState(0)
     kv = jnp.asarray(rng.randn(2, 5, 3, 8).astype(np.float32))
     q, s = _quantize_kv(kv)
-    err = np.abs(np.asarray(q.astype(jnp.float32) * s - kv))
-    bound = np.asarray(s)[..., 0] / 2 + 1e-7
+    err = np.abs(np.asarray(q.astype(jnp.float32) * s[..., None] - kv))
+    bound = np.asarray(s) / 2 + 1e-7
     assert (err.max(-1) <= bound).all()
 
     paddle.seed(0)
@@ -123,13 +124,16 @@ def test_int8_kv_cache_decode_tracks_fp():
         out = []
         for (k, v) in caches:
             pos = jnp.asarray(k.shape[1], jnp.int32)
+            # static buffers are head-major [B, H, L, D]
+            khm = jnp.transpose(k._value, (0, 2, 1, 3))
+            vhm = jnp.transpose(v._value, (0, 2, 1, 3))
             if quant:
-                kq, ks = _quantize_kv(k._value)
-                vq, vs = _quantize_kv(v._value)
+                kq, ks = _quantize_kv(khm)
+                vq, vs = _quantize_kv(vhm)
                 out.append((paddle.Tensor(kq), paddle.Tensor(vq), pos,
                             paddle.Tensor(ks), paddle.Tensor(vs)))
             else:
-                out.append((k, v, pos))
+                out.append((paddle.Tensor(khm), paddle.Tensor(vhm), pos))
         return out
     nxt = paddle.to_tensor(np.argmax(np.asarray(fp_logits._value)[:, -1], -1)
                            .astype(np.int32)[:, None])
