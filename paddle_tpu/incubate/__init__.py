@@ -177,15 +177,22 @@ def graph_send_recv(x, src_index, dst_index, pool_type="sum", out_size=None,
 
 
 def segment_sum(data, segment_ids):
+    """Ref incubate/tensor/math.py segment_sum.  Eager with concrete ids
+    returns the reference [max_id+1, ...] shape; under a trace the result is
+    padded to the static row-count bound like the other segment reductions
+    (XLA needs static shapes; callers slice)."""
     import jax
+    import jax.numpy as jnp
 
     from ..tensor.tensor import apply_op
 
     def _f(d, s):
-        import jax.numpy as jnp
-
-        n = int(s.max()) + 1 if hasattr(s, "max") else 1
-        return jax.ops.segment_sum(d, s.astype(jnp.int32), num_segments=None)
+        s = s.astype(jnp.int32)
+        n = d.shape[0]
+        out = jnp.zeros((n,) + d.shape[1:], d.dtype).at[s].add(d)
+        if not isinstance(s, jax.core.Tracer):
+            out = out[:int(s.max()) + 1]
+        return out
 
     return apply_op(_f, (data, segment_ids), name="segment_sum")
 

@@ -101,10 +101,11 @@ def _setitem(self, idx, value):
         return v.at[nidx].set(val)
 
     out = apply_op(_set, (self, value), name="setitem")
-    self._value = out._value
-    self._node = out._node
-    self._out_index = out._out_index
-    return self
+    # adopt the result THROUGH the in-place contract: plainly taking out's
+    # node would leave the node's recorded `self` input pointing at the
+    # node's own output (a self-loop) and drop the cotangents for both the
+    # base and the assigned value (see Tensor._assume)
+    return self._assume(out)
 
 
 Tensor.__getitem__ = _getitem

@@ -1,0 +1,343 @@
+"""Op-sweep round 3 (VERDICT r3 #9): the remaining systematic holes.
+
+- COMPLEX-dtype gradients (fft/hermitian paths): tape backward on
+  complex64 inputs checked against a central-difference directional probe
+  in the JAX convention (for real loss L(z), backward returns g with
+  dL = Re(sum(g * dz)) — verified equal to jax.grad).
+- STRIDED slice-assignment edges: step/negative/fancy-index setitem vs the
+  numpy oracle, plus gradient flow to both the base and the assigned value.
+- SEGMENT reduction gradients (incubate.segment_*) via the OpTest harness
+  with integer segment-id inputs.
+- extra float grids (pad modes, gather/take families, sort/topk) through
+  the same harness.
+
+Ref: unittests/test_*_op.py complex grids (test_fft_op.py), setitem suite
+(test_set_value_op.py), segment ops (test_segment_ops.py).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_harness import In, OpSpec, run_all_checks
+
+pytestmark = pytest.mark.quick
+
+
+# ------------------------------------------------------------ complex grads
+
+def _complex_input(shape, rng):
+    return (rng.standard_normal(shape) + 1j * rng.standard_normal(shape)) \
+        .astype(np.complex64)
+
+
+def _loss_of(fn):
+    def loss(t):
+        o = fn(t)
+        return paddle.sum(paddle.real(o * paddle.conj(o)))
+    return loss
+
+
+_COMPLEX_CASES = [
+    ("fft", lambda t: paddle.fft.fft(t), (6,)),
+    ("fft_axis", lambda t: paddle.fft.fft(t, axis=0), (4, 6)),
+    ("ifft", lambda t: paddle.fft.ifft(t), (8,)),
+    ("fft2", lambda t: paddle.fft.fft2(t), (4, 4)),
+    ("ifft2", lambda t: paddle.fft.ifft2(t), (4, 4)),
+    ("fftn", lambda t: paddle.fft.fftn(t), (2, 3, 4)),
+    ("fftshifted_fft", lambda t: paddle.fft.fftshift(paddle.fft.fft(t)), (6,)),
+    ("conj", lambda t: paddle.conj(t), (5,)),
+    ("complex_matmul", lambda t: paddle.matmul(t, t), (3, 3)),
+    ("complex_mul_add", lambda t: t * t + t, (7,)),
+    ("complex_exp", lambda t: paddle.exp(t), (5,)),
+    ("complex_reciprocal", lambda t: 1.0 / (t + 3.0), (5,)),
+]
+
+
+@pytest.mark.parametrize("name,fn,shape",
+                         _COMPLEX_CASES, ids=[c[0] for c in _COMPLEX_CASES])
+def test_complex_grad(name, fn, shape):
+    rng = np.random.default_rng(hash(name) % 2**32)
+    x_np = _complex_input(shape, rng)
+    loss = _loss_of(fn)
+
+    t = paddle.to_tensor(x_np, stop_gradient=False)
+    out = loss(t)
+    out.backward()
+    g = np.asarray(t.grad._value)
+
+    # directional probe along a random complex direction
+    v = _complex_input(shape, rng) * 0.5
+    eps = 1e-3
+
+    def L(arr):
+        return float(np.asarray(loss(paddle.to_tensor(arr))._value))
+
+    fd = (L(x_np + eps * v) - L(x_np - eps * v)) / (2 * eps)
+    analytic = float(np.sum(np.real(g * v)))
+    assert abs(fd - analytic) <= 2e-2 * (abs(fd) + abs(analytic) + 1.0), \
+        (name, fd, analytic)
+
+
+_HERMITIAN_CASES = [
+    # real->complex and hermitian families: probe with REAL inputs
+    ("rfft", lambda t: paddle.fft.rfft(t), (8,)),
+    ("rfft2", lambda t: paddle.fft.rfft2(t), (4, 6)),
+    ("ihfft", lambda t: paddle.fft.ihfft(t), (8,)),
+    ("ihfft2", lambda t: paddle.fft.ihfft2(t), (4, 6)),
+]
+
+
+@pytest.mark.parametrize("name,fn,shape", _HERMITIAN_CASES,
+                         ids=[c[0] for c in _HERMITIAN_CASES])
+def test_hermitian_real_input_grad(name, fn, shape):
+    rng = np.random.default_rng(7)
+    x_np = rng.standard_normal(shape).astype(np.float32)
+    loss = _loss_of(fn)
+    t = paddle.to_tensor(x_np, stop_gradient=False)
+    loss(t).backward()
+    g = np.asarray(t.grad._value)
+    assert g.shape == x_np.shape and np.isrealobj(g)
+    v = rng.standard_normal(shape).astype(np.float32)
+    eps = 1e-2
+
+    def L(arr):
+        return float(np.asarray(loss(paddle.to_tensor(arr))._value))
+
+    fd = (L(x_np + eps * v) - L(x_np - eps * v)) / (2 * eps)
+    analytic = float(np.sum(g * v))
+    assert abs(fd - analytic) <= 2e-2 * (abs(fd) + abs(analytic) + 1.0), \
+        (name, fd, analytic)
+
+
+_COMPLEX_TO_REAL_CASES = [
+    ("hfft", lambda t: paddle.fft.hfft(t), (5,)),
+    ("irfft", lambda t: paddle.fft.irfft(t), (5,)),
+    ("cabs", lambda t: paddle.abs(t), (6,)),
+    ("creal", lambda t: paddle.real(t), (6,)),
+    ("cimag", lambda t: paddle.imag(t), (6,)),
+]
+
+
+@pytest.mark.parametrize("name,fn,shape", _COMPLEX_TO_REAL_CASES,
+                         ids=[c[0] for c in _COMPLEX_TO_REAL_CASES])
+def test_complex_to_real_grad(name, fn, shape):
+    rng = np.random.default_rng(11)
+    x_np = _complex_input(shape, rng)
+
+    def loss(t):
+        o = fn(t)
+        return paddle.sum(o * o)
+
+    t = paddle.to_tensor(x_np, stop_gradient=False)
+    loss(t).backward()
+    g = np.asarray(t.grad._value)
+    v = _complex_input(shape, rng) * 0.5
+    eps = 1e-3
+
+    def L(arr):
+        return float(np.asarray(loss(paddle.to_tensor(arr))._value))
+
+    fd = (L(x_np + eps * v) - L(x_np - eps * v)) / (2 * eps)
+    analytic = float(np.sum(np.real(g * v)))
+    assert abs(fd - analytic) <= 3e-2 * (abs(fd) + abs(analytic) + 1.0), \
+        (name, fd, analytic)
+
+
+# ------------------------------------------------ strided slice-assignment
+
+_SETITEM_CASES = [
+    ("step2", (8,), lambda: np.s_[::2], (4,)),
+    ("step3_off", (10,), lambda: np.s_[1::3], (3,)),
+    ("neg_step", (8,), lambda: np.s_[::-1], (8,)),
+    ("neg_step2", (9,), lambda: np.s_[7:2:-2], (3,)),
+    ("row_stride", (6, 5), lambda: np.s_[::2, :], (3, 5)),
+    ("col_stride", (4, 8), lambda: np.s_[:, 1:7:2], (4, 3)),
+    ("both_strides", (6, 6), lambda: np.s_[::3, ::2], (2, 3)),
+    ("ellipsis_tail", (3, 4, 5), lambda: np.s_[..., -2:], (3, 4, 2)),
+    ("fancy_rows", (6, 4), lambda: ([0, 2, 5],), (3, 4)),
+    ("fancy_cols", (4, 6), lambda: (slice(None), [1, 4]), (4, 2)),
+    ("scalar_broadcast", (5, 5), lambda: np.s_[1:4, 2:5], ()),
+    ("single_row", (4, 3), lambda: np.s_[2], (3,)),
+    ("neg_index", (6,), lambda: np.s_[-2], ()),
+    ("full", (3, 3), lambda: np.s_[:], (3, 3)),
+    ("middle_3d", (3, 6, 2), lambda: np.s_[:, 1:5:2, :], (3, 2, 2)),
+    ("empty_range", (5,), lambda: np.s_[2:2], (0,)),
+]
+
+
+@pytest.mark.parametrize("name,base_shape,idx_fn,val_shape", _SETITEM_CASES,
+                         ids=[c[0] for c in _SETITEM_CASES])
+def test_strided_setitem_matches_numpy(name, base_shape, idx_fn, val_shape):
+    rng = np.random.default_rng(3)
+    base = rng.standard_normal(base_shape).astype(np.float32)
+    val = rng.standard_normal(val_shape).astype(np.float32)
+    idx = idx_fn()
+
+    want = base.copy()
+    want[idx] = val
+
+    t = paddle.to_tensor(base.copy())
+    t[idx] = paddle.to_tensor(val) if val_shape != () else float(val)
+    np.testing.assert_allclose(np.asarray(t._value), want, rtol=1e-6)
+
+
+def test_strided_setitem_gradients():
+    """Gradient flows to the assigned VALUE for assigned positions and to
+    the BASE for untouched positions (ref test_set_value_op.py grads)."""
+    rng = np.random.default_rng(5)
+    base = rng.standard_normal((8,)).astype(np.float32)
+    val = rng.standard_normal((4,)).astype(np.float32)
+    w = rng.standard_normal((8,)).astype(np.float32)
+
+    x = paddle.to_tensor(base, stop_gradient=False)
+    v = paddle.to_tensor(val, stop_gradient=False)
+    y = x * 1.0
+    y[::2] = v
+    loss = paddle.sum(y * paddle.to_tensor(w))
+    loss.backward()
+    np.testing.assert_allclose(np.asarray(v.grad._value), w[::2], rtol=1e-6)
+    want_x = w.copy()
+    want_x[::2] = 0.0
+    np.testing.assert_allclose(np.asarray(x.grad._value), want_x, rtol=1e-6)
+
+
+def test_setitem_int_and_bool_dtypes():
+    t = paddle.to_tensor(np.arange(10, dtype=np.int32))
+    t[::2] = paddle.to_tensor(np.zeros(5, np.int32))
+    np.testing.assert_array_equal(
+        np.asarray(t._value), [0, 1, 0, 3, 0, 5, 0, 7, 0, 9])
+    b = paddle.to_tensor(np.zeros(6, bool))
+    b[1:5:2] = paddle.to_tensor(np.ones(2, bool))
+    np.testing.assert_array_equal(
+        np.asarray(b._value), [False, True, False, True, False, False])
+
+
+# ------------------------------------------------------- segment reductions
+
+def _segment_specs():
+    import paddle_tpu.incubate as I
+
+    S = []
+    seg_ids = {  # sorted ids, as the reference requires: (ids, n_segments)
+        6: ([0, 0, 1, 1, 1, 2], 3),
+        10: ([0, 0, 0, 2, 2, 3, 3, 3, 3, 5], 6),
+    }
+    for n, (ids, nseg) in seg_ids.items():
+        ids_arr = np.asarray(ids, np.int32)
+        for op_name, fn, extra in (
+                ("segment_sum", I.segment_sum, {}),
+                ("segment_mean", I.segment_mean, {}),
+                ("segment_max", I.segment_max, dict(nondiff_smooth=True)),
+                ("segment_min", I.segment_min, dict(nondiff_smooth=True))):
+            for trail in ((), (3,)):
+                shape = (n,) + trail
+                tag = f"{op_name}_n{n}_{'vec' if trail else 'flat'}"
+                # slice to the static segment count so the eager (reference
+                # [max_id+1] shape) and traced (row-count-padded) layouts
+                # compare equal in the harness jit-parity check
+                S.append(OpSpec(
+                    tag,
+                    lambda d, i=ids_arr, f=fn, k=nseg: f(
+                        d, paddle.to_tensor(i))[:k],
+                    [In(*shape)], {}, grad_rtol=3e-2, grad_atol=3e-3,
+                    **extra))
+    return S
+
+
+# ------------------------------------------------------------- extra grids
+
+def _grid_specs():
+    import paddle_tpu.nn.functional as F
+
+    S = []
+    for mode in ("constant", "reflect", "replicate", "circular"):
+        S.append(OpSpec(
+            f"pad1d_{mode}",
+            lambda x, m=mode: F.pad(x, [2, 1], mode=m),
+            [In(2, 3, 6)], {}, grad_rtol=3e-2))
+        S.append(OpSpec(
+            f"pad2d_{mode}",
+            lambda x, m=mode: F.pad(x, [1, 2, 2, 1], mode=m),
+            [In(2, 3, 5, 6)], {}, grad_rtol=3e-2))
+    for axis in (0, 1, -1):
+        S.append(OpSpec(
+            f"gather_ax{axis}",
+            lambda x, a=axis: paddle.gather(
+                x, paddle.to_tensor(np.asarray([0, 2, 1], np.int32)), axis=a),
+            [In(4, 5, 3)], {}))
+        S.append(OpSpec(
+            f"index_select_ax{axis}",
+            lambda x, a=axis: paddle.index_select(
+                x, paddle.to_tensor(np.asarray([1, 0], np.int32)), axis=a),
+            [In(3, 4, 3)], {}))
+        S.append(OpSpec(
+            f"flip_ax{axis}",
+            lambda x, a=axis: paddle.flip(x, axis=a), [In(3, 4, 5)], {}))
+        S.append(OpSpec(
+            f"roll_ax{axis}",
+            lambda x, a=axis: paddle.roll(x, shifts=2, axis=a),
+            [In(3, 4, 5)], {}))
+    S.append(OpSpec(
+        "take_along_axis",
+        lambda x: paddle.take_along_axis(
+            x, paddle.to_tensor(np.asarray([[0, 2], [1, 0], [2, 2]], np.int64)), 1),
+        [In(3, 4)], {}))
+    for k in (1, 3):
+        S.append(OpSpec(
+            f"topk_{k}_values",
+            lambda x, kk=k: paddle.topk(x, kk)[0], [In(4, 7)], {},
+            nondiff_smooth=True))
+    for desc in (False, True):
+        S.append(OpSpec(
+            f"sort_desc{int(desc)}",
+            lambda x, d=desc: paddle.sort(x, descending=d), [In(4, 6)], {},
+            nondiff_smooth=True))
+    for k in (-1, 0, 1):
+        S.append(OpSpec(f"tril_k{k}", lambda x, kk=k: paddle.tril(x, kk),
+                        [In(4, 5)], {}))
+        S.append(OpSpec(f"triu_k{k}", lambda x, kk=k: paddle.triu(x, kk),
+                        [In(4, 5)], {}))
+    for axis in (0, -1):
+        S.append(OpSpec(f"cumsum_ax{axis}",
+                        lambda x, a=axis: paddle.cumsum(x, axis=a),
+                        [In(3, 4)], {}))
+        S.append(OpSpec(f"cumprod_ax{axis}",
+                        lambda x, a=axis: paddle.cumprod(x, dim=a),
+                        [In(3, 4, kind="pos")], {}, grad_rtol=3e-2))
+    S.append(OpSpec("diag_vec", lambda x: paddle.diag(x), [In(5)], {}))
+    S.append(OpSpec("diagonal", lambda x: paddle.diagonal(x), [In(4, 4)], {}))
+    S.append(OpSpec("kron", lambda a, b: paddle.kron(a, b),
+                    [In(2, 3), In(3, 2)], {}))
+    S.append(OpSpec("outer", lambda a, b: paddle.outer(a, b),
+                    [In(4), In(5)], {}))
+    S.append(OpSpec("clip_grad", lambda x: paddle.clip(x, -0.5, 0.5),
+                    [In(4, 5)], {}, nondiff_smooth=True))
+    for eq in ("ij,jk->ik", "bij,bjk->bik", "ij,ij->"):
+        shapes = {"ij,jk->ik": [(3, 4), (4, 5)],
+                  "bij,bjk->bik": [(2, 3, 4), (2, 4, 3)],
+                  "ij,ij->": [(3, 4), (3, 4)]}[eq]
+        S.append(OpSpec(
+            f"einsum_{eq.replace(',', '_').replace('->', '_to_')}",
+            lambda a, b, e=eq: paddle.einsum(e, a, b),
+            [In(*shapes[0]), In(*shapes[1])], {}))
+    return S
+
+
+SPECS3 = _segment_specs() + _grid_specs()
+
+
+@pytest.mark.parametrize("spec", SPECS3, ids=[s.name for s in SPECS3])
+def test_op3(spec):
+    run_all_checks(spec)
+
+
+def test_sweep3_size():
+    # VERDICT r3 #9 bar: >= 550 specs/cases across the three suites
+    import test_op_suite as t1
+    import test_op_suite2 as t2
+
+    total = (len(t1.SPECS) + len(t2.SPECS2) + len(t2._INT_CASES) * 2
+             + len(t2._BOOL_CASES) + len(SPECS3) + len(_COMPLEX_CASES)
+             + len(_HERMITIAN_CASES) + len(_COMPLEX_TO_REAL_CASES)
+             + len(_SETITEM_CASES) + 3)
+    assert total >= 550, total
