@@ -76,14 +76,16 @@ def _measure_gemm_peak():
 
     r = chain(x, w)
     float(jnp.sum(r[:1, :1].astype(jnp.float32)))
-    best = float("inf")
-    for _ in range(3):  # a ceiling: keep the best window (run-to-run ~10%)
+    ws = []
+    for _ in range(5):
         t0 = time.perf_counter()
         r = chain(x, w)
         float(jnp.sum(r[:1, :1].astype(jnp.float32)))
-        best = min(best, time.perf_counter() - t0)
-    best = max(best - _RTT_S, 1e-6)  # remove the per-sync tunnel latency
-    return 2 * n * n * n * iters / best / 1e12
+        ws.append(time.perf_counter() - t0)
+    # median window: a best-of window can catch an RTT dip below the median
+    # RTT being subtracted and read ABOVE the chip's nominal peak
+    dt = max(sorted(ws)[len(ws) // 2] - _RTT_S, 1e-6)
+    return 2 * n * n * n * iters / dt / 1e12
 
 
 def _measure_conv_peak():
@@ -301,10 +303,12 @@ def _bench_decode(on_accel):
         # kernel; the step streams the PADDED buffers (generation.py L_pad)
         L_pad = ((prompt_len + new_tokens + 127) // 128) * 128
         hd = cfg.hidden_size // cfg.num_attention_heads
+        # kv_elems counts BOTH k and v rows (the leading factor 2), so the
+        # per-row cost below is payload + ONE f32 scale
         kv_elems = 2 * cfg.num_hidden_layers * batch * L_pad \
             * cfg.num_key_value_heads
         kv_bytes_bf16 = kv_elems * hd * 2
-        kv_bytes_int8 = kv_elems * (hd * 1 + 2 * 4)  # int8 payload + f32 scales (k,v)
+        kv_bytes_int8 = kv_elems * (hd * 1 + 4)  # int8 payload + f32 scale
         res["llama_decode_stream_gb_per_tok"] = round(
             (2 * n_params + kv_bytes_bf16) / 1e9, 3)
         if per_tok > 1e-6:

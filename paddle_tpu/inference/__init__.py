@@ -16,7 +16,15 @@ import numpy as np
 from ..tensor.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "DynamicBatcher"]
+           "DynamicBatcher", "LLMEngine"]
+
+
+def __getattr__(name):
+    if name == "LLMEngine":  # lazy: avoid importing the LLM stack for
+        from .llm_server import LLMEngine  # classic predictor users
+
+        return LLMEngine
+    raise AttributeError(name)
 
 
 class PrecisionType:

@@ -1,0 +1,317 @@
+"""Continuous-batching LLM serving engine (slot-based, vLLM-style).
+
+Reference gap: the v2.3-era AnalysisPredictor serves one fixed-shape model
+program per request (analysis_predictor.h) — there is no decode server.
+This engine is the TPU-native design the kv-cache stack invites:
+
+- a FIXED pool of batch slots over head-major static caches
+  [slots, H, L, D] (models/kv_cache.py layouts, bf16 or int8);
+- ONE compiled decode step for the whole pool per token: each slot carries
+  its own position, so the rope offsets, cache scatters and the Pallas
+  decode-attention masks are all per-slot vectors — requests at different
+  depths decode together with no recompilation and no padding restarts;
+- admission by PREFILL into a free slot: prompts pad up to a small set of
+  bucket lengths (one compile per bucket), the prefill's k/v rows are
+  copied into the slot, and the request joins the next decode tick;
+- completion by eos/max-tokens frees the slot for the next queued request.
+
+The engine is deterministic and thread-free by default (`step()` pumps one
+decode tick; `run_until_complete()` drains); `start()` spawns the
+background pump for server use.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..autograd import tape
+from ..tensor.tensor import Tensor
+
+__all__ = ["LLMEngine"]
+
+
+@dataclass
+class _Request:
+    prompt: np.ndarray
+    max_new_tokens: int
+    future: Future
+    slot: int = -1
+    tokens: list = field(default_factory=list)
+
+
+class LLMEngine:
+    def __init__(self, model, max_batch_slots=4, max_seq_len=512,
+                 cache_dtype=None, eos_token_id=None, pad_token_id=0,
+                 prompt_buckets=(32, 64, 128, 256)):
+        cfg = model.config
+        self.model = model
+        self.n_slots = int(max_batch_slots)
+        # pad L to the decode kernel's 128 tile
+        self.L = ((int(max_seq_len) + 127) // 128) * 128
+        self.cache_dtype = cache_dtype
+        self.eos = -1 if eos_token_id is None else int(eos_token_id)
+        self.pad = int(pad_token_id)
+        self.buckets = tuple(b for b in sorted(prompt_buckets)
+                             if b <= self.L) or (self.L,)
+        self._params, self._buffers = model.functional_state()
+        H = cfg.num_key_value_heads
+        D = cfg.hidden_size // cfg.num_attention_heads
+        nl = cfg.num_hidden_layers
+        B, L = self.n_slots, self.L
+        kv_dtype = jnp.bfloat16 if str(
+            next(iter(jax.tree_util.tree_leaves(self._params))).dtype
+        ) == "bfloat16" else jnp.float32
+        self._kv_dtype = kv_dtype
+        if cache_dtype == "int8":
+            self.caches = [
+                (jnp.zeros((B, H, L, D), jnp.int8),
+                 jnp.zeros((B, H, L, D), jnp.int8),
+                 jnp.zeros((B,), jnp.int32),
+                 jnp.full((B, H, L), 1e-8, jnp.float32),
+                 jnp.full((B, H, L), 1e-8, jnp.float32))
+                for _ in range(nl)]
+        else:
+            self.caches = [
+                (jnp.zeros((B, H, L, D), kv_dtype),
+                 jnp.zeros((B, H, L, D), kv_dtype),
+                 jnp.zeros((B,), jnp.int32))
+                for _ in range(nl)]
+        self.slot_pos = np.zeros(B, np.int32)       # valid tokens per slot
+        self.slot_req: list[_Request | None] = [None] * B
+        self.last_token = np.full(B, self.pad, np.int32)
+        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._decode_jit = None
+        self._prefill_jit = {}
+        self._thread = None
+        self._stop = False
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- public
+
+    def submit(self, prompt_ids, max_new_tokens=32):
+        """Queue one prompt; returns a Future of the generated id list."""
+        arr = np.asarray(
+            prompt_ids._value if isinstance(prompt_ids, Tensor) else prompt_ids,
+            np.int32).reshape(-1)
+        if arr.size == 0 or arr.size > self.L - 1:
+            raise ValueError(f"prompt length {arr.size} not in [1, {self.L - 1}]")
+        req = _Request(arr, int(max_new_tokens), Future())
+        self._pending.put(req)
+        return req.future
+
+    def generate(self, prompt_ids, max_new_tokens=32):
+        """Blocking single-prompt convenience."""
+        fut = self.submit(prompt_ids, max_new_tokens)
+        self.run_until_complete()
+        return fut.result()
+
+    def run_until_complete(self):
+        """Pump decode ticks until the queue and all slots drain."""
+        while not self._pending.empty() or any(r is not None
+                                               for r in self.slot_req):
+            self.step()
+
+    def start(self):
+        """Background pump (server mode)."""
+        if self._thread is None:
+            self._stop = False
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        """Halt the pump and FAIL any queued/in-flight requests — a client
+        blocked on future.result() must not hang forever."""
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        while not self._pending.empty():
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if not req.future.done():
+                req.future.cancel() or req.future.set_exception(
+                    RuntimeError("LLMEngine stopped"))
+        for i, req in enumerate(self.slot_req):
+            if req is not None:
+                self.slot_req[i] = None
+                if not req.future.done():
+                    req.future.set_exception(
+                        RuntimeError("LLMEngine stopped mid-generation"))
+
+    def _loop(self):
+        import time
+
+        while not self._stop:
+            if self._pending.empty() and all(r is None for r in self.slot_req):
+                time.sleep(0.002)
+                continue
+            self.step()
+
+    # --------------------------------------------------------- internals
+
+    def _bucket(self, n):
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.L
+
+    def _prefill_fn(self, Lb):
+        """Compiled prompt prefill at bucket length Lb: returns the last
+        real token's logits and the head-major k/v rows."""
+        model = self.model
+
+        def run(params, buffers, ids, last_index):
+            restore = model.bind_functional_state(params, buffers)
+            try:
+                with tape.no_grad():
+                    logits, caches = model.prefill_step(Tensor(ids),
+                                                        last_index)
+            finally:
+                restore()
+            # k/v come out [1, Lb, H, D] -> head-major [1, H, Lb, D]
+            kvs = [(jnp.transpose(k._value, (0, 2, 1, 3)),
+                    jnp.transpose(v._value, (0, 2, 1, 3)))
+                   for (k, v) in caches]
+            return logits._value, kvs
+
+        return jax.jit(run)
+
+    def _get_prefill(self, Lb):
+        if Lb not in self._prefill_jit:
+            self._prefill_jit[Lb] = self._prefill_fn(Lb)
+        return self._prefill_jit[Lb]
+
+    def _admit(self):
+        free = [i for i, r in enumerate(self.slot_req) if r is None]
+        while free and not self._pending.empty():
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            slot = free.pop(0)
+            try:
+                self._admit_one(req, slot)
+            except Exception as e:
+                self.slot_req[slot] = None
+                free.insert(0, slot)
+                if not req.future.done():
+                    req.future.set_exception(e)
+
+    def _admit_one(self, req, slot):
+        n = req.prompt.size
+        Lb = self._bucket(n)
+        padded = np.full((1, Lb), self.pad, np.int32)
+        padded[0, :n] = req.prompt
+        logits, kvs = self._get_prefill(Lb)(
+            self._params, self._buffers, jnp.asarray(padded),
+            jnp.asarray(n - 1, jnp.int32))
+        # causal attention: positions >= n never influence position n-1,
+        # so the padded prefill's first n k/v rows are exact
+        tok = int(np.asarray(logits[0, 0]).argmax())
+        for li, (k_hm, v_hm) in enumerate(kvs):
+            c = self.caches[li]
+            if self.cache_dtype == "int8":
+                from ..models.kv_cache import _quantize_kv
+
+                kq, ks = _quantize_kv(k_hm[:, :, :Lb])
+                vq, vs = _quantize_kv(v_hm[:, :, :Lb])
+                self.caches[li] = (
+                    c[0].at[slot, :, :Lb].set(kq[0]),
+                    c[1].at[slot, :, :Lb].set(vq[0]),
+                    c[2], c[3].at[slot, :, :Lb].set(ks[0]),
+                    c[4].at[slot, :, :Lb].set(vs[0]))
+            else:
+                self.caches[li] = (
+                    c[0].at[slot, :, :Lb].set(k_hm[0].astype(c[0].dtype)),
+                    c[1].at[slot, :, :Lb].set(v_hm[0].astype(c[1].dtype)),
+                    c[2])
+        req.slot = slot
+        req.tokens = [tok]
+        self.slot_req[slot] = req
+        self.slot_pos[slot] = n
+        self.last_token[slot] = tok
+        if tok == self.eos or req.max_new_tokens <= 1:
+            self._finish(slot)
+
+    def _decode_fn(self):
+        model = self.model
+
+        def run(params, buffers, caches, tokens, pos):
+            restore = model.bind_functional_state(params, buffers)
+            try:
+                with tape.no_grad():
+                    # the [B] position vector rides RAW (like the scalar pos
+                    # in generation.py): rope/scatter/mask closures consume
+                    # it with plain jnp ops
+                    t_caches = [
+                        (Tensor(c[0]), Tensor(c[1]), pos)
+                        + tuple(Tensor(x) for x in c[3:])
+                        for c in caches]
+                    logits, new_caches = model.generate_step(
+                        Tensor(tokens), caches=t_caches)
+            finally:
+                restore()
+            raw = [tuple(x._value if isinstance(x, Tensor) else x
+                         for x in c) for c in new_caches]
+            return logits._value[:, -1], raw
+
+        return jax.jit(run, donate_argnums=(2,))
+
+    def step(self):
+        """One engine tick: admit pending prompts, then decode one token
+        for every active slot.  Serialized by the engine lock: the
+        background pump and caller-thread pumping (run_until_complete) must
+        not race on the DONATED cache buffers or the slot state."""
+        with self._lock:
+            return self._step_locked()
+
+    def _step_locked(self):
+        self._admit()
+        active = [i for i, r in enumerate(self.slot_req) if r is not None]
+        if not active:
+            return 0
+        if self._decode_jit is None:
+            self._decode_jit = self._decode_fn()
+        tokens = jnp.asarray(self.last_token.reshape(-1, 1))
+        pos = jnp.asarray(self.slot_pos)
+        logits, new_caches = self._decode_jit(
+            self._params, self._buffers, self.caches, tokens, pos)
+        # the returned tuples carry pos+1 at slot [2], but the engine's [B]
+        # slot_pos vector stays authoritative — each tick rebuilds the
+        # per-slot positions (finished slots do not advance)
+        self.caches = new_caches
+        nxt = np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
+        emitted = 0
+        for i in active:
+            req = self.slot_req[i]
+            tok = int(nxt[i])
+            req.tokens.append(tok)
+            self.last_token[i] = tok
+            self.slot_pos[i] += 1
+            emitted += 1
+            done = (tok == self.eos
+                    or len(req.tokens) >= req.max_new_tokens
+                    or self.slot_pos[i] >= self.L - 1)
+            if done:
+                self._finish(i)
+        # inactive slots scatter garbage k/v at their stale position during
+        # the shared step — harmless: a decode WRITES row `pos` before any
+        # read past it, and admission rewrites rows [0, bucket) wholesale
+        return emitted
+
+    def _finish(self, slot):
+        req = self.slot_req[slot]
+        self.slot_req[slot] = None
+        self.last_token[slot] = self.pad
+        if req is not None and not req.future.done():
+            req.future.set_result(list(req.tokens))

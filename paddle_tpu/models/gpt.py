@@ -138,7 +138,10 @@ class GPTModel(nn.Layer):
 
             from ..tensor.tensor import Tensor
 
-            pos = Tensor(jnp.arange(S, dtype=jnp.int32)[None, :] + caches[0][2])
+            off = caches[0][2]
+            if getattr(off, "ndim", 0) >= 1:
+                off = off[:, None]  # per-slot offsets (continuous batching)
+            pos = Tensor(jnp.arange(S, dtype=jnp.int32)[None, :] + off)
         elif caches is not None and caches[0] is not None:
             off = caches[0][0].shape[1]
             pos = creation.arange(off, off + S, dtype="int32").unsqueeze(0)
@@ -185,6 +188,18 @@ class GPTForCausalLM(nn.Layer):
         """Prefill (caches=None) or single-token decode step."""
         hidden, caches = self.gpt(input_ids, caches=caches, use_cache=True)
         return self.lm_head(hidden[:, -1:]), caches
+
+    def prefill_step(self, input_ids, last_index):
+        """Bucket-padded prefill for the serving engine (see llama.py)."""
+        import jax
+
+        from ..tensor.tensor import apply_op
+
+        hidden, caches = self.gpt(input_ids, caches=None, use_cache=True)
+        last = apply_op(
+            lambda h: jax.lax.dynamic_slice_in_dim(h, last_index, 1, 1),
+            (hidden,), name="prefill_last")
+        return self.lm_head(last), caches
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,

@@ -17,6 +17,15 @@ the decode loop) before the scatter at axis 2.
 
 Both LlamaAttention and GPTBlock call the helpers here so the layout and
 quantization contracts live in one place.
+
+RECOMMENDATION (measured on v5e, 738M model, b8/p1024, r4): with the Pallas
+decode kernel the int8 cache is now FASTER than bf16 at small batch (3.51
+vs 3.96 ms/token — it streams half the kv bytes and dequantizes in VMEM)
+and doubles the max decode batch/context at fixed HBM
+(kv_int8_max_batch_gain ~1.9 in BENCH_r04: 114 -> 214 max batch at 1152
+context).  Default to cache_dtype="int8" for serving whenever the model
+tolerates the ~absmax/254 per-element roundtrip error (logit drift <5% on
+the parity test); keep bf16 for exact-parity evaluation runs.
 """
 from __future__ import annotations
 
@@ -41,13 +50,26 @@ def _to_head_major(kv):
     return jnp.transpose(kv, (0, 2, 1, 3))
 
 
+def _scatter(buf, kv, offset):
+    """Write head-major new kv into the buffer at `offset` — a scalar (all
+    slots aligned: the generate() loop) or a per-slot [B] vector
+    (continuous batching; decode S == 1)."""
+    hm = kv
+    if getattr(offset, "ndim", 0) >= 1:
+        B, H = buf.shape[0], buf.shape[1]
+        bi = jnp.arange(B)[:, None]
+        hi = jnp.arange(H)[None, :]
+        return buf.at[bi, hi, offset[:, None]].set(hm[:, :, 0])
+    return jax.lax.dynamic_update_slice_in_dim(buf, hm, offset, 2)
+
+
 def update_plain_cache(cache, k, v, offset):
     """Scatter new k/v [B, S, H, D] into the head-major (k_buf, v_buf, pos)
     layout.  Returns (new_cache, k_full, v_full) with the full buffers in
     head-major [B, H, L, D]."""
     S = k.shape[1]
-    upd = lambda buf, kv: jax.lax.dynamic_update_slice_in_dim(  # noqa: E731
-        buf, _to_head_major(kv.astype(buf.dtype)), offset, 2)
+    upd = lambda buf, kv: _scatter(  # noqa: E731
+        buf, _to_head_major(kv.astype(buf.dtype)), offset)
     k_buf = apply_op(upd, (cache[0], k), name="kv_scatter")
     v_buf = apply_op(upd, (cache[1], v), name="kv_scatter")
     return (k_buf, v_buf, offset + S), k_buf, v_buf
@@ -62,6 +84,12 @@ def update_quant_cache(cache, k, v, offset, out_dtype):
 
     def upd_q(buf, sbuf, kv):
         kv_q, scale = _quantize_kv(_to_head_major(kv))
+        if getattr(offset, "ndim", 0) >= 1:
+            B, H = buf.shape[0], buf.shape[1]
+            bi = jnp.arange(B)[:, None]
+            hi = jnp.arange(H)[None, :]
+            return (buf.at[bi, hi, offset[:, None]].set(kv_q[:, :, 0]),
+                    sbuf.at[bi, hi, offset[:, None]].set(scale[:, :, 0]))
         return (jax.lax.dynamic_update_slice_in_dim(buf, kv_q, offset, 2),
                 jax.lax.dynamic_update_slice_in_dim(sbuf, scale, offset, 2))
 

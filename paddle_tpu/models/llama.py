@@ -85,11 +85,19 @@ def apply_rope(x, cos, sin, position_offset=0):
     decode scan body (each one a serial kernel dispatch); the half-split
     form fuses clean.  Attention scores are identical under either pairing
     since q and k share the permutation.
-    position_offset may be a traced scalar (static-cache decode)."""
+    position_offset may be a traced scalar (static-cache decode) or a
+    PER-BATCH [B] vector (continuous-batching slots at different depths)."""
     S, D = x.shape[1], x.shape[-1]
     if isinstance(position_offset, (int, np.integer)):
         c = cos[position_offset:position_offset + S]
         s = sin[position_offset:position_offset + S]
+    elif getattr(position_offset, "ndim", 0) >= 1:
+        # per-slot offsets: gather [B, S, D/2] position rows
+        pos = position_offset[:, None] + jnp.arange(S)[None, :]
+        c = cos[pos][:, :, None, :]  # [B,S,1,D/2]
+        s = sin[pos][:, :, None, :]
+        x1, x2 = x[..., :D // 2], x[..., D // 2:]
+        return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     else:
         import jax
 
@@ -369,6 +377,19 @@ class LlamaForCausalLM(nn.Layer):
         """Prefill (caches=None) or single-token decode step (inference path)."""
         hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)
         return self.lm_head(hidden[:, -1:]), caches
+
+    def prefill_step(self, input_ids, last_index):
+        """Bucket-padded prefill (serving admission): the prompt is padded
+        PAST `last_index`, so the next-token logits live there, not at -1
+        (causal attention keeps positions <= last_index exact under the
+        padding).  Returns (logits [B, 1, V], caches)."""
+        import jax
+
+        hidden, caches = self.llama(input_ids, caches=None, use_cache=True)
+        last = apply_op(
+            lambda h: jax.lax.dynamic_slice_in_dim(h, last_index, 1, 1),
+            (hidden,), name="prefill_last")
+        return self.lm_head(last), caches
 
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,

@@ -45,7 +45,9 @@ def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, bk, L, scale, quant,
     as [L // 128, 128] f32 views (the Mosaic lane-tiling shape for a
     per-token vector)."""
     q = q_ref[0, 0]  # [1, D], storage dtype (bf16 MXU inputs)
-    valid = len_ref[0]  # keys 0..valid-1 are attendable
+    # per-BATCH valid length (continuous-batching slots sit at different
+    # depths); keys 0..valid-1 are attendable
+    valid = len_ref[pl.program_id(0)]
     nkb = L // bk
 
     def body(kj, carry):
@@ -88,7 +90,8 @@ def _decode_pallas(q, k, v, offset, k_scale, v_scale, scale, bk, interpret):
     Hkv, L = k.shape[1], k.shape[2]
     rep = H // Hkv
     quant = k_scale is not None
-    valid = jnp.reshape(jnp.asarray(offset, jnp.int32) + S, (1,))
+    valid = jnp.broadcast_to(
+        jnp.asarray(offset, jnp.int32) + S, (B,)).astype(jnp.int32)
     # head-major query so every block's trailing dims are tile-clean
     q = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, 1, D]
 
@@ -146,7 +149,10 @@ def _decode_dense(q, k, v, offset, k_scale, v_scale, scale):
         v = jnp.repeat(v, rep, axis=1)
     s = jnp.einsum("bshd,bhld->bhsl", q, k).astype(jnp.float32) * scale
     kpos = jnp.arange(L)[None, None, None, :]
-    qpos = jnp.asarray(offset, jnp.int32) + jnp.arange(S)[None, None, :, None]
+    off = jnp.asarray(offset, jnp.int32)
+    if off.ndim >= 1:  # per-slot offsets [B]
+        off = off[:, None, None, None]
+    qpos = off + jnp.arange(S)[None, None, :, None]
     s = jnp.where(kpos <= qpos, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhsl,bhld->bshd", p, v)
