@@ -678,6 +678,11 @@ def main():
             out["hw_hbm_gbs_measured"] = round(_measure_hbm_bw(), 0)
         except Exception as e:
             out["hw_peak_error"] = repr(e)[:200]
+    # soft deadline: with ~13 jit compiles over the tunnel the full run is
+    # ~30 min; if the harness kills us mid-bench the whole JSON line is
+    # lost, so stop starting new benches near the budget and print
+    deadline = time.monotonic() + float(
+        __import__("os").environ.get("BENCH_BUDGET_S", "2700"))
     for fn, tag in ((_bench_llama, "llama"),
                     (_bench_llama_h4096, "llama_h4096"),
                     (_bench_resnet, "resnet"),
@@ -686,6 +691,9 @@ def main():
                     (_bench_ernie, "ernie"),
                     (_bench_vit, "vit"),
                     (_bench_ocr, "ocr")):
+        if time.monotonic() > deadline:
+            out[f"{tag}_skipped"] = "bench budget exhausted"
+            continue
         try:
             out.update(fn(on_accel))
         except Exception as e:  # keep the line printable even if one bench dies
