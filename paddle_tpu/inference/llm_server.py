@@ -42,8 +42,27 @@ class _Request:
     prompt: np.ndarray
     max_new_tokens: int
     future: Future
+    do_sample: bool = False
+    temperature: float = 1.0
+    top_p: float = 1.0
     slot: int = -1
     tokens: list = field(default_factory=list)
+
+
+def _select_rows(logits, key, do_sample, temperature, top_p):
+    """Vectorized per-ROW token selection: each slot carries its own
+    (do_sample, temperature, top_p) — the serving analog of
+    generation._select, which takes scalars."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    lt = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)[:, None]
+    sorted_lt = jnp.sort(lt, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_lt, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True)
+    cutoff = jnp.take_along_axis(sorted_lt, cutoff_idx, axis=-1)
+    masked = jnp.where(lt < cutoff, -jnp.inf, lt)
+    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
+    return jnp.where(do_sample, sampled, greedy)
 
 
 class LLMEngine:
@@ -87,6 +106,7 @@ class LLMEngine:
         self.slot_req: list[_Request | None] = [None] * B
         self.last_token = np.full(B, self.pad, np.int32)
         self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self._rng = np.random.default_rng(1234)  # admission-token sampling
         self._decode_jit = None
         self._prefill_jit = {}
         self._thread = None
@@ -95,20 +115,26 @@ class LLMEngine:
 
     # ------------------------------------------------------------- public
 
-    def submit(self, prompt_ids, max_new_tokens=32):
-        """Queue one prompt; returns a Future of the generated id list."""
+    def submit(self, prompt_ids, max_new_tokens=32, do_sample=False,
+               temperature=1.0, top_p=1.0):
+        """Queue one prompt; returns a Future of the generated id list.
+        Sampling knobs are PER REQUEST: slots with different settings decode
+        in the same compiled step (top_k is not supported per-slot — its k
+        changes the program shape)."""
         arr = np.asarray(
             prompt_ids._value if isinstance(prompt_ids, Tensor) else prompt_ids,
             np.int32).reshape(-1)
         if arr.size == 0 or arr.size > self.L - 1:
             raise ValueError(f"prompt length {arr.size} not in [1, {self.L - 1}]")
-        req = _Request(arr, int(max_new_tokens), Future())
+        req = _Request(arr, int(max_new_tokens), Future(),
+                       do_sample=bool(do_sample),
+                       temperature=float(temperature), top_p=float(top_p))
         self._pending.put(req)
         return req.future
 
-    def generate(self, prompt_ids, max_new_tokens=32):
+    def generate(self, prompt_ids, max_new_tokens=32, **sampling):
         """Blocking single-prompt convenience."""
-        fut = self.submit(prompt_ids, max_new_tokens)
+        fut = self.submit(prompt_ids, max_new_tokens, **sampling)
         self.run_until_complete()
         return fut.result()
 
@@ -217,7 +243,7 @@ class LLMEngine:
             jnp.asarray(n - 1, jnp.int32))
         # causal attention: positions >= n never influence position n-1,
         # so the padded prefill's first n k/v rows are exact
-        tok = int(np.asarray(logits[0, 0]).argmax())
+        tok = self._host_select(np.asarray(logits[0, 0]), req)
         for li, (k_hm, v_hm) in enumerate(kvs):
             c = self.caches[li]
             if self.cache_dtype == "int8":
@@ -243,10 +269,25 @@ class LLMEngine:
         if tok == self.eos or req.max_new_tokens <= 1:
             self._finish(slot)
 
+    def _host_select(self, row, req):
+        """First (admission) token: host-side mirror of _select_rows."""
+        if not req.do_sample:
+            return int(row.argmax())
+        lt = row.astype(np.float64) / max(req.temperature, 1e-6)
+        order = np.argsort(lt)[::-1]
+        s = lt[order]
+        e = np.exp(s - s.max())
+        cum = np.cumsum(e / e.sum())
+        cutoff = s[min(int((cum < req.top_p).sum()), s.size - 1)]
+        lt = np.where(lt < cutoff, -np.inf, lt)
+        p = np.exp(lt - lt.max())
+        return int(self._rng.choice(row.size, p=p / p.sum()))
+
     def _decode_fn(self):
         model = self.model
 
-        def run(params, buffers, caches, tokens, pos):
+        def run(params, buffers, caches, tokens, pos, do_sample, temperature,
+                top_p, key):
             restore = model.bind_functional_state(params, buffers)
             try:
                 with tape.no_grad():
@@ -263,7 +304,11 @@ class LLMEngine:
                 restore()
             raw = [tuple(x._value if isinstance(x, Tensor) else x
                          for x in c) for c in new_caches]
-            return logits._value[:, -1], raw
+            # select ON DEVICE: ships [B] token ids over the tunnel instead
+            # of [B, vocab] logits
+            nxt = _select_rows(logits._value[:, -1], key, do_sample,
+                               temperature, top_p)
+            return nxt, raw
 
         return jax.jit(run, donate_argnums=(2,))
 
@@ -284,13 +329,22 @@ class LLMEngine:
             self._decode_jit = self._decode_fn()
         tokens = jnp.asarray(self.last_token.reshape(-1, 1))
         pos = jnp.asarray(self.slot_pos)
-        logits, new_caches = self._decode_jit(
-            self._params, self._buffers, self.caches, tokens, pos)
+        reqs = self.slot_req
+        do_s = jnp.asarray([r is not None and r.do_sample for r in reqs])
+        temp = jnp.asarray([r.temperature if r is not None else 1.0
+                            for r in reqs], jnp.float32)
+        topp = jnp.asarray([r.top_p if r is not None else 1.0
+                            for r in reqs], jnp.float32)
+        from ..framework import random as _fr
+
+        nxt_dev, new_caches = self._decode_jit(
+            self._params, self._buffers, self.caches, tokens, pos,
+            do_s, temp, topp, _fr.get_rng_key())
         # the returned tuples carry pos+1 at slot [2], but the engine's [B]
         # slot_pos vector stays authoritative — each tick rebuilds the
         # per-slot positions (finished slots do not advance)
         self.caches = new_caches
-        nxt = np.asarray(jnp.argmax(logits, axis=-1)).astype(np.int32)
+        nxt = np.asarray(nxt_dev).astype(np.int32)
         emitted = 0
         for i in active:
             req = self.slot_req[i]
