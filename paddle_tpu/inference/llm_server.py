@@ -18,6 +18,12 @@ This engine is the TPU-native design the kv-cache stack invites:
 The engine is deterministic and thread-free by default (`step()` pumps one
 decode tick; `run_until_complete()` drains); `start()` spawns the
 background pump for server use.
+
+Numerics: per-request outputs are exactly the solo `generate()` tokens in
+f32 (verified on TPU under staggered admission).  In bf16, greedy argmax
+can flip on near-tied logits when a slot is co-batched with others (batch
+shape changes the reduction order) — inherent to reduced precision in any
+batched server, not a positional error.
 """
 from __future__ import annotations
 
@@ -68,7 +74,13 @@ def _select_rows(logits, key, do_sample, temperature, top_p):
 class LLMEngine:
     def __init__(self, model, max_batch_slots=4, max_seq_len=512,
                  cache_dtype=None, eos_token_id=None, pad_token_id=0,
-                 prompt_buckets=(32, 64, 128, 256)):
+                 prompt_buckets=(32, 64, 128, 256), decode_chunk=1):
+        """decode_chunk > 1 runs k decode steps per compiled call (a
+        lax.scan), amortizing the host round-trip k-fold — the multi-step
+        scheduling lever for high-latency hosts.  Slots that finish
+        mid-chunk have their surplus tokens discarded (their cache rows are
+        rewritten at the next admission), and admission/eos decisions
+        happen every k tokens instead of every token."""
         cfg = model.config
         self.model = model
         self.n_slots = int(max_batch_slots)
@@ -107,7 +119,8 @@ class LLMEngine:
         self.last_token = np.full(B, self.pad, np.int32)
         self._pending: "queue.Queue[_Request]" = queue.Queue()
         self._rng = np.random.default_rng(1234)  # admission-token sampling
-        self._decode_jit = None
+        self.decode_chunk = max(1, int(decode_chunk))
+        self._decode_jit = {}  # scan length (effective chunk) -> jitted fn
         self._prefill_jit = {}
         self._thread = None
         self._stop = False
@@ -244,23 +257,8 @@ class LLMEngine:
         # causal attention: positions >= n never influence position n-1,
         # so the padded prefill's first n k/v rows are exact
         tok = self._host_select(np.asarray(logits[0, 0]), req)
-        for li, (k_hm, v_hm) in enumerate(kvs):
-            c = self.caches[li]
-            if self.cache_dtype == "int8":
-                from ..models.kv_cache import _quantize_kv
-
-                kq, ks = _quantize_kv(k_hm[:, :, :Lb])
-                vq, vs = _quantize_kv(v_hm[:, :, :Lb])
-                self.caches[li] = (
-                    c[0].at[slot, :, :Lb].set(kq[0]),
-                    c[1].at[slot, :, :Lb].set(vq[0]),
-                    c[2], c[3].at[slot, :, :Lb].set(ks[0]),
-                    c[4].at[slot, :, :Lb].set(vs[0]))
-            else:
-                self.caches[li] = (
-                    c[0].at[slot, :, :Lb].set(k_hm[0].astype(c[0].dtype)),
-                    c[1].at[slot, :, :Lb].set(v_hm[0].astype(c[1].dtype)),
-                    c[2])
+        self.caches = self._get_slot_writer(Lb)(
+            self.caches, kvs, jnp.asarray(slot, jnp.int32))
         req.slot = slot
         req.tokens = [tok]
         self.slot_req[slot] = req
@@ -268,6 +266,45 @@ class LLMEngine:
         self.last_token[slot] = tok
         if tok == self.eos or req.max_new_tokens <= 1:
             self._finish(slot)
+
+    def _get_slot_writer(self, Lb):
+        """ONE compiled call writes a prefill's k/v into a slot across all
+        layers (instead of 2-5 host-dispatched updates per layer)."""
+        key = ("w", Lb)
+        if key not in self._prefill_jit:
+            quant = self.cache_dtype == "int8"
+
+            def write(caches, kvs, slot):
+                out = []
+                for c, (k_hm, v_hm) in zip(caches, kvs):
+                    if quant:
+                        from ..models.kv_cache import _quantize_kv
+
+                        kq, ks = _quantize_kv(k_hm[:, :, :Lb])
+                        vq, vs = _quantize_kv(v_hm[:, :, :Lb])
+                        out.append((
+                            jax.lax.dynamic_update_slice(
+                                c[0], kq, (slot, 0, 0, 0)),
+                            jax.lax.dynamic_update_slice(
+                                c[1], vq, (slot, 0, 0, 0)),
+                            c[2],
+                            jax.lax.dynamic_update_slice(
+                                c[3], ks, (slot, 0, 0)),
+                            jax.lax.dynamic_update_slice(
+                                c[4], vs, (slot, 0, 0))))
+                    else:
+                        out.append((
+                            jax.lax.dynamic_update_slice(
+                                c[0], k_hm[:, :, :Lb].astype(c[0].dtype),
+                                (slot, 0, 0, 0)),
+                            jax.lax.dynamic_update_slice(
+                                c[1], v_hm[:, :, :Lb].astype(c[1].dtype),
+                                (slot, 0, 0, 0)),
+                            c[2]))
+                return out
+
+            self._prefill_jit[key] = jax.jit(write, donate_argnums=(0,))
+        return self._prefill_jit[key]
 
     def _host_select(self, row, req):
         """First (admission) token: host-side mirror of _select_rows."""
@@ -287,28 +324,34 @@ class LLMEngine:
         model = self.model
 
         def run(params, buffers, caches, tokens, pos, do_sample, temperature,
-                top_p, key):
+                top_p, keys):
             restore = model.bind_functional_state(params, buffers)
             try:
                 with tape.no_grad():
-                    # the [B] position vector rides RAW (like the scalar pos
-                    # in generation.py): rope/scatter/mask closures consume
-                    # it with plain jnp ops
-                    t_caches = [
-                        (Tensor(c[0]), Tensor(c[1]), pos)
-                        + tuple(Tensor(x) for x in c[3:])
-                        for c in caches]
-                    logits, new_caches = model.generate_step(
-                        Tensor(tokens), caches=t_caches)
+                    def tick(carry, key):
+                        caches, tok, p = carry
+                        # the [B] position vector rides RAW (like the scalar
+                        # pos in generation.py): rope/scatter/mask closures
+                        # consume it with plain jnp ops
+                        t_caches = [
+                            (Tensor(c[0]), Tensor(c[1]), p)
+                            + tuple(Tensor(x) for x in c[3:])
+                            for c in caches]
+                        logits, new_caches = model.generate_step(
+                            Tensor(tok), caches=t_caches)
+                        raw = [tuple(x._value if isinstance(x, Tensor) else x
+                                     for x in c) for c in new_caches]
+                        # select ON DEVICE: ships token ids over the tunnel,
+                        # not [B, vocab] logits
+                        nxt = _select_rows(logits._value[:, -1], key,
+                                           do_sample, temperature, top_p)
+                        return (raw, nxt[:, None], p + 1), nxt
+
+                    (caches, _, _), toks = jax.lax.scan(
+                        tick, (caches, tokens, pos), keys)
             finally:
                 restore()
-            raw = [tuple(x._value if isinstance(x, Tensor) else x
-                         for x in c) for c in new_caches]
-            # select ON DEVICE: ships [B] token ids over the tunnel instead
-            # of [B, vocab] logits
-            nxt = _select_rows(logits._value[:, -1], key, do_sample,
-                               temperature, top_p)
-            return nxt, raw
+            return toks.T, caches  # [B, chunk]
 
         return jax.jit(run, donate_argnums=(2,))
 
@@ -325,8 +368,13 @@ class LLMEngine:
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
         if not active:
             return 0
-        if self._decode_jit is None:
-            self._decode_jit = self._decode_fn()
+        # effective chunk: stay inside the cache (slots AT capacity were
+        # finished by the previous tick's done-check, so headroom >= 1)
+        headroom = self.L - 1 - int(self.slot_pos[active].max())
+        eff = max(1, min(self.decode_chunk, headroom))
+        jit = self._decode_jit.get(eff)
+        if jit is None:
+            jit = self._decode_jit[eff] = self._decode_fn()
         tokens = jnp.asarray(self.last_token.reshape(-1, 1))
         pos = jnp.asarray(self.slot_pos)
         reqs = self.slot_req
@@ -337,27 +385,31 @@ class LLMEngine:
                             for r in reqs], jnp.float32)
         from ..framework import random as _fr
 
-        nxt_dev, new_caches = self._decode_jit(
+        keys = jax.random.split(_fr.get_rng_key(), eff)
+        nxt_dev, new_caches = jit(
             self._params, self._buffers, self.caches, tokens, pos,
-            do_s, temp, topp, _fr.get_rng_key())
-        # the returned tuples carry pos+1 at slot [2], but the engine's [B]
-        # slot_pos vector stays authoritative — each tick rebuilds the
-        # per-slot positions (finished slots do not advance)
+            do_s, temp, topp, keys)
+        # the returned tuples carry advanced pos at slot [2], but the
+        # engine's [B] slot_pos vector stays authoritative — each tick
+        # rebuilds the per-slot positions (finished slots do not advance)
         self.caches = new_caches
-        nxt = np.asarray(nxt_dev).astype(np.int32)
+        nxt = np.asarray(nxt_dev).astype(np.int32)  # [B, eff]
         emitted = 0
-        for i in active:
-            req = self.slot_req[i]
-            tok = int(nxt[i])
-            req.tokens.append(tok)
-            self.last_token[i] = tok
-            self.slot_pos[i] += 1
-            emitted += 1
-            done = (tok == self.eos
-                    or len(req.tokens) >= req.max_new_tokens
-                    or self.slot_pos[i] >= self.L - 1)
-            if done:
-                self._finish(i)
+        for j in range(eff):
+            for i in list(active):
+                req = self.slot_req[i]
+                if req is None:
+                    continue  # finished earlier in this chunk: surplus
+                tok = int(nxt[i, j])
+                req.tokens.append(tok)
+                self.last_token[i] = tok
+                self.slot_pos[i] += 1
+                emitted += 1
+                done = (tok == self.eos
+                        or len(req.tokens) >= req.max_new_tokens
+                        or self.slot_pos[i] >= self.L - 1)
+                if done:
+                    self._finish(i)
         # inactive slots scatter garbage k/v at their stale position during
         # the shared step — harmless: a decode WRITES row `pos` before any
         # read past it, and admission rewrites rows [0, bucket) wholesale

@@ -125,3 +125,27 @@ def test_per_request_sampling_knobs(model):
                      top_p=0.99)
     assert len(a) == len(b) == 12
     assert a != b  # 1024-way vocab at T=5: collision of 12 draws ~ never
+
+
+def test_chunked_decode_matches_per_token(model):
+    """decode_chunk=4 (multi-step scheduling: 4 tokens per compiled call)
+    produces the same greedy outputs, including eos mid-chunk with the
+    surplus discarded."""
+    rng = np.random.RandomState(8)
+    p1 = rng.randint(0, 1024, 11).astype(np.int32)
+    p2 = rng.randint(0, 1024, 23).astype(np.int32)
+    eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                    decode_chunk=4)
+    f1 = eng.submit(p1, max_new_tokens=10)
+    f2 = eng.submit(p2, max_new_tokens=7)  # finishes mid-chunk
+    eng.run_until_complete()
+    assert f1.result(timeout=1) == _oracle(model, p1, 10)
+    assert f2.result(timeout=1) == _oracle(model, p2, 7)
+
+    # eos mid-chunk
+    base = _oracle(model, p1, 10)
+    eos = base[4]  # stops inside the second chunk of 4
+    eng2 = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                     decode_chunk=4, eos_token_id=eos)
+    got = eng2.generate(p1, max_new_tokens=10)
+    assert got == base[:5]
