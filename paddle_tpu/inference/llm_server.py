@@ -92,7 +92,8 @@ class LLMEngine:
         self.buckets = tuple(b for b in sorted(prompt_buckets)
                              if b <= self.L) or (self.L,)
         self._params, self._buffers = model.functional_state()
-        H = cfg.num_key_value_heads
+        # GQA models declare num_key_value_heads; MHA families (GPT) do not
+        H = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
         D = cfg.hidden_size // cfg.num_attention_heads
         nl = cfg.num_hidden_layers
         B, L = self.n_slots, self.L

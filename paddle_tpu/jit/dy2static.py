@@ -170,6 +170,20 @@ def is_tensor_seq(x):
     return isinstance(x, Tensor) and getattr(x, "ndim", 0) >= 1
 
 
+def all_tensor_seqs(*xs):
+    return all(is_tensor_seq(x) for x in xs)
+
+
+def index_add(i, start):
+    """Loop counter for `enumerate(tensor, start)` bodies."""
+    return _raw(i) + start
+
+
+def index_lt_min(i, *seqs):
+    """Loop test against the SHORTEST sequence (zip semantics)."""
+    return _raw(i) < min(s.shape[0] for s in seqs)
+
+
 def index0():
     # a RAW numpy scalar, deliberately not a jax array: jnp constants created
     # inside a trace are tracers on this JAX version, which would hide the
@@ -215,13 +229,14 @@ def trip_count(i, stop, step=1):
     return max(0, _math.ceil((sv - iv) / st))
 
 
-def seq_trips(i, seq):
-    """Trip count for `for x in tensor`: the (static) leading dim minus the
-    already-peeled prefix."""
+def seq_trips(i, *seqs):
+    """Trip count for `for x in tensor` / zip-of-tensors: the (static)
+    shortest leading dim minus the already-peeled prefix."""
     iv = _raw(i)
     if isinstance(iv, jax.core.Tracer):
         return None
-    return max(0, seq.shape[0] - int(np.asarray(iv)))
+    n = min(s.shape[0] for s in seqs)
+    return max(0, n - int(np.asarray(iv)))
 
 
 def convert_ifelse(pred, true_fn, false_fn, get_args, set_args, names=()):
@@ -946,11 +961,12 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         ForNodeVisitor), anything else keeps Python semantics
         (trace-unrolled)."""
         if (node.orelse
-                or not isinstance(node.target, ast.Name)
+                or not isinstance(node.target, (ast.Name, ast.Tuple))
                 or _has_ret_yield(node.body)):
             self.generic_visit(node)
             return node
-        if (not isinstance(node.iter, ast.Call)
+        if (not isinstance(node.target, ast.Name)
+                or not isinstance(node.iter, ast.Call)
                 or not isinstance(node.iter.func, ast.Name)
                 or node.iter.func.id != "range"
                 or node.iter.keywords
@@ -990,39 +1006,81 @@ class _ControlFlowTransformer(ast.NodeTransformer):
         return assigns + pre + (out if isinstance(out, list) else [out])
 
     def _convert_for_iterable(self, node):
-        """`for x in seq`: emit a runtime type dispatch —
+        """`for x in seq` (also `for i, x in enumerate(seq[, start])` and
+        `for a, b in zip(s1, s2, ...)`): emit a runtime type dispatch —
 
-            _pt_seqN = seq
-            if __pt_jst__.is_tensor_seq(_pt_seqN):   # concrete Python test
-                <index-scan while over rows, convertible to lax.while_loop>
+            _pt_seqN = seq ...
+            if __pt_jst__.all_tensor_seqs(_pt_seqN, ...):  # concrete test
+                <index-scan while over rows, convertible to lax.scan>
             else:
                 <the original Python for, trace-unrolled>
 
         Only the Tensor arm pays the while-conversion machinery; lists,
-        dicts, generators take the untouched Python loop."""
+        dicts, generators take the untouched Python loop.  Ref: the
+        ForNodeVisitor canonicalization (loop_transformer.py) covers the
+        same three iterator forms."""
+        it = node.iter
+        enum_start = None
+        enum_name = None
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "enumerate" and not it.keywords
+                and 1 <= len(it.args) <= 2
+                and isinstance(node.target, ast.Tuple)
+                and len(node.target.elts) == 2
+                and all(isinstance(e, ast.Name) for e in node.target.elts)):
+            seq_exprs = [it.args[0]]
+            row_names = [node.target.elts[1].id]
+            enum_name = node.target.elts[0].id
+            enum_start = it.args[1] if len(it.args) == 2 \
+                else ast.Constant(value=0)
+        elif (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "zip" and not it.keywords
+                and len(it.args) >= 2
+                and isinstance(node.target, ast.Tuple)
+                and len(node.target.elts) == len(it.args)
+                and all(isinstance(e, ast.Name) for e in node.target.elts)):
+            seq_exprs = list(it.args)
+            row_names = [e.id for e in node.target.elts]
+        elif isinstance(node.target, ast.Name):
+            seq_exprs = [it]
+            row_names = [node.target.id]
+        else:
+            self.generic_visit(node)
+            return node
         i = self.idx
         self.idx += 1
         # the index must be a CARRIED loop var (plain `_pt_` prefix — the
         # `_pt_jst_` machinery prefix is excluded from carry varlists); the
-        # sequence is read-only and resolves through the closure
-        seq_n, idx_n, it = f"{_PREFIX}seq{i}", f"_pt_ti{i}", node.target.id
-        seq_assign = ast.Assign(targets=[_name(seq_n, ast.Store())],
-                                value=node.iter)
-        body_t = copy.deepcopy(node.body)
-        get_row = ast.Assign(
-            targets=[_name(it, ast.Store())],
-            value=_helper_expr("index_get", [_name(seq_n), _name(idx_n)]))
+        # sequences are read-only and resolve through the closure
+        idx_n = f"_pt_ti{i}"
+        seq_names = [f"{_PREFIX}seq{i}_{j}" for j in range(len(seq_exprs))]
+        assigns = [ast.Assign(targets=[_name(sn, ast.Store())], value=se)
+                   for sn, se in zip(seq_names, seq_exprs)]
+        rows = []
+        if enum_name is not None:
+            start_n = f"{_PREFIX}start{i}"
+            assigns.append(ast.Assign(targets=[_name(start_n, ast.Store())],
+                                      value=enum_start))
+            rows.append(ast.Assign(
+                targets=[_name(enum_name, ast.Store())],
+                value=_helper_expr("index_add", [_name(idx_n),
+                                                 _name(start_n)])))
+        rows += [ast.Assign(
+            targets=[_name(rn, ast.Store())],
+            value=_helper_expr("index_get", [_name(sn), _name(idx_n)]))
+            for rn, sn in zip(row_names, seq_names)]
         incr = ast.Assign(targets=[_name(idx_n, ast.Store())],
                           value=_helper_expr("index_incr", [_name(idx_n)]))
         loop = ast.While(
-            test=_helper_expr("index_lt", [_name(idx_n), _name(seq_n)]),
-            body=[get_row] + body_t, orelse=[])
+            test=_helper_expr("index_lt_min",
+                              [_name(idx_n)] + [_name(s) for s in seq_names]),
+            body=rows + copy.deepcopy(node.body), orelse=[])
         loop, pre_bc = self._prep_loop(loop, extra_tail=[incr])
         if loop is None:  # break/continue in a non-rewritable position
             self.generic_visit(node)
             return node
         loop._pt_bound_expr = _lambda0(_helper_expr(
-            "seq_trips", [_name(idx_n), _name(seq_n)]))
+            "seq_trips", [_name(idx_n)] + [_name(s) for s in seq_names]))
         loop._pt_force_compile = True
         self.generic_visit(loop)
         out_t = self.visit_While(loop, skip_children=True)
@@ -1030,13 +1088,24 @@ class _ControlFlowTransformer(ast.NodeTransformer):
             [ast.Assign(targets=[_name(idx_n, ast.Store())],
                         value=_helper_expr("index0", []))]
             + pre_bc + (out_t if isinstance(out_t, list) else [out_t]))
-        py_for = ast.For(target=node.target, iter=_name(seq_n),
+        if enum_name is not None:
+            py_iter = ast.Call(func=_name("enumerate"),
+                               args=[_name(seq_names[0]), _name(start_n)],
+                               keywords=[])
+        elif len(seq_names) > 1:
+            py_iter = ast.Call(func=_name("zip"),
+                               args=[_name(s) for s in seq_names],
+                               keywords=[])
+        else:
+            py_iter = _name(seq_names[0])
+        py_for = ast.For(target=node.target, iter=py_iter,
                          body=node.body, orelse=[])
         self.generic_visit(py_for)
         dispatch = ast.If(
-            test=_helper_expr("is_tensor_seq", [_name(seq_n)]),
+            test=_helper_expr("all_tensor_seqs",
+                              [_name(s) for s in seq_names]),
             body=tensor_arm, orelse=[py_for])
-        return [seq_assign, dispatch]
+        return assigns + [dispatch]
 
     def visit_While(self, node, skip_children=False):
         pre = []

@@ -560,3 +560,57 @@ def test_for_over_python_list_still_unrolls():
     ts = [paddle.to_tensor(np.float32(i)) for i in range(3)]
     out = float(np.asarray(conv(ts, paddle.to_tensor(np.float32(10)))._value))
     assert out == 13.0
+
+
+def test_for_enumerate_over_tensor():
+    x = np.arange(12, dtype=np.float32).reshape(4, 3)
+
+    @paddle.jit.to_static
+    def f(t):
+        acc = t[0] * 0
+        for i, row in enumerate(t):
+            acc = acc + row * (i + 1)
+        return acc
+
+    got = np.asarray(f(paddle.to_tensor(x))._value)
+    want = sum(x[i] * (i + 1) for i in range(4))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    @paddle.jit.to_static
+    def g(t):
+        acc = t[0] * 0
+        for i, row in enumerate(t, 10):
+            acc = acc + row * i
+        return acc
+
+    got = np.asarray(g(paddle.to_tensor(x))._value)
+    want = sum(x[i] * (i + 10) for i in range(4))
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_for_zip_over_tensors():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    b = np.arange(15, dtype=np.float32).reshape(5, 3) * 0.5  # longer: zip stops at 4
+
+    @paddle.jit.to_static
+    def f(t, u):
+        acc = t[0] * 0
+        for p, q in zip(t, u):
+            acc = acc + p * q
+        return acc
+
+    got = np.asarray(f(paddle.to_tensor(a), paddle.to_tensor(b))._value)
+    want = (a * b[:4]).sum(0)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
+def test_for_enumerate_python_list_unchanged():
+    def f(xs, y):
+        for i, x in enumerate(xs):
+            y = y + x * (i + 1)
+        return y
+
+    conv = convert_control_flow(f)
+    ts = [paddle.to_tensor(np.float32(v)) for v in (1.0, 2.0)]
+    out = float(np.asarray(conv(ts, paddle.to_tensor(np.float32(0)))._value))
+    assert out == 5.0  # 1*1 + 2*2

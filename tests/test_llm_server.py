@@ -149,3 +149,26 @@ def test_chunked_decode_matches_per_token(model):
                      decode_chunk=4, eos_token_id=eos)
     got = eng2.generate(p1, max_new_tokens=10)
     assert got == base[:5]
+
+
+def test_engine_with_gpt_family():
+    """The engine is model-agnostic over the generate_step/prefill_step
+    contract: the GPT family (learned positions, fused qkv block) serves
+    with the same parity."""
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(11)
+    cfg = GPTConfig.tiny(max_position_embeddings=128)
+    gpt = GPTForCausalLM(cfg)
+    gpt.eval()
+    rng = np.random.RandomState(9)
+    p1 = rng.randint(0, cfg.vocab_size, 9).astype(np.int32)
+    p2 = rng.randint(0, cfg.vocab_size, 21).astype(np.int32)
+    eng = LLMEngine(gpt, max_batch_slots=2, max_seq_len=128, decode_chunk=2)
+    f1 = eng.submit(p1, max_new_tokens=6)
+    f2 = eng.submit(p2, max_new_tokens=6)
+    eng.run_until_complete()
+    for p, f in ((p1, f1), (p2, f2)):
+        ids = paddle.to_tensor(np.asarray(p, np.int32)[None, :])
+        want = list(np.asarray(gpt.generate(ids, max_new_tokens=6)._value)[0])
+        assert f.result(timeout=1) == want
