@@ -19,10 +19,14 @@ update math of the dygraph TrainStep.
 
 Supported: the reference's canonical static workflow — program_guard
 capture, per-batch exe.run(feed/fetch), minimize, clone(for_test=True),
-save/load_inference_model.  Not captured: host-side buffer mutations
-(e.g. BatchNorm running-stat writes happen on placeholder values at build
-time only — use the dygraph path for BN-training parity), and in-place
-tensor rebinding inside a capture.
+save/load_inference_model.  Host-side buffer mutations (e.g. BatchNorm
+running-stat writes via `buffer.set_value(new_val)`) ARE captured:
+`_record_state_write` promotes the mutation to program state on the
+`_state_writes` tape, the compiled replay emits the written value as an
+extra output, and `Executor.run` rebinds the live buffer after each step —
+so static BN training matches dygraph exactly (BN-parity test in
+test_static_program.py).  Not captured: in-place rebinding of a tensor
+that is not program state (plain Python variables reassigned mid-capture).
 """
 from __future__ import annotations
 
