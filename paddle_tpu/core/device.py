@@ -68,6 +68,13 @@ def _accelerator_available() -> bool:
     return any(_kind(d) == "tpu" for d in jax.devices())
 
 
+def is_tpu_backend() -> bool:
+    """True when the default JAX backend is a TPU-family platform ("tpu", or
+    the tunneled "axon" plugin).  THE single predicate for fast-path dispatch
+    (Pallas kernels, hardware RNG) — don't re-implement the platform list."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
 _current_place: Place | None = None
 
 

@@ -122,7 +122,8 @@ class RNGStatesTracker:
     def add(self, name, seed):
         import jax
 
-        self.states_[name] = jax.random.key(seed)
+        from ...framework.random import make_key
+        self.states_[name] = make_key(seed)
 
     def rng_state(self, name="model_parallel_rng"):
         import contextlib

@@ -14,6 +14,37 @@ import jax
 import numpy as np
 
 
+_RNG_IMPL = None  # resolved lazily: "rbg" on TPU, jax default elsewhere
+
+
+def _rng_impl():
+    """TPU uses the hardware RBG bit generator: dropout-mask generation for
+    one ERNIE b512xs128 step measured 48.3 ms (threefry) vs 13.4 ms (rbg) on
+    v5e — threefry burns VPU cycles hashing counters while rbg reads the
+    on-chip RNG.  CPU/GPU keep the jax default (threefry) so host-side tests
+    and golden sequences are unchanged.  Override with set_rng_impl()."""
+    global _RNG_IMPL
+    if _RNG_IMPL is None:
+        from ..core.device import is_tpu_backend
+
+        _RNG_IMPL = "rbg" if is_tpu_backend() else "threefry2x32"
+    return _RNG_IMPL
+
+
+def set_rng_impl(impl: str):
+    """Force the PRNG implementation ('threefry2x32' | 'rbg'); takes effect at
+    the next paddle.seed()/key creation."""
+    global _RNG_IMPL
+    _RNG_IMPL = impl
+
+
+def make_key(seed: int):
+    """Create a PRNG key with the framework-selected implementation.  EVERY
+    key-creation site must use this (not bare jax.random.key/PRNGKey) or the
+    TPU rbg fast path silently reverts to threefry for that stream."""
+    return jax.random.key(int(seed), impl=_rng_impl())
+
+
 class Generator:
     """Stateful key-splitting generator (ref phi/core/generator.h:23)."""
 
@@ -24,12 +55,12 @@ class Generator:
     @property
     def key(self):
         if self._key is None:
-            self._key = jax.random.key(self._seed)
+            self._key = jax.random.key(self._seed, impl=_rng_impl())
         return self._key
 
     def manual_seed(self, seed: int):
         self._seed = int(seed)
-        self._key = jax.random.key(self._seed)
+        self._key = jax.random.key(self._seed, impl=_rng_impl())
         return self
 
     def initial_seed(self) -> int:

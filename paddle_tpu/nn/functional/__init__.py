@@ -12,6 +12,7 @@ from .conv import (  # noqa: F401
 from .norm import (  # noqa: F401
     batch_norm,
     layer_norm,
+    fused_dropout_add_layer_norm,
     instance_norm,
     group_norm,
     local_response_norm,

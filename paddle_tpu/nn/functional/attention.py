@@ -33,8 +33,12 @@ def _dense_sdpa(q, k, v, mask, dropout_p, is_causal, scale, training=True):
             logits = logits + mask
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
     if dropout_p and training:
-        keep = jax.random.bernoulli(_random.get_rng_key(), 1.0 - dropout_p, probs.shape)
-        probs = jnp.where(keep, probs / (1.0 - dropout_p), jnp.zeros_like(probs))
+        from .common import _dropout_mask_mul
+
+        # key-residual dropout (mask regenerated in bwd — see common.py):
+        # the [B,H,S,S] probs mask is the single largest dropout residual
+        probs = _dropout_mask_mul(probs, _random.get_rng_key(),
+                                  float(dropout_p), True, tuple(probs.shape))
     out = jnp.einsum("bhqk,bhkd->bhqd", probs, vT)
     return jnp.swapaxes(out, 1, 2)  # back to [B,S,H,D]
 
@@ -51,9 +55,9 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
             seq = qv.shape[1]
             seq_k = kv.shape[1]
             hd = qv.shape[-1]
-            import jax as _jax
+            from ...core.device import is_tpu_backend
 
-            on_tpu = _jax.default_backend() in ("tpu", "axon")
+            on_tpu = is_tpu_backend()
             no_drop = dropout_p == 0.0 or not training
             if backend == "flash" and not no_drop:
                 import warnings

@@ -4,6 +4,8 @@ Reference: python/paddle/nn/functional/common.py + input.py.
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -25,22 +27,57 @@ def linear(x, weight, bias=None, name=None):
     return apply_op(_f, (x, weight, bias), name="linear")
 
 
+def _keep_mask(key, shape, rate):
+    """Bernoulli(1-rate) keep mask from raw uint16 random bits: one
+    RngBitGenerator output + one compare, no f32 uniform temp (at the ERNIE
+    attention shape that temp alone is 384M per draw).  Granularity of the
+    keep probability is 1/65536 — below any observable dropout effect."""
+    thresh = np.uint16(min(int(round((1.0 - rate) * 65536.0)), 65535))
+    bits = jax.random.bits(key, shape, jnp.uint16)
+    return bits < thresh
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+def _dropout_mask_mul(v, key, rate, upscale, mask_shape):
+    keep = _keep_mask(key, mask_shape, rate)
+    scale = 1.0 / (1.0 - rate) if upscale else 1.0
+    return jnp.where(keep, v * jnp.asarray(scale, v.dtype), jnp.zeros_like(v))
+
+
+def _dropout_fwd(v, key, rate, upscale, mask_shape):
+    # residual = the KEY only: the mask is regenerated in the backward
+    # (hardware-RNG bits are cheap; storing the [*, S, S]/[B*S, H] bool
+    # residuals for a full encoder step costs ~2.3G HBM and OOMed the dense
+    # ERNIE step once rbg made the masks non-rematerializable for XLA)
+    return _dropout_mask_mul(v, key, rate, upscale, mask_shape), key
+
+
+def _dropout_bwd(rate, upscale, mask_shape, key, g):
+    keep = _keep_mask(key, mask_shape, rate)
+    scale = 1.0 / (1.0 - rate) if upscale else 1.0
+    dv = jnp.where(keep, g * jnp.asarray(scale, g.dtype), jnp.zeros_like(g))
+    return dv, None
+
+
+_dropout_mask_mul.defvjp(_dropout_fwd, _dropout_bwd)
+
+
 def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train", name=None):
     if not training or p == 0.0:
         if mode == "downscale_in_infer" and not training and p > 0.0:
             return apply_op(lambda v: v * (1.0 - float(p)), (x,), name="dropout_infer")
         return apply_op(lambda v: v, (x,), name="dropout_id")
     rate = float(p)
+    if rate >= 1.0:  # drop everything (1/(1-rate) scale would div-by-zero)
+        return apply_op(lambda v: jnp.zeros_like(v), (x,), name="dropout_all")
 
     def _f(v):
         shape = list(v.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
             shape = [s if i in axes else 1 for i, s in enumerate(shape)]
-        keep = jax.random.bernoulli(_random.get_rng_key(), 1.0 - rate, shape)
-        if mode == "upscale_in_train":
-            return jnp.where(keep, v / (1.0 - rate), jnp.zeros_like(v))
-        return jnp.where(keep, v, jnp.zeros_like(v))
+        return _dropout_mask_mul(v, _random.get_rng_key(), rate,
+                                 mode == "upscale_in_train", tuple(shape))
 
     return apply_op(_f, (x,), name="dropout")
 

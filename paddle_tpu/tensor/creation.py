@@ -163,7 +163,7 @@ def normal(mean=0.0, std=1.0, shape=None, name=None):
 
 def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
     d = _dt.convert_dtype(dtype)
-    key = jax.random.key(seed) if seed else _random.get_rng_key()
+    key = _random.make_key(seed) if seed else _random.get_rng_key()
     return Tensor(jax.random.uniform(key, _shape_arg(shape), dtype=d, minval=float(_unwrap(min)), maxval=float(_unwrap(max))))
 
 
