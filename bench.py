@@ -473,7 +473,17 @@ def _bench_llama_h4096(on_accel):
 
 def _bench_ernie(on_accel):
     """ERNIE/BERT-base MLM+NSP pretrain — THE driver north-star metric
-    (BASELINE.md:22: 'ERNIE-3.0 tokens/sec/chip')."""
+    (BASELINE.md:22: 'ERNIE-3.0 tokens/sec/chip').
+
+    Runs the REFERENCE pretrain recipe: masked_lm_positions with
+    max_predictions_per_seq = 20 (create_pretraining_data's 15% of seq 128),
+    MLM head over the gathered masked rows only.  FLOPs are accounted
+    HONESTLY for that recipe — encoder matmuls on all B*S tokens, MLM
+    transform+decoder on the B*20 masked rows, bidirectional attention term —
+    NOT the dense 6*N*T upper bound (which would overstate MFU ~1.19x for
+    work the masked head never does).  See ERNIE_BREAKDOWN.md for the
+    ablation ladder (694 -> ~420 ms/step) and the h=768 gemm-shape ceiling
+    audit this number sits against."""
     if not on_accel:
         return {}
     import paddle_tpu as paddle
@@ -485,41 +495,50 @@ def _bench_ernie(on_accel):
     model.bfloat16()
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01,
                                  parameters=model.parameters())
-    batch, seq, steps = 512, 128, 8
+    batch, seq, n_pred, steps = 512, 128, 20, 8
 
-    def loss_fn(ids, seg, mlm_labels, nsp):
-        loss, _ = model(ids, token_type_ids=seg, masked_lm_labels=mlm_labels,
-                        next_sentence_label=nsp)
+    def loss_fn(ids, seg, pos, labels, nsp):
+        loss, _ = model(ids, token_type_ids=seg, masked_lm_labels=labels,
+                        next_sentence_label=nsp, masked_positions=pos)
         return loss
 
     step = paddle.jit.TrainStep(model, loss_fn, opt)
     rng = np.random.RandomState(0)
     ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32))
     seg = paddle.to_tensor((rng.rand(batch, seq) > 0.5).astype(np.int32))
-    mlm = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
-    mlm[rng.rand(batch, seq) > 0.15] = -100  # 15% masked positions
-    mlm_labels = paddle.to_tensor(mlm)
+    pos = paddle.to_tensor(np.stack(
+        [rng.choice(seq, n_pred, replace=False) for _ in range(batch)]).astype(np.int32))
+    labels = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (batch, n_pred)).astype(np.int32))
     nsp = paddle.to_tensor(rng.randint(0, 2, (batch, 1)).astype(np.int32))
     for _ in range(2):
-        loss = step(ids, seg, mlm_labels, nsp)
+        loss = step(ids, seg, pos, labels, nsp)
     float(loss.item())
     windows = []
     for _ in range(3):
         t0 = time.perf_counter()
         for _ in range(steps):
-            loss = step(ids, seg, mlm_labels, nsp)
+            loss = step(ids, seg, pos, labels, nsp)
         float(loss.item())
         windows.append(time.perf_counter() - t0)
     dt = max(sorted(windows)[1] - _RTT_S, 1e-6)
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     tokens = batch * seq
-    attn_flops = 3 * 4 * batch * seq * seq * cfg.hidden_size \
-        * cfg.num_hidden_layers  # bidirectional (no causal /2)
-    flops_per_step = 6 * n_params * tokens + attn_flops
+    rows_masked = batch * n_pred
+    h, L = cfg.hidden_size, cfg.num_hidden_layers
+    # matmul param counts (weights only; gathers/biases excluded)
+    enc_matmul = L * (h * 3 * h + h * h + 2 * h * cfg.intermediate_size)
+    head_matmul = h * h + h * cfg.vocab_size        # transform + tied decoder
+    pooled_matmul = h * h + h * 2                   # pooler + NSP head
+    attn_flops = 3 * 4 * batch * seq * seq * h * L  # bidirectional (no causal /2)
+    flops_per_step = (6 * enc_matmul * tokens + 6 * head_matmul * rows_masked
+                      + 6 * pooled_matmul * batch + attn_flops)
     return {"ernie_tokens_per_sec_per_chip": round(tokens * steps / dt, 1),
             "ernie_mfu": round((flops_per_step * steps / dt) / V5E_PEAK_FLOPS, 4),
             "ernie_n_params": n_params,
-            "ernie_batch_seq": [batch, seq]}
+            "ernie_batch_seq": [batch, seq],
+            "ernie_masked_per_seq": n_pred,
+            "ernie_step_ms": round(dt / steps * 1e3, 1),
+            "ernie_flops_per_step": flops_per_step}
 
 
 def _bench_vit(on_accel):

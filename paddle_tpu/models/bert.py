@@ -123,14 +123,21 @@ class BertLayer(nn.Layer):
 
     def forward(self, x, mask=None):
         # dropout + residual + LN fused into one kernel on TPU (ref
-        # fused_dropout_helper.h epilogue; F.fused_dropout_add_layer_norm)
-        p = self.dropout.p
+        # fused_dropout_helper.h epilogue; F.fused_dropout_add_layer_norm).
+        # honours the Dropout sublayer's OWN flags (a user may call
+        # layer.dropout.eval() or configure downscale mode); the fused path
+        # assumes upscale_in_train, so other modes take the composed ops
+        drop = self.dropout
+        if drop.mode != "upscale_in_train":
+            x = self.attn_norm(x + drop(self.attention(x, mask)))
+            x = self.ffn_norm(x + drop(self.ffn_out(self.act(self.ffn_in(x)))))
+            return x
         x = F.fused_dropout_add_layer_norm(
             self.attention(x, mask), x, self.attn_norm.weight,
-            self.attn_norm.bias, p, self.attn_norm._epsilon, self.training)
+            self.attn_norm.bias, drop.p, self.attn_norm._epsilon, drop.training)
         x = F.fused_dropout_add_layer_norm(
             self.ffn_out(self.act(self.ffn_in(x))), x, self.ffn_norm.weight,
-            self.ffn_norm.bias, p, self.ffn_norm._epsilon, self.training)
+            self.ffn_norm.bias, drop.p, self.ffn_norm._epsilon, drop.training)
         return x
 
 

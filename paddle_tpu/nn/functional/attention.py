@@ -47,6 +47,34 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None, dropout_p=0.
                                  is_causal=False, training=True, scale=None, backend="auto", name=None):
     """query/key/value: [batch, seq, num_heads, head_dim] (paddle layout)."""
 
+    # fused short-sequence path (encoder workloads: BERT/ERNIE S<=512): one
+    # Pallas kernel per step with probs + dropout masks held in VMEM — the
+    # dense path's [B,H,S,S] logits/probs/mask HBM round-trips disappear
+    # (ops/encoder_attention.py; ref fused_attention_op.cu regime)
+    if backend == "auto" and attn_mask is None:
+        from ...core.device import is_tpu_backend
+        from ...ops import encoder_attention as _enc
+
+        qv = _unwrap(query)
+        kv = _unwrap(key)
+        use_enc = (qv.ndim == 4 and is_tpu_backend()
+                   and _enc.supported(qv.shape[0] * qv.shape[2], qv.shape[1],
+                                      qv.shape[-1], kv.shape[1]))
+        if use_enc:
+            rate = float(dropout_p) if (dropout_p and training) else 0.0
+            sc = scale
+
+            def _f(q, k, v):
+                seed = None
+                if rate > 0.0:
+                    seed = jax.random.bits(_random.get_rng_key(), (2,),
+                                           jnp.uint32).astype(jnp.int32)
+                return _enc.encoder_attention(q, k, v, seed=seed, scale=sc,
+                                              dropout_rate=rate,
+                                              causal=is_causal)
+
+            return apply_op(_f, (query, key, value), name="encoder_attention")
+
     use_flash = False
     if backend in ("auto", "flash"):
         try:

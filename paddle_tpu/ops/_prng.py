@@ -1,0 +1,61 @@
+"""Shared in-kernel PRNG plumbing for Pallas dropout kernels.
+
+The fwd/bwd mask-regeneration contract of ops/fused_ln.py and
+ops/encoder_attention.py depends on BIT-IDENTICAL seed mixing between the
+forward and backward kernels — this module is the single home for that logic
+(seed hash, uint threshold rounding, interpret-mode fallback) so the two
+kernels cannot silently diverge.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental.pallas import tpu as pltpu
+
+# Knuth multiplicative hash constant (2654435769 as int32): spreads
+# neighbouring block ids far apart in the seed space.
+_MIX = np.int32(-1640531527)
+
+
+def interpret_default():
+    from ..core.device import is_tpu_backend
+
+    return not is_tpu_backend()
+
+
+def thresh_u32(rate):
+    """uint32 keep-threshold: P(bits < thresh) = 1 - rate (granularity 2^-32)."""
+    return np.uint32(min(int(round((1.0 - rate) * 4294967296.0)), 4294967295))
+
+
+def block_bits(seed_ref, pid, shape, interpret):
+    """Raw uint32 random bits for grid block `pid`, deterministic in
+    (seed_ref[0], seed_ref[1], pid) — fwd and bwd kernels calling with the
+    same triple regenerate identical bits.
+
+    seed_ref: SMEM ref holding int32[2] (two words of the per-call stream).
+    On-chip: the hardware PRNG (pltpu).  Interpret mode (CPU tests): the
+    functional RNG — masks differ from on-chip masks, which is fine; dropout
+    streams are platform-local (same stance as the rbg/threefry split in
+    framework.random).
+    """
+    if interpret:
+        key = jax.random.PRNGKey(seed_ref[0].astype(jnp.uint32))
+        key = jax.random.fold_in(key, seed_ref[1].astype(jnp.uint32))
+        key = jax.random.fold_in(key, pid)
+        return jax.random.bits(key, shape, jnp.uint32)
+    # Mosaic accepts at most 2 seed words: fold the block id into word 0
+    pltpu.prng_seed(seed_ref[0] ^ (pid * _MIX), seed_ref[1])
+    return pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+
+
+def keep_mask(seed_ref, pid, shape, rate, interpret):
+    """Bernoulli(1-rate) keep-mask from block_bits."""
+    return block_bits(seed_ref, pid, shape, interpret) < thresh_u32(rate)
+
+
+def parallel_params(interpret):
+    """CompilerParams for embarrassingly-parallel 1-D grids."""
+    return None if interpret else pltpu.CompilerParams(
+        dimension_semantics=("parallel",))

@@ -28,14 +28,9 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 
-def _interpret_default():
-    from ..core.device import is_tpu_backend
-
-    return not is_tpu_backend()
-
-
-def _thresh_u32(rate):
-    return np.uint32(min(int(round((1.0 - rate) * 4294967296.0)), 4294967295))
+from ._prng import (interpret_default as _interpret_default,
+                    keep_mask as _keep_mask_bits,
+                    parallel_params as _params)
 
 
 def _pick_bn(n, h):
@@ -48,24 +43,9 @@ def _pick_bn(n, h):
 
 
 def _mask_keep(seed_ref, pid, shape, rate, interpret):
-    # two seed words + the block id: a 64-bit per-call stream, so cross-call
-    # 32-bit birthday collisions cannot replay identical mask blocks
-    if interpret:
-        # pltpu.prng_* has no interpret-mode lowering; use the functional RNG
-        # (CPU masks differ from on-chip masks — dropout streams are
-        # platform-local, same as the rbg/threefry split in framework.random)
-        key = jax.random.PRNGKey(seed_ref[0].astype(jnp.uint32))
-        key = jax.random.fold_in(key, seed_ref[1].astype(jnp.uint32))
-        key = jax.random.fold_in(key, pid)
-        bits = jax.random.bits(key, shape, jnp.uint32)
-    else:
-        # Mosaic accepts at most 2 seed words: fold the block id into word 0
-        # with a multiplicative hash (Knuth) so neighbouring pids land far
-        # apart in the seed space
-        mixed = seed_ref[0] ^ (pid * np.int32(-1640531527))  # 2654435769 as i32
-        pltpu.prng_seed(mixed, seed_ref[1])
-        bits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
-    return bits < _thresh_u32(rate)
+    # shared seed-mix contract: ops/_prng.py (fwd and bwd regenerate the
+    # same mask from the same (seed, pid))
+    return _keep_mask_bits(seed_ref, pid, shape, rate, interpret)
 
 
 def _stats(s, eps):
@@ -125,11 +105,6 @@ def _bwd_kernel(seed_ref, s_ref, g_ref, dz_ref,
     h = s.shape[-1]
     dg_ref[...] = jnp.broadcast_to(jnp.sum(dz * xhat, axis=0, keepdims=True), (8, h))
     db_ref[...] = jnp.broadcast_to(jnp.sum(dz, axis=0, keepdims=True), (8, h))
-
-
-def _params(interpret):
-    return None if interpret else pltpu.CompilerParams(
-        dimension_semantics=("parallel",))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))

@@ -1,0 +1,101 @@
+"""Fused short-sequence attention kernel (ops/encoder_attention.py).
+
+Round-5 component: the reference fused_attention_op.cu regime — whole [S,S]
+probs in VMEM, G heads per grid step, in-kernel dropout, recompute backward.
+CPU runs in interpret mode with the functional-RNG mask fallback.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from paddle_tpu.ops.encoder_attention import encoder_attention, supported
+
+pytestmark = pytest.mark.quick
+
+
+def _dense(q, k, v, causal=False):
+    d = q.shape[-1]
+    qT, kT, vT = (jnp.swapaxes(t, 1, 2) for t in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qT, kT) / np.sqrt(d)
+    if causal:
+        S = s.shape[-1]
+        s = jnp.where(jnp.tril(jnp.ones((S, S), bool)), s, -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.swapaxes(jnp.einsum("bhqk,bhkd->bhqd", p, vT), 1, 2)
+
+
+class TestEncoderAttentionKernel:
+    def setup_method(self):
+        rng = np.random.RandomState(0)
+        B, S, H, D = 2, 128, 4, 64
+        self.q = jnp.asarray(rng.randn(B, S, H, D) * 0.3, jnp.float32)
+        self.k = jnp.asarray(rng.randn(B, S, H, D) * 0.3, jnp.float32)
+        self.v = jnp.asarray(rng.randn(B, S, H, D) * 0.3, jnp.float32)
+        self.w = jnp.asarray(rng.randn(B, S, H, D), jnp.float32)
+        self.seed = jnp.asarray([3, 9], jnp.int32)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_dense(self, causal):
+        out = encoder_attention(self.q, self.k, self.v, causal=causal)
+        ref = _dense(self.q, self.k, self.v, causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_dense(self, causal):
+        gr = jax.grad(lambda q, k, v: jnp.sum(_dense(q, k, v, causal) * self.w),
+                      (0, 1, 2))(self.q, self.k, self.v)
+        gf = jax.grad(lambda q, k, v: jnp.sum(
+            encoder_attention(q, k, v, causal=causal) * self.w),
+            (0, 1, 2))(self.q, self.k, self.v)
+        for a, c in zip(gr, gf):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(c), atol=1e-4)
+
+    def test_dropout_deterministic_and_unbiased(self):
+        o1 = encoder_attention(self.q, self.k, self.v, seed=self.seed,
+                               dropout_rate=0.2)
+        o2 = encoder_attention(self.q, self.k, self.v, seed=self.seed,
+                               dropout_rate=0.2)
+        np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+        # E[dropout(p)] = p: mean over many heads/rows stays close to dense
+        ref = _dense(self.q, self.k, self.v)
+        assert float(jnp.mean(jnp.abs(o1 - ref))) < 0.05
+
+    def test_dropout_grads_finite(self):
+        gv = jax.grad(lambda v: jnp.sum(encoder_attention(
+            self.q, self.k, v, seed=self.seed, dropout_rate=0.2)))(self.v)
+        assert np.isfinite(np.asarray(gv)).all()
+
+    def test_dropout_without_seed_raises(self):
+        with pytest.raises(ValueError, match="requires a seed"):
+            encoder_attention(self.q, self.k, self.v, dropout_rate=0.1)
+
+    def test_unsupported_shape_raises(self):
+        q = jnp.zeros((2, 100, 4, 64))
+        with pytest.raises(ValueError, match="unsupported"):
+            encoder_attention(q, q, q)
+
+    def test_supported_predicate(self):
+        assert supported(6144, 128, 64)
+        assert supported(8, 512, 64)
+        assert not supported(8, 640, 64)      # S > 512
+        assert not supported(8, 100, 64)      # S % 128
+        assert not supported(8, 128, 96)      # D not in (64, 128)
+        assert not supported(8, 128, 64, 256)  # cross-attention
+
+
+class TestSdpaDispatch:
+    def test_sdpa_parity_short_seq(self):
+        # the dispatcher must give identical math whichever path it picks
+        rng = np.random.RandomState(1)
+        B, S, H, D = 2, 128, 4, 64
+        q = paddle.to_tensor(rng.randn(B, S, H, D).astype(np.float32))
+        k = paddle.to_tensor(rng.randn(B, S, H, D).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(B, S, H, D).astype(np.float32))
+        out = F.scaled_dot_product_attention(q, k, v)
+        ref = _dense(q._value, k._value, v._value)
+        np.testing.assert_allclose(np.asarray(out._value), np.asarray(ref),
+                                   atol=2e-3)

@@ -131,7 +131,7 @@ def _variant_masked(n_pred=20):
     return call, sync
 
 
-def _variant_fwd(drop=True, heads=True):
+def _variant_fwd(drop=True, attn_drop=None, heads=True):
     """Forward loss only (no grad, no optimizer) — same dropout/RNG work."""
     import jax
 
@@ -139,7 +139,9 @@ def _variant_fwd(drop=True, heads=True):
     from paddle_tpu.framework import random as _random
     from paddle_tpu.tensor.tensor import Tensor
 
-    cfg, model, loss_fn = _build(drop, True, heads)
+    if attn_drop is None:
+        attn_drop = drop  # 'nodrop' means ALL dropout off, as in _variant_step
+    cfg, model, loss_fn = _build(drop, attn_drop, heads)
     params, buffers = model.functional_state()
     ids, seg, mlm, nsp = _batch(cfg)
     raw = tuple(t._value for t in (ids, seg, mlm, nsp))
