@@ -49,16 +49,24 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
     pad = _pool_pad(padding, 2)
 
     def _f(v):
+        neg = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
+        if data_format != "NCHW" and not return_mask:
+            # native NHWC reduce_window — a transpose round-trip here would cost
+            # two full passes over the activation on the TPU fast path
+            padding = pad if isinstance(pad, str) else [(0, 0)] + list(pad) + [(0, 0)]
+            if not isinstance(padding, str) and ceil_mode:
+                padding = [(lo, hi + s - 1) for (lo, hi), s in
+                           zip(padding, (1,) + st + (1,))]
+            return jax.lax.reduce_window(v, neg, jax.lax.max, (1,) + ks + (1,),
+                                         (1,) + st + (1,), padding)
         if data_format != "NCHW":
             v = jnp.transpose(v, (0, 3, 1, 2))
-        neg = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else jnp.iinfo(v.dtype).min
         out = _reduce_pool(v, ks, st, pad, 2, jax.lax.max, neg, ceil_mode)
         if data_format != "NCHW":
             out = jnp.transpose(out, (0, 2, 3, 1))
         if return_mask:
             # argmax within each window -> flattened HxW index (ref MaxPool2dWithIndexKernel)
             n, c, h, w = v.shape
-            plist = [(0, 0), (0, 0)] + (list(pad) if not isinstance(pad, str) else None)
             # shift values to be >= 1 so the zero-filled PAD slots of
             # conv_general_dilated_patches can never win the argmax
             vshift = v - jnp.min(jnp.where(jnp.isfinite(v), v, jnp.inf)) + 1.0
@@ -77,6 +85,9 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False, ceil_m
             gi = jnp.arange(oh).reshape(1, 1, -1, 1) * st[0] - ph + wi
             gj = jnp.arange(ow).reshape(1, 1, 1, -1) * st[1] - pw + wj
             mask = (gi * w + gj).astype(jnp.int32)
+            if data_format != "NCHW":
+                # out was transposed back above; the mask must follow its layout
+                mask = jnp.transpose(mask, (0, 2, 3, 1))
             return out, mask
         return out
 

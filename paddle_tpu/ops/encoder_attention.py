@@ -43,7 +43,7 @@ def pick_g(bh, s, d):
     """Heads per grid step.  g=16 measured fastest for fwd+bwd at the encoder
     shapes (5.47 ms/layer vs 5.66 at g=8, 6.61 at g=4; BH=6144/S=128/D=64
     with dropout); fall through to any divisor that fits VMEM."""
-    for g in (16, 8, 32, 4, 2, 1):
+    for g in (16, 8, 4, 2, 1):
         if bh % g == 0 and g * s * d * 4 + g * s * s <= _VMEM_ELEMS:
             return g
     return None

@@ -42,12 +42,6 @@ def _pick_bn(n, h):
     return None
 
 
-def _mask_keep(seed_ref, pid, shape, rate, interpret):
-    # shared seed-mix contract: ops/_prng.py (fwd and bwd regenerate the
-    # same mask from the same (seed, pid))
-    return _keep_mask_bits(seed_ref, pid, shape, rate, interpret)
-
-
 def _stats(s, eps):
     # two-pass mean/var: s lives in VMEM here, so the second pass is free and
     # avoids the E[x^2]-E[x]^2 cancellation when |mean| >> spread
@@ -64,7 +58,7 @@ def _fwd_kernel(seed_ref, x_ref, y_ref, g_ref, b_ref, o_ref, s_ref,
     xf = x_ref[...].astype(jnp.float32)
     yf = y_ref[...].astype(jnp.float32)
     if rate > 0.0:
-        keep = _mask_keep(seed_ref, pid, y_ref.shape, rate, interpret)
+        keep = _keep_mask_bits(seed_ref, pid, y_ref.shape, rate, interpret)
         scale = (1.0 / (1.0 - rate)) if upscale else 1.0
         yf = jnp.where(keep, yf * scale, 0.0)
     s = xf + yf
@@ -94,7 +88,7 @@ def _bwd_kernel(seed_ref, s_ref, g_ref, dz_ref,
     ds = rstd * (dxhat - a - xhat * b)
     dx_ref[...] = ds.astype(dx_ref.dtype)
     if rate > 0.0:
-        keep = _mask_keep(seed_ref, pid, s_ref.shape, rate, interpret)
+        keep = _keep_mask_bits(seed_ref, pid, s_ref.shape, rate, interpret)
         scale = (1.0 / (1.0 - rate)) if upscale else 1.0
         dy_ref[...] = jnp.where(keep, ds * scale, 0.0).astype(dy_ref.dtype)
     else:

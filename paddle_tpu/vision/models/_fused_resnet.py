@@ -1,0 +1,146 @@
+"""TPU fast path for ResNet bottlenecks: fused 1x1-conv+BN Pallas kernels.
+
+Glue between the model and `paddle_tpu.ops.fused_conv_bn` (see that module's
+docstring for the memory-pass accounting).  Everything here is pure-JAX and
+runs inside one `apply_op` per block so the tape records a single node; the
+BatchNorm batch stats come back as extra outputs so the Layer can update its
+running buffers with the exact `F.batch_norm` momentum semantics.
+
+Layout contract: NHWC activations with the W axis padded to a multiple of 8
+("W'") from stage 2 on (wv = valid columns); pad columns hold zeros.  The
+per-stage (wv, W') ladder for a 224 input is 56/56, 28/32, 14/16, 7/8.
+
+Reference parity anchor: python/paddle/vision/models/resnet.py
+BottleneckBlock.forward — identical math (conv1x1 -> BN -> relu -> conv3x3 ->
+BN -> relu -> conv1x1 -> BN -> +identity -> relu), restructured so the
+normalize of bn2 folds into conv3's input read and never materializes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.fused_conv_bn import conv1x1_bn
+
+# Tests set this to exercise the fused path off-TPU (Pallas interpret mode).
+FORCE = False
+
+
+def masked_gap(x, *, wv):
+    """Global average pool over the VALID spatial region of a W-padded NHWC
+    activation -> [N, 1, 1, C] (AdaptiveAvgPool2D((1,1)) parity)."""
+    s = jnp.sum(x.astype(jnp.float32), axis=(1, 2), keepdims=True)
+    return (s / (x.shape[1] * wv)).astype(x.dtype)
+
+
+def update_running_stats(bn, mean_t, var_t, cnt):
+    """Write batch stats back to a BatchNorm layer's buffers with the exact
+    `F.batch_norm` momentum semantics (momentum * rm + (1-m) * stat, var
+    debiased by n/(n-1) — ref phi BatchNormKernel MeanOut/VarianceOut)."""
+    from ...tensor.tensor import Tensor, apply_op
+
+    if not isinstance(bn._mean, Tensor):
+        return
+    momentum = bn._momentum
+    factor = cnt / max(cnt - 1, 1)
+    new_mean = apply_op(
+        lambda rm, m: momentum * rm + (1 - momentum) * m,
+        (bn._mean, mean_t.detach()), name="bn_moving_mean")
+    new_var = apply_op(
+        lambda rv, v: momentum * rv + (1 - momentum) * (v * factor),
+        (bn._variance, var_t.detach()), name="bn_moving_var")
+    bn._mean.set_value(new_mean)
+    bn._variance.set_value(new_var)
+
+
+def _w1x1(w):
+    """[Cout, Cin, 1, 1] (paddle layout) -> [1, 1, Cin, Cout] (kernel layout)."""
+    return jnp.transpose(w, (2, 3, 1, 0))
+
+
+def _whwio(w):
+    """[Cout, Cin/g, kh, kw] -> [kh, kw, Cin/g, Cout]."""
+    return jnp.transpose(w, (2, 3, 1, 0))
+
+
+def _affine(s1, s2, cnt, gamma, beta, eps):
+    """Batch stats -> (mean, biased var, f32 scale/offset row vectors)."""
+    m = s1 / cnt
+    v = jnp.maximum(s2 / cnt - m * m, 0.0)
+    sc = gamma.astype(jnp.float32) * jax.lax.rsqrt(v + eps)
+    of = beta.astype(jnp.float32) - m * sc
+    return m, v, sc.reshape(1, -1), of.reshape(1, -1)
+
+
+def _colmask(Wp, wv, ndim_last):
+    col = jnp.arange(Wp) < wv
+    return col.reshape(1, 1, Wp, 1) if ndim_last else col.reshape(1, 1, Wp)
+
+
+def _sums(y):
+    yf = y.astype(jnp.float32)
+    return jnp.sum(yf, (0, 1, 2)), jnp.sum(yf * yf, (0, 1, 2))
+
+
+def downsample_step(x, wd, gd, bd, *, stride, wv_out, wp_out, eps):
+    """conv1x1(stride) + BN (no relu) for the projection shortcut.
+
+    x may be W-padded: a strided 1x1 conv maps zero pad columns to zero pad
+    columns, so only a possible re-pad (stage-2 entry, 28 -> 32) is needed.
+    """
+    y = jax.lax.conv_general_dilated(
+        x, _w1x1(wd), (stride, stride), [(0, 0), (0, 0)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    if y.shape[2] < wp_out:
+        y = jnp.pad(y, ((0, 0), (0, 0), (0, wp_out - y.shape[2]), (0, 0)))
+    s1, s2 = _sums(y)
+    cnt = y.shape[0] * y.shape[1] * wv_out
+    m, v, sc, of = _affine(s1, s2, cnt, gd, bd, eps)
+    idn = y.astype(jnp.float32) * sc.reshape(-1) + of.reshape(-1)
+    if wv_out != wp_out:
+        idn = jnp.where(_colmask(wp_out, wv_out, True), idn, 0.0)
+    return idn.astype(x.dtype), m, v
+
+
+def bottleneck_step(x, identity, w1, g1, b1, w2, g2, b2, w3, g3, b3,
+                    *, stride, groups, wv_in, wv_out, wp_out, eps):
+    """One fused bottleneck block.  Returns (z, m1, v1, m2, v2, m3, v3)."""
+    N, H, wp_in, _ = x.shape
+    dt = x.dtype
+
+    # conv1 (1x1, stride 1, input already normalized) + bn1 stats epilogue
+    y1, s11, s12 = conv1x1_bn(x, _w1x1(w1), wv=wv_in)
+    m1, v1, sc1, of1 = _affine(s11, s12, N * H * wv_in, g1, b1, eps)
+
+    # bn1 normalize + relu materializes z1 (conv2 is an XLA 3x3: producers
+    # cannot fold into its input read)
+    z1 = jnp.maximum(y1.astype(jnp.float32) * sc1.reshape(-1) + of1.reshape(-1), 0.0)
+    if wv_in != wp_in:
+        z1 = jnp.where(_colmask(wp_in, wv_in, True), z1, 0.0)
+    z1 = z1.astype(dt)
+
+    # conv2: 3x3 XLA, explicit (1,1) padding — on a padded-W input the zero
+    # columns reproduce SAME-pad semantics for the valid region
+    y2 = jax.lax.conv_general_dilated(
+        z1, _whwio(w2), (stride, stride), [(1, 1), (1, 1)],
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=groups)
+    Ho = y2.shape[1]
+    if y2.shape[2] < wp_out:
+        y2 = jnp.pad(y2, ((0, 0), (0, 0), (0, wp_out - y2.shape[2]), (0, 0)))
+    if wv_out != wp_out:
+        # garbage appears at pad columns (last valid column's window reaches
+        # into real data); re-zero them before stats / conv3
+        y2 = jnp.where(_colmask(wp_out, wv_out, True), y2, jnp.zeros((), dt))
+    s21, s22 = _sums(y2)
+    m2, v2, sc2, of2 = _affine(s21, s22, N * Ho * wv_out, g2, b2, eps)
+
+    # conv3 (1x1) with bn2's normalize+relu FOLDED into the input read
+    y3, s31, s32 = conv1x1_bn(y2, _w1x1(w3), sc2, of2, wv=wv_out)
+    m3, v3, sc3, of3 = _affine(s31, s32, N * Ho * wv_out, g3, b3, eps)
+
+    z = (y3.astype(jnp.float32) * sc3.reshape(-1) + of3.reshape(-1)
+         + identity.astype(jnp.float32))
+    z = jnp.maximum(z, 0.0)
+    if wv_out != wp_out:
+        z = jnp.where(_colmask(wp_out, wv_out, True), z, 0.0)
+    return z.astype(dt), m1, v1, m2, v2, m3, v3

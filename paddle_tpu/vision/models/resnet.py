@@ -1,24 +1,52 @@
 """ResNet family (ref: python/paddle/vision/models/resnet.py — BASELINE config #2).
 
-Same BasicBlock/BottleneckBlock structure and layer counts as the reference; NCHW
-convs lower straight onto the MXU.  bf16 via `model.bfloat16()` or amp.auto_cast.
+Same BasicBlock/BottleneckBlock structure and layer counts as the reference.
+`data_format="NHWC"` (net-new vs the reference's NCHW-only model zoo) selects
+the TPU channels-minor layout; in NHWC training mode on TPU, bottleneck blocks
+run the fused Pallas conv+BN fast path (`_fused_resnet.py` /
+`ops/fused_conv_bn.py`): bn2's normalize+ReLU folds into conv3's input read,
+BN batch stats accumulate in kernel epilogues, and the backward combines
+dX/dW/stats into single kernels.  Numerics match the composed path to bf16
+rounding (tests/test_fused_conv_bn.py).  bf16 via `model.bfloat16()` or
+amp.auto_cast.
 """
 from __future__ import annotations
 
+import functools
+
 from ... import nn
+
+
+def _fused_path_ok(model, x):
+    """NHWC + training + bottleneck blocks + (TPU or forced) + aligned input."""
+    from . import _fused_resnet as FR
+
+    if model._data_format != "NHWC" or not model.training:
+        return False
+    if not FR.FORCE:
+        from ...core.device import is_tpu_backend
+
+        if not is_tpu_backend():
+            return False
+    if str(x.dtype) not in ("paddle.bfloat16", "paddle.float32", "bfloat16", "float32"):
+        return False
+    shape = x.shape
+    return len(shape) == 4 and shape[3] == 3 and shape[1] % 32 == 0 and shape[2] % 32 == 0
 
 
 class BasicBlock(nn.Layer):
     expansion = 1
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
-        self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride, bias_attr=False)
+        norm_layer = norm_layer or functools.partial(nn.BatchNorm2D, data_format=data_format)
+        self.conv1 = nn.Conv2D(inplanes, planes, 3, padding=1, stride=stride,
+                               bias_attr=False, data_format=data_format)
         self.bn1 = norm_layer(planes)
         self.relu = nn.ReLU()
-        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False)
+        self.conv2 = nn.Conv2D(planes, planes, 3, padding=1, bias_attr=False,
+                               data_format=data_format)
         self.bn2 = norm_layer(planes)
         self.downsample = downsample
         self.stride = stride
@@ -36,19 +64,23 @@ class BottleneckBlock(nn.Layer):
     expansion = 4
 
     def __init__(self, inplanes, planes, stride=1, downsample=None, groups=1,
-                 base_width=64, dilation=1, norm_layer=None):
+                 base_width=64, dilation=1, norm_layer=None, data_format="NCHW"):
         super().__init__()
-        norm_layer = norm_layer or nn.BatchNorm2D
+        norm_layer = norm_layer or functools.partial(nn.BatchNorm2D, data_format=data_format)
         width = int(planes * (base_width / 64.0)) * groups
-        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False)
+        self.conv1 = nn.Conv2D(inplanes, width, 1, bias_attr=False, data_format=data_format)
         self.bn1 = norm_layer(width)
         self.conv2 = nn.Conv2D(width, width, 3, padding=dilation, stride=stride,
-                               groups=groups, dilation=dilation, bias_attr=False)
+                               groups=groups, dilation=dilation, bias_attr=False,
+                               data_format=data_format)
         self.bn2 = norm_layer(width)
-        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False)
+        self.conv3 = nn.Conv2D(width, planes * self.expansion, 1, bias_attr=False,
+                               data_format=data_format)
         self.bn3 = norm_layer(planes * self.expansion)
         self.relu = nn.ReLU()
         self.downsample = downsample
+        self._groups = groups
+        self._stride = stride
 
     def forward(self, x):
         identity = x
@@ -59,11 +91,45 @@ class BottleneckBlock(nn.Layer):
             identity = self.downsample(x)
         return self.relu(out + identity)
 
+    def forward_fused(self, x, wv_in, wv_out, wp_out):
+        """NHWC fused fast path (see module docstring).  x: [N, H, W'_in, C]
+        with zero pad columns; returns the block output at [N, Ho, W'_out, C']."""
+        from functools import partial
+
+        from ...tensor.tensor import apply_op
+        from . import _fused_resnet as FR
+
+        eps = float(self.bn1._epsilon)
+        N, H = x.shape[0], x.shape[1]
+        Ho = H // self._stride
+        cnt_out = N * Ho * wv_out
+        if self.downsample is not None:
+            convd, bnd = self.downsample[0], self.downsample[1]
+            identity, md, vd = apply_op(
+                partial(FR.downsample_step, stride=self._stride, wv_out=wv_out,
+                        wp_out=wp_out, eps=float(bnd._epsilon)),
+                (x, convd.weight, bnd.weight, bnd.bias), name="resnet_downsample_fused")
+            FR.update_running_stats(bnd, md, vd, cnt_out)
+        else:
+            identity = x
+        z, m1, v1, m2, v2, m3, v3 = apply_op(
+            partial(FR.bottleneck_step, stride=self._stride, groups=self._groups,
+                    wv_in=wv_in, wv_out=wv_out, wp_out=wp_out, eps=eps),
+            (x, identity, self.conv1.weight, self.bn1.weight, self.bn1.bias,
+             self.conv2.weight, self.bn2.weight, self.bn2.bias,
+             self.conv3.weight, self.bn3.weight, self.bn3.bias),
+            name="resnet_bottleneck_fused")
+        FR.update_running_stats(self.bn1, m1, v1, N * H * wv_in)
+        FR.update_running_stats(self.bn2, m2, v2, cnt_out)
+        FR.update_running_stats(self.bn3, m3, v3, cnt_out)
+        return z
+
 
 class ResNet(nn.Layer):
     """Ref resnet.py ResNet(Block, depth)."""
 
-    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True, groups=1):
+    def __init__(self, block, depth=50, width=64, num_classes=1000, with_pool=True,
+                 groups=1, data_format="NCHW"):
         super().__init__()
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
@@ -72,20 +138,23 @@ class ResNet(nn.Layer):
         self.base_width = width
         self.num_classes = num_classes
         self.with_pool = with_pool
-        self._norm_layer = nn.BatchNorm2D
+        self._data_format = data_format
+        self._norm_layer = functools.partial(nn.BatchNorm2D, data_format=data_format)
+        self._block_cls = block
         self.inplanes = 64
         self.dilation = 1
 
-        self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3, bias_attr=False)
+        self.conv1 = nn.Conv2D(3, self.inplanes, 7, stride=2, padding=3,
+                               bias_attr=False, data_format=data_format)
         self.bn1 = self._norm_layer(self.inplanes)
         self.relu = nn.ReLU()
-        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1)
+        self.maxpool = nn.MaxPool2D(3, stride=2, padding=1, data_format=data_format)
         self.layer1 = self._make_layer(block, 64, layers[0])
         self.layer2 = self._make_layer(block, 128, layers[1], stride=2)
         self.layer3 = self._make_layer(block, 256, layers[2], stride=2)
         self.layer4 = self._make_layer(block, 512, layers[3], stride=2)
         if with_pool:
-            self.avgpool = nn.AdaptiveAvgPool2D((1, 1))
+            self.avgpool = nn.AdaptiveAvgPool2D((1, 1), data_format=data_format)
         if num_classes > 0:
             self.fc = nn.Linear(512 * block.expansion, num_classes)
 
@@ -94,18 +163,48 @@ class ResNet(nn.Layer):
         downsample = None
         if stride != 1 or self.inplanes != planes * block.expansion:
             downsample = nn.Sequential(
-                nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride, bias_attr=False),
+                nn.Conv2D(self.inplanes, planes * block.expansion, 1, stride=stride,
+                          bias_attr=False, data_format=self._data_format),
                 norm_layer(planes * block.expansion),
             )
         layers = [block(self.inplanes, planes, stride, downsample, self.groups,
-                        self.base_width, 1, norm_layer)]
+                        self.base_width, 1, norm_layer, data_format=self._data_format)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
             layers.append(block(self.inplanes, planes, groups=self.groups,
-                                base_width=self.base_width, norm_layer=norm_layer))
+                                base_width=self.base_width, norm_layer=norm_layer,
+                                data_format=self._data_format))
         return nn.Sequential(*layers)
 
+    def _forward_fused(self, x):
+        """NHWC TPU fast path: stem + fused bottleneck stages + masked head."""
+        from functools import partial
+
+        from ...tensor.tensor import apply_op
+        from . import _fused_resnet as FR
+
+        x = self.relu(self.bn1(self.conv1(x)))
+        x = self.maxpool(x)
+        wv = x.shape[2]  # 56 for a 224 input; gate guarantees w0 % 8 == 0
+        for stage in (self.layer1, self.layer2, self.layer3, self.layer4):
+            for block in stage:
+                stride = block._stride
+                wv_out = wv // stride
+                wp_out = wv_out if wv_out % 8 == 0 else wv_out + (8 - wv_out % 8)
+                x = block.forward_fused(x, wv, wv_out, wp_out)
+                wv = wv_out
+        if self.with_pool:
+            x = apply_op(partial(FR.masked_gap, wv=wv), (x,), name="resnet_masked_gap")
+        if self.num_classes > 0:
+            from ...tensor.manipulation import flatten
+
+            x = flatten(x, 1)
+            x = self.fc(x)
+        return x
+
     def forward(self, x):
+        if self._block_cls is BottleneckBlock and _fused_path_ok(self, x):
+            return self._forward_fused(x)
         x = self.relu(self.bn1(self.conv1(x)))
         x = self.maxpool(x)
         x = self.layer1(x)
