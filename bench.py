@@ -309,8 +309,12 @@ def _bench_decode(on_accel):
             * cfg.num_key_value_heads
         kv_bytes_bf16 = kv_elems * hd * 2
         kv_bytes_int8 = kv_elems * (hd * 1 + 4)  # int8 payload + f32 scale
+        # streamed params exclude the INPUT embedding table: decode gathers B
+        # rows of it, it never streams (the r4 floor counted it and the round-5
+        # kernel then beat that "floor" — the accounting was the error)
+        streamed = n_params - cfg.vocab_size * cfg.hidden_size
         res["llama_decode_stream_gb_per_tok"] = round(
-            (2 * n_params + kv_bytes_bf16) / 1e9, 3)
+            (2 * streamed + kv_bytes_bf16) / 1e9, 3)
         if per_tok > 1e-6:
             res["llama_decode_ms_per_token"] = round(per_tok * 1000, 2)
             res["llama_decode_steady_tokens_per_sec"] = round(batch / per_tok, 1)
@@ -322,7 +326,7 @@ def _bench_decode(on_accel):
             res["llama_decode_int8_steady_tokens_per_sec"] = round(
                 batch / per_q8, 1)
         res["llama_decode_int8_stream_gb_per_tok"] = round(
-            (2 * n_params + kv_bytes_int8) / 1e9, 3)
+            (2 * streamed + kv_bytes_int8) / 1e9, 3)
         # int8 capacity win: max decode batch at this context before the kv
         # cache exhausts HBM (measured device limit when the runtime reports
         # one), bf16 vs int8 — the judge-requested kv_int8_max_batch_gain
@@ -346,6 +350,11 @@ def _bench_decode(on_accel):
         _, per32 = steady(ids32, new_tokens)
         if per32 > 1e-6:
             res["llama_decode_b32_steady_tokens_per_sec"] = round(32 / per32, 1)
+        # int8 at the capacity-bound batch: its halved kv stream must win here
+        _, per32q = steady(ids32, new_tokens, "int8")
+        if per32q > 1e-6:
+            res["llama_decode_int8_b32_steady_tokens_per_sec"] = round(
+                32 / per32q, 1)
     return res
 
 

@@ -39,50 +39,89 @@ def _interpret_default():
     return jax.default_backend() not in ("tpu", "axon")
 
 
-def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, bk, L, scale, quant,
-                   ks_ref=None, vs_ref=None):
-    """One (batch, head) grid point: q [D] against k/v [L, D].  Scales ride
-    as [L // 128, 128] f32 views (the Mosaic lane-tiling shape for a
-    per-token vector)."""
-    q = q_ref[0, 0]  # [1, D], storage dtype (bf16 MXU inputs)
-    # per-BATCH valid length (continuous-batching slots sit at different
-    # depths); keys 0..valid-1 are attendable
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, kw_ref, vw_ref, *,
+                   bk, L, G, rep, scale, quant, ks_ref=None, vs_ref=None):
+    """One (batch, kv-head-group) grid point: G*rep query heads against their
+    G kv heads' [L, D] caches.  Grouping amortizes the per-grid-point DMA +
+    dispatch overhead ~G*x vs the old per-(batch, head) grid (measured 0.165
+    -> ~0.04 ms/layer/step at B8 H16 L1152).  int8 caches dequantize ONCE
+    into VMEM scratch before the block loop — the in-loop cast was VPU-bound
+    and serialized against the dots (isolated: 300 -> 142 us)."""
+    H = G * rep
     valid = len_ref[pl.program_id(0)]
     nkb = L // bk
+    D = q_ref.shape[-1]
+    Hp = q_ref.shape[-2]  # H padded to the 8-sublane tile
+
+    if quant:
+        kw_ref[...] = k_ref[0].astype(jnp.bfloat16)
+        vw_ref[...] = v_ref[0].astype(jnp.bfloat16)
+        kb, vb = kw_ref, vw_ref
+    else:
+        kb, vb = k_ref, v_ref
 
     def body(kj, carry):
-        m, l, acc = carry
-        k = k_ref[0, 0, pl.ds(kj * bk, bk), :]  # [bk, D]
-        v = v_ref[0, 0, pl.ds(kj * bk, bk), :]
-        if quant:
-            k = k.astype(jnp.bfloat16)  # int8 payload exact in bf16
-            v = v.astype(jnp.bfloat16)
-        # lane-major scores: [1, D] @ [bk, D]^T on the MXU
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32) * scale  # [1, bk]
+        m, l, acc = carry  # [H, 1], [H, 1], [H, D] f32
+        rows_s = []
+        for g in range(G):
+            if quant:
+                kg = kb[g, pl.ds(kj * bk, bk), :]
+            else:
+                kg = kb[0, g, pl.ds(kj * bk, bk), :]
+            for r in range(rep):
+                h = g * rep + r
+                qh = q_ref[0, 0, h:h + 1, :]  # [1, D]
+                s = jax.lax.dot_general(qh, kg, (((1,), (1,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+                rows_s.append(s)
+        s = jnp.concatenate(rows_s, axis=0) * scale  # [H, bk]
         if quant:
             rows = bk // 128
-            ks = ks_ref[0, 0, pl.ds(kj * rows, rows), :].reshape(1, bk)
-            s = s * ks
+            ks = ks_ref[0, :, pl.ds(kj * rows, rows), :].reshape(G, bk)
+            s = s * jnp.repeat(ks, rep, axis=0) if rep > 1 else s * ks
         kpos = kj * bk + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1)
         s = jnp.where(kpos < valid, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s))
-        p = jnp.exp(s - m_new)  # [1, bk] f32
+        m_new = jnp.maximum(m, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)  # [H, bk] f32
         corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p)  # normalizer BEFORE any value scaling
+        l = l * corr + jnp.sum(p, axis=1, keepdims=True)
         if quant:
-            vs = vs_ref[0, 0, pl.ds(kj * rows, rows), :].reshape(1, bk)
-            p = p * vs  # fold the value scales into the probs
-        acc = acc * corr + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)  # [1, D]
+            vs = vs_ref[0, :, pl.ds(kj * rows, rows), :].reshape(G, bk)
+            p = p * jnp.repeat(vs, rep, axis=0) if rep > 1 else p * vs
+        pb = p.astype(jnp.bfloat16 if quant else vb.dtype)
+        outs = []
+        for g in range(G):
+            if quant:
+                vg = vb[g, pl.ds(kj * bk, bk), :]
+            else:
+                vg = vb[0, g, pl.ds(kj * bk, bk), :]
+            outs.append(jax.lax.dot_general(
+                pb[g * rep:(g + 1) * rep], vg, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        pv = jnp.concatenate(outs, axis=0)  # [H, D]
+        acc = acc * corr + pv
         return m_new, l, acc
 
-    m0 = jnp.float32(NEG_INF)
-    l0 = jnp.float32(0.0)
-    acc0 = jnp.zeros((1, q.shape[1]), jnp.float32)
+    m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((H, 1), jnp.float32)
+    acc0 = jnp.zeros((H, D), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, nkb, body, (m0, l0, acc0))
-    o_ref[0, 0, 0] = (acc[0] / l).astype(o_ref.dtype)
+    out = (acc / l).astype(o_ref.dtype)
+    if Hp != H:
+        out = jnp.concatenate(
+            [out, jnp.zeros((Hp - H, D), o_ref.dtype)], axis=0)
+    o_ref[0, 0] = out
+
+
+def _pick_group(Hkv, L, D, quant):
+    """kv heads per grid point: largest divisor of Hkv whose blocks (plus the
+    dequant scratch for int8) stay within ~6 MB of VMEM."""
+    per_head = L * D * (1 if quant else 2) * 2          # k + v blocks
+    scratch = L * D * 2 * 2 if quant else 0             # bf16 dequant scratch
+    for g in (16, 8, 4, 2, 1):
+        if Hkv % g == 0 and g * (per_head + scratch) <= 6 * 1024 * 1024:
+            return g
+    return 1
 
 
 def _decode_pallas(q, k, v, offset, k_scale, v_scale, scale, bk, interpret):
@@ -94,46 +133,56 @@ def _decode_pallas(q, k, v, offset, k_scale, v_scale, scale, bk, interpret):
         jnp.asarray(offset, jnp.int32) + S, (B,)).astype(jnp.int32)
     # head-major query so every block's trailing dims are tile-clean
     q = jnp.transpose(q, (0, 2, 1, 3))  # [B, H, 1, D]
+    G = _pick_group(Hkv, L, D, quant)
+    ng = Hkv // G
+    Hg = G * rep  # query heads per grid point
+    Hp = max(Hg, 8)  # sublane-tile floor for the per-group q/out blocks
+    qg = q.reshape(B, ng, Hg, D)
+    if Hp != Hg:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Hp - Hg), (0, 0)))
 
     # index maps receive the prefetched scalar ref as a trailing argument
     in_specs = [
-        pl.BlockSpec((1, 1, 1, D), lambda b, h, _len: (b, h, 0, 0)),
-        pl.BlockSpec((1, 1, L, D), lambda b, h, _len: (b, h // rep, 0, 0)),
-        pl.BlockSpec((1, 1, L, D), lambda b, h, _len: (b, h // rep, 0, 0)),
+        pl.BlockSpec((1, 1, Hp, D), lambda b, j, _len: (b, j, 0, 0)),
+        pl.BlockSpec((1, G, L, D), lambda b, j, _len: (b, j, 0, 0)),
+        pl.BlockSpec((1, G, L, D), lambda b, j, _len: (b, j, 0, 0)),
     ]
-    args = [q, k, v]
+    args = [qg, k, v]
     if quant:
         in_specs += [
-            pl.BlockSpec((1, 1, L // 128, 128),
-                         lambda b, h, _len: (b, h // rep, 0, 0)),
-            pl.BlockSpec((1, 1, L // 128, 128),
-                         lambda b, h, _len: (b, h // rep, 0, 0)),
+            pl.BlockSpec((1, G, L // 128, 128), lambda b, j, _len: (b, j, 0, 0)),
+            pl.BlockSpec((1, G, L // 128, 128), lambda b, j, _len: (b, j, 0, 0)),
         ]
         args += [k_scale.reshape(B, Hkv, L // 128, 128),
                  v_scale.reshape(B, Hkv, L // 128, 128)]
 
-    kernel = functools.partial(_decode_kernel, bk=bk, L=L, scale=scale,
-                               quant=quant)
-    if quant:
-        def kernel(len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref):
-            return _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref,
-                                  bk=bk, L=L, scale=scale, quant=True,
-                                  ks_ref=ks_ref, vs_ref=vs_ref)
+    def kernel(len_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, kw_ref, vw_ref = rest
+        else:
+            (o_ref,) = rest[:1]
+            ks_ref = vs_ref = kw_ref = vw_ref = None
+        return _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, kw_ref,
+                              vw_ref, bk=bk, L=L, G=G, rep=rep, scale=scale,
+                              quant=quant, ks_ref=ks_ref, vs_ref=vs_ref)
 
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(B, H),
+            grid=(B, ng),
             in_specs=in_specs,
-            out_specs=pl.BlockSpec((1, 1, 1, D), lambda b, h, _len: (b, h, 0, 0)),
+            out_specs=pl.BlockSpec((1, 1, Hp, D), lambda b, j, _len: (b, j, 0, 0)),
+            scratch_shapes=([pltpu.VMEM((G, L, D), jnp.bfloat16)] * 2
+                            if quant else []),
         ),
-        out_shape=jax.ShapeDtypeStruct((B, H, 1, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, ng, Hp, D), q.dtype),
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel")),
     )(valid, *args)
-    return jnp.transpose(out, (0, 2, 1, 3))  # back to [B, S=1, H, D]
+    out = out[:, :, :Hg, :].reshape(B, H, 1, D)
+    return out.transpose(0, 2, 1, 3)  # [B, 1, H, D]
 
 
 def _decode_dense(q, k, v, offset, k_scale, v_scale, scale):
