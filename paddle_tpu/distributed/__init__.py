@@ -26,6 +26,8 @@ from . import auto_parallel  # noqa: F401
 from .auto_parallel import ProcessMesh, shard_tensor, shard_op  # noqa: F401
 from .store import Store, TCPStore  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import fault_tolerance  # noqa: F401
+from .fault_tolerance import Preemption, run_with_recovery  # noqa: F401
 from . import fleet_executor  # noqa: F401
 from . import launch  # noqa: F401
 from . import utils  # noqa: F401

@@ -16,13 +16,32 @@ mesh/sharding: every device slice requested by the new sharding is assembled
 from whatever stored chunks overlap it.  A tp=2 checkpoint therefore restores
 under tp=4 (or pp=2, or a single chip) with no separate converter pass — the
 chunk table plays the role of the reference's Converter merge/slice machinery.
+
+Integrity & commit protocol (fault-tolerance layer):
+
+- every volume records a CRC32 + SHA-256 in ``index.json`` (or its process
+  sidecar) and all files are written tmp + ``os.replace`` — a torn write
+  leaves only an orphaned ``*.tmp`` file;
+- a save is visible only once its ``COMMITTED`` marker lands (written last
+  by process 0): ``latest_step`` scans for committed, unquarantined steps,
+  so a save killed mid-write simply does not exist;
+- ``load_state`` verifies volume checksums; a corrupt step is quarantined
+  (a ``QUARANTINED`` marker records the reason) and, when the step was not
+  explicitly requested, the loader falls back to the newest valid step;
+- ``CheckpointManager`` retries transient I/O errors (ENOSPC/EIO…) with
+  exponential backoff and its keep-last-k GC only ever deletes steps older
+  than the k newest *valid* ones — it can never remove the only good
+  checkpoint.
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import pickle
 import shutil
+import time
+import zlib
 
 import numpy as np
 import jax
@@ -30,12 +49,86 @@ import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 __all__ = [
-    "save_state", "load_state", "latest_step", "CheckpointManager",
+    "save_state", "load_state", "latest_step", "valid_steps",
+    "CheckpointManager", "CheckpointCorruptError",
     "save_train_state", "load_train_state",
 ]
 
 _INDEX = "index.json"
 _SKELETON = "skeleton.pkl"
+_COMMITTED = "COMMITTED"
+_QUARANTINED = "QUARANTINED"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (bad checksum, missing or
+    unreadable volume/index/skeleton, or a chunk-coverage gap).
+
+    ``quarantinable`` distinguishes definite corruption (checksum mismatch,
+    garbled files — safe to mark QUARANTINED forever) from findings that can
+    also be a transient multi-host race (a volume/chunk another process is
+    still writing): the loader falls back either way but only writes the
+    permanent marker for the former."""
+
+    def __init__(self, *args, quarantinable=True):
+        super().__init__(*args)
+        self.quarantinable = quarantinable
+
+
+# ------------------------------------------------------------------ integrity
+def _file_digests(path):
+    crc = 0
+    h = hashlib.sha256()
+    size = 0
+    with open(path, "rb") as f:
+        while True:
+            block = f.read(1 << 20)
+            if not block:
+                break
+            crc = zlib.crc32(block, crc)
+            h.update(block)
+            size += len(block)
+    return {"crc32": f"{crc & 0xFFFFFFFF:08x}", "sha256": h.hexdigest(),
+            "bytes": size}
+
+
+def _atomic_write(path, data: bytes):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+    os.replace(tmp, path)
+
+
+def is_committed(ckpt):
+    """True when the COMMITTED marker is present and not a de-commit
+    tombstone (a re-save in progress rewrites the marker to
+    ``{"resaving": true}`` instead of deleting it, so a kill mid-rewrite
+    can never be mistaken for a committed — or legacy pre-marker — dir)."""
+    p = os.path.join(ckpt, _COMMITTED)
+    try:
+        with open(p) as f:
+            return not json.load(f).get("resaving")
+    except FileNotFoundError:
+        return False
+    except (OSError, ValueError):
+        return False  # unreadable marker: be conservative
+
+
+def is_quarantined(ckpt):
+    return os.path.exists(os.path.join(ckpt, _QUARANTINED))
+
+
+def quarantine(ckpt, reason=""):
+    """Mark a checkpoint dir as corrupt; discovery (`latest_step`,
+    `valid_steps`, fallback loading) skips it from now on.  The data is kept
+    on disk for forensics; GC removes it once enough newer valid steps
+    exist."""
+    try:
+        _atomic_write(os.path.join(ckpt, _QUARANTINED),
+                      json.dumps({"reason": str(reason),
+                                  "time": time.time()}).encode())
+    except OSError:
+        pass  # quarantine is advisory; checksum verification still protects
 
 
 # --------------------------------------------------------------------- pytree
@@ -106,6 +199,13 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
 
     Each process saves only shards it owns; callers on multi-host must call this
     on every process (the volumes are disjoint).  Returns the checkpoint dir.
+
+    No cross-host barrier is taken: process 0's COMMITTED marker may land
+    before a peer's volume/sidecar (a reader then hits a chunk-coverage
+    gap, which is a non-quarantinable fallback, and the sidecar merge
+    skips step-mismatched leftovers).  Multi-host callers wanting a hard
+    guarantee should barrier (e.g. TCPStore.barrier) after save_state
+    before relying on the step.
     """
     proc = jax.process_index() if process_index is None else process_index
     nprocs = jax.process_count() if process_count is None else process_count
@@ -117,6 +217,20 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
             "must pass a step so each save generation is distinguishable")
     ckpt = _step_dir(path, step)
     os.makedirs(ckpt, exist_ok=True)
+    if proc == 0:
+        # de-commit before touching any content: a save killed mid-write must
+        # leave the dir invisible to discovery (and a re-save into a
+        # quarantined dir rehabilitates it only by completing).  The
+        # tombstone is written UNCONDITIONALLY (not just over an existing
+        # marker): a marker-less dir with an index — committed v2, legacy,
+        # or half-written — would otherwise pass for a legacy (pre-marker)
+        # checkpoint if this save dies partway
+        _atomic_write(os.path.join(ckpt, _COMMITTED),
+                      json.dumps({"resaving": True}).encode())
+        try:
+            os.remove(os.path.join(ckpt, _QUARANTINED))
+        except FileNotFoundError:
+            pass
 
     leaves: dict = {}
     skel = _flatten(state, "", leaves)
@@ -156,18 +270,39 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
                                         "offset": starts, "sizes": sizes})
         index[key] = entry
 
+    volumes = {}
     if chunks:
-        np.savez(os.path.join(ckpt, vol_name), **chunks)
+        vol_path = os.path.join(ckpt, vol_name)
+        tmp_vol = vol_path + ".tmp.npz"  # np.savez appends .npz if absent
+        np.savez(tmp_vol, **chunks)
+        volumes[vol_name] = _file_digests(tmp_vol)
+        os.replace(tmp_vol, vol_path)
 
     if proc == 0:
         idx_path = os.path.join(ckpt, _INDEX)
-        # drop stale artifacts from a previous save generation: step=None dirs
-        # are single-process (enforced above), so ALL sidecars/foreign volumes
-        # are stale; step dirs drop sidecars whose recorded step mismatches
+        # drop stale artifacts from previous save generations.  A sidecar/
+        # volume from a process index >= the CURRENT world size can only be
+        # a leftover from a prior, wider generation (a replay after scale-
+        # down, or a step=None re-save where nprocs==1 makes every foreign
+        # file stale) — deleting by process index is race-free, unlike a
+        # blanket purge, which could delete files current-generation peers
+        # already published (no cross-host barrier orders us).  Sidecars
+        # from procs < nprocs with a mismatched recorded step are likewise
+        # stale; a same-step same-width prior generation is overwritten by
+        # each peer's own atomic re-publish instead.
+        def _proc_of(name, prefix, suffix):
+            try:
+                return int(name[len(prefix):-len(suffix)])
+            except ValueError:
+                return None
+
         for name in os.listdir(ckpt):
             full = os.path.join(ckpt, name)
+            if ".tmp" in name:
+                continue  # a peer's in-flight atomic write — never touch
             if name.startswith("index_p") and name.endswith(".json"):
-                if step is None:
+                p = _proc_of(name, "index_p", ".json")
+                if p is not None and p >= nprocs:
                     os.remove(full)
                     continue
                 try:
@@ -179,53 +314,137 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
                     # (tmp + rename), so this is a transient read race — leave
                     # it; _read_index skips mismatched/garbled sidecars anyway
                     pass
-            elif step is None and name.startswith("volume_p") and \
-                    name != vol_name and name.endswith(".npz"):
-                os.remove(full)
-        with open(idx_path, "w") as f:
-            json.dump({"version": 1, "step": step, "leaves": index}, f)
-        with open(os.path.join(ckpt, _SKELETON), "wb") as f:
-            pickle.dump(skel, f)
-        if step is not None:
-            tmp = os.path.join(path, ".latest.tmp")
-            with open(tmp, "w") as f:
-                f.write(str(int(step)))
-            os.replace(tmp, os.path.join(path, "latest"))
+            elif name.startswith("volume_p") and name != vol_name and \
+                    name.endswith(".npz"):
+                p = _proc_of(name, "volume_p", ".npz")
+                if p is not None and p >= nprocs:
+                    os.remove(full)
+        _atomic_write(idx_path, json.dumps(
+            {"version": 2, "step": step, "leaves": index,
+             "volumes": volumes}).encode())
+        _atomic_write(os.path.join(ckpt, _SKELETON), pickle.dumps(skel))
+        # commit marker LAST: only now does the checkpoint exist for
+        # discovery (latest_step / valid_steps / fallback loading).  It
+        # carries digests of the index/skeleton — the volumes' digests live
+        # in the index, so every file in the protocol ends up verifiable
+        _atomic_write(os.path.join(ckpt, _COMMITTED), json.dumps(
+            {"step": step,
+             "files": {_INDEX: _file_digests(idx_path),
+                       _SKELETON: _file_digests(
+                           os.path.join(ckpt, _SKELETON))}}).encode())
     elif chunks:
         # non-zero process: publish our chunk table so proc 0 can merge it, or —
         # shared-filesystem case — just append via a sidecar the loader also reads.
         side = os.path.join(ckpt, f"index_p{proc:05d}.json")
-        tmp_side = side + ".tmp"
-        with open(tmp_side, "w") as f:
-            json.dump({"step": step, "leaves": index}, f)
-        os.replace(tmp_side, side)  # atomic: readers never see a partial file
+        _atomic_write(side, json.dumps(   # atomic: readers never see a partial
+            {"step": step, "leaves": index, "volumes": volumes}).encode())
     return ckpt
 
 
 # ----------------------------------------------------------------------- load
+def _discoverable(d):
+    """A dir counts for discovery/retention when it is a committed v2 step
+    OR a legacy (pre-marker) checkpoint: new-code saves write the de-commit
+    tombstone before any content, so a marker-less dir with an index can
+    only have been written whole by the old format."""
+    if is_quarantined(d):
+        return False
+    if os.path.exists(os.path.join(d, _COMMITTED)):
+        return is_committed(d)  # tombstone (resaving) -> False
+    return os.path.exists(os.path.join(d, _INDEX))
+
+
+def valid_steps(path):
+    """Sorted steps whose dirs completed their commit protocol (or predate
+    it) and are not quarantined."""
+    try:
+        names = os.listdir(path)
+    except OSError:
+        return []
+    out = []
+    for name in names:
+        if not name.startswith("step_"):
+            continue
+        try:
+            s = int(name[5:])
+        except ValueError:
+            continue
+        if _discoverable(os.path.join(path, name)):
+            out.append(s)
+    return sorted(out)
+
+
 def latest_step(path):
-    p = os.path.join(path, "latest")
-    if not os.path.exists(p):
-        return None
-    with open(p) as f:
-        return int(f.read().strip())
+    """Newest step that completed its commit protocol (a save killed
+    mid-write never committed, so it is invisible here)."""
+    steps = valid_steps(path)
+    return steps[-1] if steps else None
 
 
 class _VolumeCache:
-    def __init__(self, ckpt):
+    def __init__(self, ckpt, volmeta=None, verify=True):
         self.ckpt = ckpt
+        self.volmeta = volmeta or {}
+        self.verify = verify
         self._open = {}
 
     def get(self, volume, key):
         if volume not in self._open:
-            self._open[volume] = np.load(os.path.join(self.ckpt, volume))
-        return self._open[volume][key]
+            path = os.path.join(self.ckpt, volume)
+            meta = self.volmeta.get(volume)
+            try:
+                if self.verify and meta and "crc32" in meta:
+                    got = _file_digests(path)  # one streaming pass, no slurp
+                    for name in ("crc32", "sha256"):
+                        if name in meta and got[name] != meta[name]:
+                            raise CheckpointCorruptError(
+                                f"checkpoint volume {volume} failed {name} "
+                                f"verification (stored {meta[name]}, "
+                                f"got {got[name]})")
+                self._open[volume] = np.load(path)  # lazy per-chunk zip read
+            except FileNotFoundError as e:
+                # possibly another host still writing its volume — fall
+                # back, but do not permanently quarantine
+                raise CheckpointCorruptError(
+                    f"checkpoint volume {volume} is missing",
+                    quarantinable=False) from e
+            except CheckpointCorruptError:
+                raise
+            except OSError as e:
+                # transient media error (EIO and friends): fall back without
+                # condemning data that may read fine on retry
+                raise CheckpointCorruptError(
+                    f"checkpoint volume {volume} could not be read: {e}",
+                    quarantinable=False) from e
+            except Exception as e:
+                # the bytes were readable but are not a valid npz archive
+                raise CheckpointCorruptError(
+                    f"checkpoint volume {volume} is unreadable: {e}") from e
+        try:
+            return self._open[volume][key]
+        except KeyError as e:
+            raise CheckpointCorruptError(
+                f"chunk {key} missing from volume {volume}") from e
 
 
 def _read_index(ckpt):
-    with open(os.path.join(ckpt, _INDEX)) as f:
-        index = json.load(f)
+    try:
+        with open(os.path.join(ckpt, _INDEX)) as f:
+            index = json.load(f)
+    except FileNotFoundError:
+        if not os.path.isdir(ckpt):
+            raise
+        # non-quarantinable: a dir without its index can be a first save
+        # still in flight on another host — a stale QUARANTINED marker
+        # written now could outlive the commit and hide a valid checkpoint
+        raise CheckpointCorruptError(
+            f"checkpoint dir {ckpt} has no {_INDEX}",
+            quarantinable=False) from None
+    except ValueError as e:
+        raise CheckpointCorruptError(
+            f"checkpoint index in {ckpt} is unreadable: {e}") from e
     leaves = index["leaves"]
+    index.setdefault("volumes", {})
     # merge sidecar indices from other processes (shared filesystem); a sidecar
     # from a different save generation (mismatched step) is stale — skip it
     for name in sorted(os.listdir(ckpt)):
@@ -237,6 +456,7 @@ def _read_index(ckpt):
                 continue  # transient write race; chunk coverage check catches real gaps
             if side_doc.get("step") != index.get("step"):
                 continue
+            index["volumes"].update(side_doc.get("volumes", {}))
             side = side_doc["leaves"]
             for k, e in side.items():
                 if k not in leaves:
@@ -268,25 +488,111 @@ def _assemble(entry, req_slices, vols):
         covered += int(np.prod([h - l for l, h in zip(lo, hi)]))
     want = int(np.prod(sizes)) if sizes else 1
     if covered < want:
-        raise ValueError(
+        # a gap can mean corruption OR a multi-host save whose sidecars are
+        # still landing — fall back, but leave no permanent quarantine
+        raise CheckpointCorruptError(
             f"checkpoint chunk table does not cover the requested slice "
-            f"({covered}/{want} elements) — was the checkpoint written by all hosts?")
+            f"({covered}/{want} elements) — was the checkpoint written by "
+            f"all hosts?", quarantinable=False)
     return out
 
 
-def load_state(path, step=None, shardings=None, template=None):
+def load_state(path, step=None, shardings=None, template=None, verify=True,
+               return_step=False):
     """Load a checkpoint, resharding each leaf onto a new mesh if asked.
 
     ``shardings`` may be: None (leaves come back as host jnp arrays), a pytree
     matching the saved structure whose leaves are ``jax.sharding.Sharding`` or
     None, or a callable ``(leaf_path, shape) -> Sharding | None``.
+
+    Volume checksums are verified (``verify=False`` skips).  A corrupt step
+    is quarantined; when ``step`` was not explicitly requested the loader
+    falls back to the next-newest valid step instead of failing.  With
+    ``return_step=True`` the result is ``(state, loaded_step)`` — callers
+    resuming a step counter MUST use the returned step, not a prior
+    ``latest_step()`` read: fallback may have loaded an older one.
     """
-    if step is None and os.path.exists(os.path.join(path, "latest")):
-        step = latest_step(path)
-    ckpt = _step_dir(path, step)
+    explicit = step is not None
+    if explicit:
+        candidates = [step]
+    else:
+        vs = valid_steps(path)
+        # no step dirs: a direct (step-less) checkpoint dir
+        candidates = vs[::-1] if vs else [None]
+    last_err = None
+    for s in candidates:
+        ckpt = _step_dir(path, s)
+        try:
+            state = _load_from_dir(ckpt, shardings, verify)
+            return (state, s) if return_step else state
+        except FileNotFoundError as e:
+            # the candidate dir vanished (e.g. concurrent GC): try the next
+            last_err = e
+            if explicit:
+                raise
+        except CheckpointCorruptError as e:
+            last_err = e
+            if s is not None and e.quarantinable and os.path.isdir(ckpt):
+                quarantine(ckpt, str(e))
+            if explicit:
+                raise
+    raise CheckpointCorruptError(
+        f"no loadable checkpoint under {path}: {last_err}") from last_err
+
+
+def _verify_metadata(ckpt):
+    """Check index/skeleton digests recorded in the COMMITTED marker.
+    Legacy dirs and in-flight saves carry none — nothing to check there;
+    the marker itself needs no digest (it is tiny, atomic, and a garbled
+    one already reads as not-committed)."""
+    try:
+        with open(os.path.join(ckpt, _COMMITTED)) as f:
+            marker = json.load(f)
+    except (OSError, ValueError):
+        return
+    for name, meta in (marker.get("files") or {}).items():
+        path = os.path.join(ckpt, name)
+        try:
+            got = _file_digests(path)
+        except FileNotFoundError:
+            raise CheckpointCorruptError(
+                f"checkpoint file {name} is missing from committed "
+                f"dir {ckpt}") from None
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"checkpoint file {name} could not be read: {e}",
+                quarantinable=False) from e
+        for dig in ("crc32", "sha256"):
+            if dig in meta and got[dig] != meta[dig]:
+                raise CheckpointCorruptError(
+                    f"checkpoint file {name} failed {dig} verification "
+                    f"(stored {meta[dig]}, got {got[dig]})")
+
+
+def _load_from_dir(ckpt, shardings, verify):
+    # a de-commit tombstone means an interrupted re-save left mixed-
+    # generation files behind: refuse even explicit loads — discovery
+    # already reports this dir as nonexistent, and its index/skeleton may
+    # disagree.  (Non-quarantinable: completing the re-save heals it.)
+    if os.path.exists(os.path.join(ckpt, _COMMITTED)) \
+            and not is_committed(ckpt):
+        raise CheckpointCorruptError(
+            f"checkpoint dir {ckpt} is de-committed (a re-save was "
+            f"interrupted); re-save it or restore another step",
+            quarantinable=False)
+    if verify:
+        _verify_metadata(ckpt)
     index = _read_index(ckpt)
-    with open(os.path.join(ckpt, _SKELETON), "rb") as f:
-        skel = pickle.load(f)
+    try:
+        with open(os.path.join(ckpt, _SKELETON), "rb") as f:
+            skel = pickle.load(f)
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"checkpoint dir {ckpt} has no {_SKELETON}",
+            quarantinable=False) from None  # may still be landing (see index)
+    except Exception as e:
+        raise CheckpointCorruptError(
+            f"checkpoint skeleton in {ckpt} is unreadable: {e}") from e
 
     shard_leaves = {}
     if shardings is not None and not callable(shardings):
@@ -301,7 +607,7 @@ def load_state(path, step=None, shardings=None, template=None):
                     _walk(v, f"{prefix}/{i}" if prefix else str(i))
         _walk(shardings, "")
 
-    vols = _VolumeCache(ckpt)
+    vols = _VolumeCache(ckpt, volmeta=index.get("volumes"), verify=verify)
     leaves = {}
     for key, entry in index["leaves"].items():
         shape = tuple(entry["shape"])
@@ -325,30 +631,53 @@ def load_state(path, step=None, shardings=None, template=None):
 class CheckpointManager:
     """Step-indexed checkpoint dir with retention (ref auto_checkpoint.py:267
     TrainEpochRange: periodic snapshot + restore-latest on job restart).
+
+    Saves retry transient I/O errors (ENOSPC/EIO/EAGAIN…) with exponential
+    backoff (``retry`` is a ``fault_tolerance.RetryPolicy``; the atomic
+    commit protocol makes a failed attempt invisible, so retries are safe).
+    GC keeps the last ``keep`` *valid* steps: uncommitted or quarantined
+    dirs never count toward retention, and the only good checkpoint is
+    never deleted.
     """
 
-    def __init__(self, path, keep=3, save_interval=1):
+    def __init__(self, path, keep=3, save_interval=1, retry=None):
+        from .fault_tolerance import RetryPolicy
+
         self.path = path
         self.keep = keep
         self.save_interval = max(1, int(save_interval))
+        self.retry = retry if retry is not None else RetryPolicy()
         os.makedirs(path, exist_ok=True)
 
     def should_save(self, step):
         return step % self.save_interval == 0
 
     def save(self, step, state, force=False):
+        from .fault_tolerance import retry_call
+
         if not force and not self.should_save(step):
             return None
-        ckpt = save_state(self.path, state, step=step)
+        ckpt = retry_call(save_state, self.path, state, step=step,
+                          policy=self.retry)
         if jax.process_index() == 0:
             self._gc()
         return ckpt
 
     def _gc(self):
-        steps = self.all_steps()
-        for s in steps[:-self.keep] if self.keep else []:
-            shutil.rmtree(os.path.join(self.path, f"step_{s:010d}"),
-                          ignore_errors=True)
+        """Delete steps older than the ``keep`` newest VALID ones.  Partial
+        (uncommitted) and quarantined dirs older than the retention window go
+        too; anything newer than the oldest kept valid step is left alone
+        (it may be a concurrent save in flight)."""
+        if not self.keep:
+            return
+        valid = self.valid_steps()
+        if not valid:
+            return  # nothing provably good: delete nothing
+        cutoff = valid[-self.keep:][0]
+        for s in self.all_steps():
+            if s < cutoff:
+                shutil.rmtree(os.path.join(self.path, f"step_{s:010d}"),
+                              ignore_errors=True)
 
     def all_steps(self):
         out = []
@@ -360,11 +689,15 @@ class CheckpointManager:
                     pass
         return sorted(out)
 
+    def valid_steps(self):
+        return valid_steps(self.path)
+
     def latest_step(self):
         return latest_step(self.path)
 
-    def restore(self, step=None, shardings=None):
-        return load_state(self.path, step=step, shardings=shardings)
+    def restore(self, step=None, shardings=None, return_step=False):
+        return load_state(self.path, step=step, shardings=shardings,
+                          return_step=return_step)
 
 
 # --------------------------------------------------- train-state convenience

@@ -1,0 +1,184 @@
+"""Fault-tolerance primitives: retry policies and a self-healing train loop.
+
+Reference analog: `fluid/incubate/checkpoint/auto_checkpoint.py` restarts a
+job from its periodic snapshot; Piper (PAPERS.md) treats preemption-safe
+training as a first-class system property.  This module supplies the pieces
+the rest of the stack composes:
+
+- ``ExponentialBackoff`` — bounded jittered delay schedule; jitter draws
+  from OS entropy by default (ranks must not share a retry schedule), and
+  determinism is opt-in via an explicit ``seed`` or ``jitter=0`` for tests;
+- ``RetryPolicy`` / ``retry_call`` — transient-I/O retry used by
+  ``CheckpointManager.save`` (ENOSPC/EIO/EAGAIN style errors) and available
+  to any caller;
+- ``Preemption`` — the simulated/real preemption signal the fault harness
+  (`paddle_tpu.testing.faults`) raises and ``run_with_recovery`` catches;
+- ``run_with_recovery`` — a training supervisor that checkpoints through a
+  ``CheckpointManager``, catches recoverable failures, restores the latest
+  *valid* checkpoint (corrupt steps are quarantined by the loader) and
+  replays from the restored step counter.  With a deterministic step
+  function the recovered run's final state is bitwise identical to an
+  uninterrupted run (tests/test_fault_tolerance.py).
+"""
+from __future__ import annotations
+
+import errno
+import random
+import time
+
+__all__ = [
+    "Preemption", "ExponentialBackoff", "RetryPolicy", "retry_call",
+    "run_with_recovery", "TRANSIENT_ERRNOS",
+]
+
+#: OSError errnos considered transient (worth retrying): disk-full windows,
+#: flaky media, interrupted syscalls, device contention.
+TRANSIENT_ERRNOS = frozenset({
+    errno.ENOSPC, errno.EIO, errno.EAGAIN, errno.EINTR, errno.EBUSY,
+})
+
+
+class Preemption(Exception):
+    """A (simulated or real) preemption signal: the host is going away.
+
+    Raised by the fault-injection harness and by SIGTERM adapters; caught by
+    ``run_with_recovery`` which restores the latest valid checkpoint.
+    """
+
+
+class ExponentialBackoff:
+    """delay(attempt) = min(base * factor^(attempt-1), max_delay) * jitter.
+
+    The default ``seed=None`` draws jitter from OS entropy so concurrent
+    ranks never share a retry schedule (the thundering-herd breaker).
+    Tests wanting reproducible timing pass an explicit seed or
+    ``jitter=0``.
+    """
+
+    def __init__(self, base=0.05, factor=2.0, max_delay=2.0, jitter=0.25,
+                 seed=None):
+        self.base = float(base)
+        self.factor = float(factor)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self._rng = random.Random(seed)
+
+    def delay(self, attempt: int) -> float:
+        # exponent capped: factor**64 already dwarfs any max_delay, and an
+        # uncapped float pow overflows after ~1000 attempts
+        d = min(self.base * self.factor ** min(max(0, attempt - 1), 64),
+                self.max_delay)
+        if self.jitter:
+            d *= 1.0 + self.jitter * self._rng.random()
+        return d
+
+
+class RetryPolicy:
+    """How many times to retry, on which errors, sleeping how long.
+
+    ``retryable`` may be a callable ``(exc) -> bool``; the default retries
+    OSErrors whose errno is in ``TRANSIENT_ERRNOS``.  ``sleep`` is injectable
+    so tests record the schedule instead of waiting it out.
+    """
+
+    def __init__(self, max_attempts=3, backoff=None, retryable=None,
+                 sleep=time.sleep):
+        self.max_attempts = max(1, int(max_attempts))
+        self.backoff = backoff if backoff is not None else ExponentialBackoff()
+        self._retryable = retryable
+        self.sleep = sleep
+
+    def is_retryable(self, exc) -> bool:
+        if self._retryable is not None:
+            return bool(self._retryable(exc))
+        return isinstance(exc, OSError) and exc.errno in TRANSIENT_ERRNOS
+
+
+def retry_call(fn, *args, policy: RetryPolicy | None = None, **kwargs):
+    """Call ``fn``; on a retryable exception back off and try again (up to
+    ``policy.max_attempts`` total attempts).  The last error propagates."""
+    policy = policy if policy is not None else RetryPolicy()
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            return fn(*args, **kwargs)
+        except Exception as e:
+            if attempt >= policy.max_attempts or not policy.is_retryable(e):
+                raise
+            policy.sleep(policy.backoff.delay(attempt))
+
+
+def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
+                      recoverable=(Preemption,), max_restarts=10,
+                      save_initial=True, on_event=None):
+    """Run ``num_steps`` training steps under checkpoint-restore supervision.
+
+    ``step_fn(step)`` performs one training step (a closure over the model /
+    optimizer / data; ``step`` is the 0-based index of the step about to
+    run).  ``manager`` is a ``checkpoint.CheckpointManager``; ``get_state()``
+    returns the checkpointable state pytree and ``set_state(state)`` installs
+    a restored one.  The step counter in checkpoints counts *completed*
+    steps: a checkpoint at step k holds the state after steps [0, k).
+
+    On an exception in ``recoverable`` the supervisor restores the newest
+    valid checkpoint (the loader quarantines corrupt ones and falls back)
+    and replays from its step count — with a deterministic ``step_fn`` the
+    final state is bitwise identical to an uninterrupted run.  Other
+    exceptions propagate.  Returns ``{"completed", "restarts"}``.
+    """
+    recoverable = tuple(recoverable)
+    restarts = 0
+    if manager.latest_step() is not None:
+        completed = _restore(manager, set_state)
+        if on_event:
+            on_event("resumed", {"step": completed})
+    else:
+        completed = 0
+        if save_initial:
+            # without an initial snapshot, a failure before the first
+            # periodic save would leave nothing to restore
+            manager.save(0, get_state(), force=True)
+    while completed < num_steps:
+        try:
+            step_fn(completed)
+            completed += 1
+            # get_state() can materialize the whole train state (device ->
+            # host sync) — only pay for it on steps that actually save
+            if completed == num_steps:
+                manager.save(completed, get_state(), force=True)
+            elif manager.should_save(completed):
+                manager.save(completed, get_state())
+        except recoverable as e:
+            restarts += 1
+            if restarts > max_restarts:
+                raise
+            completed = _restore(manager, set_state, cause=e)
+            if on_event:
+                on_event("restored", {"step": completed, "error": e})
+    return {"completed": completed, "restarts": restarts}
+
+
+def _restore(manager, set_state, cause=None):
+    """Restore the newest valid checkpoint and return ITS step count.
+
+    The loader quarantines corrupt steps and falls back, so the step
+    actually restored may be older than latest_step() read beforehand —
+    the step returned WITH the state is authoritative (a later
+    latest_step() can still name a newer step when the fallback was for a
+    transient, non-quarantinable reason)."""
+    try:
+        state, step = manager.restore(return_step=True)
+    except Exception as e:
+        # chain from the RESTORE failure (it carries the diagnosis: which
+        # step, which digest); the triggering failure rides in the message
+        raise RuntimeError(
+            "run_with_recovery: no valid checkpoint to restore from"
+            + (f" (while recovering from: {cause!r})" if cause else "")
+        ) from e
+    if step is None:
+        raise RuntimeError(
+            "run_with_recovery: restored a step-less checkpoint dir — "
+            "the manager's path holds no step_* structure to resume from")
+    set_state(state)
+    return int(step)

@@ -142,13 +142,48 @@ def _send_val(conn, val: bytes):
     conn.sendall(struct.pack("<I", len(val)) + val)
 
 
+def _recvn_deadline(s, n, deadline):
+    """Client-side _recvn with a HARD deadline: the socket timeout shrinks
+    to the remaining budget before every recv, so a peer dripping one byte
+    per timeout window cannot stretch one rpc past its deadline."""
+    buf = b""
+    while len(buf) < n:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise socket.timeout("rpc deadline exceeded mid-read")
+        s.settimeout(remaining)
+        chunk = s.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        buf += chunk
+    return buf
+
+
 class TCPStore(Store):
-    """Ref tcp_store.h:120 — host:port KV store; `is_master` runs the server."""
+    """Ref tcp_store.h:120 — host:port KV store; `is_master` runs the server.
+
+    Hardened client (fault-tolerance layer): every op carries a deadline
+    (``timeout`` is the default, each public op takes a per-op override —
+    the reference ``TCPStore::wait`` timeout semantics), reconnects are
+    bounded by that deadline with jittered exponential backoff, and the
+    non-idempotent ``add`` never blind-retries once its request may have
+    been applied.
+    """
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0, is_master: bool = False,
-                 world_size: int = 1, timeout: float = 300.0, use_native: bool = True):
+                 world_size: int = 1, timeout: float = 300.0, use_native: bool = True,
+                 backoff=None, sleep=time.sleep):
+        from .fault_tolerance import ExponentialBackoff
+
         self._server = None
         self.timeout = timeout
+        # seed=None -> OS entropy: clients must NOT share a jitter stream,
+        # or every rank reconnects to a reborn master in lockstep (tests
+        # wanting determinism inject their own backoff)
+        self._backoff = backoff if backoff is not None else \
+            ExponentialBackoff(base=0.05, factor=2.0, max_delay=1.0,
+                               jitter=0.25, seed=None)
+        self._sleep = sleep
         if is_master:
             self._server = self._start_server(port, use_native)
             port = self._server.port
@@ -169,67 +204,129 @@ class TCPStore(Store):
         srv.start()
         return srv
 
-    def _rpc(self, op: str, key: str, value: bytes = b"") -> bytes:
-        deadline = time.time() + self.timeout
+    def _rpc(self, op: str, key: str, value: bytes = b"",
+             timeout: float | None = None, idempotent: bool = True) -> bytes:
+        """One request under a per-op deadline.  Reconnects with jittered
+        exponential backoff until the deadline; the socket timeout shrinks
+        to the remaining budget so a hung peer cannot exceed it."""
+        timeout = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        attempt = 0
+        last = None
         while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"TCPStore rpc {op} {key!r} timed out after {timeout:.3g}s "
+                    f"({attempt} attempts; last error: {last!r})")
+            sent = False
             try:
-                with socket.create_connection((self.host, self.port), timeout=self.timeout) as s:
+                with socket.create_connection(
+                        (self.host, self.port),
+                        timeout=min(remaining, 5.0)) as s:
                     kb = key.encode()
+                    s.settimeout(max(deadline - time.monotonic(), 0.001))
                     s.sendall(op.encode() + struct.pack("<I", len(kb)) + kb
                               + struct.pack("<I", len(value)) + value)
-                    vlen = struct.unpack("<I", _recvn(s, 4))[0]
-                    return _recvn(s, vlen) if vlen else b""
-            except (ConnectionError, OSError):
-                if time.time() > deadline:
-                    raise TimeoutError(f"TCPStore rpc {op} {key} timed out")
-                time.sleep(0.1)
+                    sent = True
+                    vlen = struct.unpack(
+                        "<I", _recvn_deadline(s, 4, deadline))[0]
+                    return _recvn_deadline(s, vlen, deadline) if vlen else b""
+            except (ConnectionError, OSError) as e:
+                last = e
+                if sent and not idempotent:
+                    # the server may have applied the mutation — a blind
+                    # retry could double-count; the caller owns this flag
+                    raise ConnectionError(
+                        f"TCPStore {op} {key!r} failed after the request "
+                        f"was sent; the mutation may or may not have been "
+                        f"applied: {e!r}") from e
+                attempt += 1
+                remaining = deadline - time.monotonic()
+                if remaining > 0:
+                    self._sleep(min(self._backoff.delay(attempt), remaining))
 
-    def set(self, key, value):
+    def set(self, key, value, timeout=None):
         if isinstance(value, str):
             value = value.encode()
-        self._rpc("S", key, value)
+        self._rpc("S", key, value, timeout=timeout)
 
-    def get(self, key) -> bytes:
-        return self._rpc("G", key)
+    def get(self, key, timeout=None) -> bytes:
+        return self._rpc("G", key, timeout=timeout)
 
-    def get_nb(self, key) -> bytes | None:
+    def get_nb(self, key, timeout=None) -> bytes | None:
         """Non-blocking get: None if the key is absent (op 'N')."""
-        out = self._rpc("N", key)
+        out = self._rpc("N", key, timeout=timeout)
         return out[1:] if out[:1] == b"1" else None
 
-    def add(self, key, amount: int) -> int:
-        out = self._rpc("A", key, str(amount).encode())
+    def add(self, key, amount: int, timeout=None) -> int:
+        # add(key, 0) is a pure read (barrier polls) and stays retryable
+        out = self._rpc("A", key, str(amount).encode(), timeout=timeout,
+                        idempotent=(int(amount) == 0))
         if out.startswith(b"ERR"):
             raise ValueError(
                 f"TCPStore.add({key!r}): stored value is not an integer")
         return int(out.decode())
 
-    def check(self, key) -> bool:
-        return self._rpc("W", key) == b"1"
+    def check(self, key, timeout=None) -> bool:
+        return self._rpc("W", key, timeout=timeout) == b"1"
 
-    def delete_key(self, key):
-        self._rpc("D", key)
+    def delete_key(self, key, timeout=None):
+        self._rpc("D", key, timeout=timeout)
 
-    def keys_with_prefix(self, prefix: str) -> list[str]:
-        out = self._rpc("L", prefix).decode()
+    def keys_with_prefix(self, prefix: str, timeout=None) -> list[str]:
+        out = self._rpc("L", prefix, timeout=timeout).decode()
         return out.split("\n") if out else []
 
     def wait(self, keys, timeout=None):
+        """Block until every key exists (ref TCPStore::wait): raises
+        TimeoutError naming the keys still missing at the deadline."""
         keys = [keys] if isinstance(keys, str) else list(keys)
-        deadline = time.time() + (timeout or self.timeout)
-        for k in keys:
-            while not self.check(k):
-                if time.time() > deadline:
-                    raise TimeoutError(f"TCPStore wait({k}) timed out")
-                time.sleep(0.05)
+        timeout = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        pending = list(keys)
+        while pending:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"TCPStore wait timed out after {timeout:.3g}s; "
+                    f"still missing: {pending}")
+            # sweep EVERY pending key each round so the timeout error names
+            # only keys that are genuinely absent, not merely unchecked; a
+            # check that itself times out (dead master) counts as absent so
+            # the documented "still missing" error is what callers see
+            still = []
+            for k in pending:
+                try:  # each check gets the full remaining budget: a slow-
+                    # but-healthy master must not be misread as "missing"
+                    present = self.check(k, timeout=max(
+                        deadline - time.monotonic(), 0.001))
+                except TimeoutError:
+                    present = False
+                if not present:
+                    still.append(k)
+            pending = still
+            if pending:
+                self._sleep(min(0.05, max(deadline - time.monotonic(), 0)))
 
     def barrier(self, name: str, world_size: int, timeout=None):
-        n = self.add(f"__barrier__/{name}", 1)
-        deadline = time.time() + (timeout or self.timeout)
-        while int(self._rpc("A", f"__barrier__/{name}", b"0").decode()) < world_size:
-            if time.time() > deadline:
-                raise TimeoutError(f"barrier {name} timed out ({n}/{world_size})")
-            time.sleep(0.05)
+        timeout = self.timeout if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        n = self.add(f"__barrier__/{name}", 1, timeout=timeout)
+        arrived = n
+        while arrived < world_size:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"barrier {name} timed out ({arrived}/{world_size})")
+            try:  # poll (add 0 = pure read); a timed-out poll is just
+                arrived = int(self._rpc(  # "not there yet"
+                    "A", f"__barrier__/{name}", b"0",
+                    timeout=max(deadline - time.monotonic(), 0.001)
+                    ).decode())
+            except TimeoutError:
+                pass
+            if arrived < world_size:
+                self._sleep(0.05)
 
     def close(self):
         if self._server is not None:

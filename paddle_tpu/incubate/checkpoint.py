@@ -42,7 +42,13 @@ class TrainEpochRange:
             meta = _ckpt.load_train_state(self.path, self.model,
                                           optimizer=self.optimizer,
                                           train_step=self.train_step)
-            self._start = int(meta.get("step", latest) or latest) + 1
+            # the step recorded IN the restored state is authoritative: a
+            # corrupt newest checkpoint makes the loader fall back to an
+            # older one, and `latest` (read pre-load) would then resume too
+            # far ahead, silently skipping epochs.  (`is not None`, not
+            # truthiness — epoch 0 is falsy.)
+            step = meta.get("step")
+            self._start = (int(step) if step is not None else int(latest)) + 1
 
     @property
     def restored_epoch(self):

@@ -16,14 +16,16 @@ import numpy as np
 from ..tensor.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
-           "DynamicBatcher", "LLMEngine"]
+           "DynamicBatcher", "LLMEngine", "ServerOverloadedError",
+           "DeadlineExceededError"]
 
 
 def __getattr__(name):
-    if name == "LLMEngine":  # lazy: avoid importing the LLM stack for
-        from .llm_server import LLMEngine  # classic predictor users
+    if name in ("LLMEngine", "ServerOverloadedError",
+                "DeadlineExceededError"):  # lazy: avoid importing the LLM
+        from . import llm_server          # stack for classic predictor users
 
-        return LLMEngine
+        return getattr(llm_server, name)
     raise AttributeError(name)
 
 

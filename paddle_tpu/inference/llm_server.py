@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 
@@ -40,7 +41,36 @@ import jax.numpy as jnp
 from ..autograd import tape
 from ..tensor.tensor import Tensor
 
-__all__ = ["LLMEngine"]
+__all__ = ["LLMEngine", "ServerOverloadedError", "DeadlineExceededError"]
+
+
+class ServerOverloadedError(RuntimeError):
+    """Admission queue full: the request was rejected (load shedding) rather
+    than queued without bound.  Callers should retry with backoff."""
+
+
+class DeadlineExceededError(TimeoutError):
+    """The request's deadline elapsed (in the queue or mid-decode); its slot
+    was freed for other traffic."""
+
+
+def _fail_future(fut, exc):
+    """set_exception tolerant of a caller cancelling concurrently — a racy
+    cancel() between a done() check and set_exception must not blow up the
+    pump thread (InvalidStateError) and take the whole engine down."""
+    try:
+        if not fut.done():
+            fut.set_exception(exc)
+    except Exception:
+        pass  # already cancelled/completed by the caller
+
+
+def _complete_future(fut, result):
+    try:
+        if not fut.done():
+            fut.set_result(result)
+    except Exception:
+        pass  # already cancelled/completed by the caller
 
 
 @dataclass
@@ -51,6 +81,7 @@ class _Request:
     do_sample: bool = False
     temperature: float = 1.0
     top_p: float = 1.0
+    deadline: float | None = None
     slot: int = -1
     tokens: list = field(default_factory=list)
 
@@ -74,13 +105,21 @@ def _select_rows(logits, key, do_sample, temperature, top_p):
 class LLMEngine:
     def __init__(self, model, max_batch_slots=4, max_seq_len=512,
                  cache_dtype=None, eos_token_id=None, pad_token_id=0,
-                 prompt_buckets=(32, 64, 128, 256), decode_chunk=1):
+                 prompt_buckets=(32, 64, 128, 256), decode_chunk=1,
+                 max_queue_len=None, clock=None):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
         scheduling lever for high-latency hosts.  Slots that finish
         mid-chunk have their surplus tokens discarded (their cache rows are
         rewritten at the next admission), and admission/eos decisions
-        happen every k tokens instead of every token."""
+        happen every k tokens instead of every token.
+
+        Degradation knobs (fault-tolerance layer): ``max_queue_len`` bounds
+        the admission queue — submit() beyond it raises
+        ServerOverloadedError instead of growing without bound; per-request
+        ``timeout`` (see submit) expires requests in the queue and
+        mid-decode with DeadlineExceededError; ``clock`` injects a time
+        source for deterministic tests (default time.monotonic)."""
         cfg = model.config
         self.model = model
         self.n_slots = int(max_batch_slots)
@@ -118,7 +157,16 @@ class LLMEngine:
         self.slot_pos = np.zeros(B, np.int32)       # valid tokens per slot
         self.slot_req: list[_Request | None] = [None] * B
         self.last_token = np.full(B, self.pad, np.int32)
-        self._pending: "queue.Queue[_Request]" = queue.Queue()
+        self.max_queue_len = None if max_queue_len is None \
+            else int(max_queue_len)
+        self._clock = clock if clock is not None else time.monotonic
+        self._pump_error: BaseException | None = None
+        self._stop_epoch = 0  # bumped by stop(): detects submit/stop races
+        # Queue(maxsize=0) means UNBOUNDED, so max_queue_len=0 ("reject
+        # everything": drain/maintenance mode) is enforced in submit()
+        self._pending: "queue.Queue[_Request]" = queue.Queue(
+            maxsize=self.max_queue_len
+            if self.max_queue_len and self.max_queue_len > 0 else 0)
         self._rng = np.random.default_rng(1234)  # admission-token sampling
         self.decode_chunk = max(1, int(decode_chunk))
         self._decode_jit = {}  # scan length (effective chunk) -> jitted fn
@@ -130,11 +178,35 @@ class LLMEngine:
     # ------------------------------------------------------------- public
 
     def submit(self, prompt_ids, max_new_tokens=32, do_sample=False,
-               temperature=1.0, top_p=1.0):
+               temperature=1.0, top_p=1.0, timeout=None):
         """Queue one prompt; returns a Future of the generated id list.
         Sampling knobs are PER REQUEST: slots with different settings decode
         in the same compiled step (top_k is not supported per-slot — its k
-        changes the program shape)."""
+        changes the program shape).
+
+        ``timeout`` (seconds) sets a per-request deadline: a request still
+        queued — or still decoding — when it expires fails with
+        DeadlineExceededError and frees its slot.  When the admission queue
+        is at max_queue_len the submit raises ServerOverloadedError (shed
+        load with a reason, never grow without bound); a dead background
+        pump raises immediately instead of handing back a future that can
+        never complete."""
+        if self._pump_error is not None:
+            raise RuntimeError(
+                "LLMEngine pump thread died; restart the engine"
+            ) from self._pump_error
+        if self._thread is not None and not self._thread.is_alive() \
+                and not self._stop:
+            raise RuntimeError("LLMEngine pump thread died without a report; "
+                               "restart the engine")
+        if self._stop:
+            # stop() is in progress: its drain may miss this request — fail
+            # fast rather than hand back a future that cannot complete
+            # (once stop() finishes, submit works again: caller-pumped or
+            # after a fresh start())
+            raise RuntimeError("LLMEngine is stopping; resubmit once stop() "
+                               "completes")
+        epoch = self._stop_epoch
         arr = np.asarray(
             prompt_ids._value if isinstance(prompt_ids, Tensor) else prompt_ids,
             np.int32).reshape(-1)
@@ -142,8 +214,33 @@ class LLMEngine:
             raise ValueError(f"prompt length {arr.size} not in [1, {self.L - 1}]")
         req = _Request(arr, int(max_new_tokens), Future(),
                        do_sample=bool(do_sample),
-                       temperature=float(temperature), top_p=float(top_p))
-        self._pending.put(req)
+                       temperature=float(temperature), top_p=float(top_p),
+                       deadline=(self._clock() + float(timeout))
+                       if timeout is not None else None)
+        try:
+            if self.max_queue_len is not None and self.max_queue_len <= 0:
+                raise queue.Full
+            self._pending.put_nowait(req)
+        except queue.Full:
+            raise ServerOverloadedError(
+                f"admission queue full ({self.max_queue_len} pending "
+                f"requests); request rejected — retry with backoff") from None
+        if self._pump_error is not None:
+            # pump died between the entry check and the enqueue: the
+            # watchdog's drain may have missed this request, so fail it
+            # here rather than strand the future
+            exc = RuntimeError("LLMEngine pump thread died; restart the "
+                               "engine")
+            _fail_future(req.future, exc)
+            raise exc from self._pump_error
+        if self._stop or self._stop_epoch != epoch:
+            # stop() ran (or is running) concurrently with this submit: its
+            # drain may have already swept the queue, stranding this
+            # request with a server-mode caller blocked on the future
+            exc = RuntimeError("LLMEngine stopped while the request was "
+                               "being submitted; resubmit")
+            _fail_future(req.future, exc)
+            raise exc
         return req.future
 
     def generate(self, prompt_ids, max_new_tokens=32, **sampling):
@@ -160,42 +257,78 @@ class LLMEngine:
 
     def start(self):
         """Background pump (server mode)."""
-        if self._thread is None:
+        if self._thread is None or not self._thread.is_alive():
             self._stop = False
+            self._pump_error = None
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
         return self
 
     def stop(self):
         """Halt the pump and FAIL any queued/in-flight requests — a client
-        blocked on future.result() must not hang forever."""
+        blocked on future.result() must not hang forever.  Afterwards the
+        engine is clean and reusable: synchronous (caller-pumped) use and
+        start() both work again."""
         self._stop = True
+        self._stop_epoch += 1
+        wedged = False
         if self._thread is not None:
             self._thread.join(timeout=30)
-            self._thread = None
+            wedged = self._thread.is_alive()
+            if not wedged:
+                self._thread = None
+        if wedged:
+            # the pump is stuck inside step() HOLDING the engine lock:
+            # taking it here would hang stop() past its own join timeout.
+            # Fail queued requests now (the queue has its own mutex); the
+            # pump's _loop drains in-flight slots itself when the wedged
+            # step finally returns and it observes _stop.  _stop stays
+            # raised and _thread stays set so start() cannot double-pump.
+            self._drain_queue(RuntimeError("LLMEngine stopped"))
+        else:
+            self._fail_pending(RuntimeError("LLMEngine stopped"))
+            # a fully-terminated pump leaves the engine clean and reusable
+            self._stop = False
+
+    def _loop(self):
+        try:
+            while not self._stop:
+                if self._pending.empty() and all(r is None
+                                                 for r in self.slot_req):
+                    time.sleep(0.002)
+                    continue
+                self.step()
+            # normal _stop exit: drain (idempotent vs stop()'s own drain) —
+            # this is what frees in-flight slots when stop() had to give up
+            # on a wedged step and could not take the engine lock itself
+            self._fail_pending(RuntimeError("LLMEngine stopped"))
+        except BaseException as e:  # watchdog: a dying pump must not strand
+            self._pump_error = e    # callers blocked on future.result()
+            self._fail_pending(RuntimeError(
+                f"LLMEngine pump thread died: {e!r}"))
+
+    def _drain_queue(self, exc):
+        """Fail every QUEUED request (the queue has its own mutex — safe
+        without the engine lock)."""
         while not self._pending.empty():
             try:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 break
-            if not req.future.done():
-                req.future.cancel() or req.future.set_exception(
-                    RuntimeError("LLMEngine stopped"))
-        for i, req in enumerate(self.slot_req):
-            if req is not None:
-                self.slot_req[i] = None
-                if not req.future.done():
-                    req.future.set_exception(
-                        RuntimeError("LLMEngine stopped mid-generation"))
+            _fail_future(req.future, exc)
 
-    def _loop(self):
-        import time
-
-        while not self._stop:
-            if self._pending.empty() and all(r is None for r in self.slot_req):
-                time.sleep(0.002)
-                continue
-            self.step()
+    def _fail_pending(self, exc):
+        """Fail every queued and in-flight request with `exc`.  Takes the
+        engine lock: a caller thread pumping run_until_complete must not
+        race the dying background pump on the slot table (step() released
+        the lock when its exception unwound)."""
+        with self._lock:
+            self._drain_queue(exc)
+            for i, req in enumerate(self.slot_req):
+                if req is not None:
+                    self.slot_req[i] = None
+                    self.last_token[i] = self.pad
+                    _fail_future(req.future, exc)
 
     # --------------------------------------------------------- internals
 
@@ -238,14 +371,20 @@ class LLMEngine:
                 req = self._pending.get_nowait()
             except queue.Empty:
                 break
+            if req.future.done():
+                continue  # cancelled by the caller, or failed by a
+                          # pump-death race — don't waste a slot on it
+            if req.deadline is not None and self._clock() > req.deadline:
+                _fail_future(req.future, DeadlineExceededError(
+                    "request deadline expired while queued for admission"))
+                continue
             slot = free.pop(0)
             try:
                 self._admit_one(req, slot)
             except Exception as e:
                 self.slot_req[slot] = None
                 free.insert(0, slot)
-                if not req.future.done():
-                    req.future.set_exception(e)
+                _fail_future(req.future, e)
 
     def _admit_one(self, req, slot):
         n = req.prompt.size
@@ -365,6 +504,8 @@ class LLMEngine:
             return self._step_locked()
 
     def _step_locked(self):
+        self._expire_queued()
+        self._expire_slots()
         self._admit()
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
         if not active:
@@ -416,9 +557,50 @@ class LLMEngine:
         # read past it, and admission rewrites rows [0, bucket) wholesale
         return emitted
 
+    def _expire_queued(self):
+        """Fail and evict expired (or caller-cancelled) requests anywhere in
+        the admission queue — with every slot busy, _admit never pops them,
+        yet they must not pin the bounded queue's capacity.
+
+        Works in place under the Queue's own mutex: submit()'s put_nowait
+        is not serialized by the engine lock, so drain-and-requeue would
+        race it.  (This bypasses unfinished_tasks, so _pending.join() must
+        never be used on this queue — the engine doesn't.)"""
+        now = self._clock()
+        expired = []
+        evicted = False
+        with self._pending.mutex:
+            keep = []
+            for req in self._pending.queue:
+                if req.future.done():  # cancelled/failed: just drop it
+                    evicted = True
+                elif req.deadline is not None and now > req.deadline:
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            if expired or evicted:
+                self._pending.queue.clear()
+                self._pending.queue.extend(keep)
+                self._pending.not_full.notify_all()
+        for req in expired:
+            _fail_future(req.future, DeadlineExceededError(
+                "request deadline expired while queued for admission"))
+
+    def _expire_slots(self):
+        """Fail and free any in-flight slot whose deadline has passed —
+        graceful degradation: a slow request never wedges its slot."""
+        for i, req in enumerate(self.slot_req):
+            if req is not None and req.deadline is not None \
+                    and self._clock() > req.deadline:
+                self.slot_req[i] = None
+                self.last_token[i] = self.pad
+                _fail_future(req.future, DeadlineExceededError(
+                    f"request deadline exceeded after "
+                    f"{len(req.tokens)} generated tokens"))
+
     def _finish(self, slot):
         req = self.slot_req[slot]
         self.slot_req[slot] = None
         self.last_token[slot] = self.pad
-        if req is not None and not req.future.done():
-            req.future.set_result(list(req.tokens))
+        if req is not None:
+            _complete_future(req.future, list(req.tokens))

@@ -1,0 +1,7 @@
+"""Testing utilities: the deterministic fault-injection harness
+(`paddle_tpu.testing.faults`) that makes every recovery path in the
+checkpoint / store / serving layers unit-testable on CPU."""
+from .faults import (  # noqa: F401
+    FaultyFS, InjectedFault, Preemption, SocketFaults, TornWrite,
+    flip_bit, preemption_schedule,
+)

@@ -1,0 +1,261 @@
+"""Deterministic fault-injection harness.
+
+Reference precedent: the reference's auto-checkpoint and elastic suites fake
+etcd and kill trainer processes to exercise restart paths.  Here every
+recovery path in the checkpoint, control-plane store, and serving layers is
+unit-testable ON CPU by injecting faults at the filesystem and socket seams.
+All schedules are call-count keyed — no wall clock, no RNG — so a failing
+run reproduces exactly.
+
+- ``FaultyFS`` — patches ``builtins.open``/``io.open`` (both names bind the
+  same callable; zipfile/np.savez go through ``io.open``): write-mode opens
+  of paths matching a glob consult a per-open schedule of ``torn`` (half the
+  bytes land, then the "process dies"), ``enospc``, or ``eio`` faults.
+- ``flip_bit`` — one-bit corruption of an already-committed file (simulated
+  media decay); no patching involved.
+- ``SocketFaults`` — patches ``socket.create_connection``: connections to a
+  given port consult a per-connect schedule of ``drop`` (refused), ``stall``
+  (recv times out), or ``reset`` (peer reset mid-exchange).
+- ``preemption_schedule`` — raises ``Preemption`` the first time each listed
+  step index is reached (the signal ``run_with_recovery`` heals from).
+"""
+from __future__ import annotations
+
+import builtins
+import errno as _errno
+import fnmatch
+import io
+import os
+import socket as _socket
+
+from ..distributed.fault_tolerance import Preemption
+
+__all__ = [
+    "InjectedFault", "TornWrite", "Preemption", "FaultyFS", "SocketFaults",
+    "flip_bit", "preemption_schedule",
+]
+
+
+class InjectedFault(OSError):
+    """Base of all injected I/O faults (an OSError so production retry
+    policies classify it exactly like the real thing)."""
+
+
+class TornWrite(InjectedFault):
+    """Simulated kill mid-write: part of the payload reached the disk."""
+
+
+def flip_bit(path, byte_offset=None, bit=0):
+    """Flip one bit in ``path`` (default: the middle byte) — simulated media
+    corruption of a file that was written successfully."""
+    with open(path, "r+b") as f:
+        f.seek(0, os.SEEK_END)
+        size = f.tell()
+        if size == 0:
+            raise ValueError(f"cannot bit-flip empty file {path}")
+        off = size // 2 if byte_offset is None else int(byte_offset)
+        f.seek(off)
+        b = f.read(1)
+        f.seek(off)
+        f.write(bytes([b[0] ^ (1 << bit)]))
+
+
+def preemption_schedule(*steps):
+    """Return ``check(step)`` raising ``Preemption`` the FIRST time each
+    listed step index is reached (replays after recovery pass through)."""
+    pending = set(steps)
+
+    def check(step):
+        if step in pending:
+            pending.discard(step)
+            raise Preemption(f"injected preemption at step {step}")
+
+    return check
+
+
+class _TornFile:
+    """File proxy whose first write tears: half the bytes land, then a
+    TornWrite unwinds the writer — the in-process analog of SIGKILL
+    mid-write (the partial file stays on disk)."""
+
+    def __init__(self, raw):
+        self._raw = raw
+        self._torn = False
+
+    def write(self, data):
+        if not self._torn:
+            self._torn = True
+            self._raw.write(data[: max(1, len(data) // 2)])
+            self._raw.flush()
+            raise TornWrite(_errno.EIO,
+                            "injected torn write (simulated kill mid-write)")
+        return self._raw.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._raw.close()
+        return False
+
+
+class _EIOFile:
+    """File proxy whose every write raises EIO (failing media)."""
+
+    def __init__(self, raw):
+        self._raw = raw
+
+    def write(self, data):
+        raise InjectedFault(_errno.EIO, "injected EIO on write")
+
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._raw.close()
+        return False
+
+
+class FaultyFS:
+    """Context manager injecting filesystem faults on write-mode opens.
+
+    ``faults`` maps the index of a matching write-open (counted within this
+    context, 0-based) to a kind:
+
+    - ``"enospc"`` — the open itself raises OSError(ENOSPC);
+    - ``"eio"`` — the open succeeds but every write raises OSError(EIO);
+    - ``"torn"`` — the first write stores half its bytes then raises
+      TornWrite, leaving a partial file behind.
+
+    Read-mode opens and non-matching paths pass through untouched, so the
+    interpreter / pytest internals are unaffected.  ``self.log`` records the
+    (index, kind, path) of every fired fault.
+    """
+
+    def __init__(self, match="*", faults=None):
+        self.match = match
+        self.faults = dict(faults or {})
+        self.write_opens = 0
+        self.log = []
+        self._real = None
+
+    def _make_opener(self, real_open):
+        harness = self
+
+        def opener(file, mode="r", *args, **kwargs):
+            if (isinstance(file, (str, os.PathLike))
+                    and any(c in str(mode) for c in "wxa+")
+                    and fnmatch.fnmatch(str(file), harness.match)):
+                idx = harness.write_opens
+                harness.write_opens += 1
+                kind = harness.faults.get(idx)
+                if kind:
+                    harness.log.append((idx, kind, str(file)))
+                if kind == "enospc":
+                    raise InjectedFault(
+                        _errno.ENOSPC, f"injected ENOSPC opening {file}")
+                if kind == "eio":
+                    return _EIOFile(real_open(file, mode, *args, **kwargs))
+                if kind == "torn":
+                    return _TornFile(real_open(file, mode, *args, **kwargs))
+            return real_open(file, mode, *args, **kwargs)
+
+        return opener
+
+    def __enter__(self):
+        self._real = builtins.open
+        wrapped = self._make_opener(self._real)
+        builtins.open = wrapped
+        io.open = wrapped
+        return self
+
+    def __exit__(self, *exc):
+        builtins.open = self._real
+        io.open = self._real
+        return False
+
+
+class _FaultySocket:
+    """Socket proxy simulating a stalled or reset peer."""
+
+    def __init__(self, raw, kind):
+        self._raw = raw
+        self._kind = kind
+
+    def sendall(self, data):
+        if self._kind == "reset":
+            raise ConnectionResetError(
+                _errno.ECONNRESET, "injected connection reset")
+        return self._raw.sendall(data)
+
+    def recv(self, n):
+        if self._kind == "stall":
+            raise _socket.timeout("injected stall: recv timed out")
+        if self._kind == "reset":
+            raise ConnectionResetError(
+                _errno.ECONNRESET, "injected connection reset")
+        return self._raw.recv(n)
+
+    def __getattr__(self, name):
+        return getattr(self._raw, name)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._raw.close()
+        return False
+
+
+class SocketFaults:
+    """Context manager injecting socket faults on connections to ``port``.
+
+    ``faults`` maps the index of a matching connect (counted within this
+    context, 0-based) to a kind:
+
+    - ``"drop"`` — the connect raises ConnectionRefusedError;
+    - ``"stall"`` — the connection opens but recv raises socket.timeout
+      (a hung peer, without spending the wall-clock);
+    - ``"reset"`` — sendall/recv raise ConnectionResetError.
+
+    Connections to other ports pass through untouched.
+    """
+
+    def __init__(self, port, faults=None):
+        self.port = int(port)
+        self.faults = dict(faults or {})
+        self.connects = 0
+        self.log = []
+        self._real = None
+
+    def __enter__(self):
+        self._real = _socket.create_connection
+        harness = self
+
+        def create_connection(address, *args, **kwargs):
+            if address[1] == harness.port:
+                idx = harness.connects
+                harness.connects += 1
+                kind = harness.faults.get(idx)
+                if kind:
+                    harness.log.append((idx, kind, address))
+                if kind == "drop":
+                    raise ConnectionRefusedError(
+                        _errno.ECONNREFUSED, "injected connect drop")
+                if kind in ("stall", "reset"):
+                    return _FaultySocket(
+                        harness._real(address, *args, **kwargs), kind)
+            return harness._real(address, *args, **kwargs)
+
+        _socket.create_connection = create_connection
+        return self
+
+    def __exit__(self, *exc):
+        _socket.create_connection = self._real
+        return False

@@ -36,7 +36,9 @@ def masked_gap(x, *, wv):
 def update_running_stats(bn, mean_t, var_t, cnt):
     """Write batch stats back to a BatchNorm layer's buffers with the exact
     `F.batch_norm` momentum semantics (momentum * rm + (1-m) * stat, var
-    debiased by n/(n-1) — ref phi BatchNormKernel MeanOut/VarianceOut)."""
+    debiased by n/(n-1)).  The n/(n-1) debias matches THIS repo's
+    cuDNN-style `F.batch_norm` running-var update; the reference CPU
+    batch_norm_kernel.cc stores the biased batch variance instead."""
     from ...tensor.tensor import Tensor, apply_op
 
     if not isinstance(bn._mean, Tensor):

@@ -18,7 +18,10 @@ from ... import nn
 
 
 def _fused_path_ok(model, x):
-    """NHWC + training + bottleneck blocks + (TPU or forced) + aligned input."""
+    """NHWC + training + bottleneck blocks + (TPU or forced) + aligned input
+    + every block's 1x1 convs admissible to the fused kernel.  Nonstandard
+    widths (e.g. base_width not a multiple of 64) degrade gracefully to the
+    composed forward instead of raising mid-forward."""
     from . import _fused_resnet as FR
 
     if model._data_format != "NHWC" or not model.training:
@@ -31,7 +34,29 @@ def _fused_path_ok(model, x):
     if str(x.dtype) not in ("paddle.bfloat16", "paddle.float32", "bfloat16", "float32"):
         return False
     shape = x.shape
-    return len(shape) == 4 and shape[3] == 3 and shape[1] % 32 == 0 and shape[2] % 32 == 0
+    if not (len(shape) == 4 and shape[3] == 3
+            and shape[1] % 32 == 0 and shape[2] % 32 == 0):
+        return False
+    return _fused_blocks_supported(model)
+
+
+def _fused_blocks_supported(model):
+    """Per-block channel alignment for the fused path: conv1/conv3 of every
+    bottleneck must pass ops.fused_conv_bn.supported (lane-aligned Cin/Cout).
+    Cached on the model — channel widths are fixed at construction."""
+    ok = model.__dict__.get("_fused_blocks_ok")
+    if ok is None:
+        from ...ops.fused_conv_bn import supported
+
+        ok = True
+        for stage in (model.layer1, model.layer2, model.layer3, model.layer4):
+            for block in stage:
+                for conv in (block.conv1, block.conv3):
+                    cout, cin = int(conv.weight.shape[0]), int(conv.weight.shape[1])
+                    if not supported((1, 1, 8, cin), (1, 1, cin, cout)):
+                        ok = False
+        model.__dict__["_fused_blocks_ok"] = ok
+    return ok
 
 
 class BasicBlock(nn.Layer):

@@ -24,3 +24,8 @@ def pytest_configure(config):
         "run with -m quick, or -m quick -n 4 for <5 min)")
     config.addinivalue_line(
         "markers", "slow: heavyweight (wheel builds, large compiles)")
+    config.addinivalue_line(
+        "markers",
+        "faults: deterministic fault-injection suite (checkpoint commit "
+        "protocol, store deadlines, server degradation, self-healing "
+        "training) — call-count-keyed schedules, no wall-clock dependence")

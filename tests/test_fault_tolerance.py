@@ -1,0 +1,605 @@
+"""Deterministic fault-injection suite for the fault-tolerance layer.
+
+Every scenario here runs on CPU with call-count-keyed fault schedules
+(`paddle_tpu.testing.faults`) — no wall-clock races, no RNG:
+
+- checkpoint commit protocol: a torn write is invisible, a bit-flipped
+  volume is quarantined and the loader falls back to the previous valid
+  step, ENOSPC is retried with recorded backoff, GC never deletes the only
+  good checkpoint;
+- `run_with_recovery` resumes across injected preemptions with a final
+  state BITWISE identical to an uninterrupted run;
+- store ops honor per-op deadlines, reconnect with deterministic backoff,
+  and `wait` times out naming the missing keys;
+- the LLM server sheds load at a bounded queue, expires requests by
+  deadline (queued and mid-decode), and a dead pump thread fails futures
+  instead of hanging callers.
+"""
+import errno
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed.fault_tolerance import (
+    ExponentialBackoff, Preemption, RetryPolicy, retry_call,
+    run_with_recovery)
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.testing.faults import (
+    FaultyFS, InjectedFault, SocketFaults, TornWrite, flip_bit,
+    preemption_schedule)
+
+pytestmark = pytest.mark.faults
+
+
+# ------------------------------------------------------------ checkpoint layer
+
+def test_torn_write_is_invisible(tmp_path):
+    """A save killed mid-write (torn volume write) never commits: discovery
+    and loading still see the previous step."""
+    p = str(tmp_path)
+    ckpt.save_state(p, {"w": jnp.arange(4.0)}, step=1)
+    # open #0 is the de-commit tombstone; #1 is the volume npz write
+    with FaultyFS(match="*step_0000000002*", faults={1: "torn"}):
+        with pytest.raises(OSError):
+            ckpt.save_state(p, {"w": jnp.full((4,), 9.0)}, step=2)
+    assert not ckpt.is_committed(str(tmp_path / "step_0000000002"))
+    assert ckpt.latest_step(p) == 1
+    out = ckpt.load_state(p)
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+
+
+def test_torn_index_write_is_invisible(tmp_path):
+    """Tearing the INDEX write (volume already landed) must also leave the
+    step uncommitted."""
+    p = str(tmp_path)
+    ckpt.save_state(p, {"w": jnp.arange(4.0)}, step=1)
+    # opens: #0 tombstone, #1 volume npz, #2 index.json's tmp
+    with FaultyFS(match="*step_0000000002*", faults={2: "torn"}):
+        with pytest.raises(OSError):
+            ckpt.save_state(p, {"w": jnp.full((4,), 9.0)}, step=2)
+    assert ckpt.latest_step(p) == 1
+    np.testing.assert_array_equal(np.asarray(ckpt.load_state(p)["w"]),
+                                  np.arange(4.0))
+
+
+def test_bitflip_quarantines_and_falls_back(tmp_path):
+    """One flipped bit in a committed volume: load_state quarantines that
+    step and restores the newest valid one; an explicit load of the corrupt
+    step raises."""
+    p = str(tmp_path)
+    ckpt.save_state(p, {"w": jnp.arange(4.0)}, step=1)
+    ckpt.save_state(p, {"w": jnp.full((4,), 9.0)}, step=2)
+    assert ckpt.latest_step(p) == 2
+    flip_bit(tmp_path / "step_0000000002" / "volume_p00000.npz")
+
+    out = ckpt.load_state(p)  # falls back to step 1
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+    assert (tmp_path / "step_0000000002" / "QUARANTINED").exists()
+    assert ckpt.latest_step(p) == 1  # quarantined step no longer discovered
+
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_state(p, step=2)
+
+
+def test_killed_resave_cannot_resurrect_via_legacy_pointer(tmp_path):
+    """A re-save of an existing step killed mid-write leaves a de-commit
+    TOMBSTONE: even with its old index.json intact, the half-rewritten dir
+    must not be mistaken for a legacy (pre-marker) checkpoint."""
+    p = str(tmp_path)
+    ckpt.save_state(p, {"w": jnp.arange(4.0)}, step=5)
+    assert ckpt.latest_step(p) == 5
+    # open #0 is the de-commit tombstone; tear the VOLUME rewrite (#1)
+    with FaultyFS(match="*step_0000000005*", faults={1: "torn"}):
+        with pytest.raises(OSError):
+            ckpt.save_state(p, {"w": jnp.full((4,), 9.0)}, step=5)
+    assert ckpt.latest_step(p) is None
+    # completing a re-save re-commits the step
+    ckpt.save_state(p, {"w": jnp.full((4,), 7.0)}, step=5)
+    assert ckpt.latest_step(p) == 5
+    np.testing.assert_array_equal(np.asarray(ckpt.load_state(p)["w"]),
+                                  np.full((4,), 7.0))
+
+
+def test_resave_drops_stale_same_step_sidecars(tmp_path):
+    """Re-saving a committed step under a smaller world must purge the
+    previous generation's sidecars/volumes — a stale same-step sidecar
+    whose chunks cover offsets the new save also covers would otherwise
+    merge silently into the restored state."""
+    import json as _json
+
+    p = str(tmp_path)
+    ckpt.save_state(p, {"w": jnp.zeros(4)}, step=5)
+    d = tmp_path / "step_0000000005"
+    # fake a leftover from a previous 2-host generation at the SAME step:
+    # a partial chunk at offset [2] that the dedup-by-offset merge would
+    # append and _assemble would write over the fresh data
+    np.savez(d / "volume_p00001.npz", **{"w#0": np.full((2,), 99.0, np.float32)})
+    with open(d / "index_p00001.json", "w") as f:
+        _json.dump({"step": 5, "leaves": {"w": {
+            "shape": [4], "dtype": "float32",
+            "chunks": [{"volume": "volume_p00001.npz", "key": "w#0",
+                        "offset": [2], "sizes": [2]}]}}}, f)
+    ckpt.save_state(p, {"w": jnp.full((4,), 7.0)}, step=5)  # replay, world=1
+    assert not (d / "index_p00001.json").exists()
+    assert not (d / "volume_p00001.npz").exists()
+    np.testing.assert_array_equal(np.asarray(ckpt.load_state(p, step=5)["w"]),
+                                  np.full((4,), 7.0))
+
+
+def test_explicit_load_refuses_decommitted_step(tmp_path):
+    """load_state(step=N) on a dir whose re-save was interrupted (de-commit
+    tombstone present) must raise, not read mixed-generation files that
+    discovery already reports as nonexistent."""
+    p = str(tmp_path)
+    ckpt.save_state(p, {"w": jnp.arange(4.0)}, step=5)
+    with FaultyFS(match="*step_0000000005*", faults={2: "torn"}):
+        with pytest.raises(OSError):  # killed between volume and index
+            ckpt.save_state(p, {"w": jnp.full((4,), 9.0)}, step=5)
+    with pytest.raises(ckpt.CheckpointCorruptError, match="de-committed"):
+        ckpt.load_state(p, step=5)
+
+
+def test_bitflip_in_index_is_caught(tmp_path):
+    """index.json and skeleton.pkl are covered too (digests live in the
+    COMMITTED marker): a flipped bit in the index quarantines the step."""
+    p = str(tmp_path)
+    ckpt.save_state(p, {"w": jnp.arange(4.0)}, step=1)
+    ckpt.save_state(p, {"w": jnp.full((4,), 9.0)}, step=2)
+    flip_bit(tmp_path / "step_0000000002" / "index.json")
+    out = ckpt.load_state(p)  # falls back to step 1
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+    assert (tmp_path / "step_0000000002" / "QUARANTINED").exists()
+
+
+def test_resave_rehabilitates_quarantined_step(tmp_path):
+    p = str(tmp_path)
+    ckpt.save_state(p, {"w": jnp.arange(4.0)}, step=1)
+    flip_bit(tmp_path / "step_0000000001" / "volume_p00000.npz")
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.load_state(p)
+    assert ckpt.latest_step(p) is None
+    ckpt.save_state(p, {"w": jnp.arange(4.0)}, step=1)  # re-save same step
+    assert ckpt.latest_step(p) == 1
+    np.testing.assert_array_equal(np.asarray(ckpt.load_state(p)["w"]),
+                                  np.arange(4.0))
+
+
+def test_enospc_retry_with_backoff(tmp_path):
+    """CheckpointManager retries transient I/O with the policy's recorded
+    (deterministic, jitter-free here) backoff schedule."""
+    delays = []
+    policy = RetryPolicy(max_attempts=3,
+                         backoff=ExponentialBackoff(base=0.01, jitter=0.0),
+                         sleep=delays.append)
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=3, retry=policy)
+    # each attempt's FIRST write-open is the de-commit tombstone: attempts
+    # 1 and 2 hit ENOSPC there, attempt 3 succeeds end to end
+    with FaultyFS(match="*step_0000000001*",
+                  faults={0: "enospc", 1: "enospc"}) as ffs:
+        mgr.save(1, {"w": jnp.ones(4)}, force=True)
+    assert [k for _, k, _ in ffs.log] == ["enospc"] * 2
+    assert delays == [0.01, 0.02]
+    assert mgr.latest_step() == 1
+    np.testing.assert_array_equal(np.asarray(mgr.restore()["w"]), np.ones(4))
+
+    # a persistent fault exhausts the attempts and surfaces the errno
+    with FaultyFS(match="*step_0000000002*",
+                  faults={i: "enospc" for i in range(10)}):
+        with pytest.raises(OSError) as ei:
+            mgr.save(2, {"w": jnp.ones(4)}, force=True)
+    assert ei.value.errno == errno.ENOSPC
+    assert mgr.latest_step() == 1  # failed save never became visible
+
+
+def test_retry_policy_does_not_retry_permanent_errors():
+    calls = []
+
+    def boom():
+        calls.append(1)
+        raise ValueError("permanent")
+
+    with pytest.raises(ValueError):
+        retry_call(boom, policy=RetryPolicy(max_attempts=5,
+                                            sleep=lambda d: None))
+    assert len(calls) == 1  # ValueError is not transient: no retry
+
+
+def test_gc_never_deletes_only_valid_checkpoint(tmp_path):
+    p = str(tmp_path)
+    mgr = ckpt.CheckpointManager(p, keep=1,
+                                 retry=RetryPolicy(max_attempts=1))
+    mgr.save(1, {"w": jnp.full((2,), 1.0)}, force=True)
+    # a newer PARTIAL dir (torn save) must not count toward retention nor
+    # shield anything from it
+    with FaultyFS(match="*step_0000000002*", faults={0: "torn"}):
+        with pytest.raises(OSError):
+            mgr.save(2, {"w": jnp.full((2,), 2.0)}, force=True)
+    mgr._gc()
+    assert 1 in mgr.valid_steps()  # the only good checkpoint survives GC
+    np.testing.assert_array_equal(np.asarray(mgr.restore()["w"]),
+                                  np.full((2,), 1.0))
+
+    # a later good save finally lets GC collect both the old step and the
+    # partial debris
+    mgr.save(3, {"w": jnp.full((2,), 3.0)}, force=True)
+    assert mgr.all_steps() == [3]
+    np.testing.assert_array_equal(np.asarray(mgr.restore()["w"]),
+                                  np.full((2,), 3.0))
+
+
+def test_gc_counts_quarantined_as_invalid(tmp_path):
+    p = str(tmp_path)
+    mgr = ckpt.CheckpointManager(p, keep=2)
+    for s in (1, 2, 3):
+        mgr.save(s, {"w": jnp.full((2,), float(s))}, force=True)
+    assert mgr.valid_steps() == [2, 3]  # keep=2 GC'd step 1 at save(3)
+    flip_bit(tmp_path / "step_0000000003" / "volume_p00000.npz")
+    out = mgr.restore()  # quarantines 3, falls back to 2
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.full((2,), 2.0))
+    # retention counts VALID steps only: with just [2] valid, nothing is
+    # collected — and the quarantined dir is kept for forensics until
+    # enough newer valid steps push the cutoff past it
+    mgr._gc()
+    assert mgr.valid_steps() == [2]
+    assert 3 in mgr.all_steps()
+    mgr.save(4, {"w": jnp.full((2,), 4.0)}, force=True)
+    mgr.save(5, {"w": jnp.full((2,), 5.0)}, force=True)
+    assert mgr.valid_steps() == [4, 5]
+    assert mgr.all_steps() == [4, 5]  # quarantined 3 collected past cutoff
+
+
+# --------------------------------------------------------- self-healing loop
+
+def _fold_steps(xs, w0, lo, hi):
+    w = w0
+    for i in range(lo, hi):
+        w = w * np.float32(0.9) + jnp.asarray(xs[i])
+    return w
+
+
+def test_run_with_recovery_bitwise_resume(tmp_path):
+    """Preemptions at step 1 (before any periodic save: restores the initial
+    snapshot) and step 3 (restores the step-2 checkpoint): the recovered
+    run's final params are BITWISE identical to an uninterrupted run."""
+    rng = np.random.default_rng(0)
+    xs = [rng.standard_normal(4).astype(np.float32) for _ in range(6)]
+    w0 = jnp.zeros(4, jnp.float32)
+    ref = _fold_steps(xs, w0, 0, 6)
+
+    box = {"w": w0}
+    check = preemption_schedule(1, 3)
+
+    def step_fn(i):
+        check(i)
+        box["w"] = box["w"] * np.float32(0.9) + jnp.asarray(xs[i])
+
+    events = []
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=3, save_interval=2)
+    report = run_with_recovery(
+        step_fn, 6, mgr,
+        get_state=lambda: {"w": box["w"]},
+        set_state=lambda s: box.__setitem__("w", s["w"]),
+        on_event=lambda kind, info: events.append((kind, info["step"])))
+    assert report == {"completed": 6, "restarts": 2}
+    assert events == [("restored", 0), ("restored", 2)]
+    assert np.asarray(box["w"]).tobytes() == np.asarray(ref).tobytes()
+
+
+def test_run_with_recovery_survives_corrupt_latest(tmp_path):
+    """Preemption + a corrupt newest checkpoint: the supervisor restores the
+    older valid step (via the loader's quarantine fallback) and still
+    finishes bitwise-correct."""
+    rng = np.random.default_rng(1)
+    xs = [rng.standard_normal(4).astype(np.float32) for _ in range(5)]
+    w0 = jnp.zeros(4, jnp.float32)
+    ref = _fold_steps(xs, w0, 0, 5)
+
+    box = {"w": w0}
+    fired = []
+
+    def step_fn(i):
+        if i == 4 and not fired:
+            fired.append(i)
+            # the newest checkpoint (step 4) rots, then the host is preempted
+            flip_bit(tmp_path / "step_0000000004" / "volume_p00000.npz")
+            raise Preemption("injected")
+        box["w"] = box["w"] * np.float32(0.9) + jnp.asarray(xs[i])
+
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=5, save_interval=1)
+    report = run_with_recovery(
+        step_fn, 5, mgr,
+        get_state=lambda: {"w": box["w"]},
+        set_state=lambda s: box.__setitem__("w", s["w"]))
+    assert report["restarts"] == 1
+    assert np.asarray(box["w"]).tobytes() == np.asarray(ref).tobytes()
+
+
+def test_recovery_resume_step_matches_restored_state(tmp_path):
+    """A MISSING volume (non-quarantinable: could be a host still writing)
+    makes the loader fall back without marking the step — the supervisor
+    must resume from the step it actually restored, then REPLAY through the
+    gap, not trust a stale latest_step read."""
+    import os
+
+    rng = np.random.default_rng(2)
+    xs = [rng.standard_normal(4).astype(np.float32) for _ in range(4)]
+    w0 = jnp.zeros(4, jnp.float32)
+    ref = _fold_steps(xs, w0, 0, 4)
+
+    box = {"w": w0}
+    fired = []
+
+    def step_fn(i):
+        if i == 3 and not fired:
+            fired.append(i)
+            os.remove(tmp_path / "step_0000000003" / "volume_p00000.npz")
+            raise Preemption("injected")
+        box["w"] = box["w"] * np.float32(0.9) + jnp.asarray(xs[i])
+
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=5, save_interval=1)
+    report = run_with_recovery(
+        step_fn, 4, mgr,
+        get_state=lambda: {"w": box["w"]},
+        set_state=lambda s: box.__setitem__("w", s["w"]))
+    assert report["restarts"] == 1
+    assert np.asarray(box["w"]).tobytes() == np.asarray(ref).tobytes()
+    # the volume-less step was NOT permanently quarantined
+    assert not (tmp_path / "step_0000000003" / "QUARANTINED").exists()
+
+
+def test_run_with_recovery_gives_up_after_max_restarts(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=2)
+
+    def always_preempted(i):
+        raise Preemption("flaky host")
+
+    with pytest.raises(Preemption):
+        run_with_recovery(always_preempted, 3, mgr,
+                          get_state=lambda: {"w": jnp.zeros(2)},
+                          set_state=lambda s: None, max_restarts=4)
+
+
+def test_train_epoch_range_resumes_from_restored_epoch(tmp_path):
+    """TrainEpochRange must resume from the epoch it actually RESTORED: a
+    corrupt newest checkpoint falls back to an older one, and the stale
+    latest_step read must not skip the intervening epochs."""
+    import paddle_tpu.nn as nn
+    from paddle_tpu.incubate.checkpoint import TrainEpochRange
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(4, 2)
+
+        def forward(self, x):
+            return self.fc(x)
+
+    def make():
+        paddle.seed(11)
+        m = Net()
+        o = paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=m.parameters())
+        return m, o
+
+    x = paddle.to_tensor(np.ones((2, 4), np.float32))
+    y = paddle.to_tensor(np.zeros((2, 2), np.float32))
+    m1, o1 = make()
+    for epoch in TrainEpochRange(2, str(tmp_path), model=m1, optimizer=o1,
+                                 save_checkpoint_inter=1):
+        loss = paddle.nn.functional.mse_loss(m1(x), y)
+        loss.backward(); o1.step(); o1.clear_grad()
+    assert ckpt.latest_step(str(tmp_path)) == 1
+    flip_bit(tmp_path / "step_0000000001" / "volume_p00000.npz")
+
+    m2, o2 = make()
+    r2 = TrainEpochRange(4, str(tmp_path), model=m2, optimizer=o2,
+                         save_checkpoint_inter=1)
+    # epoch 1's state was corrupt: restored epoch 0, so epoch 1 is replayed
+    assert r2.restored_epoch == 0
+
+
+# ------------------------------------------------------------- control plane
+
+def _closed_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_store_op_deadline_without_server():
+    store = TCPStore(host="127.0.0.1", port=_closed_port(), timeout=0.3,
+                     sleep=lambda d: None)
+    with pytest.raises(TimeoutError, match="timed out"):
+        store.check("k")
+    with pytest.raises(TimeoutError):
+        store.get("k", timeout=0.2)  # per-op override
+
+
+def test_store_wait_timeout_names_missing_keys():
+    master = TCPStore(is_master=True, use_native=False, timeout=5.0)
+    try:
+        client = TCPStore(port=master.port, timeout=5.0)
+        client.set("ready", b"1")
+        with pytest.raises(TimeoutError, match="never_set"):
+            client.wait(["ready", "never_set"], timeout=0.3)
+        client.wait(["ready"], timeout=1.0)  # present keys return at once
+    finally:
+        master.close()
+
+
+def test_store_reconnect_backoff_deterministic():
+    """Dropped connects are retried with the injected (jitter-free) backoff
+    schedule; a stalled then reset peer is also survived for idempotent
+    ops."""
+    master = TCPStore(is_master=True, use_native=False, timeout=5.0)
+    try:
+        delays = []
+        client = TCPStore(port=master.port, timeout=5.0,
+                          backoff=ExponentialBackoff(base=0.01, jitter=0.0),
+                          sleep=delays.append)
+        with SocketFaults(master.port, faults={0: "drop", 1: "drop"}):
+            client.set("k", b"v")
+        assert delays == [0.01, 0.02]
+        assert client.get("k", timeout=1.0) == b"v"
+
+        client.set("k2", b"x")
+        with SocketFaults(master.port, faults={0: "reset", 1: "stall"}):
+            assert client.get("k2", timeout=2.0) == b"x"  # 3rd connect wins
+    finally:
+        master.close()
+
+
+def test_store_add_never_blind_retries_after_send():
+    """A failure AFTER the add request was sent must raise, not retry — a
+    blind retry could double-count (non-idempotent op)."""
+    master = TCPStore(is_master=True, use_native=False, timeout=5.0)
+    try:
+        client = TCPStore(port=master.port, timeout=5.0,
+                          sleep=lambda d: None)
+        with SocketFaults(master.port, faults={0: "stall"}):
+            with pytest.raises(ConnectionError, match="may or may not"):
+                client.add("ctr", 1, timeout=1.0)
+        # the increment DID land server-side; the next add observes it
+        assert client.add("ctr", 1) == 2
+        # add(key, 0) is a pure read (barrier polls): it stays retryable
+        # even when the failure hits after the request was sent
+        with SocketFaults(master.port, faults={0: "stall"}):
+            assert client.add("ctr", 0, timeout=2.0) == 2
+    finally:
+        master.close()
+
+
+# ------------------------------------------------------------- serving layer
+
+@pytest.fixture(scope="module")
+def tiny_model():
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, use_flash_attention=False,
+                           max_position_embeddings=128)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_llm_queue_shedding(tiny_model):
+    from paddle_tpu.inference.llm_server import LLMEngine, ServerOverloadedError
+
+    eng = LLMEngine(tiny_model, max_batch_slots=1, max_seq_len=64,
+                    max_queue_len=2)
+    f1 = eng.submit([1, 2, 3], max_new_tokens=2)
+    f2 = eng.submit([4, 5], max_new_tokens=2)
+    with pytest.raises(ServerOverloadedError, match="queue full"):
+        eng.submit([6], max_new_tokens=2)
+    eng.run_until_complete()  # draining the queue restores admission
+    assert len(f1.result(timeout=1)) == 2 and len(f2.result(timeout=1)) == 2
+    f3 = eng.submit([6], max_new_tokens=1)
+    eng.run_until_complete()
+    assert len(f3.result(timeout=1)) == 1
+
+
+def test_llm_queue_len_zero_rejects_everything(tiny_model):
+    """max_queue_len=0 is drain/maintenance mode: every submit sheds."""
+    from paddle_tpu.inference.llm_server import LLMEngine, ServerOverloadedError
+
+    eng = LLMEngine(tiny_model, max_batch_slots=1, max_seq_len=64,
+                    max_queue_len=0)
+    with pytest.raises(ServerOverloadedError):
+        eng.submit([1, 2], max_new_tokens=1)
+
+
+def test_llm_deadline_expires_in_queue(tiny_model):
+    from paddle_tpu.inference.llm_server import DeadlineExceededError, LLMEngine
+
+    now = [0.0]
+    eng = LLMEngine(tiny_model, max_batch_slots=1, max_seq_len=64,
+                    clock=lambda: now[0])
+    fut = eng.submit([1, 2, 3], max_new_tokens=4, timeout=5.0)
+    now[0] = 10.0  # deadline passes while still queued
+    eng.step()
+    with pytest.raises(DeadlineExceededError):
+        fut.result(timeout=1)
+    assert eng.slot_req == [None]  # never admitted, slot still free
+
+
+def test_llm_queued_deadline_expires_with_all_slots_busy(tiny_model):
+    """Expired requests are evicted from the queue even when no slot is
+    free, releasing the bounded queue's capacity at the deadline."""
+    from paddle_tpu.inference.llm_server import DeadlineExceededError, LLMEngine
+
+    now = [0.0]
+    eng = LLMEngine(tiny_model, max_batch_slots=1, max_seq_len=64,
+                    max_queue_len=1, clock=lambda: now[0])
+    f1 = eng.submit([1, 2, 3], max_new_tokens=40)       # will hold the slot
+    eng.step()  # admit f1
+    f2 = eng.submit([4, 5], max_new_tokens=4, timeout=5.0)  # fills the queue
+    now[0] = 9.0
+    eng.step()  # slot still busy with f1, but f2's deadline passed
+    with pytest.raises(DeadlineExceededError):
+        f2.result(timeout=1)
+    f3 = eng.submit([6], max_new_tokens=1)  # capacity was released
+    assert not f3.done()
+
+
+def test_llm_deadline_expires_mid_decode(tiny_model):
+    from paddle_tpu.inference.llm_server import DeadlineExceededError, LLMEngine
+
+    now = [0.0]
+    eng = LLMEngine(tiny_model, max_batch_slots=1, max_seq_len=64,
+                    clock=lambda: now[0])
+    fut = eng.submit([1, 2, 3], max_new_tokens=50, timeout=5.0)
+    eng.step()  # admit + decode one token
+    assert eng.slot_req[0] is not None
+    now[0] = 10.0
+    eng.step()  # expiry check frees the slot before decoding further
+    with pytest.raises(DeadlineExceededError, match="generated tokens"):
+        fut.result(timeout=1)
+    assert eng.slot_req == [None]
+
+
+def test_llm_pump_death_fails_futures_not_callers(tiny_model):
+    """When the background pump dies, queued/in-flight futures fail with the
+    pump error instead of hanging result(), and later submits fail fast."""
+    from paddle_tpu.inference.llm_server import LLMEngine
+
+    eng = LLMEngine(tiny_model, max_batch_slots=1, max_seq_len=64)
+    eng.step = lambda: (_ for _ in ()).throw(RuntimeError("injected pump crash"))
+    eng.start()
+    try:
+        fut = eng.submit([1, 2, 3], max_new_tokens=2)
+        with pytest.raises(RuntimeError, match="pump thread died"):
+            fut.result(timeout=30)
+        with pytest.raises(RuntimeError, match="pump thread died"):
+            eng.submit([4, 5])
+    finally:
+        eng.stop()
+
+
+def test_llm_engine_usable_after_stop(tiny_model):
+    """stop() leaves the engine clean: caller-pumped generate() still works
+    (no background pump needed)."""
+    from paddle_tpu.inference.llm_server import LLMEngine
+
+    eng = LLMEngine(tiny_model, max_batch_slots=1, max_seq_len=64)
+    eng.start()
+    eng.stop()
+    got = eng.generate([1, 2, 3], max_new_tokens=2)
+    assert len(got) == 2
+
+
+def test_injected_fault_classifies_as_transient():
+    """The harness's faults look exactly like real transient OSErrors to the
+    production retry policy."""
+    policy = RetryPolicy()
+    assert policy.is_retryable(InjectedFault(errno.ENOSPC, "x"))
+    assert policy.is_retryable(TornWrite(errno.EIO, "x"))
+    assert not policy.is_retryable(ValueError("x"))

@@ -180,12 +180,55 @@ def test_resnet50_fused_model_parity():
         assert max_err < 0.2 and mean_err < 2e-2, \
             f"grad mismatch {n}: max {max_err} mean {mean_err}"
 
-    # running stats parity (bn3 of the last block exercises fold + masking)
-    for (n, br), bf in zip(ref.named_sublayers(), (m for _, m in fused.named_sublayers())):
-        pass
-    rm_r = np.asarray(ref.layer4[2].bn3._mean._value)
-    rm_f = np.asarray(fused.layer4[2].bn3._mean._value)
-    np.testing.assert_allclose(rm_f, rm_r, atol=5e-3, rtol=1e-3)
-    rv_r = np.asarray(ref.layer4[2].bn3._variance._value)
-    rv_f = np.asarray(fused.layer4[2].bn3._variance._value)
-    np.testing.assert_allclose(rv_f, rv_r, atol=5e-3, rtol=5e-3)
+    # running stats parity for EVERY paired BatchNorm (the two models are
+    # structurally identical, so named_sublayers order matches; the last
+    # blocks exercise fold + masking, the downsamples the strided path)
+    checked = 0
+    for (n, br), (_, bf) in zip(ref.named_sublayers(), fused.named_sublayers()):
+        if not isinstance(br, nn.BatchNorm2D):
+            continue
+        np.testing.assert_allclose(
+            np.asarray(bf._mean._value), np.asarray(br._mean._value),
+            atol=5e-3, rtol=1e-3, err_msg=f"running mean mismatch at {n}")
+        np.testing.assert_allclose(
+            np.asarray(bf._variance._value), np.asarray(br._variance._value),
+            atol=5e-3, rtol=5e-3, err_msg=f"running var mismatch at {n}")
+        checked += 1
+    assert checked == 53  # stem + 16 blocks x 3 + 4 downsamples
+
+
+def test_nonstandard_width_degrades_to_composed_path():
+    """A bottleneck model whose channel widths are not lane-aligned must NOT
+    take the fused path (ops.fused_conv_bn.supported would reject its 1x1
+    convs mid-forward) — it silently runs the composed forward instead of
+    raising ValueError."""
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import resnet
+    from paddle_tpu.vision.models import _fused_resnet as FR
+
+    paddle.seed(3)
+    # base_width=48 -> stage-1 bottleneck width 48, not a multiple of 64
+    model = resnet.ResNet(resnet.BottleneckBlock, 50, width=48,
+                          num_classes=10, data_format="NHWC")
+    model.train()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).rand(1, 32, 32, 3).astype(np.float32))
+    assert not resnet._fused_blocks_supported(model)
+    FR.FORCE = True
+    try:
+        assert not resnet._fused_path_ok(model, x)
+        out = model(x)  # composed path; must not raise
+    finally:
+        FR.FORCE = False
+    assert tuple(out.shape) == (1, 10)
+
+    # a standard-width model still takes the fused path under FORCE
+    paddle.seed(3)
+    std = resnet.resnet50(num_classes=10, data_format="NHWC")
+    std.train()
+    assert resnet._fused_blocks_supported(std)
+    FR.FORCE = True
+    try:
+        assert resnet._fused_path_ok(std, x)
+    finally:
+        FR.FORCE = False
