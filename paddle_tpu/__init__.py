@@ -73,6 +73,7 @@ from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import text  # noqa: F401
 from . import profiler  # noqa: F401
+from . import observability  # noqa: F401
 from . import framework  # noqa: F401
 from . import device  # noqa: F401
 from . import hapi  # noqa: F401

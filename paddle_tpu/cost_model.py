@@ -10,6 +10,7 @@ and `profile_measure` wall-clocks the compiled program.
 """
 from __future__ import annotations
 
+import os
 import time
 
 import jax
@@ -17,7 +18,42 @@ import jax.numpy as jnp
 
 from .tensor.tensor import Tensor
 
-__all__ = ["CostModel"]
+__all__ = ["CostModel", "peak_flops_per_device"]
+
+#: Dense bf16 peak FLOP/s per chip, by device_kind substring (public TPU
+#: spec sheets; the MFU denominator).  Unknown kinds (CPU hosts, new
+#: generations) return 0.0 unless PADDLE_TPU_PEAK_FLOPS overrides.
+_PEAK_FLOPS_BY_KIND = (
+    # jax reports the "lite" chips as e.g. "TPU v5 lite" / "TPU v5e"
+    # depending on runtime version — match both spellings
+    ("v6 lite", 918e12), ("v6e", 918e12),
+    ("v5p", 459e12), ("v5 lite", 197e12), ("v5e", 197e12),
+    ("v4", 275e12), ("v3", 123e12), ("v2", 45e12),
+)
+
+
+def peak_flops_per_device(device=None) -> float:
+    """Peak dense FLOP/s of one attached device (0.0 when unknown).
+
+    ``PADDLE_TPU_PEAK_FLOPS`` overrides — the escape hatch for CPU hosts,
+    dryruns projecting a different pod, and future device kinds.  Used by
+    the train-step instrumentation to turn HLO-estimated step FLOPs into an
+    MFU gauge (`train_mfu_ratio`).
+    """
+    env = os.environ.get("PADDLE_TPU_PEAK_FLOPS")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        kind = (device or jax.devices()[0]).device_kind.lower()
+    except Exception:
+        return 0.0
+    for sub, peak in _PEAK_FLOPS_BY_KIND:
+        if sub in kind:
+            return peak
+    return 0.0
 
 
 def _unwrap(args):

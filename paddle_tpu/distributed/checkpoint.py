@@ -48,6 +48,37 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..observability import metrics as _obs
+from ..observability.spans import span as _span
+
+# Checkpoint-protocol telemetry (README §Observability; every save/load/
+# quarantine/GC decision leaves a countable trace for the operator).
+_M_SAVES = _obs.counter(
+    "checkpoint_saves_total", "Committed checkpoint saves (this process)")
+_M_SAVE_FAILURES = _obs.counter(
+    "checkpoint_save_failures_total",
+    "Checkpoint saves that failed after exhausting retries")
+_M_SAVE_SECONDS = _obs.histogram(
+    "checkpoint_save_duration_seconds",
+    "save_state wall time (serialize + digest + atomic publish)")
+_M_SAVED_BYTES = _obs.counter(
+    "checkpoint_saved_bytes_total",
+    "Bytes of checkpoint volume data written by this process")
+_M_LOADS = _obs.counter(
+    "checkpoint_loads_total", "Successful checkpoint loads")
+_M_LOAD_SECONDS = _obs.histogram(
+    "checkpoint_load_duration_seconds",
+    "load_state wall time (verify + assemble + reshard)")
+_M_LOAD_FALLBACKS = _obs.counter(
+    "checkpoint_load_fallbacks_total",
+    "Loads that fell back past a corrupt/incomplete newest step")
+_M_QUARANTINES = _obs.counter(
+    "checkpoint_quarantines_total",
+    "Checkpoint steps quarantined after failing verification")
+_M_GC_DELETED = _obs.counter(
+    "checkpoint_gc_deleted_total",
+    "Checkpoint step dirs removed by the retention GC")
+
 __all__ = [
     "save_state", "load_state", "latest_step", "valid_steps",
     "CheckpointManager", "CheckpointCorruptError",
@@ -127,6 +158,7 @@ def quarantine(ckpt, reason=""):
         _atomic_write(os.path.join(ckpt, _QUARANTINED),
                       json.dumps({"reason": str(reason),
                                   "time": time.time()}).encode())
+        _M_QUARANTINES.inc()
     except OSError:
         pass  # quarantine is advisory; checksum verification still protects
 
@@ -195,6 +227,19 @@ def _step_dir(path, step):
 
 
 def save_state(path, state, step=None, process_index=None, process_count=None):
+    """Write `state` (a pytree of arrays) as a sharded checkpoint
+    (instrumented: `checkpoint_save_duration_seconds` + a span in the
+    chrome trace; the body is `_save_state_impl`)."""
+    with _span("checkpoint_save", _M_SAVE_SECONDS):
+        ckpt = _save_state_impl(path, state, step=step,
+                                process_index=process_index,
+                                process_count=process_count)
+    _M_SAVES.inc()
+    return ckpt
+
+
+def _save_state_impl(path, state, step=None, process_index=None,
+                     process_count=None):
     """Write `state` (a pytree of arrays) as a sharded checkpoint.
 
     Each process saves only shards it owns; callers on multi-host must call this
@@ -277,6 +322,7 @@ def save_state(path, state, step=None, process_index=None, process_count=None):
         np.savez(tmp_vol, **chunks)
         volumes[vol_name] = _file_digests(tmp_vol)
         os.replace(tmp_vol, vol_path)
+        _M_SAVED_BYTES.inc(volumes[vol_name]["bytes"])
 
     if proc == 0:
         idx_path = os.path.join(ckpt, _INDEX)
@@ -523,19 +569,23 @@ def load_state(path, step=None, shardings=None, template=None, verify=True,
     for s in candidates:
         ckpt = _step_dir(path, s)
         try:
-            state = _load_from_dir(ckpt, shardings, verify)
+            with _span("checkpoint_load", _M_LOAD_SECONDS):
+                state = _load_from_dir(ckpt, shardings, verify)
+            _M_LOADS.inc()
             return (state, s) if return_step else state
         except FileNotFoundError as e:
             # the candidate dir vanished (e.g. concurrent GC): try the next
             last_err = e
             if explicit:
                 raise
+            _M_LOAD_FALLBACKS.inc()
         except CheckpointCorruptError as e:
             last_err = e
             if s is not None and e.quarantinable and os.path.isdir(ckpt):
                 quarantine(ckpt, str(e))
             if explicit:
                 raise
+            _M_LOAD_FALLBACKS.inc()
     raise CheckpointCorruptError(
         f"no loadable checkpoint under {path}: {last_err}") from last_err
 
@@ -657,8 +707,12 @@ class CheckpointManager:
 
         if not force and not self.should_save(step):
             return None
-        ckpt = retry_call(save_state, self.path, state, step=step,
-                          policy=self.retry)
+        try:
+            ckpt = retry_call(save_state, self.path, state, step=step,
+                              policy=self.retry)
+        except Exception:
+            _M_SAVE_FAILURES.inc()
+            raise
         if jax.process_index() == 0:
             self._gc()
         return ckpt
@@ -678,6 +732,7 @@ class CheckpointManager:
             if s < cutoff:
                 shutil.rmtree(os.path.join(self.path, f"step_{s:010d}"),
                               ignore_errors=True)
+                _M_GC_DELETED.inc()
 
     def all_steps(self):
         out = []

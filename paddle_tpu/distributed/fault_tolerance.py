@@ -22,14 +22,38 @@ the rest of the stack composes:
 """
 from __future__ import annotations
 
+import contextlib
 import errno
 import random
+import signal as _signal
+import threading
 import time
+
+from ..observability import metrics as _obs
 
 __all__ = [
     "Preemption", "ExponentialBackoff", "RetryPolicy", "retry_call",
     "run_with_recovery", "TRANSIENT_ERRNOS",
+    "install_preemption_handler", "PreemptionNotice",
 ]
+
+# Recovery telemetry (README §Observability): restart/restore/preemption
+# rates are the self-healing loop's health signals.
+_M_RETRIES = _obs.counter(
+    "retry_attempts_total",
+    "Transient-failure retries issued by retry_call", labelnames=("op",))
+_M_PREEMPTIONS = _obs.counter(
+    "preemptions_total",
+    "Preemption notices received (SIGTERM/SIGINT adapter fires)")
+_M_RESTARTS = _obs.counter(
+    "recovery_restarts_total",
+    "run_with_recovery restarts after a recoverable failure")
+_M_RESTORES = _obs.counter(
+    "recovery_restores_total",
+    "Checkpoint restores performed by run_with_recovery")
+_M_RESTORED_STEP = _obs.gauge(
+    "recovery_last_restored_step",
+    "Completed-step counter of the last checkpoint restore")
 
 #: OSError errnos considered transient (worth retrying): disk-full windows,
 #: flaky media, interrupted syscalls, device contention.
@@ -106,6 +130,7 @@ def retry_call(fn, *args, policy: RetryPolicy | None = None, **kwargs):
         except Exception as e:
             if attempt >= policy.max_attempts or not policy.is_retryable(e):
                 raise
+            _M_RETRIES.labels(op=getattr(fn, "__name__", "call")).inc()
             policy.sleep(policy.backoff.delay(attempt))
 
 
@@ -153,6 +178,7 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
             restarts += 1
             if restarts > max_restarts:
                 raise
+            _M_RESTARTS.inc()
             completed = _restore(manager, set_state, cause=e)
             if on_event:
                 on_event("restored", {"step": completed, "error": e})
@@ -181,4 +207,74 @@ def _restore(manager, set_state, cause=None):
             "run_with_recovery: restored a step-less checkpoint dir — "
             "the manager's path holds no step_* structure to resume from")
     set_state(state)
+    _M_RESTORES.inc()
+    _M_RESTORED_STEP.set(int(step))
     return int(step)
+
+
+# ------------------------------------------------------------ signal adapter
+class PreemptionNotice:
+    """Handle returned by ``install_preemption_handler``: records whether/
+    how often the adapter fired (``count``, ``last_signum``) and exposes
+    ``preempted`` for polling-style loops (``mode='flag'``)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self.count = 0
+        self.last_signum = None
+
+    @property
+    def preempted(self) -> bool:
+        return self._event.is_set()
+
+
+@contextlib.contextmanager
+def install_preemption_handler(signals=(_signal.SIGTERM, _signal.SIGINT), *,
+                               mode="raise", on_preempt=None):
+    """Adapt OS termination signals into the ``Preemption`` exception.
+
+    The ROADMAP "real TPU preemption notices" hook: cloud preemption
+    delivers SIGTERM ahead of the kill, so a training loop wrapped as ::
+
+        with install_preemption_handler():
+            run_with_recovery(step_fn, n, manager, get_state, set_state)
+
+    self-heals on a real eviction exactly like on an injected one — the
+    handler raises ``Preemption`` in the main thread, ``run_with_recovery``
+    checkpoint-restores, and `preemptions_total` counts the notice.
+
+    ``mode='raise'`` (default) raises from the handler; ``mode='flag'``
+    only records — poll the yielded ``PreemptionNotice.preempted`` between
+    steps and raise at a safe point yourself.  Previous handlers are
+    restored on exit.  Must be entered from the main thread (CPython
+    delivers signals there).
+    """
+    if mode not in ("raise", "flag"):
+        raise ValueError(f"mode must be 'raise' or 'flag', got {mode!r}")
+    notice = PreemptionNotice()
+
+    def _handler(signum, frame):
+        notice.count += 1
+        notice.last_signum = signum
+        notice._event.set()
+        _M_PREEMPTIONS.inc()
+        if on_preempt is not None:
+            on_preempt(signum)
+        if mode == "raise":
+            raise Preemption(
+                f"received signal {_signal.Signals(signum).name}: "
+                f"the host is being preempted")
+
+    prev = {}
+    try:
+        for s in signals:
+            prev[s] = _signal.signal(s, _handler)
+    except ValueError:
+        for s, h in prev.items():  # not the main thread: undo partial install
+            _signal.signal(s, h)
+        raise
+    try:
+        yield notice
+    finally:
+        for s, h in prev.items():
+            _signal.signal(s, h)

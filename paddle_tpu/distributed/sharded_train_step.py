@@ -15,6 +15,8 @@ TPU-native: ONE jitted step with NamedShardings:
 """
 from __future__ import annotations
 
+import os
+
 import numpy as np
 
 import jax
@@ -24,7 +26,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..tensor.tensor import Tensor
 from ..framework import random as _random
 from ..jit._step_impl import build_step_fn, init_scaler_state
+from ..observability import metrics as _obs
+from ..observability.spans import span as _span
 from .sharding_ctx import mesh_scope, param_sharding
+
+# Per-step training telemetry (names documented in README §Observability;
+# tools/metrics_lint.py polices the namespace).
+_M_STEPS = _obs.counter(
+    "train_steps_total", "Sharded train steps executed")
+_M_STEP_SECONDS = _obs.histogram(
+    "train_step_duration_seconds",
+    "Wall-clock latency of one sharded train step call (dispatch + "
+    "donated-buffer backpressure; excludes the first compile call)")
+_M_COMPILE_SECONDS = _obs.gauge(
+    "train_compile_seconds",
+    "Duration of the first train-step call (trace + XLA compile)")
+_M_TOKENS = _obs.counter(
+    "train_tokens_total",
+    "Training tokens consumed (batch x seq for rank-2 inputs, else samples)")
+_M_TOKENS_PER_S = _obs.gauge(
+    "train_tokens_per_second", "Token throughput of the latest step")
+_M_FLOPS_PER_S = _obs.gauge(
+    "train_model_flops_per_second",
+    "Achieved FLOP/s (HLO-estimated step FLOPs / step wall time); "
+    "populated once compiled_stats() has run")
+_M_MFU = _obs.gauge(
+    "train_mfu_ratio",
+    "Model FLOP utilization: achieved FLOP/s over the device peak "
+    "(cost_model.peak_flops_per_device); 0 until the peak is known")
+_M_COLLECTIVE_BYTES = _obs.gauge(
+    "train_collective_bytes",
+    "Per-device collective payload bytes per compiled step (census.py)",
+    labelnames=("op",))
 
 
 def _zero_spec(shape, spec, axis_name, mesh):
@@ -67,6 +100,7 @@ class ShardedTrainStep:
         self.accum_steps = max(1, int(accum_steps))
         self.scaler = scaler
         self._scaler_state = None
+        self._est_step_flops = None  # filled by compiled_stats()
 
     def _specs(self):
         named = dict(self.model.named_parameters())
@@ -157,12 +191,68 @@ class ShardedTrainStep:
         compiled = self._jitted.lower(
             params, buffers, self._opt_state, self._scaler_state, lr, key, *raw
         ).compile()
-        return collective_census(compiled)
+        census = collective_census(compiled)
+        # publish the census so the interconnect traffic of the *current*
+        # compiled step is always scrapeable next to the latency series
+        self._est_step_flops = census.get("est_step_flops")
+        if _obs.enabled():
+            for op, key_ in (("all-reduce", "bytes_allreduce"),
+                             ("all-gather", "bytes_allgather"),
+                             ("reduce-scatter", "bytes_reducescatter"),
+                             ("collective-permute", "bytes_ppermute"),
+                             ("all-to-all", "bytes_alltoall")):
+                _M_COLLECTIVE_BYTES.labels(op=op).set(census[key_])
+        return census
+
+    def _record_step_metrics(self, dt, raw, compiled_call):
+        if compiled_call:
+            _M_COMPILE_SECONDS.set(dt)
+            return
+        _M_STEPS.inc()
+        _M_STEP_SECONDS.observe(dt)
+        if raw and hasattr(raw[0], "shape"):
+            shape = raw[0].shape
+            # rank-2 inputs are (batch, seq) -> tokens; anything else
+            # (vision NCHW etc.) counts samples, not dim products
+            tokens = (int(shape[0]) * int(shape[1]) if len(shape) == 2
+                      else int(shape[0]) if len(shape) else 1)
+            if tokens and dt > 0:
+                _M_TOKENS.inc(tokens)
+                _M_TOKENS_PER_S.set(tokens / dt)
+        if self._est_step_flops and dt > 0:
+            achieved = self._est_step_flops / dt
+            _M_FLOPS_PER_S.set(achieved)
+            from ..cost_model import peak_flops_per_device
+
+            # est_step_flops comes from the per-device SPMD program, so the
+            # ratio is already per-device — no mesh-size factor
+            peak = peak_flops_per_device()
+            if peak > 0:
+                _M_MFU.set(achieved / peak)
 
     def __call__(self, *batch):
+        if not _obs.enabled():
+            return self._step(*batch)
+        compiled_call = self._jitted is None
+        with _span("sharded_train_step") as sp:
+            out = self._step(*batch)
+        self._record_step_metrics(sp.duration,
+                                  tuple(getattr(b, "_value", b) for b in batch),
+                                  compiled_call)
+        return out
+
+    def _step(self, *batch):
         raw = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
         if self._jitted is None:
             self._init(raw)
+            if _obs.enabled() and os.environ.get(
+                    "PADDLE_TPU_OBS_CENSUS", "").lower() in ("1", "true", "on"):
+                # opt-in: one extra AOT compile buys per-step MFU/collective
+                # gauges without the caller wiring compiled_stats() itself
+                try:
+                    self.compiled_stats(*batch)
+                except Exception:
+                    pass
         if self.scaler is not None and getattr(self.scaler, "_host_dirty", False):
             self._scaler_state = init_scaler_state(self.scaler)
             self.scaler._host_dirty = False

@@ -18,6 +18,25 @@ import struct
 import threading
 import time
 
+from ..observability import metrics as _obs
+
+# Control-plane telemetry (README §Observability): per-op rate + latency,
+# reconnect churn, and deadline hits — the straggler/partition signals.
+_OP_NAMES = {"S": "set", "G": "get", "N": "get_nb", "A": "add", "W": "check",
+             "D": "delete", "L": "list"}
+_M_OPS = _obs.counter(
+    "store_ops_total", "TCPStore client ops completed", labelnames=("op",))
+_M_OP_SECONDS = _obs.histogram(
+    "store_op_duration_seconds",
+    "TCPStore rpc latency (connect + round-trip, including retries)",
+    labelnames=("op",))
+_M_RECONNECTS = _obs.counter(
+    "store_reconnects_total",
+    "TCPStore reconnect attempts after a connection failure")
+_M_DEADLINE_HITS = _obs.counter(
+    "store_deadline_hits_total",
+    "TCPStore rpcs abandoned at their per-op deadline")
+
 
 class Store:
     """Ref store.h:26 abstract Store."""
@@ -213,9 +232,13 @@ class TCPStore(Store):
         deadline = time.monotonic() + timeout
         attempt = 0
         last = None
+        record = _obs.enabled()
+        t0 = time.perf_counter() if record else 0.0
+        opname = _OP_NAMES.get(op, op)
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                _M_DEADLINE_HITS.inc()
                 raise TimeoutError(
                     f"TCPStore rpc {op} {key!r} timed out after {timeout:.3g}s "
                     f"({attempt} attempts; last error: {last!r})")
@@ -231,7 +254,12 @@ class TCPStore(Store):
                     sent = True
                     vlen = struct.unpack(
                         "<I", _recvn_deadline(s, 4, deadline))[0]
-                    return _recvn_deadline(s, vlen, deadline) if vlen else b""
+                    out = _recvn_deadline(s, vlen, deadline) if vlen else b""
+                    if record:
+                        _M_OPS.labels(op=opname).inc()
+                        _M_OP_SECONDS.labels(op=opname).observe(
+                            time.perf_counter() - t0)
+                    return out
             except (ConnectionError, OSError) as e:
                 last = e
                 if sent and not idempotent:
@@ -242,6 +270,7 @@ class TCPStore(Store):
                         f"was sent; the mutation may or may not have been "
                         f"applied: {e!r}") from e
                 attempt += 1
+                _M_RECONNECTS.inc()
                 remaining = deadline - time.monotonic()
                 if remaining > 0:
                     self._sleep(min(self._backoff.delay(attempt), remaining))
@@ -288,6 +317,7 @@ class TCPStore(Store):
         while pending:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
+                _M_DEADLINE_HITS.inc()
                 raise TimeoutError(
                     f"TCPStore wait timed out after {timeout:.3g}s; "
                     f"still missing: {pending}")
@@ -316,6 +346,7 @@ class TCPStore(Store):
         arrived = n
         while arrived < world_size:
             if time.monotonic() > deadline:
+                _M_DEADLINE_HITS.inc()
                 raise TimeoutError(
                     f"barrier {name} timed out ({arrived}/{world_size})")
             try:  # poll (add 0 = pure read); a timed-out poll is just

@@ -5,6 +5,19 @@ import time
 
 import numpy as np
 
+from ..observability import metrics as _obs
+
+_M_BATCHES = _obs.counter(
+    "hapi_batches_total", "Batches processed by Model.fit/evaluate",
+    labelnames=("mode",))
+_M_BATCH_SECONDS = _obs.histogram(
+    "hapi_batch_duration_seconds",
+    "Per-batch wall time inside the hapi loop", labelnames=("mode",))
+_M_LAST_LOSS = _obs.gauge(
+    "hapi_last_loss_value", "Loss of the most recent training batch")
+_M_EPOCHS = _obs.counter(
+    "hapi_epochs_total", "Training epochs completed by Model.fit")
+
 
 class Callback:
     def set_model(self, model):
@@ -192,6 +205,58 @@ class ReduceLROnPlateau(Callback):
                         print(f"ReduceLROnPlateau: lr {old:.6g} -> {new:.6g}")
                 self.cooldown_counter = self.cooldown
                 self.wait = 0
+
+
+class StatsCallback(Callback):
+    """Observability bridge for the hapi loop: publishes per-batch latency,
+    loss, and epoch counters into the process-global metrics registry
+    (``paddle_tpu.observability``), and optionally appends a JSONL snapshot
+    every ``dump_every`` batches — the per-step accounting the paper stack's
+    profiler pairs with its traces.
+
+    ``StatsCallback.snapshot()`` returns the registry snapshot for
+    programmatic readers; `paddle_tpu.observability.render_prometheus()`
+    serves the same series as a `/metrics` payload.
+    """
+
+    def __init__(self, jsonl_path=None, dump_every=0):
+        self.jsonl_path = jsonl_path
+        self.dump_every = int(dump_every)
+        self._t0 = None
+        self._batches = 0
+
+    def on_batch_begin(self, mode, step, logs=None):
+        if _obs.enabled():
+            self._t0 = time.perf_counter()
+
+    def on_batch_end(self, mode, step, logs=None):
+        if not _obs.enabled():
+            return
+        if self._t0 is not None:
+            _M_BATCH_SECONDS.labels(mode=mode).observe(
+                time.perf_counter() - self._t0)
+            self._t0 = None
+        _M_BATCHES.labels(mode=mode).inc()
+        if mode == "train" and logs and "loss" in logs:
+            loss = logs["loss"]
+            loss = loss[0] if isinstance(loss, (list, tuple)) else loss
+            try:
+                _M_LAST_LOSS.set(float(np.asarray(
+                    getattr(loss, "_value", loss)).reshape(-1)[0]))
+            except (TypeError, ValueError):
+                pass
+        self._batches += 1
+        if self.jsonl_path and self.dump_every \
+                and self._batches % self.dump_every == 0:
+            _obs.dump_jsonl(self.jsonl_path,
+                            extra={"mode": mode, "step": step})
+
+    def on_epoch_end(self, epoch, logs=None):
+        _M_EPOCHS.inc()
+
+    @staticmethod
+    def snapshot():
+        return _obs.snapshot()
 
 
 class VisualDL(Callback):

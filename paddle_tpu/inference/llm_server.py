@@ -39,9 +39,49 @@ import jax
 import jax.numpy as jnp
 
 from ..autograd import tape
+from ..observability import metrics as _obs
+from ..observability.spans import span as _span
 from ..tensor.tensor import Tensor
 
 __all__ = ["LLMEngine", "ServerOverloadedError", "DeadlineExceededError"]
+
+# Serving telemetry (README §Observability): queue depth + shed/expiry rates
+# are the queue-collapse signals; TTFT and decode tok/s are the user-visible
+# latency/throughput pair (the Gemma-on-TPU serving comparison's axes).
+_M_QUEUE_DEPTH = _obs.gauge(
+    "llm_queue_depth", "Requests waiting in the admission queue")
+_M_ACTIVE_SLOTS = _obs.gauge(
+    "llm_active_slots", "Batch slots decoding this tick")
+_M_SUBMITTED = _obs.counter(
+    "llm_requests_submitted_total", "Requests accepted into the queue")
+_M_SHED = _obs.counter(
+    "llm_requests_shed_total",
+    "Requests rejected at admission (queue full / maintenance mode)")
+_M_ADMITTED = _obs.counter(
+    "llm_admissions_total", "Requests admitted into a batch slot (prefill)")
+_M_COMPLETED = _obs.counter(
+    "llm_requests_completed_total", "Requests finished with a result")
+_M_EXPIRED = _obs.counter(
+    "llm_deadline_expiries_total",
+    "Requests failed at their deadline", labelnames=("where",))
+_M_QUEUE_WAIT = _obs.histogram(
+    "llm_queue_wait_seconds", "Time from submit to slot admission")
+_M_TTFT = _obs.histogram(
+    "llm_ttft_seconds",
+    "Time to first token (submit -> prefill's first generated token)")
+_M_E2E = _obs.histogram(
+    "llm_request_duration_seconds", "End-to-end request latency")
+_M_DECODE_TOKENS = _obs.counter(
+    "llm_decode_tokens_total", "Tokens emitted by decode ticks")
+_M_DECODE_TPS = _obs.gauge(
+    "llm_decode_tokens_per_second",
+    "Aggregate decode throughput of the latest tick")
+_M_TICK_SECONDS = _obs.histogram(
+    "llm_decode_tick_duration_seconds",
+    "One engine tick (admissions + compiled decode + bookkeeping)")
+_M_WATCHDOG = _obs.counter(
+    "llm_pump_watchdog_trips_total",
+    "Background pump deaths caught by the watchdog")
 
 
 class ServerOverloadedError(RuntimeError):
@@ -84,6 +124,8 @@ class _Request:
     deadline: float | None = None
     slot: int = -1
     tokens: list = field(default_factory=list)
+    submit_ts: float | None = None  # engine-clock stamps for the latency
+    admit_ts: float | None = None   # histograms (queue wait / TTFT / e2e)
 
 
 def _select_rows(logits, key, do_sample, temperature, top_p):
@@ -212,19 +254,24 @@ class LLMEngine:
             np.int32).reshape(-1)
         if arr.size == 0 or arr.size > self.L - 1:
             raise ValueError(f"prompt length {arr.size} not in [1, {self.L - 1}]")
+        now = self._clock()
         req = _Request(arr, int(max_new_tokens), Future(),
                        do_sample=bool(do_sample),
                        temperature=float(temperature), top_p=float(top_p),
-                       deadline=(self._clock() + float(timeout))
-                       if timeout is not None else None)
+                       deadline=(now + float(timeout))
+                       if timeout is not None else None,
+                       submit_ts=now)
         try:
             if self.max_queue_len is not None and self.max_queue_len <= 0:
                 raise queue.Full
             self._pending.put_nowait(req)
         except queue.Full:
+            _M_SHED.inc()
             raise ServerOverloadedError(
                 f"admission queue full ({self.max_queue_len} pending "
                 f"requests); request rejected — retry with backoff") from None
+        _M_SUBMITTED.inc()
+        _M_QUEUE_DEPTH.set(self._pending.qsize())
         if self._pump_error is not None:
             # pump died between the entry check and the enqueue: the
             # watchdog's drain may have missed this request, so fail it
@@ -254,6 +301,44 @@ class LLMEngine:
         while not self._pending.empty() or any(r is not None
                                                for r in self.slot_req):
             self.step()
+
+    @staticmethod
+    def _hist_summary(hist):
+        return {"count": hist.count, "sum": hist.sum,
+                "mean": (hist.sum / hist.count) if hist.count else 0.0}
+
+    def stats(self):
+        """Operator snapshot — deliberately does NOT take the engine (pump)
+        lock: a monitoring scrape must never block behind a wedged step(),
+        and every field here is a single atomic read (the queue keeps its
+        own mutex; the slot table is only ever swept, not summed, under
+        the lock).  Values can therefore lag one tick — fine for stats.
+        Request/latency series come from the process-global metrics
+        registry, so two engines in one process share those counters.
+        """
+        return {
+            "queue_depth": self._pending.qsize(),
+            "active_slots": sum(r is not None for r in self.slot_req),
+            "n_slots": self.n_slots,
+            "pump_alive": self._thread.is_alive()
+            if self._thread is not None else False,
+            "pump_error": repr(self._pump_error)
+            if self._pump_error is not None else None,
+            "stopping": self._stop,
+            "requests": {
+                "submitted": _M_SUBMITTED.value,
+                "admitted": _M_ADMITTED.value,
+                "completed": _M_COMPLETED.value,
+                "shed": _M_SHED.value,
+                "expired_queued": _M_EXPIRED.labels(where="queued").value,
+                "expired_inflight": _M_EXPIRED.labels(where="inflight").value,
+            },
+            "decode_tokens": _M_DECODE_TOKENS.value,
+            "decode_tokens_per_second": _M_DECODE_TPS.value,
+            "queue_wait_seconds": self._hist_summary(_M_QUEUE_WAIT),
+            "ttft_seconds": self._hist_summary(_M_TTFT),
+            "e2e_seconds": self._hist_summary(_M_E2E),
+        }
 
     def start(self):
         """Background pump (server mode)."""
@@ -304,6 +389,7 @@ class LLMEngine:
             self._fail_pending(RuntimeError("LLMEngine stopped"))
         except BaseException as e:  # watchdog: a dying pump must not strand
             self._pump_error = e    # callers blocked on future.result()
+            _M_WATCHDOG.inc()
             self._fail_pending(RuntimeError(
                 f"LLMEngine pump thread died: {e!r}"))
 
@@ -375,6 +461,7 @@ class LLMEngine:
                 continue  # cancelled by the caller, or failed by a
                           # pump-death race — don't waste a slot on it
             if req.deadline is not None and self._clock() > req.deadline:
+                _M_EXPIRED.labels(where="queued").inc()
                 _fail_future(req.future, DeadlineExceededError(
                     "request deadline expired while queued for admission"))
                 continue
@@ -387,6 +474,9 @@ class LLMEngine:
                 _fail_future(req.future, e)
 
     def _admit_one(self, req, slot):
+        req.admit_ts = self._clock()
+        if req.submit_ts is not None:
+            _M_QUEUE_WAIT.observe(max(0.0, req.admit_ts - req.submit_ts))
         n = req.prompt.size
         Lb = self._bucket(n)
         padded = np.full((1, Lb), self.pad, np.int32)
@@ -404,6 +494,10 @@ class LLMEngine:
         self.slot_req[slot] = req
         self.slot_pos[slot] = n
         self.last_token[slot] = tok
+        _M_ADMITTED.inc()
+        if req.submit_ts is not None:
+            # the prefill's token IS the first token out
+            _M_TTFT.observe(max(0.0, self._clock() - req.submit_ts))
         if tok == self.eos or req.max_new_tokens <= 1:
             self._finish(slot)
 
@@ -501,13 +595,22 @@ class LLMEngine:
         background pump and caller-thread pumping (run_until_complete) must
         not race on the DONATED cache buffers or the slot state."""
         with self._lock:
-            return self._step_locked()
+            if not _obs.enabled():
+                return self._step_locked()
+            with _span("llm_decode_tick", _M_TICK_SECONDS) as sp:
+                emitted = self._step_locked()
+            if emitted and sp.duration:
+                _M_DECODE_TOKENS.inc(emitted)
+                _M_DECODE_TPS.set(emitted / sp.duration)
+            return emitted
 
     def _step_locked(self):
         self._expire_queued()
         self._expire_slots()
         self._admit()
+        _M_QUEUE_DEPTH.set(self._pending.qsize())
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
+        _M_ACTIVE_SLOTS.set(len(active))
         if not active:
             return 0
         # effective chunk: stay inside the cache (slots AT capacity were
@@ -583,6 +686,7 @@ class LLMEngine:
                 self._pending.queue.extend(keep)
                 self._pending.not_full.notify_all()
         for req in expired:
+            _M_EXPIRED.labels(where="queued").inc()
             _fail_future(req.future, DeadlineExceededError(
                 "request deadline expired while queued for admission"))
 
@@ -594,6 +698,7 @@ class LLMEngine:
                     and self._clock() > req.deadline:
                 self.slot_req[i] = None
                 self.last_token[i] = self.pad
+                _M_EXPIRED.labels(where="inflight").inc()
                 _fail_future(req.future, DeadlineExceededError(
                     f"request deadline exceeded after "
                     f"{len(req.tokens)} generated tokens"))
@@ -603,4 +708,7 @@ class LLMEngine:
         self.slot_req[slot] = None
         self.last_token[slot] = self.pad
         if req is not None:
+            _M_COMPLETED.inc()
+            if req.submit_ts is not None:
+                _M_E2E.observe(max(0.0, self._clock() - req.submit_ts))
             _complete_future(req.future, list(req.tokens))

@@ -1,0 +1,38 @@
+"""Unified observability layer: metrics registry + structured spans.
+
+The numbers half of the paper stack's host-tracer/device-tracer/cost-model
+triple: a dependency-free process-global metrics registry
+(`observability.metrics`) and span events that feed both the registry and
+the native chrome-trace buffer (`observability.spans` via
+`profiler.RecordEvent`).  Every built-in hot path — sharded train step,
+checkpoint commit protocol, TCPStore client, recovery supervisor, LLM
+server — registers its series here at import time, so
+``paddle_tpu.observability.render_prometheus()`` is a complete `/metrics`
+payload the moment the process starts, and ``tools/metrics_lint.py`` can
+police the namespace without running a workload.
+
+Quick start::
+
+    import paddle_tpu as paddle
+    obs = paddle.observability
+    ...train / serve...
+    print(obs.render_prometheus())         # Prometheus text exposition
+    obs.dump_jsonl("metrics.jsonl")        # append-only local time series
+    obs.disable()                          # per-call cost -> one dict lookup
+"""
+from .metrics import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricRegistry, REGISTRY,
+    counter, gauge, histogram, enable, disable, enabled,
+    snapshot, render_prometheus, dump_jsonl, log_buckets,
+    DEFAULT_TIME_BUCKETS,
+)
+from .spans import span  # noqa: F401
+from . import metrics  # noqa: F401
+from . import spans  # noqa: F401
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
+    "counter", "gauge", "histogram", "enable", "disable", "enabled",
+    "snapshot", "render_prometheus", "dump_jsonl", "log_buckets",
+    "DEFAULT_TIME_BUCKETS", "span", "metrics", "spans",
+]

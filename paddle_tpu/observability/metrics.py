@@ -1,0 +1,467 @@
+"""Dependency-free metrics registry (the substrate every perf/elasticity PR
+reports through).
+
+Reference analog: the paper's stack pairs host/device tracers with per-step
+cost accounting (SURVEY §profiler); Piper and the Gemma-on-TPU serving
+comparison (PAPERS.md) both lean on per-step/per-request series to find
+stragglers and queue collapse.  This module is the *numbers* half of that
+pairing (the *traces* half is `observability.spans` -> `profiler.RecordEvent`):
+
+- ``Counter`` / ``Gauge`` / ``Histogram`` (fixed log-spaced buckets) with
+  labeled children, registered in a process-global default ``REGISTRY``;
+- ``snapshot()`` (plain dicts), ``render_prometheus()`` (text exposition
+  format, the `/metrics` payload) and ``dump_jsonl()`` (append-only local
+  time series for offline joins with chrome traces);
+- ``disable()``: the per-call overhead of every instrumentation point drops
+  to one dict lookup — hot paths stay benchmark-clean with observability
+  off (`PADDLE_TPU_OBSERVABILITY=0` starts disabled).
+
+No jax / numpy / paddle imports: the registry must be importable from any
+layer (store, checkpoint, server) without dragging in device runtimes.
+"""
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+from bisect import bisect_left
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
+    "counter", "gauge", "histogram", "enable", "disable", "enabled",
+    "snapshot", "render_prometheus", "dump_jsonl", "log_buckets",
+    "DEFAULT_TIME_BUCKETS",
+]
+
+# The disabled fast path: every record call starts with one dict lookup on
+# this module-level dict (no attribute chains, no function indirection).
+_runtime = {"enabled": os.environ.get("PADDLE_TPU_OBSERVABILITY", "1")
+            .lower() not in ("0", "false", "off")}
+
+
+def enable():
+    """(Re-)enable metric recording process-wide."""
+    _runtime["enabled"] = True
+
+
+def disable():
+    """Disable recording: every inc/set/observe returns after one dict
+    lookup.  Registration still works (the namespace stays lintable)."""
+    _runtime["enabled"] = False
+
+
+def enabled() -> bool:
+    return _runtime["enabled"]
+
+
+def log_buckets(lo: float, hi: float, per_decade: int = 3):
+    """Fixed log-spaced bucket bounds covering [lo, hi]: ``per_decade``
+    bounds per factor-of-10, rounded to 4 significant digits so the
+    Prometheus ``le`` strings stay short and stable."""
+    if lo <= 0 or hi <= lo:
+        raise ValueError("log_buckets needs 0 < lo < hi")
+    n = int(math.ceil(math.log10(hi / lo) * per_decade)) + 1
+    out = []
+    for i in range(n):
+        b = lo * 10.0 ** (i / per_decade)
+        mag = 10.0 ** (math.floor(math.log10(b)) - 3)
+        out.append(round(round(b / mag) * mag, 12))
+    out[-1] = min(out[-1], hi) if out[-1] > hi else out[-1]
+    # dedupe while preserving order (rounding can collide at decade edges)
+    seen, bounds = set(), []
+    for b in out:
+        if b not in seen:
+            seen.add(b)
+            bounds.append(b)
+    return tuple(bounds)
+
+
+#: 100 µs .. 100 s, 3 buckets per decade — wide enough for a store rpc and a
+#: full-model compile in the same histogram family.
+DEFAULT_TIME_BUCKETS = log_buckets(1e-4, 100.0, per_decade=3)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample formatting: integers render bare, floats via repr."""
+    f = float(v)
+    if f == math.inf:
+        return "+Inf"
+    if f == -math.inf:
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape(v: str) -> str:
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _labelstr(names, values) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape(str(v))}"'
+                     for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+# ------------------------------------------------------------------ children
+class _CounterChild:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def inc(self, amount=1.0):
+        if not _runtime["enabled"]:
+            return
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self):
+        return self._value
+
+
+class _GaugeChild:
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, value):
+        if not _runtime["enabled"]:
+            return
+        self._value = float(value)
+
+    def inc(self, amount=1.0):
+        if not _runtime["enabled"]:
+            return
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount=1.0):
+        self.inc(-amount)
+
+    @property
+    def value(self):
+        return self._value
+
+
+class _HistogramChild:
+    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock")
+
+    def __init__(self, bounds):
+        self._bounds = bounds
+        self._counts = [0] * (len(bounds) + 1)  # last slot = +Inf overflow
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value):
+        if not _runtime["enabled"]:
+            return
+        v = float(value)
+        i = bisect_left(self._bounds, v)  # first bound >= v (le semantics)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def sum(self):
+        return self._sum
+
+    @property
+    def count(self):
+        return self._count
+
+    def bucket_counts(self):
+        """{upper_bound: cumulative count} including +Inf."""
+        out, cum = {}, 0
+        for b, c in zip(self._bounds, self._counts):
+            cum += c
+            out[b] = cum
+        out[math.inf] = cum + self._counts[-1]
+        return out
+
+
+# ------------------------------------------------------------------- parents
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name, help="", labelnames=()):
+        _validate_name(name)
+        self.name = name
+        self.help = str(help)
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, object] = {}
+        if not self.labelnames:
+            self._children[()] = self._make_child()
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def labels(self, *values, **kv):
+        """Child for one label-value combination (created on first use)."""
+        if kv:
+            if values:
+                raise ValueError("pass label values positionally OR by name")
+            try:
+                values = tuple(kv[n] for n in self.labelnames)
+            except KeyError as e:
+                raise ValueError(
+                    f"metric {self.name} has labels {self.labelnames}; "
+                    f"missing {e.args[0]!r}") from None
+            if len(kv) != len(self.labelnames):
+                extra = set(kv) - set(self.labelnames)
+                raise ValueError(
+                    f"metric {self.name} got unknown labels {sorted(extra)}")
+        values = tuple(str(v) for v in values)
+        if len(values) != len(self.labelnames):
+            raise ValueError(
+                f"metric {self.name} expects {len(self.labelnames)} label "
+                f"values {self.labelnames}, got {len(values)}")
+        child = self._children.get(values)
+        if child is None:
+            with self._lock:
+                child = self._children.setdefault(values, self._make_child())
+        return child
+
+    def _solo(self):
+        if self.labelnames:
+            raise ValueError(
+                f"metric {self.name} is labeled {self.labelnames}; "
+                f"call .labels(...) first")
+        return self._children[()]
+
+    def series(self):
+        """[(labelvalues_tuple, child)] in creation order."""
+        return list(self._children.items())
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _make_child(self):
+        return _CounterChild()
+
+    def inc(self, amount=1.0):
+        if not _runtime["enabled"]:
+            return
+        self._solo().inc(amount)
+
+    @property
+    def value(self):
+        return self._solo().value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _make_child(self):
+        return _GaugeChild()
+
+    def set(self, value):
+        if not _runtime["enabled"]:
+            return
+        self._solo().set(value)
+
+    def inc(self, amount=1.0):
+        if not _runtime["enabled"]:
+            return
+        self._solo().inc(amount)
+
+    def dec(self, amount=1.0):
+        if not _runtime["enabled"]:
+            return
+        self._solo().dec(amount)
+
+    @property
+    def value(self):
+        return self._solo().value
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labelnames=(), buckets=None):
+        bounds = tuple(sorted(float(b) for b in
+                              (buckets if buckets is not None
+                               else DEFAULT_TIME_BUCKETS)))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self.buckets = bounds
+        super().__init__(name, help, labelnames)
+
+    def _make_child(self):
+        return _HistogramChild(self.buckets)
+
+    def observe(self, value):
+        if not _runtime["enabled"]:
+            return
+        self._solo().observe(value)
+
+    @property
+    def sum(self):
+        return self._solo().sum
+
+    @property
+    def count(self):
+        return self._solo().count
+
+
+def _validate_name(name):
+    if not name or not all(c.islower() or c.isdigit() or c == "_"
+                           for c in name) or not name[0].isalpha():
+        raise ValueError(
+            f"metric name {name!r} must be snake_case "
+            f"([a-z][a-z0-9_]*); see tools/metrics_lint.py")
+
+
+# ------------------------------------------------------------------ registry
+class MetricRegistry:
+    """Name -> metric family.  Registration is idempotent: re-registering the
+    same (name, kind, labelnames) returns the existing family (so module
+    reloads and multiple import paths share series); a conflicting
+    re-registration raises."""
+
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, cls, name, help, labelnames, **kw):
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if (existing.kind != cls.kind
+                        or existing.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{existing.labelnames}, conflicting "
+                        f"with {cls.kind}{tuple(labelnames)}")
+                return existing
+            m = cls(name, help, labelnames, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="", labelnames=()) -> Counter:
+        return self._register(Counter, name, help, labelnames)
+
+    def gauge(self, name, help="", labelnames=()) -> Gauge:
+        return self._register(Gauge, name, help, labelnames)
+
+    def histogram(self, name, help="", labelnames=(),
+                  buckets=None) -> Histogram:
+        return self._register(Histogram, name, help, labelnames,
+                              buckets=buckets)
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def names(self):
+        return list(self._metrics)
+
+    def __iter__(self):
+        return iter(self._metrics.values())
+
+    def unregister(self, name):
+        with self._lock:
+            self._metrics.pop(name, None)
+
+    def reset(self):
+        """Zero every series (keep the registered families).  Test hook."""
+        with self._lock:
+            for m in self._metrics.values():
+                fresh = {}
+                for lv in m._children:
+                    fresh[lv] = m._make_child()
+                m._children = fresh
+
+    # ---------------------------------------------------------- exposition
+    def snapshot(self) -> dict:
+        """Plain-dict view of every series (JSON-ready)."""
+        out = {}
+        for m in self._metrics.values():
+            series = []
+            for lv, child in m.series():
+                labels = dict(zip(m.labelnames, lv))
+                if m.kind == "histogram":
+                    series.append({"labels": labels, "sum": child.sum,
+                                   "count": child.count,
+                                   "buckets": {_fmt(b): c for b, c in
+                                               child.bucket_counts().items()}})
+                else:
+                    series.append({"labels": labels, "value": child.value})
+            out[m.name] = {"kind": m.kind, "help": m.help, "series": series}
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus/OpenMetrics text exposition — the `/metrics` payload
+        (serve it from any HTTP handler; nothing here binds a socket)."""
+        lines = []
+        for m in self._metrics.values():
+            if m.help:
+                lines.append(f"# HELP {m.name} {_escape(m.help)}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for lv, child in m.series():
+                if m.kind == "histogram":
+                    for b, c in child.bucket_counts().items():
+                        ls = _labelstr(m.labelnames + ("le",),
+                                       lv + (_fmt(b),))
+                        lines.append(f"{m.name}_bucket{ls} {c}")
+                    ls = _labelstr(m.labelnames, lv)
+                    lines.append(f"{m.name}_sum{ls} {_fmt(child.sum)}")
+                    lines.append(f"{m.name}_count{ls} {child.count}")
+                else:
+                    ls = _labelstr(m.labelnames, lv)
+                    lines.append(f"{m.name}{ls} {_fmt(child.value)}")
+        return "\n".join(lines) + "\n"
+
+    def dump_jsonl(self, path, extra=None):
+        """Append one timestamped snapshot line to ``path`` (local JSONL time
+        series; join offline with chrome-trace exports by wall time)."""
+        rec = {"time": time.time(), "metrics": self.snapshot()}
+        if extra:
+            rec["extra"] = dict(extra)
+        line = json.dumps(rec, separators=(",", ":"))
+        with open(path, "a") as f:
+            f.write(line + "\n")
+        return path
+
+
+#: Process-global default registry: every built-in instrumentation point
+#: registers here, and `render_prometheus()` below exposes it.
+REGISTRY = MetricRegistry()
+
+
+def counter(name, help="", labelnames=(), registry=None) -> Counter:
+    return (registry or REGISTRY).counter(name, help, labelnames)
+
+
+def gauge(name, help="", labelnames=(), registry=None) -> Gauge:
+    return (registry or REGISTRY).gauge(name, help, labelnames)
+
+
+def histogram(name, help="", labelnames=(), buckets=None,
+              registry=None) -> Histogram:
+    return (registry or REGISTRY).histogram(name, help, labelnames, buckets)
+
+
+def snapshot(registry=None) -> dict:
+    return (registry or REGISTRY).snapshot()
+
+
+def render_prometheus(registry=None) -> str:
+    return (registry or REGISTRY).render_prometheus()
+
+
+def dump_jsonl(path, extra=None, registry=None):
+    return (registry or REGISTRY).dump_jsonl(path, extra=extra)

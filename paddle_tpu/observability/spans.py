@@ -1,0 +1,70 @@
+"""Structured spans: ONE instrumentation point lands in both sinks.
+
+``with span("checkpoint_save", histogram=H):`` opens a
+``profiler.RecordEvent`` (native host-trace buffer -> chrome://tracing
+export, plus a jax TraceAnnotation -> XPlane timeline) and, on exit,
+observes the wall-clock duration into ``histogram`` and bumps ``counter``.
+Metrics and traces therefore always agree on what a "checkpoint_save" is —
+the correlation the README's Observability section documents.
+
+``metrics.disable()`` turns spans into no-ops too (one dict lookup on
+enter), so instrumented hot paths stay benchmark-clean.
+"""
+from __future__ import annotations
+
+import time
+
+from . import metrics as _metrics
+
+__all__ = ["span"]
+
+_record_event_cls = None
+
+
+def _record_event(name):
+    """profiler.RecordEvent, imported lazily (profiler drags in jax; the
+    metrics registry itself must stay dependency-free)."""
+    global _record_event_cls
+    if _record_event_cls is None:
+        try:
+            from ..profiler import RecordEvent
+            _record_event_cls = RecordEvent
+        except Exception:
+            _record_event_cls = False
+    return _record_event_cls(name) if _record_event_cls else None
+
+
+class span:
+    """Context manager: trace span + latency histogram + event counter."""
+
+    __slots__ = ("name", "histogram", "counter", "_t0", "_ev", "duration")
+
+    def __init__(self, name, histogram=None, counter=None):
+        self.name = name
+        self.histogram = histogram
+        self.counter = counter
+        self._t0 = None
+        self._ev = None
+        self.duration = None
+
+    def __enter__(self):
+        if not _metrics._runtime["enabled"]:
+            return self
+        self._ev = _record_event(self.name)
+        if self._ev is not None:
+            self._ev.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._t0 is not None:
+            self.duration = time.perf_counter() - self._t0
+            self._t0 = None
+            if self._ev is not None:
+                self._ev.__exit__(None, None, None)
+                self._ev = None
+            if self.histogram is not None:
+                self.histogram.observe(self.duration)
+            if self.counter is not None:
+                self.counter.inc()
+        return False

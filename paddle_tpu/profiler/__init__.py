@@ -76,8 +76,20 @@ def export_chrome_tracing(dir_name, worker_name=None):
     return handler
 
 
+_RECORD_STATES = (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)
+
+
 class Profiler:
-    """with Profiler(targets=[...], on_trace_ready=export_chrome_tracing('./log')): ..."""
+    """with Profiler(targets=[...], on_trace_ready=export_chrome_tracing('./log')): ...
+
+    With a ``scheduler`` (see `make_scheduler`) the profiler drives the
+    reference's CLOSED/READY/RECORD/RECORD_AND_RETURN step-phase state
+    machine from ``step()``: tracing runs only during RECORD phases, the
+    host-trace buffer is cleared at each record-window start, and
+    ``on_trace_ready`` fires once per window when its RECORD_AND_RETURN
+    step completes (ref profiler.py Profiler._trigger_action).  Without a
+    scheduler the whole start()..stop() range records, as before.
+    """
 
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None, timer_only=False,
                  record_shapes=False, profile_memory=False, with_flops=False):
@@ -90,11 +102,19 @@ class Profiler:
         self._step_num = 0
         self._step_t0 = None
         self._step_times: list[float] = []
+        self._scheduler = scheduler
+        self.current_state = ProfilerState.CLOSED
+        self._record_windows = 0  # completed record windows (handler fires)
 
-    def start(self):
+    def is_recording(self) -> bool:
+        """True while the tracers collect (always inside start()..stop()
+        without a scheduler; only during RECORD phases with one)."""
+        return self.current_state in _RECORD_STATES
+
+    def _tracing_on(self):
         tr = _tracer()
         if tr is not None:
-            tr.clear()
+            tr.clear()  # each record window exports only its own spans
             tr.enable(True)
         if not self._timer_only:
             os.makedirs(self._dir, exist_ok=True)
@@ -103,20 +123,42 @@ class Profiler:
                 self._started = True
             except Exception:
                 self._started = False
-        self._step_t0 = time.perf_counter()
 
-    def stop(self):
+    def _tracing_off(self):
         if self._started:
             jax.profiler.stop_trace()
             self._started = False
         tr = _tracer()
         if tr is not None:
             tr.enable(False)
+
+    def _fire_trace_ready(self):
+        self._record_windows += 1
         if self._on_trace_ready is not None:
             try:
                 self._on_trace_ready(self)
             except Exception:
                 pass
+
+    def start(self):
+        if self._scheduler is None:
+            self.current_state = ProfilerState.RECORD
+            self._tracing_on()
+        else:
+            self.current_state = self._scheduler(0)
+            if self.current_state in _RECORD_STATES:
+                self._tracing_on()
+        self._step_t0 = time.perf_counter()
+
+    def stop(self):
+        was_recording = self.is_recording()
+        self._tracing_off()
+        if self._scheduler is None or was_recording:
+            # a scheduler-driven profiler whose window already closed (state
+            # CLOSED/READY) exported via its RECORD_AND_RETURN step; firing
+            # again here would hand the handler an empty buffer
+            self._fire_trace_ready()
+        self.current_state = ProfilerState.CLOSED
 
     def step(self, num_samples=None):
         now = time.perf_counter()
@@ -124,6 +166,21 @@ class Profiler:
             self._step_times.append(now - self._step_t0)
         self._step_t0 = now
         self._step_num += 1
+        if self._scheduler is None:
+            return
+        prev = self.current_state
+        new = self._scheduler(self._step_num)
+        if prev in _RECORD_STATES and (new not in _RECORD_STATES
+                                       or prev == ProfilerState.RECORD_AND_RETURN):
+            # record window closed (RECORD_AND_RETURN step just completed,
+            # or the schedule left the record phase): export + notify
+            self._tracing_off()
+            self._fire_trace_ready()
+            if new in _RECORD_STATES:  # back-to-back windows
+                self._tracing_on()
+        elif prev not in _RECORD_STATES and new in _RECORD_STATES:
+            self._tracing_on()
+        self.current_state = new
 
     def step_info(self, unit=None):
         if not self._step_times:

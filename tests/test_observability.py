@@ -1,0 +1,524 @@
+"""Observability layer: metrics registry, spans, scheduler state machine,
+preemption adapter, and end-to-end instrumentation of train/checkpoint/
+store/serve hot paths (ISSUE 2).
+
+Oracles: the Prometheus text format is goldened byte-for-byte for a tiny
+registry; the disabled fast path must record NOTHING; the profiler
+scheduler must trace only during RECORD phases; one tiny train step + one
+checkpoint save + one LLM request must populate the documented series; and
+tools/metrics_lint.py (tier-1 via this file) must pass against README's
+catalogue.
+"""
+import importlib.util
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+from paddle_tpu import profiler as prof_mod
+from paddle_tpu.distributed import ShardedTrainStep
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import fault_tolerance as ft
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.inference.llm_server import ServerOverloadedError
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import metrics as obs_metrics
+
+pytestmark = pytest.mark.quick
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_metrics_lint():
+    spec = importlib.util.spec_from_file_location(
+        "metrics_lint", os.path.join(_REPO, "tools", "metrics_lint.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+# ----------------------------------------------------------------- registry
+def test_counter_gauge_labels_and_snapshot():
+    r = obs.MetricRegistry()
+    c = r.counter("reqs_total", "requests", labelnames=("code",))
+    c.labels(code="200").inc()
+    c.labels(code="200").inc(2)
+    c.labels("500").inc()
+    g = r.gauge("depth_count", "depth")
+    g.set(5)
+    g.dec()
+    snap = r.snapshot()
+    assert snap["reqs_total"]["kind"] == "counter"
+    series = {tuple(s["labels"].items()): s["value"]
+              for s in snap["reqs_total"]["series"]}
+    assert series[(("code", "200"),)] == 3.0
+    assert series[(("code", "500"),)] == 1.0
+    assert snap["depth_count"]["series"][0]["value"] == 4.0
+    # same child object on repeated labels() (series identity)
+    assert c.labels(code="200") is c.labels("200")
+
+
+def test_registration_idempotent_and_conflicts():
+    r = obs.MetricRegistry()
+    a = r.counter("x_total", "x")
+    assert r.counter("x_total", "ignored") is a
+    with pytest.raises(ValueError):
+        r.gauge("x_total")  # kind conflict
+    with pytest.raises(ValueError):
+        r.counter("x_total", labelnames=("op",))  # label conflict
+    with pytest.raises(ValueError):
+        r.counter("BadName_total")  # not snake_case
+    with pytest.raises(ValueError):
+        a.inc(-1)  # counters only go up
+    with pytest.raises(ValueError):
+        a.labels("x")  # unlabeled metric has no children
+
+
+def test_histogram_bucket_semantics():
+    r = obs.MetricRegistry()
+    h = r.histogram("lat_seconds", "latency", buckets=(0.01, 0.1, 1.0))
+    for v in (0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    cum = h.labels().bucket_counts()
+    assert cum[0.01] == 1 and cum[0.1] == 2 and cum[1.0] == 3
+    assert cum[float("inf")] == 4
+    assert h.count == 4 and abs(h.sum - 5.555) < 1e-9
+    # log-spaced default buckets are sorted and fixed
+    d = obs.DEFAULT_TIME_BUCKETS
+    assert list(d) == sorted(d) and d[0] == 1e-4 and d[-1] == 100.0
+
+
+def test_prometheus_text_golden():
+    r = obs.MetricRegistry()
+    c = r.counter("demo_requests_total", "Requests", labelnames=("code",))
+    c.labels(code="200").inc()
+    c.labels(code="500").inc(2)
+    g = r.gauge("demo_queue_depth", "Depth")
+    g.set(3)
+    h = r.histogram("demo_latency_seconds", "Latency", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(5)
+    assert r.render_prometheus() == (
+        '# HELP demo_requests_total Requests\n'
+        '# TYPE demo_requests_total counter\n'
+        'demo_requests_total{code="200"} 1\n'
+        'demo_requests_total{code="500"} 2\n'
+        '# HELP demo_queue_depth Depth\n'
+        '# TYPE demo_queue_depth gauge\n'
+        'demo_queue_depth 3\n'
+        '# HELP demo_latency_seconds Latency\n'
+        '# TYPE demo_latency_seconds histogram\n'
+        'demo_latency_seconds_bucket{le="0.1"} 1\n'
+        'demo_latency_seconds_bucket{le="1"} 2\n'
+        'demo_latency_seconds_bucket{le="+Inf"} 3\n'
+        'demo_latency_seconds_sum 5.55\n'
+        'demo_latency_seconds_count 3\n'
+    )
+
+
+def test_jsonl_dump(tmp_path):
+    r = obs.MetricRegistry()
+    r.counter("n_total", "n").inc()
+    path = str(tmp_path / "m.jsonl")
+    r.dump_jsonl(path)
+    r.counter("n_total").inc()
+    r.dump_jsonl(path, extra={"step": 2})
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    assert lines[0]["metrics"]["n_total"]["series"][0]["value"] == 1.0
+    assert lines[1]["metrics"]["n_total"]["series"][0]["value"] == 2.0
+    assert lines[1]["extra"] == {"step": 2}
+    assert lines[1]["time"] >= lines[0]["time"]
+
+
+def test_disabled_path_records_nothing():
+    r = obs.MetricRegistry()
+    c = r.counter("d_total", "d")
+    h = r.histogram("d_seconds", "d")
+    g = r.gauge("d_depth", "d")
+    obs.disable()
+    try:
+        assert not obs.enabled()
+        c.inc()
+        g.set(9)
+        h.observe(1.0)
+        with obs.span("noop", histogram=h, counter=c):
+            pass
+        assert c.value == 0.0 and g.value == 0.0 and h.count == 0
+    finally:
+        obs.enable()
+    c.inc()
+    assert c.value == 1.0
+
+
+def test_span_feeds_histogram_and_counter():
+    r = obs.MetricRegistry()
+    h = r.histogram("sp_seconds", "s")
+    c = r.counter("sp_total", "s")
+    with obs.span("unit_test_span", histogram=h, counter=c) as sp:
+        pass
+    assert h.count == 1 and c.value == 1.0
+    assert sp.duration is not None and sp.duration >= 0
+
+
+# ------------------------------------------------- profiler scheduler (sat 1)
+def test_scheduler_state_machine_drives_recording():
+    sch = prof_mod.make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    fired = []
+    p = prof_mod.Profiler(scheduler=sch, timer_only=True,
+                          on_trace_ready=lambda pr: fired.append(pr._step_num))
+    p.start()
+    states, recording = [p.current_state], [p.is_recording()]
+    for _ in range(6):
+        p.step()
+        states.append(p.current_state)
+        recording.append(p.is_recording())
+    S = prof_mod.ProfilerState
+    assert states[:5] == [S.CLOSED, S.READY, S.RECORD,
+                          S.RECORD_AND_RETURN, S.CLOSED]
+    assert states[5:] == [S.CLOSED, S.CLOSED]
+    # tracing only during RECORD phases
+    assert recording == [False, False, True, True, False, False, False]
+    # on_trace_ready fired exactly once, when the RECORD_AND_RETURN step done
+    assert fired == [4]
+    p.stop()
+    assert fired == [4]  # no duplicate export for a closed window
+
+
+def test_scheduler_repeat_cycles():
+    sch = prof_mod.make_scheduler(closed=0, ready=0, record=2, repeat=2)
+    fired = []
+    p = prof_mod.Profiler(scheduler=sch, timer_only=True,
+                          on_trace_ready=lambda pr: fired.append(pr._step_num))
+    p.start()
+    assert p.is_recording()
+    for _ in range(5):
+        p.step()
+    p.stop()
+    assert fired == [2, 4]  # one export per completed record window
+    assert p._record_windows == 2
+
+
+def test_profiler_without_scheduler_unchanged():
+    fired = []
+    p = prof_mod.Profiler(timer_only=True,
+                          on_trace_ready=lambda pr: fired.append(1))
+    p.start()
+    assert p.is_recording()
+    p.step()
+    p.step()
+    assert p.is_recording()
+    p.stop()
+    assert fired == [1] and not p.is_recording()
+    assert "step" in p.step_info()
+
+
+# ------------------------------------------------ preemption adapter (sat 2)
+def test_sigterm_raises_preemption_and_counts():
+    before = ft._M_PREEMPTIONS.value
+    prev_handler = signal.getsignal(signal.SIGTERM)
+    with ft.install_preemption_handler(signals=(signal.SIGTERM,)) as notice:
+        with pytest.raises(ft.Preemption):
+            os.kill(os.getpid(), signal.SIGTERM)
+            # delivery is at the next bytecode boundary; spin until then
+            for _ in range(10_000):
+                pass
+        assert notice.preempted and notice.count == 1
+        assert notice.last_signum == signal.SIGTERM
+    assert signal.getsignal(signal.SIGTERM) is prev_handler
+    assert ft._M_PREEMPTIONS.value == before + 1
+
+
+def test_sigterm_flag_mode_does_not_raise():
+    with ft.install_preemption_handler(signals=(signal.SIGTERM,),
+                                       mode="flag") as notice:
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(10_000):
+            pass
+        assert notice.preempted
+    with pytest.raises(ValueError):
+        with ft.install_preemption_handler(mode="bogus"):
+            pass
+
+
+def test_sigterm_self_heals_through_run_with_recovery(tmp_path):
+    """A real OS signal mid-step behaves exactly like an injected
+    Preemption: run_with_recovery restores and finishes all steps."""
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=3, save_interval=2)
+    state = {"x": np.zeros(1)}
+    killed = {"done": False}
+
+    def step_fn(step):
+        if step == 2 and not killed["done"]:
+            killed["done"] = True
+            os.kill(os.getpid(), signal.SIGTERM)
+            for _ in range(10_000):
+                pass
+            raise AssertionError("signal did not interrupt the step")
+        state["x"] = state["x"] + 1
+
+    with ft.install_preemption_handler(signals=(signal.SIGTERM,)):
+        report = ft.run_with_recovery(
+            step_fn, 4, mgr,
+            get_state=lambda: {"x": state["x"]},
+            set_state=lambda s: state.update(x=np.asarray(s["x"])))
+    assert report == {"completed": 4, "restarts": 1}
+    assert float(state["x"][0]) == 4.0
+
+
+# -------------------------------------------------- hot-path instrumentation
+def test_store_ops_metrics():
+    ops_before = obs_metrics.REGISTRY.get("store_ops_total")
+    set_before = ops_before.labels(op="set").value
+    get_before = ops_before.labels(op="get").value
+    store = TCPStore(is_master=True, timeout=5.0, use_native=False)
+    try:
+        store.set("k", b"v")
+        assert store.get("k") == b"v"
+        store.add("ctr", 2)
+    finally:
+        store.close()
+    assert ops_before.labels(op="set").value == set_before + 1
+    assert ops_before.labels(op="get").value == get_before + 1
+    hist = obs_metrics.REGISTRY.get("store_op_duration_seconds")
+    assert hist.labels(op="set").count >= 1
+
+
+def test_store_deadline_hit_counts():
+    hits = obs_metrics.REGISTRY.get("store_deadline_hits_total")
+    before = hits.value
+    # unroutable port: every connect fails, deadline expires
+    store = TCPStore(host="127.0.0.1", port=1, timeout=0.05,
+                     use_native=False, sleep=lambda s: None)
+    with pytest.raises(TimeoutError):
+        store.get("missing")
+    assert hits.value == before + 1
+
+
+def test_checkpoint_metrics(tmp_path):
+    saves = obs_metrics.REGISTRY.get("checkpoint_saves_total")
+    loads = obs_metrics.REGISTRY.get("checkpoint_loads_total")
+    sbytes = obs_metrics.REGISTRY.get("checkpoint_saved_bytes_total")
+    s0, l0, b0 = saves.value, loads.value, sbytes.value
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=2)
+    mgr.save(1, {"w": jnp.arange(8.0)})
+    mgr.restore()
+    assert saves.value == s0 + 1
+    assert loads.value == l0 + 1
+    assert sbytes.value > b0
+    assert obs_metrics.REGISTRY.get(
+        "checkpoint_save_duration_seconds").count >= 1
+    assert obs_metrics.REGISTRY.get(
+        "checkpoint_load_duration_seconds").count >= 1
+
+
+def test_checkpoint_quarantine_and_fallback_metrics(tmp_path):
+    q = obs_metrics.REGISTRY.get("checkpoint_quarantines_total")
+    fb = obs_metrics.REGISTRY.get("checkpoint_load_fallbacks_total")
+    q0, fb0 = q.value, fb.value
+    mgr = ckpt.CheckpointManager(str(tmp_path), keep=3)
+    mgr.save(1, {"w": jnp.arange(4.0)})
+    mgr.save(2, {"w": jnp.arange(4.0) + 1})
+    # corrupt the newest volume -> load falls back to step 1 and quarantines
+    vol = os.path.join(str(tmp_path), "step_0000000002", "volume_p00000.npz")
+    with open(vol, "r+b") as f:
+        f.seek(30)
+        b = f.read(1)
+        f.seek(30)
+        f.write(bytes([b[0] ^ 0xFF]))
+    out = mgr.restore()
+    np.testing.assert_array_equal(np.asarray(out["w"]), np.arange(4.0))
+    assert q.value == q0 + 1
+    assert fb.value == fb0 + 1
+
+
+@pytest.fixture(scope="module")
+def llm_model():
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, use_flash_attention=False,
+                           max_position_embeddings=256)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_llm_request_latency_histograms_and_stats(llm_model):
+    reg = obs_metrics.REGISTRY
+    qw, e2e, ttft = (reg.get("llm_queue_wait_seconds"),
+                     reg.get("llm_request_duration_seconds"),
+                     reg.get("llm_ttft_seconds"))
+    sub = reg.get("llm_requests_submitted_total")
+    qw0, e0, t0, s0 = qw.count, e2e.count, ttft.count, sub.value
+    eng = LLMEngine(llm_model, max_batch_slots=2, max_seq_len=128)
+    prompt = np.random.RandomState(0).randint(0, 1024, 9).astype(np.int32)
+    out = eng.generate(prompt, max_new_tokens=4)
+    assert len(out) == 4
+    assert qw.count == qw0 + 1 and e2e.count == e0 + 1 \
+        and ttft.count == t0 + 1
+    assert sub.value == s0 + 1
+    # every latency respects queue_wait <= ttft <= e2e (same clock)
+    st = eng.stats()
+    assert st["queue_depth"] == 0 and st["active_slots"] == 0
+    assert st["requests"]["submitted"] >= 1
+    assert st["requests"]["completed"] >= 1
+    assert st["decode_tokens"] >= 3
+    assert st["e2e_seconds"]["count"] >= 1
+    assert st["pump_alive"] is False and st["pump_error"] is None
+
+
+def test_llm_shed_and_deadline_metrics(llm_model):
+    reg = obs_metrics.REGISTRY
+    shed = reg.get("llm_requests_shed_total")
+    exp = reg.get("llm_deadline_expiries_total")
+    shed0 = shed.value
+    q0 = exp.labels(where="queued").value
+    now = {"t": 100.0}
+    eng = LLMEngine(llm_model, max_batch_slots=1, max_seq_len=128,
+                    max_queue_len=1, clock=lambda: now["t"])
+    prompt = np.arange(5, dtype=np.int32)
+    f1 = eng.submit(prompt, max_new_tokens=2, timeout=5.0)
+    with pytest.raises(ServerOverloadedError):
+        eng.submit(prompt, max_new_tokens=2)
+    assert shed.value == shed0 + 1
+    now["t"] += 10.0  # f1 expires in the queue
+    eng.step()
+    assert exp.labels(where="queued").value >= q0 + 1
+    with pytest.raises(Exception):
+        f1.result(timeout=1)
+
+
+def test_sharded_train_step_metrics():
+    reg = obs_metrics.REGISTRY
+    steps_c = reg.get("train_steps_total")
+    hist = reg.get("train_step_duration_seconds")
+    tokens = reg.get("train_tokens_total")
+    n0, h0, t0 = steps_c.value, hist.count, tokens.value
+
+    paddle.seed(3)
+    model = nn.Linear(16, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("dp",))
+    step = ShardedTrainStep(model, loss_fn, opt, mesh)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    y = rng.standard_normal((8, 4)).astype(np.float32)
+    for _ in range(3):
+        step(x, y)
+    # first call is the compile call: gauge set, step histogram skipped
+    assert reg.get("train_compile_seconds").value > 0
+    assert steps_c.value == n0 + 2 and hist.count == h0 + 2
+    assert tokens.value == t0 + 2 * 8 * 16  # (8,16) batch -> 128 "tokens"
+    # census publishes collective gauges + est flops for the MFU path
+    census = step.compiled_stats(x, y)
+    assert census["est_step_flops"] is None or census["est_step_flops"] >= 0
+    coll = reg.get("train_collective_bytes")
+    assert coll.labels(op="all-reduce").value >= 0
+
+
+# -------------------------------------------------------- e2e + lint (sat 6)
+def test_end_to_end_prometheus_dump(tmp_path, llm_model):
+    """Acceptance: 3 train steps + 1 checkpoint save + 1 LLM request produce
+    a Prometheus dump containing step-latency, checkpoint, store,
+    queue-depth and TTFT series."""
+    paddle.seed(5)
+    model = nn.Linear(8, 2)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    tstep = ShardedTrainStep(model, loss_fn, opt, mesh)
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((4, 8)).astype(np.float32)
+    y = rng.standard_normal((4, 2)).astype(np.float32)
+    for _ in range(3):
+        tstep(x, y)
+
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=2)
+    ckpt.save_train_state(str(tmp_path / "ck"), model, optimizer=opt,
+                          train_step=tstep, step=3)
+
+    store = TCPStore(is_master=True, timeout=5.0, use_native=False)
+    try:
+        store.set("epoch", b"3")
+        store.get("epoch")
+    finally:
+        store.close()
+
+    eng = LLMEngine(llm_model, max_batch_slots=1, max_seq_len=128)
+    eng.generate(np.arange(1, 8, dtype=np.int32), max_new_tokens=3)
+
+    text = obs.render_prometheus()
+    for series in ("train_step_duration_seconds_bucket",
+                   "train_steps_total",
+                   "checkpoint_saves_total",
+                   "checkpoint_save_duration_seconds_sum",
+                   'store_ops_total{op="set"}',
+                   "llm_queue_depth",
+                   "llm_ttft_seconds_count",
+                   "llm_decode_tokens_total"):
+        assert series in text, f"missing {series} in /metrics dump"
+    # JSONL dump of the same registry parses back
+    p = str(tmp_path / "metrics.jsonl")
+    obs.dump_jsonl(p)
+    rec = json.loads(open(p).read())
+    assert "train_steps_total" in rec["metrics"]
+
+
+def test_hapi_stats_callback():
+    from paddle_tpu.hapi.callbacks import StatsCallback
+
+    reg = obs_metrics.REGISTRY
+    batches = reg.get("hapi_batches_total")
+    b0 = batches.labels(mode="train").value
+    cb = StatsCallback()
+    cb.on_batch_begin("train", 0, {})
+    cb.on_batch_end("train", 0, {"loss": [0.5]})
+    cb.on_epoch_end(0)
+    assert batches.labels(mode="train").value == b0 + 1
+    assert reg.get("hapi_last_loss_value").value == 0.5
+    assert reg.get("hapi_batch_duration_seconds").labels(
+        mode="train").count >= 1
+    assert "hapi_batches_total" in cb.snapshot()
+
+
+def test_metrics_lint_clean_on_repo():
+    ml = _load_metrics_lint()
+    errors = ml.lint(obs_metrics.REGISTRY,
+                     readme_path=os.path.join(_REPO, "README.md"))
+    assert errors == [], "\n".join(errors)
+
+
+def test_metrics_lint_catches_rot(tmp_path):
+    ml = _load_metrics_lint()
+    r = obs.MetricRegistry()
+    r.counter("undocumented_total", "not in any catalogue")
+    r.gauge("suffixless", "no unit")
+    readme = tmp_path / "README.md"
+    readme.write_text("## Observability\n\n| `documented_total` | c | x |\n")
+    errors = ml.lint(r, readme_path=str(readme))
+    msgs = "\n".join(errors)
+    assert "undocumented_total: not documented" in msgs
+    assert "suffixless: missing unit suffix" in msgs
+    # a catalogue-less README is itself a finding
+    errors2 = ml.lint(r, readme_path=str(tmp_path / "absent.md"))
+    assert any("source of truth" in e for e in errors2)

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""Metric-namespace lint (wired as a tier-1 test in tests/test_observability.py).
+
+The observability layer registers every metric family at module import time,
+so the full namespace is visible without running a workload.  This lint
+walks the default registry and fails on:
+
+- non-snake_case names (anything outside ``[a-z][a-z0-9_]*``);
+- names without a recognized unit suffix (``_total``, ``_seconds``,
+  ``_bytes``, ``_ratio``, ``_per_second``, ``_depth``, ``_slots``,
+  ``_step``, ``_count``, ``_value``) — a unitless gauge named ``foo`` rots
+  into three dashboards disagreeing about its dimension;
+- names not documented in README.md's "## Observability" metric catalogue —
+  undocumented series are invisible to operators and drift silently;
+- label names that are not snake_case.
+
+Usage: ``python tools/metrics_lint.py [--readme README.md]`` from the repo
+root; exit code 1 on any finding.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import sys
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+# `name` or `name{label,...}` — the catalogue writes labeled families with
+# their label names inline
+_BACKTICK_RE = re.compile(r"`([a-z][a-z0-9_]*)(?:\{[^}`]*\})?`")
+
+#: Recognized unit suffixes.  Deliberately short: extend it here (and in the
+#: README catalogue) rather than minting one-off unit spellings.
+UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio", "_per_second",
+                 "_depth", "_slots", "_step", "_count", "_value")
+
+
+def documented_names(readme_path: str) -> set[str]:
+    """Backticked identifiers inside README's '## Observability' section."""
+    try:
+        with open(readme_path) as f:
+            text = f.read()
+    except OSError:
+        return set()
+    m = re.search(r"^## Observability\b(.*?)(?=^## |\Z)", text,
+                  re.MULTILINE | re.DOTALL)
+    if not m:
+        return set()
+    return set(_BACKTICK_RE.findall(m.group(1)))
+
+
+def lint(registry=None, readme_path: str = "README.md") -> list[str]:
+    """Return a list of human-readable findings (empty = clean)."""
+    if registry is None:
+        from paddle_tpu.observability import REGISTRY as registry
+    documented = documented_names(readme_path)
+    errors = []
+    for metric in registry:
+        name = metric.name
+        if not _NAME_RE.match(name):
+            errors.append(f"{name}: not snake_case ([a-z][a-z0-9_]*)")
+        if not name.endswith(UNIT_SUFFIXES):
+            errors.append(
+                f"{name}: missing unit suffix (expected one of "
+                f"{', '.join(UNIT_SUFFIXES)})")
+        if documented and name not in documented:
+            errors.append(
+                f"{name}: not documented in the README Observability "
+                f"catalogue ({readme_path})")
+        for ln in metric.labelnames:
+            if not _NAME_RE.match(ln):
+                errors.append(f"{name}: label {ln!r} is not snake_case")
+    if not documented:
+        errors.append(
+            f"{readme_path}: no '## Observability' section with backticked "
+            f"metric names found — the catalogue is the lint's source of "
+            f"truth")
+    return errors
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--readme", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "README.md"))
+    args = ap.parse_args(argv)
+
+    # Import every instrumented layer so its families are registered even if
+    # the package __init__ is ever slimmed down.
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root not in sys.path:
+        sys.path.insert(0, repo_root)
+    import paddle_tpu  # noqa: F401
+    import paddle_tpu.distributed.checkpoint  # noqa: F401
+    import paddle_tpu.distributed.fault_tolerance  # noqa: F401
+    import paddle_tpu.distributed.sharded_train_step  # noqa: F401
+    import paddle_tpu.distributed.store  # noqa: F401
+    import paddle_tpu.hapi.callbacks  # noqa: F401
+    import paddle_tpu.inference.llm_server  # noqa: F401
+    from paddle_tpu.observability import REGISTRY
+
+    errors = lint(REGISTRY, args.readme)
+    if errors:
+        print(f"metrics_lint: {len(errors)} finding(s):")
+        for e in errors:
+            print(f"  - {e}")
+        return 1
+    print(f"metrics_lint: {len(REGISTRY.names())} metric families clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
