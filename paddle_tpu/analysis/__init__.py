@@ -1,0 +1,34 @@
+"""tpulint — TPU-native static analysis for the whole package.
+
+The paper's ≥70%-MFU north star is killed by bug classes XLA compiles
+without complaint: host syncs inside jitted steps, wall-clock reads baked
+into traces, collectives over renamed mesh axes, donated buffers read after
+the call, f32 drift in bf16 paths.  This package is the machine-checked
+floor: an AST-based, dependency-free lint engine plus a framework-aware rule
+suite, run in tier-1 via ``tools/tpulint.py --check paddle_tpu``.
+
+Public surface::
+
+    from paddle_tpu.analysis import run_project, RULES
+    findings = run_project(repo_root, paths=["paddle_tpu"])
+
+Suppress one line:   ``# tpulint: disable=rule-name``
+Grandfather history: ``tools/tpulint_baseline.json`` (every entry justified).
+"""
+from .engine import (  # noqa: F401
+    RULES,
+    BaselineError,
+    FileContext,
+    FileRule,
+    Finding,
+    ProjectContext,
+    ProjectRule,
+    Rule,
+    apply_baseline,
+    load_baseline,
+    register,
+    render_json,
+    render_text,
+    run_project,
+)
+from . import rules  # noqa: F401  (registers the built-in suite)

@@ -1,0 +1,448 @@
+"""tpulint engine: rule registry, file walker, suppressions, baseline, reporters.
+
+The paper's ≥70%-MFU target dies by a thousand silent cuts — a ``.item()``
+host-sync baked into a jitted step, a wall-clock read traced into a constant,
+a collective issued under a renamed mesh axis.  XLA compiles all of these into
+slow-but-plausible programs, so they must be caught at the *program* level:
+this module is the AST lint engine that every rule plugs into.
+
+Design constraints:
+
+- **Dependency-free.** Only the stdlib (``ast``/``json``/``re``) — the engine
+  must run even when jax or the package itself cannot import (a linter that
+  needs the patient healthy is not a diagnostic tool).  Rules that *do* need
+  the live package (metrics-catalogue) import it lazily and degrade to a
+  ``note`` finding.
+- **Two rule kinds.** :class:`FileRule` runs per file on a shared parsed AST;
+  :class:`ProjectRule` runs once per lint with repo-level context
+  (:class:`ProjectContext`: declared mesh axes, the exported-name map).
+- **Suppression and baseline are explicit.** An inline
+  ``# tpulint: disable=RULE[,RULE]`` comment silences that line; a checked-in
+  baseline file grandfathers pre-existing findings, and every entry MUST carry
+  a one-line justification — the loader rejects empty or ``TODO`` entries.
+
+Severities: ``error`` > ``warning`` > ``note``.  The driver fails on error and
+warning by default; notes are informational (e.g. a rule that skipped itself
+because its inputs are unavailable).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+
+SEVERITIES = ("error", "warning", "note")
+
+#: Inline suppression: ``# tpulint: disable=rule-a,rule-b`` or ``disable=all``.
+_SUPPRESS_RE = re.compile(r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+class BaselineError(Exception):
+    """The baseline file is malformed or an entry lacks a justification."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str          # root-relative, posix separators
+    line: int
+    col: int
+    message: str
+    severity: str = "error"
+    #: Stripped source line — the baseline key.  Content-addressed so the
+    #: baseline survives unrelated line-number drift.
+    content: str = ""
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """Base: a named, described check with a default severity and an optional
+    path scope (root-relative prefixes; ``None`` = every file)."""
+
+    name: str = ""
+    severity: str = "error"
+    description: str = ""
+    paths: tuple | None = None
+
+    def applies_to(self, relpath: str) -> bool:
+        if self.paths is None:
+            return True
+        return any(relpath == p or relpath.startswith(p) for p in self.paths)
+
+
+class FileRule(Rule):
+    def check(self, ctx: "FileContext"):
+        raise NotImplementedError
+
+
+class ProjectRule(Rule):
+    def check_project(self, project: "ProjectContext"):
+        raise NotImplementedError
+
+
+#: name -> rule instance.  Populated by :func:`register` at import of
+#: ``paddle_tpu.analysis.rules``.
+RULES: dict = {}
+
+
+def register(rule_cls):
+    """Class decorator: instantiate and register a rule by its ``name``."""
+    rule = rule_cls()
+    if not rule.name:
+        raise ValueError(f"{rule_cls.__name__} has no name")
+    RULES[rule.name] = rule
+    return rule_cls
+
+
+# --------------------------------------------------------------------- context
+class FileContext:
+    """One parsed file: source, lines, AST, and per-line suppressions."""
+
+    def __init__(self, project: "ProjectContext", abspath: str, relpath: str):
+        self.project = project
+        self.path = abspath
+        self.relpath = relpath
+        with open(abspath, encoding="utf-8") as f:
+            self.source = f.read()
+        self.lines = self.source.splitlines()
+        self.tree = ast.parse(self.source)  # SyntaxError handled by the runner
+        self._suppressions = None
+
+    def line_content(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+    def suppressions(self) -> dict:
+        """lineno -> set of rule names (or {'all'}) suppressed on that line."""
+        if self._suppressions is None:
+            out = {}
+            for i, line in enumerate(self.lines, 1):
+                m = _SUPPRESS_RE.search(line)
+                if m:
+                    out[i] = {r.strip() for r in m.group(1).split(",")
+                              if r.strip()}
+            self._suppressions = out
+        return self._suppressions
+
+    def finding(self, rule: Rule, node, message: str,
+                severity: str | None = None) -> Finding:
+        """Build a Finding anchored at an AST node (or explicit line int)."""
+        line = node if isinstance(node, int) else getattr(node, "lineno", 1)
+        col = 0 if isinstance(node, int) else getattr(node, "col_offset", 0)
+        return Finding(rule=rule.name, path=self.relpath, line=line, col=col,
+                       message=message, severity=severity or rule.severity,
+                       content=self.line_content(line))
+
+    # ------------------------------------------------------------ import map
+    def import_aliases(self) -> dict:
+        """Top-of-file import table: local alias -> dotted module path.
+
+        ``import numpy as np`` -> {'np': 'numpy'};
+        ``from ..framework import random as _random`` -> {'_random':
+        '..framework.random'} — lets rules tell stdlib ``random`` apart from
+        the framework's sanctioned PRNG of the same trailing name.
+        """
+        aliases = {}
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    aliases[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = ("." * node.level) + (node.module or "")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    aliases[a.asname or a.name] = f"{mod}.{a.name}"
+        return aliases
+
+
+class ProjectContext:
+    """Repo-level facts shared by rules: the declared mesh axes and the
+    exported-name surfaces.  Everything is parsed from source with ``ast`` —
+    nothing is imported."""
+
+    #: Where the mesh axes are declared.  A rename here must fail lint, not a
+    #: pod run — so the collective-axis rule reads THIS file, not a copy.
+    TOPOLOGY_RELPATH = "paddle_tpu/distributed/topology.py"
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        self._mesh_axes = -1  # unset sentinel
+        self._export_cache = {}
+
+    # ------------------------------------------------------------- mesh axes
+    def mesh_axes(self):
+        """frozenset of axis names from topology.py's ``AXIS_ORDER``, or
+        ``None`` when the file/assignment is absent (validation skipped)."""
+        if self._mesh_axes != -1:
+            return self._mesh_axes
+        self._mesh_axes = None
+        path = os.path.join(self.root, *self.TOPOLOGY_RELPATH.split("/"))
+        try:
+            with open(path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return None
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "AXIS_ORDER":
+                        try:
+                            val = ast.literal_eval(node.value)
+                        except ValueError:
+                            continue
+                        if isinstance(val, (tuple, list)) and all(
+                                isinstance(v, str) for v in val):
+                            self._mesh_axes = frozenset(val)
+        return self._mesh_axes
+
+    # -------------------------------------------------------- export surface
+    def exported_names(self, relpath: str):
+        """Names of module ``relpath`` that are part of an ``__init__``
+        surface: imported by the sibling ``__init__.py``, listed in the
+        module's own ``__all__``, or (for an ``__init__.py`` itself) defined
+        publicly at top level."""
+        if relpath in self._export_cache:
+            return self._export_cache[relpath]
+        exported = set()
+        abspath = os.path.join(self.root, *relpath.split("/"))
+        modname = os.path.splitext(os.path.basename(relpath))[0]
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            tree = None
+        if tree is not None:
+            exported |= self._own_all(tree)
+            if modname == "__init__":
+                exported |= {n.name for n in tree.body
+                             if isinstance(n, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef,
+                                               ast.ClassDef))
+                             and not n.name.startswith("_")}
+        init = os.path.join(os.path.dirname(abspath), "__init__.py")
+        if modname != "__init__" and os.path.exists(init):
+            exported |= self._init_imports(init, modname, tree)
+        out = frozenset(exported)
+        self._export_cache[relpath] = out
+        return out
+
+    @staticmethod
+    def _own_all(tree) -> set:
+        for node in tree.body:
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and tgt.id == "__all__":
+                        try:
+                            val = ast.literal_eval(node.value)
+                        except ValueError:
+                            return set()
+                        return {v for v in val if isinstance(v, str)}
+        return set()
+
+    @staticmethod
+    def _init_imports(init_path: str, modname: str, modtree) -> set:
+        """Names the package __init__ pulls from sibling module ``modname``."""
+        try:
+            with open(init_path, encoding="utf-8") as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            return set()
+        out = set()
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ImportFrom) or node.level == 0:
+                continue
+            if (node.module or "").split(".")[0] != modname:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    if modtree is not None:
+                        out |= {n.name for n in modtree.body
+                                if isinstance(n, (ast.FunctionDef,
+                                                  ast.AsyncFunctionDef,
+                                                  ast.ClassDef))
+                                and not n.name.startswith("_")}
+                else:
+                    out.add(a.name)
+        return out
+
+
+# -------------------------------------------------------------------- baseline
+def load_baseline(path: str):
+    """Parse + validate the baseline file.  Each entry: ``rule``, ``path``,
+    one of ``content`` (exact stripped line) or ``match`` (regex over the
+    line), and a non-empty ``justification`` that is not a TODO stub."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            entries = json.load(f)
+    except OSError as e:
+        raise BaselineError(f"cannot read baseline {path}: {e}")
+    except ValueError as e:
+        raise BaselineError(f"baseline {path} is not valid JSON: {e}")
+    if not isinstance(entries, list):
+        raise BaselineError(f"baseline {path}: expected a JSON list")
+    for i, e in enumerate(entries):
+        if not isinstance(e, dict) or "rule" not in e or "path" not in e:
+            raise BaselineError(
+                f"baseline {path} entry {i}: needs 'rule' and 'path'")
+        if ("content" in e) == ("match" in e):
+            raise BaselineError(
+                f"baseline {path} entry {i} ({e.get('rule')}): needs exactly "
+                f"one of 'content' or 'match'")
+        # an empty content would match EVERY finding of that rule+path —
+        # current and future — silently defeating the gate
+        if not (e.get("content", "x") or "").strip():
+            raise BaselineError(
+                f"baseline {path} entry {i} ({e.get('rule')} @ "
+                f"{e.get('path')}): 'content' must be the non-empty "
+                f"stripped source line (or the finding's message for "
+                f"project rules)")
+        just = (e.get("justification") or "").strip()
+        if not just or just.upper().startswith("TODO"):
+            raise BaselineError(
+                f"baseline {path} entry {i} ({e.get('rule')} @ "
+                f"{e.get('path')}): every baseline entry must carry a "
+                f"one-line justification (found: {just!r})")
+        if "match" in e:
+            # an empty regex matches every line — same gate-defeating
+            # blanket as empty content
+            if not (e["match"] or "").strip():
+                raise BaselineError(
+                    f"baseline {path} entry {i} ({e.get('rule')} @ "
+                    f"{e.get('path')}): 'match' must be a non-empty regex")
+            try:
+                re.compile(e["match"])
+            except re.error as err:
+                raise BaselineError(
+                    f"baseline {path} entry {i}: bad regex: {err}")
+    return entries
+
+
+def apply_baseline(findings, entries):
+    """Split findings into (kept, baselined); also return entries that
+    matched nothing (stale — candidates for deletion)."""
+    used = [False] * len(entries)
+
+    def matches(entry, f: Finding) -> bool:
+        if entry["rule"] != f.rule or entry["path"] != f.path:
+            return False
+        if "content" in entry:
+            return entry["content"] == f.content
+        return re.search(entry["match"], f.content) is not None
+
+    kept, baselined = [], []
+    for f in findings:
+        hit = False
+        for i, e in enumerate(entries):
+            if matches(e, f):
+                used[i] = hit = True
+                break
+        (baselined if hit else kept).append(f)
+    unused = [e for i, e in enumerate(entries) if not used[i]]
+    return kept, baselined, unused
+
+
+# ---------------------------------------------------------------------- runner
+def _iter_py_files(target: str):
+    if os.path.isfile(target):
+        yield target
+        return
+    for dirpath, dirnames, filenames in os.walk(target):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != "__pycache__" and not d.startswith("."))
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def _selected(rule: Rule, select, ignore) -> bool:
+    if select is not None and rule.name not in select:
+        return False
+    if ignore is not None and rule.name in ignore:
+        return False
+    return True
+
+
+def run_project(root: str, paths=None, select=None, ignore=None,
+                project_rules: bool = True):
+    """Lint ``paths`` (files/dirs, default: the whole root) and return the
+    sorted post-suppression findings.  Baseline application is the driver's
+    job — this returns everything a human could be asked about."""
+    root = os.path.abspath(root)
+    project = ProjectContext(root)
+    targets = [os.path.join(root, p) if not os.path.isabs(p) else p
+               for p in (paths or [root])]
+    file_rules = [r for r in RULES.values()
+                  if isinstance(r, FileRule) and _selected(r, select, ignore)]
+    findings = []
+    seen = set()
+    for target in targets:
+        for abspath in _iter_py_files(target):
+            if abspath in seen:
+                continue
+            seen.add(abspath)
+            relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
+            try:
+                ctx = FileContext(project, abspath, relpath)
+            except (SyntaxError, ValueError, OSError) as e:
+                # OSError: broken symlink / perms / deleted mid-walk — one
+                # unreadable file must not abort the whole run
+                findings.append(Finding(
+                    rule="parse-error", path=relpath,
+                    line=getattr(e, "lineno", 1) or 1, col=0,
+                    message=f"cannot read/parse: {e}", severity="error"))
+                continue
+            file_findings = []
+            for rule in file_rules:
+                if rule.applies_to(relpath):
+                    file_findings.extend(rule.check(ctx))
+            sup = ctx.suppressions()
+            for f in file_findings:
+                on_line = sup.get(f.line, ())
+                if f.rule in on_line or "all" in on_line:
+                    continue
+                findings.append(f)
+    if project_rules:
+        for rule in RULES.values():
+            if isinstance(rule, ProjectRule) and _selected(rule, select,
+                                                           ignore):
+                findings.extend(rule.check_project(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+# ------------------------------------------------------------------- reporters
+def render_text(findings, baselined_count: int = 0, unused_baseline=None):
+    lines = [f.render() for f in findings]
+    fail = [f for f in findings if f.severity in ("error", "warning")]
+    tail = (f"tpulint: {len(fail)} finding(s)"
+            if fail else "tpulint: clean")
+    if baselined_count:
+        tail += f" ({baselined_count} baselined)"
+    for e in (unused_baseline or []):
+        lines.append(f"note: stale baseline entry matched nothing: "
+                     f"{e['rule']} @ {e['path']}")
+    lines.append(tail)
+    return "\n".join(lines)
+
+
+def render_json(findings, baselined_count: int = 0, unused_baseline=None):
+    counts = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        counts[f.severity] = counts.get(f.severity, 0) + 1
+    return json.dumps({
+        "version": 1,
+        "findings": [f.to_dict() for f in findings],
+        "counts": counts,
+        "baselined": baselined_count,
+        "stale_baseline_entries": list(unused_baseline or []),
+    }, indent=2, sort_keys=True)

@@ -1,0 +1,28 @@
+"""tpulint built-in rule suite.  Importing this package registers every rule
+with the engine registry (``paddle_tpu.analysis.engine.RULES``).
+
+Catalogue (see README §Static analysis for the operator-facing version):
+
+====================  ========  =================================================
+rule                  severity  polices
+====================  ========  =================================================
+host-sync-in-jit      error     .item()/float()/np.asarray() on traced values
+impure-trace          error     time/random/global state baked into a trace;
+                                wall-clock time.time() anywhere (warning)
+collective-axis       error     literal mesh-axis names vs topology.AXIS_ORDER
+donation-misuse       error     donated buffers read after the jitted call
+dtype-drift           warning   f32 upcasts materialized in bf16 hot paths
+silent-noop           warning   exported functions whose body does nothing
+bare-except-swallow   error     swallowed faults in the recovery paths
+metrics-catalogue     error     metric namespace vs README catalogue (PR 2)
+docs-stale            warning   PROJECTION.md cites the newest BENCH round
+====================  ========  =================================================
+"""
+from . import bare_except      # noqa: F401
+from . import catalogues       # noqa: F401
+from . import collective_axis  # noqa: F401
+from . import donation         # noqa: F401
+from . import dtype_drift      # noqa: F401
+from . import host_sync        # noqa: F401
+from . import impure_trace     # noqa: F401
+from . import silent_noop      # noqa: F401

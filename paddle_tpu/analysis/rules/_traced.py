@@ -1,0 +1,96 @@
+"""Shared helper: which functions in a module get TRACED by jax?
+
+A function body runs under tracing when it is
+
+- decorated with a jit-family decorator (``@jax.jit``, ``@partial(jax.jit,
+  static_argnums=...)``, ``@paddle.jit.to_static``), or
+- passed by name (or as a lambda / ``partial(fn, ...)``) into a tracing
+  entry point — ``jax.jit(fn)``, ``shard_map(fn)``, ``pl.pallas_call(kernel)``,
+  ``lax.scan(body, ...)``, ``jax.grad(f)`` — anywhere in the module, or
+- *defined inside* such a function: closures like the decode ``tick`` in
+  llm_server execute during the enclosing trace.
+
+This is a deliberate over-approximation by lexical span: everything between a
+traced function's first and last line is treated as traced.  Rules that only
+make sense on traced values (host-sync, impurity) use :func:`in_traced`.
+"""
+from __future__ import annotations
+
+import ast
+
+#: Call/decorator names whose function-valued arguments are traced.  The
+#: trailing attribute is matched (``jax.jit``, ``jax.experimental.pjit.pjit``
+#: and a bare ``jit`` all end in the same segment).
+TRACE_ENTRY_NAMES = frozenset({
+    "jit", "pjit", "shard_map", "pallas_call", "to_static",
+    "grad", "value_and_grad", "vjp", "jvp", "linearize",
+    "vmap", "pmap", "scan", "while_loop", "fori_loop", "cond", "switch",
+    "remat", "checkpoint", "custom_vjp", "custom_jvp",
+})
+
+
+def callee_name(func) -> str:
+    """Trailing segment of a call target: ``jax.lax.psum`` -> ``psum``."""
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return ""
+
+
+def _unwrap_partial(node):
+    """``partial(fn, ...)`` -> ``fn``; anything else unchanged."""
+    if (isinstance(node, ast.Call) and callee_name(node.func) == "partial"
+            and node.args):
+        return node.args[0]
+    return node
+
+
+def traced_spans(tree):
+    """Return the list of function/lambda nodes whose bodies are traced."""
+    defs = {}
+    spans = []
+    seen = set()
+
+    def add(node):
+        if id(node) not in seen:
+            seen.add(id(node))
+            spans.append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                names = {callee_name(dec)}
+                if isinstance(dec, ast.Call):
+                    names.add(callee_name(dec.func))
+                    inner = _unwrap_partial(dec)
+                    if inner is not dec:
+                        names.add(callee_name(inner))
+                        names.add(callee_name(getattr(inner, "func", inner)))
+                if names & TRACE_ENTRY_NAMES:
+                    add(node)
+
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and callee_name(node.func) in TRACE_ENTRY_NAMES):
+            continue
+        for arg in node.args:
+            arg = _unwrap_partial(arg)
+            if isinstance(arg, ast.Lambda):
+                add(arg)
+            elif isinstance(arg, ast.Name):
+                for d in defs.get(arg.id, ()):
+                    add(d)
+    return spans
+
+
+def in_traced(node, spans) -> bool:
+    """Is ``node`` lexically inside any traced function's span?"""
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return False
+    for s in spans:
+        if s.lineno <= line <= (getattr(s, "end_lineno", None) or s.lineno):
+            return True
+    return False

@@ -1,0 +1,75 @@
+"""bare-except-swallow: exception swallowing in the recovery paths.
+
+The fault-tolerance layer's whole contract is that failures are *diagnosed*:
+``run_with_recovery`` needs the real exception to decide retry-vs-raise, the
+checkpoint loader needs it to quarantine the right step.  A bare ``except:``
+(which also eats ``KeyboardInterrupt``/``SystemExit``) or an
+``except Exception: pass`` in these files turns a diagnosable fault into a
+silent hang one layer up — the exact failure mode PR 1 was built to kill.
+
+Scope is the recovery surface only (fault_tolerance, llm_server, store,
+checkpoint): elsewhere a narrow swallowed exception can be a legitimate
+best-effort cleanup.  Stays clean by design: handlers that re-raise, log,
+record metrics, or catch a NARROW type (``except OSError: pass`` around an
+advisory write is fine — the type itself documents the intent).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+
+#: The recovery surface — files whose except-handlers make retry decisions.
+RECOVERY_PATHS = (
+    "paddle_tpu/distributed/fault_tolerance.py",
+    "paddle_tpu/distributed/store.py",
+    "paddle_tpu/distributed/checkpoint.py",
+    "paddle_tpu/inference/llm_server.py",
+)
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _swallows(handler) -> bool:
+    """Body does nothing with the exception: only pass/continue/constants."""
+    return all(
+        isinstance(s, (ast.Pass, ast.Continue))
+        or (isinstance(s, ast.Expr) and isinstance(s.value, ast.Constant))
+        for s in handler.body)
+
+
+@register
+class BareExceptSwallowRule(FileRule):
+    name = "bare-except-swallow"
+    severity = "error"
+    description = (
+        "bare except (error) or `except Exception: pass` (warning) in "
+        "recovery paths — turns diagnosable faults into silent hangs")
+    paths = RECOVERY_PATHS
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                out.append(ctx.finding(
+                    self, node,
+                    "bare except in a recovery path — also catches "
+                    "KeyboardInterrupt/SystemExit; name the exception types "
+                    "the recovery decision actually handles",
+                    severity="error"))
+                continue
+            types = (list(node.type.elts)
+                     if isinstance(node.type, ast.Tuple) else [node.type])
+            tnames = [t.attr if isinstance(t, ast.Attribute)
+                      else getattr(t, "id", None) for t in types]
+            tname = next((n for n in tnames if n in _BROAD), None)
+            if tname is not None and _swallows(node):
+                out.append(ctx.finding(
+                    self, node,
+                    f"'except {tname}' swallows the fault in a recovery "
+                    f"path — re-raise, log, or narrow the type; baseline "
+                    f"with a justification if the swallow is load-bearing",
+                    severity="warning"))
+        return out

@@ -1,0 +1,98 @@
+"""Project-level catalogue rules: the non-AST lints unified under tpulint.
+
+- ``metrics-catalogue`` — PR 2's ``tools/metrics_lint.py`` registered as a
+  tpulint rule so CI has ONE lint entrypoint.  The old CLI remains as a thin
+  shim; the logic (registry walk vs README §Observability catalogue) still
+  lives in tools/metrics_lint.py and is loaded from there, so the two
+  entrypoints cannot drift.
+- ``docs-stale`` — ``tools/docs_lint.py``: PROJECTION.md must cite the
+  newest ``BENCH_r*.json`` round; a stale citation means the pod projections
+  are anchored to superseded measurements.
+
+Both degrade to a ``note`` (never fails the build) when their inputs are
+absent — fixture trees and installed-package environments have no tools/
+directory, and the metrics rule needs the live package importable.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+from ..engine import Finding, ProjectRule, register
+
+
+def _load_tool(root: str, filename: str, modname: str):
+    """Import a tools/ script by path (tools/ is not a package).  The cache
+    key includes the root: one process may lint several trees (fixture tests,
+    a daemon over two checkouts) and must not serve rootA's module to
+    rootB."""
+    path = os.path.join(root, "tools", filename)
+    if not os.path.exists(path):
+        return None
+    modname = f"{modname}_{abs(hash(os.path.abspath(root))):x}"
+    if modname in sys.modules:
+        return sys.modules[modname]
+    spec = importlib.util.spec_from_file_location(modname, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[modname] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@register
+class MetricsCatalogueRule(ProjectRule):
+    name = "metrics-catalogue"
+    severity = "error"
+    description = (
+        "metric namespace lint (tools/metrics_lint.py): snake_case, unit "
+        "suffix, README §Observability catalogue membership")
+
+    def check_project(self, project):
+        ml = _load_tool(project.root, "metrics_lint.py", "_tpulint_metrics")
+        if ml is None:
+            return []  # not a repo checkout — nothing to police
+        readme = os.path.join(project.root, "README.md")
+        try:
+            registry = ml.import_instrumented(project.root)
+        except Exception as e:  # package unimportable: report, don't crash
+            return [Finding(
+                rule=self.name, path="tools/metrics_lint.py", line=1, col=0,
+                message=f"skipped: cannot import the instrumented package "
+                        f"({type(e).__name__}: {e})", severity="note")]
+        # `import paddle_tpu` is cached process-wide: if an EARLIER lint (or
+        # the host app) imported it from a different checkout, this registry
+        # does not describe project.root — say so instead of mis-linting
+        pkg = sys.modules.get("paddle_tpu")
+        pkg_file = getattr(pkg, "__file__", None)
+        if pkg_file and os.path.realpath(os.path.dirname(os.path.dirname(
+                pkg_file))) != os.path.realpath(project.root):
+            return [Finding(
+                rule=self.name, path="tools/metrics_lint.py", line=1, col=0,
+                message=f"skipped: paddle_tpu already imported from "
+                        f"{os.path.dirname(pkg_file)}, not this root — "
+                        f"run `python tools/tpulint.py --select "
+                        f"metrics-catalogue` in a fresh process",
+                severity="note")]
+        # content = message: project findings have no source line, and the
+        # baseline must be able to address ONE finding, not the whole rule
+        return [Finding(rule=self.name, path="README.md", line=1, col=0,
+                        message=err, severity=self.severity, content=err)
+                for err in ml.lint(registry, readme)]
+
+
+@register
+class DocsStaleRule(ProjectRule):
+    name = "docs-stale"
+    severity = "warning"
+    description = (
+        "PROJECTION.md must cite the newest BENCH_r*.json round "
+        "(tools/docs_lint.py)")
+
+    def check_project(self, project):
+        dl = _load_tool(project.root, "docs_lint.py", "_tpulint_docs")
+        if dl is None:
+            return []
+        return [Finding(rule=self.name, path=path, line=line, col=0,
+                        message=msg, severity=self.severity, content=msg)
+                for path, line, msg in dl.check(project.root)]

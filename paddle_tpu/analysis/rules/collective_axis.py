@@ -1,0 +1,118 @@
+"""collective-axis: literal mesh-axis names must exist in the declared mesh.
+
+The hybrid-parallel mesh axes are declared ONCE — ``AXIS_ORDER`` in
+``distributed/topology.py`` — and every ``psum``/``all_gather``/``ppermute``
+references them by string.  XLA does not validate the *intent*: a collective
+over a renamed or misspelled axis raises at best a late shape error and at
+worst silently reduces over the wrong group (EQuARX's observation: collective
+layout mistakes cost silently).  This rule makes the rename fail lint, not a
+pod run.
+
+Checked: string-literal axis arguments (positional or ``axis``/
+``axis_name=``) of collective calls, and string defaults of parameters named
+``axis``/``axis_name``/``*_axis``.  Variables are not resolved — a
+non-literal axis is the caller's contract, not this file's.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+from ._traced import callee_name
+
+#: Collectives (and the process-group constructor) whose axis argument names
+#: a mesh axis.  ``axis_index`` included: it burns the axis name into the
+#: program the same way.
+COLLECTIVE_CALLS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "psum_scatter",
+    "all_gather", "all_gather_invariant", "ppermute", "pshuffle",
+    "all_to_all", "axis_index", "new_group",
+})
+
+#: Parameter-name suffixes whose string defaults are mesh axes.
+_AXIS_PARAM = ("axis_name", "axis")
+
+
+def _axis_param_name(name: str) -> bool:
+    return name in _AXIS_PARAM or name.endswith("_axis")
+
+
+def _literal_axes(node):
+    """Axis names in a literal str / tuple / list node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+        return out or None
+    return None
+
+
+@register
+class CollectiveAxisRule(FileRule):
+    name = "collective-axis"
+    severity = "error"
+    description = (
+        "psum/pmean/all_gather/ppermute axis names (and *_axis parameter "
+        "defaults) must match the mesh axes declared in "
+        "distributed/topology.py AXIS_ORDER")
+
+    def check(self, ctx):
+        axes = ctx.project.mesh_axes()
+        if not axes:
+            return []  # no declared mesh in this tree — nothing to validate
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call):
+                out.extend(self._check_call(ctx, node, axes))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_defaults(ctx, node, axes))
+        return out
+
+    def _check_call(self, ctx, node, axes):
+        if callee_name(node.func) not in COLLECTIVE_CALLS:
+            return []
+        callee = callee_name(node.func)
+        # keyword candidates that actually carry literal axis names;
+        # all_gather/all_to_all's `axis=` keyword is an INT array dimension,
+        # so a non-literal keyword must not shadow the positional mesh axis
+        candidates = [kw.value for kw in node.keywords
+                      if kw.arg in ("axis_name", "axis")
+                      and _literal_axes(kw.value)]
+        if not candidates:
+            if callee == "axis_index" and node.args:
+                candidates.append(node.args[0])  # axis_index(axis_name)
+            elif len(node.args) >= 2 and callee != "new_group":
+                candidates.append(node.args[1])  # lax convention: (x, axis)
+        return self._validate(ctx, node, candidates, axes,
+                              f"collective {callee_name(node.func)}()")
+
+    def _check_defaults(self, ctx, node, axes):
+        args = node.args
+        pos = list(args.posonlyargs) + list(args.args)
+        named = pos + list(args.kwonlyargs)
+        defaults = ([None] * (len(pos) - len(args.defaults))
+                    + list(args.defaults) + list(args.kw_defaults))
+        out = []
+        for a, d in zip(named, defaults):
+            if d is not None and _axis_param_name(a.arg):
+                out.extend(self._validate(
+                    ctx, d, [d], axes, f"default of parameter '{a.arg}'"))
+        return out
+
+    def _validate(self, ctx, node, candidates, axes, where):
+        out = []
+        for cand in candidates:
+            names = _literal_axes(cand)
+            if not names:
+                continue
+            for name in names:
+                if name not in axes:
+                    out.append(ctx.finding(
+                        self, node,
+                        f"unknown mesh axis '{name}' in {where} — declared "
+                        f"axes are {sorted(axes)} "
+                        f"(distributed/topology.py AXIS_ORDER)"))
+        return out

@@ -1,0 +1,119 @@
+"""donation-misuse: a donated buffer read after the jitted call.
+
+``donate_argnums`` hands the argument's buffer to XLA for reuse; the Python
+reference left behind is poison — reading it after the call returns garbage
+or raises ``BufferDonationError`` only on some platforms/layouts, i.e. it
+works on CPU tests and corrupts on TPU pods.
+
+Statically tractable slice handled here: the jitted callable is bound to a
+simple name or ``self.attr`` with a LITERAL ``donate_argnums``, and a call
+site passes a plain name / ``self.attr`` in a donated position.  The rule
+fires when that expression is loaded again later in the same function body
+without an intervening rebind.  Rebinding the result over the donated input
+(``state = step(state)``) is the sanctioned idiom and stays clean; variable
+``donate_argnums`` values are skipped (not resolvable without execution).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+from ._traced import callee_name
+
+
+def _expr_key(node):
+    """Stable key for a donated-arg expression: Name or self.attr chains."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        base = _expr_key(node.value)
+        return f"{base}.{node.attr}" if base else None
+    return None
+
+
+def _donated_indices(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            try:
+                val = ast.literal_eval(kw.value)
+            except ValueError:
+                return None  # non-literal: skip, can't resolve statically
+            if isinstance(val, int):
+                return (val,)
+            if isinstance(val, (tuple, list)):
+                return tuple(v for v in val if isinstance(v, int))
+    return None
+
+
+@register
+class DonationMisuseRule(FileRule):
+    name = "donation-misuse"
+    severity = "error"
+    description = (
+        "argument in a donate_argnums position read after the jitted call "
+        "in the same scope — donated buffers are invalidated by XLA")
+
+    def check(self, ctx):
+        # jitted-callable binding (name or self.attr) -> donated index tuple
+        donators = {}
+        for node in ast.walk(ctx.tree):
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.value, ast.Call)
+                    and callee_name(node.value.func) in ("jit", "pjit")):
+                idxs = _donated_indices(node.value)
+                key = _expr_key(node.targets[0])
+                if idxs and key:
+                    donators[key] = idxs
+        if not donators:
+            return []
+        out = []
+        for fn in ast.walk(ctx.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(self._check_scope(ctx, fn, donators))
+        # nested defs are walked by both their own and the enclosing scope
+        return list(dict.fromkeys(out))
+
+    def _check_scope(self, ctx, fn, donators):
+        """Linear scan of one function body for donated-then-read args."""
+        calls = []  # (call node, donated arg key)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            key = _expr_key(node.func)
+            idxs = donators.get(key)
+            if not idxs:
+                continue
+            for i in idxs:
+                if i < len(node.args):
+                    akey = _expr_key(node.args[i])
+                    if akey:
+                        calls.append((node, akey, i))
+        if not calls:
+            return []
+        out = []
+        for call, akey, idx in calls:
+            rebind_line = None
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (node.targets
+                               if isinstance(node, ast.Assign)
+                               else [node.target])
+                    if any(_expr_key(t) == akey for t in targets) \
+                            and node.lineno >= call.lineno:
+                        if rebind_line is None or node.lineno < rebind_line:
+                            rebind_line = node.lineno
+            for node in ast.walk(fn):
+                if (isinstance(node, (ast.Name, ast.Attribute))
+                        and isinstance(getattr(node, "ctx", None), ast.Load)
+                        and _expr_key(node) == akey
+                        and node.lineno > call.lineno
+                        and (rebind_line is None
+                             or node.lineno < rebind_line)):
+                    out.append(ctx.finding(
+                        self, node,
+                        f"'{akey}' was donated (donate_argnums index {idx}) "
+                        f"to the jitted call at line {call.lineno} and is "
+                        f"read here — the buffer may already be reused by "
+                        f"XLA; rebind the call's result instead"))
+                    break  # one finding per donated call is enough
+        return out

@@ -1,0 +1,88 @@
+"""dtype-drift: f32 upcasts materialized inside bf16 hot paths.
+
+The bf16 training/serving paths (``ops/``, the sharded train step) budget
+HBM bandwidth and MXU throughput for 2-byte activations.  An ``astype(
+jnp.float32)`` on a traced tensor silently doubles the tensor's footprint and
+drags every consumer up to f32 — XLA will compile it happily and the step
+just gets slower (the paper's MFU floor erodes with no error anywhere).
+
+Flagged: ``.astype(float32)`` (attribute or "float32" string form) and
+``asarray/array(x, float32)`` on non-constant ``x`` inside the configured
+hot paths.  Severity is warning: legitimate precision choices exist (bwd-pass
+softmax statistics, loss accumulation) and live in the baseline with a
+one-line justification each.
+
+Sanctioned idioms that stay CLEAN by design (the documented false-positive
+surface):
+
+- ``preferred_element_type=jnp.float32`` — MXU accumulation dtype without
+  materializing f32 tensors: the right way to get f32 accuracy in bf16 paths;
+- f32 *creation* of scratch accumulators: ``jnp.zeros(shape, jnp.float32)``,
+  ``jnp.full(...)`` — online-softmax/loss state is supposed to be f32;
+- casts *down* (``.astype(jnp.bfloat16)``, ``.astype(x.dtype)``).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+
+#: bf16-annotated hot paths (root-relative prefixes).
+BF16_PATHS = (
+    "paddle_tpu/ops/",
+    "paddle_tpu/distributed/sharded_train_step.py",
+)
+
+
+def _is_f32(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value == "float32"
+    if isinstance(node, ast.Attribute) and node.attr == "float32":
+        base = node.value
+        return isinstance(base, ast.Name) and base.id in ("jnp", "np",
+                                                          "numpy", "jax")
+    return False
+
+
+@register
+class DtypeDriftRule(FileRule):
+    name = "dtype-drift"
+    severity = "warning"
+    description = (
+        "astype(float32)/asarray(x, float32) inside bf16 hot paths "
+        "(ops/, sharded_train_step) — materialized f32 doubles HBM traffic; "
+        "use preferred_element_type or baseline deliberate precision "
+        "choices")
+    paths = BF16_PATHS
+
+    def check(self, ctx):
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if (isinstance(func, ast.Attribute) and func.attr == "astype"
+                    and len(node.args) == 1 and _is_f32(node.args[0])):
+                out.append(ctx.finding(
+                    self, node,
+                    "f32 upcast materialized in a bf16 hot path — prefer "
+                    "preferred_element_type for accumulation, downcast on "
+                    "store, or baseline with the precision rationale"))
+                continue
+            if isinstance(func, ast.Attribute) and func.attr in ("asarray",
+                                                                 "array"):
+                dtype_arg = None
+                if len(node.args) >= 2:
+                    dtype_arg = node.args[1]
+                for kw in node.keywords:
+                    if kw.arg == "dtype":
+                        dtype_arg = kw.value
+                if (dtype_arg is not None and _is_f32(dtype_arg)
+                        and node.args
+                        and not isinstance(node.args[0], ast.Constant)):
+                    out.append(ctx.finding(
+                        self, node,
+                        f"{func.attr}(..., float32) materializes f32 in a "
+                        f"bf16 hot path — baseline with the precision "
+                        f"rationale if deliberate"))
+        return out

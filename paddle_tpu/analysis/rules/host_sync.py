@@ -1,0 +1,110 @@
+"""host-sync-in-jit: device→host synchronization in traced or hot-path code.
+
+Inside a traced function, ``.item()`` / ``float()`` / ``np.asarray()`` on a
+traced value either breaks tracing outright or — worse — silently bakes a
+host round-trip into every step.  In the serving/training hot-path modules the
+same calls are legal but each one stalls the dispatch pipeline, so they are
+reported as warnings and the deliberate ones live in the baseline with a
+justification (e.g. llm_server's host-side admission-token sampling).
+
+Documented false positives that stay clean:
+
+- ``int(x.shape[0])`` / ``float(q.shape[-1])`` — static shape math, resolved
+  at trace time, no sync (anything mentioning ``.shape``/``.ndim``/``.size``/
+  ``len()`` is exempt);
+- ``jnp.asarray(...)`` — device-side, only ``np.asarray``/``np.array`` sync;
+- ``.item()`` in ordinary eager helpers outside traced spans and hot paths;
+- ``int(accum_steps)`` / ``float(req.temperature)`` in hot-path modules —
+  ``int()``/``float()``/``bool()`` on host config values is not a sync, so
+  the builtin-cast check applies only INSIDE traced spans (where the
+  argument is a tracer and the cast forces concretization).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+from ._traced import callee_name, in_traced, traced_spans
+
+#: Method calls that force a device→host transfer.
+SYNC_METHODS = frozenset({"item", "numpy", "tolist", "block_until_ready"})
+
+#: Serving/training hot paths where even host-legal syncs are budget items.
+HOT_PATHS = (
+    "paddle_tpu/distributed/sharded_train_step.py",
+    "paddle_tpu/inference/llm_server.py",
+    "paddle_tpu/models/generation.py",
+)
+
+_SHAPE_WORDS = frozenset({"shape", "ndim", "size", "dtype"})
+
+
+def _is_shape_math(node) -> bool:
+    """True when the expression is static-shape arithmetic (no sync)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _SHAPE_WORDS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _SHAPE_WORDS:
+            return True
+        if isinstance(sub, ast.Call) and callee_name(sub.func) == "len":
+            return True
+    return False
+
+
+@register
+class HostSyncRule(FileRule):
+    name = "host-sync-in-jit"
+    severity = "error"
+    description = (
+        ".item()/float()/int()/np.asarray()/.block_until_ready() on traced "
+        "values inside jit/pjit/shard_map (error), or in serving/training "
+        "hot-path modules (warning)")
+
+    def check(self, ctx):
+        spans = traced_spans(ctx.tree)
+        hot = any(ctx.relpath == p or ctx.relpath.startswith(p)
+                  for p in HOT_PATHS)
+        aliases = ctx.import_aliases()
+        np_names = {a for a, mod in aliases.items() if mod == "numpy"}
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            traced = in_traced(node, spans)
+            what = self._sync_kind(node, np_names, include_casts=traced)
+            if what is None:
+                continue
+            if traced:
+                out.append(ctx.finding(
+                    self, node,
+                    f"{what} inside a traced function — forces a host sync "
+                    f"at trace time or breaks tracing; hoist it out of the "
+                    f"jitted step", severity="error"))
+            elif hot:
+                out.append(ctx.finding(
+                    self, node,
+                    f"{what} in a hot-path module — each call stalls device "
+                    f"dispatch; baseline with a justification if the sync "
+                    f"is deliberate", severity="warning"))
+        return out
+
+    @staticmethod
+    def _sync_kind(node: ast.Call, np_names, include_casts: bool):
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in SYNC_METHODS:
+                return f".{func.attr}()"
+            if (isinstance(func.value, ast.Name) and func.value.id in np_names
+                    and func.attr in ("asarray", "array")):
+                return f"{func.value.id}.{func.attr}()"
+            if (func.attr == "device_get"
+                    and isinstance(func.value, ast.Name)
+                    and func.value.id == "jax"):
+                return "jax.device_get()"
+        elif include_casts and isinstance(func, ast.Name) \
+                and func.id in ("float", "int", "bool"):
+            if len(node.args) == 1 and not isinstance(node.args[0],
+                                                      ast.Constant):
+                if not _is_shape_math(node.args[0]):
+                    return f"{func.id}()"
+        return None

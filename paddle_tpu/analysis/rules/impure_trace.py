@@ -1,0 +1,148 @@
+"""impure-trace: host state read inside a traced function, and wall-clock
+discipline everywhere.
+
+Tracing runs the Python body ONCE: ``time.time()``, stdlib ``random``,
+``np.random.*``, ``os.environ`` reads and ``global`` mutation are evaluated at
+trace time and the *result* is burned into the compiled program — every later
+step replays the same "random" number and the same timestamp.  The sanctioned
+randomness path is the framework PRNG (``framework/random.py`` keys threaded
+through the step, ``ops/_prng.py`` inside Pallas kernels); ``jax.random.*`` on
+an explicit key is pure and never flagged.
+
+Module-wide sub-check (warning): ``time.time()`` anywhere in the package.
+Wall clock is not monotonic — NTP slew makes deadlines and durations lie.
+Durations and deadlines must use ``time.monotonic()``/``perf_counter()``;
+genuinely wall-clock timestamps (operator logs, cross-host heartbeats,
+checkpoint metadata) are baselined with a justification.
+
+Documented false positive that stays clean: ``jax.random.normal(key, ...)``
+inside a traced function, and ``from ..framework import random as _random``
+usage — the alias map distinguishes it from stdlib ``random``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+from ._traced import in_traced, traced_spans
+
+#: module-path -> attribute names that are impure under trace (empty set =
+#: any attribute of the module).
+_IMPURE_MODULE_CALLS = {
+    "time": frozenset(),          # any time.* read is a trace-time constant
+    "random": frozenset(),        # stdlib PRNG: hidden global host state
+    "numpy.random": frozenset(),
+    "datetime": frozenset({"now", "utcnow", "today"}),
+    "datetime.datetime": frozenset({"now", "utcnow", "today"}),
+    "datetime.date": frozenset({"today"}),
+    "os": frozenset({"getenv"}),  # os.environ handled as an attribute read
+    "uuid": frozenset({"uuid1", "uuid4"}),
+    "secrets": frozenset(),
+}
+
+
+@register
+class ImpureTraceRule(FileRule):
+    name = "impure-trace"
+    severity = "error"
+    description = (
+        "time.*/random.*/np.random.*/os.environ/global mutation inside "
+        "traced functions (error); wall-clock time.time() anywhere "
+        "(warning — use monotonic clocks for durations/deadlines)")
+
+    def check(self, ctx):
+        spans = traced_spans(ctx.tree)
+        aliases = ctx.import_aliases()
+        out = []
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Global) and in_traced(node, spans):
+                out.append(ctx.finding(
+                    self, node,
+                    f"'global {', '.join(node.names)}' inside a traced "
+                    f"function — mutation happens at trace time, not per "
+                    f"step", severity="error"))
+                continue
+            if self._is_environ_read(node, aliases):
+                if in_traced(node, spans):
+                    out.append(ctx.finding(
+                        self, node,
+                        "os.environ read inside a traced function is "
+                        "evaluated ONCE at trace time and baked into the "
+                        "program; read it on the host and pass the value in",
+                        severity="error"))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = self._resolve(node.func, aliases)
+            if dotted is None:
+                continue
+            mod, attr = dotted
+            impure = self._impure(mod, attr)
+            if impure is None:
+                continue
+            if in_traced(node, spans):
+                out.append(ctx.finding(
+                    self, node,
+                    f"{impure} inside a traced function is evaluated ONCE at "
+                    f"trace time and baked into the program; thread a "
+                    f"framework PRNG key / pass host values as arguments",
+                    severity="error"))
+            elif mod == "time" and attr == "time":
+                out.append(ctx.finding(
+                    self, node,
+                    "wall-clock time.time() — use time.monotonic()/"
+                    "perf_counter() for durations and deadlines; baseline "
+                    "with a justification if a wall-clock timestamp is "
+                    "intended", severity="warning"))
+        return out
+
+    @staticmethod
+    def _is_environ_read(node, aliases) -> bool:
+        """Any access spelled through os.environ: subscripts, .get(), plain
+        attribute reads — none of them are Call(os.environ), so the call
+        table can never catch them."""
+        if (isinstance(node, ast.Attribute) and node.attr == "environ"
+                and isinstance(node.value, ast.Name)
+                and aliases.get(node.value.id) == "os"):
+            return True
+        return (isinstance(node, ast.Name)
+                and aliases.get(node.id) == "os.environ")
+
+    @staticmethod
+    def _resolve(func, aliases):
+        """Map a call target to (canonical module path, attr) via the file's
+        import table; None when it cannot be an impure-module call."""
+        if isinstance(func, ast.Attribute):
+            parts = [func.attr]
+            cur = func.value
+            while isinstance(cur, ast.Attribute):
+                parts.append(cur.attr)
+                cur = cur.value
+            if not isinstance(cur, ast.Name):
+                return None
+            base = aliases.get(cur.id)
+            if base is None:
+                return None
+            parts.append(base)
+            dotted = ".".join(reversed(parts))
+            mod, _, attr = dotted.rpartition(".")
+            return mod, attr
+        if isinstance(func, ast.Name):
+            dotted = aliases.get(func.id)
+            if dotted is None:
+                return None
+            mod, _, attr = dotted.rpartition(".")
+            return mod, attr
+        return None
+
+    @staticmethod
+    def _impure(mod: str, attr: str):
+        """Human-readable description when (mod, attr) is impure, else
+        None.  Relative imports (leading dots) never match: the framework's
+        own ``random``/``time`` siblings are sanctioned."""
+        if mod.startswith("."):
+            return None
+        for impure_mod, attrs in _IMPURE_MODULE_CALLS.items():
+            if mod == impure_mod and (not attrs or attr in attrs):
+                return f"{mod}.{attr}()"
+        return None

@@ -1,0 +1,71 @@
+"""silent-noop: exported functions whose body does nothing.
+
+An API that accepts user intent and silently discards it is the worst failure
+mode a framework has (round-1 verdict #10; ``tests/test_no_silent_noops.py``
+pins the semantic cases).  This rule is the static sweep: any function whose
+body is only ``pass`` / ``...`` / bare ``return`` AND whose name is part of
+an ``__init__`` surface (imported by the sibling ``__init__.py``, listed in
+``__all__``, or defined publicly in an ``__init__.py`` itself) is flagged.
+
+Deliberate no-ops are real on TPU (``get_cudnn_version`` — there is no cuDNN)
+and belong in the baseline with the reason.  Stays clean by design: private
+helpers, decorated defs (abstract methods, overloads, registrations), class
+methods (callback hooks like ``on_epoch_begin`` are no-op by contract), and
+functions not reachable from any ``__init__`` surface.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+
+
+def _trivial_body(fn) -> bool:
+    body = fn.body
+    if (body and isinstance(body[0], ast.Expr)
+            and isinstance(body[0].value, ast.Constant)
+            and isinstance(body[0].value.value, str)):
+        body = body[1:]  # docstring
+    if not body:
+        return True
+    if len(body) != 1:
+        return False
+    stmt = body[0]
+    if isinstance(stmt, ast.Pass):
+        return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+        return True  # bare `...`
+    if isinstance(stmt, ast.Return):
+        return stmt.value is None or (
+            isinstance(stmt.value, ast.Constant) and stmt.value.value is None)
+    return False
+
+
+@register
+class SilentNoopRule(FileRule):
+    name = "silent-noop"
+    severity = "warning"
+    description = (
+        "exported function whose body is pass/.../bare return — silently "
+        "discards user intent; raise, implement, or baseline with the "
+        "documented no-op reason")
+
+    def check(self, ctx):
+        exported = None  # computed lazily: most files have no trivial defs
+        out = []
+        for node in ctx.tree.body:  # module level only: the exported surface
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.decorator_list or node.name.startswith("_"):
+                continue
+            if not _trivial_body(node):
+                continue
+            if exported is None:
+                exported = ctx.project.exported_names(ctx.relpath)
+            if node.name in exported:
+                out.append(ctx.finding(
+                    self, node,
+                    f"'{node.name}' is exported but its body is a no-op — "
+                    f"implement it, raise NotImplementedError, or baseline "
+                    f"with the documented no-op reason"))
+        return out

@@ -148,8 +148,10 @@ class ElasticManager:
         return ElasticStatus.HOLD  # wait for nodes to (re)join
 
     def wait_for_np(self, timeout=60) -> bool:
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        # local wait window: monotonic (the heartbeat VALUES stay wall-clock —
+        # they are compared across hosts, which share NTP, not a boot clock)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             if self.min_np <= len(self.hosts()) <= self.max_np:
                 return True
             time.sleep(self.interval / 2)

@@ -231,7 +231,7 @@ class Carrier:
         `timeout` is an IDLE timeout: it resets whenever a result arrives, so
         a long-running but progressing graph never trips it."""
         out = []
-        deadline = time.time() + timeout
+        deadline = time.monotonic() + timeout  # monotonic: NTP-slew-proof
 
         def _collect(tid, payload):
             if isinstance(payload, tuple) and len(payload) == 2 \
@@ -245,11 +245,11 @@ class Carrier:
             try:
                 tid, payload = self.results.get(timeout=0.05)
             except _queue.Empty:
-                if time.time() > deadline:
+                if time.monotonic() > deadline:
                     raise TimeoutError("fleet executor made no progress "
                                        f"for {timeout}s")
                 continue
-            deadline = time.time() + timeout   # progress resets the idle clock
+            deadline = time.monotonic() + timeout  # progress resets the idle clock
             _collect(tid, payload)
         while not self.results.empty():
             _collect(*self.results.get_nowait())

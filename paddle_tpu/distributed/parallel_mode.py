@@ -89,9 +89,9 @@ def gloo_barrier():
     gen = (n - 1) // _gloo_n  # barrier generation this arrival belongs to
     import time
 
-    deadline = time.time() + 300
+    deadline = time.monotonic() + 300  # NTP slew must not shrink the window
     while _gloo_store.add("gloo/barrier", 0) < (gen + 1) * _gloo_n:
-        if time.time() > deadline:
+        if time.monotonic() > deadline:
             raise TimeoutError("gloo_barrier timed out")
         time.sleep(0.01)
 

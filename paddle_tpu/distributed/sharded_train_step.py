@@ -101,6 +101,7 @@ class ShardedTrainStep:
         self.scaler = scaler
         self._scaler_state = None
         self._est_step_flops = None  # filled by compiled_stats()
+        self._peak_flops = None      # device peak, resolved once per process
 
     def _specs(self):
         named = dict(self.model.named_parameters())
@@ -222,13 +223,16 @@ class ShardedTrainStep:
         if self._est_step_flops and dt > 0:
             achieved = self._est_step_flops / dt
             _M_FLOPS_PER_S.set(achieved)
-            from ..cost_model import peak_flops_per_device
+            if self._peak_flops is None:
+                # resolve once: device kind cannot change within the process,
+                # and this sits in the per-step instrumentation path
+                from ..cost_model import peak_flops_per_device
 
+                self._peak_flops = peak_flops_per_device()
             # est_step_flops comes from the per-device SPMD program, so the
             # ratio is already per-device — no mesh-size factor
-            peak = peak_flops_per_device()
-            if peak > 0:
-                _M_MFU.set(achieved / peak)
+            if self._peak_flops > 0:
+                _M_MFU.set(achieved / self._peak_flops)
 
     def __call__(self, *batch):
         if not _obs.enabled():

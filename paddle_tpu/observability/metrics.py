@@ -116,10 +116,12 @@ class _CounterChild:
         self._lock = threading.Lock()
 
     def inc(self, amount=1.0):
-        if not _runtime["enabled"]:
-            return
+        # validate BEFORE the disabled fast path: a negative delta must fail
+        # in CI (metrics off) exactly as it would in production (metrics on)
         if amount < 0:
             raise ValueError("counters only go up; use a Gauge")
+        if not _runtime["enabled"]:
+            return
         with self._lock:
             self._value += amount
 
@@ -138,7 +140,8 @@ class _GaugeChild:
     def set(self, value):
         if not _runtime["enabled"]:
             return
-        self._value = float(value)
+        with self._lock:  # a lock-free set can erase a concurrent inc
+            self._value = float(value)
 
     def inc(self, amount=1.0):
         if not _runtime["enabled"]:
@@ -243,8 +246,11 @@ class _Metric:
         return self._children[()]
 
     def series(self):
-        """[(labelvalues_tuple, child)] in creation order."""
-        return list(self._children.items())
+        """[(labelvalues_tuple, child)] in creation order.  Copied under the
+        lock: a scrape iterating while labels() inserts a first-seen child
+        must not see the dict resize mid-iteration."""
+        with self._lock:
+            return list(self._children.items())
 
 
 class Counter(_Metric):
@@ -254,6 +260,10 @@ class Counter(_Metric):
         return _CounterChild()
 
     def inc(self, amount=1.0):
+        # same order as _CounterChild.inc: validate even when disabled, so a
+        # negative delta fails in metrics-off CI exactly as in production
+        if amount < 0:
+            raise ValueError("counters only go up; use a Gauge")
         if not _runtime["enabled"]:
             return
         self._solo().inc(amount)
@@ -367,10 +377,13 @@ class MetricRegistry:
         return self._metrics.get(name)
 
     def names(self):
-        return list(self._metrics)
+        with self._lock:  # list() during a concurrent register() can resize
+            return list(self._metrics)
 
     def __iter__(self):
-        return iter(self._metrics.values())
+        # locked copy: scrapes race with first-use register() calls
+        with self._lock:
+            return iter(list(self._metrics.values()))
 
     def unregister(self, name):
         with self._lock:
@@ -380,16 +393,20 @@ class MetricRegistry:
         """Zero every series (keep the registered families).  Test hook."""
         with self._lock:
             for m in self._metrics.values():
-                fresh = {}
-                for lv in m._children:
-                    fresh[lv] = m._make_child()
-                m._children = fresh
+                # per-metric lock: labels() may be inserting a first-seen
+                # child concurrently — without it the iteration can see the
+                # dict resize, or the insert lands in the discarded dict
+                with m._lock:
+                    fresh = {}
+                    for lv in m._children:
+                        fresh[lv] = m._make_child()
+                    m._children = fresh
 
     # ---------------------------------------------------------- exposition
     def snapshot(self) -> dict:
         """Plain-dict view of every series (JSON-ready)."""
         out = {}
-        for m in self._metrics.values():
+        for m in self:
             series = []
             for lv, child in m.series():
                 labels = dict(zip(m.labelnames, lv))
@@ -407,7 +424,7 @@ class MetricRegistry:
         """Prometheus/OpenMetrics text exposition — the `/metrics` payload
         (serve it from any HTTP handler; nothing here binds a socket)."""
         lines = []
-        for m in self._metrics.values():
+        for m in self:
             if m.help:
                 lines.append(f"# HELP {m.name} {_escape(m.help)}")
             lines.append(f"# TYPE {m.name} {m.kind}")

@@ -1,0 +1,522 @@
+"""tpulint suite (tier-1): every rule's true positive fires, every documented
+false-positive pattern stays clean, suppressions and the justified baseline
+work, and `tools/tpulint.py --check paddle_tpu` gates the shipped tree.
+
+Fixture trees replicate the package layout (paddle_tpu/ + a topology.py
+declaring AXIS_ORDER) so path-scoped rules and the mesh-axis source resolve
+exactly as they do in the real repo.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from paddle_tpu.analysis import (
+    BaselineError,
+    RULES,
+    apply_baseline,
+    load_baseline,
+    run_project,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TPULINT = os.path.join(REPO, "tools", "tpulint.py")
+
+TOPOLOGY = 'AXIS_ORDER = ("pp", "dp", "sharding", "sep", "mp")\n'
+
+
+def lint_tree(tmp_path, files, **kw):
+    """Write a fixture tree under tmp_path and lint its paddle_tpu/."""
+    files = dict(files)
+    files.setdefault("paddle_tpu/distributed/topology.py", TOPOLOGY)
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    kw.setdefault("project_rules", False)
+    return run_project(str(tmp_path), paths=["paddle_tpu"], **kw)
+
+
+def by_rule(findings, rule):
+    return [f for f in findings if f.rule == rule]
+
+
+# ------------------------------------------------------------- registry shape
+def test_rule_catalogue_registered():
+    for name in ("host-sync-in-jit", "impure-trace", "collective-axis",
+                 "donation-misuse", "dtype-drift", "silent-noop",
+                 "bare-except-swallow", "metrics-catalogue", "docs-stale"):
+        assert name in RULES, f"rule {name} missing from registry"
+
+
+# ------------------------------------------------------------ host-sync-in-jit
+def test_host_sync_fires_in_jitted_fn(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def step(x):\n"
+        "    return x.item() + 1\n"
+        "g = jax.jit(step)\n")})
+    hits = by_rule(out, "host-sync-in-jit")
+    assert len(hits) == 1 and hits[0].line == 3
+    assert hits[0].severity == "error"
+
+
+def test_host_sync_fires_in_decorated_and_nested_fns(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "import numpy as np\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    def inner(y):\n"
+        "        return np.asarray(y)\n"
+        "    return inner(x)\n")})
+    assert len(by_rule(out, "host-sync-in-jit")) == 1
+
+
+def test_host_sync_false_positives_stay_clean(tmp_path):
+    # shape math, jnp.asarray, and eager-code .item() are all sanctioned
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "def step(x):\n"
+        "    n = int(x.shape[0])\n"
+        "    scale = float(x.shape[-1]) ** -0.5\n"
+        "    return jnp.asarray(x) * scale + n\n"
+        "g = jax.jit(step)\n"
+        "def eager_report(t):\n"
+        "    return t.item()\n")})
+    assert by_rule(out, "host-sync-in-jit") == []
+
+
+def test_host_sync_hot_path_warning(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/models/generation.py": (
+        "import numpy as np\n"
+        "def emit(dev):\n"
+        "    return np.asarray(dev)\n")})
+    hits = by_rule(out, "host-sync-in-jit")
+    assert len(hits) == 1 and hits[0].severity == "warning"
+    # int() on host config values in hot paths is NOT a sync — stays clean
+    out = lint_tree(tmp_path, {"paddle_tpu/models/generation.py": (
+        "def cfg(steps):\n"
+        "    return int(steps)\n")})
+    assert by_rule(out, "host-sync-in-jit") == []
+
+
+# ---------------------------------------------------------------- impure-trace
+def test_impure_trace_fires_on_time_random_global(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import time, random\n"
+        "import numpy as np\n"
+        "import jax\n"
+        "_calls = 0\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    global _calls\n"
+        "    t = time.time()\n"
+        "    r = random.random()\n"
+        "    z = np.random.rand(4)\n"
+        "    return x + t + r + z.sum()\n")})
+    hits = by_rule(out, "impure-trace")
+    errors = [f for f in hits if f.severity == "error"]
+    msgs = " ".join(f.message for f in errors)
+    assert len(errors) == 4  # global, time.time, random.random, np.random
+    assert "global _calls" in msgs or "'global" in msgs
+
+
+def test_impure_trace_sanctioned_prng_stays_clean(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "from paddle_tpu.framework import random as _random\n"
+        "@jax.jit\n"
+        "def step(x, key):\n"
+        "    noise = jax.random.normal(key, x.shape)\n"
+        "    k2 = _random.get_rng_key()\n"
+        "    return x + noise + k2[0]\n")})
+    assert by_rule(out, "impure-trace") == []
+
+
+def test_impure_trace_environ_reads_in_trace(tmp_path):
+    # every spelling: subscript, .get(), os.getenv() — none survive tracing
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import os\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    a = os.environ['SEED']\n"
+        "    b = os.environ.get('SEED', '0')\n"
+        "    c = os.getenv('SEED')\n"
+        "    return x\n")})
+    hits = by_rule(out, "impure-trace")
+    assert sorted(f.line for f in hits) == [5, 6, 7]
+    assert all(f.severity == "error" for f in hits)
+    # host-side environ reads (module scope, eager helpers) stay clean
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import os\n"
+        "FLAG = os.environ.get('PADDLE_TPU_FLAG', '')\n"
+        "def host_cfg():\n"
+        "    return os.getenv('PADDLE_TPU_MODE')\n")})
+    assert by_rule(out, "impure-trace") == []
+
+
+def test_impure_trace_wallclock_warning_everywhere(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/util.py": (
+        "import time\n"
+        "def wait():\n"
+        "    deadline = time.time() + 5\n"
+        "    return deadline\n")})
+    hits = by_rule(out, "impure-trace")
+    assert len(hits) == 1 and hits[0].severity == "warning"
+    # monotonic clocks are the fix and stay clean
+    out = lint_tree(tmp_path, {"paddle_tpu/util.py": (
+        "import time\n"
+        "def wait():\n"
+        "    return time.monotonic() + 5\n")})
+    assert by_rule(out, "impure-trace") == []
+
+
+# ------------------------------------------------------------- collective-axis
+def test_collective_axis_typo_fails(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.lax.psum(x, 'dpp')\n")})
+    hits = by_rule(out, "collective-axis")
+    assert len(hits) == 1 and "dpp" in hits[0].message
+    assert "topology" in hits[0].message
+
+
+def test_collective_axis_param_default_and_tuple(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def ring(x, sep_axis='sepp'):\n"
+        "    return x\n"
+        "def g(x):\n"
+        "    return jax.lax.pmean(x, axis_name=('dp', 'shardingg'))\n")})
+    hits = by_rule(out, "collective-axis")
+    assert {m for f in hits for m in ("sepp", "shardingg")
+            if m in f.message} == {"sepp", "shardingg"}
+
+
+def test_collective_axis_int_axis_kwarg_does_not_shadow(tmp_path):
+    # all_gather's axis= keyword is an array DIMENSION; the positional mesh
+    # axis must still be validated
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def f(x):\n"
+        "    return jax.lax.all_gather(x, 'typo_axis', axis=0)\n")})
+    hits = by_rule(out, "collective-axis")
+    assert len(hits) == 1 and "typo_axis" in hits[0].message
+
+
+def test_collective_axis_axis_index_positional(tmp_path):
+    # axis_index takes the axis as its ONLY positional argument
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def f():\n"
+        "    return jax.lax.axis_index('bogus')\n")})
+    hits = by_rule(out, "collective-axis")
+    assert len(hits) == 1 and "bogus" in hits[0].message
+
+
+def test_collective_axis_valid_and_variable_stay_clean(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def f(x, ax):\n"
+        "    y = jax.lax.psum(x, 'dp')\n"
+        "    i = jax.lax.axis_index('mp')\n"
+        "    return jax.lax.all_gather(y, ax) + i\n")})
+    assert by_rule(out, "collective-axis") == []
+
+
+def test_collective_axis_renamed_mesh_is_caught(tmp_path):
+    # the rule reads AXIS_ORDER from the tree under lint: renaming an axis
+    # there makes every old literal fail — the ISSUE's rename scenario
+    out = lint_tree(tmp_path, {
+        "paddle_tpu/distributed/topology.py":
+            'AXIS_ORDER = ("pp", "data", "sharding", "sep", "mp")\n',
+        "paddle_tpu/mod.py": (
+            "import jax\n"
+            "def f(x):\n"
+            "    return jax.lax.psum(x, 'dp')\n")})
+    assert len(by_rule(out, "collective-axis")) == 1
+
+
+# ------------------------------------------------------------- donation-misuse
+def test_donation_read_after_call_fires(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def f(a):\n"
+        "    return a * 2\n"
+        "g = jax.jit(f, donate_argnums=(0,))\n"
+        "def use(x):\n"
+        "    y = g(x)\n"
+        "    return x + y\n")})
+    hits = by_rule(out, "donation-misuse")
+    assert len(hits) == 1 and hits[0].line == 7
+
+
+def test_donation_rebind_idiom_stays_clean(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def f(a):\n"
+        "    return a * 2\n"
+        "g = jax.jit(f, donate_argnums=(0,))\n"
+        "def use(x):\n"
+        "    x = g(x)\n"
+        "    return x + 1\n")})
+    assert by_rule(out, "donation-misuse") == []
+
+
+# ----------------------------------------------------------------- dtype-drift
+def test_dtype_drift_fires_only_in_bf16_paths(tmp_path):
+    src = ("import jax.numpy as jnp\n"
+           "def f(x):\n"
+           "    return x.astype(jnp.float32)\n")
+    out = lint_tree(tmp_path / "a", {"paddle_tpu/ops/k.py": src})
+    assert len(by_rule(out, "dtype-drift")) == 1
+    out = lint_tree(tmp_path / "b", {"paddle_tpu/metric/k.py": src})
+    assert by_rule(out, "dtype-drift") == []
+
+
+def test_dtype_drift_sanctioned_idioms_stay_clean(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/ops/k.py": (
+        "import jax.numpy as jnp\n"
+        "def f(q, k, acc):\n"
+        "    s = jnp.dot(q, k, preferred_element_type=jnp.float32)\n"
+        "    m0 = jnp.zeros((4, 1), jnp.float32)\n"
+        "    return s, m0, acc.astype(jnp.bfloat16)\n")})
+    assert by_rule(out, "dtype-drift") == []
+
+
+# ----------------------------------------------------------------- silent-noop
+def test_silent_noop_exported_pass_fires(tmp_path):
+    out = lint_tree(tmp_path, {
+        "paddle_tpu/sub/__init__.py": "from .mod import api_call\n",
+        "paddle_tpu/sub/mod.py": (
+            "def api_call(x):\n"
+            "    pass\n"
+            "def _private_helper():\n"
+            "    pass\n"
+            "def unexported():\n"
+            "    pass\n")})
+    hits = by_rule(out, "silent-noop")
+    assert [f.message.split("'")[1] for f in hits] == ["api_call"]
+
+
+def test_silent_noop_real_body_and_decorated_stay_clean(tmp_path):
+    out = lint_tree(tmp_path, {
+        "paddle_tpu/sub/__init__.py": "from .mod import a, b\n",
+        "paddle_tpu/sub/mod.py": (
+            "import functools\n"
+            "def a(x):\n"
+            "    raise NotImplementedError('explicit is fine')\n"
+            "@functools.lru_cache()\n"
+            "def b():\n"
+            "    pass\n")})
+    assert by_rule(out, "silent-noop") == []
+
+
+# --------------------------------------------------------- bare-except-swallow
+def test_bare_except_in_recovery_path_fires(tmp_path):
+    out = lint_tree(tmp_path, {
+        "paddle_tpu/distributed/fault_tolerance.py": (
+            "def recover(fn):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except:\n"
+            "        pass\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception:\n"
+            "        pass\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except (Exception, OSError):\n"
+            "        pass\n")})
+    hits = by_rule(out, "bare-except-swallow")
+    sev = sorted(f.severity for f in hits)
+    assert sev == ["error", "warning", "warning"]  # tuple spelling counts
+
+
+def test_bare_except_narrow_or_handled_stays_clean(tmp_path):
+    out = lint_tree(tmp_path, {
+        "paddle_tpu/distributed/fault_tolerance.py": (
+            "def recover(fn, log):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except OSError:\n"
+            "        pass\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception as e:\n"
+            "        log(e)\n"
+            "        raise\n"),
+        # same patterns OUTSIDE the recovery surface are out of scope
+        "paddle_tpu/vision/thing.py": (
+            "def probe(fn):\n"
+            "    try:\n"
+            "        return fn()\n"
+            "    except Exception:\n"
+            "        pass\n")})
+    assert by_rule(out, "bare-except-swallow") == []
+
+
+# ---------------------------------------------------- suppressions & baseline
+def test_unreadable_file_is_a_finding_not_a_crash(tmp_path):
+    (tmp_path / "paddle_tpu").mkdir(parents=True)
+    (tmp_path / "paddle_tpu" / "ok.py").write_text("x = 1\n")
+    (tmp_path / "paddle_tpu" / "dangling.py").symlink_to(
+        tmp_path / "nowhere.py")
+    out = run_project(str(tmp_path), paths=["paddle_tpu"],
+                      project_rules=False)
+    assert [f.rule for f in out] == ["parse-error"]
+    assert "dangling.py" in out[0].path
+
+
+def test_inline_suppression_silences_one_line(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def f(x):\n"
+        "    return x.item()  # tpulint: disable=host-sync-in-jit\n"
+        "g = jax.jit(f)\n")})
+    assert by_rule(out, "host-sync-in-jit") == []
+
+
+def test_baseline_matches_by_content_and_regex(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "def f(x):\n"
+        "    return x.item()\n"
+        "def f2(x):\n"
+        "    return x.numpy()\n"
+        "g = jax.jit(f)\n"
+        "h = jax.jit(f2)\n")})
+    assert len(by_rule(out, "host-sync-in-jit")) == 2
+    entries = [
+        {"rule": "host-sync-in-jit", "path": "paddle_tpu/mod.py",
+         "content": "return x.item()", "justification": "test: deliberate"},
+        {"rule": "host-sync-in-jit", "path": "paddle_tpu/mod.py",
+         "match": r"x\.numpy\(\)", "justification": "test: deliberate"},
+    ]
+    kept, baselined, unused = apply_baseline(out, entries)
+    assert len(baselined) == 2 and unused == []
+    assert by_rule(kept, "host-sync-in-jit") == []
+
+
+def test_baseline_requires_justification(tmp_path):
+    bad = tmp_path / "b.json"
+    bad.write_text(json.dumps([{
+        "rule": "host-sync-in-jit", "path": "paddle_tpu/mod.py",
+        "content": "return x.item()", "justification": "TODO later"}]))
+    with pytest.raises(BaselineError, match="justification"):
+        load_baseline(str(bad))
+    bad.write_text(json.dumps([{
+        "rule": "host-sync-in-jit", "path": "paddle_tpu/mod.py",
+        "content": "return x.item()"}]))
+    with pytest.raises(BaselineError):
+        load_baseline(str(bad))
+    # empty content would grandfather EVERY finding of that rule+path
+    bad.write_text(json.dumps([{
+        "rule": "metrics-catalogue", "path": "README.md",
+        "content": "", "justification": "tries to baseline the world"}]))
+    with pytest.raises(BaselineError, match="non-empty"):
+        load_baseline(str(bad))
+    # ...and so would an empty match regex
+    bad.write_text(json.dumps([{
+        "rule": "impure-trace", "path": "paddle_tpu/mod.py",
+        "match": "", "justification": "blanket regex"}]))
+    with pytest.raises(BaselineError, match="non-empty regex"):
+        load_baseline(str(bad))
+
+
+def test_shipped_baseline_every_entry_justified():
+    entries = load_baseline(os.path.join(REPO, "tools",
+                                         "tpulint_baseline.json"))
+    assert entries, "shipped baseline unexpectedly empty"
+    for e in entries:
+        assert len(e["justification"].split()) >= 4, (
+            f"baseline entry {e['rule']} @ {e['path']} needs a real "
+            f"one-line justification")
+
+
+# ------------------------------------------------------------------ docs-stale
+def test_docs_stale_flags_old_citation(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("{}")
+    (tmp_path / "BENCH_r02.json").write_text("{}")
+    (tmp_path / "PROJECTION.md").write_text(
+        "# P\nrates from `BENCH_r01.json` here\n")
+    tools = tmp_path / "tools"
+    tools.mkdir()
+    docs_lint = (tmp_path / "tools" / "docs_lint.py")
+    docs_lint.write_text(
+        open(os.path.join(REPO, "tools", "docs_lint.py")).read())
+    (tmp_path / "paddle_tpu").mkdir()
+    out = run_project(str(tmp_path), paths=["paddle_tpu"],
+                      select={"docs-stale"})
+    assert len(out) == 1 and out[0].rule == "docs-stale"
+    assert "BENCH_r02" in out[0].message and out[0].line == 2
+    # refreshing the citation clears it
+    (tmp_path / "PROJECTION.md").write_text(
+        "# P\nrates from `BENCH_r02.json` here\n")
+    assert run_project(str(tmp_path), paths=["paddle_tpu"],
+                       select={"docs-stale"}) == []
+
+
+def test_docs_lint_cli_clean_on_repo():
+    r = subprocess.run([sys.executable,
+                        os.path.join(REPO, "tools", "docs_lint.py")],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ------------------------------------------------------------------ CLI driver
+def test_cli_check_paddle_tpu_clean_on_shipped_tree():
+    """The tier-1 gate: a new finding anywhere in the package fails this."""
+    r = subprocess.run([sys.executable, TPULINT, "--check", "paddle_tpu"],
+                       capture_output=True, text=True, cwd=REPO,
+                       timeout=240)
+    assert r.returncode == 0, f"tpulint found new issues:\n{r.stdout}"
+    assert "clean" in r.stdout
+
+
+def test_cli_injected_true_positive_fails_with_location(tmp_path):
+    (tmp_path / "paddle_tpu").mkdir()
+    (tmp_path / "paddle_tpu" / "bad.py").write_text(
+        "import time\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x + time.time()\n")
+    r = subprocess.run([sys.executable, TPULINT, "--check", "paddle_tpu"],
+                       capture_output=True, text=True, cwd=str(tmp_path),
+                       timeout=120)
+    assert r.returncode == 1
+    assert "paddle_tpu/bad.py:5" in r.stdout and "impure-trace" in r.stdout
+
+
+def test_cli_missing_target_is_usage_error(tmp_path):
+    """A typo'd path must not report 'clean': exit 2, not 0."""
+    r = subprocess.run([sys.executable, TPULINT, "--check", "paddle_tpuu"],
+                       capture_output=True, text=True, cwd=str(tmp_path),
+                       timeout=120)
+    assert r.returncode == 2
+    assert "not found" in r.stderr and "clean" not in r.stdout
+
+
+def test_cli_json_format_and_select(tmp_path):
+    (tmp_path / "paddle_tpu").mkdir()
+    (tmp_path / "paddle_tpu" / "bad.py").write_text(
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return x.item()\n")
+    r = subprocess.run(
+        [sys.executable, TPULINT, "--check", "paddle_tpu",
+         "--select", "host-sync-in-jit", "--format", "json"],
+        capture_output=True, text=True, cwd=str(tmp_path), timeout=120)
+    payload = json.loads(r.stdout)
+    assert r.returncode == 1
+    assert payload["counts"]["error"] == 1
+    assert payload["findings"][0]["rule"] == "host-sync-in-jit"

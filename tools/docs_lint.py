@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Docs staleness lint (tpulint rule `docs-stale`; standalone CLI kept).
+
+PROJECTION.md's pod-scale estimates are anchored to measured single-chip
+rates from a ``BENCH_r*.json`` round.  ``tools/project_pod.py`` always reads
+the NEWEST round (lexically last glob match), so a PROJECTION.md citing an
+older round is stale output that no longer matches what the generator would
+produce — the projections and the measurements have drifted apart.
+
+Check: the basename stem of the newest ``BENCH_r*.json`` (e.g. ``BENCH_r05``)
+must appear in PROJECTION.md.  Fix: ``python tools/project_pod.py --validate
+--write``.
+
+Usage: ``python tools/docs_lint.py [--root DIR]``; exit 1 on findings.
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import re
+import sys
+
+_BENCH_CITE_RE = re.compile(r"BENCH_r[0-9][0-9a-z_]*")
+
+
+def newest_bench(root: str):
+    """Basename of the newest bench round, or None.  Lexical sort matches
+    tools/project_pod.py's ``paths[-1]`` — the two must agree on 'newest'."""
+    paths = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    return os.path.basename(paths[-1]) if paths else None
+
+
+def check(root: str):
+    """Return findings as (relpath, line, message) tuples; empty = clean."""
+    newest = newest_bench(root)
+    proj = os.path.join(root, "PROJECTION.md")
+    if newest is None or not os.path.exists(proj):
+        return []
+    stem = newest[:-len(".json")] if newest.endswith(".json") else newest
+    with open(proj, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    cited_lines = []  # (lineno, {stems cited on that line})
+    for i, line in enumerate(lines, 1):
+        hits = set(_BENCH_CITE_RE.findall(line))
+        if hits:
+            cited_lines.append((i, hits))
+    all_cited = set().union(*(h for _, h in cited_lines)) if cited_lines \
+        else set()
+    if stem in all_cited:
+        return []
+    if not cited_lines:
+        return [("PROJECTION.md", 1,
+                 f"cites no BENCH round at all — newest is {newest}; "
+                 f"regenerate with `python tools/project_pod.py --validate "
+                 f"--write`")]
+    line_no, stale = cited_lines[0]
+    return [("PROJECTION.md", line_no,
+             f"cites {sorted(stale)[0]} but the newest bench round is "
+             f"{newest} — regenerate with `python tools/project_pod.py "
+             f"--validate --write`")]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    args = ap.parse_args(argv)
+    findings = check(args.root)
+    for path, line, msg in findings:
+        print(f"{path}:{line}: docs-stale {msg}")
+    if not findings:
+        print("docs_lint: PROJECTION.md cites the newest bench round "
+              f"({newest_bench(args.root)})")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

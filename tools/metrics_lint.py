@@ -14,6 +14,12 @@ walks the default registry and fails on:
   undocumented series are invisible to operators and drift silently;
 - label names that are not snake_case.
 
+This lint is registered as tpulint rule ``metrics-catalogue`` — the
+canonical CI entrypoint is ``python tools/tpulint.py --check paddle_tpu``
+(one driver for every lint).  This CLI remains as a thin shim over the same
+``import_instrumented()`` + ``lint()`` pair the rule calls, so the two
+entrypoints cannot drift.
+
 Usage: ``python tools/metrics_lint.py [--readme README.md]`` from the repo
 root; exit code 1 on any finding.
 """
@@ -78,17 +84,14 @@ def lint(registry=None, readme_path: str = "README.md") -> list[str]:
     return errors
 
 
-def main(argv=None) -> int:
-    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--readme", default=os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
-        "README.md"))
-    args = ap.parse_args(argv)
-
-    # Import every instrumented layer so its families are registered even if
-    # the package __init__ is ever slimmed down.
+def import_instrumented(repo_root=None):
+    """Import every instrumented layer so its metric families are registered
+    even if the package __init__ is ever slimmed down; return the registry.
+    Shared by this CLI and the tpulint ``metrics-catalogue`` rule."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo_root is None:
+        repo_root = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
     if repo_root not in sys.path:
         sys.path.insert(0, repo_root)
     import paddle_tpu  # noqa: F401
@@ -99,7 +102,19 @@ def main(argv=None) -> int:
     import paddle_tpu.hapi.callbacks  # noqa: F401
     import paddle_tpu.inference.llm_server  # noqa: F401
     from paddle_tpu.observability import REGISTRY
+    return REGISTRY
 
+
+def main(argv=None) -> int:
+    """Thin shim — `python tools/tpulint.py --select metrics-catalogue` is
+    the canonical entrypoint; this stays for muscle memory and --readme."""
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--readme", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "README.md"))
+    args = ap.parse_args(argv)
+
+    REGISTRY = import_instrumented()
     errors = lint(REGISTRY, args.readme)
     if errors:
         print(f"metrics_lint: {len(errors)} finding(s):")

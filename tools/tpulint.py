@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""tpulint — TPU-native static-analysis driver (tier-1 gate).
+
+Polices trace-safety, collectives, and dtype discipline across the package:
+host syncs inside jitted steps, impure traces, mesh-axis typos, donated
+buffers read after the call, f32 drift in bf16 paths, exported no-ops,
+swallowed faults in recovery code, the metric-namespace catalogue, and docs
+staleness.  Rule catalogue: README §Static analysis;
+engine: ``paddle_tpu/analysis/``.
+
+Usage::
+
+    python tools/tpulint.py --check paddle_tpu          # the tier-1 gate
+    python tools/tpulint.py --list-rules
+    python tools/tpulint.py path/ --format json
+    python tools/tpulint.py --check paddle_tpu --select impure-trace
+    python tools/tpulint.py --check paddle_tpu --write-baseline /tmp/b.json
+
+Exit codes: 0 clean, 1 findings at/above --fail-on, 2 usage/baseline error.
+
+Suppress a single line with ``# tpulint: disable=rule-name`` (or ``=all``);
+grandfather history in ``tools/tpulint_baseline.json`` — every entry MUST
+carry a one-line justification or the driver refuses to run.
+
+The engine is loaded by file path under a private module name so linting
+works even when ``import paddle_tpu`` itself is broken — a linter that needs
+the patient healthy is not a diagnostic tool.  (The metrics-catalogue rule
+does import the live package, and degrades to a note if it cannot.)
+"""
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def load_analysis():
+    """Load paddle_tpu/analysis as a standalone package (no paddle_tpu
+    __init__, no jax import)."""
+    name = "_tpulint_analysis"
+    if name in sys.modules:
+        return sys.modules[name]
+    pkg_dir = os.path.join(REPO_ROOT, "paddle_tpu", "analysis")
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve_root(targets):
+    """Repo root when every target lives inside it, else the CWD — lets the
+    same driver lint fixture trees in tests."""
+    abs_targets = [os.path.abspath(t) for t in targets]
+    if all(t.startswith(REPO_ROOT + os.sep) or t == REPO_ROOT
+           for t in abs_targets):
+        return REPO_ROOT
+    return os.getcwd()
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="tpulint", description=__doc__.splitlines()[0].strip())
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument("--check", action="append", default=[], metavar="PATH",
+                    help="path to lint (alias for a positional path; the "
+                         "tier-1 invocation is --check paddle_tpu)")
+    ap.add_argument("--root", default=None,
+                    help="project root for relative paths/baseline "
+                         "(default: auto)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: <root>/tools/"
+                         "tpulint_baseline.json when present)")
+    ap.add_argument("--no-baseline", action="store_true")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--select", action="append", metavar="RULE",
+                    help="run only these rules (repeatable)")
+    ap.add_argument("--ignore", action="append", metavar="RULE",
+                    help="skip these rules (repeatable)")
+    ap.add_argument("--fail-on", choices=("error", "warning"),
+                    default="warning",
+                    help="lowest severity that fails the run (default: "
+                         "warning; notes never fail)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="write current findings as baseline entries (each "
+                         "needs its justification filled in before the "
+                         "loader will accept it)")
+    args = ap.parse_args(argv)
+
+    analysis = load_analysis()
+
+    if args.list_rules:
+        for name in sorted(analysis.RULES):
+            r = analysis.RULES[name]
+            print(f"{name:22s} [{r.severity}] {r.description}")
+        return 0
+
+    targets = list(args.paths) + list(args.check)
+    if not targets:
+        targets = ["paddle_tpu"]
+    root = os.path.abspath(args.root) if args.root else _resolve_root(targets)
+    # a typo'd/missing target must be a usage error, not a clean exit —
+    # otherwise a misconfigured CI job "passes" forever while linting nothing
+    missing = [t for t in targets
+               if not os.path.exists(t if os.path.isabs(t)
+                                     else os.path.join(root, t))]
+    if missing:
+        print(f"tpulint: target(s) not found under {root}: "
+              f"{', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    unknown = [r for r in (args.select or []) + (args.ignore or [])
+               if r not in analysis.RULES]
+    if unknown:
+        print(f"tpulint: unknown rule(s): {', '.join(unknown)} "
+              f"(see --list-rules)", file=sys.stderr)
+        return 2
+
+    # Project rules (metrics-catalogue imports the live package + jax) run
+    # on whole-package lints and explicit --select; a single-file spot-lint
+    # stays a sub-second AST pass.
+    abs_targets = [t if os.path.isabs(t) else os.path.join(root, t)
+                   for t in targets]
+    whole = {os.path.abspath(root),
+             os.path.join(os.path.abspath(root), "paddle_tpu")}
+    project_rules = (bool(args.select)
+                     or any(os.path.abspath(t) in whole for t in abs_targets))
+
+    findings = analysis.run_project(
+        root, paths=targets,
+        select=set(args.select) if args.select else None,
+        ignore=set(args.ignore) if args.ignore else None,
+        project_rules=project_rules)
+
+    if args.write_baseline:
+        entries = [{"rule": f.rule, "path": f.path, "content": f.content,
+                    "justification": "TODO — one-line reason this finding "
+                                     "is deliberate"}
+                   for f in findings if f.severity != "note"]
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            json.dump(entries, fh, indent=2)
+            fh.write("\n")
+        print(f"tpulint: wrote {len(entries)} entries to "
+              f"{args.write_baseline}; fill in every justification — the "
+              f"loader rejects TODO stubs")
+        return 0
+
+    baselined, unused = [], []
+    baseline_path = args.baseline or os.path.join(root, "tools",
+                                                  "tpulint_baseline.json")
+    if not args.no_baseline and os.path.exists(baseline_path):
+        try:
+            entries = analysis.load_baseline(baseline_path)
+        except analysis.BaselineError as e:
+            print(f"tpulint: {e}", file=sys.stderr)
+            return 2
+        findings, baselined, unused = analysis.apply_baseline(findings,
+                                                              entries)
+        # only entries whose rule ran AND whose path was linted can be
+        # judged stale — a subdirectory spot-lint must not tell the
+        # developer to delete justified entries elsewhere in the tree
+        active = {n for n in analysis.RULES
+                  if (not args.select or n in args.select)
+                  and n not in (args.ignore or ())}
+        rel_targets = [os.path.relpath(t, root).replace(os.sep, "/")
+                       for t in abs_targets]
+
+        def _in_scope(e):
+            if e["rule"] not in active:
+                return False
+            rule = analysis.RULES.get(e["rule"])
+            if isinstance(rule, analysis.ProjectRule):
+                return project_rules
+            return any(t in (".", "") or e["path"] == t
+                       or e["path"].startswith(t.rstrip("/") + "/")
+                       for t in rel_targets)
+
+        unused = [e for e in unused if _in_scope(e)]
+
+    if args.format == "json":
+        print(analysis.render_json(findings, len(baselined), unused))
+    else:
+        print(analysis.render_text(findings, len(baselined), unused))
+
+    fail_severities = (("error",) if args.fail_on == "error"
+                       else ("error", "warning"))
+    return 1 if any(f.severity in fail_severities for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
