@@ -23,6 +23,18 @@ V5E_PEAK_FLOPS = 197e12  # bf16, one v5e chip (nominal)
 _RTT_S = 0.0  # measured dispatch+sync round-trip of the attached chip
 
 
+def paged_capacity_trace(L_pad, page_size=128):
+    """Deterministic mixed-length serving trace for the paged-kv capacity
+    accounting (shared with tools/project_pod.py so the 'derived' PROJECTION
+    numbers can never drift from what bench.py measures): context lengths
+    100..L_pad in steps of 100 — deliberately OFF the page grid so the
+    round-up-to-page waste is represented.  Returns (trace, mean pages per
+    request at `page_size`)."""
+    trace = list(range(100, int(L_pad) + 1, 100))
+    pages_mean = sum(-(-t // page_size) for t in trace) / len(trace)
+    return trace, pages_mean
+
+
 def _measure_rtt():
     """The tunneled chip pays ~100ms dispatch+sync latency PER HOST SYNC —
     every single-sync timing window is inflated by this constant.  Measure
@@ -273,24 +285,26 @@ def _bench_decode(on_accel):
     ids = paddle.to_tensor(
         np.random.randint(0, cfg.vocab_size, (batch, prompt_len), np.int32))
 
-    def timed(the_ids, ntok, cache_dtype=None, reps=3):
+    def timed(the_ids, ntok, cache_dtype=None, kv_layout=None, reps=3):
         out = model.generate(the_ids, max_new_tokens=ntok,
-                             cache_dtype=cache_dtype)  # compile
+                             cache_dtype=cache_dtype,
+                             kv_layout=kv_layout)  # compile
         _ = np.asarray(out._value)
         ws = []
         for _ in range(reps):
             t0 = time.perf_counter()
             out = model.generate(the_ids, max_new_tokens=ntok,
-                                 cache_dtype=cache_dtype)
+                                 cache_dtype=cache_dtype,
+                                 kv_layout=kv_layout)
             _ = np.asarray(out._value)
             ws.append(time.perf_counter() - t0)
         # median window: steady-state deltas difference out the RTT anyway,
         # and a best-of window would overstate the achieved rate
         return max(sorted(ws)[len(ws) // 2] - _RTT_S, 1e-6)
 
-    def steady(the_ids, ntok, cache_dtype=None):
-        d_full = timed(the_ids, ntok, cache_dtype)
-        d_half = timed(the_ids, ntok // 2, cache_dtype)
+    def steady(the_ids, ntok, cache_dtype=None, kv_layout=None):
+        d_full = timed(the_ids, ntok, cache_dtype, kv_layout)
+        d_half = timed(the_ids, ntok // 2, cache_dtype, kv_layout)
         return d_full, (d_full - d_half) / (ntok - ntok // 2)
 
     dt, per_tok = steady(ids, new_tokens) if on_accel else (
@@ -355,6 +369,43 @@ def _bench_decode(on_accel):
         if per32q > 1e-6:
             res["llama_decode_int8_b32_steady_tokens_per_sec"] = round(
                 32 / per32q, 1)
+        # PAGED decode (ragged paged attention kernel behind page tables):
+        # same math, page-pool residency — the serving engine's layout
+        _, per_pg = steady(ids, new_tokens, kv_layout="paged")
+        if per_pg > 1e-6:
+            res["llama_decode_paged_ms_per_token"] = round(per_pg * 1000, 2)
+            res["llama_decode_paged_steady_tokens_per_sec"] = round(
+                batch / per_pg, 1)
+        _, per_pg8 = steady(ids, new_tokens, "int8", kv_layout="paged")
+        if per_pg8 > 1e-6:
+            res["llama_decode_paged_int8_steady_tokens_per_sec"] = round(
+                batch / per_pg8, 1)
+        _, per_pg32 = steady(ids32, new_tokens, kv_layout="paged")
+        if per_pg32 > 1e-6:
+            res["llama_decode_paged_b32_steady_tokens_per_sec"] = round(
+                32 / per_pg32, 1)
+        # paged CAPACITY: a dense server reserves L_pad rows per slot (the
+        # longest admissible context); pages follow ACTUAL lengths.  Model
+        # the deterministic mixed-length trace from paged_capacity_trace
+        # (contexts 100..L_pad step 100, page_size 128) and report the max
+        # decode batch the same HBM budget holds (the dense counterpart of
+        # this accounting is kv_bf16_max_batch above, whose every slot costs
+        # the full L_pad rows)
+        ps_pg = 128
+        trace, pages_mean = paged_capacity_trace(L_pad, ps_pg)
+        rows_mean = pages_mean * ps_pg
+        row_bytes_bf16 = 2 * cfg.num_hidden_layers \
+            * cfg.num_key_value_heads * hd * 2
+        row_bytes_int8 = 2 * cfg.num_hidden_layers \
+            * cfg.num_key_value_heads * (hd + 4)
+        res["kv_paged_max_batch"] = int(budget / (rows_mean * row_bytes_bf16))
+        res["kv_paged_int8_max_batch"] = int(
+            budget / (rows_mean * row_bytes_int8))
+        res["kv_paged_max_batch_gain"] = round(
+            res["kv_paged_max_batch"] / max(res["kv_bf16_max_batch"], 1), 2)
+        # fraction of allocated page rows holding real tokens on this trace
+        res["kv_paged_pool_utilization"] = round(
+            sum(trace) / (len(trace) * rows_mean), 3)
     return res
 
 

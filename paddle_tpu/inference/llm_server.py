@@ -15,6 +15,17 @@ This engine is the TPU-native design the kv-cache stack invites:
   copied into the slot, and the request joins the next decode tick;
 - completion by eos/max-tokens frees the slot for the next queued request.
 
+``kv_layout="paged"`` swaps the dense per-slot buffers for a PAGED cache
+(the Ragged Paged Attention design, kv_cache.py paged contract): a global
+page pool + per-slot page tables, admission gated by FREE PAGES instead of
+reserved max_seq_len rows, page reclamation on finish/expiry,
+recompute-style preemption when the pool runs dry, and CHUNKED PREFILL —
+prompts prefill in fixed-size chunks interleaved with decode ticks through
+ONE compiled chunk program (no per-bucket compile zoo), so a long prompt
+never stalls running slots for more than one chunk step.  ``warmup()``
+pre-compiles either layout's programs so the first request pays no compile
+latency.
+
 The engine is deterministic and thread-free by default (`step()` pumps one
 decode tick; `run_until_complete()` drains); `start()` spawns the
 background pump for server use.
@@ -82,6 +93,23 @@ _M_TICK_SECONDS = _obs.histogram(
 _M_WATCHDOG = _obs.counter(
     "llm_pump_watchdog_trips_total",
     "Background pump deaths caught by the watchdog")
+_M_PREFILL_CHUNKS = _obs.counter(
+    "llm_prefill_chunks_total",
+    "Prefill chunks executed (chunked, decode-interleaved admission)")
+_M_PREFILL_CHUNK_S = _obs.histogram(
+    "llm_prefill_chunk_seconds", "One compiled prefill-chunk call")
+_M_PAGES_IN_USE = _obs.gauge(
+    "llm_kv_pages_in_use_count",
+    "KV-cache pages currently allocated to slots (paged layout)")
+_M_PAGE_UTIL = _obs.gauge(
+    "llm_kv_page_utilization_ratio",
+    "Allocated fraction of the allocatable kv page pool")
+_M_PAGE_PREEMPT = _obs.counter(
+    "llm_page_preemptions_total",
+    "In-flight requests preempted because the kv page pool ran dry")
+_M_WARMUP_S = _obs.gauge(
+    "llm_warmup_compile_seconds",
+    "Wall time of the last warmup() precompile pass")
 
 
 class ServerOverloadedError(RuntimeError):
@@ -148,13 +176,27 @@ class LLMEngine:
     def __init__(self, model, max_batch_slots=4, max_seq_len=512,
                  cache_dtype=None, eos_token_id=None, pad_token_id=0,
                  prompt_buckets=(32, 64, 128, 256), decode_chunk=1,
-                 max_queue_len=None, clock=None):
+                 max_queue_len=None, clock=None, kv_layout=None,
+                 page_size=128, num_pages=None, prefill_chunk=None):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
         scheduling lever for high-latency hosts.  Slots that finish
         mid-chunk have their surplus tokens discarded (their cache rows are
         rewritten at the next admission), and admission/eos decisions
         happen every k tokens instead of every token.
+
+        ``kv_layout="paged"`` replaces the dense per-slot cache with a
+        PAGED one: a global page pool of ``num_pages`` pages of
+        ``page_size`` tokens (page 0 reserved as the trash page) plus
+        per-slot page tables.  Admission is by FREE PAGES, capacity scales
+        with actual sequence lengths, pages reclaim on finish/expiry, and
+        prompts prefill in ``prefill_chunk``-token chunks interleaved with
+        decode ticks — ONE compiled prefill program (no per-bucket zoo) and
+        a long prompt never stalls running slots for more than one chunk.
+        ``num_pages`` defaults to full dense capacity
+        (slots * max_seq_len / page_size + trash); size it by HBM budget to
+        oversubscribe.  A slot whose decode outruns the pool is preempted
+        with ServerOverloadedError (llm_page_preemptions_total).
 
         Degradation knobs (fault-tolerance layer): ``max_queue_len`` bounds
         the admission queue — submit() beyond it raises
@@ -167,6 +209,24 @@ class LLMEngine:
         self.n_slots = int(max_batch_slots)
         # pad L to the decode kernel's 128 tile
         self.L = ((int(max_seq_len) + 127) // 128) * 128
+        if kv_layout not in (None, "dense", "paged"):
+            raise ValueError(
+                f"kv_layout must be None, 'dense' or 'paged', got {kv_layout!r}")
+        self.paged = kv_layout == "paged"
+        self.kv_layout = "paged" if self.paged else "dense"
+        self.ps = int(page_size)
+        if self.paged:
+            if not getattr(model, "_supports_paged_cache", False):
+                raise ValueError(
+                    f"{type(model).__name__} does not support the paged "
+                    "kv-cache layout; use kv_layout=None")
+            if self.ps < 1:
+                raise ValueError(f"page_size must be >= 1, got {page_size}")
+            import math
+
+            # keep L a whole number of pages AND of 128-lane kernel tiles
+            unit = self.ps * 128 // math.gcd(self.ps, 128)
+            self.L = ((self.L + unit - 1) // unit) * unit
         self.cache_dtype = cache_dtype
         self.eos = -1 if eos_token_id is None else int(eos_token_id)
         self.pad = int(pad_token_id)
@@ -182,7 +242,35 @@ class LLMEngine:
             next(iter(jax.tree_util.tree_leaves(self._params))).dtype
         ) == "bfloat16" else jnp.float32
         self._kv_dtype = kv_dtype
-        if cache_dtype == "int8":
+        if self.paged:
+            ps = self.ps
+            self.M = self.L // ps  # page-table width (max pages per slot)
+            P = int(num_pages) if num_pages is not None \
+                else self.n_slots * self.M + 1
+            P = max(P, 2)  # trash page + at least one allocatable page
+            self.num_pages = P
+            if cache_dtype == "int8":
+                self.caches = [
+                    (jnp.zeros((P, H, ps, D), jnp.int8),
+                     jnp.zeros((P, H, ps, D), jnp.int8),
+                     jnp.full((P, H, ps), 1e-8, jnp.float32),
+                     jnp.full((P, H, ps), 1e-8, jnp.float32))
+                    for _ in range(nl)]
+            else:
+                self.caches = [
+                    (jnp.zeros((P, H, ps, D), kv_dtype),
+                     jnp.zeros((P, H, ps, D), kv_dtype))
+                    for _ in range(nl)]
+            # host-side allocator: page 0 is the trash page, never handed
+            # out; pop() order is deterministic (highest id first)
+            self._free_pages = list(range(1, P))
+            self._slot_pages: list[list[int]] = [[] for _ in range(B)]
+            self._pt_host = np.zeros((B, self.M), np.int32)
+            self._pt_dev = jnp.asarray(self._pt_host)
+            self.prefill_chunk = max(1, min(
+                int(prefill_chunk) if prefill_chunk is not None else 128,
+                self.L))
+        elif cache_dtype == "int8":
             self.caches = [
                 (jnp.zeros((B, H, L, D), jnp.int8),
                  jnp.zeros((B, H, L, D), jnp.int8),
@@ -196,6 +284,7 @@ class LLMEngine:
                  jnp.zeros((B, H, L, D), kv_dtype),
                  jnp.zeros((B,), jnp.int32))
                 for _ in range(nl)]
+        self._prefilling = None  # (request, slot, prompt tokens consumed)
         self.slot_pos = np.zeros(B, np.int32)       # valid tokens per slot
         self.slot_req: list[_Request | None] = [None] * B
         self.last_token = np.full(B, self.pad, np.int32)
@@ -298,8 +387,9 @@ class LLMEngine:
 
     def run_until_complete(self):
         """Pump decode ticks until the queue and all slots drain."""
-        while not self._pending.empty() or any(r is not None
-                                               for r in self.slot_req):
+        while not self._pending.empty() \
+                or any(r is not None for r in self.slot_req) \
+                or self._prefilling is not None:
             self.step()
 
     @staticmethod
@@ -316,10 +406,18 @@ class LLMEngine:
         Request/latency series come from the process-global metrics
         registry, so two engines in one process share those counters.
         """
+        pages_total = (self.num_pages - 1) if self.paged else 0
+        pages_used = pages_total - len(self._free_pages) if self.paged else 0
         return {
             "queue_depth": self._pending.qsize(),
             "active_slots": sum(r is not None for r in self.slot_req),
             "n_slots": self.n_slots,
+            "kv_layout": self.kv_layout,
+            "llm_kv_pages_in_use": pages_used,
+            "kv_pages_total": pages_total,
+            "kv_page_utilization": pages_used / pages_total
+            if pages_total else 0.0,
+            "prefill_in_progress": self._prefilling is not None,
             "pump_alive": self._thread.is_alive()
             if self._thread is not None else False,
             "pump_error": repr(self._pump_error)
@@ -378,8 +476,8 @@ class LLMEngine:
     def _loop(self):
         try:
             while not self._stop:
-                if self._pending.empty() and all(r is None
-                                                 for r in self.slot_req):
+                if self._pending.empty() and self._prefilling is None \
+                        and all(r is None for r in self.slot_req):
                     time.sleep(0.002)
                     continue
                 self.step()
@@ -410,10 +508,16 @@ class LLMEngine:
         the lock when its exception unwound)."""
         with self._lock:
             self._drain_queue(exc)
+            if self._prefilling is not None:
+                req, slot, _ = self._prefilling
+                self._prefilling = None
+                self._release_pages(slot)
+                _fail_future(req.future, exc)
             for i, req in enumerate(self.slot_req):
                 if req is not None:
                     self.slot_req[i] = None
                     self.last_token[i] = self.pad
+                    self._release_pages(i)
                     _fail_future(req.future, exc)
 
     # --------------------------------------------------------- internals
@@ -472,6 +576,11 @@ class LLMEngine:
                 self.slot_req[slot] = None
                 free.insert(0, slot)
                 _fail_future(req.future, e)
+                if not self._caches_alive():
+                    # the slot writer donates self.caches (see
+                    # _prefill_tick): a consumed-buffer failure is
+                    # engine-fatal, not a per-request one
+                    raise
 
     def _admit_one(self, req, slot):
         req.admit_ts = self._clock()
@@ -540,6 +649,283 @@ class LLMEngine:
             self._prefill_jit[key] = jax.jit(write, donate_argnums=(0,))
         return self._prefill_jit[key]
 
+    def _caches_alive(self):
+        """False when the kv cache buffers were consumed by a donating
+        compiled call that then failed mid-execution — the engine must not
+        keep serving on deleted arrays (trace/compile-time failures raise
+        BEFORE donation is consumed, so those stay per-request)."""
+        try:
+            return not any(
+                getattr(x, "is_deleted", lambda: False)()
+                for c in self.caches for x in c)
+        except Exception:
+            return False
+
+    # ---------------------------------------------------- paged internals
+
+    def _release_pages(self, slot):
+        """Reclaim every page a slot holds (finish/expiry/preempt/stop) and
+        point its page-table row back at the trash page."""
+        if not self.paged or not self._slot_pages[slot]:
+            return
+        self._free_pages.extend(reversed(self._slot_pages[slot]))
+        self._slot_pages[slot] = []
+        self._pt_host[slot, :] = 0
+        self._pt_dev = jnp.asarray(self._pt_host)
+
+    def _alloc_pages(self, slot, n):
+        """Move n pages from the free list into a slot's table; returns
+        False (allocating nothing) if the pool cannot cover the request."""
+        if n <= 0:
+            return True
+        if len(self._free_pages) < n:
+            return False
+        for _ in range(n):
+            page = self._free_pages.pop()
+            self._pt_host[slot, len(self._slot_pages[slot])] = page
+            self._slot_pages[slot].append(page)
+        self._pt_dev = jnp.asarray(self._pt_host)
+        return True
+
+    def _update_page_gauges(self):
+        total = self.num_pages - 1
+        used = total - len(self._free_pages)
+        _M_PAGES_IN_USE.set(used)
+        _M_PAGE_UTIL.set(used / total if total else 0.0)
+
+    def _preempt_slot(self, slot):
+        """Preempt an in-flight request whose next token has no free page:
+        reclaim its pages and REQUEUE it (recompute-style preemption) — the
+        prompt is extended with the tokens generated so far, so
+        re-admission re-prefills the full prefix and greedy decoding
+        continues exactly where it left off.  A request already holding the
+        entire pool can never fit and fails with ServerOverloadedError
+        instead of looping forever."""
+        req = self.slot_req[slot]
+        self.slot_req[slot] = None
+        self.last_token[slot] = self.pad
+        held = len(self._slot_pages[slot])
+        self._release_pages(slot)
+        _M_PAGE_PREEMPT.inc()
+        if req is None:
+            return
+        if held >= self.num_pages - 1:
+            _fail_future(req.future, ServerOverloadedError(
+                f"request needs more kv pages than the whole pool "
+                f"({self.num_pages - 1} pages x {self.ps} tokens); rejected"))
+            return
+        req.prompt = np.concatenate(
+            [req.prompt, np.asarray(req.tokens, np.int32)])
+        with self._pending.mutex:
+            self._pending.queue.appendleft(req)
+
+    def _ensure_decode_pages(self, active, eff):
+        """Grow each active slot's page table to cover the rows this tick
+        will write (pos .. pos+eff-1); preempt slots the pool cannot cover.
+        Returns the surviving active list."""
+        out = []
+        for i in active:
+            need = -(-(int(self.slot_pos[i]) + eff) // self.ps) \
+                - len(self._slot_pages[i])
+            if self._alloc_pages(i, need):
+                out.append(i)
+            else:
+                self._preempt_slot(i)
+        return out
+
+    def _chunk_prefill_fn(self):
+        """ONE compiled program prefills any prompt in fixed-size chunks —
+        ids [1, C] against the paged pools at per-slot offset `off`,
+        killing the per-bucket prefill compile zoo.  Returns the logits at
+        `last_index` (the final chunk's last real token) and the updated
+        pools; the page table row routes the scatter, padded tail rows land
+        in the trash page / are overwritten by the first decode."""
+        model = self.model
+
+        def run(params, buffers, caches, page_row, ids, off, last_index):
+            restore = model.bind_functional_state(params, buffers)
+            try:
+                with tape.no_grad():
+                    t_caches = [
+                        (Tensor(c[0]), Tensor(c[1]), off, Tensor(page_row))
+                        + tuple(Tensor(x) for x in c[2:])
+                        for c in caches]
+                    logits, new_caches = model.prefill_chunk_step(
+                        Tensor(ids), t_caches, last_index)
+                    raw = []
+                    for c in new_caches:
+                        vals = tuple(x._value if isinstance(x, Tensor) else x
+                                     for x in c)
+                        raw.append((vals[0], vals[1]) + vals[4:])
+            finally:
+                restore()
+            return logits._value, raw
+
+        return jax.jit(run, donate_argnums=(2,))
+
+    def _get_chunk_prefill(self):
+        if "chunk" not in self._prefill_jit:
+            self._prefill_jit["chunk"] = self._chunk_prefill_fn()
+        return self._prefill_jit["chunk"]
+
+    def _admit_paged(self):
+        """Chunked-prefill admission: at most ONE prompt chunk per tick, so
+        running slots keep decoding underneath a long admission (the
+        head-of-line fix).  Admission is gated on FREE PAGES: the queue head
+        waits until reclamation frees enough pages for its prompt + first
+        decode token."""
+        if self._prefilling is None:
+            self._start_prefill()
+        if self._prefilling is not None:
+            self._prefill_tick()
+
+    def _start_prefill(self):
+        free = [i for i, r in enumerate(self.slot_req) if r is None]
+        while free and not self._pending.empty():
+            try:
+                req = self._pending.get_nowait()
+            except queue.Empty:
+                return
+            if req.future.done():
+                continue  # cancelled / failed by a pump-death race
+            if req.deadline is not None and self._clock() > req.deadline:
+                _M_EXPIRED.labels(where="queued").inc()
+                _fail_future(req.future, DeadlineExceededError(
+                    "request deadline expired while queued for admission"))
+                continue
+            need = -(-(req.prompt.size + 1) // self.ps)
+            if need > self.num_pages - 1:
+                _fail_future(req.future, ServerOverloadedError(
+                    f"prompt needs {need} kv pages but the pool only has "
+                    f"{self.num_pages - 1}; rejected"))
+                continue
+            slot = free[0]
+            if not self._alloc_pages(slot, need):
+                # admission by free pages: head-of-line waits for
+                # reclamation (put it back where it came from)
+                with self._pending.mutex:
+                    self._pending.queue.appendleft(req)
+                return
+            req.admit_ts = self._clock()
+            if req.submit_ts is not None and not req.tokens:
+                _M_QUEUE_WAIT.observe(max(0.0, req.admit_ts - req.submit_ts))
+            self._prefilling = (req, slot, 0)
+            return
+
+    def _prefill_tick(self):
+        """Run ONE prefill chunk of the admitting request; on the final
+        chunk emit the first token and activate the slot."""
+        req, slot, done = self._prefilling
+        if req.future.done() or (req.deadline is not None
+                                 and self._clock() > req.deadline):
+            self._prefilling = None
+            self._release_pages(slot)
+            if not req.future.done():
+                _M_EXPIRED.labels(where="inflight").inc()
+                _fail_future(req.future, DeadlineExceededError(
+                    f"request deadline exceeded after {done} prefilled "
+                    "prompt tokens"))
+            return
+        n = req.prompt.size
+        C = self.prefill_chunk
+        m = min(C, n - done)
+        chunk = np.full((1, C), self.pad, np.int32)
+        chunk[0, :m] = req.prompt[done:done + m]
+        args = (self._params, self._buffers, self.caches,
+                self._pt_dev[slot:slot + 1], jnp.asarray(chunk),
+                jnp.asarray([done], jnp.int32),
+                jnp.asarray(m - 1, jnp.int32))
+        try:
+            jit = self._get_chunk_prefill()
+            if _obs.enabled():
+                with _span("llm_prefill_chunk", _M_PREFILL_CHUNK_S):
+                    logits, self.caches = jit(*args)
+            else:
+                logits, self.caches = jit(*args)
+        except Exception as e:
+            self._prefilling = None
+            self._release_pages(slot)
+            _fail_future(req.future, e)
+            if not self._caches_alive():
+                # the chunk call DONATES self.caches: an execution-time
+                # failure may have consumed the buffers, and serving on
+                # deleted arrays would fail every later request with a
+                # misleading error — escalate to the pump watchdog instead
+                raise
+            return
+        _M_PREFILL_CHUNKS.inc()
+        done += m
+        if done < n:
+            self._prefilling = (req, slot, done)
+            return
+        self._prefilling = None
+        tok = self._host_select(np.asarray(logits[0, 0]), req)
+        first = not req.tokens  # re-admission after preemption continues
+        req.slot = slot
+        req.tokens.append(tok)
+        self.slot_req[slot] = req
+        self.slot_pos[slot] = n
+        self.last_token[slot] = tok
+        _M_ADMITTED.inc()
+        if first and req.submit_ts is not None:
+            # the final chunk's token IS the first token out
+            _M_TTFT.observe(max(0.0, self._clock() - req.submit_ts))
+        if tok == self.eos or len(req.tokens) >= req.max_new_tokens:
+            self._finish(slot)
+
+    def warmup(self, buckets=None):
+        """Pre-compile the serving programs so the FIRST request pays no
+        compile latency (the TTFT spike visible in llm_ttft_seconds): the
+        decode step at the configured decode_chunk, plus either every
+        prompt-bucket prefill + slot writer (dense layout) or the single
+        prefill-chunk program (paged layout; `buckets` is ignored there —
+        the chunk program serves every prompt length).  Runs the real
+        compiled calls against the engine's own idle cache state: the
+        garbage rows land in the trash page (paged) or in rows admission
+        rewrites wholesale (dense).  Returns the wall seconds spent and
+        publishes them on llm_warmup_compile_seconds."""
+        t0 = time.perf_counter()
+        with self._lock:
+            if self._prefilling is not None \
+                    or any(r is not None for r in self.slot_req):
+                raise RuntimeError("warmup() requires an idle engine")
+            params, buffers = self._params, self._buffers
+            if self.paged:
+                C = self.prefill_chunk
+                _, self.caches = self._get_chunk_prefill()(
+                    params, buffers, self.caches,
+                    jnp.zeros((1, self.M), jnp.int32),
+                    jnp.full((1, C), self.pad, jnp.int32),
+                    jnp.zeros((1,), jnp.int32), jnp.asarray(0, jnp.int32))
+            else:
+                for Lb in (buckets if buckets is not None else self.buckets):
+                    Lb = int(Lb)
+                    ids = jnp.full((1, Lb), self.pad, jnp.int32)
+                    _, kvs = self._get_prefill(Lb)(
+                        params, buffers, ids, jnp.asarray(Lb - 1, jnp.int32))
+                    self.caches = self._get_slot_writer(Lb)(
+                        self.caches, kvs, jnp.asarray(0, jnp.int32))
+            eff = max(1, min(self.decode_chunk, self.L - 1))
+            jit = self._decode_jit.get(eff)
+            if jit is None:
+                jit = self._decode_jit[eff] = self._decode_fn()
+            from ..framework import random as _fr
+
+            keys = jax.random.split(_fr.get_rng_key(), eff)
+            B = self.n_slots
+            args = (params, buffers, self.caches)
+            if self.paged:
+                args += (self._pt_dev,)
+            args += (jnp.asarray(np.full((B, 1), self.pad, np.int32)),
+                     jnp.zeros((B,), jnp.int32),
+                     jnp.zeros((B,), bool),
+                     jnp.ones((B,), jnp.float32),
+                     jnp.ones((B,), jnp.float32), keys)
+            _, self.caches = jit(*args)
+        dt = time.perf_counter() - t0
+        _M_WARMUP_S.set(dt)
+        return dt
+
     def _host_select(self, row, req):
         """First (admission) token: host-side mirror of _select_rows."""
         if not req.do_sample:
@@ -556,6 +942,43 @@ class LLMEngine:
 
     def _decode_fn(self):
         model = self.model
+
+        if self.paged:
+            def run(params, buffers, caches, page_tbl, tokens, pos,
+                    do_sample, temperature, top_p, keys):
+                restore = model.bind_functional_state(params, buffers)
+                try:
+                    with tape.no_grad():
+                        def tick(carry, key):
+                            caches, tok, p = carry
+                            # engine-side caches hold only the page POOLS
+                            # (k, v[, ks, vs]); pos and the page table are
+                            # threaded in here so the donated pytree never
+                            # aliases the shared table nl times
+                            t_caches = [
+                                (Tensor(c[0]), Tensor(c[1]), p,
+                                 Tensor(page_tbl))
+                                + tuple(Tensor(x) for x in c[2:])
+                                for c in caches]
+                            logits, new_caches = model.generate_step(
+                                Tensor(tok), caches=t_caches)
+                            raw = []
+                            for c in new_caches:
+                                vals = tuple(
+                                    x._value if isinstance(x, Tensor) else x
+                                    for x in c)
+                                raw.append((vals[0], vals[1]) + vals[4:])
+                            nxt = _select_rows(logits._value[:, -1], key,
+                                               do_sample, temperature, top_p)
+                            return (raw, nxt[:, None], p + 1), nxt
+
+                        (caches, _, _), toks = jax.lax.scan(
+                            tick, (caches, tokens, pos), keys)
+                finally:
+                    restore()
+                return toks.T, caches  # [B, chunk]
+
+            return jax.jit(run, donate_argnums=(2,))
 
         def run(params, buffers, caches, tokens, pos, do_sample, temperature,
                 top_p, keys):
@@ -607,7 +1030,11 @@ class LLMEngine:
     def _step_locked(self):
         self._expire_queued()
         self._expire_slots()
-        self._admit()
+        if self.paged:
+            self._admit_paged()
+            self._update_page_gauges()
+        else:
+            self._admit()
         _M_QUEUE_DEPTH.set(self._pending.qsize())
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
         _M_ACTIVE_SLOTS.set(len(active))
@@ -617,6 +1044,13 @@ class LLMEngine:
         # finished by the previous tick's done-check, so headroom >= 1)
         headroom = self.L - 1 - int(self.slot_pos[active].max())
         eff = max(1, min(self.decode_chunk, headroom))
+        if self.paged:
+            # grow page tables to cover this tick's writes; slots the pool
+            # cannot cover any longer are preempted (shed, not wedged)
+            active = self._ensure_decode_pages(active, eff)
+            self._update_page_gauges()
+            if not active:
+                return 0
         jit = self._decode_jit.get(eff)
         if jit is None:
             jit = self._decode_jit[eff] = self._decode_fn()
@@ -631,9 +1065,19 @@ class LLMEngine:
         from ..framework import random as _fr
 
         keys = jax.random.split(_fr.get_rng_key(), eff)
+        args = (self._params, self._buffers, self.caches)
+        if self.paged:
+            # decode sees a table with INACTIVE slots masked to the trash
+            # page: a mid-prefill slot already owns real pages, and the
+            # shared step's garbage scatter for it must not clobber the
+            # prompt rows the chunked prefill has already written
+            pt = self._pt_host.copy()
+            for i, r in enumerate(self.slot_req):
+                if r is None:
+                    pt[i, :] = 0
+            args += (jnp.asarray(pt),)
         nxt_dev, new_caches = jit(
-            self._params, self._buffers, self.caches, tokens, pos,
-            do_s, temp, topp, keys)
+            *args, tokens, pos, do_s, temp, topp, keys)
         # the returned tuples carry advanced pos at slot [2], but the
         # engine's [B] slot_pos vector stays authoritative — each tick
         # rebuilds the per-slot positions (finished slots do not advance)
@@ -698,6 +1142,7 @@ class LLMEngine:
                     and self._clock() > req.deadline:
                 self.slot_req[i] = None
                 self.last_token[i] = self.pad
+                self._release_pages(i)
                 _M_EXPIRED.labels(where="inflight").inc()
                 _fail_future(req.future, DeadlineExceededError(
                     f"request deadline exceeded after "
@@ -707,6 +1152,7 @@ class LLMEngine:
         req = self.slot_req[slot]
         self.slot_req[slot] = None
         self.last_token[slot] = self.pad
+        self._release_pages(slot)
         if req is not None:
             _M_COMPLETED.inc()
             if req.submit_ts is not None:

@@ -41,7 +41,8 @@ def _select(logits, key, do_sample, temperature, top_k, top_p):
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-             pad_token_id=0, cache_dtype=None):
+             pad_token_id=0, cache_dtype=None, kv_layout=None,
+             page_size=128):
     """Generate `max_new_tokens` continuations of `input_ids` [B, S0].
 
     Returns int32 ids [B, max_new_tokens]; once a row emits `eos_token_id`
@@ -53,6 +54,13 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     decode step streams: the Pallas decode-attention kernel
     (ops/decode_attention.py) reads the int8 buffers directly and
     dequantizes in VMEM — capacity and speed lever in one.
+
+    kv_layout="paged" decodes through the PAGED cache (global page pool +
+    per-row identity page tables, `page_size` tokens per page) — the
+    serving engine's layout, exposed here so the ragged paged kernel can be
+    parity-tested and benchmarked against the dense static path with no
+    server in the loop.  Greedy outputs are identical to the static
+    layout's: same math, different residency.
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
@@ -73,9 +81,17 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             f"{type(model).__name__} does not support the int8 kv-cache "
             "layout (its attention only understands the (k, v, pos) tuple); "
             "use the default cache_dtype")
+    if kv_layout not in (None, "paged"):
+        raise ValueError(f"kv_layout must be None or 'paged', got {kv_layout!r}")
+    if kv_layout == "paged" and not getattr(model, "_supports_paged_cache",
+                                            False):
+        raise ValueError(
+            f"{type(model).__name__} does not support the paged kv-cache "
+            "layout; use the default kv_layout")
+    page_size = int(page_size)
     cache_key = (B, S0, int(max_new_tokens), bool(do_sample), float(temperature),
                  int(top_k), float(top_p), eos, int(pad_token_id),
-                 bool(model.training), cache_dtype)
+                 bool(model.training), cache_dtype, kv_layout, page_size)
     gen_cache = model.__dict__.setdefault("_generate_cache", {})
     if cache_key in gen_cache:
         key = _random.get_rng_key()
@@ -92,8 +108,39 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                 # convert the prefill's concat-caches into HEAD-MAJOR static
                 # buffers [B, H, L, D]; L is padded up to a multiple of 128 so
                 # the Pallas decode kernel's key blocks tile cleanly (the
-                # padded tail is never valid, the kernel masks by position)
-                L_pad = ((total + 127) // 128) * 128
+                # padded tail is never valid, the kernel masks by position).
+                # kv_layout="paged" additionally pads to whole pages and
+                # reshapes each row's buffer into page-pool rows behind an
+                # identity page table (page 0 stays the reserved trash page)
+                unit = 128
+                if kv_layout == "paged":
+                    import math
+
+                    unit = page_size * 128 // math.gcd(page_size, 128)
+                L_pad = ((total + unit - 1) // unit) * unit
+                n_pages = L_pad // page_size if kv_layout == "paged" else 0
+
+                def to_pool(x):  # [B, H, L_pad, D] -> [1 + B*M, H, ps, D]
+                    Bb, H, L, D = x.shape
+                    pg = x.reshape(Bb, H, n_pages, page_size, D)
+                    pg = jnp.transpose(pg, (0, 2, 1, 3, 4))
+                    pg = pg.reshape(Bb * n_pages, H, page_size, D)
+                    return jnp.concatenate(
+                        [jnp.zeros((1,) + pg.shape[1:], pg.dtype), pg], axis=0)
+
+                def to_spool(s):  # [B, H, L_pad] -> [1 + B*M, H, ps]
+                    Bb, H, L = s.shape
+                    pg = s.reshape(Bb, H, n_pages, page_size)
+                    pg = jnp.transpose(pg, (0, 2, 1, 3))
+                    pg = pg.reshape(Bb * n_pages, H, page_size)
+                    return jnp.concatenate(
+                        [jnp.full((1,) + pg.shape[1:], 1e-8, pg.dtype), pg],
+                        axis=0)
+
+                page_tbl = None
+                if kv_layout == "paged":
+                    page_tbl = (1 + jnp.arange(B * n_pages, dtype=jnp.int32)
+                                ).reshape(B, n_pages)
                 static = []
                 for (k, v) in caches:
                     pad = [(0, 0), (0, 0), (0, L_pad - S0), (0, 0)]
@@ -105,7 +152,15 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
 
                         kq, ks = _quantize_kv(kp)
                         vq, vs = _quantize_kv(vp)
-                        static.append((kq, vq, pos, ks, vs))
+                        if kv_layout == "paged":
+                            static.append((to_pool(kq), to_pool(vq), pos,
+                                           page_tbl, to_spool(ks),
+                                           to_spool(vs)))
+                        else:
+                            static.append((kq, vq, pos, ks, vs))
+                    elif kv_layout == "paged":
+                        static.append((to_pool(kp), to_pool(vp), pos,
+                                       page_tbl))
                     else:
                         static.append((kp, vp, pos))
                 key, sub = jax.random.split(key)

@@ -60,7 +60,19 @@ class GPTBlock(nn.Layer):
         qkv = self.qkv(h).reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn_mask = None
-        if cache is not None and len(cache) in (3, 5):
+        if cache is not None and len(cache) in (4, 6):
+            # PAGED layout (kv_cache.py paged contract): scatter into the
+            # global page pool, attend through the slot's page table —
+            # decode S==1 hits the ragged paged Pallas kernel, chunked
+            # prefill (S>1) the gathered dense math
+            from .kv_cache import paged_attention_update
+
+            offset = cache[2]
+            new_cache, attn = paged_attention_update(cache, q, k, v, offset)
+            x = x + self.drop(self.proj(attn.reshape([B, S, -1])))
+            x = x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+            return x, new_cache
+        elif cache is not None and len(cache) in (3, 5):
             # static head-major (k_buf, v_buf, pos) layout for the compiled
             # generate loop; the 5-tuple adds (k_scale, v_scale) for the int8
             # cache (kv_cache._quantize_kv) — the decode-attention kernel
@@ -130,9 +142,10 @@ class GPTModel(nn.Layer):
         use_cache = use_cache or caches is not None
         if use_cache and caches is None:
             caches = [None] * len(self.h)
-        if caches is not None and caches[0] is not None and len(caches[0]) in (3, 5):
-            # static cache (plain 3-tuple or int8 5-tuple): the live offset is
-            # at [2] in both layouts; the legacy growing (k, v) pair falls to
+        if caches is not None and caches[0] is not None \
+                and len(caches[0]) in (3, 4, 5, 6):
+            # static or paged cache: the live offset is at [2] in every
+            # fixed-capacity layout; the legacy growing (k, v) pair falls to
             # the elif, where the past length IS the k buffer's axis-1 extent
             import jax.numpy as jnp
 
@@ -163,6 +176,7 @@ class GPTModel(nn.Layer):
 
 class GPTForCausalLM(nn.Layer):
     _supports_quant_cache = True  # GPTBlock understands the 5-tuple
+    _supports_paged_cache = True  # ... and the paged 4/6-tuples
 
     def __init__(self, config: GPTConfig):
         super().__init__()
@@ -201,12 +215,26 @@ class GPTForCausalLM(nn.Layer):
             (hidden,), name="prefill_last")
         return self.lm_head(last), caches
 
+    def prefill_chunk_step(self, input_ids, caches, last_index):
+        """One chunk of an incremental paged prefill (see llama.py)."""
+        import jax
+
+        from ..tensor.tensor import apply_op
+
+        hidden, caches = self.gpt(input_ids, caches=caches, use_cache=True)
+        last = apply_op(
+            lambda h: jax.lax.dynamic_slice_in_dim(h, last_index, 1, 1),
+            (hidden,), name="prefill_chunk_last")
+        return self.lm_head(last), caches
+
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 pad_token_id=0, cache_dtype=None):
+                 pad_token_id=0, cache_dtype=None, kv_layout=None,
+                 page_size=128):
         """Compiled decode loop on a static kv-cache (models/generation.py)."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
                     top_k, top_p, eos_token_id, pad_token_id,
-                    cache_dtype=cache_dtype)
+                    cache_dtype=cache_dtype, kv_layout=kv_layout,
+                    page_size=page_size)

@@ -1,13 +1,32 @@
-"""Shared static kv-cache layouts for the compiled generate() loop.
+"""Shared static + paged kv-cache layouts for the compiled decode loops.
 
-Two layouts, distinguished by tuple length (see generation.generate):
-  (k_buf, v_buf, pos)                      — plain, cache dtype = kv dtype
-  (k_q, v_q, pos, k_scale, v_scale)        — int8 + per-(head, token) absmax
-                                             scales: HALF the HBM footprint
-                                             AND half the decode stream when
-                                             the Pallas decode kernel runs
+Four layouts, distinguished by tuple length (see generation.generate and
+inference/llm_server.py):
+  (k_buf, v_buf, pos)                      — plain static, cache dtype = kv dtype
+  (k_pages, v_pages, pos, page_tbl)        — PAGED plain: global page pool
+                                             [P, H, page_size, D] + per-slot
+                                             page tables [B, max_pages]
+  (k_q, v_q, pos, k_scale, v_scale)        — int8 static + per-(head, token)
+                                             absmax scales: HALF the HBM
+                                             footprint AND half the decode
+                                             stream when the Pallas decode
+                                             kernel runs
                                              (ops/decode_attention.py
                                              dequantizes in VMEM)
+  (k_pages, v_pages, pos, page_tbl,
+   k_scale_pages, v_scale_pages)           — PAGED int8: scale pools are
+                                             [P, H, page_size] f32
+
+Paged layout contract (the vLLM/Ragged-Paged-Attention design, TPU-native):
+  - page 0 is the TRASH page: never allocated to a slot; unused page-table
+    entries point at it, so masked/padded scatters land there instead of in
+    another slot's memory, and reads never see it (valid-length masking).
+  - a token at absolute position t of slot b lives in page
+    page_tbl[b, t // page_size] at row t % page_size; distinct live slots
+    never share a page, so the vectorized scatter has no write collisions
+    outside the trash page.
+  - capacity is bounded by ACTUAL sequence lengths rounded up to a page,
+    not by max_seq_len — the whole point: admission is by free pages.
 
 Buffers are HEAD-MAJOR [B, H, L, D] (scales [B, H, L]): each (batch, head)
 streams contiguous [L, D] keys/values — the layout the decode kernel and the
@@ -96,3 +115,108 @@ def update_quant_cache(cache, k, v, offset, out_dtype):
     k_buf, k_sc = apply_op(upd_q, (cache[0], cache[3], k), name="kv_scatter_q")
     v_buf, v_sc = apply_op(upd_q, (cache[1], cache[4], v), name="kv_scatter_q")
     return (k_buf, v_buf, offset + S, k_sc, v_sc), k_buf, v_buf, k_sc, v_sc
+
+
+# ------------------------------------------------------------------- paged
+
+TRASH_PAGE = 0  # reserved pool slot: padding/garbage writes land here
+
+
+def pages_for(n_tokens, page_size):
+    """Pages needed to hold n_tokens (host-side allocator arithmetic)."""
+    return -(-int(n_tokens) // int(page_size))
+
+
+def _token_pages_rows(pos, page_tbl, S, page_size, max_pages):
+    """Per-token (page id, row) for S new tokens starting at `pos` (scalar
+    or [B]).  Positions past the table's coverage (a padded prefill tail
+    overflowing max_pages * page_size) route to TRASH_PAGE explicitly — a
+    clip to the last entry would alias a fully-populated table's REAL last
+    page and clobber live rows.  Within coverage, unallocated entries
+    already point at TRASH_PAGE by the engine's convention."""
+    B = page_tbl.shape[0]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32), (B,))
+    tpos = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]  # [B, S]
+    in_table = tpos < max_pages * page_size
+    pidx = jnp.clip(tpos // page_size, 0, max_pages - 1)
+    page = jnp.take_along_axis(page_tbl, pidx, axis=1)             # [B, S]
+    page = jnp.where(in_table, page, TRASH_PAGE)
+    return page, tpos % page_size
+
+
+def _paged_scatter(pool, hm, pos, page_tbl):
+    """Write head-major new kv [B, H, S, D] into the page pool
+    [P, H, page_size, D] at absolute positions pos..pos+S-1 of each slot,
+    routed through that slot's page-table row."""
+    H, ps = pool.shape[1], pool.shape[2]
+    S = hm.shape[2]
+    page, row = _token_pages_rows(pos, page_tbl, S, ps, page_tbl.shape[1])
+    hi = jnp.arange(H)[None, None, :]
+    vals = jnp.transpose(hm, (0, 2, 1, 3))  # [B, S, H, D]
+    return pool.at[page[..., None], hi, row[..., None]].set(vals)
+
+
+def _paged_scatter_scale(spool, scale, pos, page_tbl):
+    """Same routing for the f32 scale pool [P, H, page_size]; scale arrives
+    head-major [B, H, S]."""
+    H, ps = spool.shape[1], spool.shape[2]
+    S = scale.shape[2]
+    page, row = _token_pages_rows(pos, page_tbl, S, ps, page_tbl.shape[1])
+    hi = jnp.arange(H)[None, None, :]
+    vals = jnp.transpose(scale, (0, 2, 1))  # [B, S, H]
+    return spool.at[page[..., None], hi, row[..., None]].set(vals)
+
+
+def update_paged_cache(cache, k, v, offset):
+    """Scatter new k/v [B, S, H, D] into the paged 4-tuple layout.  Returns
+    (new_cache, k_pages, v_pages) — the pools plus the (unchanged) page
+    table go straight to paged_decode_attention."""
+    S = k.shape[1]
+    upd = lambda pool, kv, tbl: _paged_scatter(  # noqa: E731
+        pool, _to_head_major(kv.astype(pool.dtype)), offset, tbl)
+    k_pool = apply_op(upd, (cache[0], k, cache[3]), name="kv_paged_scatter")
+    v_pool = apply_op(upd, (cache[1], v, cache[3]), name="kv_paged_scatter")
+    return (k_pool, v_pool, offset + S, cache[3]), k_pool, v_pool
+
+
+def update_paged_quant_cache(cache, k, v, offset):
+    """Quantize + scatter new k/v [B, S, H, D] into the paged int8 6-tuple.
+    Returns (new_cache, k_pages, v_pages, k_scale_pages, v_scale_pages)."""
+    S = k.shape[1]
+
+    def upd_q(pool, spool, kv, tbl):
+        kv_q, scale = _quantize_kv(_to_head_major(kv))
+        return (_paged_scatter(pool, kv_q, offset, tbl),
+                _paged_scatter_scale(spool, scale, offset, tbl))
+
+    k_pool, k_sc = apply_op(upd_q, (cache[0], cache[4], k, cache[3]),
+                            name="kv_paged_scatter_q")
+    v_pool, v_sc = apply_op(upd_q, (cache[1], cache[5], v, cache[3]),
+                            name="kv_paged_scatter_q")
+    return ((k_pool, v_pool, offset + S, cache[3], k_sc, v_sc),
+            k_pool, v_pool, k_sc, v_sc)
+
+
+def paged_attention_update(cache, q, k, v, offset):
+    """Scatter new k/v [B, S, H, D] into the paged cache, then attend q
+    through the page table (ragged paged Pallas kernel at S == 1 on TPU,
+    gathered dense math otherwise) — the ONE paged decode / chunked-prefill
+    hot path shared by every attention family that understands the paged
+    4/6-tuples.  Returns (new_cache, out [B, S, Hq, D])."""
+    from ..ops.decode_attention import paged_decode_attention
+
+    if len(cache) == 6:
+        new_cache, k_q, v_q, k_sc, v_sc = update_paged_quant_cache(
+            cache, k, v, offset)
+        out = apply_op(
+            lambda qq, kk, vv, pt, ks, vs: paged_decode_attention(
+                qq, kk, vv, offset, pt, ks, vs),
+            (q, k_q, v_q, cache[3], k_sc, v_sc),
+            name="paged_decode_attention")
+    else:
+        new_cache, k_p, v_p = update_paged_cache(cache, k, v, offset)
+        out = apply_op(
+            lambda qq, kk, vv, pt: paged_decode_attention(
+                qq, kk, vv, offset, pt),
+            (q, k_p, v_p, cache[3]), name="paged_decode_attention")
+    return new_cache, out

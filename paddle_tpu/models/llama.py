@@ -65,6 +65,7 @@ def _rope_cache(head_dim, max_pos, theta):
 
 from .kv_cache import (  # noqa: E402  (shared cache layouts; re-exported
     _quantize_kv,         # for backward compat — tests import from here)
+    paged_attention_update,
     update_plain_cache,
     update_quant_cache,
 )
@@ -170,15 +171,31 @@ class LlamaAttention(nn.Layer):
         # compiles once.  A 5-tuple (k_q, v_q, pos, k_scale, v_scale) is the
         # int8-quantized variant: per-(head, token) absmax scales — HALF the
         # cache HBM footprint AND half the decode stream (the Pallas decode
-        # kernel dequantizes in VMEM; ops/decode_attention.py).
+        # kernel dequantizes in VMEM; ops/decode_attention.py).  The 4/6-tuple
+        # PAGED variants route through a global page pool + per-slot page
+        # tables (kv_cache.py paged contract): same math, but capacity scales
+        # with actual sequence lengths — the serving engine's layout.
         static_cache = cache is not None and len(cache) in (3, 5)
         quant_cache = cache is not None and len(cache) == 5
-        if static_cache:
+        paged_cache = cache is not None and len(cache) in (4, 6)
+        if static_cache or paged_cache:
             offset = cache[2]
         else:
             offset = cache[0].shape[1] if cache is not None else 0
         q = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (q, rope_cos, rope_sin), name="rope")
         k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, rope_cos, rope_sin), name="rope")
+
+        if paged_cache and attn_mask is None:
+            # paged decode / chunked-prefill path: scatter into the page
+            # pool, then attend through the page table (ragged paged Pallas
+            # kernel at S == 1 on TPU; gathered dense math for prefill
+            # chunks and CPU)
+            new_cache, out = paged_attention_update(cache, q, k, v, offset)
+            out = out.reshape([B, S, self.num_heads * self.head_dim])
+            out = self.o_proj(out)
+            if use_cache:
+                return out, new_cache
+            return out
 
         if static_cache and attn_mask is None:
             # decode hot path: single-query attention straight off the
@@ -344,6 +361,7 @@ class LlamaModel(nn.Layer):
 
 class LlamaForCausalLM(nn.Layer):
     _supports_quant_cache = True  # LlamaAttention understands the 5-tuple
+    _supports_paged_cache = True  # ... and the paged 4/6-tuples
 
     def __init__(self, config: LlamaConfig):
         super().__init__()
@@ -391,14 +409,35 @@ class LlamaForCausalLM(nn.Layer):
             (hidden,), name="prefill_last")
         return self.lm_head(last), caches
 
+    def prefill_chunk_step(self, input_ids, caches, last_index):
+        """One CHUNK of an incremental (paged) prefill: input_ids [B, C] are
+        the next C prompt tokens of each row (pad-padded past `last_index`
+        on the final chunk), caches carry the paged pools + page tables with
+        pos = tokens already prefilled.  Returns (logits [B, 1, V] at
+        `last_index`, caches) — the logits only matter on the final chunk;
+        earlier chunks pay one [B, 1, V] head gemv for shape stability
+        (llm_server.py compiles exactly ONE chunk program, killing the
+        per-bucket prefill zoo)."""
+        import jax
+
+        hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)
+        last = apply_op(
+            lambda h: jax.lax.dynamic_slice_in_dim(h, last_index, 1, 1),
+            (hidden,), name="prefill_chunk_last")
+        return self.lm_head(last), caches
+
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
-                 pad_token_id=0, cache_dtype=None):
+                 pad_token_id=0, cache_dtype=None, kv_layout=None,
+                 page_size=128):
         """Compiled autoregressive decoding on a static kv-cache — one XLA
         program for prefill + the whole token scan (models/generation.py).
-        cache_dtype='int8' halves the kv-cache HBM footprint."""
+        cache_dtype='int8' halves the kv-cache HBM footprint;
+        kv_layout='paged' decodes through the paged pool + page-table
+        layout (the serving engine's cache) for parity/benchmarking."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
                     top_k, top_p, eos_token_id, pad_token_id,
-                    cache_dtype=cache_dtype)
+                    cache_dtype=cache_dtype, kv_layout=kv_layout,
+                    page_size=page_size)

@@ -239,3 +239,226 @@ def decode_attention(q, k, v, offset, k_scale=None, v_scale=None, scale=None,
         return _decode_pallas(q, k, v, offset, k_scale, v_scale, scale, bk,
                               interpret)
     return _decode_dense(q, k, v, offset, k_scale, v_scale, scale)
+
+
+# ------------------------------------------------------------------- paged
+#
+# Ragged paged attention (the arxiv 2604.15464 design, adapted to this
+# stack's head-major page layout): the kv cache is a global page pool
+# [P, Hkv, page_size, D] plus per-slot page tables [B, max_pages] — capacity
+# scales with ACTUAL sequence lengths, not max_seq_len.  The decode kernel
+# walks each slot's pages through a scalar-prefetched page table: the
+# BlockSpec index map reads pt_ref[b, p], so the pipeline DMAs exactly the
+# pages the slot owns.  Slots shorter than max_pages point their unused
+# table entries at the trash page (kv_cache.TRASH_PAGE); consecutive equal
+# block indices elide the re-fetch, so the ragged tail costs ~one trash-page
+# DMA per (slot, head-group), with the compute skipped by the valid-length
+# mask.
+
+
+def gather_pages(pool, page_tbl):
+    """[P, H, ps, D] pool + [B, M] table -> contiguous [B, H, M*ps, D]
+    (scale pools [P, H, ps] -> [B, H, M*ps]).  The dense fallback's view of
+    the paged cache; also the test oracle."""
+    g = pool[page_tbl]  # [B, M, H, ps, ...]
+    if g.ndim == 5:
+        B, M, H, ps, D = g.shape
+        return jnp.transpose(g, (0, 2, 1, 3, 4)).reshape(B, H, M * ps, D)
+    B, M, H, ps = g.shape
+    return jnp.transpose(g, (0, 2, 1, 3)).reshape(B, H, M * ps)
+
+
+def _paged_kernel(len_ref, pt_ref, q_ref, k_ref, v_ref, *refs, ps, G,
+                  rep, scale, quant):
+    """One (slot, kv-head-group, page) grid step: fold this page's keys and
+    values into the slot's online-softmax state (m/l/acc VMEM scratch that
+    persists across the sequential page axis).  int8 pages dequantize in
+    VMEM: payload cast once per page, per-(head, token) scales applied to
+    the score/probability rows outside the dots (the static kernel's
+    recipe)."""
+    if quant:  # inputs continue with the scale pages, THEN output + scratch
+        ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref = vs_ref = None
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    M = pl.num_programs(2)
+    valid = len_ref[b]
+    Hg = G * rep
+    D = q_ref.shape[-1]
+    Hp = q_ref.shape[-2]  # Hg padded to the 8-sublane tile
+
+    @pl.when(p == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(p * ps < valid)
+    def _page():
+        if quant:
+            kb = k_ref[0].astype(jnp.bfloat16)  # [G, ps, D]
+            vb = v_ref[0].astype(jnp.bfloat16)
+        else:
+            kb, vb = k_ref[0], v_ref[0]
+        rows_s = []
+        for g in range(G):
+            kg = kb[g]
+            for r in range(rep):
+                h = g * rep + r
+                qh = q_ref[0, 0, h:h + 1, :]  # [1, D]
+                rows_s.append(jax.lax.dot_general(
+                    qh, kg, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32))
+        s = jnp.concatenate(rows_s, axis=0) * scale  # [Hg, ps]
+        if quant:
+            ks = ks_ref[0].reshape(G, ps)
+            s = s * jnp.repeat(ks, rep, axis=0) if rep > 1 else s * ks
+        kpos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
+        s = jnp.where(kpos < valid, s, NEG_INF)
+        m_prev = m_ref[:Hg, :1]
+        l_prev = l_ref[:Hg, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        pexp = jnp.exp(s - m_new)  # [Hg, ps] f32
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(pexp, axis=1, keepdims=True)
+        if quant:
+            vs = vs_ref[0].reshape(G, ps)
+            pexp = pexp * jnp.repeat(vs, rep, axis=0) if rep > 1 \
+                else pexp * vs
+        pb = pexp.astype(jnp.bfloat16 if quant else vb.dtype)
+        outs = []
+        for g in range(G):
+            outs.append(jax.lax.dot_general(
+                pb[g * rep:(g + 1) * rep], vb[g], (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        pv = jnp.concatenate(outs, axis=0)  # [Hg, D]
+        m_ref[:Hg, :1] = m_new
+        l_ref[:Hg, :1] = l_new
+        acc_ref[:Hg, :] = acc_ref[:Hg, :] * corr + pv
+
+    @pl.when(p == M - 1)
+    def _emit():
+        l = l_ref[:Hg, :1]
+        out = (acc_ref[:Hg, :]
+               / jnp.where(l <= 0.0, 1.0, l)).astype(o_ref.dtype)
+        if Hp != Hg:
+            out = jnp.concatenate(
+                [out, jnp.zeros((Hp - Hg, D), o_ref.dtype)], axis=0)
+        o_ref[0, 0] = out
+
+
+def _pick_group_paged(Hkv, ps, D, quant):
+    """kv heads per grid step: page blocks are small (one page, not the
+    whole sequence), so the bound is the double-buffered page pair staying
+    comfortably inside VMEM."""
+    per_head = ps * D * (1 if quant else 2) * 2  # k + v page blocks
+    for g in (16, 8, 4, 2, 1):
+        if Hkv % g == 0 and g * per_head <= 2 * 1024 * 1024:
+            return g
+    return 1
+
+
+def _paged_pallas(q, k_pages, v_pages, lengths, page_tbl, k_scale, v_scale,
+                  scale, interpret):
+    B, S, H, D = q.shape
+    Hkv, ps = k_pages.shape[1], k_pages.shape[2]
+    M = page_tbl.shape[1]
+    rep = H // Hkv
+    quant = k_scale is not None
+    G = _pick_group_paged(Hkv, ps, D, quant)
+    ng = Hkv // G
+    Hg = G * rep
+    Hp = max(Hg, 8)
+    qg = jnp.transpose(q, (0, 2, 1, 3)).reshape(B, ng, Hg, D)
+    if Hp != Hg:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Hp - Hg), (0, 0)))
+    lengths = jnp.asarray(lengths, jnp.int32).reshape(B)
+    page_tbl = jnp.asarray(page_tbl, jnp.int32)
+
+    # index maps receive the prefetched (lengths, page-table) refs last; the
+    # page axis walks pt_ref[b, p] — THE ragged gather
+    in_specs = [
+        pl.BlockSpec((1, 1, Hp, D), lambda b, g, p, _len, _pt: (b, g, 0, 0)),
+        pl.BlockSpec((1, G, ps, D),
+                     lambda b, g, p, _len, pt: (pt[b, p], g, 0, 0)),
+        pl.BlockSpec((1, G, ps, D),
+                     lambda b, g, p, _len, pt: (pt[b, p], g, 0, 0)),
+    ]
+    args = [qg, k_pages, v_pages]
+    if quant:
+        sb = ps // 128
+        in_specs += [
+            pl.BlockSpec((1, G, sb, 128),
+                         lambda b, g, p, _len, pt: (pt[b, p], g, 0, 0)),
+            pl.BlockSpec((1, G, sb, 128),
+                         lambda b, g, p, _len, pt: (pt[b, p], g, 0, 0)),
+        ]
+        P = k_pages.shape[0]
+        args += [k_scale.reshape(P, Hkv, sb, 128),
+                 v_scale.reshape(P, Hkv, sb, 128)]
+
+    kernel = functools.partial(_paged_kernel, ps=ps, G=G, rep=rep,
+                               scale=scale, quant=quant)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, ng, M),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec(
+                (1, 1, Hp, D), lambda b, g, p, _len, _pt: (b, g, 0, 0)),
+            scratch_shapes=[pltpu.VMEM((Hp, 128), jnp.float32),
+                            pltpu.VMEM((Hp, 128), jnp.float32),
+                            pltpu.VMEM((Hp, D), jnp.float32)],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, ng, Hp, D), q.dtype),
+        interpret=interpret,
+        compiler_params=None if interpret else pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+    )(lengths, page_tbl, *args)
+    out = out[:, :, :Hg, :].reshape(B, H, 1, D)
+    return out.transpose(0, 2, 1, 3)  # [B, 1, H, D]
+
+
+def _paged_dense(q, k_pages, v_pages, offset, page_tbl, k_scale, v_scale,
+                 scale):
+    """XLA fallback (CPU tests, chunked-prefill S > 1, odd page sizes):
+    gather the slot's pages into a contiguous view, then the dense math."""
+    k = gather_pages(k_pages, page_tbl)
+    v = gather_pages(v_pages, page_tbl)
+    if k_scale is not None:
+        k = k.astype(q.dtype) * gather_pages(
+            k_scale, page_tbl).astype(q.dtype)[..., None]
+        v = v.astype(q.dtype) * gather_pages(
+            v_scale, page_tbl).astype(q.dtype)[..., None]
+        k_scale = v_scale = None
+    return _decode_dense(q, k, v, offset, None, None, scale)
+
+
+def paged_decode_attention(q, k_pages, v_pages, offset, page_tbl,
+                           k_scale=None, v_scale=None, scale=None,
+                           interpret=None):
+    """Attention of q [B, S, H, D] against a PAGED cache: pool
+    [P, Hkv, page_size, D] + page table [B, max_pages], with the first
+    offset + s positions of each slot valid for query position s.  int8
+    pools pass per-(head, token) scale pools [P, Hkv, page_size].
+    Returns [B, S, H, D] in q's dtype."""
+    B, S, H, D = q.shape
+    Hkv, ps = k_pages.shape[1], k_pages.shape[2]
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+    lengths = jnp.broadcast_to(
+        jnp.asarray(offset, jnp.int32), (B,)).astype(jnp.int32) + S
+    # ps % 128 == 0 keeps every page block (and the reshaped scale pages)
+    # on clean (sublane, 128-lane) tiles; anything else is fallback-only
+    shapes_ok = (S == 1 and D % 128 == 0 and ps % 128 == 0
+                 and H % Hkv == 0)
+    if shapes_ok:
+        return _paged_pallas(q, k_pages, v_pages, lengths, page_tbl,
+                             k_scale, v_scale, scale, interpret)
+    return _paged_dense(q, k_pages, v_pages, offset, page_tbl,
+                        k_scale, v_scale, scale)

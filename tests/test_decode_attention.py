@@ -10,7 +10,8 @@ import pytest
 import jax.numpy as jnp
 
 from paddle_tpu.ops.decode_attention import (
-    _decode_dense, _decode_pallas, decode_attention)
+    _decode_dense, _decode_pallas, _paged_dense, _paged_pallas,
+    decode_attention, gather_pages, paged_decode_attention)
 from paddle_tpu.models.kv_cache import _quantize_kv
 
 pytestmark = [pytest.mark.quick]
@@ -75,4 +76,88 @@ def test_dispatcher_falls_back_for_multi_query():
     # rows see strictly growing prefixes: position 1 attends one more key
     o0 = decode_attention(q, k, v, offset=10, interpret=True)
     np.testing.assert_allclose(np.asarray(out[:, :1]), np.asarray(o0),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------------- ragged paged attention
+
+
+def _mk_paged(B=3, H=8, Hkv=4, D=128, ps=128, M=4, seed=0,
+              lens=(37, 300, 511), poison_trash=True):
+    """Page pool + shuffled per-slot page tables with ragged lengths;
+    unused table entries point at the (poisoned) trash page."""
+    rng = np.random.RandomState(seed)
+    P = 1 + B * M
+    q = jnp.asarray(rng.randn(B, 1, H, D).astype(np.float32) * 0.3)
+    kp = jnp.asarray(rng.randn(P, Hkv, ps, D).astype(np.float32) * 0.3)
+    vp = jnp.asarray(rng.randn(P, Hkv, ps, D).astype(np.float32) * 0.3)
+    free = list(range(1, P))
+    rng.shuffle(free)
+    pt = np.zeros((B, M), np.int32)
+    for b in range(B):
+        for j in range(-(-(int(lens[b]) + 1) // ps)):
+            pt[b, j] = free.pop()
+    if poison_trash:  # a leak from the trash page would blow the output up
+        kp = kp.at[0].set(1e4)
+        vp = vp.at[0].set(1e4)
+    return q, kp, vp, jnp.asarray(pt), jnp.asarray(lens, jnp.int32)
+
+
+def test_paged_kernel_matches_dense_gather_ragged():
+    q, kp, vp, pt, lens = _mk_paged()
+    got = _paged_pallas(q, kp, vp, lens + 1, pt, None, None,
+                        scale=1 / 128 ** 0.5, interpret=True)
+    want = _paged_dense(q, kp, vp, lens, pt, None, None, 1 / 128 ** 0.5)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_gqa_head_mapping():
+    q, kp, vp, pt, lens = _mk_paged(H=8, Hkv=2, lens=(129, 64, 400))
+    got = _paged_pallas(q, kp, vp, lens + 1, pt, None, None, scale=0.1,
+                        interpret=True)
+    want = _paged_dense(q, kp, vp, lens, pt, None, None, 0.1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_int8_dequant_in_kernel():
+    q, kp, vp, pt, lens = _mk_paged(poison_trash=False)
+    kq, ks = _quantize_kv(kp)
+    vq, vs = _quantize_kv(vp)
+    got = _paged_pallas(q, kq, vq, lens + 1, pt, ks, vs,
+                        scale=1 / 128 ** 0.5, interpret=True)
+    want = _paged_dense(q, kq, vq, lens, pt, ks, vs, 1 / 128 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=4e-4, atol=4e-4)
+
+
+def test_paged_matches_contiguous_static():
+    """The paged path is numerically the static head-major path behind a
+    page indirection: gather the pages and run the static dense oracle."""
+    q, kp, vp, pt, lens = _mk_paged(poison_trash=False)
+    got = paged_decode_attention(q, kp, vp, lens, pt, interpret=True)
+    k = gather_pages(kp, pt)
+    v = gather_pages(vp, pt)
+    want = _decode_dense(q, k, v, lens, None, None, 1 / 128 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_dispatcher_fallbacks():
+    # S = 2 (chunked prefill) -> dense path, strictly growing prefixes
+    q, kp, vp, pt, lens = _mk_paged()
+    q2 = jnp.concatenate([q, q], axis=1)
+    out = paged_decode_attention(q2, kp, vp, lens, pt, interpret=True)
+    assert out.shape == (3, 2, 8, 128)
+    o0 = paged_decode_attention(q, kp, vp, lens, pt, interpret=True)
+    np.testing.assert_allclose(np.asarray(out[:, :1]), np.asarray(o0),
+                               rtol=2e-5, atol=2e-5)
+    # page size off the 128 tile -> dense path (still correct)
+    q3, kp3, vp3, pt3, lens3 = _mk_paged(D=128, ps=32, M=8,
+                                         lens=(5, 100, 200))
+    got = paged_decode_attention(q3, kp3, vp3, lens3, pt3, interpret=True)
+    want = _paged_dense(q3, kp3, vp3, lens3, pt3, None, None, 1 / 128 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=2e-5, atol=2e-5)

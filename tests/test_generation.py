@@ -163,3 +163,32 @@ def test_gpt_int8_kv_cache_decode():
     q8 = np.asarray(m.generate(ids, max_new_tokens=6, cache_dtype="int8")._value)
     assert fp.shape == q8.shape == (2, 6)
     assert q8.min() >= 0 and q8.max() < cfg.vocab_size
+
+
+def test_paged_kv_layout_matches_dense_generate():
+    """kv_layout='paged' (page pool + identity page tables) decodes the
+    SAME greedy tokens as the dense static cache, plain and int8."""
+    model = _model()
+    prompt = np.random.RandomState(3).randint(0, 128, (2, 9)).astype(np.int32)
+    ids = paddle.to_tensor(prompt)
+    dense = np.asarray(model.generate(ids, max_new_tokens=7)._value)
+    paged = np.asarray(model.generate(ids, max_new_tokens=7,
+                                      kv_layout="paged",
+                                      page_size=16)._value)
+    np.testing.assert_array_equal(dense, paged)
+    dense8 = np.asarray(model.generate(ids, max_new_tokens=7,
+                                       cache_dtype="int8")._value)
+    paged8 = np.asarray(model.generate(ids, max_new_tokens=7,
+                                       cache_dtype="int8", kv_layout="paged",
+                                       page_size=16)._value)
+    np.testing.assert_array_equal(dense8, paged8)
+
+
+def test_paged_kv_layout_rejects_unknown():
+    import pytest
+
+    model = _model()
+    prompt = np.random.RandomState(3).randint(0, 128, (1, 4)).astype(np.int32)
+    with pytest.raises(ValueError):
+        model.generate(paddle.to_tensor(prompt), max_new_tokens=2,
+                       kv_layout="interleaved")

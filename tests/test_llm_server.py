@@ -151,6 +151,206 @@ def test_chunked_decode_matches_per_token(model):
     assert got == base[:5]
 
 
+# ------------------------------- paged kv cache + chunked prefill engine
+
+
+def _prefill_chunk_count():
+    from paddle_tpu.observability import metrics as obs
+
+    return obs.counter("llm_prefill_chunks_total", "x").value
+
+
+def test_paged_engine_parity_mixed_lengths_and_slot_reuse(model):
+    """Paged decode + chunked prefill is numerically the dense path under
+    mixed prompt lengths, more requests than slots (page/slot reuse), and
+    chunk boundaries that split prompts."""
+    rng = np.random.RandomState(21)
+    prompts = [rng.randint(0, 1024, n).astype(np.int32)
+               for n in (5, 17, 33, 9, 26)]
+    eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=16)
+    futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+    eng.run_until_complete()
+    for p, f in zip(prompts, futs):
+        assert f.result(timeout=1) == _oracle(model, p, 5)
+    st = eng.stats()
+    assert st["kv_layout"] == "paged"
+    assert st["llm_kv_pages_in_use"] == 0  # everything reclaimed
+    assert st["kv_pages_total"] == 2 * (128 // 32)
+
+
+def test_paged_chunked_prefill_matches_whole_prompt(model):
+    """Chunked prefill emits BITWISE the same greedy tokens as the dense
+    engine's whole-prompt prefill (and the solo-generate oracle), for a
+    prompt spanning several chunks including a ragged final chunk."""
+    rng = np.random.RandomState(22)
+    p = rng.randint(0, 1024, 43).astype(np.int32)  # 6 chunks of 8, ragged
+    paged = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                      kv_layout="paged", page_size=32, prefill_chunk=8)
+    n0 = _prefill_chunk_count()
+    got = paged.generate(p, max_new_tokens=6)
+    assert _prefill_chunk_count() - n0 == 6  # ceil(43 / 8)
+    assert got == _oracle(model, p, 6)
+
+
+def test_paged_prefill_tail_overflow_near_capacity(model):
+    """A prompt near max_seq_len whose final padded chunk overflows the
+    page table's coverage: the tail must spill to the trash page, not wrap
+    onto the slot's own last page (regression for the clip-vs-trash bug)."""
+    rng = np.random.RandomState(31)
+    p = rng.randint(0, 1024, 120).astype(np.int32)
+    eng = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=96)
+    # chunk 2 spans positions 96..191 — 120..127 pad inside L, 128..191 past
+    # the whole table
+    assert eng.generate(p, max_new_tokens=5) == _oracle(model, p, 5)
+
+
+def test_paged_int8_matches_dense_int8_engine(model):
+    rng = np.random.RandomState(23)
+    p = rng.randint(0, 1024, 19).astype(np.int32)
+    paged = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                      kv_layout="paged", page_size=32, prefill_chunk=16,
+                      cache_dtype="int8")
+    dense = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                      cache_dtype="int8")
+    assert paged.generate(p, max_new_tokens=4) == \
+        dense.generate(p, max_new_tokens=4)
+
+
+def test_paged_decode_chunk_crosses_page_boundaries(model):
+    """decode_chunk=4 with page_size=32: a single compiled call writes
+    tokens across a page boundary; pages grow ahead of the chunk."""
+    rng = np.random.RandomState(32)
+    p = rng.randint(0, 1024, 29).astype(np.int32)  # decode crosses row 32
+    eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=16,
+                    decode_chunk=4)
+    assert eng.generate(p, max_new_tokens=10) == _oracle(model, p, 10)
+
+
+def test_paged_long_prompt_does_not_stall_decode(model):
+    """A long prompt admitted mid-decode prefills one chunk per tick while
+    the running slot emits a token EVERY tick — the head-of-line fix,
+    asserted through the chunked-prefill counter."""
+    rng = np.random.RandomState(24)
+    eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=8)
+    fa = eng.submit(rng.randint(0, 1024, 6).astype(np.int32),
+                    max_new_tokens=40)
+    eng.step()  # admit A
+    pb = rng.randint(0, 1024, 33).astype(np.int32)  # 5 chunks of 8
+    fb = eng.submit(pb, max_new_tokens=4)
+    n0 = _prefill_chunk_count()
+    for _ in range(5):  # the whole admission of B
+        before = len(eng.slot_req[0].tokens)
+        eng.step()
+        assert len(eng.slot_req[0].tokens) == before + 1  # A never stalls
+    assert _prefill_chunk_count() - n0 == 5
+    eng.run_until_complete()
+    assert fb.result(timeout=1) == _oracle(model, pb, 4)
+
+
+def test_paged_admission_waits_for_free_pages(model):
+    """Pool sized so both requests cannot hold their full contexts at once:
+    admission/preemption is by free pages and BOTH still finish with exact
+    parity (recompute-style preemption replays the generated prefix)."""
+    rng = np.random.RandomState(25)
+    pa = rng.randint(0, 1024, 30).astype(np.int32)
+    pb = rng.randint(0, 1024, 30).astype(np.int32)
+    eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=32,
+                    num_pages=3)  # trash + 2 allocatable
+    fa = eng.submit(pa, max_new_tokens=4)
+    fb = eng.submit(pb, max_new_tokens=4)
+    eng.run_until_complete()
+    assert fa.result(timeout=1) == _oracle(model, pa, 4)
+    assert fb.result(timeout=1) == _oracle(model, pb, 4)
+    assert eng.stats()["llm_kv_pages_in_use"] == 0
+
+
+def test_paged_impossible_request_is_shed(model):
+    """A request that can never fit in the whole pool fails with
+    ServerOverloadedError instead of preempt-looping forever."""
+    from paddle_tpu.inference import ServerOverloadedError
+
+    rng = np.random.RandomState(26)
+    eng = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=32,
+                    num_pages=2)  # ONE allocatable page = 32 tokens
+    f = eng.submit(rng.randint(0, 1024, 20).astype(np.int32),
+                   max_new_tokens=60)
+    eng.run_until_complete()
+    with pytest.raises(ServerOverloadedError):
+        f.result(timeout=1)
+    assert eng.stats()["llm_kv_pages_in_use"] == 0
+
+
+def test_paged_deadline_expiry_reclaims_pages(model):
+    from paddle_tpu.inference import DeadlineExceededError
+
+    rng = np.random.RandomState(27)
+    t = [0.0]
+    eng = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=32,
+                    clock=lambda: t[0])
+    f = eng.submit(rng.randint(0, 1024, 10).astype(np.int32),
+                   max_new_tokens=50, timeout=5.0)
+    eng.step()
+    eng.step()
+    assert eng.stats()["llm_kv_pages_in_use"] > 0
+    t[0] = 10.0
+    eng.step()
+    with pytest.raises(DeadlineExceededError):
+        f.result(timeout=1)
+    assert eng.stats()["llm_kv_pages_in_use"] == 0
+
+
+def test_warmup_precompiles_paged_and_dense(model):
+    rng = np.random.RandomState(28)
+    p = rng.randint(0, 1024, 12).astype(np.int32)
+    paged = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                      kv_layout="paged", page_size=32, prefill_chunk=16)
+    dt = paged.warmup()
+    assert dt > 0.0
+    assert "chunk" in paged._prefill_jit and paged._decode_jit
+    assert paged.generate(p, max_new_tokens=5) == _oracle(model, p, 5)
+
+    dense = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                      prompt_buckets=(8, 32))
+    dense.warmup()
+    assert set(dense._prefill_jit) >= {8, 32, ("w", 8), ("w", 32)}
+    assert dense.generate(p, max_new_tokens=5) == _oracle(model, p, 5)
+
+
+def test_warmup_requires_idle_engine(model):
+    rng = np.random.RandomState(29)
+    eng = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=32)
+    eng.submit(rng.randint(0, 1024, 8).astype(np.int32), max_new_tokens=20)
+    eng.step()
+    with pytest.raises(RuntimeError):
+        eng.warmup()
+    eng.run_until_complete()
+
+
+def test_paged_engine_with_gpt_family():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(11)
+    cfg = GPTConfig.tiny(max_position_embeddings=128)
+    gpt = GPTForCausalLM(cfg)
+    gpt.eval()
+    rng = np.random.RandomState(30)
+    p = rng.randint(0, cfg.vocab_size, 21).astype(np.int32)
+    eng = LLMEngine(gpt, max_batch_slots=2, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=16)
+    got = eng.generate(p, max_new_tokens=6)
+    ids = paddle.to_tensor(np.asarray(p, np.int32)[None, :])
+    want = list(np.asarray(gpt.generate(ids, max_new_tokens=6)._value)[0])
+    assert got == want
+
+
 def test_engine_with_gpt_family():
     """The engine is model-agnostic over the generate_step/prefill_step
     contract: the GPT family (learned positions, fused qkv block) serves

@@ -181,6 +181,50 @@ def project_llama7b_hybrid256(bench, tp_cal=1.0):
     }
 
 
+def project_serving_capacity(bench):
+    """Serving-capacity axis (inference/llm_server.py): per-chip decode
+    rates and kv-cache capacity from the newest bench round, plus the paged
+    layout's capacity at the same HBM budget.  Paged numbers come from the
+    round's kv_paged_* fields when present; until a round measures them,
+    they are derived with the same mixed-length-trace accounting bench.py
+    uses (contexts 100..L in steps of 100, page_size 128) and labeled so."""
+    from bench import paged_capacity_trace  # ROOT is on sys.path
+
+    tok8 = bench.get("llama_decode_steady_tokens_per_sec")
+    dense_b = bench.get("kv_bf16_max_batch")
+    if not tok8 or not dense_b:
+        return None
+    L_ctx = bench.get("llama_decode_prompt_len", 1024) + 128
+    L_pad = ((L_ctx + 127) // 128) * 128
+    _, pages_mean = paged_capacity_trace(L_pad, 128)
+    gain = L_pad / (pages_mean * 128)
+    dense_b8 = bench.get("kv_int8_max_batch")
+    measured = "kv_paged_max_batch" in bench
+    paged_b = bench.get("kv_paged_max_batch", int(dense_b * gain))
+    paged_b8 = bench.get("kv_paged_int8_max_batch",
+                         int((dense_b8 or 0) * gain))
+    tok32q = bench.get("llama_decode_int8_b32_steady_tokens_per_sec")
+    out = {
+        "config": f"LLM decode service, 738M model @ ctx {L_pad} "
+                  "(per chip; x256 for the pod)",
+        "decode_tokens_per_sec_chip_b8": tok8,
+        "decode_tokens_per_sec_chip_b32": bench.get(
+            "llama_decode_b32_steady_tokens_per_sec"),
+        "decode_tokens_per_sec_chip_b32_int8": tok32q,
+        "kv_dense_bf16_max_batch": dense_b,
+        "kv_dense_int8_max_batch": dense_b8,
+        "kv_paged_max_batch": paged_b,
+        "kv_paged_int8_max_batch": paged_b8,
+        "paged_capacity_gain_mixed_trace": round(gain, 2),
+        "paged_numbers_source": "measured (bench kv_paged_*)" if measured
+        else "derived from dense round via the bench.py trace formula",
+    }
+    if tok32q:
+        out["pod_decode_tokens_per_sec_256chips_int8_b32"] = round(
+            tok32q * 256, 0)
+    return out
+
+
 # --------------------------------------------------------------- validation
 
 def validate_on_cpu_mesh():
@@ -295,6 +339,7 @@ def main():
         "tp_traffic_calibration": tp_cal,
         "ernie_dp256": project_ernie_dp256(bench),
         "llama7b_hybrid256": project_llama7b_hybrid256(bench, tp_cal=tp_cal),
+        "serving_capacity": project_serving_capacity(bench),
         "validation": val,
         "bench_source": os.path.basename(paths[-1]) if paths else None,
     }
@@ -320,7 +365,9 @@ def write_md(proj):
              "(reduce-scatter+allgather along x, allreduce shard along y)",
              ""]
     for key, title in (("ernie_dp256", "ERNIE/BERT-base DP-256 (north star)"),
-                       ("llama7b_hybrid256", "LLaMA-2-7B tp4 x pp8 x zero2-dp8")):
+                       ("llama7b_hybrid256", "LLaMA-2-7B tp4 x pp8 x zero2-dp8"),
+                       ("serving_capacity",
+                        "Serving capacity (paged kv cache)")):
         p = proj.get(key)
         if not p:
             continue
