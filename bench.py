@@ -747,6 +747,61 @@ def _bench_resnet(on_accel):
     return {"resnet50_images_per_sec": round(ips, 2), "resnet50_mfu": round(mfu, 4)}
 
 
+def _bench_observability(on_accel):
+    """Telemetry overhead guard (ISSUE 5): per-step wall-time delta of the
+    instrumented train step vs `observability.disable()` on the SAME
+    compiled program — future BENCH rounds catch a telemetry regression as
+    obs_overhead_us_per_step drifting up.  Runs on CPU too (the
+    instrumentation cost is host-side by construction)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu import observability as obs
+
+    batch, hidden = (256, 1024) if on_accel else (32, 64)
+    steps = 60 if on_accel else 30
+
+    paddle.seed(0)
+    model = nn.Linear(hidden, hidden)
+    opt = paddle.optimizer.SGD(learning_rate=0.01,
+                               parameters=model.parameters())
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    x = paddle.to_tensor(
+        np.random.rand(batch, hidden).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.rand(batch, hidden).astype(np.float32))
+
+    def window():
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = step(x, y)
+        float(loss.item())
+        return (time.perf_counter() - t0) / steps
+
+    out = {}
+    try:
+        step(x, y)  # compile outside both windows
+        # median of 3 per mode, interleaved so allocator/thermal drift
+        # lands on both sides
+        on_s, off_s = [], []
+        for _ in range(3):
+            obs.enable()
+            on_s.append(window())
+            obs.disable()
+            off_s.append(window())
+        on_med, off_med = sorted(on_s)[1], sorted(off_s)[1]
+        out["obs_overhead_us_per_step"] = round((on_med - off_med) * 1e6, 2)
+        out["obs_overhead_frac"] = round(
+            (on_med - off_med) / off_med, 5) if off_med > 0 else 0.0
+        out["obs_disabled_us_per_step"] = round(off_med * 1e6, 2)
+    finally:
+        obs.enable()
+    return out
+
+
 def main():
     import jax
 
@@ -776,7 +831,8 @@ def main():
                     (_bench_llama7b_layer, "llama7b_layer"),
                     (_bench_ernie, "ernie"),
                     (_bench_vit, "vit"),
-                    (_bench_ocr, "ocr")):
+                    (_bench_ocr, "ocr"),
+                    (_bench_observability, "observability")):
         if time.monotonic() > deadline:
             out[f"{tag}_skipped"] = "bench budget exhausted"
             continue

@@ -90,3 +90,94 @@ def collective_census(compiled):
         "counts": {op: v["count"] for op, v in out.items()},
         "est_step_flops": flops,
     }
+
+
+# ------------------------------------------------------------- per-op census
+_NAME_RE = re.compile(r"^\s*(?:ROOT\s+)?%?([^\s=]+)\s*=")
+# the opcode is the bare word between the result type (which ends in ']',
+# '}' or ')') and its '(' argument list
+_OPCODE_RE = re.compile(r"[\])}]\s+([a-z][a-z0-9\-]*)\(")
+_CONTRACT_RE = re.compile(r"lhs_contracting_dims=\{([0-9,]*)\}")
+
+#: Bookkeeping opcodes that carry no compute and clutter attribution.
+_TRIVIAL_OPCODES = frozenset({
+    "parameter", "constant", "tuple", "get-tuple-element", "bitcast",
+    "partition-id", "after-all",
+})
+
+
+def _entry_lines(txt):
+    """Lines of the ENTRY computation only.  The body ends at the first
+    closing ``}`` on its own line — nested braces inside the body occur
+    only in same-line attributes (layouts ``{1,0}``, sharding specs), never
+    as standalone lines."""
+    out, in_entry = [], False
+    for line in txt.splitlines():
+        if not in_entry:
+            if line.lstrip().startswith("ENTRY "):
+                in_entry = True
+            continue
+        if line.strip() == "}":
+            break
+        out.append(line)
+    return out
+
+
+def _dims(group_text):
+    """First `dtype[d0,d1,...]` group in ``group_text`` -> list of dims."""
+    m = re.search(r"(\w+)\[([0-9,]*)\]", group_text)
+    if not m or m.group(1) not in _DT_BYTES:
+        return None
+    return [int(d) for d in m.group(2).split(",") if d]
+
+
+def per_op_census(compiled, include_trivial=False):
+    """Per-op cost table of a compiled program: ``[{name, opcode,
+    bytes_out, bytes_in, flops}]`` in program order.
+
+    ``compiled`` is a jax Compiled (``jitted.lower(*args).compile()``).
+    Bytes come from the printed operand/result shapes; ``flops`` is an
+    analytic 2*M*N*K estimate for ``dot`` ops (contracting dims read off
+    the HLO attributes) and 0 elsewhere — enough to RANK ops for the
+    census<->timeline attribution join (`tools/trace_report.py`), not a
+    replacement for the backend cost model.
+
+    Only the ENTRY computation is scanned: fused-computation bodies repeat
+    the fusion's internal ops, which would double-count the fusion row's
+    bytes and pad the table with names no timeline event carries.
+    """
+    ops = []
+    for line in _entry_lines(compiled.as_text()):
+        nm = _NAME_RE.match(line)
+        if nm is None:
+            continue
+        m = _OPCODE_RE.search(line)
+        if m is None:
+            continue
+        opcode = m.group(1)
+        if opcode in _TRIVIAL_OPCODES and not include_trivial:
+            continue
+        result_txt = line[nm.end():m.start() + 1]
+        operand_txt = line[m.end():]
+        flops = 0
+        if opcode == "dot":
+            out_dims = _dims(result_txt)
+            lhs_dims = _dims(operand_txt)
+            cm = _CONTRACT_RE.search(line)
+            if out_dims is not None and lhs_dims is not None and cm:
+                k = 1
+                for i in (int(d) for d in cm.group(1).split(",") if d):
+                    if i < len(lhs_dims):
+                        k *= lhs_dims[i]
+                n = 1
+                for d in out_dims:
+                    n *= d
+                flops = 2 * n * k
+        ops.append({
+            "name": nm.group(1),
+            "opcode": opcode,
+            "bytes_out": _shape_bytes(result_txt, reduce="sum"),
+            "bytes_in": _shape_bytes(operand_txt, reduce="sum"),
+            "flops": flops,
+        })
+    return ops

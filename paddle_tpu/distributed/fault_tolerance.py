@@ -24,12 +24,15 @@ from __future__ import annotations
 
 import contextlib
 import errno
+import os
 import random
 import signal as _signal
 import threading
 import time
 
+from ..observability import flight_recorder as _flight
 from ..observability import metrics as _obs
+from ..observability.spans import span as _span
 
 __all__ = [
     "Preemption", "ExponentialBackoff", "RetryPolicy", "retry_call",
@@ -130,13 +133,18 @@ def retry_call(fn, *args, policy: RetryPolicy | None = None, **kwargs):
         except Exception as e:
             if attempt >= policy.max_attempts or not policy.is_retryable(e):
                 raise
-            _M_RETRIES.labels(op=getattr(fn, "__name__", "call")).inc()
+            op = getattr(fn, "__name__", "call")
+            _M_RETRIES.labels(op=op).inc()
+            _flight.record_event("retry", op=op, attempt=attempt,
+                                 error=repr(e))
             policy.sleep(policy.backoff.delay(attempt))
 
 
 def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
                       recoverable=(Preemption,), max_restarts=10,
-                      save_initial=True, on_event=None):
+                      save_initial=True, on_event=None,
+                      flight_recorder_dir=None, telemetry_port=None,
+                      healthy_step_age=600.0):
     """Run ``num_steps`` training steps under checkpoint-restore supervision.
 
     ``step_fn(step)`` performs one training step (a closure over the model /
@@ -151,38 +159,92 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
     and replays from its step count — with a deterministic ``step_fn`` the
     final state is bitwise identical to an uninterrupted run.  Other
     exceptions propagate.  Returns ``{"completed", "restarts"}``.
+
+    Telemetry plane: every step runs inside a ``recovery_step`` span (so
+    the black box records what was executing), restores/restarts land
+    flight-recorder events, and BOTH a recoverable failure and a fatal
+    (propagating) one dump the ring to ``flight_recorder_dir`` — default
+    ``<manager.path>/flight_recorder``, the black box next to the
+    checkpoints; pass ``False`` to disable.  ``telemetry_port`` (0 =
+    ephemeral) serves `/metrics` + `/healthz` for the duration of the run;
+    its ``last_step_age`` check fails when no step has completed for
+    ``healthy_step_age`` seconds (a wedged loop looks unhealthy, not idle).
     """
     recoverable = tuple(recoverable)
+    if flight_recorder_dir is None:
+        flight_recorder_dir = os.path.join(
+            str(manager.path), "flight_recorder")
+    flight_dir = flight_recorder_dir or None  # False/"" -> disabled
+
+    def _dump(reason, **extra):
+        # best-effort: safe_dump never masks the crash that triggered it
+        _flight.safe_dump(flight_dir, reason=reason, extra=extra)
+
+    last_step_mono = [time.monotonic()]
+    server = None
+    if telemetry_port is not None:
+        from ..observability.exporter import TelemetryServer
+
+        def _check_step_age():
+            age = time.monotonic() - last_step_mono[0]
+            return age < healthy_step_age, f"last completed step {age:.1f}s ago"
+
+        server = TelemetryServer(port=telemetry_port,
+                                 recorder=_flight.RECORDER)
+        server.register_healthcheck("last_step_age", _check_step_age)
+        server.start()
     restarts = 0
-    if manager.latest_step() is not None:
-        completed = _restore(manager, set_state)
-        if on_event:
-            on_event("resumed", {"step": completed})
-    else:
-        completed = 0
-        if save_initial:
-            # without an initial snapshot, a failure before the first
-            # periodic save would leave nothing to restore
-            manager.save(0, get_state(), force=True)
-    while completed < num_steps:
-        try:
-            step_fn(completed)
-            completed += 1
-            # get_state() can materialize the whole train state (device ->
-            # host sync) — only pay for it on steps that actually save
-            if completed == num_steps:
-                manager.save(completed, get_state(), force=True)
-            elif manager.should_save(completed):
-                manager.save(completed, get_state())
-        except recoverable as e:
-            restarts += 1
-            if restarts > max_restarts:
-                raise
-            _M_RESTARTS.inc()
-            completed = _restore(manager, set_state, cause=e)
+    dumped_exc = [None]  # the exception the inner handler already dumped
+    try:
+        if manager.latest_step() is not None:
+            completed = _restore(manager, set_state)
+            _flight.record_event("recovery_resumed", step=completed)
             if on_event:
-                on_event("restored", {"step": completed, "error": e})
-    return {"completed": completed, "restarts": restarts}
+                on_event("resumed", {"step": completed})
+        else:
+            completed = 0
+            if save_initial:
+                # without an initial snapshot, a failure before the first
+                # periodic save would leave nothing to restore
+                manager.save(0, get_state(), force=True)
+        while completed < num_steps:
+            try:
+                with _span("recovery_step"):
+                    step_fn(completed)
+                completed += 1
+                last_step_mono[0] = time.monotonic()
+                # get_state() can materialize the whole train state (device
+                # -> host sync) — only pay for it on steps that save
+                if completed == num_steps:
+                    manager.save(completed, get_state(), force=True)
+                elif manager.should_save(completed):
+                    manager.save(completed, get_state())
+            except recoverable as e:
+                restarts += 1
+                _flight.record_event("recoverable_failure", step=completed,
+                                     restarts=restarts, error=repr(e))
+                _dump("recoverable", step=completed, error=repr(e))
+                dumped_exc[0] = e
+                if restarts > max_restarts:
+                    raise
+                _M_RESTARTS.inc()
+                completed = _restore(manager, set_state, cause=e)
+                _flight.record_event("recovery_restored", step=completed)
+                if on_event:
+                    on_event("restored", {"step": completed, "error": e})
+        return {"completed": completed, "restarts": restarts}
+    except BaseException as e:
+        # anything escaping the supervisor is fatal to THIS run — including
+        # a recoverable raised outside the step loop (a Preemption landing
+        # mid-restore or mid-initial-save); dump unless the inner handler
+        # already dumped this very exception (restarts exhausted)
+        if e is not dumped_exc[0]:
+            _flight.record_event("fatal_failure", error=repr(e))
+            _dump("fatal", error=repr(e))
+        raise
+    finally:
+        if server is not None:
+            server.stop()
 
 
 def _restore(manager, set_state, cause=None):
@@ -258,6 +320,7 @@ def install_preemption_handler(signals=(_signal.SIGTERM, _signal.SIGINT), *,
         notice.last_signum = signum
         notice._event.set()
         _M_PREEMPTIONS.inc()
+        _flight.record_event("preemption", signum=int(signum))
         if on_preempt is not None:
             on_preempt(signum)
         if mode == "raise":

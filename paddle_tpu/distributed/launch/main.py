@@ -48,6 +48,9 @@ def parse_args(argv=None):
     p.add_argument("--max_restart", type=int, default=3)
     p.add_argument("--elastic_level", type=int, default=-1)
     p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("--metrics_port", type=int, default=None,
+                   help="serve /metrics,/healthz,/varz from the launcher "
+                        "(0 = ephemeral); healthz reports per-rank liveness")
     p.add_argument("training_script")
     p.add_argument("training_script_args", nargs=argparse.REMAINDER)
     return p.parse_args(argv)
@@ -62,9 +65,48 @@ class CollectiveController:
         self.restarts = 0
         self._host_list = None
         self._rdzv_rank = None
+        self.telemetry = None
         nn = str(args.nnodes)
         self.min_nodes = int(nn.split(":")[0])
         self.max_nodes = int(nn.split(":")[-1])
+        if getattr(args, "metrics_port", None) is not None:
+            self._start_telemetry(args.metrics_port)
+
+    def _start_telemetry(self, port):
+        """Launcher-side telemetry plane (README "Endpoints & flight
+        recorder"): /metrics + /varz over the process-global registry, and
+        a /healthz `ranks` check that fails while any spawned trainer has
+        exited nonzero (a restart-looping rank shows up as unhealthy, not
+        as silent churn)."""
+        from ...observability import metrics as _obs
+        from ...observability.exporter import TelemetryServer
+
+        self._m_restarts = _obs.gauge(
+            "launch_rank_restarts_count",
+            "Trainer ranks restarted by the launcher watcher")
+        self._m_alive = _obs.gauge(
+            "launch_ranks_alive_count", "Spawned trainer ranks still running")
+
+        def _check_ranks():
+            failed, alive, n = self._update_rank_gauges()
+            if failed:
+                return False, f"ranks {failed} exited nonzero"
+            return True, f"{alive}/{n} ranks running"
+
+        self.telemetry = TelemetryServer(port=port)
+        self.telemetry.register_healthcheck("ranks", _check_ranks)
+        self.telemetry.start()
+
+    def _update_rank_gauges(self):
+        """Refresh the launch_* gauges (called from BOTH the watch loop and
+        the /healthz check, so plain /metrics scrapes never read stale
+        values) -> (failed_ranks, alive, total)."""
+        states = [p.poll() for p in self.procs]
+        alive = sum(s is None for s in states)
+        self._m_alive.set(alive)
+        self._m_restarts.set(self.restarts)
+        failed = [i for i, s in enumerate(states) if s not in (None, 0)]
+        return failed, alive, len(states)
 
     def _endpoints(self, n):
         # deterministic port base: hash() is randomized per process (PYTHONHASHSEED),
@@ -191,6 +233,8 @@ class CollectiveController:
         while True:
             time.sleep(0.5)
             states = [p.poll() for p in self.procs]
+            if self.telemetry is not None:
+                self._update_rank_gauges()
             if all(s == 0 for s in states):
                 return 0
             failed = [i for i, s in enumerate(states) if s not in (None, 0)]
@@ -204,6 +248,8 @@ class CollectiveController:
                 return next(s for s in states if s not in (None, 0))
 
     def stop(self):
+        if self.telemetry is not None:
+            self.telemetry.stop()
         for p in self.procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)

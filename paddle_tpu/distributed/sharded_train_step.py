@@ -27,6 +27,7 @@ from ..tensor.tensor import Tensor
 from ..framework import random as _random
 from ..jit._step_impl import build_step_fn, init_scaler_state
 from ..observability import metrics as _obs
+from ..observability import slo as _slo
 from ..observability.spans import span as _span
 from .sharding_ctx import mesh_scope, param_sharding
 
@@ -177,22 +178,28 @@ class ShardedTrainStep:
         self._jitted = jax.jit(traced, in_shardings=in_shardings, out_shardings=out_shardings,
                                donate_argnums=donate)
 
-    def compiled_stats(self, *batch):
-        """Collective-traffic census of the compiled step (census.py):
-        per-device bytes for all-reduce / all-gather / reduce-scatter /
-        ppermute / all-to-all plus HLO-estimated FLOPs."""
-        from .census import collective_census
-
+    def _compile_for_analysis(self, *batch):
+        """AOT-compile the step on example inputs for census/per-op
+        analysis.  Deliberately NOT cached on self: the executable can hold
+        hundreds of MB of host memory, and every analysis entrypoint is a
+        startup-time call, not a hot path."""
         raw = tuple(b._value if isinstance(b, Tensor) else jnp.asarray(b) for b in batch)
         if self._jitted is None:
             self._init(raw)
         params, buffers = self.model.functional_state()
         lr = jnp.asarray(self.optimizer.get_lr(), jnp.float32)
         key = _random.get_rng_key()
-        compiled = self._jitted.lower(
+        return self._jitted.lower(
             params, buffers, self._opt_state, self._scaler_state, lr, key, *raw
         ).compile()
-        census = collective_census(compiled)
+
+    def compiled_stats(self, *batch):
+        """Collective-traffic census of the compiled step (census.py):
+        per-device bytes for all-reduce / all-gather / reduce-scatter /
+        ppermute / all-to-all plus HLO-estimated FLOPs."""
+        from .census import collective_census
+
+        census = collective_census(self._compile_for_analysis(*batch))
         # publish the census so the interconnect traffic of the *current*
         # compiled step is always scrapeable next to the latency series
         self._est_step_flops = census.get("est_step_flops")
@@ -205,12 +212,28 @@ class ShardedTrainStep:
                 _M_COLLECTIVE_BYTES.labels(op=op).set(census[key_])
         return census
 
+    def per_op_stats(self, *batch, json_path=None):
+        """Per-op flops/bytes of the compiled step (``census.per_op_census``)
+        — the cost half of the census<->timeline join
+        ``tools/trace_report.py`` performs against a recorded trace.
+        Optionally writes the table as JSON to ``json_path``."""
+        from .census import per_op_census
+
+        ops = per_op_census(self._compile_for_analysis(*batch))
+        if json_path is not None:
+            import json
+
+            with open(json_path, "w") as f:
+                json.dump(ops, f)
+        return ops
+
     def _record_step_metrics(self, dt, raw, compiled_call):
         if compiled_call:
             _M_COMPILE_SECONDS.set(dt)
             return
         _M_STEPS.inc()
         _M_STEP_SECONDS.observe(dt)
+        _slo.track("train_step", dt)
         if raw and hasattr(raw[0], "shape"):
             shape = raw[0].shape
             # rank-2 inputs are (batch, seq) -> tokens; anything else

@@ -6,6 +6,7 @@ import time
 import numpy as np
 
 from ..observability import metrics as _obs
+from ..observability import slo as _slo
 
 _M_BATCHES = _obs.counter(
     "hapi_batches_total", "Batches processed by Model.fit/evaluate",
@@ -217,13 +218,20 @@ class StatsCallback(Callback):
     ``StatsCallback.snapshot()`` returns the registry snapshot for
     programmatic readers; `paddle_tpu.observability.render_prometheus()`
     serves the same series as a `/metrics` payload.
+
+    Train-batch latency also feeds the sliding-window SLO tracker
+    (series ``hapi_batch``): pass ``slo_target`` seconds to count budget
+    burn, read percentiles back via ``slo_summary()`` or the
+    ``slo_latency_seconds{series="hapi_batch"}`` gauges on `/metrics`.
     """
 
-    def __init__(self, jsonl_path=None, dump_every=0):
+    def __init__(self, jsonl_path=None, dump_every=0, slo_target=None):
         self.jsonl_path = jsonl_path
         self.dump_every = int(dump_every)
         self._t0 = None
         self._batches = 0
+        if slo_target is not None:
+            _slo.set_target("hapi_batch", slo_target)
 
     def on_batch_begin(self, mode, step, logs=None):
         if _obs.enabled():
@@ -233,8 +241,10 @@ class StatsCallback(Callback):
         if not _obs.enabled():
             return
         if self._t0 is not None:
-            _M_BATCH_SECONDS.labels(mode=mode).observe(
-                time.perf_counter() - self._t0)
+            dt = time.perf_counter() - self._t0
+            _M_BATCH_SECONDS.labels(mode=mode).observe(dt)
+            if mode == "train":
+                _slo.track("hapi_batch", dt)
             self._t0 = None
         _M_BATCHES.labels(mode=mode).inc()
         if mode == "train" and logs and "loss" in logs:
@@ -257,6 +267,12 @@ class StatsCallback(Callback):
     @staticmethod
     def snapshot():
         return _obs.snapshot()
+
+    @staticmethod
+    def slo_summary():
+        """Sliding-window percentiles/burn rate of the hapi loop (plus any
+        other tracked series sharing the process-global SLO registry)."""
+        return _slo.summary()
 
 
 class VisualDL(Callback):

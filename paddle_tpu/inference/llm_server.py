@@ -38,6 +38,7 @@ batched server, not a positional error.
 """
 from __future__ import annotations
 
+import os
 import queue
 import threading
 import time
@@ -50,7 +51,9 @@ import jax
 import jax.numpy as jnp
 
 from ..autograd import tape
+from ..observability import flight_recorder as _flight
 from ..observability import metrics as _obs
+from ..observability import slo as _slo
 from ..observability.spans import span as _span
 from ..tensor.tensor import Tensor
 
@@ -110,6 +113,11 @@ _M_PAGE_PREEMPT = _obs.counter(
 _M_WARMUP_S = _obs.gauge(
     "llm_warmup_compile_seconds",
     "Wall time of the last warmup() precompile pass")
+
+#: LLMEngine(slo_targets={...}) keys -> SLO series names (observability.slo
+#: sliding-window percentiles + burn rates, README §Observability).
+_SLO_SERIES = {"ttft": "llm_ttft", "e2e": "llm_e2e",
+               "queue_wait": "llm_queue_wait", "tick": "llm_tick"}
 
 
 class ServerOverloadedError(RuntimeError):
@@ -177,7 +185,9 @@ class LLMEngine:
                  cache_dtype=None, eos_token_id=None, pad_token_id=0,
                  prompt_buckets=(32, 64, 128, 256), decode_chunk=1,
                  max_queue_len=None, clock=None, kv_layout=None,
-                 page_size=128, num_pages=None, prefill_chunk=None):
+                 page_size=128, num_pages=None, prefill_chunk=None,
+                 metrics_port=None, slo_targets=None,
+                 flight_recorder_dir=None, healthy_heartbeat_age=60.0):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
         scheduling lever for high-latency hosts.  Slots that finish
@@ -203,7 +213,21 @@ class LLMEngine:
         ServerOverloadedError instead of growing without bound; per-request
         ``timeout`` (see submit) expires requests in the queue and
         mid-decode with DeadlineExceededError; ``clock`` injects a time
-        source for deterministic tests (default time.monotonic)."""
+        source for deterministic tests (default time.monotonic).
+
+        Telemetry plane (README §Observability, "Endpoints & flight
+        recorder"): ``metrics_port`` (0 = ephemeral) starts an HTTP
+        exporter serving `/metrics`, `/healthz` (pump liveness +
+        pump-heartbeat age) and `/varz`; it stops with ``stop()``.
+        ``slo_targets`` maps {"ttft","e2e","queue_wait","tick"} to target
+        seconds for the sliding-window SLO trackers (percentiles are
+        tracked either way; targets add burn-rate accounting).
+        ``flight_recorder_dir`` (or ``PADDLE_TPU_FLIGHT_DIR``) names where
+        the black-box event ring is dumped when the pump watchdog trips.
+        ``healthy_heartbeat_age`` bounds how stale the pump's heartbeat may
+        grow before `/healthz` reports a wedge; the check stays green until
+        the FIRST tick completes, so a long initial compile (the spike
+        warmup() exists for) cannot fail a liveness probe."""
         cfg = model.config
         self.model = model
         self.n_slots = int(max_batch_slots)
@@ -305,6 +329,64 @@ class LLMEngine:
         self._thread = None
         self._stop = False
         self._lock = threading.Lock()
+        # -------------------------------------------------- telemetry plane
+        self._flight_dir = flight_recorder_dir \
+            if flight_recorder_dir is not None \
+            else os.environ.get("PADDLE_TPU_FLIGHT_DIR") or None
+        self.slo_targets = dict(slo_targets or {})
+        unknown = set(self.slo_targets) - set(_SLO_SERIES)
+        if unknown:
+            raise ValueError(
+                f"slo_targets keys must be in {sorted(_SLO_SERIES)}, "
+                f"got unknown {sorted(unknown)}")
+        for key, series in _SLO_SERIES.items():
+            if key in self.slo_targets:
+                _slo.set_target(series, self.slo_targets[key])
+        self._pump_heartbeat = None  # monotonic stamp of the last pump turn
+        self._first_tick_done = False
+        self.healthy_heartbeat_age = float(healthy_heartbeat_age)
+        self.telemetry = None
+        if metrics_port is not None:
+            from ..observability.exporter import TelemetryServer
+
+            self.telemetry = TelemetryServer(
+                port=metrics_port, recorder=_flight.RECORDER)
+            self.telemetry.register_healthcheck("pump", self._check_pump)
+            self.telemetry.register_healthcheck(
+                "pump_heartbeat", self._check_heartbeat)
+            self.telemetry.start()
+
+    # --------------------------------------------------------- healthchecks
+
+    def _check_pump(self):
+        """Healthcheck: the background pump (when started) is alive and has
+        not tripped the watchdog.  A never-started engine (caller-pumped
+        synchronous mode) is healthy by definition."""
+        if self._pump_error is not None:
+            return False, f"pump died: {self._pump_error!r}"
+        if self._thread is not None and not self._thread.is_alive() \
+                and not self._stop:
+            return False, "pump thread dead without a report"
+        return True, "alive" if (self._thread is not None
+                                 and self._thread.is_alive()) else "not started"
+
+    def _check_heartbeat(self):
+        """Healthcheck: the pump's last turn is recent — catches a pump
+        WEDGED inside step() (alive but not progressing), which the
+        liveness check above cannot see."""
+        if self._thread is None or not self._thread.is_alive():
+            return True, "pump not running"
+        if self._pump_heartbeat is None:
+            return True, "pump starting"
+        if not self._first_tick_done:
+            # the first tick pays every jit compile; a liveness probe must
+            # not kill a pod that is merely compiling (use warmup() to
+            # shrink this window)
+            return True, "pump warming up (first tick may be compiling)"
+        age = time.monotonic() - self._pump_heartbeat
+        if age > self.healthy_heartbeat_age:
+            return False, f"last pump turn {age:.1f}s ago"
+        return True, f"last pump turn {age:.3f}s ago"
 
     # ------------------------------------------------------------- public
 
@@ -356,6 +438,8 @@ class LLMEngine:
             self._pending.put_nowait(req)
         except queue.Full:
             _M_SHED.inc()
+            _flight.record_event("shed", queue_len=self.max_queue_len,
+                                 prompt_len=int(arr.size))
             raise ServerOverloadedError(
                 f"admission queue full ({self.max_queue_len} pending "
                 f"requests); request rejected — retry with backoff") from None
@@ -436,10 +520,19 @@ class LLMEngine:
             "queue_wait_seconds": self._hist_summary(_M_QUEUE_WAIT),
             "ttft_seconds": self._hist_summary(_M_TTFT),
             "e2e_seconds": self._hist_summary(_M_E2E),
+            # sliding-window percentiles + burn rates (observability.slo);
+            # like the registry series these are process-global
+            "slo": _slo.summary(prefix="llm_"),
+            "telemetry_url": self.telemetry.url
+            if self.telemetry is not None else None,
         }
 
     def start(self):
-        """Background pump (server mode)."""
+        """Background pump (server mode).  Re-starts the telemetry exporter
+        when the engine was configured with one and a prior stop() shut it
+        down (port 0 rebinds a fresh ephemeral port)."""
+        if self.telemetry is not None and not self.telemetry.running():
+            self.telemetry.start()
         if self._thread is None or not self._thread.is_alive():
             self._stop = False
             self._pump_error = None
@@ -451,7 +544,10 @@ class LLMEngine:
         """Halt the pump and FAIL any queued/in-flight requests — a client
         blocked on future.result() must not hang forever.  Afterwards the
         engine is clean and reusable: synchronous (caller-pumped) use and
-        start() both work again."""
+        start() both work again.  Stops the telemetry exporter too — the
+        clean-shutdown contract that keeps tier-1 from leaking sockets."""
+        if self.telemetry is not None:
+            self.telemetry.stop()
         self._stop = True
         self._stop_epoch += 1
         wedged = False
@@ -476,6 +572,7 @@ class LLMEngine:
     def _loop(self):
         try:
             while not self._stop:
+                self._pump_heartbeat = time.monotonic()
                 if self._pending.empty() and self._prefilling is None \
                         and all(r is None for r in self.slot_req):
                     time.sleep(0.002)
@@ -488,6 +585,10 @@ class LLMEngine:
         except BaseException as e:  # watchdog: a dying pump must not strand
             self._pump_error = e    # callers blocked on future.result()
             _M_WATCHDOG.inc()
+            _flight.record_event("watchdog_trip", error=repr(e))
+            # best-effort black box; safe_dump never masks the pump's crash
+            _flight.safe_dump(self._flight_dir, reason="watchdog_trip",
+                              extra={"error": repr(e)})
             self._fail_pending(RuntimeError(
                 f"LLMEngine pump thread died: {e!r}"))
 
@@ -585,7 +686,9 @@ class LLMEngine:
     def _admit_one(self, req, slot):
         req.admit_ts = self._clock()
         if req.submit_ts is not None:
-            _M_QUEUE_WAIT.observe(max(0.0, req.admit_ts - req.submit_ts))
+            wait = max(0.0, req.admit_ts - req.submit_ts)
+            _M_QUEUE_WAIT.observe(wait)
+            _slo.track("llm_queue_wait", wait)
         n = req.prompt.size
         Lb = self._bucket(n)
         padded = np.full((1, Lb), self.pad, np.int32)
@@ -606,7 +709,9 @@ class LLMEngine:
         _M_ADMITTED.inc()
         if req.submit_ts is not None:
             # the prefill's token IS the first token out
-            _M_TTFT.observe(max(0.0, self._clock() - req.submit_ts))
+            ttft = max(0.0, self._clock() - req.submit_ts)
+            _M_TTFT.observe(ttft)
+            _slo.track("llm_ttft", ttft)
         if tok == self.eos or req.max_new_tokens <= 1:
             self._finish(slot)
 
@@ -707,6 +812,8 @@ class LLMEngine:
         held = len(self._slot_pages[slot])
         self._release_pages(slot)
         _M_PAGE_PREEMPT.inc()
+        _flight.record_event("page_preemption", slot=int(slot),
+                             pages_held=int(held))
         if req is None:
             return
         if held >= self.num_pages - 1:
@@ -808,7 +915,9 @@ class LLMEngine:
                 return
             req.admit_ts = self._clock()
             if req.submit_ts is not None and not req.tokens:
-                _M_QUEUE_WAIT.observe(max(0.0, req.admit_ts - req.submit_ts))
+                wait = max(0.0, req.admit_ts - req.submit_ts)
+                _M_QUEUE_WAIT.observe(wait)
+                _slo.track("llm_queue_wait", wait)
             self._prefilling = (req, slot, 0)
             return
 
@@ -869,7 +978,9 @@ class LLMEngine:
         _M_ADMITTED.inc()
         if first and req.submit_ts is not None:
             # the final chunk's token IS the first token out
-            _M_TTFT.observe(max(0.0, self._clock() - req.submit_ts))
+            ttft = max(0.0, self._clock() - req.submit_ts)
+            _M_TTFT.observe(ttft)
+            _slo.track("llm_ttft", ttft)
         if tok == self.eos or len(req.tokens) >= req.max_new_tokens:
             self._finish(slot)
 
@@ -1019,9 +1130,14 @@ class LLMEngine:
         not race on the DONATED cache buffers or the slot state."""
         with self._lock:
             if not _obs.enabled():
-                return self._step_locked()
+                out = self._step_locked()
+                self._first_tick_done = True
+                return out
             with _span("llm_decode_tick", _M_TICK_SECONDS) as sp:
                 emitted = self._step_locked()
+            self._first_tick_done = True
+            if sp.duration:
+                _slo.track("llm_tick", sp.duration)
             if emitted and sp.duration:
                 _M_DECODE_TOKENS.inc(emitted)
                 _M_DECODE_TPS.set(emitted / sp.duration)
@@ -1131,6 +1247,7 @@ class LLMEngine:
                 self._pending.not_full.notify_all()
         for req in expired:
             _M_EXPIRED.labels(where="queued").inc()
+            _flight.record_event("deadline_expiry", where="queued")
             _fail_future(req.future, DeadlineExceededError(
                 "request deadline expired while queued for admission"))
 
@@ -1144,6 +1261,8 @@ class LLMEngine:
                 self.last_token[i] = self.pad
                 self._release_pages(i)
                 _M_EXPIRED.labels(where="inflight").inc()
+                _flight.record_event("deadline_expiry", where="inflight",
+                                     slot=int(i), tokens=len(req.tokens))
                 _fail_future(req.future, DeadlineExceededError(
                     f"request deadline exceeded after "
                     f"{len(req.tokens)} generated tokens"))
@@ -1156,5 +1275,7 @@ class LLMEngine:
         if req is not None:
             _M_COMPLETED.inc()
             if req.submit_ts is not None:
-                _M_E2E.observe(max(0.0, self._clock() - req.submit_ts))
+                e2e = max(0.0, self._clock() - req.submit_ts)
+                _M_E2E.observe(e2e)
+                _slo.track("llm_e2e", e2e)
             _complete_future(req.future, list(req.tokens))

@@ -1,4 +1,4 @@
-"""Unified observability layer: metrics registry + structured spans.
+"""Unified observability layer: metrics, spans, and the telemetry plane.
 
 The numbers half of the paper stack's host-tracer/device-tracer/cost-model
 triple: a dependency-free process-global metrics registry
@@ -11,13 +11,29 @@ server — registers its series here at import time, so
 payload the moment the process starts, and ``tools/metrics_lint.py`` can
 police the namespace without running a workload.
 
+On top of the registry sits the telemetry plane (ISSUE 5):
+
+- `observability.exporter` — stdlib HTTP endpoints: `/metrics`
+  (Prometheus text), `/healthz` (component healthchecks), `/varz` (JSON
+  snapshot); opt-in via ``LLMEngine(metrics_port=...)``,
+  ``run_with_recovery(telemetry_port=...)`` or the launcher's
+  ``--metrics_port``;
+- `observability.flight_recorder` — a bounded black-box event ring dumped
+  to JSONL (+ chrome trace) on crashes, preemptions and watchdog trips;
+- `observability.slo` — deterministic sliding-window p50/p95/p99 and
+  burn-rate tracking against configurable SLO targets.
+
 Quick start::
 
     import paddle_tpu as paddle
     obs = paddle.observability
+    srv = obs.start_exporter(port=9100)    # /metrics /healthz /varz
     ...train / serve...
     print(obs.render_prometheus())         # Prometheus text exposition
+    print(obs.slo.summary())               # sliding-window percentiles
     obs.dump_jsonl("metrics.jsonl")        # append-only local time series
+    obs.flight_recorder.dump("black_box")  # forensic event dump
+    srv.stop()
     obs.disable()                          # per-call cost -> one dict lookup
 """
 from .metrics import (  # noqa: F401
@@ -27,12 +43,21 @@ from .metrics import (  # noqa: F401
     DEFAULT_TIME_BUCKETS,
 )
 from .spans import span  # noqa: F401
+from .flight_recorder import FlightRecorder, record_event  # noqa: F401
+from .exporter import TelemetryServer, start_exporter  # noqa: F401
+from .slo import SLOTracker, SLORegistry, SLOS  # noqa: F401
 from . import metrics  # noqa: F401
 from . import spans  # noqa: F401
+from . import flight_recorder  # noqa: F401
+from . import exporter  # noqa: F401
+from . import slo  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
     "counter", "gauge", "histogram", "enable", "disable", "enabled",
     "snapshot", "render_prometheus", "dump_jsonl", "log_buckets",
     "DEFAULT_TIME_BUCKETS", "span", "metrics", "spans",
+    "FlightRecorder", "record_event", "flight_recorder",
+    "TelemetryServer", "start_exporter", "exporter",
+    "SLOTracker", "SLORegistry", "SLOS", "slo",
 ]

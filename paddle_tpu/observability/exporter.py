@@ -1,0 +1,204 @@
+"""HTTP telemetry endpoints: ``/metrics``, ``/healthz``, ``/varz``.
+
+The PR-2 registry renders Prometheus text but every series still died
+inside the process; this module is the missing network edge — a
+stdlib-only (http.server) daemon-threaded exporter any layer can opt into:
+
+- ``/metrics`` — Prometheus text exposition (``render_prometheus()``) with
+  the canonical ``text/plain; version=0.0.4`` content type;
+- ``/healthz`` — liveness plus registered component healthchecks (store
+  connected, pump thread alive, last-step age ...): HTTP 200 when every
+  check passes, 503 with a JSON body naming the failures otherwise — the
+  k8s/load-balancer probe contract;
+- ``/varz`` — the full registry snapshot as JSON (the debug endpoint).
+
+Lifecycle: ``TelemetryServer(port=0)`` binds an ephemeral port,
+``start()`` serves from a daemon thread (a forgotten exporter can never
+hang interpreter exit — the tier-1 guarantee), ``stop()`` shuts the
+socket down and joins the thread.  ``LLMEngine(metrics_port=...)``,
+``run_with_recovery(telemetry_port=...)`` and the launcher's
+``--metrics_port`` own one each; libraries embed via
+``register_healthcheck``.
+
+No jax / numpy imports (same contract as ``observability.metrics``).
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import metrics as _metrics
+
+__all__ = ["TelemetryServer", "start_exporter", "PROMETHEUS_CONTENT_TYPE"]
+
+#: The content type Prometheus scrapers negotiate for the text format.
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_M_SCRAPES = _metrics.counter(
+    "exporter_scrapes_total",
+    "HTTP requests served by the telemetry exporter",
+    labelnames=("endpoint",))
+_M_HTTP_ERRORS = _metrics.counter(
+    "exporter_http_errors_total",
+    "Exporter requests that failed (bad path or handler exception)")
+_M_HEALTH = _metrics.gauge(
+    "healthcheck_status_value",
+    "Latest result of each registered healthcheck (1 healthy, 0 failing)",
+    labelnames=("check",))
+
+
+class TelemetryServer:
+    """One process-local scrape endpoint over a metrics registry."""
+
+    def __init__(self, port=0, host="127.0.0.1", registry=None,
+                 recorder=None):
+        self.host = host
+        self._requested_port = int(port)
+        self.registry = registry if registry is not None \
+            else _metrics.REGISTRY
+        self.recorder = recorder  # optional FlightRecorder for /varz
+        self._httpd = None
+        self._thread = None
+        self._checks = {}  # name -> callable() -> truthy | (ok, detail)
+        self._checks_lock = threading.Lock()
+
+    # ----------------------------------------------------------- lifecycle
+    @property
+    def port(self):
+        return self._httpd.server_address[1] if self._httpd else None
+
+    @property
+    def url(self):
+        return f"http://{self.host}:{self.port}" if self._httpd else None
+
+    def running(self):
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self):
+        """Bind and serve from a daemon thread.  Idempotent."""
+        if self.running():
+            return self
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self):  # noqa: N802 (http.server API)
+                server._handle(self)
+
+            def log_message(self, *args):
+                pass  # scrapes must not spam the training job's stdout
+
+        self._httpd = ThreadingHTTPServer(
+            (self.host, self._requested_port), Handler)
+        self._httpd.daemon_threads = True  # scrape handlers never pin exit
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="paddle-tpu-telemetry", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self, timeout=5.0):
+        """Shut down the listener and join the serving thread — the clean
+        shutdown that keeps tier-1 from hanging on a live socket."""
+        httpd, thread = self._httpd, self._thread
+        self._httpd = None
+        self._thread = None
+        if httpd is not None:
+            httpd.shutdown()
+            httpd.server_close()
+        if thread is not None:
+            thread.join(timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # --------------------------------------------------------- healthchecks
+    def register_healthcheck(self, name, fn):
+        """Register ``fn`` under ``name``.  ``fn()`` returns truthy
+        (healthy), falsy (failing), or an ``(ok, detail)`` pair; a raise
+        counts as failing with the exception as detail."""
+        with self._checks_lock:
+            self._checks[str(name)] = fn
+        return self
+
+    def unregister_healthcheck(self, name):
+        with self._checks_lock:
+            self._checks.pop(str(name), None)
+
+    def health(self):
+        """Run every registered check: ``(all_ok, {name: {ok, detail}})``.
+        Publishes each result on ``healthcheck_status_value{check=}``."""
+        with self._checks_lock:
+            checks = dict(self._checks)
+        results, all_ok = {}, True
+        for name, fn in checks.items():
+            try:
+                out = fn()
+                ok, detail = (bool(out[0]), str(out[1])) \
+                    if isinstance(out, tuple) else (bool(out), "")
+            except Exception as e:
+                ok, detail = False, repr(e)
+            results[name] = {"ok": ok, "detail": detail}
+            _M_HEALTH.labels(check=name).set(1.0 if ok else 0.0)
+            all_ok = all_ok and ok
+        return all_ok, results
+
+    # ------------------------------------------------------------ handlers
+    def _handle(self, req):
+        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                _M_SCRAPES.labels(endpoint="metrics").inc()
+                body = self.registry.render_prometheus().encode()
+                self._reply(req, 200, PROMETHEUS_CONTENT_TYPE, body)
+            elif path == "/healthz":
+                _M_SCRAPES.labels(endpoint="healthz").inc()
+                ok, results = self.health()
+                body = json.dumps(
+                    {"status": "ok" if ok else "unhealthy",
+                     "checks": results}, sort_keys=True).encode()
+                self._reply(req, 200 if ok else 503,
+                            "application/json", body)
+            elif path == "/varz":
+                _M_SCRAPES.labels(endpoint="varz").inc()
+                varz = {"metrics": self.registry.snapshot()}
+                if self.recorder is not None:
+                    varz["flight_recorder"] = {
+                        "events": len(self.recorder),
+                        "capacity": self.recorder.capacity,
+                    }
+                body = json.dumps(varz, default=repr).encode()
+                self._reply(req, 200, "application/json", body)
+            else:
+                _M_HTTP_ERRORS.inc()
+                self._reply(req, 404, "text/plain; charset=utf-8",
+                            b"not found: try /metrics /healthz /varz\n")
+        except BrokenPipeError:
+            pass  # scraper hung up mid-reply; nothing to clean up
+        except Exception:
+            _M_HTTP_ERRORS.inc()
+            try:
+                self._reply(req, 500, "text/plain; charset=utf-8",
+                            b"internal error\n")
+            except Exception:
+                pass  # socket already gone
+
+    @staticmethod
+    def _reply(req, code, ctype, body):
+        req.send_response(code)
+        req.send_header("Content-Type", ctype)
+        req.send_header("Content-Length", str(len(body)))
+        req.end_headers()
+        req.wfile.write(body)
+
+
+def start_exporter(port=0, host="127.0.0.1", registry=None, recorder=None):
+    """Convenience: build + start a :class:`TelemetryServer`."""
+    return TelemetryServer(port=port, host=host, registry=registry,
+                           recorder=recorder).start()

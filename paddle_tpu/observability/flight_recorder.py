@@ -1,0 +1,215 @@
+"""Black-box flight recorder: a bounded ring of structured events that
+survives to disk when the process crashes.
+
+The serving/training stack already *counts* failures (metrics) and *times*
+them (spans); what a postmortem needs is the ORDER of the last few thousand
+things that happened before the crash — which span was open, which store op
+retried, which slot shed — the aviation-flight-recorder role Piper's
+distributed-training telemetry and the Gemma serving comparison (PAPERS.md)
+assign to their event logs.
+
+Design constraints:
+
+- **lock-cheap**: ``record()`` is on hot paths (every span close, every
+  shed).  The disabled fast path is the same one dict lookup as
+  ``metrics.disable()``; the enabled path is one ``deque.append`` under a
+  lock held for the append only (no I/O, no formatting).
+- **bounded**: a ``deque(maxlen=capacity)`` — old events fall off the back,
+  the recorder can never OOM a long-running server.
+- **dump-on-demand, not log-continuously**: ``dump()`` writes one JSONL
+  file (header line + events, oldest first) and, when the native
+  chrome-trace buffer has spans, a sibling ``*.trace.json`` — the pair an
+  operator loads after a crash.  `run_with_recovery` and `LLMEngine` call
+  it on unhandled exceptions, ``Preemption`` and watchdog trips so every
+  crash/restart leaves a black box next to the checkpoint dir.
+
+No jax / numpy imports: importable from any layer (same contract as
+``observability.metrics``).
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+
+from . import metrics as _metrics
+
+__all__ = [
+    "FlightRecorder", "RECORDER", "record_event", "dump", "safe_dump",
+    "events", "clear",
+]
+
+_M_EVENTS = _metrics.counter(
+    "flight_recorder_events_total",
+    "Events appended to the flight-recorder ring")
+_M_DROPPED = _metrics.counter(
+    "flight_recorder_dropped_total",
+    "Events that pushed an older one off the bounded ring")
+_M_DUMPS = _metrics.counter(
+    "flight_recorder_dumps_total",
+    "Flight-recorder dumps written to disk", labelnames=("reason",))
+
+
+class FlightRecorder:
+    """Bounded ring buffer of structured events.
+
+    Each event is a plain dict: ``{"seq", "time", "mono", "kind", ...}`` —
+    ``time`` is wall-clock (forensic joins with external logs), ``mono`` the
+    monotonic stamp (ordering/durations within the process).
+    """
+
+    def __init__(self, capacity=4096):
+        self.capacity = int(capacity)
+        self._events: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._dumps = 0  # advances on every dump(), even with metrics off
+
+    def record(self, kind, **fields):
+        """Append one event.  One dict lookup when observability is
+        disabled; one locked deque.append when enabled."""
+        if not _metrics._runtime["enabled"]:
+            return
+        evt = {"time": time.time(),  # tpulint: disable=impure-trace
+               "mono": time.monotonic(), "kind": str(kind)}
+        if fields:
+            evt.update(fields)
+        with self._lock:
+            self._seq += 1
+            evt["seq"] = self._seq
+            if len(self._events) == self.capacity:
+                _M_DROPPED.inc()
+            self._events.append(evt)
+        _M_EVENTS.inc()
+
+    def events(self):
+        """Snapshot of the ring, oldest first."""
+        with self._lock:
+            return list(self._events)
+
+    def clear(self):
+        with self._lock:
+            self._events.clear()
+
+    def __len__(self):
+        return len(self._events)
+
+    # ------------------------------------------------------------- dumping
+    def dump(self, directory, reason="manual", extra=None, trace=True):
+        """Write the black box: ``flight_<reason>_<dumpno>_<seq>.jsonl`` in
+        ``directory`` (created if missing) — a header line
+        ``{"flight_recorder": ..., "reason": ..., "pid": ...}`` followed by
+        one event per line, oldest first — plus, when the native trace
+        buffer holds spans, a sibling ``.trace.json`` chrome trace.
+
+        Returns the JSONL path.  Raises OSError on an unwritable target
+        (crash paths go through :func:`safe_dump` instead).  The per-dump
+        counter keeps names unique even when observability is disabled and
+        the event seq therefore never advances — a later crash must not
+        overwrite an earlier black box.
+        """
+        os.makedirs(directory, exist_ok=True)
+        evts = self.events()
+        with self._lock:
+            seq = self._seq
+            self._dumps += 1
+            dumpno = self._dumps
+        name = f"flight_{_slug(reason)}_{dumpno:04d}_{seq:08d}.jsonl"
+        path = os.path.join(directory, name)
+        header = {
+            "flight_recorder": 1,
+            "reason": str(reason),
+            "pid": os.getpid(),
+            "time": time.time(),  # tpulint: disable=impure-trace
+            "events": len(evts),
+            "capacity": self.capacity,
+        }
+        if extra:
+            header["extra"] = dict(extra)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header, separators=(",", ":")) + "\n")
+            for e in evts:
+                f.write(json.dumps(e, separators=(",", ":"),
+                                   default=repr) + "\n")
+        os.replace(tmp, path)  # a torn dump must not look complete
+        if trace:
+            doc = _native_trace_json()
+            if doc is not None:
+                with open(path[:-len(".jsonl")] + ".trace.json", "w") as f:
+                    f.write(doc)
+        _M_DUMPS.labels(reason=_slug(reason)).inc()
+        return path
+
+    def to_chrome_trace(self):
+        """Span-close events as a chrome://tracing document (complete 'X'
+        events) — lets ``tools/trace_report.py`` consume a flight dump as a
+        timeline even when the native trace buffer was off."""
+        out = []
+        for e in self.events():
+            if e.get("kind") != "span" or "duration_s" not in e:
+                continue
+            dur_us = float(e["duration_s"]) * 1e6
+            out.append({
+                "name": e.get("name", "?"), "ph": "X", "pid": os.getpid(),
+                "tid": 0, "ts": float(e["mono"]) * 1e6 - dur_us,
+                "dur": dur_us,
+            })
+        return {"traceEvents": out}
+
+
+def _slug(s):
+    return "".join(c if (c.isalnum() or c == "_") else "_"
+                   for c in str(s))[:48] or "event"
+
+
+def _native_trace_json():
+    """Chrome-trace JSON from the native host-trace buffer, or None when the
+    buffer is unavailable/empty (no toolchain, profiler never enabled)."""
+    try:
+        from ..profiler import _tracer
+        tr = _tracer()
+        if tr is None or not tr.count():
+            return None
+        return tr.dump_json()
+    except Exception:
+        return None
+
+
+#: Process-global recorder: every built-in instrumentation point records
+#: here; crash handlers dump it.
+RECORDER = FlightRecorder()
+
+
+def record_event(kind, **fields):
+    RECORDER.record(kind, **fields)
+
+
+def dump(directory, reason="manual", extra=None, trace=True):
+    return RECORDER.dump(directory, reason=reason, extra=extra, trace=trace)
+
+
+def safe_dump(directory, reason="crash", extra=None, recorder=None):
+    """Crash-path dump: best-effort, NEVER raises — the crash that
+    triggered the dump must stay the propagating exception.  A failed dump
+    is recorded as a ``flight_dump_failed`` event (visible to a later
+    successful dump) instead.  Returns the path or None.  No-op when
+    ``directory`` is falsy."""
+    if not directory:
+        return None
+    rec = recorder if recorder is not None else RECORDER
+    try:
+        return rec.dump(directory, reason=reason, extra=extra)
+    except Exception as dump_err:
+        rec.record("flight_dump_failed", error=repr(dump_err))
+        return None
+
+
+def events():
+    return RECORDER.events()
+
+
+def clear():
+    RECORDER.clear()

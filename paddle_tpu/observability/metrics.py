@@ -95,14 +95,23 @@ def _fmt(v: float) -> str:
     return repr(f)
 
 
-def _escape(v: str) -> str:
+def _escape_label(v: str) -> str:
+    """Label-value escaping (exposition format): backslash FIRST, then the
+    quote and line feed — the only three escapes the text parser knows."""
     return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _escape_help(v: str) -> str:
+    """HELP-text escaping: ONLY ``\\`` and ``\\n``.  Escaping ``"`` here
+    (as label values must) would render a literal ``\\"`` in every scrape —
+    the parser recognizes no quote escape outside label values."""
+    return v.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _labelstr(names, values) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{n}="{_escape(str(v))}"'
+    inner = ",".join(f'{n}="{_escape_label(str(v))}"'
                      for n, v in zip(names, values))
     return "{" + inner + "}"
 
@@ -426,7 +435,9 @@ class MetricRegistry:
         lines = []
         for m in self:
             if m.help:
-                lines.append(f"# HELP {m.name} {_escape(m.help)}")
+                lines.append(f"# HELP {m.name} {_escape_help(m.help)}")
+            # exactly one TYPE line per family — labeled children are
+            # samples of the SAME family, never their own TYPE block
             lines.append(f"# TYPE {m.name} {m.kind}")
             for lv, child in m.series():
                 if m.kind == "histogram":

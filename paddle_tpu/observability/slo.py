@@ -1,0 +1,187 @@
+"""SLO tracking: deterministic sliding-window percentiles + burn rates.
+
+Histograms answer "what is the long-run distribution"; an operator paging
+on an SLO needs "what are p50/p95/p99 *right now* and how fast am I
+burning error budget".  This module keeps, per tracked series, a bounded
+window of the most recent observations and derives:
+
+- ``slo_latency_seconds{series,quantile}`` — nearest-rank percentiles over
+  the window (deterministic: same observations => same value, no
+  interpolation, no decay constants);
+- ``slo_events_total{series}`` / ``slo_violations_total{series}`` — every
+  observation, and those above the series' SLO target;
+- ``slo_burn_rate_ratio{series}`` — violating fraction of the current
+  window: 0.0 = no budget burn, 1.0 = every request out of SLO (multiply
+  by the window span for an alerting burn-rate);
+- ``slo_target_seconds{series}`` — the configured target, scrapeable next
+  to the latencies it judges.
+
+Wired into the serving path (TTFT / e2e / queue-wait / decode tick, see
+``LLMEngine``), the train step (``sharded_train_step``) and the hapi
+``StatsCallback``; surfaced in ``LLMEngine.stats()["slo"]`` and on
+``/metrics``.  ``metrics.disable()`` turns ``observe`` into one dict
+lookup, like every other instrumentation point.
+
+No jax / numpy imports (same contract as ``observability.metrics``).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from bisect import insort, bisect_left
+from collections import deque
+
+from . import metrics as _metrics
+
+__all__ = [
+    "SLOTracker", "SLORegistry", "SLOS", "track", "set_target", "summary",
+    "DEFAULT_QUANTILES", "DEFAULT_WINDOW",
+]
+
+DEFAULT_QUANTILES = (0.5, 0.95, 0.99)
+DEFAULT_WINDOW = 512
+
+_M_LATENCY = _metrics.gauge(
+    "slo_latency_seconds",
+    "Sliding-window latency percentile per tracked series",
+    labelnames=("series", "quantile"))
+_M_TARGET = _metrics.gauge(
+    "slo_target_seconds",
+    "Configured SLO target of each tracked series (0 = untargeted)",
+    labelnames=("series",))
+_M_EVENTS = _metrics.counter(
+    "slo_events_total", "Observations per tracked series",
+    labelnames=("series",))
+_M_VIOLATIONS = _metrics.counter(
+    "slo_violations_total",
+    "Observations above the series' SLO target", labelnames=("series",))
+_M_BURN = _metrics.gauge(
+    "slo_burn_rate_ratio",
+    "Violating fraction of the current window per series",
+    labelnames=("series",))
+
+
+def _quantile_label(q):
+    # 0.5 -> "p50", 0.99 -> "p99", 0.999 -> "p99_9" (label values stay
+    # snake-ish so dashboards can template them)
+    pct = q * 100.0
+    if abs(pct - round(pct)) < 1e-9:
+        return f"p{int(round(pct))}"
+    return ("p" + f"{pct:.10g}").replace(".", "_")
+
+
+class SLOTracker:
+    """One series: bounded observation window + sorted mirror.
+
+    The sorted mirror makes every percentile read O(1) after an
+    O(log n) insert/remove per observation — scrapes never sort, and the
+    hot path never allocates beyond the two bounded containers.
+    """
+
+    def __init__(self, series, target=None, window=DEFAULT_WINDOW,
+                 quantiles=DEFAULT_QUANTILES):
+        self.series = str(series)
+        self.window = max(1, int(window))
+        self.quantiles = tuple(quantiles)
+        self._ring: deque = deque()   # arrival order (for eviction)
+        self._sorted: list = []       # value order (for percentiles)
+        self._viol_ring: deque = deque()  # parallel to _ring (0/1 flags)
+        self._viol_count = 0          # running sum of _viol_ring
+        self._lock = threading.Lock()
+        self.target = None
+        self.set_target(target)
+
+    def set_target(self, target):
+        self.target = float(target) if target is not None else None
+        _M_TARGET.labels(series=self.series).set(self.target or 0.0)
+        return self
+
+    def observe(self, value):
+        if not _metrics._runtime["enabled"]:
+            return
+        v = float(value)
+        violated = self.target is not None and v > self.target
+        with self._lock:
+            if len(self._ring) == self.window:
+                old = self._ring.popleft()
+                del self._sorted[bisect_left(self._sorted, old)]
+                self._viol_count -= self._viol_ring.popleft()
+            self._ring.append(v)
+            insort(self._sorted, v)
+            self._viol_ring.append(1 if violated else 0)
+            self._viol_count += 1 if violated else 0
+            burn = self._viol_count / len(self._ring)
+            pcts = [self._percentile_locked(q) for q in self.quantiles]
+        _M_EVENTS.labels(series=self.series).inc()
+        if violated:
+            _M_VIOLATIONS.labels(series=self.series).inc()
+        _M_BURN.labels(series=self.series).set(burn)
+        for q, p in zip(self.quantiles, pcts):
+            _M_LATENCY.labels(series=self.series,
+                              quantile=_quantile_label(q)).set(p)
+
+    def _percentile_locked(self, q):
+        n = len(self._sorted)
+        if not n:
+            return 0.0
+        # nearest-rank (inclusive): the smallest value with cumulative
+        # frequency >= q — deterministic and exact on small windows
+        idx = max(0, min(n - 1, int(math.ceil(q * n)) - 1))
+        return self._sorted[idx]
+
+    def percentile(self, q):
+        with self._lock:
+            return self._percentile_locked(q)
+
+    def summary(self):
+        with self._lock:
+            n = len(self._ring)
+            pcts = {_quantile_label(q): self._percentile_locked(q)
+                    for q in self.quantiles}
+            burn = (self._viol_count / n) if n else 0.0
+        return {"window": n, "target": self.target, "burn_rate": burn,
+                **pcts}
+
+
+class SLORegistry:
+    """series name -> tracker, created on first use."""
+
+    def __init__(self):
+        self._trackers = {}
+        self._lock = threading.Lock()
+
+    def tracker(self, series, target=None, window=DEFAULT_WINDOW) -> SLOTracker:
+        t = self._trackers.get(series)
+        if t is None:
+            with self._lock:
+                t = self._trackers.setdefault(
+                    series, SLOTracker(series, target=target, window=window))
+        return t
+
+    def track(self, series, value):
+        self.tracker(series).observe(value)
+
+    def set_target(self, series, target):
+        self.tracker(series).set_target(target)
+
+    def summary(self, prefix=None):
+        with self._lock:
+            items = list(self._trackers.items())
+        return {name: t.summary() for name, t in items
+                if prefix is None or name.startswith(prefix)}
+
+
+#: Process-global SLO registry (mirrors metrics.REGISTRY).
+SLOS = SLORegistry()
+
+
+def track(series, value):
+    SLOS.track(series, value)
+
+
+def set_target(series, target):
+    SLOS.set_target(series, target)
+
+
+def summary(prefix=None):
+    return SLOS.summary(prefix=prefix)

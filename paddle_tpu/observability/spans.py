@@ -9,12 +9,18 @@ the correlation the README's Observability section documents.
 
 ``metrics.disable()`` turns spans into no-ops too (one dict lookup on
 enter), so instrumented hot paths stay benchmark-clean.
+
+Every span close also lands one structured event in the process-global
+flight recorder (``observability.flight_recorder``): after a crash the
+black-box dump shows WHICH span was running and how long it had been —
+the per-event cost is one bounded deque append.
 """
 from __future__ import annotations
 
 import time
 
 from . import metrics as _metrics
+from . import flight_recorder as _flight
 
 __all__ = ["span"]
 
@@ -67,4 +73,13 @@ class span:
                 self.histogram.observe(self.duration)
             if self.counter is not None:
                 self.counter.inc()
+            if exc and exc[0] is not None:
+                # a span unwound by an exception is exactly the event a
+                # postmortem wants last in the black box
+                _flight.record_event("span", name=self.name,
+                                     duration_s=self.duration,
+                                     error=repr(exc[1]))
+            else:
+                _flight.record_event("span", name=self.name,
+                                     duration_s=self.duration)
         return False

@@ -1,0 +1,702 @@
+"""Telemetry plane (ISSUE 5): HTTP /metrics + health endpoints, black-box
+flight recorder, SLO percentiles, and the census<->timeline trace report.
+
+Oracles: a scrape of a LIVE engine's `/metrics` parses as Prometheus text
+and carries the SLO gauges; `/healthz` follows the 200/503 probe contract
+and flips when the pump dies (fault-injected, `faults` marker); a crash
+under `run_with_recovery` leaves a JSONL black box whose LAST events name
+the failing span; SLO percentiles are deterministic nearest-rank over a
+bounded window; trace_report joins census flops/bytes with span timings
+into a ranked table; and the disabled fast path records NOTHING while the
+exporter shuts down cleanly (no hanging tier-1).
+"""
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import ShardedTrainStep
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import fault_tolerance as ft
+from paddle_tpu.distributed.census import per_op_census
+from paddle_tpu.inference import LLMEngine
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import exporter as obs_exporter
+from paddle_tpu.observability import flight_recorder as obs_flight
+from paddle_tpu.observability import slo as obs_slo
+from paddle_tpu.observability.metrics import MetricRegistry
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.quick
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.headers.get("Content-Type"), r.read().decode()
+
+
+def _parse_prometheus(text):
+    """Minimal exposition-format parser: {name: {labelstr: value}} plus the
+    set of (name, kind) TYPE declarations.  Raises on malformed lines — the
+    'parses as valid Prometheus text' acceptance check."""
+    series, types = {}, []
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            assert len(line.split(" ", 3)) >= 3
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, kind = line.split(" ", 3)
+            assert kind in ("counter", "gauge", "histogram", "untyped")
+            types.append((name, kind))
+            continue
+        assert not line.startswith("#"), f"unknown comment: {line}"
+        body, value = line.rsplit(" ", 1)
+        float(value.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        name = body.split("{", 1)[0]
+        assert name and all(c.isalnum() or c == "_" for c in name), line
+        if "{" in body:
+            assert body.endswith("}"), line
+        series.setdefault(name, {})[body[len(name):]] = value
+    return series, types
+
+
+# -------------------------------------------------------- exporter lifecycle
+def test_exporter_port0_bind_and_endpoints():
+    r = MetricRegistry()
+    r.counter("tp_demo_total", "demo").inc(3)
+    srv = obs_exporter.TelemetryServer(port=0, registry=r)
+    srv.start()
+    try:
+        assert srv.port and srv.port > 0
+        code, ctype, text = _get(srv.url + "/metrics")
+        assert code == 200
+        assert ctype == obs_exporter.PROMETHEUS_CONTENT_TYPE
+        series, _ = _parse_prometheus(text)
+        assert series["tp_demo_total"][""] == "3"
+        code, ctype, body = _get(srv.url + "/varz")
+        assert code == 200 and ctype == "application/json"
+        assert json.loads(body)["metrics"]["tp_demo_total"]["kind"] \
+            == "counter"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/bogus")
+        assert ei.value.code == 404
+    finally:
+        srv.stop()
+
+
+def test_exporter_healthz_contract():
+    srv = obs_exporter.TelemetryServer(port=0, registry=MetricRegistry())
+    srv.start()
+    try:
+        code, _, body = _get(srv.url + "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "ok"
+        srv.register_healthcheck("good", lambda: (True, "fine"))
+        srv.register_healthcheck("bad", lambda: (False, "broken"))
+        srv.register_healthcheck("raises", lambda: 1 / 0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(srv.url + "/healthz")
+        assert ei.value.code == 503
+        doc = json.loads(ei.value.read().decode())
+        assert doc["status"] == "unhealthy"
+        assert doc["checks"]["good"]["ok"] is True
+        assert doc["checks"]["bad"] == {"ok": False, "detail": "broken"}
+        assert not doc["checks"]["raises"]["ok"]
+        assert "ZeroDivisionError" in doc["checks"]["raises"]["detail"]
+        # healthcheck results land on the gauge for alerting
+        g = obs.REGISTRY.get("healthcheck_status_value")
+        assert g.labels(check="bad").value == 0.0
+        assert g.labels(check="good").value == 1.0
+        srv.unregister_healthcheck("bad")
+        srv.unregister_healthcheck("raises")
+        code, _, _ = _get(srv.url + "/healthz")
+        assert code == 200
+    finally:
+        srv.stop()
+
+
+def test_exporter_concurrent_scrapes_during_recording():
+    """Scrapes racing first-use labels() and observations must neither 500
+    nor return unparseable text (registry iteration is lock-copied)."""
+    r = MetricRegistry()
+    h = r.histogram("tp_lat_seconds", "lat", labelnames=("op",))
+    srv = obs_exporter.TelemetryServer(port=0, registry=r).start()
+    stop = threading.Event()
+    errors = []
+
+    def writer(i):
+        n = 0
+        while not stop.is_set() and n < 2000:
+            h.labels(op=f"op{i}_{n % 37}").observe(0.001 * (n % 11))
+            n += 1
+
+    def scraper():
+        while not stop.is_set():
+            try:
+                _, _, text = _get(srv.url + "/metrics")
+                _parse_prometheus(text)
+            except Exception as e:  # surface in the main thread
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(2)]
+    threads += [threading.Thread(target=scraper) for _ in range(2)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(0.4)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        srv.stop()
+    assert errors == []
+
+
+def test_exporter_shutdown_closes_socket_and_thread():
+    srv = obs_exporter.TelemetryServer(port=0, registry=MetricRegistry())
+    srv.start()
+    port = srv.port
+    thread = srv._thread
+    srv.stop()
+    assert not thread.is_alive()
+    assert srv.port is None and not srv.running()
+    with pytest.raises(OSError):
+        s = socket.create_connection(("127.0.0.1", port), timeout=0.5)
+        s.close()
+    # restart works (fresh ephemeral bind)
+    srv.start()
+    assert srv.running() and srv.port
+    srv.stop()
+
+
+def test_disabled_fast_path_records_nothing_anywhere():
+    """disable() must silence the whole plane — flight recorder, SLO
+    trackers — with the same one-dict-lookup hot path as the registry."""
+    rec = obs_flight.FlightRecorder(capacity=8)
+    tracker = obs_slo.SLOTracker("tp_disabled_series")
+    obs.disable()
+    try:
+        rec.record("x", a=1)
+        tracker.observe(1.0)
+        with obs.span("tp_disabled_span"):
+            pass
+        assert len(rec) == 0
+        assert tracker.summary()["window"] == 0
+    finally:
+        obs.enable()
+    rec.record("x", a=1)
+    assert len(rec) == 1
+
+
+# ------------------------------------------------------ prometheus exposition
+def test_prometheus_label_and_help_escaping():
+    r = MetricRegistry()
+    c = r.counter("esc_total", 'Help with \\ backslash, "quote" and\nnewline',
+                  labelnames=("path",))
+    c.labels(path='a\\b"c\nd').inc()
+    text = r.render_prometheus()
+    # HELP escapes ONLY backslash + newline (a \" in HELP would render as
+    # literal backslash-quote to the parser)
+    assert '# HELP esc_total Help with \\\\ backslash, "quote" and\\nnewline' \
+        in text
+    # label values escape backslash, quote AND newline
+    assert 'esc_total{path="a\\\\b\\"c\\nd"} 1' in text
+    # nothing unescaped leaks a raw newline mid-line
+    for line in text.splitlines():
+        assert not line.startswith('d"')
+
+
+def test_prometheus_type_line_once_per_labeled_family():
+    r = MetricRegistry()
+    c = r.counter("fam_total", "family", labelnames=("op",))
+    c.labels(op="a").inc()
+    c.labels(op="b").inc()
+    h = r.histogram("fam_seconds", "family", labelnames=("op",),
+                    buckets=(0.1, 1.0))
+    h.labels(op="a").observe(0.5)
+    h.labels(op="b").observe(1.5)
+    text = r.render_prometheus()
+    assert text.count("# TYPE fam_total counter") == 1
+    assert text.count("# TYPE fam_seconds histogram") == 1
+    series, types = _parse_prometheus(text)
+    assert ("fam_total", "counter") in types
+    assert len(series["fam_total"]) == 2  # both children rendered
+
+
+# ------------------------------------------------------------ flight recorder
+def test_flight_recorder_ring_bounds_and_drop_counter():
+    rec = obs_flight.FlightRecorder(capacity=4)
+    dropped0 = obs.REGISTRY.get("flight_recorder_dropped_total").value
+    for i in range(7):
+        rec.record("tick", i=i)
+    evts = rec.events()
+    assert len(evts) == 4
+    assert [e["i"] for e in evts] == [3, 4, 5, 6]  # oldest fell off
+    assert [e["seq"] for e in evts] == [4, 5, 6, 7]
+    assert obs.REGISTRY.get("flight_recorder_dropped_total").value \
+        == dropped0 + 3
+    assert evts[-1]["mono"] >= evts[0]["mono"]
+
+
+def test_flight_recorder_dump_schema(tmp_path):
+    rec = obs_flight.FlightRecorder(capacity=16)
+    rec.record("alpha", n=1)
+    rec.record("beta", err="x")
+    path = rec.dump(str(tmp_path / "bb"), reason="unit test!",
+                    extra={"who": "tester"})
+    assert os.path.basename(path).startswith("flight_unit_test_")
+    lines = [json.loads(l) for l in open(path)]
+    header, events = lines[0], lines[1:]
+    assert header["flight_recorder"] == 1
+    assert header["reason"] == "unit test!" and header["events"] == 2
+    assert header["extra"] == {"who": "tester"}
+    assert [e["kind"] for e in events] == ["alpha", "beta"]
+    assert all("time" in e and "mono" in e and "seq" in e for e in events)
+    # no stray .tmp left behind (atomic rename)
+    assert not [n for n in os.listdir(tmp_path / "bb")
+                if n.endswith(".tmp")]
+    # successive dumps never collide, even with recording DISABLED (the
+    # event seq is frozen then; the dump counter still advances)
+    obs.disable()
+    try:
+        p1 = rec.dump(str(tmp_path / "bb"), reason="off")
+        p2 = rec.dump(str(tmp_path / "bb"), reason="off")
+    finally:
+        obs.enable()
+    assert p1 != p2 and os.path.exists(p1) and os.path.exists(p2)
+
+
+def test_span_close_lands_in_flight_recorder():
+    obs_flight.clear()
+    with obs.span("tp_unit_span"):
+        pass
+    with pytest.raises(RuntimeError):
+        with obs.span("tp_failing_span"):
+            raise RuntimeError("inner failure")
+    kinds = [(e["kind"], e.get("name")) for e in obs_flight.events()]
+    assert ("span", "tp_unit_span") in kinds
+    failing = [e for e in obs_flight.events()
+               if e.get("name") == "tp_failing_span"]
+    assert failing and "RuntimeError" in failing[-1]["error"]
+    assert failing[-1]["duration_s"] >= 0
+
+
+# ---------------------------------------------------------------- SLO tracker
+def test_slo_percentiles_deterministic_nearest_rank():
+    t = obs_slo.SLOTracker("tp_det_series", target=0.5, window=10)
+    for v in [0.1, 0.2, 0.3, 0.4, 0.5, 0.6, 0.7, 0.8, 0.9, 1.0]:
+        t.observe(v)
+    s = t.summary()
+    # nearest-rank over n=10: p50 -> index ceil(0.5*10)-1 = 4 -> 0.5
+    assert s["p50"] == 0.5
+    assert s["p95"] == 1.0 and s["p99"] == 1.0
+    assert s["burn_rate"] == 0.5  # 5 of 10 above the 0.5 target
+    # window slides: 10 more fast observations push the slow ones out
+    for _ in range(10):
+        t.observe(0.1)
+    s2 = t.summary()
+    assert s2["p50"] == 0.1 and s2["p99"] == 0.1 and s2["burn_rate"] == 0.0
+    assert t.percentile(0.5) == 0.1
+
+
+def test_slo_gauges_and_counters_exported():
+    obs_slo.track("tp_gauge_series", 0.2)
+    obs_slo.set_target("tp_gauge_series", 0.1)
+    obs_slo.track("tp_gauge_series", 0.3)
+    reg = obs.REGISTRY
+    assert reg.get("slo_latency_seconds").labels(
+        series="tp_gauge_series", quantile="p50").value == 0.2
+    assert reg.get("slo_target_seconds").labels(
+        series="tp_gauge_series").value == pytest.approx(0.1)
+    assert reg.get("slo_events_total").labels(
+        series="tp_gauge_series").value == 2
+    assert reg.get("slo_violations_total").labels(
+        series="tp_gauge_series").value == 1  # only the post-target 0.3
+    assert reg.get("slo_burn_rate_ratio").labels(
+        series="tp_gauge_series").value == 0.5
+    text = reg.render_prometheus()
+    assert 'slo_latency_seconds{series="tp_gauge_series",quantile="p99"}' \
+        in text
+
+
+def test_slo_unknown_engine_target_key_rejected(llm_model):
+    with pytest.raises(ValueError):
+        LLMEngine(llm_model, max_batch_slots=1, max_seq_len=128,
+                  slo_targets={"nope": 1.0})
+
+
+# ------------------------------------------------------------- live LLMEngine
+@pytest.fixture(scope="module")
+def llm_model():
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, use_flash_attention=False,
+                           max_position_embeddings=256)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def test_live_engine_scrape_parses_with_slo_gauges(llm_model):
+    """Acceptance: /metrics scraped DURING a running engine parses as
+    Prometheus text and includes the SLO percentile gauges."""
+    eng = LLMEngine(llm_model, max_batch_slots=2, max_seq_len=128,
+                    metrics_port=0, slo_targets={"ttft": 10.0, "e2e": 30.0})
+    try:
+        assert eng.telemetry.running()
+        eng.start()
+        futs = [eng.submit(np.arange(1, 9, dtype=np.int32),
+                           max_new_tokens=6) for _ in range(3)]
+        # scrape while the pump decodes
+        _, ctype, mid_text = _get(eng.telemetry.url + "/metrics")
+        assert ctype == obs_exporter.PROMETHEUS_CONTENT_TYPE
+        _parse_prometheus(mid_text)
+        for f in futs:
+            assert len(f.result(timeout=120)) == 6
+        _, _, text = _get(eng.telemetry.url + "/metrics")
+        series, types = _parse_prometheus(text)
+        for q in ("p50", "p95", "p99"):
+            key = f'{{series="llm_ttft",quantile="{q}"}}'
+            assert key in series["slo_latency_seconds"], key
+            assert float(series["slo_latency_seconds"][key]) >= 0
+        assert ("slo_latency_seconds", "gauge") in types
+        assert float(series["slo_target_seconds"]
+                     ['{series="llm_ttft"}']) == 10.0
+        assert "llm_decode_tick_duration_seconds" in series or \
+            "llm_decode_tick_duration_seconds_bucket" in series
+        # healthz: live pump reports healthy with a fresh heartbeat
+        code, _, body = _get(eng.telemetry.url + "/healthz")
+        assert code == 200
+        checks = json.loads(body)["checks"]
+        assert checks["pump"]["ok"] and checks["pump_heartbeat"]["ok"]
+        st = eng.stats()
+        assert st["telemetry_url"] == eng.telemetry.url
+        assert st["slo"]["llm_ttft"]["window"] >= 3
+        assert st["slo"]["llm_e2e"]["p99"] > 0
+    finally:
+        eng.stop()
+    assert not eng.telemetry.running()
+
+
+@pytest.mark.faults
+def test_pump_death_flips_healthz_and_dumps_black_box(llm_model, tmp_path):
+    """Fault injection: the pump thread dies mid-step -> /healthz turns
+    503, and the flight-recorder dump holds the watchdog-trip event."""
+    calls = {"n": 0}
+
+    def dying_clock():
+        # call 0 stamps submit(); the pump's first step trips the fault
+        calls["n"] += 1
+        if calls["n"] >= 2:
+            raise faults.InjectedFault(5, "injected clock failure (EIO)")
+        return 100.0
+
+    eng = LLMEngine(llm_model, max_batch_slots=1, max_seq_len=128,
+                    metrics_port=0, clock=dying_clock,
+                    flight_recorder_dir=str(tmp_path / "bb"))
+    trips = obs.REGISTRY.get("llm_pump_watchdog_trips_total")
+    t0 = trips.value
+    try:
+        eng.start()
+        fut = eng.submit(np.arange(1, 6, dtype=np.int32), max_new_tokens=2)
+        deadline = time.monotonic() + 30
+        while eng._pump_error is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert eng._pump_error is not None, "pump did not die"
+        assert trips.value == t0 + 1
+        with pytest.raises(Exception):
+            fut.result(timeout=10)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(eng.telemetry.url + "/healthz")
+        assert ei.value.code == 503
+        doc = json.loads(ei.value.read().decode())
+        assert not doc["checks"]["pump"]["ok"]
+        assert "InjectedFault" in doc["checks"]["pump"]["detail"]
+        dumps = [n for n in os.listdir(tmp_path / "bb")
+                 if n.endswith(".jsonl")]
+        assert len(dumps) == 1 and dumps[0].startswith(
+            "flight_watchdog_trip_")
+        lines = [json.loads(l) for l in open(tmp_path / "bb" / dumps[0])]
+        assert lines[0]["reason"] == "watchdog_trip"
+        kinds = [l.get("kind") for l in lines[1:]]
+        assert "watchdog_trip" in kinds
+        trip = next(l for l in lines[1:] if l["kind"] == "watchdog_trip")
+        assert "InjectedFault" in trip["error"]
+    finally:
+        eng.stop()
+
+
+def test_shed_and_preemption_leave_flight_events(llm_model):
+    obs_flight.clear()
+    now = {"t": 100.0}
+    eng = LLMEngine(llm_model, max_batch_slots=1, max_seq_len=128,
+                    max_queue_len=1, clock=lambda: now["t"])
+    eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=2, timeout=5.0)
+    with pytest.raises(Exception):
+        eng.submit(np.arange(5, dtype=np.int32), max_new_tokens=2)
+    now["t"] += 10.0
+    eng.step()  # expires the queued request
+    kinds = [e["kind"] for e in obs_flight.events()]
+    assert "shed" in kinds
+    assert "deadline_expiry" in kinds
+
+
+# -------------------------------------------------------- recovery black box
+def test_recovery_crash_dump_ends_with_failing_span(tmp_path):
+    """Acceptance: a fault-injected crash under run_with_recovery leaves a
+    JSONL dump whose last events include the failing span."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=3)
+    state = {"x": np.zeros(1)}
+
+    def bad_step(step):
+        if step == 1:
+            raise RuntimeError("irrecoverable explosion")
+        state["x"] = state["x"] + 1
+
+    with pytest.raises(RuntimeError, match="irrecoverable"):
+        ft.run_with_recovery(
+            bad_step, 3, mgr,
+            get_state=lambda: {"x": state["x"]},
+            set_state=lambda s: state.update(x=np.asarray(s["x"])))
+    flight_dir = tmp_path / "ck" / "flight_recorder"
+    dumps = sorted(n for n in os.listdir(flight_dir)
+                   if n.endswith(".jsonl"))
+    assert len(dumps) == 1 and dumps[0].startswith("flight_fatal_")
+    lines = [json.loads(l) for l in open(flight_dir / dumps[0])]
+    tail = lines[-4:]
+    span_evt = next(e for e in reversed(tail)
+                    if e.get("kind") == "span"
+                    and e.get("name") == "recovery_step")
+    assert "irrecoverable explosion" in span_evt["error"]
+    assert tail[-1]["kind"] == "fatal_failure"
+
+
+def test_recovery_preemption_dump_and_telemetry_endpoint(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=3,
+                                 save_interval=2)
+    state = {"x": np.zeros(1)}
+    check = faults.preemption_schedule(2)
+    urls = {}
+
+    def step_fn(step):
+        check(step)
+        if "url" in urls:  # scrape mid-run exactly once
+            code, _, body = _get(urls.pop("url") + "/healthz")
+            assert code == 200
+            assert json.loads(body)["checks"]["last_step_age"]["ok"]
+        state["x"] = state["x"] + 1
+
+    # find the server the supervisor starts: poke via on_event
+    def on_event(kind, info):
+        pass
+
+    # run with an ephemeral telemetry port; grab the URL via a healthcheck
+    # scrape inside the first step
+    import paddle_tpu.observability.exporter as ex
+    orig_start = ex.TelemetryServer.start
+
+    def start_and_record(self):
+        out = orig_start(self)
+        urls["url"] = self.url
+        return out
+
+    ex.TelemetryServer.start = start_and_record
+    try:
+        report = ft.run_with_recovery(
+            step_fn, 4, mgr,
+            get_state=lambda: {"x": state["x"]},
+            set_state=lambda s: state.update(x=np.asarray(s["x"])),
+            telemetry_port=0, on_event=on_event)
+    finally:
+        ex.TelemetryServer.start = orig_start
+    assert report == {"completed": 4, "restarts": 1}
+    assert float(state["x"][0]) == 4.0
+    dumps = [n for n in os.listdir(tmp_path / "ck" / "flight_recorder")
+             if n.startswith("flight_recoverable_")]
+    assert len(dumps) == 1
+    lines = [json.loads(l) for l in
+             open(tmp_path / "ck" / "flight_recorder" / dumps[0])]
+    kinds = [l.get("kind") for l in lines[1:]]
+    assert kinds[-1] == "recoverable_failure"
+    assert "span" in kinds  # the steps that ran are on the record
+
+
+def test_recovery_preemption_outside_step_loop_still_dumps(tmp_path):
+    """A recoverable raised OUTSIDE the step loop (here: during the
+    resume-time restore) escapes the supervisor — but must still leave a
+    black box; while one dumped inside the loop must not dump twice."""
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=3)
+    mgr.save(1, {"x": np.zeros(1)})
+
+    def boom_set_state(s):
+        raise ft.Preemption("evicted mid-restore")
+
+    with pytest.raises(ft.Preemption):
+        ft.run_with_recovery(lambda step: None, 3, mgr,
+                             get_state=lambda: {"x": np.zeros(1)},
+                             set_state=boom_set_state)
+    flight_dir = tmp_path / "ck" / "flight_recorder"
+    dumps = [n for n in os.listdir(flight_dir) if n.endswith(".jsonl")]
+    assert len(dumps) == 1 and dumps[0].startswith("flight_fatal_")
+    lines = [json.loads(l) for l in open(flight_dir / dumps[0])]
+    assert lines[-1]["kind"] == "fatal_failure"
+    assert "evicted mid-restore" in lines[-1]["error"]
+
+
+def test_recovery_exhausted_restarts_dump_once(tmp_path):
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=3)
+    state = {"x": np.zeros(1)}
+
+    def always_preempted(step):
+        raise ft.Preemption("again")
+
+    with pytest.raises(ft.Preemption):
+        ft.run_with_recovery(
+            always_preempted, 3, mgr, max_restarts=2,
+            get_state=lambda: {"x": state["x"]},
+            set_state=lambda s: state.update(x=np.asarray(s["x"])))
+    flight_dir = tmp_path / "ck" / "flight_recorder"
+    dumps = [n for n in os.listdir(flight_dir) if n.endswith(".jsonl")]
+    # one dump per recoverable failure (3), and the terminal re-raise does
+    # NOT add a duplicate "fatal" dump for the same exception
+    assert len(dumps) == 3
+    assert all(n.startswith("flight_recoverable_") for n in dumps)
+
+
+# --------------------------------------------------------------- trace report
+def test_per_op_census_flops_and_bytes():
+    import jax.numpy as jnp
+
+    def f(a, b):
+        return jnp.tanh(a @ b).sum()
+
+    compiled = jax.jit(f).lower(jnp.ones((8, 4), jnp.float32),
+                                jnp.ones((4, 16), jnp.float32)).compile()
+    ops = per_op_census(compiled)
+    dots = [o for o in ops if o["opcode"] == "dot"]
+    assert len(dots) == 1
+    assert dots[0]["flops"] == 2 * 8 * 16 * 4
+    assert dots[0]["bytes_out"] == 8 * 16 * 4
+    assert all(o["opcode"] not in ("parameter", "tuple") for o in ops)
+
+
+def test_trace_report_join_and_ranking(tmp_path):
+    tr = _load_tool("trace_report")
+    trace = {"traceEvents": [
+        {"name": "jit_step/dot.4", "ph": "X", "ts": 0, "dur": 900.0},
+        {"name": "tanh.0", "ph": "B", "ts": 10.0, "tid": 1},
+        {"name": "tanh.0", "ph": "E", "ts": 110.0, "tid": 1},
+        {"name": "host_copy", "ph": "X", "ts": 0, "dur": 50.0},
+    ]}
+    census = [
+        {"name": "dot", "opcode": "dot", "bytes_out": 8,
+         "bytes_in": 8, "flops": 2},
+        {"name": "dot.4", "opcode": "dot", "bytes_out": 512,
+         "bytes_in": 384, "flops": 1024},
+        {"name": "tanh.0", "opcode": "tanh", "bytes_out": 512,
+         "bytes_in": 512, "flops": 0},
+        {"name": "never_timed", "opcode": "fusion", "bytes_out": 4,
+         "bytes_in": 512, "flops": 0},
+    ]
+    tpath, cpath = str(tmp_path / "t.json"), str(tmp_path / "c.json")
+    json.dump(trace, open(tpath, "w"))
+    json.dump(census, open(cpath, "w"))
+    timeline = tr.load_timeline(path=tpath)
+    assert timeline["tanh.0"]["total_us"] == 100.0  # B/E pair folded
+    rows = tr.join(timeline, tr.load_census(cpath))
+    assert [r["name"] for r in rows] == [
+        "jit_step/dot.4", "tanh.0", "host_copy", "dot", "never_timed"]
+    # the prefixed event joins the SPECIFIC census row ("dot.4"), never the
+    # shorter "dot" that merely shares a prefix
+    assert rows[0]["matched"] and rows[0]["flops"] == 1024
+    assert not rows[2]["matched"]  # timed but un-attributed
+    assert rows[3]["total_us"] == 0.0  # census ops never seen on timeline
+    text = tr.render_text(rows, top=3)
+    assert "host_copy *" in text and "3/5 ops shown" in text
+    # CLI writes JSON and exits 0
+    out = str(tmp_path / "rows.json")
+    assert tr.main(["--trace", tpath, "--census", cpath,
+                    "--json", out]) == 0
+    assert len(json.load(open(out))) == 5
+
+
+def test_trace_report_from_recorded_train_step(tmp_path):
+    """Acceptance: a top-K per-op table out of a RECORDED train-step trace
+    (flight-recorder span timings) joined with the step's own census."""
+    paddle.seed(3)
+    model = nn.Linear(16, 4)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+
+    def loss_fn(x, y):
+        return paddle.nn.functional.mse_loss(model(x), y)
+
+    mesh = Mesh(np.array(jax.devices()[:1]), ("dp",))
+    step = ShardedTrainStep(model, loss_fn, opt, mesh)
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 16)).astype(np.float32)
+    y = rng.standard_normal((8, 4)).astype(np.float32)
+    obs_flight.clear()
+    for _ in range(3):
+        step(x, y)
+    census_path = str(tmp_path / "per_op.json")
+    ops = step.per_op_stats(x, y, json_path=census_path)
+    assert any(o["opcode"] == "dot" and o["flops"] > 0 for o in ops)
+    dump = obs_flight.dump(str(tmp_path), reason="train_trace")
+    tr = _load_tool("trace_report")
+    timeline = tr.load_timeline(flight_path=dump)
+    assert timeline["sharded_train_step"]["count"] == 3
+    rows = tr.join(timeline, tr.load_census(census_path))
+    text = tr.render_text(rows, top=5)
+    assert "sharded_train_step" in text
+    assert rows[0]["name"] == "sharded_train_step"  # ranked by time
+    # the census ops ride along as attribution rows
+    assert any(r["opcode"] == "dot" for r in rows)
+    # train-step SLO series populated by the instrumented calls
+    assert obs_slo.SLOS.summary()["train_step"]["window"] >= 2
+
+
+def test_llm_stats_slo_section_without_exporter(llm_model):
+    eng = LLMEngine(llm_model, max_batch_slots=1, max_seq_len=128)
+    out = eng.generate(np.arange(1, 8, dtype=np.int32), max_new_tokens=3)
+    assert len(out) == 3
+    st = eng.stats()
+    assert st["telemetry_url"] is None
+    assert st["slo"]["llm_e2e"]["window"] >= 1
+    assert st["slo"]["llm_ttft"]["p50"] >= 0
+
+
+def test_hapi_stats_callback_slo():
+    from paddle_tpu.hapi.callbacks import StatsCallback
+
+    cb = StatsCallback(slo_target=100.0)
+    for _ in range(3):
+        cb.on_batch_begin("train", 0, {})
+        cb.on_batch_end("train", 0, {"loss": [0.5]})
+    s = cb.slo_summary()["hapi_batch"]
+    assert s["window"] >= 3 and s["target"] == 100.0
+    assert s["burn_rate"] == 0.0  # no batch takes 100s

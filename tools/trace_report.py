@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""trace_report — join per-op census costs with recorded span timings.
+
+The ROADMAP's census<->timeline join: the cost model knows how much
+compute/traffic each op SHOULD cost (``census.per_op_census`` /
+``collective_census``), the timeline knows how long each span ACTUALLY
+took (chrome-trace JSON from ``Profiler.export`` / the flight recorder's
+``*.trace.json``, or the span events inside a flight-recorder JSONL dump).
+This tool joins the two by name into a top-K per-op cost-attribution
+table — the first thing to read when MFU drops: which op eats the time,
+and whether its measured share matches its analytic share.
+
+Inputs
+------
+--trace trace.json          chrome-trace document ({"traceEvents": [...]}
+                            or a bare event list; complete 'X' events and
+                            'B'/'E' pairs both count)
+--flight dump.jsonl         alternative timing source: a flight-recorder
+                            dump whose `span` events carry duration_s
+--census census.json        per-op cost table: the per_op_census() list,
+                            or a {name: {flops, bytes}} mapping, or a
+                            collective_census() dict
+--top K                     rows to print (default 20, by total time,
+                            then by flops for time-less census rows)
+--json out.json             also write the full joined table as JSON
+
+Join rule: exact name match first, else substring containment either way
+(census op ``dot.4`` matches timeline event ``jit_step/dot.4``); census
+rows without a timed event and events without census costs both stay in
+the table (flagged) — unattributed time is a finding, not noise.
+
+Usage::
+
+    python tools/trace_report.py --trace prof/worker.json \
+        --census per_op.json --top 15
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import OrderedDict
+
+__all__ = ["load_timeline", "load_census", "join", "render_text", "main"]
+
+
+# ------------------------------------------------------------------ loading
+def load_timeline(path=None, events=None, flight_path=None):
+    """-> OrderedDict name -> {"count", "total_us"} aggregated timings."""
+    if flight_path is not None:
+        events = _events_from_flight(flight_path)
+    elif path is not None:
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc.get("traceEvents", doc) if isinstance(doc, dict) \
+            else doc
+    out: "OrderedDict[str, dict]" = OrderedDict()
+    open_begins: dict = {}
+    for e in events or []:
+        if not isinstance(e, dict):
+            continue
+        name, ph = e.get("name"), e.get("ph", "X")
+        if name is None:
+            continue
+        if ph == "X" and "dur" in e:
+            dur = float(e["dur"])
+        elif ph == "B":
+            open_begins.setdefault((e.get("tid", 0), name), []).append(
+                float(e.get("ts", 0.0)))
+            continue
+        elif ph == "E":
+            stack = open_begins.get((e.get("tid", 0), name))
+            if not stack:
+                continue
+            dur = float(e.get("ts", 0.0)) - stack.pop()
+        else:
+            continue
+        row = out.setdefault(name, {"count": 0, "total_us": 0.0})
+        row["count"] += 1
+        row["total_us"] += max(0.0, dur)
+    return out
+
+
+def _events_from_flight(path):
+    """Span-close events of a flight-recorder JSONL dump as chrome 'X'
+    events (mirrors FlightRecorder.to_chrome_trace, but offline)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("kind") == "span" and "duration_s" in rec:
+                events.append({"name": rec.get("name", "?"), "ph": "X",
+                               "dur": float(rec["duration_s"]) * 1e6})
+    return events
+
+
+def load_census(path):
+    """-> OrderedDict name -> {"opcode", "flops", "bytes"}; accepts the
+    three shapes documented in the module docstring."""
+    with open(path) as f:
+        doc = json.load(f)
+    out: "OrderedDict[str, dict]" = OrderedDict()
+    if isinstance(doc, list):  # per_op_census() rows
+        for row in doc:
+            name = str(row.get("name", "?"))
+            prev = out.setdefault(name, {"opcode": row.get("opcode", ""),
+                                         "flops": 0.0, "bytes": 0.0})
+            prev["flops"] += float(row.get("flops", 0) or 0)
+            prev["bytes"] += float(row.get("bytes_out", 0) or 0) \
+                + float(row.get("bytes_in", 0) or 0) \
+                + float(row.get("bytes", 0) or 0)
+        return out
+    if isinstance(doc, dict) and "counts" in doc:  # collective_census()
+        for key, op in (("bytes_allreduce", "all-reduce"),
+                        ("bytes_allgather", "all-gather"),
+                        ("bytes_reducescatter", "reduce-scatter"),
+                        ("bytes_ppermute", "collective-permute"),
+                        ("bytes_alltoall", "all-to-all")):
+            if doc.get(key):
+                out[op] = {"opcode": op, "flops": 0.0,
+                           "bytes": float(doc[key])}
+        return out
+    if isinstance(doc, dict):  # {name: {flops, bytes}}
+        for name, row in doc.items():
+            out[str(name)] = {"opcode": str(row.get("opcode", "")),
+                              "flops": float(row.get("flops", 0) or 0),
+                              "bytes": float(row.get("bytes", 0) or 0)}
+        return out
+    raise ValueError(f"unrecognized census document shape in {path}")
+
+
+# ------------------------------------------------------------------ joining
+def _match(event_name, census):
+    if event_name in census:
+        return event_name
+    # trace names prefix ops with the program path ("jit_step/dot.12"):
+    # try the trailing component exactly before any fuzzy containment
+    tail = event_name.rsplit("/", 1)[-1]
+    if tail in census:
+        return tail
+    # fuzzy fallback: LONGEST containment wins, so census row "dot.12"
+    # beats "dot" / "dot.1" for event ".../dot.12"
+    best = None
+    for cname in census:
+        if (cname in event_name or event_name in cname) \
+                and (best is None or len(cname) > len(best)):
+            best = cname
+    return best
+
+
+def join(timeline, census):
+    """-> list of rows {name, count, total_us, flops, bytes, opcode,
+    gflops_per_s, matched} sorted by total time desc, then flops desc.
+    Census ops no event timed keep total_us=0 (matched=False) so missing
+    attribution is visible."""
+    rows, used = [], set()
+    for name, t in timeline.items():
+        cname = _match(name, census)
+        c = census.get(cname) if cname else None
+        if cname:
+            used.add(cname)
+        secs = t["total_us"] / 1e6
+        rows.append({
+            "name": name, "count": t["count"],
+            "total_us": round(t["total_us"], 3),
+            "opcode": (c or {}).get("opcode", ""),
+            "flops": (c or {}).get("flops", 0.0),
+            "bytes": (c or {}).get("bytes", 0.0),
+            "gflops_per_s": round((c["flops"] / secs) / 1e9, 3)
+            if c and c["flops"] and secs > 0 else 0.0,
+            "matched": c is not None,
+        })
+    for cname, c in census.items():
+        if cname in used:
+            continue
+        rows.append({"name": cname, "count": 0, "total_us": 0.0,
+                     "opcode": c.get("opcode", ""), "flops": c["flops"],
+                     "bytes": c["bytes"], "gflops_per_s": 0.0,
+                     "matched": False})
+    rows.sort(key=lambda r: (-r["total_us"], -r["flops"], -r["bytes"],
+                             r["name"]))
+    return rows
+
+
+# ---------------------------------------------------------------- rendering
+def _human(n, unit=""):
+    for div, suf in ((1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")):
+        if abs(n) >= div:
+            return f"{n / div:.2f}{suf}{unit}"
+    return f"{n:.0f}{unit}"
+
+
+def render_text(rows, top=20):
+    total_us = sum(r["total_us"] for r in rows) or 1.0
+    head = (f"{'op':40s} {'count':>6s} {'time_ms':>10s} {'time%':>6s} "
+            f"{'flops':>9s} {'bytes':>9s} {'GF/s':>8s}")
+    lines = [head, "-" * len(head)]
+    for r in rows[:top]:
+        mark = "" if r["matched"] or r["total_us"] == 0 else " *"
+        lines.append(
+            f"{(r['name'] + mark)[:40]:40s} {r['count']:6d} "
+            f"{r['total_us'] / 1e3:10.3f} "
+            f"{100.0 * r['total_us'] / total_us:6.1f} "
+            f"{_human(r['flops']):>9s} {_human(r['bytes']):>9s} "
+            f"{r['gflops_per_s']:8.2f}")
+    shown = min(top, len(rows))
+    lines.append(f"({shown}/{len(rows)} ops shown; * = no census match; "
+                 f"time-less rows are census ops never seen on the "
+                 f"timeline)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group(required=True)
+    src.add_argument("--trace", help="chrome-trace JSON (Profiler.export)")
+    src.add_argument("--flight",
+                     help="flight-recorder JSONL dump (span events)")
+    ap.add_argument("--census", default=None,
+                    help="per-op census JSON (per_op_census / "
+                         "collective_census output)")
+    ap.add_argument("--top", type=int, default=20)
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="write the full joined table as JSON here")
+    args = ap.parse_args(argv)
+
+    timeline = load_timeline(path=args.trace, flight_path=args.flight)
+    census = load_census(args.census) if args.census else OrderedDict()
+    rows = join(timeline, census)
+    if not rows:
+        print("trace_report: no timed events and no census ops — nothing "
+              "to attribute")
+        return 1
+    print(render_text(rows, top=args.top))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(rows, f, indent=1)
+        print(f"wrote {len(rows)} rows to {args.json_out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
