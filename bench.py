@@ -35,6 +35,47 @@ def paged_capacity_trace(L_pad, page_size=128):
     return trace, pages_mean
 
 
+def shared_prefix_trace(L_pad, page_size=128, n_requests=32):
+    """Deterministic fleet-style SHARED-PREFIX serving trace (shared with
+    tools/project_pod.py so the 'derived' PROJECTION numbers can never
+    drift from what bench.py measures): every request carries one common
+    system prompt plus a small varied tail.  The shared length is
+    deliberately OFF the page grid so the tail page is partially filled —
+    later requests fork it copy-on-write, the behavior the prefix cache
+    must pay for.  Returns the trace geometry plus the analytic per-request
+    page accounting: admission charges only the UNIQUE pages (tail + the
+    COW fork), so effective capacity multiplies by
+    total_pages / unique_pages as the fleet share amortizes."""
+    ps = int(page_size)
+    # the shared prompt spans N full pages PLUS ps/8 tokens into the next
+    # page, and the varied tail + decode stay inside that same page — so
+    # the divergence point always sits inside a partially-filled shared
+    # page; N is clamped so the whole trace fits inside L_pad
+    tail_len = max(1, ps // 16)
+    new_tokens = max(1, ps // 8)
+    extra = max(1, ps // 8) + tail_len + new_tokens
+    if int(L_pad) - extra < ps:
+        raise ValueError(
+            f"shared_prefix_trace needs L_pad >= page_size + {extra} to fit "
+            f"one full shared page plus the divergent tail; got "
+            f"L_pad={L_pad}, page_size={ps}")
+    shared_full_pages = max(1, min((3 * int(L_pad)) // 4 // ps,
+                                   (int(L_pad) - extra) // ps))
+    shared_len = shared_full_pages * ps + max(1, ps // 8)
+    total_tokens = shared_len + tail_len + new_tokens
+    total_pages = -(-total_tokens // ps)
+    unique_pages = total_pages - shared_full_pages
+    # every request but the first serves its shared tokens from the cache
+    hit_ratio = (n_requests - 1) / n_requests \
+        * shared_len / (shared_len + tail_len)
+    return {"n_requests": n_requests, "shared_len": shared_len,
+            "tail_len": tail_len, "new_tokens": new_tokens,
+            "total_pages": total_pages,
+            "shared_full_pages": shared_full_pages,
+            "unique_pages": unique_pages,
+            "hit_ratio": round(hit_ratio, 4)}
+
+
 def _measure_rtt():
     """The tunneled chip pays ~100ms dispatch+sync latency PER HOST SYNC —
     every single-sync timing window is inflated by this constant.  Measure
@@ -406,7 +447,82 @@ def _bench_decode(on_accel):
         # fraction of allocated page rows holding real tokens on this trace
         res["kv_paged_pool_utilization"] = round(
             sum(trace) / (len(trace) * rows_mean), 3)
+        # PREFIX-CACHE capacity: the shared-prefix fleet trace (one system
+        # prompt + varied tails).  Admission charges only UNIQUE pages, so
+        # the same budget holds (budget_pages - shared) / unique_per_req
+        # concurrent requests — vs budget_pages / total_pages unshared
+        tr = shared_prefix_trace(L_pad, ps_pg)
+        page_bytes_bf16 = ps_pg * row_bytes_bf16
+        page_bytes_int8 = ps_pg * row_bytes_int8
+        for tag, pb in (("", page_bytes_bf16), ("int8_", page_bytes_int8)):
+            budget_pages = budget / pb
+            res[f"kv_prefix_{tag}max_batch"] = int(
+                (budget_pages - tr["shared_full_pages"])
+                // tr["unique_pages"])
+        res["kv_prefix_max_batch_gain"] = round(
+            res["kv_prefix_max_batch"] / max(res["kv_paged_max_batch"], 1),
+            2)
+        res["kv_prefix_trace_hit_ratio"] = tr["hit_ratio"]
+        res["kv_prefix_trace"] = {k: tr[k] for k in
+                                  ("shared_len", "tail_len", "new_tokens",
+                                   "total_pages", "unique_pages")}
     return res
+
+
+def _bench_prefix_cache(on_accel):
+    """Shared-prefix serving trace through the REAL engine (prefix cache
+    on): measures the achieved llm_prefix_cache_hit_ratio, COW forks and
+    prefix evictions on the deterministic trace shared_prefix_trace
+    describes — the measured side of the kv_prefix_max_batch accounting
+    above.  Runs a scaled-down trace on CPU so the number exists (tiny) in
+    every round."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16", tensor_parallel=False,
+            use_flash_attention=True)
+        L, ps, slots, n_req, new_toks = 1152, 128, 8, 16, 16
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False,
+                               use_flash_attention=False)
+        L, ps, slots, n_req, new_toks = 128, 32, 2, 6, 4
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+    tr = shared_prefix_trace(L, ps, n_requests=n_req)
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg.vocab_size, tr["shared_len"]).astype(np.int32)
+    prompts = [np.concatenate([shared,
+                               rng.randint(0, cfg.vocab_size, tr["tail_len"])
+                               .astype(np.int32)]) for _ in range(n_req)]
+    eng = LLMEngine(model, max_batch_slots=slots, max_seq_len=L,
+                    kv_layout="paged", page_size=ps,
+                    num_pages=slots * (tr["total_pages"] + 1),
+                    prefill_chunk=ps)
+    eng.warmup()
+    t0 = time.perf_counter()
+    futs = [eng.submit(p, max_new_tokens=new_toks) for p in prompts]
+    eng.run_until_complete()
+    dt = max(time.perf_counter() - t0, 1e-6)
+    for f in futs:
+        f.result(timeout=1)
+    # engine-local counts, not the process-global registry's
+    st = eng.stats()["prefix_cache"]
+    return {"llm_prefix_cache_hit_ratio": round(st["hit_ratio"], 4),
+            "prefix_trace_requests": n_req,
+            "prefix_cow_copies": int(st["cow_copies"]),
+            "prefix_evictions": int(st["evictions"]),
+            "prefix_trace_tokens_per_sec": round(
+                n_req * new_toks / dt, 1)}
 
 
 def _bench_llama7b_layer(on_accel):
@@ -828,6 +944,7 @@ def main():
                     (_bench_llama_h4096, "llama_h4096"),
                     (_bench_resnet, "resnet"),
                     (_bench_decode, "decode"),
+                    (_bench_prefix_cache, "prefix_cache"),
                     (_bench_llama7b_layer, "llama7b_layer"),
                     (_bench_ernie, "ernie"),
                     (_bench_vit, "vit"),

@@ -26,6 +26,19 @@ never stalls running slots for more than one chunk step.  ``warmup()``
 pre-compiles either layout's programs so the first request pays no compile
 latency.
 
+On top of the paged layout sits the PREFIX CACHE (on by default,
+``prefix_cache=False`` to disable): a radix index over chained hashes of
+page-aligned prompt blocks (inference/prefix_cache.py) remembers which
+pages hold which prefixes.  Admission maps the cached pages straight into
+the new slot's page table — pages are REFCOUNTED, so finish/expiry/preempt
+decref instead of freeing — charges the pool only for the UNIQUE
+(uncached) pages, and starts chunked prefill at the first uncached token.
+A slot that must write into a shared partially-filled tail page forks it
+copy-on-write first; unreferenced cached prefixes are LRU-evicted when the
+free list runs dry.  Greedy outputs are bitwise identical with the cache
+on or off: shared pages hold exactly the kv the slot would have computed
+itself (causal attention — a token's kv never depends on what follows it).
+
 The engine is deterministic and thread-free by default (`step()` pumps one
 decode tick; `run_until_complete()` drains); `start()` spawns the
 background pump for server use.
@@ -113,6 +126,18 @@ _M_PAGE_PREEMPT = _obs.counter(
 _M_WARMUP_S = _obs.gauge(
     "llm_warmup_compile_seconds",
     "Wall time of the last warmup() precompile pass")
+_M_PREFIX_HIT_RATIO = _obs.gauge(
+    "llm_prefix_cache_hit_ratio",
+    "Cumulative fraction of prompt tokens served from the prefix cache")
+_M_PAGES_SHARED = _obs.gauge(
+    "llm_kv_pages_shared_count",
+    "KV pages currently mapped by more than one holder (slots/prefix cache)")
+_M_COW = _obs.counter(
+    "llm_cow_copies_total",
+    "Copy-on-write forks: a slot wrote into a shared kv page")
+_M_PREFIX_EVICT = _obs.counter(
+    "llm_prefix_evictions_total",
+    "Cached prefix pages reclaimed (LRU eviction / tail steal-back)")
 
 #: LLMEngine(slo_targets={...}) keys -> SLO series names (observability.slo
 #: sliding-window percentiles + burn rates, README §Observability).
@@ -159,6 +184,14 @@ class _Request:
     top_p: float = 1.0
     deadline: float | None = None
     slot: int = -1
+    skip_cache: bool = False  # set on preemption: re-admission goes fully
+                              # private so a COW-starved request can never
+                              # re-match the same contended pages forever
+    match_epoch: int = -1     # memoized radix match for a head-of-line
+    match_result: tuple | None = None  # request spinning on a full pool
+    hit_tokens: int = 0       # cache hit credited at first admission —
+                              # reversed if a COW-starved requeue abandons
+                              # the prefill those tokens were skipping
     tokens: list = field(default_factory=list)
     submit_ts: float | None = None  # engine-clock stamps for the latency
     admit_ts: float | None = None   # histograms (queue wait / TTFT / e2e)
@@ -186,7 +219,7 @@ class LLMEngine:
                  prompt_buckets=(32, 64, 128, 256), decode_chunk=1,
                  max_queue_len=None, clock=None, kv_layout=None,
                  page_size=128, num_pages=None, prefill_chunk=None,
-                 metrics_port=None, slo_targets=None,
+                 prefix_cache=None, metrics_port=None, slo_targets=None,
                  flight_recorder_dir=None, healthy_heartbeat_age=60.0):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
@@ -207,6 +240,15 @@ class LLMEngine:
         (slots * max_seq_len / page_size + trash); size it by HBM budget to
         oversubscribe.  A slot whose decode outruns the pool is preempted
         with ServerOverloadedError (llm_page_preemptions_total).
+
+        ``prefix_cache`` (paged only; default on) shares kv pages across
+        requests with a common prompt prefix: admission matches the prompt
+        against a radix index of page-block hashes, maps the hit pages
+        into the slot's table (refcounted), charges admission only for the
+        unique pages, and prefills from the first uncached token.  Writes
+        into a shared tail page fork it copy-on-write; unreferenced cached
+        prefixes LRU-evict when the pool runs dry.  Greedy outputs are
+        bitwise identical to prefix_cache=False.
 
         Degradation knobs (fault-tolerance layer): ``max_queue_len`` bounds
         the admission queue — submit() beyond it raises
@@ -238,6 +280,11 @@ class LLMEngine:
                 f"kv_layout must be None, 'dense' or 'paged', got {kv_layout!r}")
         self.paged = kv_layout == "paged"
         self.kv_layout = "paged" if self.paged else "dense"
+        if prefix_cache and not self.paged:
+            raise ValueError(
+                "prefix_cache requires kv_layout='paged' (sharing rides on "
+                "the page tables)")
+        self._prefix = None  # set by the paged branch below
         self.ps = int(page_size)
         if self.paged:
             if not getattr(model, "_supports_paged_cache", False):
@@ -286,14 +333,38 @@ class LLMEngine:
                      jnp.zeros((P, H, ps, D), kv_dtype))
                     for _ in range(nl)]
             # host-side allocator: page 0 is the trash page, never handed
-            # out; pop() order is deterministic (highest id first)
+            # out; pop() order is deterministic (highest id first).  Pages
+            # are REFCOUNTED: a page may be held by several slots (shared
+            # prefix) and/or by one prefix-cache node; it returns to the
+            # free list only when the last holder decrefs.
             self._free_pages = list(range(1, P))
+            self._page_ref = np.zeros(P, np.int32)
+            self._page_cached = np.zeros(P, bool)  # held by a cache node
             self._slot_pages: list[list[int]] = [[] for _ in range(B)]
             self._pt_host = np.zeros((B, self.M), np.int32)
+            # host->device table upload is BATCHED: allocator mutations only
+            # set the dirty flag; _pt_device() uploads once per consumer
             self._pt_dev = jnp.asarray(self._pt_host)
+            self._pt_dirty = False
             self.prefill_chunk = max(1, min(
                 int(prefill_chunk) if prefill_chunk is not None else 128,
                 self.L))
+            if prefix_cache is None:
+                prefix_cache = True  # the fleet default: share prefixes
+            if prefix_cache:
+                from .prefix_cache import PrefixCache
+
+                self._prefix = PrefixCache(self.ps)
+            self._prefix_hit_tokens = 0
+            self._prefix_prompt_tokens = 0
+            # engine-local mirrors of the process-global counters, so
+            # stats() stays per-engine (two engines in one process must not
+            # read each other's forks/evictions)
+            self._cow_copies = 0
+            self._prefix_evictions = 0
+            self._prefix_epoch = 0  # bumped on insert/evict: invalidates
+                                    # requests' memoized match results
+            self._cow_jit = None
         elif cache_dtype == "int8":
             self.caches = [
                 (jnp.zeros((B, H, L, D), jnp.int8),
@@ -491,7 +562,23 @@ class LLMEngine:
         registry, so two engines in one process share those counters.
         """
         pages_total = (self.num_pages - 1) if self.paged else 0
-        pages_used = pages_total - len(self._free_pages) if self.paged else 0
+        # "in use" counts pages mapped by SLOTS; pages held only by the
+        # prefix cache are reclaimable on demand and reported separately
+        pages_used = self._slot_held_pages() if self.paged else 0
+        prefix = None
+        if self.paged and self._prefix is not None:
+            prompt_toks = self._prefix_prompt_tokens
+            prefix = {
+                "hit_ratio": self._prefix_hit_tokens / prompt_toks
+                if prompt_toks else 0.0,
+                "hit_tokens": self._prefix_hit_tokens,
+                "prompt_tokens": prompt_toks,
+                "cached_pages": int(self._page_cached.sum()),
+                "shared_pages": int((self._page_ref > 1).sum()),
+                "nodes": len(self._prefix),
+                "cow_copies": self._cow_copies,
+                "evictions": self._prefix_evictions,
+            }
         return {
             "queue_depth": self._pending.qsize(),
             "active_slots": sum(r is not None for r in self.slot_req),
@@ -501,6 +588,7 @@ class LLMEngine:
             "kv_pages_total": pages_total,
             "kv_page_utilization": pages_used / pages_total
             if pages_total else 0.0,
+            "prefix_cache": prefix,
             "prefill_in_progress": self._prefilling is not None,
             "pump_alive": self._thread.is_alive()
             if self._thread is not None else False,
@@ -768,35 +856,171 @@ class LLMEngine:
 
     # ---------------------------------------------------- paged internals
 
+    def _pt_device(self):
+        """The device copy of the page table, uploaded AT MOST once per
+        consumer no matter how many allocator mutations happened since —
+        alloc/release/COW only dirty-flag the host table (the per-call
+        jnp.asarray re-upload was pure host-side waste)."""
+        if self._pt_dirty:
+            self._pt_dev = jnp.asarray(self._pt_host)
+            self._pt_dirty = False
+        return self._pt_dev
+
+    def _incref(self, page):
+        self._page_ref[page] += 1
+
+    def _decref(self, page):
+        """Drop one hold on a page; the LAST holder frees it.  A negative
+        refcount means a double-free — fail loudly, a silently corrupted
+        allocator serves one slot's kv to another."""
+        r = int(self._page_ref[page]) - 1
+        if r < 0:
+            raise AssertionError(f"kv page {page} decref below zero")
+        self._page_ref[page] = r
+        if r == 0:
+            self._free_pages.append(page)
+
     def _release_pages(self, slot):
-        """Reclaim every page a slot holds (finish/expiry/preempt/stop) and
-        point its page-table row back at the trash page."""
+        """Decref every page a slot holds (finish/expiry/preempt/stop) and
+        point its page-table row back at the trash page.  Shared pages
+        survive in other slots / the prefix cache; exclusive ones free."""
         if not self.paged or not self._slot_pages[slot]:
             return
-        self._free_pages.extend(reversed(self._slot_pages[slot]))
+        for page in self._slot_pages[slot]:
+            self._decref(page)
         self._slot_pages[slot] = []
         self._pt_host[slot, :] = 0
-        self._pt_dev = jnp.asarray(self._pt_host)
+        self._pt_dirty = True
 
     def _alloc_pages(self, slot, n):
-        """Move n pages from the free list into a slot's table; returns
-        False (allocating nothing) if the pool cannot cover the request."""
+        """Move n pages from the free list into a slot's table (refcount 1:
+        exclusively owned); returns False (allocating nothing) if the pool
+        cannot cover the request even after evicting unreferenced cached
+        prefixes."""
         if n <= 0:
             return True
-        if len(self._free_pages) < n:
+        if len(self._free_pages) < n and \
+                not self._evict_prefix(n - len(self._free_pages)):
             return False
         for _ in range(n):
             page = self._free_pages.pop()
+            self._page_ref[page] = 1
             self._pt_host[slot, len(self._slot_pages[slot])] = page
             self._slot_pages[slot].append(page)
-        self._pt_dev = jnp.asarray(self._pt_host)
+        self._pt_dirty = True
         return True
+
+    def _evict_prefix(self, need):
+        """LRU-evict cached prefixes nobody references until ``need`` more
+        pages are free.  Only leaves whose page is held by the cache ALONE
+        are candidates — a page mapped by a live slot frees nothing (and a
+        matched chain must stay intact under its reader)."""
+        if self._prefix is None:
+            return False
+        if self._prefix.freeable_count(
+                lambda p: int(self._page_ref[p]) > 1) < need:
+            # eviction could not cover the allocation anyway: keep the warm
+            # entries instead of destroying cache for a doomed alloc
+            return False
+        freed = 0
+        while freed < need:
+            page = self._prefix.evict_one(
+                lambda p: int(self._page_ref[p]) == 1
+                and bool(self._page_cached[p]))
+            if page is None:
+                return False
+            self._page_cached[page] = False
+            self._decref(page)
+            _M_PREFIX_EVICT.inc()
+            self._prefix_evictions += 1
+            self._prefix_epoch += 1
+            freed += 1
+        return True
+
+    def _get_cow_copy(self):
+        if self._cow_jit is None:
+            from ..models.kv_cache import cow_copy_pages
+
+            self._cow_jit = jax.jit(cow_copy_pages, donate_argnums=(0,))
+        return self._cow_jit
+
+    def _cow_page(self, slot, idx):
+        """Copy-on-write guard for a slot about to WRITE rows of its
+        page-table entry ``idx``: a shared page (other slots and/or the
+        prefix cache read it) is forked — rows copied into a fresh page,
+        the slot's table repointed, the original decref'd — so readers
+        keep the frozen kv.  When the ONLY other holder is the prefix
+        cache and no page can be freed, the slot steals the page back
+        (evicts the cache node, writes in place) instead of failing.
+        Returns False only when a genuinely-needed copy found no page."""
+        pages = self._slot_pages[slot]
+        if idx >= len(pages):
+            return True  # not allocated yet: the grower hands out a fresh one
+        old = pages[idx]
+        if int(self._page_ref[old]) <= 1:
+            return True  # exclusive: write in place
+        if self._free_pages or self._evict_prefix(1):
+            new = self._free_pages.pop()
+            self._page_ref[new] = 1
+            try:
+                self.caches = self._get_cow_copy()(
+                    self.caches, jnp.asarray(old, jnp.int32),
+                    jnp.asarray(new, jnp.int32))
+            except Exception:
+                # the copy donates self.caches; the caller's _caches_alive
+                # check escalates a consumed-buffer failure to the watchdog
+                self._page_ref[new] = 0
+                self._free_pages.append(new)
+                raise
+            pages[idx] = new
+            self._pt_host[slot, idx] = new
+            self._pt_dirty = True
+            self._decref(old)
+            _M_COW.inc()
+            self._cow_copies += 1
+            return True
+        if int(self._page_ref[old]) == 2 and self._page_cached[old] \
+                and self._prefix is not None \
+                and self._prefix.evict_page(old):
+            # steal-back: the diverging tail is the least valuable entry in
+            # the cache anyway — reclaim it rather than preempt the slot
+            self._page_cached[old] = False
+            self._decref(old)
+            _M_PREFIX_EVICT.inc()
+            self._prefix_evictions += 1
+            self._prefix_epoch += 1
+            return True
+        return False
+
+    def _cache_insert(self, slot, prompt):
+        """Register a freshly prefilled prompt's pages in the prefix index;
+        the index's new holds are incref'd so they outlive the slot."""
+        if self._prefix is None:
+            return
+        new_holds = self._prefix.insert(prompt, self._slot_pages[slot])
+        if new_holds:
+            self._prefix_epoch += 1
+        for page in new_holds:
+            self._incref(page)
+            self._page_cached[page] = True
+
+    def _slot_held_pages(self):
+        """Pages mapped by at least one SLOT (a page held only by the
+        prefix cache is reclaimable on demand, so it does not count as in
+        use — the capacity gauges would otherwise read a full pool forever
+        once the cache warms up)."""
+        return int((self._page_ref > self._page_cached).sum())
 
     def _update_page_gauges(self):
         total = self.num_pages - 1
-        used = total - len(self._free_pages)
+        used = self._slot_held_pages()
         _M_PAGES_IN_USE.set(used)
         _M_PAGE_UTIL.set(used / total if total else 0.0)
+        if self._prefix is not None:
+            _M_PAGES_SHARED.set(int((self._page_ref > 1).sum()))
+            if self._prefix_prompt_tokens:
+                _M_PREFIX_HIT_RATIO.set(
+                    self._prefix_hit_tokens / self._prefix_prompt_tokens)
 
     def _preempt_slot(self, slot):
         """Preempt an in-flight request whose next token has no free page:
@@ -816,11 +1040,15 @@ class LLMEngine:
                              pages_held=int(held))
         if req is None:
             return
-        if held >= self.num_pages - 1:
+        if held >= self.num_pages - 1 and self._prefix is None:
+            # without sharing, a slot mapping the whole pool can never fit;
+            # with the prefix cache, `held` counts shared pages too, so the
+            # impossibility check moves to re-admission (full private need)
             _fail_future(req.future, ServerOverloadedError(
                 f"request needs more kv pages than the whole pool "
                 f"({self.num_pages - 1} pages x {self.ps} tokens); rejected"))
             return
+        req.skip_cache = True
         req.prompt = np.concatenate(
             [req.prompt, np.asarray(req.tokens, np.int32)])
         with self._pending.mutex:
@@ -828,13 +1056,22 @@ class LLMEngine:
 
     def _ensure_decode_pages(self, active, eff):
         """Grow each active slot's page table to cover the rows this tick
-        will write (pos .. pos+eff-1); preempt slots the pool cannot cover.
-        Returns the surviving active list."""
+        will write (pos .. pos+eff-1), COW-forking any of those pages that
+        are shared; preempt slots the pool cannot cover.  Returns the
+        surviving active list."""
         out = []
         for i in active:
-            need = -(-(int(self.slot_pos[i]) + eff) // self.ps) \
-                - len(self._slot_pages[i])
-            if self._alloc_pages(i, need):
+            first = int(self.slot_pos[i]) // self.ps
+            last = (int(self.slot_pos[i]) + eff - 1) // self.ps
+            ok = self._alloc_pages(i, last + 1 - len(self._slot_pages[i]))
+            if ok and self._prefix is not None:
+                # only the boundary page can be shared (grown pages are
+                # fresh), but the per-entry refcount check is O(1)
+                for idx in range(first, last + 1):
+                    if not self._cow_page(i, idx):
+                        ok = False
+                        break
+            if ok:
                 out.append(i)
             else:
                 self._preempt_slot(i)
@@ -901,24 +1138,62 @@ class LLMEngine:
                     "request deadline expired while queued for admission"))
                 continue
             need = -(-(req.prompt.size + 1) // self.ps)
+            matched, shared = 0, []
+            if self._prefix is not None and not req.skip_cache:
+                if req.match_epoch == self._prefix_epoch \
+                        and req.match_result is not None:
+                    # head-of-line request spinning on a full pool: the
+                    # index hasn't changed, don't re-hash the prompt's
+                    # blocks every tick
+                    matched, shared = req.match_result
+                else:
+                    matched, shared = self._prefix.match(req.prompt)
+                    req.match_epoch = self._prefix_epoch
+                    req.match_result = (matched, shared)
             if need > self.num_pages - 1:
+                # TOTAL need, not unique: a cached prefix's pages occupy
+                # the same pool, so a slot whose table must reference more
+                # pages than exist can never complete — admitting it would
+                # spin head-of-line forever (its own matched pages pin the
+                # cache against eviction)
                 _fail_future(req.future, ServerOverloadedError(
                     f"prompt needs {need} kv pages but the pool only has "
                     f"{self.num_pages - 1}; rejected"))
                 continue
             slot = free[0]
-            if not self._alloc_pages(slot, need):
+            if shared:
+                # map the cached prefix straight into the slot's table;
+                # admission below is charged only for the UNIQUE pages
+                for p in shared:
+                    self._incref(p)
+                self._slot_pages[slot] = list(shared)
+                self._pt_host[slot, :len(shared)] = shared
+                self._pt_dirty = True
+            if not self._alloc_pages(slot, need - len(shared)):
                 # admission by free pages: head-of-line waits for
-                # reclamation (put it back where it came from)
+                # reclamation (put it back where it came from; the shared
+                # holds roll back so the cache stays evictable meanwhile)
+                self._release_pages(slot)
                 with self._pending.mutex:
                     self._pending.queue.appendleft(req)
                 return
+            # first admission EVER (admit_ts is stamped once and survives
+            # requeues): preemption/COW-starvation retries must not observe
+            # queue-wait twice nor double-count the hit-ratio denominator
+            first_admission = req.admit_ts is None
             req.admit_ts = self._clock()
-            if req.submit_ts is not None and not req.tokens:
+            if req.submit_ts is not None and first_admission:
                 wait = max(0.0, req.admit_ts - req.submit_ts)
                 _M_QUEUE_WAIT.observe(wait)
                 _slo.track("llm_queue_wait", wait)
-            self._prefilling = (req, slot, 0)
+                self._prefix_prompt_tokens += int(req.prompt.size)
+                self._prefix_hit_tokens += int(matched)
+                req.hit_tokens = int(matched)  # reversed if the prefill is
+                # abandoned by a COW-starvation requeue (the skipped chunks
+                # get recomputed privately, so the hit never happened)
+            # chunked prefill starts at the first UNCACHED token — a hit
+            # skips every chunk the cache already covers
+            self._prefilling = (req, slot, matched)
             return
 
     def _prefill_tick(self):
@@ -938,10 +1213,28 @@ class LLMEngine:
         n = req.prompt.size
         C = self.prefill_chunk
         m = min(C, n - done)
+        if self._prefix is not None \
+                and not self._cow_page(slot, done // self.ps):
+            # the chunk would write into a page other slots still read and
+            # no page can be freed for the fork: requeue recompute-style
+            # (fully private next time) instead of wedging or failing
+            self._prefilling = None
+            self._release_pages(slot)
+            req.skip_cache = True
+            # the hit credited at admission never materialized: the private
+            # re-prefill recomputes every chunk the cache was covering
+            self._prefix_hit_tokens -= req.hit_tokens
+            req.hit_tokens = 0
+            _M_PAGE_PREEMPT.inc()
+            _flight.record_event("page_preemption", slot=int(slot),
+                                 where="prefill_cow")
+            with self._pending.mutex:
+                self._pending.queue.appendleft(req)
+            return
         chunk = np.full((1, C), self.pad, np.int32)
         chunk[0, :m] = req.prompt[done:done + m]
         args = (self._params, self._buffers, self.caches,
-                self._pt_dev[slot:slot + 1], jnp.asarray(chunk),
+                self._pt_device()[slot:slot + 1], jnp.asarray(chunk),
                 jnp.asarray([done], jnp.int32),
                 jnp.asarray(m - 1, jnp.int32))
         try:
@@ -968,6 +1261,11 @@ class LLMEngine:
             self._prefilling = (req, slot, done)
             return
         self._prefilling = None
+        # the slot's pages now hold the whole prompt's kv: index the full
+        # blocks + partial tail so CONCURRENT same-prefix requests hit
+        # (insert precedes the first decode write, whose COW check then
+        # sees the tail page as shared and forks it)
+        self._cache_insert(slot, req.prompt)
         tok = self._host_select(np.asarray(logits[0, 0]), req)
         first = not req.tokens  # re-admission after preemption continues
         req.slot = slot
@@ -1026,7 +1324,7 @@ class LLMEngine:
             B = self.n_slots
             args = (params, buffers, self.caches)
             if self.paged:
-                args += (self._pt_dev,)
+                args += (self._pt_device(),)
             args += (jnp.asarray(np.full((B, 1), self.pad, np.int32)),
                      jnp.zeros((B,), jnp.int32),
                      jnp.zeros((B,), bool),

@@ -1,0 +1,231 @@
+"""Radix/trie prefix index over paged kv-cache blocks (host side).
+
+Fleet traffic is dominated by requests sharing system prompts and few-shot
+prefixes; the paged kv cache (models/kv_cache.py) makes reusing their kv a
+pure page-table problem — the ragged paged decode kernel already walks
+arbitrary per-slot page tables, so a shared page needs ZERO kernel changes.
+This module is the index that finds the shareable pages:
+
+- **Chained block hashes.**  A prompt is split into page-aligned blocks of
+  ``page_size`` tokens; block i's key is ``sha1(parent_key || tokens_i)``,
+  so a key commits to the ENTIRE prefix up to and including its block (two
+  prompts share a node only if every earlier token matches too).  Keys are
+  deterministic across processes — a cache test reproduces exactly.
+- **Full nodes** hold one completely-filled page.  They are only ever READ
+  by later requests (writes happen past the prompt), so they can be mapped
+  into any number of slots with no copy.
+- **Partial tail nodes** hold the prompt's last, partially-filled page
+  (``ntok < page_size`` valid rows) and record their raw tokens so a later
+  prompt can match the LONGEST common prefix of the tail, not just the
+  whole block.  A slot that maps a partial tail will eventually write into
+  it (its own continuation rows) — the engine forks the page copy-on-write
+  at that moment, leaving the cached rows frozen.
+- **LRU eviction.**  When the page pool runs dry the engine asks for the
+  least-recently-used LEAF whose page nobody but the cache holds; interior
+  nodes are never evicted from under a live chain (a matched chain pins its
+  pages via slot refcounts, so its nodes never satisfy the predicate).
+
+The index owns NO device memory and NO refcounts: it returns/accepts page
+ids and the engine's allocator does the incref/decref — which keeps this
+class a plain deterministic data structure that unit-tests stand alone.
+"""
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+__all__ = ["PrefixCache"]
+
+_ROOT = b""  # parent key of a prompt's first block
+
+
+class _Node:
+    __slots__ = ("key", "parent", "page", "ntok", "tokens", "nchildren",
+                 "last_used")
+
+    def __init__(self, key, parent, page, ntok, tokens):
+        self.key = key
+        self.parent = parent
+        self.page = int(page)
+        self.ntok = int(ntok)
+        self.tokens = tokens  # None for full blocks; np.int32 for partials
+        self.nchildren = 0
+        self.last_used = 0
+
+
+class PrefixCache:
+    """Trie of cached prompt-prefix pages, keyed by chained block hashes."""
+
+    def __init__(self, page_size):
+        self.ps = int(page_size)
+        self._nodes: dict[bytes, _Node] = {}
+        self._partials: dict[bytes, set[bytes]] = {}  # parent -> partial keys
+        self._tick = 0  # LRU clock: bumped on every touch, no wall time
+
+    def __len__(self):
+        return len(self._nodes)
+
+    def pages(self):
+        """Every page currently held by the index (diagnostics/invariants)."""
+        return [n.page for n in self._nodes.values()]
+
+    def _touch(self, node):
+        self._tick += 1
+        node.last_used = self._tick
+
+    @staticmethod
+    def _child_key(parent, blk_bytes, partial=False):
+        h = hashlib.sha1(parent)
+        if partial:
+            # domain-separate partial tails: a 7-token tail must never
+            # collide with a full block whose first bytes match
+            h.update(b"\x00partial\x00")
+        h.update(blk_bytes)
+        return h.digest()
+
+    # ------------------------------------------------------------- lookup
+
+    def match(self, prompt):
+        """Longest cached prefix of ``prompt`` an admission can map.
+
+        Capped at ``len(prompt) - 1`` tokens: the last prompt token's
+        logits ARE the first output token, so at least one position must
+        always be recomputed.  Returns ``(matched_tokens, pages)`` where
+        ``pages`` covers page indices ``0 .. len(pages)-1`` of the slot's
+        table (the last page is partially valid when ``matched_tokens`` is
+        off the page grid).  Touches every matched node for LRU.
+        """
+        prompt = np.asarray(prompt, np.int32)
+        usable = prompt.size - 1
+        key, matched, pages = _ROOT, 0, []
+        while matched + self.ps <= usable:
+            k = self._child_key(key, prompt[matched:matched + self.ps]
+                                .tobytes())
+            node = self._nodes.get(k)
+            if node is None:
+                break
+            self._touch(node)
+            pages.append(node.page)
+            matched += self.ps
+            key = k
+        best, best_t = None, 0
+        # sorted: set order varies with hash randomization, and an
+        # equal-overlap tie must pick the same node in every process
+        for pk in sorted(self._partials.get(key, ())):
+            node = self._nodes[pk]
+            t_max = min(node.ntok, usable - matched)
+            if t_max <= 0:
+                continue
+            eq = node.tokens[:t_max] == prompt[matched:matched + t_max]
+            t = t_max if eq.all() else int(np.argmin(eq))
+            if t > best_t:
+                best, best_t = node, t
+        if best is not None:
+            self._touch(best)
+            pages.append(best.page)
+            matched += best_t
+        return matched, pages
+
+    # ----------------------------------------------------------- mutation
+
+    def insert(self, prompt, slot_pages):
+        """Register a freshly prefilled prompt's pages.
+
+        ``slot_pages[i]`` must hold tokens ``i*ps .. (i+1)*ps - 1`` — the
+        engine's slot layout.  Blocks already cached are only touched (the
+        slot keeps its private duplicate; it frees on finish).  Returns the
+        pages NEWLY held by the index — the caller increfs each, which is
+        what keeps them alive after the slot releases.
+        """
+        prompt = np.asarray(prompt, np.int32)
+        n = prompt.size
+        key, new_holds = _ROOT, []
+        full = n // self.ps
+        for i in range(full):
+            blk = prompt[i * self.ps:(i + 1) * self.ps]
+            k = self._child_key(key, blk.tobytes())
+            node = self._nodes.get(k)
+            if node is None:
+                node = _Node(k, key, slot_pages[i], self.ps, None)
+                self._nodes[k] = node
+                parent = self._nodes.get(key)
+                if parent is not None:
+                    parent.nchildren += 1
+                new_holds.append(node.page)
+            self._touch(node)
+            key = k
+        t = n - full * self.ps
+        if t > 0:
+            tail = prompt[full * self.ps:]
+            k = self._child_key(key, tail.tobytes(), partial=True)
+            node = self._nodes.get(k)
+            if node is None:
+                node = _Node(k, key, slot_pages[full], t, tail.copy())
+                self._nodes[k] = node
+                self._partials.setdefault(key, set()).add(k)
+                parent = self._nodes.get(key)
+                if parent is not None:
+                    parent.nchildren += 1
+                new_holds.append(node.page)
+            self._touch(node)
+        return new_holds
+
+    def freeable_count(self, pinned_page):
+        """How many pages leaf-first eviction could EVER free right now:
+        every node except those on the path to a node whose page
+        ``pinned_page(page)`` says is held beyond the cache (a pinned node
+        can't be evicted, so neither can its ancestors — evicting an
+        interior node would strand the pinned chain).  Lets the engine
+        refuse an eviction run that would destroy warm entries without
+        ultimately covering the allocation."""
+        pinned = set()
+        for node in self._nodes.values():
+            if pinned_page(node.page):
+                k = node.key
+                while k != _ROOT and k not in pinned:
+                    n = self._nodes.get(k)
+                    if n is None:
+                        break  # orphaned boundary (evicted interior parent)
+                    pinned.add(k)
+                    k = n.parent
+        return len(self._nodes) - len(pinned)
+
+    def evict_one(self, evictable):
+        """Remove the least-recently-used LEAF whose page satisfies
+        ``evictable(page)`` (the engine passes "held by nobody but the
+        cache").  Returns the freed page (caller decrefs) or None.  The
+        LRU scan is O(nodes) — the index is host-side and small next to a
+        page pool worth of HBM."""
+        best = None
+        for node in self._nodes.values():
+            if node.nchildren == 0 and evictable(node.page):
+                if best is None or node.last_used < best.last_used:
+                    best = node
+        if best is None:
+            return None
+        self._remove(best)
+        return best.page
+
+    def evict_page(self, page):
+        """Remove the leaf node holding ``page`` (the steal-back path: a
+        slot about to write a tail page whose ONLY other holder is the
+        cache reclaims it in place instead of paying a copy).  Returns
+        True if a node was removed."""
+        for node in self._nodes.values():
+            if node.page == page and node.nchildren == 0:
+                self._remove(node)
+                return True
+        return False
+
+    def _remove(self, node):
+        del self._nodes[node.key]
+        if node.tokens is not None:
+            siblings = self._partials.get(node.parent)
+            if siblings is not None:
+                siblings.discard(node.key)
+                if not siblings:
+                    del self._partials[node.parent]
+        parent = self._nodes.get(node.parent)
+        if parent is not None:
+            parent.nchildren -= 1

@@ -42,7 +42,7 @@ def _select(logits, key, do_sample, temperature, top_k, top_p):
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              pad_token_id=0, cache_dtype=None, kv_layout=None,
-             page_size=128):
+             page_size=128, share_prefix=False):
     """Generate `max_new_tokens` continuations of `input_ids` [B, S0].
 
     Returns int32 ids [B, max_new_tokens]; once a row emits `eos_token_id`
@@ -61,6 +61,15 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     parity-tested and benchmarked against the dense static path with no
     server in the loop.  Greedy outputs are identical to the static
     layout's: same math, different residency.
+
+    share_prefix=True (paged only) additionally aliases every row's
+    page-aligned common prompt prefix onto row 0's physical pages — the
+    serving engine's shared-prefix read path (inference/prefix_cache.py),
+    run solo so it can be parity-tested with no server in the loop.  The
+    aliased pages are read-only by construction: decode writes land at
+    positions >= the prompt length, i.e. in each row's private pages, so
+    no copy-on-write is ever needed here and outputs stay bitwise
+    identical to private tables.
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
@@ -88,10 +97,15 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         raise ValueError(
             f"{type(model).__name__} does not support the paged kv-cache "
             "layout; use the default kv_layout")
+    if share_prefix and kv_layout != "paged":
+        raise ValueError(
+            "share_prefix requires kv_layout='paged' (sharing rides on the "
+            "page tables)")
     page_size = int(page_size)
     cache_key = (B, S0, int(max_new_tokens), bool(do_sample), float(temperature),
                  int(top_k), float(top_p), eos, int(pad_token_id),
-                 bool(model.training), cache_dtype, kv_layout, page_size)
+                 bool(model.training), cache_dtype, kv_layout, page_size,
+                 bool(share_prefix))
     gen_cache = model.__dict__.setdefault("_generate_cache", {})
     if cache_key in gen_cache:
         key = _random.get_rng_key()
@@ -141,6 +155,18 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
                 if kv_layout == "paged":
                     page_tbl = (1 + jnp.arange(B * n_pages, dtype=jnp.int32)
                                 ).reshape(B, n_pages)
+                    if share_prefix and B > 1:
+                        # alias every row's page-aligned common prompt
+                        # prefix onto row 0's PHYSICAL pages.  Aliased
+                        # pages are never written: decode scatters at
+                        # positions >= S0 >= cpl, whose page index is >=
+                        # k_shared, and only pages < k_shared are shared.
+                        same = jnp.all(ids == ids[:1], axis=0)
+                        cpl = jnp.where(same.all(), S0, jnp.argmin(same))
+                        k_shared = (cpl // page_size).astype(jnp.int32)
+                        page_tbl = jnp.where(
+                            jnp.arange(n_pages, dtype=jnp.int32)[None, :]
+                            < k_shared, page_tbl[:1], page_tbl)
                 static = []
                 for (k, v) in caches:
                     pad = [(0, 0), (0, 0), (0, L_pad - S0), (0, 0)]

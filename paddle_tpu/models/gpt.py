@@ -230,11 +230,11 @@ class GPTForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  pad_token_id=0, cache_dtype=None, kv_layout=None,
-                 page_size=128):
+                 page_size=128, share_prefix=False):
         """Compiled decode loop on a static kv-cache (models/generation.py)."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
                     top_k, top_p, eos_token_id, pad_token_id,
                     cache_dtype=cache_dtype, kv_layout=kv_layout,
-                    page_size=page_size)
+                    page_size=page_size, share_prefix=share_prefix)

@@ -27,6 +27,14 @@ Paged layout contract (the vLLM/Ragged-Paged-Attention design, TPU-native):
     outside the trash page.
   - capacity is bounded by ACTUAL sequence lengths rounded up to a page,
     not by max_seq_len — the whole point: admission is by free pages.
+  - SHARING (prefix cache, inference/prefix_cache.py): a page may appear in
+    several slots' tables at once — requests with a common prompt prefix
+    map the same physical pages and the host allocator refcounts them.
+    Shared FULL pages are read-only by construction (every write lands at
+    a position past the prompt); a shared partially-filled TAIL page is
+    forked copy-on-write (``cow_copy_pages``) the moment a slot must write
+    its continuation rows into it, so readers keep the frozen original.
+    None of this reaches the kernel: it still just walks page tables.
 
 Buffers are HEAD-MAJOR [B, H, L, D] (scales [B, H, L]): each (batch, head)
 streams contiguous [L, D] keys/values — the layout the decode kernel and the
@@ -125,6 +133,17 @@ TRASH_PAGE = 0  # reserved pool slot: padding/garbage writes land here
 def pages_for(n_tokens, page_size):
     """Pages needed to hold n_tokens (host-side allocator arithmetic)."""
     return -(-int(n_tokens) // int(page_size))
+
+
+def cow_copy_pages(caches, src, dst):
+    """Copy page ``src``'s rows into page ``dst`` across every layer's
+    pools — the device side of a COPY-ON-WRITE fork.  ``caches`` is the
+    engine's per-layer list of pool tuples (k/v pools, plus scale pools in
+    the int8 layout — every element is ``[P, ...]`` page-major, so one
+    generic row copy covers both layouts).  The caller then repoints the
+    writing slot's page-table entry at ``dst``; readers of ``src`` are
+    untouched."""
+    return [tuple(x.at[dst].set(x[src]) for x in c) for c in caches]
 
 
 def _token_pages_rows(pos, page_tbl, S, page_size, max_pages):

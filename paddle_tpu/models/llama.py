@@ -429,15 +429,17 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  pad_token_id=0, cache_dtype=None, kv_layout=None,
-                 page_size=128):
+                 page_size=128, share_prefix=False):
         """Compiled autoregressive decoding on a static kv-cache — one XLA
         program for prefill + the whole token scan (models/generation.py).
         cache_dtype='int8' halves the kv-cache HBM footprint;
         kv_layout='paged' decodes through the paged pool + page-table
-        layout (the serving engine's cache) for parity/benchmarking."""
+        layout (the serving engine's cache) for parity/benchmarking;
+        share_prefix=True additionally aliases the batch's common prompt
+        prefix onto shared physical pages (the prefix-cache read path)."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
                     top_k, top_p, eos_token_id, pad_token_id,
                     cache_dtype=cache_dtype, kv_layout=kv_layout,
-                    page_size=page_size)
+                    page_size=page_size, share_prefix=share_prefix)

@@ -192,3 +192,30 @@ def test_paged_kv_layout_rejects_unknown():
     with pytest.raises(ValueError):
         model.generate(paddle.to_tensor(prompt), max_new_tokens=2,
                        kv_layout="interleaved")
+
+
+def test_paged_share_prefix_matches_private_tables():
+    """share_prefix=True aliases the rows' page-aligned common prompt
+    prefix onto row 0's physical pages — the serving engine's
+    shared-prefix READ path, run solo.  Greedy outputs are bitwise
+    identical to private tables (plain + int8); the prompts diverge
+    mid-page so the partial page stays private."""
+    import pytest
+
+    model = _model()
+    rng = np.random.RandomState(7)
+    shared = rng.randint(0, 128, 37)  # 2 full pages of 16 + 5 into page 3
+    prompt = np.stack([np.concatenate([shared, rng.randint(0, 128, 5)]),
+                       np.concatenate([shared, rng.randint(0, 128, 5)])
+                       ]).astype(np.int32)
+    ids = paddle.to_tensor(prompt)
+    for dt in (None, "int8"):
+        private = np.asarray(model.generate(
+            ids, max_new_tokens=6, kv_layout="paged", page_size=16,
+            cache_dtype=dt)._value)
+        aliased = np.asarray(model.generate(
+            ids, max_new_tokens=6, kv_layout="paged", page_size=16,
+            cache_dtype=dt, share_prefix=True)._value)
+        np.testing.assert_array_equal(private, aliased)
+    with pytest.raises(ValueError):  # dense has no page tables to share
+        model.generate(ids, max_new_tokens=2, share_prefix=True)

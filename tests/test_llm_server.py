@@ -351,6 +351,195 @@ def test_paged_engine_with_gpt_family():
     assert got == want
 
 
+# ----------------------------- prefix cache: refcounted shared kv pages
+
+
+def _mk_shared_prompts(rng, shared_len, tails, vocab=1024):
+    shared = rng.randint(0, vocab, shared_len).astype(np.int32)
+    return [np.concatenate([shared, rng.randint(0, vocab, t)
+                            .astype(np.int32)]) for t in tails]
+
+
+def test_prefix_cache_bitwise_parity_on_vs_off(model):
+    """Greedy decode is BITWISE identical with the prefix cache on vs off,
+    across a shared-prefix batch whose tails diverge INSIDE the partial
+    tail page (so hits, partial-tail matches, and COW forks all fire)."""
+    rng = np.random.RandomState(50)
+    prompts = _mk_shared_prompts(rng, 44, (4, 6, 3, 5))  # off the page grid
+    outs = []
+    for on in (True, False):
+        eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                        kv_layout="paged", page_size=32, prefill_chunk=16,
+                        prefix_cache=on)
+        futs = [eng.submit(p, max_new_tokens=6) for p in prompts]
+        eng.run_until_complete()
+        outs.append([f.result(timeout=1) for f in futs])
+        if on:
+            st = eng.stats()["prefix_cache"]
+            assert st["hit_tokens"] > 0 and st["cow_copies"] > 0
+            assert eng.stats()["llm_kv_pages_in_use"] == 0
+    assert outs[0] == outs[1]
+    for p, got in zip(prompts, outs[0]):
+        assert got == _oracle(model, p, 6)
+
+
+def test_prefix_cache_parity_int8_paged(model):
+    rng = np.random.RandomState(51)
+    prompts = _mk_shared_prompts(rng, 40, (5, 7))
+    outs = []
+    for on in (True, False):
+        eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                        kv_layout="paged", page_size=32, prefill_chunk=16,
+                        cache_dtype="int8", prefix_cache=on)
+        futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        eng.run_until_complete()
+        outs.append([f.result(timeout=1) for f in futs])
+    assert outs[0] == outs[1]
+
+
+def test_prefix_cache_parity_gpt_family():
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    paddle.seed(11)
+    cfg = GPTConfig.tiny(max_position_embeddings=128)
+    gpt = GPTForCausalLM(cfg)
+    gpt.eval()
+    rng = np.random.RandomState(52)
+    prompts = _mk_shared_prompts(rng, 37, (6, 4), vocab=cfg.vocab_size)
+    outs = []
+    for on in (True, False):
+        eng = LLMEngine(gpt, max_batch_slots=2, max_seq_len=128,
+                        kv_layout="paged", page_size=32, prefill_chunk=16,
+                        prefix_cache=on)
+        futs = [eng.submit(p, max_new_tokens=5) for p in prompts]
+        eng.run_until_complete()
+        outs.append([f.result(timeout=1) for f in futs])
+    assert outs[0] == outs[1]
+    for p, got in zip(prompts, outs[0]):
+        ids = paddle.to_tensor(np.asarray(p, np.int32)[None, :])
+        want = list(np.asarray(gpt.generate(ids, max_new_tokens=5)._value)[0])
+        assert got == want
+
+
+def test_prefix_hit_skips_prefill_chunks(model):
+    """A hit starts chunked prefill at the first UNCACHED token: an
+    identical re-submitted prompt prefills in ONE chunk instead of five."""
+    rng = np.random.RandomState(53)
+    p = rng.randint(0, 1024, 40).astype(np.int32)
+    eng = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=8)
+    n0 = _prefill_chunk_count()
+    first = eng.generate(p, max_new_tokens=4)
+    assert _prefill_chunk_count() - n0 == 5  # ceil(40 / 8): cold
+    n1 = _prefill_chunk_count()
+    again = eng.generate(p, max_new_tokens=4)
+    # 39 of 40 tokens cached (the last one must be recomputed for logits)
+    assert _prefill_chunk_count() - n1 == 1
+    assert again == first == _oracle(model, p, 4)
+    st = eng.stats()["prefix_cache"]
+    assert st["hit_tokens"] == 39 and st["prompt_tokens"] == 80
+
+
+def test_prefix_sharing_multiplies_concurrency_at_fixed_pool(model):
+    """The capacity lever: four shared-prefix requests run CONCURRENTLY in
+    a pool where unshared paged admission fits only two — admission charges
+    only the unique pages."""
+    rng = np.random.RandomState(54)
+    prompts = _mk_shared_prompts(rng, 96, (7, 7, 7, 7))  # page-aligned share
+    peak = {True: 0, False: 0}
+    for on in (True, False):
+        eng = LLMEngine(model, max_batch_slots=4, max_seq_len=128,
+                        kv_layout="paged", page_size=32, prefill_chunk=32,
+                        num_pages=9, prefix_cache=on)  # 8 allocatable pages
+        futs = [eng.submit(p, max_new_tokens=12) for p in prompts]
+        for _ in range(300):
+            if all(f.done() for f in futs):
+                break
+            eng.step()
+            peak[on] = max(peak[on],
+                           sum(r is not None for r in eng.slot_req))
+        outs = [f.result(timeout=1) for f in futs]
+        for p, got in zip(prompts, outs):
+            assert got == _oracle(model, p, 12)
+        if on:
+            st = eng.stats()["prefix_cache"]
+            assert st["shared_pages"] == 0  # drained: holds released
+            assert st["hit_ratio"] > 0.65
+    assert peak[True] == 4, "sharing should fit the whole batch at once"
+    assert peak[False] <= 2, "unshared paged admission must not fit 4"
+
+
+def test_prefix_eviction_then_reprefill_parity(model):
+    """Pool pressure LRU-evicts unreferenced cached prefixes; a re-submit
+    of the evicted prompt re-prefills from scratch and still matches the
+    oracle bitwise (the eviction -> re-prefill cycle)."""
+    rng = np.random.RandomState(55)
+    pa = rng.randint(0, 1024, 40).astype(np.int32)
+    pb = rng.randint(0, 1024, 40).astype(np.int32)
+    eng = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=32,
+                    num_pages=4)  # 3 allocatable: A's cache must give way
+    assert eng.generate(pa, max_new_tokens=4) == _oracle(model, pa, 4)
+    assert eng.generate(pb, max_new_tokens=4) == _oracle(model, pb, 4)
+    # B's admission had to evict A's pages (engine-local count)
+    assert eng.stats()["prefix_cache"]["evictions"] > 0
+    # the evicted prompt admits again, re-prefills, and stays exact
+    assert eng.generate(pa, max_new_tokens=4) == _oracle(model, pa, 4)
+    assert eng.stats()["llm_kv_pages_in_use"] == 0
+
+
+def test_prefix_cache_shared_pages_visible_midflight(model):
+    """llm_kv_pages_shared_count / stats() see pages mapped by two slots
+    plus the cache while both requests are in flight."""
+    rng = np.random.RandomState(56)
+    prompts = _mk_shared_prompts(rng, 64, (5, 9))
+    eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=32)
+    f1 = eng.submit(prompts[0], max_new_tokens=20)
+    f2 = eng.submit(prompts[1], max_new_tokens=20)
+    for _ in range(6):
+        eng.step()
+    st = eng.stats()["prefix_cache"]
+    assert sum(r is not None for r in eng.slot_req) == 2
+    assert st["shared_pages"] >= 2  # the two full shared-prefix pages
+    assert st["cached_pages"] >= 2
+    eng.run_until_complete()
+    assert f1.result(timeout=1) == _oracle(model, prompts[0], 20)
+    assert f2.result(timeout=1) == _oracle(model, prompts[1], 20)
+
+
+def test_prefix_cache_rejected_on_dense_layout(model):
+    with pytest.raises(ValueError):
+        LLMEngine(model, max_batch_slots=2, max_seq_len=128,
+                  prefix_cache=True)
+
+
+def test_prefix_impossible_total_need_is_shed(model):
+    """Admission's impossibility check uses the TOTAL page need, not the
+    unique (uncached) need: a cached prefix's pages occupy the same pool,
+    so a prompt whose full table exceeds the pool can never complete even
+    on a 100% hit — it must shed, not spin head-of-line forever (its own
+    matched pages pin the cache against eviction)."""
+    from paddle_tpu.inference import ServerOverloadedError
+
+    rng = np.random.RandomState(53)
+    eng = LLMEngine(model, max_batch_slots=1, max_seq_len=128,
+                    kv_layout="paged", page_size=32, prefill_chunk=32,
+                    num_pages=4)  # 3 allocatable pages
+    head = rng.randint(0, 1024, 40).astype(np.int32)
+    f1 = eng.submit(head, max_new_tokens=4)  # caches ~2 pages of prefix
+    eng.run_until_complete()
+    assert f1.result(timeout=1) == _oracle(model, head, 4)
+    assert eng.stats()["prefix_cache"]["cached_pages"] >= 1
+    # extends the cached prefix: unique need fits the pool, total doesn't
+    big = np.concatenate([head, rng.randint(0, 1024, 60).astype(np.int32)])
+    f2 = eng.submit(big, max_new_tokens=30)  # needs 4 > 3 pages total
+    eng.run_until_complete()
+    with pytest.raises(ServerOverloadedError):
+        f2.result(timeout=1)
+    assert eng.stats()["llm_kv_pages_in_use"] == 0
+
+
 def test_engine_with_gpt_family():
     """The engine is model-agnostic over the generate_step/prefill_step
     contract: the GPT family (learned positions, fused qkv block) serves

@@ -184,11 +184,13 @@ def project_llama7b_hybrid256(bench, tp_cal=1.0):
 def project_serving_capacity(bench):
     """Serving-capacity axis (inference/llm_server.py): per-chip decode
     rates and kv-cache capacity from the newest bench round, plus the paged
-    layout's capacity at the same HBM budget.  Paged numbers come from the
-    round's kv_paged_* fields when present; until a round measures them,
-    they are derived with the same mixed-length-trace accounting bench.py
-    uses (contexts 100..L in steps of 100, page_size 128) and labeled so."""
-    from bench import paged_capacity_trace  # ROOT is on sys.path
+    layout's capacity at the same HBM budget and the PREFIX-CACHE capacity
+    on the shared-prefix fleet trace.  Paged/prefix numbers come from the
+    round's kv_paged_* / kv_prefix_* fields when present; until a round
+    measures them, they are derived with the same trace accounting bench.py
+    uses (mixed lengths 100..L step 100 for paged; one shared system prompt
+    + varied tails for prefix, page_size 128) and labeled so."""
+    from bench import paged_capacity_trace, shared_prefix_trace
 
     tok8 = bench.get("llama_decode_steady_tokens_per_sec")
     dense_b = bench.get("kv_bf16_max_batch")
@@ -203,6 +205,17 @@ def project_serving_capacity(bench):
     paged_b = bench.get("kv_paged_max_batch", int(dense_b * gain))
     paged_b8 = bench.get("kv_paged_int8_max_batch",
                          int((dense_b8 or 0) * gain))
+    # prefix cache on the shared-prefix trace: the SAME page budget the
+    # paged numbers used (budget_pages ~= paged_b * mixed-trace pages/req),
+    # charged only for each request's unique pages
+    tr = shared_prefix_trace(L_pad, 128)
+    measured_px = "kv_prefix_max_batch" in bench
+    budget_pages = paged_b * pages_mean
+    prefix_b = bench.get("kv_prefix_max_batch", int(
+        (budget_pages - tr["shared_full_pages"]) // tr["unique_pages"]))
+    prefix_b8 = bench.get("kv_prefix_int8_max_batch", int(
+        (paged_b8 * pages_mean - tr["shared_full_pages"])
+        // tr["unique_pages"]) if paged_b8 else None)
     tok32q = bench.get("llama_decode_int8_b32_steady_tokens_per_sec")
     out = {
         "config": f"LLM decode service, 738M model @ ctx {L_pad} "
@@ -218,6 +231,19 @@ def project_serving_capacity(bench):
         "paged_capacity_gain_mixed_trace": round(gain, 2),
         "paged_numbers_source": "measured (bench kv_paged_*)" if measured
         else "derived from dense round via the bench.py trace formula",
+        "kv_prefix_max_batch": prefix_b,
+        "kv_prefix_int8_max_batch": prefix_b8,
+        "prefix_capacity_gain_vs_paged": round(
+            prefix_b / max(paged_b, 1), 2),
+        "prefix_trace_hit_ratio": bench.get(
+            "llm_prefix_cache_hit_ratio", tr["hit_ratio"]),
+        "prefix_trace": {k: tr[k] for k in
+                         ("shared_len", "tail_len", "new_tokens",
+                          "total_pages", "unique_pages", "n_requests")},
+        "prefix_numbers_source": "measured (bench kv_prefix_*)"
+        if measured_px
+        else "derived from the paged numbers via the bench.py shared-prefix"
+             " trace formula",
     }
     if tok32q:
         out["pod_decode_tokens_per_sec_256chips_int8_b32"] = round(
