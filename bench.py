@@ -918,6 +918,66 @@ def _bench_observability(on_accel):
     return out
 
 
+def _bench_alerting(on_accel):
+    """Alerting-plane cost guard (ISSUE 7): exposition parse cost of a
+    realistic scraped payload and rule-evaluation cost per engine tick
+    over the default rule set — the companions to
+    obs_overhead_us_per_step, so the sense/decide loop can't quietly grow
+    into a hot-path tax.  Host-side by construction: runs on CPU too."""
+    from paddle_tpu.observability import alerts, metrics, scrape, slo
+
+    # a realistic fleet payload: the full instrumented registry (the
+    # process importing bench has llm/train/store series registered) plus
+    # synthetic per-replica series to hit fleet-scale label cardinality
+    reg = metrics.REGISTRY
+    for i in range(8):
+        slo.track(f"bench_alert_series_{i}", 0.01 * (i + 1))
+    synth = metrics.MetricRegistry()
+    g = synth.gauge("bench_fleet_depth", "synthetic", labelnames=("rank",))
+    h = synth.histogram("bench_fleet_seconds", "synthetic",
+                        labelnames=("rank",))
+    for rank in range(16):
+        g.labels(rank=str(rank)).set(rank * 3.0)
+        for k in range(8):
+            h.labels(rank=str(rank)).observe(0.001 * (k + 1))
+    payload = reg.render_prometheus() + synth.render_prometheus()
+
+    def med(fn, n):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    parse_s = med(lambda: scrape.parse_prometheus(payload), 9)
+    families = scrape.parse_prometheus(payload)
+    samples = scrape.SampleSet().add_families(families, {"target": "t0"})
+
+    rules = alerts.default_rules() + [
+        alerts.Rule("bench_backlog", metric="bench_fleet_depth", op=">",
+                    threshold=30.0, for_s=5.0),
+        alerts.Rule("bench_rising", kind="delta",
+                    metric="bench_fleet_seconds_count", op=">",
+                    threshold=100.0, window_s=60.0),
+    ]
+    engine = alerts.AlertEngine(rules=rules, clock=lambda: 0.0)
+    tick = {"t": 0.0}
+
+    def one_tick():
+        tick["t"] += 1.0
+        engine.evaluate(samples, now=tick["t"])
+
+    one_tick()  # first tick builds the instance cells
+    eval_s = med(one_tick, 50)
+    return {
+        "alert_parse_us_per_scrape": round(parse_s * 1e6, 1),
+        "alert_eval_us_per_tick": round(eval_s * 1e6, 1),
+        "alert_scrape_samples": len(samples),
+        "alert_rules_count": len(rules),
+    }
+
+
 def main():
     import jax
 
@@ -949,7 +1009,8 @@ def main():
                     (_bench_ernie, "ernie"),
                     (_bench_vit, "vit"),
                     (_bench_ocr, "ocr"),
-                    (_bench_observability, "observability")):
+                    (_bench_observability, "observability"),
+                    (_bench_alerting, "alerting")):
         if time.monotonic() > deadline:
             out[f"{tag}_skipped"] = "bench budget exhausted"
             continue

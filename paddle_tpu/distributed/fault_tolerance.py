@@ -35,8 +35,8 @@ from ..observability import metrics as _obs
 from ..observability.spans import span as _span
 
 __all__ = [
-    "Preemption", "ExponentialBackoff", "RetryPolicy", "retry_call",
-    "run_with_recovery", "TRANSIENT_ERRNOS",
+    "Preemption", "AlertRestart", "ExponentialBackoff", "RetryPolicy",
+    "retry_call", "run_with_recovery", "TRANSIENT_ERRNOS",
     "install_preemption_handler", "PreemptionNotice",
 ]
 
@@ -71,6 +71,19 @@ class Preemption(Exception):
     Raised by the fault-injection harness and by SIGTERM adapters; caught by
     ``run_with_recovery`` which restores the latest valid checkpoint.
     """
+
+
+class AlertRestart(Preemption):
+    """A telemetry-driven restart decision: an ``AlertPolicy`` mapped a
+    firing alert to the ``restart`` action (ISSUE 7's sense->decide->act
+    loop).  Subclasses ``Preemption`` so the default ``recoverable`` set of
+    ``run_with_recovery`` already heals it with a checkpoint restore."""
+
+    def __init__(self, decision):
+        self.decision = decision
+        super().__init__(
+            f"alert {decision.alert!r} (episode {decision.episode}, labels "
+            f"{decision.labels}) fired with action 'restart'")
 
 
 class ExponentialBackoff:
@@ -144,7 +157,8 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
                       recoverable=(Preemption,), max_restarts=10,
                       save_initial=True, on_event=None,
                       flight_recorder_dir=None, telemetry_port=None,
-                      healthy_step_age=600.0):
+                      healthy_step_age=600.0, alert_policy=None,
+                      alert_every=1):
     """Run ``num_steps`` training steps under checkpoint-restore supervision.
 
     ``step_fn(step)`` performs one training step (a closure over the model /
@@ -169,6 +183,19 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
     ephemeral) serves `/metrics` + `/healthz` for the duration of the run;
     its ``last_step_age`` check fails when no step has completed for
     ``healthy_step_age`` seconds (a wedged loop looks unhealthy, not idle).
+
+    Alerting plane: ``alert_policy`` (an ``observability.alerts.
+    AlertPolicy``) is polled after every ``alert_every``-th completed step
+    — sense (scrape the fleet, or read the local registry), decide
+    (evaluate the rules), act.  A decision whose action is ``"restart"``
+    raises :class:`AlertRestart` (a ``Preemption``), so the supervisor
+    checkpoint-restores exactly as it would for an eviction — the restart
+    decision is finally driven by the scraped series, as the telemetry
+    plane left open.  A policy that should never restart this supervisor
+    simply maps no alert to ``"restart"``.  A scraper-backed policy
+    self-throttles (``AlertPolicy.min_interval_s``, default 15 s), so
+    per-step polling never puts a fleet HTTP scrape on the hot path;
+    ``alert_every`` additionally coarsens by step count.
     """
     recoverable = tuple(recoverable)
     if flight_recorder_dir is None:
@@ -192,6 +219,13 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
         server = TelemetryServer(port=telemetry_port,
                                  recorder=_flight.RECORDER)
         server.register_healthcheck("last_step_age", _check_step_age)
+        if alert_policy is not None:
+            # /alertz on the training endpoint reports the very engine
+            # driving the restarts.  eval_on_request=False: the policy's
+            # poll is the one tick source — a scrape must not feed LOCAL
+            # registry samples into an engine evaluating SCRAPED ones
+            server.attach_alerts(alert_policy.engine,
+                                 eval_on_request=False)
         server.start()
     restarts = 0
     dumped_exc = [None]  # the exception the inner handler already dumped
@@ -219,6 +253,20 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
                     manager.save(completed, get_state(), force=True)
                 elif manager.should_save(completed):
                     manager.save(completed, get_state())
+                if alert_policy is not None \
+                        and completed % max(1, int(alert_every)) == 0:
+                    for d in alert_policy.poll():
+                        if d.action == "restart":
+                            raise AlertRestart(d)
+                        # this supervisor only executes restarts; other
+                        # string actions are for an ElasticManager — and
+                        # since the policy marked the episode acted, they
+                        # are gone.  Leave a black-box trace, never drop
+                        # an actuation silently.
+                        _flight.record_event(
+                            "alert_decision_unhandled", alert=d.alert,
+                            action=d.action, episode=d.episode,
+                            handler="run_with_recovery")
             except recoverable as e:
                 restarts += 1
                 _flight.record_event("recoverable_failure", step=completed,

@@ -68,10 +68,22 @@ class ElasticManager:
 
     `np` may be "N" or "MIN:MAX" (ref manager.py parses PADDLE_ELASTIC_NP the same
     way).  `on_change(event, hosts)` fires with event in {"scale_in", "scale_out"}.
+
+    Alerting plane (ISSUE 7): `alert_policy` (an
+    `observability.alerts.AlertPolicy`) lets scraped telemetry drive the
+    manager's decisions — `poll_alerts()` runs sense->decide->act and maps
+    the policy's decisions onto the manager: `restart` marks a pending
+    restart (`check()` then returns `ElasticStatus.RESTART` until
+    `consume_restart()`), `quarantine` removes the named host from
+    membership (the alert instance's `host`/`target` label names it), and
+    `widen_deadline` grants `wait_for_np` extra slack — a fleet that is
+    slow because it is restarting should not be declared dead by its own
+    supervisor.
     """
 
     def __init__(self, store=None, job_id=None, np=None, host=None,
-                 heartbeat_interval=1.0, on_change=None):
+                 heartbeat_interval=1.0, on_change=None, alert_policy=None,
+                 max_wait_slack=300.0, target_to_host=None):
         self.store = store if store is not None else _DictStore()
         self.job_id = job_id or os.environ.get("PADDLE_JOB_ID", "default")
         np = str(np or os.environ.get("PADDLE_ELASTIC_NP", "1"))
@@ -87,6 +99,15 @@ class ElasticManager:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._known_hosts: set[str] = set()
+        self.alert_policy = alert_policy
+        self._quarantined: set[str] = set()
+        self._wait_slack = 0.0
+        self.max_wait_slack = float(max_wait_slack)
+        self._pending_restart = None  # AlertDecision awaiting consume
+        # scrape-target name (host:metrics_port) -> membership host name;
+        # the metrics port is rarely the trainer endpoint, so a quarantine
+        # decision needs this mapping to land on the right heartbeat key
+        self.target_to_host = dict(target_to_host or {})
 
     # ------------------------------------------------------------- membership
     def _node_key(self, host=None):
@@ -122,8 +143,9 @@ class ElasticManager:
             if v is None:
                 continue
             ts = float(v.decode() if isinstance(v, bytes) else v)
-            if now - ts <= 3 * self.interval:
-                out.append(k[len(pre):])
+            name = k[len(pre):]
+            if now - ts <= 3 * self.interval and name not in self._quarantined:
+                out.append(name)
         return sorted(out)
 
     def _watch_loop(self):
@@ -141,7 +163,13 @@ class ElasticManager:
 
     # ------------------------------------------------------------- decisions
     def check(self) -> str:
-        """Map current membership to an action (ref manager.py exit/restart logic)."""
+        """Map current membership to an action (ref manager.py exit/restart
+        logic).  A telemetry-driven restart decision (`poll_alerts`)
+        dominates membership: the ranks may all be heartbeating while one
+        of them is wedged — exactly the failure mode heartbeats cannot
+        see and scraped healthchecks can."""
+        if self._pending_restart is not None:
+            return ElasticStatus.RESTART
         n = len(self.hosts())
         if n >= self.min_np:
             return ElasticStatus.COMPLETED if n <= self.max_np else ElasticStatus.ERROR
@@ -149,13 +177,82 @@ class ElasticManager:
 
     def wait_for_np(self, timeout=60) -> bool:
         # local wait window: monotonic (the heartbeat VALUES stay wall-clock —
-        # they are compared across hosts, which share NTP, not a boot clock)
-        deadline = time.monotonic() + timeout
+        # they are compared across hosts, which share NTP, not a boot clock).
+        # widen_wait() slack (a widen_deadline alert action) extends it.
+        deadline = time.monotonic() + timeout + self._wait_slack
         while time.monotonic() < deadline:
             if self.min_np <= len(self.hosts()) <= self.max_np:
                 return True
             time.sleep(self.interval / 2)
         return False
+
+    # ------------------------------------------------- telemetry-driven act
+    def quarantine(self, host):
+        """Exclude ``host`` from membership until ``unquarantine`` — the
+        actuation for a node whose telemetry says it is lying about being
+        alive (heartbeats fresh, healthchecks failing)."""
+        self._quarantined.add(str(host))
+
+    def unquarantine(self, host):
+        self._quarantined.discard(str(host))
+
+    @property
+    def quarantined(self):
+        return sorted(self._quarantined)
+
+    def widen_wait(self, extra_s):
+        """Grant ``wait_for_np`` additional slack — cumulative but capped
+        at ``max_wait_slack``: a flapping widen_deadline alert (each
+        re-fire is a fresh episode past the policy's per-episode gate) must
+        not grow the deadline until the supervisor can never declare a
+        dead fleet."""
+        self._wait_slack = min(self._wait_slack + float(extra_s),
+                               self.max_wait_slack)
+
+    def consume_restart(self):
+        """Pop the pending restart decision (``check()`` stops returning
+        RESTART).  Returns the AlertDecision, or None."""
+        d, self._pending_restart = self._pending_restart, None
+        return d
+
+    def poll_alerts(self, samples=None, now=None, widen_step_s=None):
+        """One sense->decide->act turn of the attached ``alert_policy``.
+
+        Maps decisions onto the manager: ``restart`` arms ``check()``,
+        ``quarantine`` quarantines the host named by the alert instance's
+        ``host`` label — or its ``target`` label routed through
+        ``target_to_host`` (a scrape-target name is host:METRICS_port, not
+        the trainer endpoint membership is keyed by) — ``widen_deadline``
+        adds ``widen_step_s`` (default: one full heartbeat-timeout window,
+        ``3 * interval``) of ``wait_for_np`` slack.  A quarantine that
+        names no current membership entry still registers (it excludes a
+        future join) but leaves a ``quarantine_unknown_host`` flight event
+        so a mis-mapped actuation is never silent.  Returns the decisions.
+        """
+        if self.alert_policy is None:
+            return []
+        decisions = self.alert_policy.poll(samples=samples, now=now)
+        for d in decisions:
+            if d.action == "restart":
+                self._pending_restart = d
+            elif d.action == "quarantine":
+                target = d.labels.get("target")
+                host = d.labels.get("host") \
+                    or self.target_to_host.get(target, target)
+                if host:
+                    known = {k[len(f"{self.prefix}/nodes/"):] for k in
+                             self.store.keys_with_prefix(
+                                 f"{self.prefix}/nodes/")}
+                    if host not in known:
+                        from ....observability import flight_recorder
+                        flight_recorder.record_event(
+                            "quarantine_unknown_host", host=host,
+                            alert=d.alert, known=sorted(known))
+                    self.quarantine(host)
+            elif d.action == "widen_deadline":
+                self.widen_wait(widen_step_s if widen_step_s is not None
+                                else 3 * self.interval)
+        return decisions
 
     def exit(self, completed=True):
         self._stop.set()

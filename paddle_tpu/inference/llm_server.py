@@ -220,7 +220,8 @@ class LLMEngine:
                  max_queue_len=None, clock=None, kv_layout=None,
                  page_size=128, num_pages=None, prefill_chunk=None,
                  prefix_cache=None, metrics_port=None, slo_targets=None,
-                 flight_recorder_dir=None, healthy_heartbeat_age=60.0):
+                 flight_recorder_dir=None, healthy_heartbeat_age=60.0,
+                 alert_rules=None):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
         scheduling lever for high-latency hosts.  Slots that finish
@@ -269,7 +270,12 @@ class LLMEngine:
         ``healthy_heartbeat_age`` bounds how stale the pump's heartbeat may
         grow before `/healthz` reports a wedge; the check stays green until
         the FIRST tick completes, so a long initial compile (the spike
-        warmup() exists for) cannot fail a liveness probe."""
+        warmup() exists for) cannot fail a liveness probe.
+        ``alert_rules`` (with ``metrics_port``) overrides the default alert
+        rule set served on `/alertz` — each GET evaluates the engine
+        against the local registry, so an external scraper polling
+        `/alertz` gets current burn-rate / queue-backlog / healthcheck
+        alert state without this process running its own evaluation loop."""
         cfg = model.config
         self.model = model
         self.n_slots = int(max_batch_slots)
@@ -417,15 +423,22 @@ class LLMEngine:
         self._first_tick_done = False
         self.healthy_heartbeat_age = float(healthy_heartbeat_age)
         self.telemetry = None
+        self.alert_engine = None
         if metrics_port is not None:
+            from ..observability.alerts import AlertEngine
             from ..observability.exporter import TelemetryServer
 
+            self.alert_engine = AlertEngine(rules=alert_rules)
             self.telemetry = TelemetryServer(
-                port=metrics_port, recorder=_flight.RECORDER)
+                port=metrics_port, recorder=_flight.RECORDER,
+                alerts=self.alert_engine)
             self.telemetry.register_healthcheck("pump", self._check_pump)
             self.telemetry.register_healthcheck(
                 "pump_heartbeat", self._check_heartbeat)
             self.telemetry.start()
+        elif alert_rules is not None:
+            raise ValueError("alert_rules requires metrics_port (the rules "
+                             "are served on the exporter's /alertz)")
 
     # --------------------------------------------------------- healthchecks
 

@@ -23,6 +23,19 @@ On top of the registry sits the telemetry plane (ISSUE 5):
 - `observability.slo` — deterministic sliding-window p50/p95/p99 and
   burn-rate tracking against configurable SLO targets.
 
+And on top of the telemetry plane, the alerting plane (ISSUE 7) — the
+first CONSUMER of the endpoints:
+
+- `observability.scrape` — Prometheus text-format parser (the inverse of
+  ``render_prometheus()``) plus a multi-target fleet scraper with
+  per-target monotonic deadlines, bounded retry and staleness tracking;
+- `observability.alerts` — declarative threshold / burn-rate / absence /
+  delta rules with `for`-duration hysteresis, a deterministic
+  inactive→pending→firing→resolved state machine, `/alertz` state on
+  ``TelemetryServer``, and ``AlertPolicy`` actuation that drives
+  ``run_with_recovery`` / ``ElasticManager`` restart decisions off the
+  scraped series (``tools/fleetwatch.py`` is the operator CLI).
+
 Quick start::
 
     import paddle_tpu as paddle
@@ -46,11 +59,19 @@ from .spans import span  # noqa: F401
 from .flight_recorder import FlightRecorder, record_event  # noqa: F401
 from .exporter import TelemetryServer, start_exporter  # noqa: F401
 from .slo import SLOTracker, SLORegistry, SLOS  # noqa: F401
+from .scrape import (  # noqa: F401
+    parse_prometheus, SampleSet, Scraper, ScrapeTarget,
+)
+from .alerts import (  # noqa: F401
+    Rule, AlertEngine, AlertPolicy, AlertDecision, default_rules,
+)
 from . import metrics  # noqa: F401
 from . import spans  # noqa: F401
 from . import flight_recorder  # noqa: F401
 from . import exporter  # noqa: F401
 from . import slo  # noqa: F401
+from . import scrape  # noqa: F401
+from . import alerts  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
@@ -60,4 +81,7 @@ __all__ = [
     "FlightRecorder", "record_event", "flight_recorder",
     "TelemetryServer", "start_exporter", "exporter",
     "SLOTracker", "SLORegistry", "SLOS", "slo",
+    "parse_prometheus", "SampleSet", "Scraper", "ScrapeTarget", "scrape",
+    "Rule", "AlertEngine", "AlertPolicy", "AlertDecision", "default_rules",
+    "alerts",
 ]

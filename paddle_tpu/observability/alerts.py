@@ -1,0 +1,618 @@
+"""Alert-rule engine: declarative rules over scraped (or local) samples,
+a deterministic hysteresis state machine, and actuation policies.
+
+The decide half of the alerting plane's sense -> decide -> act loop
+(ISSUE 7).  Rules are declarative and data-only (JSON round-trip via
+``Rule.to_dict``/``from_dict``); evaluation is a pure function of a
+:class:`~paddle_tpu.observability.scrape.SampleSet` and an injected clock,
+so the golden transition tests replay exactly.
+
+Rule kinds:
+
+- ``threshold`` — instant comparison of every matching sample against a
+  bound (``llm_queue_depth > 64``, ``healthcheck_status_value < 1``);
+- ``burn_rate`` — sugar for a threshold over ``slo_burn_rate_ratio`` (the
+  PR-5 SLO gauges: violating fraction of the current window per series);
+- ``absence`` — fires for label sets that were seen on an earlier
+  evaluation and have since disappeared (a replica that stopped reporting),
+  plus the rule's own explicit selector when the family matches nothing —
+  staleness alerting composes with the scraper's
+  ``scrape_staleness_seconds`` threshold rules.  ``window_s`` doubles as
+  the absence TTL: after firing-absent that long the label set is taken
+  as decommissioned (scale-in) and forgotten, so the alert resolves
+  instead of paging forever and the engine stays bounded under label
+  churn;
+- ``delta`` — increase of a counter over a sliding window (counter resets
+  tolerated: only positive inter-sample deltas accumulate), e.g. a rising
+  ``recovery_restarts_total``.
+
+Each rule instance (one per distinct matched label set) walks a
+deterministic state machine::
+
+    inactive -> pending   condition true, ``for_s`` hysteresis running
+    pending  -> firing    condition held for ``for_s`` (``for_s=0`` skips
+                          pending entirely)
+    pending  -> inactive  condition cleared before ``for_s`` elapsed
+    firing   -> resolved  condition cleared
+    resolved -> pending   condition true again (re-fire / flap)
+    resolved -> inactive  quiet for ``resolved_hold_s``
+
+State is exported as ``alert_state_value{alert}`` (0 inactive, 1 resolved,
+2 pending, 3 firing — max over the rule's instances, so firing dominates),
+every transition lands in the flight recorder and an optional JSONL log,
+and ``TelemetryServer`` serves the full engine state on ``/alertz``.
+
+Actuation: :class:`AlertPolicy` maps alert names to actions (``restart``,
+``quarantine``, ``widen_deadline``, or a callable) and emits one
+:class:`AlertDecision` per firing EPISODE (a flapping alert re-decides only
+after re-firing, never once per poll).  ``run_with_recovery`` and
+``ElasticManager`` consume decisions — the restart wiring PRs 2/5 left
+open.
+
+No jax / numpy imports (same contract as ``observability.metrics``).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+from . import metrics as _metrics
+from . import flight_recorder as _flight
+from .scrape import SampleSet
+
+__all__ = [
+    "Rule", "AlertEngine", "AlertPolicy", "AlertDecision", "default_rules",
+    "STATE_INACTIVE", "STATE_RESOLVED", "STATE_PENDING", "STATE_FIRING",
+    "STATE_VALUES", "ACTIONS",
+]
+
+#: Exported state encoding: higher = worse, so a max over instances keeps
+#: firing visible while a sibling instance idles.
+STATE_INACTIVE = "inactive"
+STATE_RESOLVED = "resolved"
+STATE_PENDING = "pending"
+STATE_FIRING = "firing"
+STATE_VALUES = {STATE_INACTIVE: 0, STATE_RESOLVED: 1,
+                STATE_PENDING: 2, STATE_FIRING: 3}
+
+#: Actions an AlertPolicy can map a firing alert to (besides a callable).
+ACTIONS = ("restart", "quarantine", "widen_deadline")
+
+_KINDS = ("threshold", "burn_rate", "absence", "delta")
+_OPS = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+_M_STATE = _metrics.gauge(
+    "alert_state_value",
+    "Worst state across the rule's instances "
+    "(0 inactive, 1 resolved, 2 pending, 3 firing)",
+    labelnames=("alert",))
+_M_TRANSITIONS = _metrics.counter(
+    "alert_transitions_total",
+    "Alert-instance state transitions, by entered state",
+    labelnames=("alert", "state"))
+_M_EVAL = _metrics.histogram(
+    "alert_evaluation_seconds",
+    "Wall time of one AlertEngine.evaluate() tick")
+_M_ACTIONS = _metrics.counter(
+    "alert_actions_total",
+    "Actuation decisions emitted by AlertPolicy, by action",
+    labelnames=("alert", "action"))
+
+
+class Rule:
+    """One declarative alert rule.  Pure data + a condition evaluator;
+    all state (hysteresis clocks, delta windows) lives in the engine."""
+
+    def __init__(self, name, metric=None, kind="threshold", labels=None,
+                 op=">", threshold=0.0, for_s=0.0, window_s=300.0,
+                 resolved_hold_s=300.0, severity="page", description=""):
+        if kind not in _KINDS:
+            raise ValueError(f"rule kind must be one of {_KINDS}, "
+                             f"got {kind!r}")
+        if op not in _OPS:
+            raise ValueError(f"rule op must be one of {sorted(_OPS)}, "
+                             f"got {op!r}")
+        if kind == "burn_rate" and metric is None:
+            metric = "slo_burn_rate_ratio"
+        if metric is None:
+            raise ValueError(f"rule {name!r} needs a metric")
+        self.name = str(name)
+        self.kind = kind
+        self.metric = str(metric)
+        self.labels = {str(k): str(v) for k, v in (labels or {}).items()}
+        self.op = op
+        self.threshold = float(threshold)
+        self.for_s = float(for_s)
+        self.window_s = float(window_s)
+        self.resolved_hold_s = float(resolved_hold_s)
+        self.severity = str(severity)
+        self.description = str(description)
+
+    def to_dict(self):
+        return {"name": self.name, "kind": self.kind, "metric": self.metric,
+                "labels": dict(self.labels), "op": self.op,
+                "threshold": self.threshold, "for_s": self.for_s,
+                "window_s": self.window_s,
+                "resolved_hold_s": self.resolved_hold_s,
+                "severity": self.severity, "description": self.description}
+
+    _FIELDS = ("name", "kind", "metric", "labels", "op", "threshold",
+               "for_s", "window_s", "resolved_hold_s", "severity",
+               "description")
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        unknown = set(d) - set(cls._FIELDS)
+        if unknown:
+            # a typo ("for", "treshold") must not silently yield a rule
+            # with zero hysteresis/threshold — this is the operator path
+            raise ValueError(
+                f"rule {d.get('name', '?')!r} has unknown fields "
+                f"{sorted(unknown)}; valid fields: {cls._FIELDS}")
+        return cls(**d)
+
+    def __repr__(self):
+        return (f"Rule({self.name!r}, {self.kind}: {self.metric}"
+                f"{self.labels or ''} {self.op} {self.threshold})")
+
+
+def default_rules(queue_depth=64, burn_rate=0.5, staleness_s=60.0,
+                  restart_window_s=600.0):
+    """The stock rule set over the existing README catalogue: SLO burn
+    rate, component healthchecks (including the LLM pump heartbeat-age
+    check), store deadline pressure, serving backlog, recovery restart
+    storms, and the scraper's own target liveness/staleness."""
+    return [
+        Rule("slo_burn_rate_high", kind="burn_rate", threshold=burn_rate,
+             for_s=30.0,
+             description="an SLO series is burning error budget: the "
+                         "violating fraction of its sliding window exceeds "
+                         f"{burn_rate}"),
+        Rule("healthcheck_failing", metric="healthcheck_status_value",
+             op="<", threshold=1.0, for_s=15.0,
+             description="a registered component healthcheck (pump "
+                         "liveness, pump heartbeat age, last-step age, "
+                         "rank liveness) reports failing"),
+        Rule("store_deadline_pressure", kind="delta",
+             metric="store_deadline_hits_total", op=">", threshold=0.0,
+             window_s=120.0, for_s=0.0, severity="ticket",
+             description="control-plane store ops started missing their "
+                         "per-op deadlines within the last window"),
+        Rule("llm_queue_backlog", metric="llm_queue_depth", op=">",
+             threshold=float(queue_depth), for_s=30.0,
+             description="serving admission queue persistently deeper "
+                         f"than {queue_depth} (shedding is next)"),
+        Rule("recovery_restart_storm", kind="delta",
+             metric="recovery_restarts_total", op=">", threshold=2.0,
+             window_s=restart_window_s, for_s=0.0,
+             description="run_with_recovery restarted more than twice "
+                         "inside the window — the job is crash-looping"),
+        # exported_target="" matches only THIS scraper's own liveness
+        # samples, never a target's re-exported view of its own fleet
+        # (scrape.SampleSet.match: empty selector value = label absent)
+        Rule("scrape_target_down", metric="scrape_target_up",
+             labels={"exported_target": ""}, op="<",
+             threshold=1.0, for_s=10.0,
+             description="a fleet scrape target stopped answering "
+                         "/metrics"),
+        Rule("scrape_target_stale", metric="scrape_staleness_seconds",
+             labels={"exported_target": ""},
+             op=">", threshold=float(staleness_s), for_s=0.0,
+             severity="ticket",
+             description="no successful scrape of the target for "
+                         f"{staleness_s}s"),
+        Rule("telemetry_absent", kind="absence",
+             metric="exporter_scrapes_total", for_s=30.0, severity="ticket",
+             description="a previously-reporting telemetry exporter's "
+                         "series vanished from the scrape"),
+    ]
+
+
+def _labelkey(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+class _Instance:
+    """Mutable per-(rule, label set) state-machine cell."""
+
+    __slots__ = ("labels", "state", "since", "pending_since",
+                 "resolved_since", "value", "episodes")
+
+    def __init__(self, labels, now):
+        self.labels = dict(labels)
+        self.state = STATE_INACTIVE
+        self.since = now
+        self.pending_since = None
+        self.resolved_since = None
+        self.value = None
+        self.episodes = 0  # completed transitions INTO firing
+
+
+class AlertEngine:
+    """Evaluate rules against successive SampleSets; deterministic under an
+    injected clock (every `for`/window/hold comparison uses the ``now``
+    passed to :meth:`evaluate`, defaulting to ``clock()``).
+
+    Thread-safety: evaluation and state reads share one lock, so a live
+    ``/alertz`` scrape never sees a half-applied transition.
+    """
+
+    def __init__(self, rules=None, clock=time.monotonic, log_path=None,
+                 recorder=None, registry=None):
+        self.rules = list(rules if rules is not None else default_rules())
+        names = [r.name for r in self.rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rule names: {sorted(names)}")
+        self.clock = clock
+        self.log_path = log_path
+        self.recorder = recorder  # None -> module-global flight recorder
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._instances: dict[str, dict[tuple, _Instance]] = \
+            {r.name: {} for r in self.rules}
+        self._seen: dict[str, set] = {r.name: set() for r in self.rules}
+        self._windows: dict[tuple, deque] = {}  # (rule, labelkey) -> samples
+        self._evals = 0
+
+    # ------------------------------------------------------------ conditions
+    def _conditions(self, rule, samples, now):
+        """[(labelkey, labels, cond_bool, value)] for this evaluation."""
+        out = []
+        if rule.kind in ("threshold", "burn_rate"):
+            for labels, value in samples.match(rule.metric, rule.labels):
+                out.append((_labelkey(labels), labels,
+                            _OPS[rule.op](value, rule.threshold), value))
+        elif rule.kind == "delta":
+            for labels, value in samples.match(rule.metric, rule.labels):
+                key = (rule.name, _labelkey(labels))
+                st = self._windows.get(key)
+                if st is None:
+                    st = self._windows[key] = {"win": deque(), "inc": 0.0}
+                win = st["win"]
+                # the counter-reset-tolerant increase (sum of positive
+                # consecutive deltas) is maintained INCREMENTALLY, and
+                # same-spacing samples coalesce into the tail entry — the
+                # window stays O(256) and O(1) per tick no matter how fast
+                # the caller evaluates (per-step polls, /alertz scrapes)
+                spacing = rule.window_s / 256.0
+                if win and now - win[-1][0] < spacing \
+                        and value >= win[-1][1]:
+                    st["inc"] += value - win[-1][1]
+                    win[-1] = (win[-1][0], value)
+                else:
+                    if win:
+                        st["inc"] += max(0.0, value - win[-1][1])
+                    win.append((now, value))
+                while win and now - win[0][0] > rule.window_s:
+                    _, v0 = win.popleft()
+                    if win:
+                        st["inc"] -= max(0.0, win[0][1] - v0)
+                inc = st["inc"]
+                out.append((_labelkey(labels), labels,
+                            _OPS[rule.op](inc, rule.threshold), inc))
+        elif rule.kind == "absence":
+            matched = {_labelkey(l): (l, v)
+                       for l, v in samples.match(rule.metric, rule.labels)}
+            seen = self._seen[rule.name]
+            seen.update(matched)
+            insts = self._instances[rule.name]
+            for key in sorted(seen):
+                if key in matched:
+                    labels, value = matched[key]
+                    out.append((key, labels, False, value))
+                    continue
+                # absence TTL: a label set that has been FIRING-absent for
+                # window_s is taken as decommissioned (scale-in), not lost
+                # — un-see it so the alert resolves, the instance reaps,
+                # and the engine cannot grow forever under label churn.  A
+                # later reappearance re-seeds it fresh.
+                inst = insts.get(key)
+                if inst is not None and inst.state == STATE_FIRING \
+                        and now - inst.since >= rule.window_s:
+                    seen.discard(key)
+                    out.append((key, dict(key), False, None))
+                else:
+                    out.append((key, dict(key), True, None))
+            if not seen and rule.labels:
+                # explicit selector that has never matched at all
+                key = _labelkey(rule.labels)
+                out.append((key, dict(rule.labels), True, None))
+        return out
+
+    # --------------------------------------------------------- state machine
+    def _advance(self, rule, inst, cond, value, now):
+        """One instance, one tick.  Returns the entered state or None."""
+        inst.value = value
+        state = inst.state
+        if state == STATE_INACTIVE:
+            if cond:
+                if rule.for_s <= 0:
+                    return STATE_FIRING
+                inst.pending_since = now
+                return STATE_PENDING
+        elif state == STATE_PENDING:
+            if not cond:
+                return STATE_INACTIVE
+            if now - inst.pending_since >= rule.for_s:
+                return STATE_FIRING
+        elif state == STATE_FIRING:
+            if not cond:
+                inst.resolved_since = now
+                return STATE_RESOLVED
+        elif state == STATE_RESOLVED:
+            if cond:  # re-fire (flap): back through the hysteresis gate
+                if rule.for_s <= 0:
+                    return STATE_FIRING
+                inst.pending_since = now
+                return STATE_PENDING
+            if now - inst.resolved_since >= rule.resolved_hold_s:
+                return STATE_INACTIVE
+        return None
+
+    def evaluate(self, samples=None, now=None):
+        """One engine tick.  ``samples`` defaults to the local registry
+        (in-process mode); pass a scraped SampleSet for fleet mode.
+        Returns the list of transition dicts applied this tick."""
+        if samples is None:
+            samples = SampleSet.from_registry(self._registry)
+        t0 = time.perf_counter()
+        now = self.clock() if now is None else float(now)
+        transitions = []
+        with self._lock:
+            self._evals += 1
+            for rule in self.rules:
+                insts = self._instances[rule.name]
+                # last-cond-wins dedupe: a malformed payload repeating a
+                # series must not advance one instance twice in one tick
+                conds = {key: (labels, cond, value) for key, labels, cond,
+                         value in self._conditions(rule, samples, now)}
+                live_keys = set()
+                for key, (labels, cond, value) in conds.items():
+                    live_keys.add(key)
+                    inst = insts.get(key)
+                    if inst is None:
+                        inst = insts[key] = _Instance(labels, now)
+                    entered = self._advance(rule, inst, cond, value, now)
+                    if entered is not None:
+                        transitions.append(self._transition(
+                            rule, inst, entered, now))
+                # instances no longer matched read as condition-false and
+                # wind down instead of firing forever (for absence rules
+                # this only reaps an explicit-selector instance orphaned by
+                # the real series appearing under different labels)
+                for key, inst in list(insts.items()):
+                    if key in live_keys:
+                        continue
+                    entered = self._advance(rule, inst, False, None, now)
+                    if entered is not None:
+                        transitions.append(self._transition(
+                            rule, inst, entered, now))
+                # drop fully-quiet cells (and their delta windows) so a
+                # churning label space (ephemeral targets) cannot grow the
+                # engine without bound
+                for key, inst in list(insts.items()):
+                    if inst.state == STATE_INACTIVE and key not in live_keys:
+                        del insts[key]
+                        self._windows.pop((rule.name, key), None)
+                self._export_state(rule, insts)
+        # JSONL write happens OUTSIDE the engine lock: a slow disk must
+        # stall neither concurrent evaluates nor the /alertz handler
+        self._write_log(transitions)
+        _M_EVAL.observe(time.perf_counter() - t0)
+        return transitions
+
+    def _transition(self, rule, inst, entered, now):
+        prev = inst.state
+        inst.state = entered
+        inst.since = now
+        if entered == STATE_FIRING:
+            inst.episodes += 1
+        rec = {"alert": rule.name, "labels": dict(inst.labels),
+               "from": prev, "to": entered, "mono": now,
+               "value": inst.value, "severity": rule.severity,
+               "episode": inst.episodes}
+        _M_TRANSITIONS.labels(alert=rule.name, state=entered).inc()
+        recorder = self.recorder if self.recorder is not None \
+            else _flight.RECORDER
+        recorder.record("alert_transition", **rec)
+        return rec
+
+    def _write_log(self, transitions):
+        """Append transition lines to the JSONL alert log (called outside
+        the engine lock)."""
+        if not self.log_path or not transitions:
+            return
+        # wall-clock stamp is deliberate: the alert log is joined with
+        # operator logs and dashboards across hosts, which share NTP,
+        # not a boot clock (the monotonic stamp rides along in "mono")
+        stamp = time.time()  # tpulint: disable=impure-trace
+        try:
+            with open(self.log_path, "a") as f:
+                for rec in transitions:
+                    f.write(json.dumps({"time": stamp, **rec},
+                                       separators=(",", ":")) + "\n")
+        except OSError as e:
+            recorder = self.recorder if self.recorder is not None \
+                else _flight.RECORDER
+            recorder.record("alert_log_failed", error=repr(e))
+
+    def _export_state(self, rule, insts):
+        worst = max((STATE_VALUES[i.state] for i in insts.values()),
+                    default=0)
+        _M_STATE.labels(alert=rule.name).set(float(worst))
+
+    # -------------------------------------------------------------- reading
+    def state(self):
+        """JSON-safe full engine state (the `/alertz` payload)."""
+        with self._lock:
+            alerts = []
+            for rule in self.rules:
+                insts = self._instances[rule.name]
+                alerts.append({
+                    **rule.to_dict(),
+                    "state": max(
+                        (i.state for i in insts.values()),
+                        key=lambda s: STATE_VALUES[s], default=STATE_INACTIVE),
+                    "instances": [
+                        {"labels": dict(i.labels), "state": i.state,
+                         "since": i.since, "value": i.value,
+                         "episodes": i.episodes}
+                        for i in insts.values()],
+                })
+            return {"evaluations": self._evals, "alerts": alerts}
+
+    def firing(self, name=None):
+        """Currently-firing instances: ``[{"alert", "labels", "value",
+        "since", "episode"}]`` (optionally for one rule)."""
+        with self._lock:
+            out = []
+            for rule in self.rules:
+                if name is not None and rule.name != name:
+                    continue
+                for inst in self._instances[rule.name].values():
+                    if inst.state == STATE_FIRING:
+                        out.append({"alert": rule.name,
+                                    "labels": dict(inst.labels),
+                                    "value": inst.value,
+                                    "since": inst.since,
+                                    "episode": inst.episodes})
+            return out
+
+
+class AlertDecision:
+    """One actuation decision: alert X (labels Y) asks for action Z."""
+
+    __slots__ = ("alert", "action", "labels", "value", "episode", "mono")
+
+    def __init__(self, alert, action, labels, value, episode, mono):
+        self.alert = alert
+        self.action = action
+        self.labels = dict(labels)
+        self.value = value
+        self.episode = episode
+        self.mono = mono
+
+    def to_dict(self):
+        return {"alert": self.alert, "action": self.action,
+                "labels": dict(self.labels), "value": self.value,
+                "episode": self.episode, "mono": self.mono}
+
+    def __repr__(self):
+        return f"AlertDecision({self.alert!r} -> {self.action!r})"
+
+
+class AlertPolicy:
+    """Map named firing alerts to actions; emit one decision per firing
+    EPISODE.
+
+    ``actions`` maps rule name -> ``"restart"`` | ``"quarantine"`` |
+    ``"widen_deadline"`` | callable(decision).  Callables run inside
+    :meth:`poll` (exceptions propagate to the caller — actuation failures
+    must not be silent); string actions are returned as decisions for the
+    host (``run_with_recovery``, ``ElasticManager``) to execute.
+
+    ``scraper=None`` evaluates the LOCAL registry — the in-process mode
+    ``run_with_recovery(alert_policy=)`` uses; with a
+    :class:`~paddle_tpu.observability.scrape.Scraper` every poll scrapes
+    the fleet first (sense), evaluates (decide), then maps to actions
+    (act).
+
+    ``min_interval_s`` throttles implicit polls: a ``poll()`` with neither
+    ``samples`` nor ``now`` (the hot-path shape — ``run_with_recovery``
+    calls it after every step) that lands within the interval is a no-op
+    returning ``[]``, so a scraper-backed policy never turns each training
+    step into a fleet HTTP scrape.  Default: 15 s with a scraper, 0
+    (unthrottled — evaluation is microseconds) for local-registry
+    policies.  Explicit ``samples``/``now`` bypass the throttle: the
+    caller owns the cadence (deterministic tests, ``poll_alerts(now=)``).
+    """
+
+    def __init__(self, actions, rules=None, engine=None, scraper=None,
+                 clock=time.monotonic, log_path=None, min_interval_s=None):
+        self.actions = dict(actions or {})
+        for name, act in self.actions.items():
+            if not callable(act) and act not in ACTIONS:
+                raise ValueError(
+                    f"action for alert {name!r} must be callable or one of "
+                    f"{ACTIONS}, got {act!r}")
+        self.engine = engine if engine is not None else AlertEngine(
+            rules=rules, clock=clock, log_path=log_path)
+        known = {r.name for r in self.engine.rules}
+        unknown = set(self.actions) - known
+        if unknown:
+            raise ValueError(
+                f"actions name alerts with no rule: {sorted(unknown)} "
+                f"(rules: {sorted(known)})")
+        self.scraper = scraper
+        self.clock = clock
+        self.min_interval_s = float(
+            (15.0 if scraper is not None else 0.0)
+            if min_interval_s is None else min_interval_s)
+        self._last_implicit_poll = None  # clock() stamp of the last one
+        self._acted: dict[tuple, int] = {}  # instance -> last acted episode
+        self._last_results = None  # [ScrapeResult] of the latest poll
+
+    def poll(self, samples=None, now=None):
+        """Sense -> decide -> act.  Returns the list of
+        :class:`AlertDecision` emitted this poll (string actions only;
+        callable actions have already run)."""
+        results = None
+        if samples is None:
+            if now is None and self.min_interval_s > 0:
+                t = self.clock()
+                if self._last_implicit_poll is not None \
+                        and t - self._last_implicit_poll \
+                        < self.min_interval_s:
+                    return []  # throttled: keep scrapes off the hot path
+                self._last_implicit_poll = t
+            if self.scraper is not None:
+                samples, results = self.scraper.poll()
+            else:  # local mode: read the engine's registry (default global)
+                samples = SampleSet.from_registry(self.engine._registry)
+        self.engine.evaluate(samples, now=now)
+        decisions = []
+        firing = self.engine.firing()
+        # prune acted-episode memory for instances no longer firing: bounds
+        # it to the live firing set AND keeps a reaped-then-recreated
+        # instance (episode numbering restarts at 1) from colliding with a
+        # stale entry and silently swallowing its decision
+        firing_keys = {(f["alert"], _labelkey(f["labels"])) for f in firing}
+        self._acted = {k: v for k, v in self._acted.items()
+                       if k in firing_keys}
+        for f in firing:
+            action = self.actions.get(f["alert"])
+            if action is None:
+                continue
+            key = (f["alert"], _labelkey(f["labels"]))
+            if self._acted.get(key) == f["episode"]:
+                continue  # already decided for this firing episode
+            name = action if isinstance(action, str) \
+                else getattr(action, "__name__", "callable")
+            d = AlertDecision(f["alert"], name, f["labels"], f["value"],
+                              f["episode"],
+                              self.clock() if now is None else now)
+            if callable(action):
+                # run the callable BEFORE any accounting: a raising
+                # notifier propagates, stays retryable next poll (no
+                # acted-mark), and counts once per episode, not per retry
+                action(d)
+            self._acted[key] = f["episode"]
+            _M_ACTIONS.labels(alert=d.alert, action=d.action).inc()
+            recorder = self.engine.recorder if self.engine.recorder \
+                is not None else _flight.RECORDER
+            recorder.record("alert_action", alert=d.alert, action=d.action,
+                            labels=dict(d.labels), episode=d.episode)
+            if not callable(action):
+                decisions.append(d)
+        self._last_results = results
+        return decisions

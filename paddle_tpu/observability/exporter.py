@@ -10,7 +10,11 @@ stdlib-only (http.server) daemon-threaded exporter any layer can opt into:
   connected, pump thread alive, last-step age ...): HTTP 200 when every
   check passes, 503 with a JSON body naming the failures otherwise — the
   k8s/load-balancer probe contract;
-- ``/varz`` — the full registry snapshot as JSON (the debug endpoint).
+- ``/varz`` — the full registry snapshot as JSON (the debug endpoint);
+- ``/alertz`` — the attached alert engine's rule/instance state as JSON
+  (``attach_alerts``); each GET re-evaluates the engine against the local
+  registry first (scrape-driven evaluation: the scraper IS the tick), so
+  the payload is always current.
 
 Lifecycle: ``TelemetryServer(port=0)`` binds an ephemeral port,
 ``start()`` serves from a daemon thread (a forgotten exporter can never
@@ -52,7 +56,7 @@ class TelemetryServer:
     """One process-local scrape endpoint over a metrics registry."""
 
     def __init__(self, port=0, host="127.0.0.1", registry=None,
-                 recorder=None):
+                 recorder=None, alerts=None):
         self.host = host
         self._requested_port = int(port)
         self.registry = registry if registry is not None \
@@ -62,6 +66,19 @@ class TelemetryServer:
         self._thread = None
         self._checks = {}  # name -> callable() -> truthy | (ok, detail)
         self._checks_lock = threading.Lock()
+        self.alerts = None  # AlertEngine served on /alertz
+        self._alerts_eval = True
+        if alerts is not None:
+            self.attach_alerts(alerts)
+
+    def attach_alerts(self, engine, eval_on_request=True):
+        """Serve ``engine`` (an ``alerts.AlertEngine``) on ``/alertz``.
+        With ``eval_on_request`` every GET first evaluates the engine
+        against this server's registry — each scrape is an engine tick, so
+        an otherwise-idle process still advances its alert state machine."""
+        self.alerts = engine
+        self._alerts_eval = bool(eval_on_request)
+        return self
 
     # ----------------------------------------------------------- lifecycle
     @property
@@ -175,10 +192,24 @@ class TelemetryServer:
                     }
                 body = json.dumps(varz, default=repr).encode()
                 self._reply(req, 200, "application/json", body)
+            elif path == "/alertz":
+                _M_SCRAPES.labels(endpoint="alertz").inc()
+                if self.alerts is None:
+                    doc = {"enabled": False, "alerts": []}
+                else:
+                    if self._alerts_eval:
+                        from .scrape import SampleSet
+                        self.alerts.evaluate(
+                            SampleSet.from_registry(self.registry))
+                    doc = {"enabled": True, **self.alerts.state(),
+                           "firing": self.alerts.firing()}
+                body = json.dumps(doc, default=repr).encode()
+                self._reply(req, 200, "application/json", body)
             else:
                 _M_HTTP_ERRORS.inc()
                 self._reply(req, 404, "text/plain; charset=utf-8",
-                            b"not found: try /metrics /healthz /varz\n")
+                            b"not found: try /metrics /healthz /varz "
+                            b"/alertz\n")
         except BrokenPipeError:
             pass  # scraper hung up mid-reply; nothing to clean up
         except Exception:

@@ -1,0 +1,552 @@
+"""Fleet scraper: Prometheus text-format parser + multi-target HTTP poller.
+
+PR 5 put the registry on the network (`/metrics`); this module is the first
+CONSUMER of that exposition — the sense half of the alerting plane's
+sense -> decide -> act loop (ISSUE 7).  Two layers:
+
+- ``parse_prometheus(text)`` — the exact inverse of
+  ``metrics.render_prometheus()``: HELP/TYPE comments, label escaping
+  (``\\`` / ``\"`` / ``\\n``), and histogram ``_bucket``/``_sum``/``_count``
+  sample families reassembled into one histogram family.  The round-trip
+  property ``parse_prometheus(render_prometheus()) == snapshot()`` holds for
+  the full README catalogue (tests/test_alerting.py).
+- ``Scraper`` — polls N ``/metrics`` targets concurrently with a PER-TARGET
+  deadline on a monotonic clock, bounded retry, and staleness tracking.
+  One slow or dead target can never block the others: each target is
+  fetched on its own thread with a socket timeout derived from its own
+  remaining deadline, and ``poll()`` joins against the same deadline.
+  Self-telemetry: ``scrape_target_up{target}``,
+  ``scrape_duration_seconds{target}``, ``scrape_staleness_seconds{target}``,
+  ``scrape_errors_total{target}`` — the scraper watches the fleet and the
+  alert engine watches the scraper with the same machinery.
+
+Scraped samples land in a :class:`SampleSet` — a flat, label-addressable
+view (every sample gains a ``target`` label, the Prometheus ``instance``
+convention) that `observability.alerts` evaluates rules against.  A
+``SampleSet`` can also be built from the local registry
+(:meth:`SampleSet.from_registry`), so the alert engine runs identically
+in-process and against a scraped fleet.
+
+No jax / numpy imports (same contract as ``observability.metrics``).
+"""
+from __future__ import annotations
+
+import http.client
+import math
+import threading
+import time
+import urllib.parse
+
+from . import metrics as _metrics
+
+__all__ = [
+    "parse_prometheus", "SampleSet", "Scraper", "ScrapeTarget",
+    "ScrapeResult", "flatten_families",
+]
+
+_M_UP = _metrics.gauge(
+    "scrape_target_up",
+    "1 when the last scrape of the target succeeded, 0 otherwise",
+    labelnames=("target",))
+_M_DURATION = _metrics.histogram(
+    "scrape_duration_seconds",
+    "Wall time of one target scrape (including retries)",
+    labelnames=("target",))
+_M_STALENESS = _metrics.gauge(
+    "scrape_staleness_seconds",
+    "Seconds since the last successful scrape of the target",
+    labelnames=("target",))
+_M_ERRORS = _metrics.counter(
+    "scrape_errors_total",
+    "Failed scrape attempts per target (each retry counts)",
+    labelnames=("target",))
+
+
+# ----------------------------------------------------------------- parsing
+def _unescape_label(s: str) -> str:
+    """Inverse of ``metrics._escape_label``: the only three escapes the
+    exposition format defines inside label values."""
+    out, i, n = [], 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "\\" and i + 1 < n:
+            nxt = s[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:  # unknown escape: literal backslash (Prometheus behavior)
+                out.append("\\")
+                out.append(nxt)
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _unescape_help(s: str) -> str:
+    """Inverse of ``metrics._escape_help`` (only ``\\`` and ``\\n``)."""
+    out, i, n = [], 0, len(s)
+    while i < n:
+        c = s[i]
+        if c == "\\" and i + 1 < n:
+            nxt = s[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+                i += 2
+                continue
+            if nxt == "n":
+                out.append("\n")
+                i += 2
+                continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
+def _parse_labels(body: str, line: str) -> dict:
+    """Parse ``k="v",...`` between braces, honoring escaped quotes."""
+    labels = {}
+    i, n = 0, len(body)
+    while i < n:
+        eq = body.index("=", i)
+        key = body[i:eq].strip().lstrip(",").strip()
+        if body[eq + 1] != '"':
+            raise ValueError(f"unquoted label value in: {line}")
+        j = eq + 2
+        while j < n:
+            if body[j] == "\\":
+                j += 2
+                continue
+            if body[j] == '"':
+                break
+            j += 1
+        else:
+            raise ValueError(f"unterminated label value in: {line}")
+        labels[key] = _unescape_label(body[eq + 2:j])
+        i = j + 1
+        while i < n and body[i] in ", ":
+            i += 1
+    return labels
+
+
+def _parse_value(s: str) -> float:
+    if s == "+Inf":
+        return math.inf
+    if s == "-Inf":
+        return -math.inf
+    return float(s)
+
+
+def _split_sample(line: str):
+    """``name{labels} value [timestamp]`` -> (name, labels, value).  The
+    brace scan is quote- and escape-aware, so a ``}`` inside a label value
+    never truncates the label block."""
+    brace = line.find("{")
+    space = line.find(" ")
+    if brace != -1 and (space == -1 or brace < space):
+        name = line[:brace]
+        j, n = brace + 1, len(line)
+        in_quotes = False
+        while j < n:
+            c = line[j]
+            if in_quotes:
+                if c == "\\":
+                    j += 2
+                    continue
+                if c == '"':
+                    in_quotes = False
+            elif c == '"':
+                in_quotes = True
+            elif c == "}":
+                break
+            j += 1
+        if j >= n:
+            raise ValueError(f"unterminated label block: {line}")
+        labels = _parse_labels(line[brace + 1:j], line)
+        rest = line[j + 1:].strip()
+    else:
+        name, _, rest = line.partition(" ")
+        labels = {}
+        rest = rest.strip()
+    parts = rest.split()
+    if not parts:
+        raise ValueError(f"sample line has no value: {line}")
+    return name, labels, _parse_value(parts[0])
+
+
+def parse_prometheus(text: str) -> dict:
+    """Parse exposition text into the ``MetricRegistry.snapshot()`` shape:
+    ``{name: {"kind", "help", "series": [...]}}``.
+
+    Histogram families are reassembled: a ``# TYPE f histogram`` groups the
+    subsequent ``f_bucket{le=}``/``f_sum``/``f_count`` samples by their
+    non-``le`` labels into ``{"labels", "sum", "count", "buckets"}`` series
+    entries whose bucket keys keep the exposition's ``le`` strings
+    (``"0.001"``, ``"+Inf"``) — exactly what ``snapshot()`` emits, so
+    ``parse_prometheus(render_prometheus())`` round-trips sample-for-sample.
+    Families never declared by a TYPE line parse as kind ``"untyped"``.
+    """
+    families: dict = {}
+    hist_names = set()
+
+    def family(name, kind=None, help_=None):
+        fam = families.get(name)
+        if fam is None:
+            fam = families[name] = {"kind": "untyped", "help": "",
+                                    "series": []}
+        if kind is not None:
+            fam["kind"] = kind
+        if help_ is not None:
+            fam["help"] = help_
+        return fam
+
+    def hist_series(fam, labels):
+        for s in fam["series"]:
+            if s["labels"] == labels:
+                return s
+        s = {"labels": labels, "sum": 0.0, "count": 0, "buckets": {}}
+        fam["series"].append(s)
+        return s
+
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            family(name, help_=_unescape_help(help_text))
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            kind = kind.strip()
+            family(name, kind=kind)
+            if kind == "histogram":
+                hist_names.add(name)
+            continue
+        if line.startswith("#"):
+            continue  # other comments are legal exposition noise
+        name, labels, value = _split_sample(line)
+        base = None
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in hist_names:
+                base = name[:-len(suffix)]
+                break
+        if base is not None:
+            fam = family(base)
+            if name.endswith("_bucket"):
+                le = labels.pop("le", "+Inf")
+                s = hist_series(fam, labels)
+                s["buckets"][le] = int(value)
+            elif name.endswith("_sum"):
+                hist_series(fam, labels)["sum"] = value
+            else:
+                hist_series(fam, labels)["count"] = int(value)
+        else:
+            family(name)["series"].append({"labels": labels, "value": value})
+    return families
+
+
+def _merge_labels(labels: dict, extra: dict) -> dict:
+    """Overlay ``extra`` onto ``labels``; a colliding pre-existing label is
+    preserved as ``exported_<name>`` (the Prometheus honor_labels=false
+    convention) — a target that scrapes OTHER targets must not have its
+    view of them collapsed into its own ``target`` identity."""
+    out = dict(labels)
+    for k, v in extra.items():
+        if k in out and out[k] != v:
+            out[f"exported_{k}"] = out.pop(k)
+        out[k] = v
+    return out
+
+
+def flatten_families(families: dict, extra_labels=None):
+    """Yield flat ``(name, labels, value)`` samples from a parsed (or
+    ``snapshot()``) family dict.  Histogram families flatten back into
+    ``_bucket``/``_sum``/``_count`` samples so rule selectors address them
+    the way a Prometheus expression would."""
+    extra = dict(extra_labels or {})
+    for name, fam in families.items():
+        for s in fam["series"]:
+            labels = _merge_labels(s["labels"], extra)
+            if "buckets" in s:
+                for le, c in s["buckets"].items():
+                    yield (f"{name}_bucket", {**labels, "le": str(le)},
+                           float(c))
+                yield f"{name}_sum", labels, float(s["sum"])
+                yield f"{name}_count", labels, float(s["count"])
+            else:
+                yield name, labels, float(s["value"])
+
+
+# --------------------------------------------------------------- sample set
+class SampleSet:
+    """Flat, label-addressable view of scraped/local samples.
+
+    The alert engine's only input: ``match(name, selector)`` returns every
+    sample of a family whose labels are a superset of ``selector`` — the
+    subset-match semantics of a Prometheus instant selector.
+    """
+
+    def __init__(self):
+        self._by_name: dict[str, list] = {}
+
+    def add(self, name, labels, value):
+        self._by_name.setdefault(str(name), []).append(
+            (dict(labels or {}), float(value)))
+        return self
+
+    def add_families(self, families, extra_labels=None):
+        """Merge a parsed/snapshot family dict (histograms flattened)."""
+        for name, labels, value in flatten_families(families, extra_labels):
+            self.add(name, labels, value)
+        return self
+
+    @classmethod
+    def from_registry(cls, registry=None):
+        """The local-process view: evaluate alert rules without a network
+        hop (``run_with_recovery(alert_policy=)`` uses this)."""
+        reg = registry if registry is not None else _metrics.REGISTRY
+        return cls().add_families(reg.snapshot())
+
+    def names(self):
+        return set(self._by_name)
+
+    def match(self, name, selector=None):
+        """Samples of ``name`` whose labels contain every (k, v) of
+        ``selector``: ``[(labels, value)]``.  Prometheus convention: a
+        selector value of ``""`` matches samples where the label is ABSENT
+        (e.g. ``{"exported_target": ""}`` excludes another scraper's
+        re-exported series)."""
+        out = []
+        sel = {str(k): str(v) for k, v in (selector or {}).items()}
+        for labels, value in self._by_name.get(str(name), ()):
+            if all(labels.get(k, "") == v for k, v in sel.items()):
+                out.append((labels, value))
+        return out
+
+    def value(self, name, selector=None, default=None):
+        """Value of the single matching sample (raises on ambiguity)."""
+        hits = self.match(name, selector)
+        if not hits:
+            return default
+        if len(hits) > 1:
+            raise ValueError(
+                f"{name}{selector or {}} matches {len(hits)} samples; "
+                f"narrow the selector or use match()")
+        return hits[0][1]
+
+    def __len__(self):
+        return sum(len(v) for v in self._by_name.values())
+
+
+# ------------------------------------------------------------------ scraper
+class ScrapeTarget:
+    """One scrape endpoint.  ``url`` may be ``host:port`` or a full
+    ``http://host:port[/metrics]`` URL; ``name`` defaults to ``host:port``
+    and becomes the sample's ``target`` label.  ``probe_health=True`` GETs
+    ``/healthz`` before ``/metrics`` so the target's component healthchecks
+    re-evaluate and their ``healthcheck_status_value`` gauges are fresh in
+    the same scrape (the probe's status code is informational; a 503 target
+    still serves its metrics)."""
+
+    def __init__(self, url, name=None, probe_health=False):
+        u = str(url)
+        if "//" not in u:
+            u = "http://" + u
+        parsed = urllib.parse.urlsplit(u)
+        if not parsed.hostname or not parsed.port:
+            raise ValueError(f"scrape target needs host:port, got {url!r}")
+        self.host = parsed.hostname
+        self.port = int(parsed.port)
+        self.path = parsed.path if parsed.path not in ("", "/") \
+            else "/metrics"
+        self.name = str(name) if name else f"{self.host}:{self.port}"
+        self.probe_health = bool(probe_health)
+
+    def __repr__(self):
+        return f"ScrapeTarget({self.name!r})"
+
+
+class ScrapeResult:
+    """Outcome of one target scrape."""
+
+    __slots__ = ("target", "ok", "families", "error", "duration_s",
+                 "attempts", "health_status")
+
+    def __init__(self, target, ok, families=None, error=None,
+                 duration_s=0.0, attempts=0, health_status=None):
+        self.target = target
+        self.ok = ok
+        self.families = families if families is not None else {}
+        self.error = error
+        self.duration_s = duration_s
+        self.attempts = attempts
+        self.health_status = health_status
+
+    def to_dict(self):
+        return {"target": self.target.name, "ok": self.ok,
+                "error": self.error, "duration_s": round(self.duration_s, 6),
+                "attempts": self.attempts,
+                "families": len(self.families),
+                "health_status": self.health_status}
+
+
+class Scraper:
+    """Poll N targets; never let one bad target starve the rest.
+
+    Per-target budget: ``timeout_s`` on a monotonic clock covers ALL
+    attempts (``retries + 1``) of that target including backoff sleeps; the
+    socket timeout of each attempt is the target's remaining budget.
+    ``poll()`` runs every target on its own (daemon) thread and joins
+    against the same budget — a target that somehow outlives its deadline
+    is reported down for this poll and its straggler thread is abandoned,
+    not waited on.
+    """
+
+    def __init__(self, targets, timeout_s=5.0, retries=1,
+                 retry_backoff_s=0.05, clock=time.monotonic, sleep=None):
+        self.targets = [t if isinstance(t, ScrapeTarget) else ScrapeTarget(t)
+                        for t in targets]
+        names = [t.name for t in self.targets]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate target names: {sorted(names)}")
+        self.timeout_s = float(timeout_s)
+        self.retries = max(0, int(retries))
+        self.retry_backoff_s = float(retry_backoff_s)
+        self._clock = clock
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._last_ok: dict[str, float] = {}   # target name -> mono stamp
+        self._started = self._clock()
+
+    # ------------------------------------------------------------ one target
+    def _fetch(self, target, path, deadline):
+        remaining = deadline - self._clock()
+        if remaining <= 0:
+            raise TimeoutError(f"scrape budget exhausted for {target.name}")
+        conn = http.client.HTTPConnection(target.host, target.port,
+                                          timeout=remaining)
+        try:
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, resp.read().decode("utf-8", "replace")
+        finally:
+            conn.close()
+
+    def scrape_one(self, target, defer_publish=False) -> ScrapeResult:
+        """Scrape one target within its own deadline; updates the
+        self-telemetry series unless ``defer_publish`` — ``poll()`` defers
+        and publishes under its own lock, so a straggler thread it has
+        abandoned can never publish up=1 after the poll already reported
+        the target down."""
+        t0 = self._clock()
+        deadline = t0 + self.timeout_s
+        error, attempts, health_status = None, 0, None
+        families = None
+        while attempts <= self.retries:
+            attempts += 1
+            try:
+                if target.probe_health:
+                    health_status, _ = self._fetch(
+                        target, "/healthz", deadline)
+                status, body = self._fetch(target, target.path, deadline)
+                if status != 200:
+                    raise OSError(f"HTTP {status} from {target.name}")
+                families = parse_prometheus(body)
+                error = None
+                break
+            except Exception as e:
+                error = repr(e)
+                _M_ERRORS.labels(target=target.name).inc()
+                remaining = deadline - self._clock()
+                if attempts <= self.retries and remaining > 0:
+                    self._sleep(min(self.retry_backoff_s, remaining))
+                if remaining <= 0:
+                    break
+        dur = self._clock() - t0
+        ok = families is not None
+        result = ScrapeResult(target, ok, families, error=error,
+                              duration_s=dur, attempts=attempts,
+                              health_status=health_status)
+        if not defer_publish:
+            self._publish(result)
+        return result
+
+    def _publish(self, result):
+        """Land one result on the self-telemetry series + staleness clock."""
+        name = result.target.name
+        now = self._clock()
+        if result.ok:
+            self._last_ok[name] = now
+        _M_UP.labels(target=name).set(1.0 if result.ok else 0.0)
+        _M_DURATION.labels(target=name).observe(result.duration_s)
+        _M_STALENESS.labels(target=name).set(self.staleness(name, now=now))
+
+    def staleness(self, target_name, now=None) -> float:
+        """Seconds since the last successful scrape (since construction when
+        the target has never answered)."""
+        now = self._clock() if now is None else now
+        return now - self._last_ok.get(target_name, self._started)
+
+    # ------------------------------------------------------------- the fleet
+    def poll(self):
+        """Scrape every target concurrently.  Returns ``(SampleSet,
+        [ScrapeResult])``: scraped samples carry a ``target`` label, and the
+        scraper's own up/staleness series are ALSO present as samples, so
+        absence/staleness rules evaluate against the same view."""
+        results: dict[str, ScrapeResult] = {}
+        abandoned: set[str] = set()
+        lock = threading.Lock()
+
+        def worker(t):
+            r = self.scrape_one(t, defer_publish=True)
+            with lock:  # publish and abandon are mutually exclusive
+                if t.name not in abandoned:
+                    self._publish(r)
+                results[t.name] = r
+
+        threads = [threading.Thread(target=worker, args=(t,), daemon=True,
+                                    name=f"scrape-{t.name}")
+                   for t in self.targets]
+        deadline = self._clock() + self.timeout_s + 0.25
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(max(0.0, deadline - self._clock()))
+        now = self._clock()
+        samples = SampleSet()
+        out = []
+        for t in self.targets:
+            with lock:
+                r = results.get(t.name)
+                if r is None:
+                    # straggler blew even the joined deadline: abandoning
+                    # it under the publish lock guarantees its late
+                    # completion can never land up=1 over this verdict
+                    abandoned.add(t.name)
+            if r is None:
+                r = ScrapeResult(t, False, error="scrape thread overran "
+                                 "its deadline", duration_s=self.timeout_s)
+                _M_UP.labels(target=t.name).set(0.0)
+                _M_ERRORS.labels(target=t.name).inc()
+                _M_DURATION.labels(target=t.name).observe(r.duration_s)
+                # keep the staleness gauge advancing: a perpetually-
+                # wedged target must look STALE to a meta-scraper, not
+                # frozen at its last healthy reading
+                _M_STALENESS.labels(target=t.name).set(
+                    self.staleness(t.name, now=now))
+            if r.ok:
+                samples.add_families(r.families, {"target": t.name})
+            samples.add("scrape_target_up", {"target": t.name},
+                        1.0 if r.ok else 0.0)
+            samples.add("scrape_staleness_seconds", {"target": t.name},
+                        self.staleness(t.name, now=now))
+            samples.add("scrape_duration_seconds", {"target": t.name},
+                        r.duration_s)
+            out.append(r)
+        return samples, out

@@ -1,0 +1,924 @@
+"""Alerting plane (ISSUE 7): fleet scraper, burn-rate alert rules, and
+telemetry-driven restart decisions.
+
+Oracles: ``parse_prometheus(render_prometheus())`` recovers EVERY sample
+of the full README catalogue (names, labels, values, histogram buckets);
+the alert state machine is deterministic under an injected clock (golden
+transition sequences for threshold, burn-rate, absence and delta rules,
+including `for`-hysteresis and flap); a socket fault on ONE scrape target
+fires the liveness alert for that target only, within its per-target
+deadline, while healthy targets keep scraping; and an elastic-manager
+restart decision is driven end to end by a scraped
+``healthcheck_status_value`` flip from a live ``TelemetryServer`` with
+``/alertz`` reporting the firing alert.
+"""
+import importlib.util
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu  # noqa: F401  (registers the instrumented namespaces)
+from paddle_tpu import observability as obs
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.distributed import fault_tolerance as ft
+from paddle_tpu.distributed.fleet.elastic.manager import (
+    ElasticManager, ElasticStatus,
+)
+from paddle_tpu.observability import alerts as obs_alerts
+from paddle_tpu.observability import exporter as obs_exporter
+from paddle_tpu.observability import flight_recorder as obs_flight
+from paddle_tpu.observability import scrape as obs_scrape
+from paddle_tpu.observability.metrics import MetricRegistry
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.quick
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_tool(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(_REPO, "tools", f"{name}.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.status, r.read().decode()
+
+
+def _ss(**named_samples):
+    """SampleSet literal: _ss(metric=[({"l": "v"}, 1.0), ...])."""
+    s = obs_scrape.SampleSet()
+    for name, series in named_samples.items():
+        for labels, value in series:
+            s.add(name, labels, value)
+    return s
+
+
+# ------------------------------------------------------- parser round trip
+def test_parse_prometheus_roundtrips_full_catalogue():
+    """Acceptance: the parser is the exact inverse of render_prometheus()
+    over the full instrumented registry — every sample (names, labels,
+    values, histogram buckets) is recovered."""
+    import paddle_tpu.distributed.checkpoint  # noqa: F401
+    import paddle_tpu.distributed.fault_tolerance  # noqa: F401
+    import paddle_tpu.distributed.sharded_train_step  # noqa: F401
+    import paddle_tpu.distributed.store  # noqa: F401
+    import paddle_tpu.hapi.callbacks  # noqa: F401
+    import paddle_tpu.inference.llm_server  # noqa: F401
+
+    reg = obs.REGISTRY
+    # touch labeled children so the exposition has labeled samples to lose
+    reg.get("store_ops_total").labels(op="rt_probe").inc(3)
+    reg.get("store_op_duration_seconds").labels(op="rt_probe").observe(0.01)
+    reg.get("healthcheck_status_value").labels(check="rt_probe").set(1.0)
+    obs.disable()  # freeze values: render and snapshot must see one state
+    try:
+        text = reg.render_prometheus()
+        snap = reg.snapshot()
+    finally:
+        obs.enable()
+    parsed = obs_scrape.parse_prometheus(text)
+    assert set(parsed) == set(snap)
+    for name in snap:
+        assert parsed[name] == snap[name], f"family {name} did not round-trip"
+
+
+def test_parse_prometheus_escapes_histograms_and_noise():
+    r = MetricRegistry()
+    c = r.counter("rt_esc_total", 'help \\ "q" and\nnewline',
+                  labelnames=("path",))
+    c.labels(path='a\\b"c}d\ne').inc()
+    h = r.histogram("rt_lat_seconds", "lat", labelnames=("op",),
+                    buckets=(0.1, 1.0))
+    h.labels(op="x").observe(0.05)
+    h.labels(op="x").observe(50.0)  # lands in +Inf overflow
+    text = r.render_prometheus()
+    assert obs_scrape.parse_prometheus(text) == r.snapshot()
+    # timestamped samples and stray comments are legal exposition noise
+    noisy = "# random comment\nfoo_value 3 1700000000000\n"
+    fam = obs_scrape.parse_prometheus(noisy)
+    assert fam["foo_value"] == {
+        "kind": "untyped", "help": "",
+        "series": [{"labels": {}, "value": 3.0}]}
+    with pytest.raises(ValueError):
+        obs_scrape.parse_prometheus('bad_value{l="x} 1\n')  # unterminated
+
+
+def test_sampleset_match_semantics():
+    s = _ss(m_value=[({"a": "1", "b": "2"}, 5.0), ({"a": "1"}, 7.0)])
+    assert len(s.match("m_value", {"a": "1"})) == 2  # subset match
+    assert s.value("m_value", {"b": "2"}) == 5.0
+    with pytest.raises(ValueError):
+        s.value("m_value", {"a": "1"})  # ambiguous
+    assert s.value("missing_value", default=None) is None
+    flat = obs_scrape.SampleSet.from_registry()
+    assert "store_ops_total" in flat.names()
+
+
+# ----------------------------------------------------------------- scraper
+def test_scrape_target_parsing():
+    t = obs_scrape.ScrapeTarget("10.0.0.1:9100")
+    assert (t.host, t.port, t.path, t.name) \
+        == ("10.0.0.1", 9100, "/metrics", "10.0.0.1:9100")
+    t2 = obs_scrape.ScrapeTarget("http://h:1/custom", name="n")
+    assert (t2.port, t2.path, t2.name) == (1, "/custom", "n")
+    with pytest.raises(ValueError):
+        obs_scrape.ScrapeTarget("no-port")
+    with pytest.raises(ValueError):
+        obs_scrape.Scraper(["h:1", "h:1"])  # duplicate names
+
+
+def test_scraper_live_and_dead_targets():
+    r = MetricRegistry()
+    r.counter("sc_demo_total", "demo").inc(9)
+    srv = obs_exporter.TelemetryServer(port=0, registry=r).start()
+    try:
+        live = f"127.0.0.1:{srv.port}"
+        sc = obs_scrape.Scraper(
+            [live, obs_scrape.ScrapeTarget("127.0.0.1:1", name="dead")],
+            timeout_s=2.0, retries=1, retry_backoff_s=0.0)
+        samples, results = sc.poll()
+        by = {res.target.name: res for res in results}
+        assert by[live].ok and by[live].attempts == 1
+        assert not by["dead"].ok and by["dead"].attempts == 2  # bounded retry
+        # scraped samples carry the target label
+        assert samples.value("sc_demo_total", {"target": live}) == 9.0
+        # self-telemetry is in the SampleSet and the registry
+        assert samples.value("scrape_target_up", {"target": live}) == 1.0
+        assert samples.value("scrape_target_up", {"target": "dead"}) == 0.0
+        assert samples.value("scrape_staleness_seconds",
+                             {"target": live}) == pytest.approx(0.0, abs=0.5)
+        assert samples.value("scrape_staleness_seconds",
+                             {"target": "dead"}) > 0.0
+        g = obs.REGISTRY.get("scrape_target_up")
+        assert g.labels(target=live).value == 1.0
+        assert g.labels(target="dead").value == 0.0
+        assert obs.REGISTRY.get("scrape_errors_total") \
+            .labels(target="dead").value >= 2
+    finally:
+        srv.stop()
+
+
+def test_scrape_one_defer_publish_keeps_telemetry_untouched():
+    """poll() abandons stragglers; a deferred scrape_one must not land
+    up/staleness side effects until the caller publishes it."""
+    sc = obs_scrape.Scraper([obs_scrape.ScrapeTarget("127.0.0.1:1",
+                                                     name="straggler")],
+                            timeout_s=0.3, retries=0)
+    up = obs.REGISTRY.get("scrape_target_up")
+    up.labels(target="straggler").set(1.0)  # pretend an earlier poll said up
+    r = sc.scrape_one(sc.targets[0], defer_publish=True)
+    assert not r.ok
+    assert up.labels(target="straggler").value == 1.0  # untouched
+    assert "straggler" not in sc._last_ok
+    sc._publish(r)
+    assert up.labels(target="straggler").value == 0.0
+
+
+def test_poll_straggler_keeps_staleness_gauge_advancing(monkeypatch):
+    """A thread that overruns even the joined deadline is reported down
+    AND keeps aging on the staleness gauge — a wedged target must never
+    look fresh to a meta-scraper."""
+    sc = obs_scrape.Scraper([obs_scrape.ScrapeTarget("127.0.0.1:1",
+                                                     name="wedged")],
+                            timeout_s=0.2, retries=0)
+    sc._last_ok["wedged"] = sc._clock()  # pretend it was healthy just now
+
+    def never_returns(target, defer_publish=False):
+        time.sleep(5.0)
+
+    monkeypatch.setattr(sc, "scrape_one", never_returns)
+    t0 = time.monotonic()
+    samples, results = sc.poll()
+    assert time.monotonic() - t0 < 2.0  # poll did not wait the 5 s out
+    assert not results[0].ok and "overran" in results[0].error
+    st = obs.REGISTRY.get("scrape_staleness_seconds") \
+        .labels(target="wedged")
+    assert st.value > 0.0
+    assert samples.value("scrape_target_up", {"target": "wedged"}) == 0.0
+
+
+def test_scraper_health_probe_refreshes_gauges():
+    flag = {"ok": True}
+    srv = obs_exporter.TelemetryServer(port=0)
+    srv.register_healthcheck("probe_demo", lambda: flag["ok"])
+    srv.start()
+    try:
+        name = f"127.0.0.1:{srv.port}"
+        sc = obs_scrape.Scraper(
+            [obs_scrape.ScrapeTarget(name, probe_health=True)],
+            timeout_s=2.0)
+        samples, results = sc.poll()
+        assert results[0].health_status == 200
+        assert samples.value("healthcheck_status_value",
+                             {"check": "probe_demo", "target": name}) == 1.0
+        flag["ok"] = False  # no explicit /healthz hit: the scrape probes it
+        samples, results = sc.poll()
+        assert results[0].health_status == 503
+        assert samples.value("healthcheck_status_value",
+                             {"check": "probe_demo", "target": name}) == 0.0
+    finally:
+        srv.unregister_healthcheck("probe_demo")
+        srv.stop()
+
+
+# ------------------------------------------------- golden state transitions
+def test_alert_state_machine_golden_sequence():
+    """Acceptance: deterministic transitions under an injected clock for
+    all four rule kinds, including for-hysteresis and flap."""
+    rules = [
+        obs_alerts.Rule("th", metric="q_depth", op=">", threshold=10.0,
+                        for_s=10.0, resolved_hold_s=40.0),
+        obs_alerts.Rule("br", kind="burn_rate",
+                        labels={"series": "e2e"}, threshold=0.5, for_s=0.0),
+        obs_alerts.Rule("ab", kind="absence", metric="hb_value",
+                        for_s=5.0),
+        obs_alerts.Rule("de", kind="delta", metric="restarts_total",
+                        op=">", threshold=2.0, window_s=100.0, for_s=0.0),
+    ]
+    eng = obs_alerts.AlertEngine(rules=rules, clock=lambda: 0.0)
+
+    def tick(t, q, burn, hb, restarts):
+        s = obs_scrape.SampleSet()
+        s.add("q_depth", {}, q)
+        s.add("slo_burn_rate_ratio", {"series": "e2e"}, burn)
+        if hb is not None:
+            s.add("hb_value", {"node": "n1"}, hb)
+        s.add("restarts_total", {}, restarts)
+        return [(t, tr["alert"], tr["from"], tr["to"])
+                for tr in eng.evaluate(s, now=t)]
+
+    seq = []
+    seq += tick(0, 5, 0.0, 1.0, 0)     # all quiet (hb seen)
+    seq += tick(10, 20, 0.0, 1.0, 0)   # th: inactive->pending
+    seq += tick(15, 20, 0.6, 1.0, 1)   # br: ->firing (for_s=0 skips pending)
+    seq += tick(21, 20, 0.6, None, 2)  # th: pending->firing (held 11s >= 10)
+    #                                    ab: hb vanished -> pending
+    seq += tick(25, 5, 0.2, None, 4)   # th: firing->resolved,
+    #                                    br: firing->resolved,
+    #                                    de: inc 4>2 -> firing
+    seq += tick(27, 5, 0.2, 1.0, 4)    # ab: hb back before for_s -> inactive
+    seq += tick(40, 20, 0.2, 1.0, 4)   # th: resolved->pending (re-fire arm)
+    seq += tick(51, 20, 0.2, 1.0, 4)   # th: pending->firing (flap refire)
+    seq += tick(130, 5, 0.2, 1.0, 4)   # th: firing->resolved; de: window
+    #                                    slid empty (inc 0) -> resolved
+    assert seq == [
+        (10, "th", "inactive", "pending"),
+        (15, "br", "inactive", "firing"),
+        (21, "th", "pending", "firing"),
+        (21, "ab", "inactive", "pending"),
+        (25, "th", "firing", "resolved"),
+        (25, "br", "firing", "resolved"),
+        (25, "de", "inactive", "firing"),
+        (27, "ab", "pending", "inactive"),
+        (40, "th", "resolved", "pending"),
+        (51, "th", "pending", "firing"),
+        (130, "th", "firing", "resolved"),
+        (130, "de", "firing", "resolved"),
+    ], seq
+    # episodes counted per firing episode (th fired twice = flap)
+    st = eng.state()
+    th = next(a for a in st["alerts"] if a["name"] == "th")
+    assert th["instances"][0]["episodes"] == 2
+
+
+def test_absence_rule_fires_after_hysteresis_and_counts_missing():
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("gone", kind="absence", metric="hb_value",
+                               for_s=5.0)],
+        clock=lambda: 0.0)
+    s2 = _ss(hb_value=[({"node": "a"}, 1.0), ({"node": "b"}, 1.0)])
+    eng.evaluate(s2, now=0)
+    only_a = _ss(hb_value=[({"node": "a"}, 1.0)])
+    eng.evaluate(only_a, now=1)       # b vanished -> pending
+    trs = eng.evaluate(only_a, now=7)  # held 6s >= 5 -> firing
+    assert [(t["labels"], t["to"]) for t in trs] \
+        == [({"node": "b"}, "firing")]
+    assert eng.firing() and eng.firing()[0]["labels"] == {"node": "b"}
+
+
+def test_absence_ttl_forgets_decommissioned_label_sets():
+    """A label set firing-absent for window_s is taken as scale-in: the
+    alert resolves, the engine forgets it (bounded under churn), and a
+    reappearance re-seeds it fresh."""
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("gone", kind="absence", metric="hb_value",
+                               for_s=0.0, window_s=60.0,
+                               resolved_hold_s=10.0)],
+        clock=lambda: 0.0)
+    eng.evaluate(_ss(hb_value=[({"node": "a"}, 1.0)]), now=0)
+    empty = obs_scrape.SampleSet()
+    trs = eng.evaluate(empty, now=1)  # vanished -> firing (for_s=0)
+    assert [t["to"] for t in trs] == ["firing"]
+    assert eng.evaluate(empty, now=30) == []  # still inside the TTL
+    trs = eng.evaluate(empty, now=62)  # fired 61s >= 60: decommissioned
+    assert [t["to"] for t in trs] == ["resolved"]
+    eng.evaluate(empty, now=80)  # resolved_hold elapsed -> inactive+reaped
+    assert eng._seen["gone"] == set()
+    assert eng._instances["gone"] == {}
+    # the node coming BACK is a fresh seen entry, quiet until it drops out
+    assert eng.evaluate(_ss(hb_value=[({"node": "a"}, 1.0)]), now=90) == []
+    trs = eng.evaluate(empty, now=91)
+    assert [t["to"] for t in trs] == ["firing"]
+
+
+def test_delta_rule_tolerates_counter_reset():
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("de", kind="delta", metric="c_total",
+                               op=">", threshold=5.0, window_s=100.0)],
+        clock=lambda: 0.0)
+    for t, v in [(0, 100.0), (10, 103.0), (20, 1.0), (30, 4.0)]:
+        trs = eng.evaluate(_ss(c_total=[({}, v)]), now=t)
+        # positive deltas only: 3 (100->103) + 0 (reset) + 3 (1->4) = 6 > 5
+        if t < 30:
+            assert trs == []
+    assert [i["state"] for a in eng.state()["alerts"]
+            for i in a["instances"]] == ["firing"]
+
+
+def test_transitions_export_metrics_flight_events_and_jsonl(tmp_path):
+    """Satellite: transitions land on alert_state_value / the transitions
+    counter, in the flight recorder (crash-dump context) and the JSONL
+    alert log."""
+    obs_flight.clear()
+    log = str(tmp_path / "alerts.jsonl")
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("tx_demo", metric="q_depth", op=">",
+                               threshold=1.0, for_s=0.0)],
+        clock=lambda: 0.0, log_path=log)
+    eng.evaluate(_ss(q_depth=[({}, 5.0)]), now=1.0)
+    assert obs.REGISTRY.get("alert_state_value") \
+        .labels(alert="tx_demo").value == 3.0  # firing
+    eng.evaluate(_ss(q_depth=[({}, 0.0)]), now=2.0)
+    assert obs.REGISTRY.get("alert_state_value") \
+        .labels(alert="tx_demo").value == 1.0  # resolved
+    assert obs.REGISTRY.get("alert_transitions_total") \
+        .labels(alert="tx_demo", state="firing").value >= 1
+    flights = [e for e in obs_flight.events()
+               if e["kind"] == "alert_transition"
+               and e.get("alert") == "tx_demo"]
+    assert [(e["from"], e["to"]) for e in flights] \
+        == [("inactive", "firing"), ("firing", "resolved")]
+    lines = [json.loads(l) for l in open(log)]
+    assert [(l["from"], l["to"]) for l in lines] \
+        == [("inactive", "firing"), ("firing", "resolved")]
+    assert all("time" in l and "mono" in l and l["alert"] == "tx_demo"
+               and "severity" in l for l in lines)
+
+
+def test_rule_validation_and_dict_roundtrip():
+    with pytest.raises(ValueError):
+        obs_alerts.Rule("x", metric="m", kind="nope")
+    with pytest.raises(ValueError):
+        obs_alerts.Rule("x", metric="m", op="~")
+    with pytest.raises(ValueError):
+        obs_alerts.Rule("x", kind="threshold")  # threshold needs a metric
+    r = obs_alerts.Rule("x", kind="burn_rate", threshold=0.3, for_s=5)
+    assert r.metric == "slo_burn_rate_ratio"
+    assert obs_alerts.Rule.from_dict(r.to_dict()).to_dict() == r.to_dict()
+    with pytest.raises(ValueError, match="unknown fields.*for"):
+        # a Prometheus-spelling typo must not yield a zero-hysteresis rule
+        obs_alerts.Rule.from_dict({"name": "x", "metric": "m", "for": 30})
+    with pytest.raises(ValueError):
+        obs_alerts.AlertEngine(rules=[r, obs_alerts.Rule(
+            "x", metric="m")])  # duplicate names
+    with pytest.raises(ValueError):
+        obs_alerts.AlertPolicy({"x": "explode"},
+                               rules=[obs_alerts.Rule("x", metric="m")])
+    with pytest.raises(ValueError):
+        obs_alerts.AlertPolicy({"unknown_alert": "restart"},
+                               rules=[obs_alerts.Rule("x", metric="m")])
+    names = {r.name for r in obs_alerts.default_rules()}
+    assert {"slo_burn_rate_high", "healthcheck_failing",
+            "store_deadline_pressure", "llm_queue_backlog",
+            "recovery_restart_storm", "scrape_target_down"} <= names
+
+
+# ----------------------------------------------------------------- /alertz
+def test_alertz_endpoint_serves_and_ticks_engine():
+    reg = MetricRegistry()
+    g = reg.gauge("az_depth", "demo")
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("az_backlog", metric="az_depth", op=">",
+                               threshold=10.0, for_s=0.0)],
+        registry=reg)
+    srv = obs_exporter.TelemetryServer(port=0, registry=reg,
+                                       alerts=eng).start()
+    try:
+        _, body = _get(srv.url + "/alertz")
+        doc = json.loads(body)
+        assert doc["enabled"] and doc["firing"] == []
+        assert doc["alerts"][0]["name"] == "az_backlog"
+        g.set(50.0)  # each GET is an engine tick over the local registry
+        _, body = _get(srv.url + "/alertz")
+        doc = json.loads(body)
+        assert [f["alert"] for f in doc["firing"]] == ["az_backlog"]
+        assert doc["alerts"][0]["state"] == "firing"
+        # servers without an engine answer the probe honestly
+        bare = obs_exporter.TelemetryServer(port=0,
+                                            registry=MetricRegistry())
+        bare.start()
+        try:
+            _, body = _get(bare.url + "/alertz")
+            assert json.loads(body) == {"enabled": False, "alerts": []}
+        finally:
+            bare.stop()
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------- chaos tests
+@pytest.mark.faults
+def test_socket_fault_on_one_target_alerts_that_target_only():
+    """Satellite: drop the connection of ONE scrape target (fault harness)
+    — its liveness alert fires within its per-target deadline while the
+    healthy target keeps scraping."""
+    r1, r2 = MetricRegistry(), MetricRegistry()
+    r1.counter("chaos_a_total", "a").inc(1)
+    r2.counter("chaos_b_total", "b").inc(2)
+    s1 = obs_exporter.TelemetryServer(port=0, registry=r1).start()
+    s2 = obs_exporter.TelemetryServer(port=0, registry=r2).start()
+    try:
+        t1, t2 = f"127.0.0.1:{s1.port}", f"127.0.0.1:{s2.port}"
+        sc = obs_scrape.Scraper([t1, t2], timeout_s=1.0, retries=1,
+                                retry_backoff_s=0.0)
+        eng = obs_alerts.AlertEngine(
+            rules=[obs_alerts.Rule("target_down",
+                                   metric="scrape_target_up", op="<",
+                                   threshold=1.0, for_s=0.0)],
+            clock=lambda: 0.0)
+        samples, _ = sc.poll()
+        assert eng.evaluate(samples, now=0.0) == []  # both healthy
+        with faults.SocketFaults(s1.port,
+                                 faults={i: "drop" for i in range(8)}):
+            samples, results = sc.poll()
+        by = {res.target.name: res for res in results}
+        assert not by[t1].ok and "injected connect drop" in by[t1].error
+        assert by[t1].duration_s <= 1.0 + 0.5  # inside its own deadline
+        assert by[t2].ok  # the healthy target was never blocked
+        assert samples.value("chaos_b_total", {"target": t2}) == 2.0
+        trs = eng.evaluate(samples, now=1.0)
+        assert [(t["labels"], t["to"]) for t in trs] \
+            == [({"target": t1}, "firing")]  # that target ONLY
+        firing = eng.firing()
+        assert len(firing) == 1 and firing[0]["labels"]["target"] == t1
+        # recovery: the fault context exited, next poll heals the alert
+        samples, _ = sc.poll()
+        trs = eng.evaluate(samples, now=2.0)
+        assert [(t["labels"], t["to"]) for t in trs] \
+            == [({"target": t1}, "resolved")]
+    finally:
+        s1.stop()
+        s2.stop()
+
+
+@pytest.mark.faults
+def test_stalled_target_bounded_by_per_target_deadline():
+    """A target that accepts and never answers (stall) costs exactly its
+    own scrape budget; the healthy sibling is untouched."""
+    silent = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(4)
+    healthy = obs_exporter.TelemetryServer(port=0,
+                                           registry=MetricRegistry())
+    healthy.start()
+    try:
+        stall_t = f"127.0.0.1:{silent.getsockname()[1]}"
+        ok_t = f"127.0.0.1:{healthy.port}"
+        sc = obs_scrape.Scraper([stall_t, ok_t], timeout_s=0.5, retries=0)
+        t0 = time.monotonic()
+        samples, results = sc.poll()
+        wall = time.monotonic() - t0
+        by = {res.target.name: res for res in results}
+        assert not by[stall_t].ok and "timed out" in by[stall_t].error
+        assert by[ok_t].ok
+        # the stalled target burned ~its own budget, not the fleet's
+        assert 0.4 <= by[stall_t].duration_s <= 1.5
+        assert wall <= 2.0  # poll joined against the shared deadline
+        assert samples.value("scrape_target_up", {"target": stall_t}) == 0.0
+        assert samples.value("scrape_target_up", {"target": ok_t}) == 1.0
+    finally:
+        silent.close()
+        healthy.stop()
+
+
+def test_flatten_preserves_colliding_labels_as_exported():
+    """A target that itself scrapes others must not have its view of them
+    collapsed into its own target identity (honor_labels=false)."""
+    fam = {"scrape_target_up": {"kind": "gauge", "help": "", "series": [
+        {"labels": {"target": "10.0.0.2:9100"}, "value": 0.0}]}}
+    s = obs_scrape.SampleSet().add_families(fam, {"target": "10.0.0.1:9100"})
+    assert s.match("scrape_target_up") == [(
+        {"exported_target": "10.0.0.2:9100", "target": "10.0.0.1:9100"},
+        0.0)]
+    # no collision -> no exported_ alias
+    s2 = obs_scrape.SampleSet().add_families(fam, {"target": "10.0.0.2:9100"})
+    assert s2.match("scrape_target_up") == [(
+        {"target": "10.0.0.2:9100"}, 0.0)]
+
+
+def test_duplicate_samples_cannot_double_advance_an_instance():
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("dup", metric="up_value", op="<",
+                               threshold=1.0, for_s=5.0)],
+        clock=lambda: 0.0)
+    dup = _ss(up_value=[({"t": "a"}, 0.0), ({"t": "a"}, 1.0)])
+    # last-cond-wins: the healthy duplicate overrides; no transition at all
+    assert eng.evaluate(dup, now=0.0) == []
+    assert eng.state()["alerts"][0]["instances"][0]["state"] == "inactive"
+
+
+def test_engine_reaps_windows_and_instances_for_vanished_labels():
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("de", kind="delta", metric="c_total",
+                               op=">", threshold=100.0, window_s=50.0)],
+        clock=lambda: 0.0)
+    for i in range(5):  # 5 ephemeral pods, one eval each, then gone
+        eng.evaluate(_ss(c_total=[({"pod": f"p{i}"}, 1.0)]), now=float(i))
+    eng.evaluate(obs_scrape.SampleSet(), now=10.0)
+    assert eng._windows == {}  # dead deques reaped with their instances
+    assert eng._instances["de"] == {}
+
+
+# -------------------------------------------------------- actuation: policy
+def test_policy_emits_once_per_episode_and_runs_callables():
+    hits = []
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("cb", metric="q_depth", op=">",
+                               threshold=1.0, for_s=0.0,
+                               resolved_hold_s=10.0)],
+        clock=lambda: 0.0)
+    pol = obs_alerts.AlertPolicy({"cb": lambda d: hits.append(d)},
+                                 engine=eng, clock=lambda: 0.0)
+    assert pol.poll(_ss(q_depth=[({}, 5.0)]), now=0) == []  # callable ran
+    assert len(hits) == 1 and hits[0].alert == "cb"
+    pol.poll(_ss(q_depth=[({}, 5.0)]), now=1)  # same episode: no re-act
+    assert len(hits) == 1
+    pol.poll(_ss(q_depth=[({}, 0.0)]), now=2)  # resolve
+    pol.poll(_ss(q_depth=[({}, 9.0)]), now=3)  # re-fire = new episode
+    assert len(hits) == 2 and hits[1].episode == 2
+    assert obs.REGISTRY.get("alert_actions_total") \
+        .labels(alert="cb", action="<lambda>").value >= 2
+
+
+def test_policy_throttles_implicit_polls_and_prunes_acted():
+    clk = {"t": 0.0}
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("thr", metric="q_depth", op=">",
+                               threshold=1.0, for_s=0.0)],
+        registry=MetricRegistry(), clock=lambda: clk["t"])
+    pol = obs_alerts.AlertPolicy({"thr": "restart"}, engine=eng,
+                                 clock=lambda: clk["t"], min_interval_s=10.0)
+    pol.poll()  # implicit poll: evaluates
+    evals = eng.state()["evaluations"]
+    clk["t"] = 5.0
+    assert pol.poll() == [] and eng.state()["evaluations"] == evals  # throttled
+    clk["t"] = 11.0
+    pol.poll()
+    assert eng.state()["evaluations"] == evals + 1  # interval elapsed
+    # explicit samples/now bypass the throttle (caller owns the cadence)
+    pol.poll(_ss(q_depth=[({}, 0.0)]), now=11.5)
+    assert eng.state()["evaluations"] == evals + 2
+    # _acted is bounded to the live firing set
+    pol.poll(_ss(q_depth=[({}, 9.0)]), now=12.0)
+    assert len(pol._acted) == 1
+    pol.poll(_ss(q_depth=[({}, 0.0)]), now=13.0)  # resolves
+    assert pol._acted == {}
+    # scraper-backed policies default the throttle on; local ones off
+    assert obs_alerts.AlertPolicy({}, rules=[obs_alerts.Rule(
+        "r", metric="m")]).min_interval_s == 0.0
+
+
+def test_policy_callable_failure_stays_retryable():
+    """A raising action callable must propagate AND leave the episode
+    un-acted, so the actuation is retried on the next poll instead of
+    being silently lost."""
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("cbfail", metric="q_depth", op=">",
+                               threshold=1.0, for_s=0.0)],
+        clock=lambda: 0.0)
+    hits = {"n": 0, "boom": True}
+
+    def notify(d):
+        hits["n"] += 1
+        if hits["boom"]:
+            raise OSError("webhook down")
+
+    pol = obs_alerts.AlertPolicy({"cbfail": notify}, engine=eng,
+                                 clock=lambda: 0.0)
+    acted = obs.REGISTRY.get("alert_actions_total") \
+        .labels(alert="cbfail", action="notify")
+    a0 = acted.value
+    with pytest.raises(OSError):
+        pol.poll(_ss(q_depth=[({}, 5.0)]), now=0)
+    assert acted.value == a0  # a failed action is not counted as acted
+    hits["boom"] = False
+    pol.poll(_ss(q_depth=[({}, 5.0)]), now=1)  # same episode: retried now
+    assert hits["n"] == 2
+    pol.poll(_ss(q_depth=[({}, 5.0)]), now=2)  # acted: no third call
+    assert hits["n"] == 2
+    assert acted.value == a0 + 1  # once per episode, not per retry
+
+
+def test_delta_window_bounded_under_fast_evaluation():
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("de", kind="delta", metric="c_total",
+                               op=">", threshold=1e9, window_s=10.0)],
+        clock=lambda: 0.0)
+    for i in range(4000):  # 100 evals/s for 40s of injected time
+        eng.evaluate(_ss(c_total=[({}, float(i))]), now=i * 0.01)
+    st = eng._windows[("de", ())]
+    # coalesced: one entry per window_s/256 spacing, not one per eval
+    assert len(st["win"]) <= 260
+    # and the incremental increase still tracks the true window delta
+    assert st["inc"] == pytest.approx(10.0 / 0.01, rel=0.05)
+
+
+def test_run_with_recovery_serves_alertz_for_its_policy(tmp_path):
+    import paddle_tpu.observability.exporter as ex
+
+    pol = obs_alerts.AlertPolicy(
+        {}, rules=[obs_alerts.Rule("quiet", metric="rwr_never_value",
+                                   op=">", threshold=1e9)])
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=2)
+    state = {"x": np.zeros(1)}
+    urls = {}
+    orig_start = ex.TelemetryServer.start
+
+    def start_and_record(self):
+        out = orig_start(self)
+        urls.setdefault("url", self.url)
+        return out
+
+    def step_fn(step):
+        if "url" in urls:  # the training endpoint reports its own engine
+            _, body = _get(urls.pop("url") + "/alertz")
+            doc = json.loads(body)
+            assert doc["enabled"]
+            assert [a["name"] for a in doc["alerts"]] == ["quiet"]
+        state["x"] = state["x"] + 1
+
+    ex.TelemetryServer.start = start_and_record
+    try:
+        ft.run_with_recovery(
+            step_fn, 2, mgr,
+            get_state=lambda: {"x": state["x"]},
+            set_state=lambda s: state.update(x=np.asarray(s["x"])),
+            telemetry_port=0, alert_policy=pol)
+    finally:
+        ex.TelemetryServer.start = orig_start
+
+
+def test_run_with_recovery_logs_unhandled_decisions(tmp_path):
+    """A non-restart decision reaching the supervisor (which only executes
+    restarts) leaves a black-box trace instead of vanishing."""
+    reg = MetricRegistry()
+    reg.gauge("rwr_q_value", "demo").set(9.0)  # fires immediately
+    pol = obs_alerts.AlertPolicy(
+        {"rwr_backlog": "quarantine"},
+        engine=obs_alerts.AlertEngine(
+            rules=[obs_alerts.Rule("rwr_backlog", metric="rwr_q_value",
+                                   op=">", threshold=1.0, for_s=0.0)],
+            registry=reg))
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=2)
+    state = {"x": np.zeros(1)}
+    obs_flight.clear()
+    report = ft.run_with_recovery(
+        lambda step: state.update(x=state["x"] + 1), 2, mgr,
+        get_state=lambda: {"x": state["x"]},
+        set_state=lambda s: state.update(x=np.asarray(s["x"])),
+        alert_policy=pol)
+    assert report == {"completed": 2, "restarts": 0}  # no restart executed
+    evts = [e for e in obs_flight.events()
+            if e["kind"] == "alert_decision_unhandled"]
+    assert evts and evts[0]["alert"] == "rwr_backlog" \
+        and evts[0]["action"] == "quarantine"
+
+
+def test_run_with_recovery_restart_driven_by_alert(tmp_path):
+    """A firing alert mapped to 'restart' checkpoint-restores the training
+    loop exactly like a preemption — the telemetry-driven restart."""
+    reg = MetricRegistry()
+    health = reg.gauge("rwr_health_value", "worker health")
+    health.set(1.0)
+    pol = obs_alerts.AlertPolicy(
+        {"rwr_unhealthy": "restart"},
+        engine=obs_alerts.AlertEngine(
+            rules=[obs_alerts.Rule("rwr_unhealthy",
+                                   metric="rwr_health_value", op="<",
+                                   threshold=1.0, for_s=0.0)],
+            registry=reg))
+    mgr = ckpt.CheckpointManager(str(tmp_path / "ck"), keep=3)
+    state = {"x": np.zeros(1)}
+
+    def step_fn(step):
+        if step == 2:
+            health.set(0.0)  # the fleet telemetry goes bad mid-run
+        state["x"] = state["x"] + 1
+
+    report = ft.run_with_recovery(
+        step_fn, 5, mgr,
+        get_state=lambda: {"x": state["x"]},
+        set_state=lambda s: state.update(x=np.asarray(s["x"])),
+        alert_policy=pol)
+    # the restart decision fired once (episode dedupe), restored, replayed
+    assert report["restarts"] == 1
+    assert float(state["x"][0]) == 5.0
+    kinds = [e["kind"] for e in obs_flight.events()]
+    assert "alert_action" in kinds
+    # the AlertRestart carries the decision for the postmortem
+    recoverables = [e for e in obs_flight.events()
+                    if e["kind"] == "recoverable_failure"
+                    and "rwr_unhealthy" in e.get("error", "")]
+    assert recoverables, "restart was not attributed to the alert"
+
+
+# --------------------------------------------- actuation: elastic manager
+def test_elastic_manager_quarantine_and_widen():
+    mgr = ElasticManager(np="1:3", heartbeat_interval=0.05)
+    mgr.store.set(mgr._node_key("a:1"), str(time.time()))
+    mgr.store.set(mgr._node_key("b:1"), str(time.time()))
+    assert mgr.hosts() == ["a:1", "b:1"]
+    mgr.quarantine("b:1")
+    assert mgr.hosts() == ["a:1"] and mgr.quarantined == ["b:1"]
+    mgr.unquarantine("b:1")
+    assert mgr.hosts() == ["a:1", "b:1"]
+    assert mgr._wait_slack == 0.0
+    mgr.widen_wait(30.0)
+    mgr.widen_wait(15.0)
+    assert mgr._wait_slack == 45.0
+    mgr.widen_wait(1e9)  # a flapping widen alert cannot unbound the wait
+    assert mgr._wait_slack == mgr.max_wait_slack == 300.0
+    assert mgr.check() == ElasticStatus.COMPLETED
+    assert mgr.poll_alerts() == []  # no policy attached: a no-op
+
+
+def test_quarantine_decision_routes_target_through_host_map():
+    """A scrape-target name (host:metrics_port) is not a membership key;
+    target_to_host routes it, and an unmappable quarantine leaves a
+    flight event instead of silently doing nothing."""
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("down", metric="scrape_target_up", op="<",
+                               threshold=1.0, for_s=0.0)],
+        clock=lambda: 0.0)
+    pol = obs_alerts.AlertPolicy({"down": "quarantine"}, engine=eng,
+                                 clock=lambda: 0.0)
+    mgr = ElasticManager(np="1:3", heartbeat_interval=0.05,
+                         alert_policy=pol,
+                         target_to_host={"10.0.0.2:9100": "b:7000"})
+    mgr.store.set(mgr._node_key("a:7000"), str(time.time()))
+    mgr.store.set(mgr._node_key("b:7000"), str(time.time()))
+    down = _ss(scrape_target_up=[({"target": "10.0.0.2:9100"}, 0.0)])
+    decs = mgr.poll_alerts(samples=down, now=0.0)
+    assert [d.action for d in decs] == ["quarantine"]
+    assert mgr.quarantined == ["b:7000"]  # mapped, not the raw target name
+    assert mgr.hosts() == ["a:7000"]
+    # unmapped target: quarantined verbatim + visible in the black box
+    obs_flight.clear()
+    down2 = _ss(scrape_target_up=[({"target": "10.0.0.9:9100"}, 0.0)])
+    mgr.poll_alerts(samples=down2, now=1.0)
+    assert "10.0.0.9:9100" in mgr.quarantined
+    evts = [e for e in obs_flight.events()
+            if e["kind"] == "quarantine_unknown_host"]
+    assert evts and evts[0]["host"] == "10.0.0.9:9100"
+
+
+def test_closed_loop_scraped_healthcheck_drives_elastic_restart():
+    """Acceptance: live TelemetryServer (port 0) -> fleet scraper ->
+    healthcheck_failing rule -> AlertPolicy -> ElasticManager restart
+    decision, with /alertz reporting the firing alert."""
+    flag = {"ok": True}
+    srv = obs_exporter.TelemetryServer(port=0)
+    srv.register_healthcheck("fleet_worker", lambda: flag["ok"])
+    srv.start()
+    try:
+        target = f"127.0.0.1:{srv.port}"
+        scraper = obs_scrape.Scraper(
+            [obs_scrape.ScrapeTarget(target, probe_health=True)],
+            timeout_s=2.0)
+        rules = [
+            obs_alerts.Rule("healthcheck_failing",
+                            metric="healthcheck_status_value",
+                            labels={"check": "fleet_worker"},
+                            op="<", threshold=1.0, for_s=5.0),
+            # exported_target="" excludes another scraper's re-exported
+            # series (this very process self-scrapes its global registry)
+            obs_alerts.Rule("scrape_target_down",
+                            metric="scrape_target_up",
+                            labels={"exported_target": ""}, op="<",
+                            threshold=1.0, for_s=0.0),
+        ]
+        engine = obs_alerts.AlertEngine(rules=rules, clock=lambda: 0.0)
+        policy = obs_alerts.AlertPolicy(
+            {"healthcheck_failing": "restart",
+             "scrape_target_down": "quarantine"},
+            engine=engine, scraper=scraper, clock=lambda: 0.0)
+        srv.attach_alerts(engine, eval_on_request=False)
+        mgr = ElasticManager(np="1", heartbeat_interval=0.05,
+                             alert_policy=policy)
+        mgr.store.set(mgr._node_key(target), str(time.time()))
+
+        assert mgr.poll_alerts(now=0.0) == []
+        assert mgr.check() == ElasticStatus.COMPLETED
+        flag["ok"] = False  # the worker goes unhealthy; heartbeats keep on
+        assert mgr.poll_alerts(now=1.0) == []       # pending (for_s=5)
+        decisions = mgr.poll_alerts(now=7.0)        # held 6s -> firing
+        assert [d.action for d in decisions] == ["restart"]
+        assert decisions[0].alert == "healthcheck_failing"
+        assert decisions[0].labels["target"] == target
+        assert mgr.check() == ElasticStatus.RESTART  # decision armed
+        # /alertz on the LIVE server reports the firing alert
+        _, body = _get(srv.url + "/alertz")
+        doc = json.loads(body)
+        firing = {f["alert"] for f in doc["firing"]}
+        assert firing == {"healthcheck_failing"}
+        # consume: checkpoint-and-re-exec happens, the manager disarms
+        d = mgr.consume_restart()
+        assert d is decisions[0]
+        assert mgr.check() == ElasticStatus.COMPLETED
+        # recovery: the worker heals, the alert resolves on the next poll
+        flag["ok"] = True
+        assert mgr.poll_alerts(now=8.0) == []
+        assert not engine.firing()
+    finally:
+        srv.stop()
+
+
+# -------------------------------------------------------------- fleetwatch
+def test_fleetwatch_selftest_and_live_run(capsys):
+    fw = _load_tool("fleetwatch")
+    assert fw.main(["--selftest"]) == 0
+    capsys.readouterr()
+    srv = obs_exporter.TelemetryServer(port=0,
+                                       registry=MetricRegistry()).start()
+    try:
+        rc = fw.main([f"127.0.0.1:{srv.port}", "--json", "--timeout", "2",
+                      "--no-default-rules"])
+        doc = json.loads(capsys.readouterr().out)
+        assert rc == 0
+        assert doc["targets"][0]["ok"] is True
+        assert doc["firing"] == []
+        # a down target turns the exit code into a health-gate failure
+        rc = fw.main(["127.0.0.1:1", "--timeout", "0.5", "--retries", "0",
+                      "--no-default-rules"])
+        out = capsys.readouterr().out
+        assert rc == 1 and "DOWN" in out
+    finally:
+        srv.stop()
+
+
+def test_fleetwatch_rules_file_and_watch_iterations(tmp_path, capsys):
+    fw = _load_tool("fleetwatch")
+    srv = obs_exporter.TelemetryServer(port=0).start()
+    try:
+        rules = [{"name": "fw_demo", "metric": "exporter_scrapes_total",
+                  "op": ">=", "threshold": 0.0, "for_s": 0.0}]
+        rp = tmp_path / "rules.json"
+        rp.write_text(json.dumps(rules))
+        rc = fw.main([f"127.0.0.1:{srv.port}", "--rules", str(rp),
+                      "--no-default-rules", "--json", "--watch",
+                      "--interval", "0.01", "--iterations", "2",
+                      "--timeout", "2"])
+        lines = [json.loads(l) for l in
+                 capsys.readouterr().out.strip().splitlines()]
+        assert len(lines) == 2  # --iterations bounded the watch loop
+        assert rc == 1  # the always-true demo rule is firing
+        assert any(f["alert"] == "fw_demo" for f in lines[-1]["firing"])
+    finally:
+        srv.stop()
+
+
+# ------------------------------------------------------------ llm engine
+def test_llm_engine_rejects_alert_rules_without_port():
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    paddle.seed(11)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, use_flash_attention=False,
+                           max_position_embeddings=64)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    with pytest.raises(ValueError):
+        LLMEngine(m, max_batch_slots=1, max_seq_len=64,
+                  alert_rules=obs_alerts.default_rules())
+    eng = LLMEngine(m, max_batch_slots=1, max_seq_len=64, metrics_port=0)
+    try:
+        assert eng.alert_engine is not None
+        _, body = _get(eng.telemetry.url + "/alertz")
+        doc = json.loads(body)
+        assert doc["enabled"]
+        assert {a["name"] for a in doc["alerts"]} \
+            >= {"llm_queue_backlog", "slo_burn_rate_high"}
+    finally:
+        eng.stop()

@@ -1,0 +1,246 @@
+#!/usr/bin/env python
+"""fleetwatch: scrape a fleet of telemetry endpoints, evaluate alert rules,
+and render a status table — the operator CLI of the alerting plane
+(README §Observability, "Alerting").
+
+Usage::
+
+    python tools/fleetwatch.py HOST:PORT [HOST:PORT ...]
+        [--timeout 2.0] [--retries 1] [--probe-health]
+        [--rules rules.json] [--no-default-rules]
+        [--json] [--watch] [--interval 10] [--iterations N]
+        [--log alerts.jsonl]
+    python tools/fleetwatch.py --selftest
+
+One shot by default: scrape every target once (per-target monotonic
+deadline — a dead replica cannot block the table), evaluate the rule set
+(defaults: `observability.alerts.default_rules()`; `--rules` adds/replaces
+from a JSON list of rule dicts), print targets + alert states.  `--watch`
+re-polls every `--interval` seconds until interrupted (`--iterations`
+bounds it for scripting).  `--json` emits the machine-readable form of the
+same payload `/alertz` serves, plus per-target scrape results.
+
+`--selftest` runs the embedded acceptance corpus: a canned Prometheus
+exposition (escapes, histograms, +Inf) must parse sample-for-sample, a
+registry render must round-trip, and a scripted sample sequence must walk
+the alert state machine through the golden
+inactive->pending->firing->resolved transition order.  Exit 0 = healthy —
+run it on a new deployment before trusting the alerts.
+
+Exit code (non-selftest): 0 when nothing is firing and every target is up,
+1 when any alert is firing or any target is down — wire it straight into a
+cron/systemd health gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _imports():
+    from paddle_tpu.observability import alerts, scrape
+    return scrape, alerts
+
+
+# ------------------------------------------------------------------ render
+def _fmt_age(seconds):
+    if seconds is None:
+        return "-"
+    if seconds < 120:
+        return f"{seconds:.0f}s"
+    if seconds < 7200:
+        return f"{seconds / 60:.0f}m"
+    return f"{seconds / 3600:.1f}h"
+
+
+def render_status(results, state, now):
+    """Text status table: targets first, then every non-inactive alert."""
+    lines = ["TARGET                        UP  DURATION  ATTEMPTS  ERROR"]
+    for r in results:
+        lines.append(
+            f"{r.target.name:<28}  {'up' if r.ok else 'DOWN':<4}"
+            f"{r.duration_s * 1000:7.1f}ms  {r.attempts:>8}  "
+            f"{(r.error or '-')[:40]}")
+    lines.append("")
+    lines.append("ALERT                      STATE     SINCE  VALUE"
+                 "     LABELS")
+    quiet = 0
+    for a in state["alerts"]:
+        live = [i for i in a["instances"] if i["state"] != "inactive"]
+        if not live:
+            quiet += 1
+            continue
+        for i in live:
+            labels = ",".join(f"{k}={v}" for k, v in
+                              sorted(i["labels"].items()))
+            val = "-" if i["value"] is None else f"{i['value']:.4g}"
+            lines.append(
+                f"{a['name']:<25}  {i['state']:<8}"
+                f"{_fmt_age(max(0.0, now - i['since'])):>7}  {val:<8}  "
+                f"{labels[:48]}")
+    lines.append(f"({quiet} rule(s) quiet)")
+    return "\n".join(lines)
+
+
+def load_rules(args, alerts_mod):
+    rules = [] if args.no_default_rules else alerts_mod.default_rules()
+    if args.rules:
+        with open(args.rules) as f:
+            extra = [alerts_mod.Rule.from_dict(d) for d in json.load(f)]
+        byname = {r.name: r for r in rules}
+        for r in extra:  # file rules replace same-named defaults
+            byname[r.name] = r
+        rules = list(byname.values())
+    return rules
+
+
+def run_once(scraper, engine, as_json):
+    samples, results = scraper.poll()
+    engine.evaluate(samples)
+    state = engine.state()
+    firing = engine.firing()
+    if as_json:
+        print(json.dumps({
+            "targets": [r.to_dict() for r in results],
+            "firing": firing, **state}, default=repr))
+    else:
+        print(render_status(results, state, now=time.monotonic()))
+    unhealthy = bool(firing) or any(not r.ok for r in results)
+    return 1 if unhealthy else 0
+
+
+# ---------------------------------------------------------------- selftest
+#: Canned exposition corpus: escaped HELP + label values, a histogram with
+#: +Inf, an untyped family, a `}` inside a label value, and a timestamped
+#: sample (legal exposition noise a strict parser must tolerate).
+SELFTEST_CORPUS = """\
+# HELP demo_requests_total Requests with \\\\ backslash and\\nnewline
+# TYPE demo_requests_total counter
+demo_requests_total{path="/a\\"b}c",code="200"} 42
+demo_requests_total{path="plain",code="500"} 3
+# TYPE demo_lat_seconds histogram
+# HELP demo_lat_seconds Latency
+demo_lat_seconds_bucket{op="x",le="0.1"} 1
+demo_lat_seconds_bucket{op="x",le="1"} 3
+demo_lat_seconds_bucket{op="x",le="+Inf"} 4
+demo_lat_seconds_sum{op="x"} 5.5
+demo_lat_seconds_count{op="x"} 4
+untyped_thing_value 7 1700000000000
+"""
+
+
+def selftest():
+    scrape, alerts = _imports()
+    from paddle_tpu.observability.metrics import MetricRegistry
+
+    # 1. canned corpus parses sample-for-sample
+    fam = scrape.parse_prometheus(SELFTEST_CORPUS)
+    assert fam["demo_requests_total"]["kind"] == "counter"
+    assert fam["demo_requests_total"]["help"] == \
+        "Requests with \\ backslash and\nnewline"
+    s = scrape.SampleSet().add_families(fam)
+    assert s.value("demo_requests_total",
+                   {"path": '/a"b}c', "code": "200"}) == 42.0
+    assert s.value("demo_lat_seconds_bucket",
+                   {"op": "x", "le": "+Inf"}) == 4.0
+    assert s.value("demo_lat_seconds_sum", {"op": "x"}) == 5.5
+    assert s.value("untyped_thing_value") == 7.0
+    assert fam["untyped_thing_value"]["kind"] == "untyped"
+
+    # 2. render -> parse round-trip on a live registry
+    reg = MetricRegistry()
+    reg.counter("st_total", "selftest", labelnames=("k",)) \
+        .labels(k='we"ird\n').inc(2)
+    reg.histogram("st_seconds", "selftest", buckets=(0.5,)).observe(0.25)
+    assert scrape.parse_prometheus(reg.render_prometheus()) \
+        == reg.snapshot()
+
+    # 3. golden state-machine walk under an injected clock
+    rule = alerts.Rule("st_hc", metric="healthcheck_status_value",
+                       op="<", threshold=1.0, for_s=10.0,
+                       resolved_hold_s=20.0)
+    eng = alerts.AlertEngine(rules=[rule], clock=lambda: 0.0)
+
+    def at(t, v):
+        ss = scrape.SampleSet()
+        ss.add("healthcheck_status_value", {"check": "w"}, v)
+        return [(t, tr["from"], tr["to"])
+                for tr in eng.evaluate(ss, now=t)]
+
+    seq = []
+    for t, v in [(0, 1.0), (5, 0.0), (10, 0.0), (16, 0.0),
+                 (25, 1.0), (30, 0.0), (41, 0.0), (45, 1.0), (70, 1.0)]:
+        seq += at(t, v)
+    golden = [
+        (5, "inactive", "pending"), (16, "pending", "firing"),
+        (25, "firing", "resolved"),
+        (30, "resolved", "pending"), (41, "pending", "firing"),  # flap
+        (45, "firing", "resolved"), (70, "resolved", "inactive"),
+    ]
+    assert seq == golden, f"state machine diverged: {seq}"
+    print("fleetwatch selftest: ok "
+          f"({len(SELFTEST_CORPUS.splitlines())} corpus lines, "
+          f"{len(golden)} golden transitions)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("targets", nargs="*", metavar="HOST:PORT",
+                    help="telemetry endpoints to scrape (/metrics)")
+    ap.add_argument("--timeout", type=float, default=2.0,
+                    help="per-target scrape budget, seconds (monotonic)")
+    ap.add_argument("--retries", type=int, default=1)
+    ap.add_argument("--probe-health", action="store_true",
+                    help="GET /healthz before /metrics on every target "
+                         "(refreshes healthcheck_status_value gauges)")
+    ap.add_argument("--rules", help="JSON file: list of rule dicts "
+                                    "(replace same-named defaults)")
+    ap.add_argument("--no-default-rules", action="store_true")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--watch", action="store_true")
+    ap.add_argument("--interval", type=float, default=10.0)
+    ap.add_argument("--iterations", type=int, default=0,
+                    help="with --watch: stop after N polls (0 = forever)")
+    ap.add_argument("--log", help="append alert transitions to this JSONL")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    if not args.targets:
+        ap.error("need at least one HOST:PORT target (or --selftest)")
+
+    scrape, alerts = _imports()
+    scraper = scrape.Scraper(
+        [scrape.ScrapeTarget(t, probe_health=args.probe_health)
+         for t in args.targets],
+        timeout_s=args.timeout, retries=args.retries)
+    engine = alerts.AlertEngine(rules=load_rules(args, alerts),
+                                log_path=args.log)
+
+    rc = run_once(scraper, engine, args.as_json)
+    polls = 1
+    while args.watch and (args.iterations <= 0 or polls < args.iterations):
+        try:
+            time.sleep(args.interval)
+        except KeyboardInterrupt:
+            break
+        if not args.as_json:
+            print()
+        rc = run_once(scraper, engine, args.as_json)
+        polls += 1
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,9 +36,11 @@ _NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
 _BACKTICK_RE = re.compile(r"`([a-z][a-z0-9_]*)(?:\{[^}`]*\})?`")
 
 #: Recognized unit suffixes.  Deliberately short: extend it here (and in the
-#: README catalogue) rather than minting one-off unit spellings.
+#: README catalogue) rather than minting one-off unit spellings.  ``_up`` is
+#: the Prometheus liveness-boolean convention (the scraper's
+#: ``scrape_target_up{target}`` mirrors Prometheus' own ``up`` series).
 UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio", "_per_second",
-                 "_depth", "_slots", "_step", "_count", "_value")
+                 "_depth", "_slots", "_step", "_count", "_value", "_up")
 
 
 def documented_names(readme_path: str) -> set[str]:
