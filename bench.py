@@ -978,6 +978,78 @@ def _bench_alerting(on_accel):
     }
 
 
+def _bench_tracing(on_accel):
+    """Request-tracing cost guard (ISSUE 8): per-request overhead of the
+    full traced lifecycle (start -> queue_wait -> admission span -> 4
+    prefill-chunk spans -> coalesced decode summary -> end + tail-sample
+    offer) in three modes — enabled-and-kept, enabled-but-sampled-out,
+    and observability disabled — next to obs_overhead_us_per_step, so the
+    forensic plane can't quietly grow into a hot-path tax.  Host-side by
+    construction: runs on CPU too."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import tracing
+
+    n = 4000 if on_accel else 1500
+    hist = obs.metrics.MetricRegistry().histogram(
+        "bench_trace_seconds", "synthetic")
+
+    def lifecycle(tracer):
+        t = tracer.start_trace("llm_request", prompt_tokens=128,
+                               max_new_tokens=32)
+        t.add_span("queue_wait", duration_s=1e-4)
+        adm = t.span("admission", slot=0, episode=1,
+                     cached_tokens=64).open()
+        for i in range(4):
+            with t.span("llm_prefill_chunk", index=i, tokens=32):
+                pass
+        adm.close()
+        t.add_span("decode", duration_s=1e-3, ticks=32, tokens=32)
+        hist.observe(1e-3, exemplar=t.trace_id or None)
+        t.end("ok", generated_tokens=32)
+
+    def window(tracer, reps):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            lifecycle(tracer)
+        return (time.perf_counter() - t0) / reps
+
+    def baseline_window(reps):
+        # the same loop shape with NO tracer calls: what "tracing absent"
+        # costs, the disabled mode's comparison floor
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            hist.observe(1e-3)
+        return (time.perf_counter() - t0) / reps
+
+    out = {}
+    try:
+        obs.enable()
+        kept = tracing.Tracer(store=tracing.TraceStore(
+            capacity=64, sample_every=1))
+        sampled_out = tracing.Tracer(store=tracing.TraceStore(
+            capacity=64, sample_every=0))  # healthy traces all dropped
+        med = lambda xs: sorted(xs)[len(xs) // 2]  # noqa: E731
+        kept_s, samp_s, dis_s, base_s = [], [], [], []
+        for _ in range(3):  # interleaved medians, like _bench_observability
+            obs.enable()
+            kept_s.append(window(kept, n))
+            samp_s.append(window(sampled_out, n))
+            base_s.append(baseline_window(n))
+            obs.disable()
+            dis_s.append(window(kept, n))
+        out["trace_overhead_us_per_request_enabled"] = round(
+            med(kept_s) * 1e6, 3)
+        out["trace_overhead_us_per_request_sampled_out"] = round(
+            med(samp_s) * 1e6, 3)
+        out["trace_overhead_us_per_request_disabled"] = round(
+            med(dis_s) * 1e6, 3)
+        out["trace_overhead_us_per_request_baseline"] = round(
+            med(base_s) * 1e6, 3)
+    finally:
+        obs.enable()
+    return out
+
+
 def main():
     import jax
 
@@ -1010,7 +1082,8 @@ def main():
                     (_bench_vit, "vit"),
                     (_bench_ocr, "ocr"),
                     (_bench_observability, "observability"),
-                    (_bench_alerting, "alerting")):
+                    (_bench_alerting, "alerting"),
+                    (_bench_tracing, "tracing")):
         if time.monotonic() > deadline:
             out[f"{tag}_skipped"] = "bench budget exhausted"
             continue

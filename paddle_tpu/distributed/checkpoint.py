@@ -226,11 +226,16 @@ def _step_dir(path, step):
     return os.path.join(path, f"step_{int(step):010d}") if step is not None else path
 
 
-def save_state(path, state, step=None, process_index=None, process_count=None):
+def save_state(path, state, step=None, process_index=None,
+               process_count=None, trace=None):
     """Write `state` (a pytree of arrays) as a sharded checkpoint
     (instrumented: `checkpoint_save_duration_seconds` + a span in the
-    chrome trace; the body is `_save_state_impl`)."""
-    with _span("checkpoint_save", _M_SAVE_SECONDS):
+    chrome trace; the body is `_save_state_impl`).  ``trace`` (a
+    ``observability.tracing.Trace``) additionally lands the save as a
+    span in that request/run trace and attaches the trace id to the
+    duration histogram as an OpenMetrics exemplar."""
+    with _span("checkpoint_save", _M_SAVE_SECONDS, trace=trace,
+               attrs={"step": step} if step is not None else None):
         ckpt = _save_state_impl(path, state, step=step,
                                 process_index=process_index,
                                 process_count=process_count)
@@ -544,7 +549,7 @@ def _assemble(entry, req_slices, vols):
 
 
 def load_state(path, step=None, shardings=None, template=None, verify=True,
-               return_step=False):
+               return_step=False, trace=None):
     """Load a checkpoint, resharding each leaf onto a new mesh if asked.
 
     ``shardings`` may be: None (leaves come back as host jnp arrays), a pytree
@@ -569,7 +574,8 @@ def load_state(path, step=None, shardings=None, template=None, verify=True,
     for s in candidates:
         ckpt = _step_dir(path, s)
         try:
-            with _span("checkpoint_load", _M_LOAD_SECONDS):
+            with _span("checkpoint_load", _M_LOAD_SECONDS, trace=trace,
+                       attrs={"step": s} if s is not None else None):
                 state = _load_from_dir(ckpt, shardings, verify)
             _M_LOADS.inc()
             return (state, s) if return_step else state
@@ -702,14 +708,14 @@ class CheckpointManager:
     def should_save(self, step):
         return step % self.save_interval == 0
 
-    def save(self, step, state, force=False):
+    def save(self, step, state, force=False, trace=None):
         from .fault_tolerance import retry_call
 
         if not force and not self.should_save(step):
             return None
         try:
             ckpt = retry_call(save_state, self.path, state, step=step,
-                              policy=self.retry)
+                              policy=self.retry, trace=trace)
         except Exception:
             _M_SAVE_FAILURES.inc()
             raise
@@ -750,9 +756,10 @@ class CheckpointManager:
     def latest_step(self):
         return latest_step(self.path)
 
-    def restore(self, step=None, shardings=None, return_step=False):
+    def restore(self, step=None, shardings=None, return_step=False,
+                trace=None):
         return load_state(self.path, step=step, shardings=shardings,
-                          return_step=return_step)
+                          return_step=return_step, trace=trace)
 
 
 # --------------------------------------------------- train-state convenience

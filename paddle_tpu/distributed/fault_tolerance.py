@@ -32,6 +32,7 @@ import time
 
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _obs
+from ..observability import tracing as _tracing
 from ..observability.spans import span as _span
 
 __all__ = [
@@ -196,6 +197,16 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
     self-throttles (``AlertPolicy.min_interval_s``, default 15 s), so
     per-step polling never puts a fleet HTTP scrape on the hot path;
     ``alert_every`` additionally coarsens by step count.
+
+    Request-scoped tracing: the whole supervised run is ONE trace
+    (``run_with_recovery``) — an ``episode`` span per restart attempt
+    (the restart's error and start step as attributes), each checkpoint
+    save/load nested inside it, a ``restore`` span per recovery, and the
+    steps between saves coalesced into bounded ``steps`` summary spans.
+    Restart episodes keep the trace in the tail sampler (any restart is a
+    keep), flight events carry its ``trace_id``, and the checkpoint
+    histograms carry it as an exemplar — the crash dump's sibling
+    ``traces_*.json`` holds the run's causal timeline.
     """
     recoverable = tuple(recoverable)
     if flight_recorder_dir is None:
@@ -229,10 +240,40 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
         server.start()
     restarts = 0
     dumped_exc = [None]  # the exception the inner handler already dumped
+    tr = _tracing.start_trace("run_with_recovery", num_steps=int(num_steps))
+    # per-restart-attempt "episode" span, held open across the step loop;
+    # steps coalesce into bounded "steps" summary spans inside it
+    ep = {"span": None, "index": 0, "steps": 0, "t0": None}
+
+    def _open_episode(start_step):
+        ep["index"] += 1
+        ep["span"] = tr.span("episode", index=ep["index"],
+                             start_step=int(start_step)).open()
+        ep["steps"] = 0
+        ep["t0"] = time.perf_counter()
+
+    def _flush_steps():
+        if ep["steps"]:
+            tr.add_span("steps",
+                        duration_s=max(0.0,
+                                       time.perf_counter() - ep["t0"]),
+                        count=ep["steps"])
+        ep["steps"] = 0
+        ep["t0"] = time.perf_counter()
+
+    def _close_episode(error=None):
+        if ep["span"] is not None:
+            _flush_steps()
+            ep["span"].close(error=error)
+            ep["span"] = None
+
     try:
         if manager.latest_step() is not None:
-            completed = _restore(manager, set_state)
-            _flight.record_event("recovery_resumed", step=completed)
+            with tr.span("restore", resume=True):
+                completed = _restore(manager, set_state, trace=tr)
+            _flight.record_event("recovery_resumed", step=completed,
+                                 **({"trace_id": tr.trace_id}
+                                    if tr.trace_id else {}))
             if on_event:
                 on_event("resumed", {"step": completed})
         else:
@@ -240,19 +281,24 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
             if save_initial:
                 # without an initial snapshot, a failure before the first
                 # periodic save would leave nothing to restore
-                manager.save(0, get_state(), force=True)
+                manager.save(0, get_state(), force=True, trace=tr)
+        _open_episode(completed)
         while completed < num_steps:
             try:
                 with _span("recovery_step"):
                     step_fn(completed)
                 completed += 1
+                ep["steps"] += 1
                 last_step_mono[0] = time.monotonic()
                 # get_state() can materialize the whole train state (device
                 # -> host sync) — only pay for it on steps that save
                 if completed == num_steps:
-                    manager.save(completed, get_state(), force=True)
+                    _flush_steps()
+                    manager.save(completed, get_state(), force=True,
+                                 trace=tr)
                 elif manager.should_save(completed):
-                    manager.save(completed, get_state())
+                    _flush_steps()
+                    manager.save(completed, get_state(), trace=tr)
                 if alert_policy is not None \
                         and completed % max(1, int(alert_every)) == 0:
                     for d in alert_policy.poll():
@@ -270,22 +316,33 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
             except recoverable as e:
                 restarts += 1
                 _flight.record_event("recoverable_failure", step=completed,
-                                     restarts=restarts, error=repr(e))
+                                     restarts=restarts, error=repr(e),
+                                     **({"trace_id": tr.trace_id}
+                                        if tr.trace_id else {}))
+                _close_episode(error=repr(e))
+                tr.inc_attr("restart_episodes")
                 _dump("recoverable", step=completed, error=repr(e))
                 dumped_exc[0] = e
                 if restarts > max_restarts:
                     raise
                 _M_RESTARTS.inc()
-                completed = _restore(manager, set_state, cause=e)
+                with tr.span("restore", after=repr(e)):
+                    completed = _restore(manager, set_state, cause=e,
+                                         trace=tr)
                 _flight.record_event("recovery_restored", step=completed)
+                _open_episode(completed)
                 if on_event:
                     on_event("restored", {"step": completed, "error": e})
+        _close_episode()
+        tr.end("ok", completed=completed, restarts=restarts)
         return {"completed": completed, "restarts": restarts}
     except BaseException as e:
         # anything escaping the supervisor is fatal to THIS run — including
         # a recoverable raised outside the step loop (a Preemption landing
         # mid-restore or mid-initial-save); dump unless the inner handler
         # already dumped this very exception (restarts exhausted)
+        _close_episode(error=repr(e))
+        tr.end("error", error=repr(e), restarts=restarts)
         if e is not dumped_exc[0]:
             _flight.record_event("fatal_failure", error=repr(e))
             _dump("fatal", error=repr(e))
@@ -295,7 +352,7 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
             server.stop()
 
 
-def _restore(manager, set_state, cause=None):
+def _restore(manager, set_state, cause=None, trace=None):
     """Restore the newest valid checkpoint and return ITS step count.
 
     The loader quarantines corrupt steps and falls back, so the step
@@ -304,7 +361,7 @@ def _restore(manager, set_state, cause=None):
     latest_step() can still name a newer step when the fallback was for a
     transient, non-quarantinable reason)."""
     try:
-        state, step = manager.restore(return_step=True)
+        state, step = manager.restore(return_step=True, trace=trace)
     except Exception as e:
         # chain from the RESTORE failure (it carries the diagnosis: which
         # step, which digest); the triggering failure rides in the message

@@ -67,6 +67,7 @@ from ..autograd import tape
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _obs
 from ..observability import slo as _slo
+from ..observability import tracing as _tracing
 from ..observability.spans import span as _span
 from ..tensor.tensor import Tensor
 
@@ -144,6 +145,18 @@ _M_PREFIX_EVICT = _obs.counter(
 _SLO_SERIES = {"ttft": "llm_ttft", "e2e": "llm_e2e",
                "queue_wait": "llm_queue_wait", "tick": "llm_tick"}
 
+#: Decode ticks coalesce into ONE trace summary span per this many ticks
+#: (and per admission episode) — a 10k-token decode contributes a bounded
+#: handful of spans to its request trace, never 10k.
+_DECODE_SPAN_TICKS = 256
+
+
+def _trace_kv(req):
+    """``{"trace_id": ...}`` for flight-recorder correlation, or ``{}``
+    when tracing is off (the NULL trace's id is empty)."""
+    tid = req.trace.trace_id
+    return {"trace_id": tid} if tid else {}
+
 
 class ServerOverloadedError(RuntimeError):
     """Admission queue full: the request was rejected (load shedding) rather
@@ -195,6 +208,18 @@ class _Request:
     tokens: list = field(default_factory=list)
     submit_ts: float | None = None  # engine-clock stamps for the latency
     admit_ts: float | None = None   # histograms (queue wait / TTFT / e2e)
+    # ---- request-scoped tracing (observability.tracing): the trace IS
+    # the explicit context object — it rides on the request, never in a
+    # thread-local the jitted paths could see
+    trace: object = _tracing.NULL_TRACE
+    adm_span: object = None         # open "admission" span handle, held
+                                    # across prefill-chunk ticks
+    adm_episode: int = 0            # admission attempts (requeues re-admit)
+    requeue_reason: str | None = None  # why the LAST requeue happened —
+                                    # stamped on the next admission span
+    dec_ticks: int = 0              # coalesced decode-summary window
+    dec_tokens: int = 0
+    dec_t0: float | None = None
 
 
 def _select_rows(logits, key, do_sample, temperature, top_p):
@@ -221,7 +246,7 @@ class LLMEngine:
                  page_size=128, num_pages=None, prefill_chunk=None,
                  prefix_cache=None, metrics_port=None, slo_targets=None,
                  flight_recorder_dir=None, healthy_heartbeat_age=60.0,
-                 alert_rules=None):
+                 alert_rules=None, tracer=None):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
         scheduling lever for high-latency hosts.  Slots that finish
@@ -275,7 +300,20 @@ class LLMEngine:
         rule set served on `/alertz` — each GET evaluates the engine
         against the local registry, so an external scraper polling
         `/alertz` gets current burn-rate / queue-backlog / healthcheck
-        alert state without this process running its own evaluation loop."""
+        alert state without this process running its own evaluation loop.
+
+        Request tracing (README §Observability, "Request tracing"): every
+        request gets a per-request span tree — queue wait, each admission
+        episode (with prefix-cache hit and requeue-reason attributes),
+        every prefill chunk, coalesced decode summaries — tail-sampled
+        into ``tracer.store`` (default: the process-global
+        ``observability.tracing.TRACER``) and served on the exporter's
+        `/tracez`.  The TTFT / e2e / queue-wait histograms carry the
+        trace id as an OpenMetrics exemplar, and every flight-recorder
+        event of the request carries it as ``trace_id`` — the aggregate
+        planes point back at the exact request.  ``tracer=`` injects a
+        private ``tracing.Tracer`` (its own store/sampling) for tests or
+        per-engine isolation."""
         cfg = model.config
         self.model = model
         self.n_slots = int(max_batch_slots)
@@ -422,6 +460,7 @@ class LLMEngine:
         self._pump_heartbeat = None  # monotonic stamp of the last pump turn
         self._first_tick_done = False
         self.healthy_heartbeat_age = float(healthy_heartbeat_age)
+        self._tracer = tracer if tracer is not None else _tracing.TRACER
         self.telemetry = None
         self.alert_engine = None
         if metrics_port is not None:
@@ -431,7 +470,7 @@ class LLMEngine:
             self.alert_engine = AlertEngine(rules=alert_rules)
             self.telemetry = TelemetryServer(
                 port=metrics_port, recorder=_flight.RECORDER,
-                alerts=self.alert_engine)
+                alerts=self.alert_engine, traces=self._tracer)
             self.telemetry.register_healthcheck("pump", self._check_pump)
             self.telemetry.register_healthcheck(
                 "pump_heartbeat", self._check_heartbeat)
@@ -515,7 +554,10 @@ class LLMEngine:
                        temperature=float(temperature), top_p=float(top_p),
                        deadline=(now + float(timeout))
                        if timeout is not None else None,
-                       submit_ts=now)
+                       submit_ts=now,
+                       trace=self._tracer.start_trace(
+                           "llm_request", prompt_tokens=int(arr.size),
+                           max_new_tokens=int(max_new_tokens)))
         try:
             if self.max_queue_len is not None and self.max_queue_len <= 0:
                 raise queue.Full
@@ -523,7 +565,8 @@ class LLMEngine:
         except queue.Full:
             _M_SHED.inc()
             _flight.record_event("shed", queue_len=self.max_queue_len,
-                                 prompt_len=int(arr.size))
+                                 prompt_len=int(arr.size), **_trace_kv(req))
+            req.trace.end(status="shed", reason="queue_full")
             raise ServerOverloadedError(
                 f"admission queue full ({self.max_queue_len} pending "
                 f"requests); request rejected — retry with backoff") from None
@@ -536,6 +579,7 @@ class LLMEngine:
             exc = RuntimeError("LLMEngine pump thread died; restart the "
                                "engine")
             _fail_future(req.future, exc)
+            req.trace.end(status="error", error="pump died during submit")
             raise exc from self._pump_error
         if self._stop or self._stop_epoch != epoch:
             # stop() ran (or is running) concurrently with this submit: its
@@ -544,6 +588,7 @@ class LLMEngine:
             exc = RuntimeError("LLMEngine stopped while the request was "
                                "being submitted; resubmit")
             _fail_future(req.future, exc)
+            req.trace.end(status="error", error="stopped during submit")
             raise exc
         return req.future
 
@@ -624,6 +669,9 @@ class LLMEngine:
             # sliding-window percentiles + burn rates (observability.slo);
             # like the registry series these are process-global
             "slo": _slo.summary(prefix="llm_"),
+            # tracer sampling health (started/sampled/dropped + store
+            # occupancy) — fleetwatch's view of whether /tracez is useful
+            "tracing": self._tracer.stats(),
             "telemetry_url": self.telemetry.url
             if self.telemetry is not None else None,
         }
@@ -687,11 +735,16 @@ class LLMEngine:
             self._pump_error = e    # callers blocked on future.result()
             _M_WATCHDOG.inc()
             _flight.record_event("watchdog_trip", error=repr(e))
-            # best-effort black box; safe_dump never masks the pump's crash
-            _flight.safe_dump(self._flight_dir, reason="watchdog_trip",
-                              extra={"error": repr(e)})
-            self._fail_pending(RuntimeError(
-                f"LLMEngine pump thread died: {e!r}"))
+            try:
+                # fail (and trace-end) the in-flight requests BEFORE the
+                # dump: the black box's sibling traces_*.json then holds
+                # the dying requests' span trees, not just their events
+                self._fail_pending(RuntimeError(
+                    f"LLMEngine pump thread died: {e!r}"))
+            finally:
+                # best-effort black box; safe_dump never masks the crash
+                _flight.safe_dump(self._flight_dir, reason="watchdog_trip",
+                                  extra={"error": repr(e)})
 
     def _drain_queue(self, exc):
         """Fail every QUEUED request (the queue has its own mutex — safe
@@ -702,6 +755,7 @@ class LLMEngine:
             except queue.Empty:
                 break
             _fail_future(req.future, exc)
+            self._end_trace(req, "error", error=repr(exc))
 
     def _fail_pending(self, exc):
         """Fail every queued and in-flight request with `exc`.  Takes the
@@ -715,12 +769,71 @@ class LLMEngine:
                 self._prefilling = None
                 self._release_pages(slot)
                 _fail_future(req.future, exc)
+                self._end_trace(req, "error", error=repr(exc))
             for i, req in enumerate(self.slot_req):
                 if req is not None:
                     self.slot_req[i] = None
                     self.last_token[i] = self.pad
                     self._release_pages(i)
                     _fail_future(req.future, exc)
+                    self._end_trace(req, "error", error=repr(exc))
+
+    # --------------------------------------------------- request tracing
+
+    def _flush_decode_span(self, req):
+        """Close the request's current coalesced decode window into ONE
+        summary span (ticks + tokens attributes) — called at the window
+        bound, at finish, and before any requeue/expiry, so a trace holds
+        a bounded number of decode spans no matter how long it decoded."""
+        if req.dec_ticks:
+            req.trace.add_span(
+                "decode",
+                duration_s=max(0.0, time.perf_counter() - req.dec_t0),
+                ticks=int(req.dec_ticks), tokens=int(req.dec_tokens))
+        req.dec_ticks = 0
+        req.dec_tokens = 0
+        req.dec_t0 = None
+
+    def _end_trace(self, req, status, **attrs):
+        """Terminal trace bookkeeping for a request leaving the engine:
+        flush the decode window, close a dangling admission span, end the
+        trace and hand it to the tail sampler (idempotent)."""
+        self._flush_decode_span(req)
+        if req.adm_span is not None:
+            req.adm_span.close(error=None if status == "ok" else status)
+            req.adm_span = None
+        req.trace.end(status=status, generated_tokens=len(req.tokens),
+                      **attrs)
+
+    def _trace_queue_wait(self, req):
+        """First-admission queue-wait: histogram (+trace exemplar), SLO
+        verdict onto the trace, queue_wait span — shared by the dense and
+        paged admission paths so their traces cannot diverge."""
+        wait = max(0.0, req.admit_ts - req.submit_ts)
+        _M_QUEUE_WAIT.observe(wait, exemplar=req.trace.trace_id or None)
+        if _slo.track("llm_queue_wait", wait):
+            req.trace.mark_slo("llm_queue_wait")
+        req.trace.add_span("queue_wait", duration_s=wait)
+        return wait
+
+    def _open_admission_span(self, req, slot, **attrs):
+        """One "admission" span per EPISODE: a preempted/requeued request
+        re-admits under a new span carrying the requeue reason — its
+        trace shows every attempt, not just the last."""
+        req.adm_episode += 1
+        attrs = {"slot": int(slot), "episode": req.adm_episode,
+                 "prompt_tokens": int(req.prompt.size), **attrs}
+        if req.requeue_reason:
+            attrs["requeue_reason"] = req.requeue_reason
+            req.requeue_reason = None
+        req.adm_span = req.trace.span("admission", **attrs).open()
+
+    def _observe_ttft(self, req):
+        """The admission token IS the first token out (both layouts)."""
+        ttft = max(0.0, self._clock() - req.submit_ts)
+        _M_TTFT.observe(ttft, exemplar=req.trace.trace_id or None)
+        if _slo.track("llm_ttft", ttft):
+            req.trace.mark_slo("llm_ttft")
 
     # --------------------------------------------------------- internals
 
@@ -764,12 +877,15 @@ class LLMEngine:
             except queue.Empty:
                 break
             if req.future.done():
-                continue  # cancelled by the caller, or failed by a
-                          # pump-death race — don't waste a slot on it
+                # cancelled by the caller, or failed by a pump-death race
+                # — don't waste a slot on it
+                self._end_trace(req, "cancelled")
+                continue
             if req.deadline is not None and self._clock() > req.deadline:
                 _M_EXPIRED.labels(where="queued").inc()
                 _fail_future(req.future, DeadlineExceededError(
                     "request deadline expired while queued for admission"))
+                self._end_trace(req, "expired", where="queued")
                 continue
             slot = free.pop(0)
             try:
@@ -778,6 +894,7 @@ class LLMEngine:
                 self.slot_req[slot] = None
                 free.insert(0, slot)
                 _fail_future(req.future, e)
+                self._end_trace(req, "error", error=repr(e))
                 if not self._caches_alive():
                     # the slot writer donates self.caches (see
                     # _prefill_tick): a consumed-buffer failure is
@@ -787,11 +904,10 @@ class LLMEngine:
     def _admit_one(self, req, slot):
         req.admit_ts = self._clock()
         if req.submit_ts is not None:
-            wait = max(0.0, req.admit_ts - req.submit_ts)
-            _M_QUEUE_WAIT.observe(wait)
-            _slo.track("llm_queue_wait", wait)
+            self._trace_queue_wait(req)
         n = req.prompt.size
         Lb = self._bucket(n)
+        self._open_admission_span(req, slot, bucket=int(Lb))
         padded = np.full((1, Lb), self.pad, np.int32)
         padded[0, :n] = req.prompt
         logits, kvs = self._get_prefill(Lb)(
@@ -808,11 +924,10 @@ class LLMEngine:
         self.slot_pos[slot] = n
         self.last_token[slot] = tok
         _M_ADMITTED.inc()
+        req.adm_span.close()
+        req.adm_span = None
         if req.submit_ts is not None:
-            # the prefill's token IS the first token out
-            ttft = max(0.0, self._clock() - req.submit_ts)
-            _M_TTFT.observe(ttft)
-            _slo.track("llm_ttft", ttft)
+            self._observe_ttft(req)
         if tok == self.eos or req.max_new_tokens <= 1:
             self._finish(slot)
 
@@ -991,6 +1106,9 @@ class LLMEngine:
             self._decref(old)
             _M_COW.inc()
             self._cow_copies += 1
+            r = self._req_for_slot(slot)
+            if r is not None:  # the fork is part of the request's story
+                r.trace.inc_attr("cow_forks")
             return True
         if int(self._page_ref[old]) == 2 and self._page_cached[old] \
                 and self._prefix is not None \
@@ -1004,6 +1122,15 @@ class LLMEngine:
             self._prefix_epoch += 1
             return True
         return False
+
+    def _req_for_slot(self, slot):
+        """The request currently writing through ``slot`` — active, or
+        the one mid-chunked-prefill (its slot_req entry is still None)."""
+        r = self.slot_req[slot]
+        if r is None and self._prefilling is not None \
+                and self._prefilling[1] == slot:
+            return self._prefilling[0]
+        return r
 
     def _cache_insert(self, slot, prompt):
         """Register a freshly prefilled prompt's pages in the prefix index;
@@ -1050,9 +1177,11 @@ class LLMEngine:
         self._release_pages(slot)
         _M_PAGE_PREEMPT.inc()
         _flight.record_event("page_preemption", slot=int(slot),
-                             pages_held=int(held))
+                             pages_held=int(held),
+                             **(_trace_kv(req) if req is not None else {}))
         if req is None:
             return
+        self._flush_decode_span(req)
         if held >= self.num_pages - 1 and self._prefix is None:
             # without sharing, a slot mapping the whole pool can never fit;
             # with the prefix cache, `held` counts shared pages too, so the
@@ -1060,8 +1189,12 @@ class LLMEngine:
             _fail_future(req.future, ServerOverloadedError(
                 f"request needs more kv pages than the whole pool "
                 f"({self.num_pages - 1} pages x {self.ps} tokens); rejected"))
+            self._end_trace(req, "shed", reason="pool_exhausted",
+                            pages_held=int(held))
             return
         req.skip_cache = True
+        req.requeue_reason = "page_pool_dry"
+        req.trace.inc_attr("preempt_requeues")
         req.prompt = np.concatenate(
             [req.prompt, np.asarray(req.tokens, np.int32)])
         with self._pending.mutex:
@@ -1144,11 +1277,14 @@ class LLMEngine:
             except queue.Empty:
                 return
             if req.future.done():
-                continue  # cancelled / failed by a pump-death race
+                # cancelled / failed by a pump-death race
+                self._end_trace(req, "cancelled")
+                continue
             if req.deadline is not None and self._clock() > req.deadline:
                 _M_EXPIRED.labels(where="queued").inc()
                 _fail_future(req.future, DeadlineExceededError(
                     "request deadline expired while queued for admission"))
+                self._end_trace(req, "expired", where="queued")
                 continue
             need = -(-(req.prompt.size + 1) // self.ps)
             matched, shared = 0, []
@@ -1172,6 +1308,8 @@ class LLMEngine:
                 _fail_future(req.future, ServerOverloadedError(
                     f"prompt needs {need} kv pages but the pool only has "
                     f"{self.num_pages - 1}; rejected"))
+                self._end_trace(req, "shed", reason="pool_too_small",
+                                pages_needed=int(need))
                 continue
             slot = free[0]
             if shared:
@@ -1196,14 +1334,14 @@ class LLMEngine:
             first_admission = req.admit_ts is None
             req.admit_ts = self._clock()
             if req.submit_ts is not None and first_admission:
-                wait = max(0.0, req.admit_ts - req.submit_ts)
-                _M_QUEUE_WAIT.observe(wait)
-                _slo.track("llm_queue_wait", wait)
+                self._trace_queue_wait(req)
                 self._prefix_prompt_tokens += int(req.prompt.size)
                 self._prefix_hit_tokens += int(matched)
                 req.hit_tokens = int(matched)  # reversed if the prefill is
                 # abandoned by a COW-starvation requeue (the skipped chunks
                 # get recomputed privately, so the hit never happened)
+            self._open_admission_span(req, slot,
+                                      cached_tokens=int(matched))
             # chunked prefill starts at the first UNCACHED token — a hit
             # skips every chunk the cache already covers
             self._prefilling = (req, slot, matched)
@@ -1222,6 +1360,10 @@ class LLMEngine:
                 _fail_future(req.future, DeadlineExceededError(
                     f"request deadline exceeded after {done} prefilled "
                     "prompt tokens"))
+                self._end_trace(req, "expired", where="prefill",
+                                prefilled_tokens=int(done))
+            else:
+                self._end_trace(req, "cancelled")
             return
         n = req.prompt.size
         C = self.prefill_chunk
@@ -1240,7 +1382,12 @@ class LLMEngine:
             req.hit_tokens = 0
             _M_PAGE_PREEMPT.inc()
             _flight.record_event("page_preemption", slot=int(slot),
-                                 where="prefill_cow")
+                                 where="prefill_cow", **_trace_kv(req))
+            if req.adm_span is not None:
+                req.adm_span.close(error="cow_starved")
+                req.adm_span = None
+            req.requeue_reason = "prefill_cow"
+            req.trace.inc_attr("preempt_requeues")
             with self._pending.mutex:
                 self._pending.queue.appendleft(req)
             return
@@ -1253,7 +1400,9 @@ class LLMEngine:
         try:
             jit = self._get_chunk_prefill()
             if _obs.enabled():
-                with _span("llm_prefill_chunk", _M_PREFILL_CHUNK_S):
+                with _span("llm_prefill_chunk", _M_PREFILL_CHUNK_S,
+                           trace=req.trace,
+                           attrs={"index": done // C, "tokens": int(m)}):
                     logits, self.caches = jit(*args)
             else:
                 logits, self.caches = jit(*args)
@@ -1261,6 +1410,7 @@ class LLMEngine:
             self._prefilling = None
             self._release_pages(slot)
             _fail_future(req.future, e)
+            self._end_trace(req, "error", error=repr(e))
             if not self._caches_alive():
                 # the chunk call DONATES self.caches: an execution-time
                 # failure may have consumed the buffers, and serving on
@@ -1287,11 +1437,11 @@ class LLMEngine:
         self.slot_pos[slot] = n
         self.last_token[slot] = tok
         _M_ADMITTED.inc()
+        if req.adm_span is not None:
+            req.adm_span.close()
+            req.adm_span = None
         if first and req.submit_ts is not None:
-            # the final chunk's token IS the first token out
-            ttft = max(0.0, self._clock() - req.submit_ts)
-            _M_TTFT.observe(ttft)
-            _slo.track("llm_ttft", ttft)
+            self._observe_ttft(req)
         if tok == self.eos or len(req.tokens) >= req.max_new_tokens:
             self._finish(slot)
 
@@ -1510,6 +1660,16 @@ class LLMEngine:
         # rebuilds the per-slot positions (finished slots do not advance)
         self.caches = new_caches
         nxt = np.asarray(nxt_dev).astype(np.int32)  # [B, eff]
+        if _obs.enabled():
+            # per-request decode accounting for the coalesced trace
+            # summary spans: one stamp per tick, not per token
+            now_pc = time.perf_counter()
+            for i in active:
+                r = self.slot_req[i]
+                if r is not None:
+                    if r.dec_t0 is None:
+                        r.dec_t0 = now_pc
+                    r.dec_ticks += 1
         emitted = 0
         for j in range(eff):
             for i in list(active):
@@ -1518,6 +1678,7 @@ class LLMEngine:
                     continue  # finished earlier in this chunk: surplus
                 tok = int(nxt[i, j])
                 req.tokens.append(tok)
+                req.dec_tokens += 1
                 self.last_token[i] = tok
                 self.slot_pos[i] += 1
                 emitted += 1
@@ -1526,6 +1687,10 @@ class LLMEngine:
                         or self.slot_pos[i] >= self.L - 1)
                 if done:
                     self._finish(i)
+        for i in active:
+            req = self.slot_req[i]
+            if req is not None and req.dec_ticks >= _DECODE_SPAN_TICKS:
+                self._flush_decode_span(req)  # bound spans per episode
         # inactive slots scatter garbage k/v at their stale position during
         # the shared step — harmless: a decode WRITES row `pos` before any
         # read past it, and admission rewrites rows [0, bucket) wholesale
@@ -1542,12 +1707,12 @@ class LLMEngine:
         never be used on this queue — the engine doesn't.)"""
         now = self._clock()
         expired = []
-        evicted = False
+        evicted = []
         with self._pending.mutex:
             keep = []
             for req in self._pending.queue:
                 if req.future.done():  # cancelled/failed: just drop it
-                    evicted = True
+                    evicted.append(req)
                 elif req.deadline is not None and now > req.deadline:
                     expired.append(req)
                 else:
@@ -1556,11 +1721,15 @@ class LLMEngine:
                 self._pending.queue.clear()
                 self._pending.queue.extend(keep)
                 self._pending.not_full.notify_all()
+        for req in evicted:
+            self._end_trace(req, "cancelled")
         for req in expired:
             _M_EXPIRED.labels(where="queued").inc()
-            _flight.record_event("deadline_expiry", where="queued")
+            _flight.record_event("deadline_expiry", where="queued",
+                                 **_trace_kv(req))
             _fail_future(req.future, DeadlineExceededError(
                 "request deadline expired while queued for admission"))
+            self._end_trace(req, "expired", where="queued")
 
     def _expire_slots(self):
         """Fail and free any in-flight slot whose deadline has passed —
@@ -1573,10 +1742,12 @@ class LLMEngine:
                 self._release_pages(i)
                 _M_EXPIRED.labels(where="inflight").inc()
                 _flight.record_event("deadline_expiry", where="inflight",
-                                     slot=int(i), tokens=len(req.tokens))
+                                     slot=int(i), tokens=len(req.tokens),
+                                     **_trace_kv(req))
                 _fail_future(req.future, DeadlineExceededError(
                     f"request deadline exceeded after "
                     f"{len(req.tokens)} generated tokens"))
+                self._end_trace(req, "expired", where="inflight")
 
     def _finish(self, slot):
         req = self.slot_req[slot]
@@ -1587,6 +1758,8 @@ class LLMEngine:
             _M_COMPLETED.inc()
             if req.submit_ts is not None:
                 e2e = max(0.0, self._clock() - req.submit_ts)
-                _M_E2E.observe(e2e)
-                _slo.track("llm_e2e", e2e)
+                _M_E2E.observe(e2e, exemplar=req.trace.trace_id or None)
+                if _slo.track("llm_e2e", e2e):
+                    req.trace.mark_slo("llm_e2e")
+            self._end_trace(req, "ok")
             _complete_future(req.future, list(req.tokens))

@@ -36,6 +36,15 @@ first CONSUMER of the endpoints:
   ``run_with_recovery`` / ``ElasticManager`` restart decisions off the
   scraped series (``tools/fleetwatch.py`` is the operator CLI).
 
+And orthogonal to the aggregate planes, the forensic plane (ISSUE 8):
+
+- `observability.tracing` — request-scoped tracing: per-request span
+  trees carried by an explicit context object, tail-sampled into a
+  bounded store served on ``TelemetryServer`` `/tracez`, correlated to
+  the aggregate planes via flight-recorder ``trace_id`` fields and
+  OpenMetrics histogram EXEMPLARS (``# {trace_id="..."}`` annotations on
+  `/metrics` that ``parse_prometheus`` round-trips).
+
 Quick start::
 
     import paddle_tpu as paddle
@@ -64,6 +73,10 @@ from .scrape import (  # noqa: F401
 )
 from .alerts import (  # noqa: F401
     Rule, AlertEngine, AlertPolicy, AlertDecision, default_rules,
+    JsonlNotifier,
+)
+from .tracing import (  # noqa: F401
+    Trace, Tracer, TraceStore, TRACES, TRACER, NULL_TRACE, start_trace,
 )
 from . import metrics  # noqa: F401
 from . import spans  # noqa: F401
@@ -72,6 +85,7 @@ from . import exporter  # noqa: F401
 from . import slo  # noqa: F401
 from . import scrape  # noqa: F401
 from . import alerts  # noqa: F401
+from . import tracing  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
@@ -83,5 +97,7 @@ __all__ = [
     "SLOTracker", "SLORegistry", "SLOS", "slo",
     "parse_prometheus", "SampleSet", "Scraper", "ScrapeTarget", "scrape",
     "Rule", "AlertEngine", "AlertPolicy", "AlertDecision", "default_rules",
-    "alerts",
+    "JsonlNotifier", "alerts",
+    "Trace", "Tracer", "TraceStore", "TRACES", "TRACER", "NULL_TRACE",
+    "start_trace", "tracing",
 ]

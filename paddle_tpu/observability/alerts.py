@@ -64,6 +64,7 @@ from .scrape import SampleSet
 
 __all__ = [
     "Rule", "AlertEngine", "AlertPolicy", "AlertDecision", "default_rules",
+    "JsonlNotifier",
     "STATE_INACTIVE", "STATE_RESOLVED", "STATE_PENDING", "STATE_FIRING",
     "STATE_VALUES", "ACTIONS",
 ]
@@ -106,6 +107,12 @@ _M_ACTIONS = _metrics.counter(
     "alert_actions_total",
     "Actuation decisions emitted by AlertPolicy, by action",
     labelnames=("alert", "action"))
+_M_NOTIFY = _metrics.counter(
+    "alert_notifications_total",
+    "Alert state transitions shipped through the notify hook")
+_M_NOTIFY_FAIL = _metrics.counter(
+    "alert_notify_failures_total",
+    "notify-hook deliveries that raised (transition kept, not retried)")
 
 
 class Rule:
@@ -248,7 +255,16 @@ class AlertEngine:
     """
 
     def __init__(self, rules=None, clock=time.monotonic, log_path=None,
-                 recorder=None, registry=None):
+                 recorder=None, registry=None, notify=None):
+        """``notify`` — the push-style transition shipper: a callable
+        invoked with each transition dict (now carrying any correlated
+        exemplar ``trace_ids``), or a path string (sugar for
+        :class:`JsonlNotifier`).  Runs OUTSIDE the engine lock after each
+        evaluate; a raising notifier is counted
+        (``alert_notify_failures_total``) and recorded in the flight
+        recorder, never propagated — and since transitions only exist on
+        state CHANGES, the stream is flap-safe by the same
+        one-transition-per-episode machinery the actuation path uses."""
         self.rules = list(rules if rules is not None else default_rules())
         names = [r.name for r in self.rules]
         if len(set(names)) != len(names):
@@ -257,6 +273,12 @@ class AlertEngine:
         self.log_path = log_path
         self.recorder = recorder  # None -> module-global flight recorder
         self._registry = registry
+        self.notify = JsonlNotifier(notify) if isinstance(notify, str) \
+            else notify
+        if self.notify is not None and not callable(self.notify):
+            raise ValueError(
+                f"notify must be a callable or a JSONL path, got "
+                f"{notify!r}")
         self._lock = threading.Lock()
         self._instances: dict[str, dict[tuple, _Instance]] = \
             {r.name: {} for r in self.rules}
@@ -385,7 +407,7 @@ class AlertEngine:
                     entered = self._advance(rule, inst, cond, value, now)
                     if entered is not None:
                         transitions.append(self._transition(
-                            rule, inst, entered, now))
+                            rule, inst, entered, now, samples))
                 # instances no longer matched read as condition-false and
                 # wind down instead of firing forever (for absence rules
                 # this only reaps an explicit-selector instance orphaned by
@@ -405,13 +427,35 @@ class AlertEngine:
                         del insts[key]
                         self._windows.pop((rule.name, key), None)
                 self._export_state(rule, insts)
-        # JSONL write happens OUTSIDE the engine lock: a slow disk must
-        # stall neither concurrent evaluates nor the /alertz handler
+        # JSONL write and notify shipping happen OUTSIDE the engine lock:
+        # a slow disk/webhook must stall neither concurrent evaluates nor
+        # the /alertz handler
         self._write_log(transitions)
+        self._ship(transitions)
         _M_EVAL.observe(time.perf_counter() - t0)
         return transitions
 
-    def _transition(self, rule, inst, entered, now):
+    def _exemplar_trace_ids(self, rule, labels, samples):
+        """Trace ids correlated with a firing instance, harvested from the
+        SampleSet's histogram exemplars: the rule's own metric family
+        first (a threshold on ``llm_ttft_seconds_bucket``), else the
+        instance's ``series`` label (a burn-rate rule on
+        ``slo_burn_rate_ratio{series="llm_ttft"}`` resolves to the
+        ``llm_ttft_seconds`` exemplars)."""
+        getter = getattr(samples, "exemplar_trace_ids", None)
+        if getter is None:
+            return []
+        base = rule.metric
+        for suf in ("_bucket", "_sum", "_count"):
+            if base.endswith(suf):
+                base = base[:-len(suf)]
+                break
+        ids = getter(base)
+        if not ids and labels.get("series"):
+            ids = getter(labels["series"])
+        return ids[-4:]  # the newest few; a page needs a pointer, not all
+
+    def _transition(self, rule, inst, entered, now, samples=None):
         prev = inst.state
         inst.state = entered
         inst.since = now
@@ -421,11 +465,32 @@ class AlertEngine:
                "from": prev, "to": entered, "mono": now,
                "value": inst.value, "severity": rule.severity,
                "episode": inst.episodes}
+        if entered == STATE_FIRING and samples is not None:
+            ids = self._exemplar_trace_ids(rule, inst.labels, samples)
+            if ids:
+                rec["trace_ids"] = ids
         _M_TRANSITIONS.labels(alert=rule.name, state=entered).inc()
         recorder = self.recorder if self.recorder is not None \
             else _flight.RECORDER
         recorder.record("alert_transition", **rec)
         return rec
+
+    def _ship(self, transitions):
+        """Push each transition through the notify hook (outside the
+        engine lock).  Failures are counted and black-boxed, never
+        propagated — alerting must not die with its webhook."""
+        if self.notify is None or not transitions:
+            return
+        recorder = self.recorder if self.recorder is not None \
+            else _flight.RECORDER
+        for rec in transitions:
+            try:
+                self.notify(rec)
+                _M_NOTIFY.inc()
+            except Exception as e:
+                _M_NOTIFY_FAIL.inc()
+                recorder.record("alert_notify_failed", alert=rec["alert"],
+                                to=rec["to"], error=repr(e))
 
     def _write_log(self, transitions):
         """Append transition lines to the JSONL alert log (called outside
@@ -487,6 +552,30 @@ class AlertEngine:
                                     "since": inst.since,
                                     "episode": inst.episodes})
             return out
+
+
+class JsonlNotifier:
+    """The stock notify hook: append each transition as one JSONL line —
+    the log-shipper shape (tail it into a webhook forwarder, or let a
+    collector pick the file up).  ``AlertEngine(notify="path.jsonl")`` is
+    sugar for this class."""
+
+    def __init__(self, path):
+        self.path = str(path)
+        self._lock = threading.Lock()
+
+    def __call__(self, rec):
+        # wall-clock stamp is deliberate: shipped transitions are joined
+        # with operator dashboards across hosts, which share NTP, not a
+        # boot clock (the monotonic stamp rides along in "mono")
+        stamp = time.time()  # tpulint: disable=impure-trace
+        line = json.dumps({"time": stamp, **rec}, separators=(",", ":"))
+        with self._lock:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+
+    def __repr__(self):
+        return f"JsonlNotifier({self.path!r})"
 
 
 class AlertDecision:

@@ -14,7 +14,12 @@ stdlib-only (http.server) daemon-threaded exporter any layer can opt into:
 - ``/alertz`` — the attached alert engine's rule/instance state as JSON
   (``attach_alerts``); each GET re-evaluates the engine against the local
   registry first (scrape-driven evaluation: the scraper IS the tick), so
-  the payload is always current.
+  the payload is always current;
+- ``/tracez`` — the tail-sampled request-trace store
+  (``observability.tracing``): bare GET lists trace summaries + sampler
+  stats, ``?trace_id=<id>`` fetches one full span tree as JSON, and
+  ``?trace_id=<id>&format=chrome`` exports it as a chrome://tracing
+  document — the histogram exemplars on `/metrics` resolve here.
 
 Lifecycle: ``TelemetryServer(port=0)`` binds an ephemeral port,
 ``start()`` serves from a daemon thread (a forgotten exporter can never
@@ -30,14 +35,23 @@ from __future__ import annotations
 
 import json
 import threading
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import metrics as _metrics
 
-__all__ = ["TelemetryServer", "start_exporter", "PROMETHEUS_CONTENT_TYPE"]
+__all__ = ["TelemetryServer", "start_exporter", "PROMETHEUS_CONTENT_TYPE",
+           "OPENMETRICS_CONTENT_TYPE"]
 
 #: The content type Prometheus scrapers negotiate for the text format.
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+#: Served when the scraper's Accept header asks for OpenMetrics — the
+#: only variant that carries histogram exemplar annotations (the classic
+#: 0.0.4 text format has no exemplar syntax, and a stock Prometheus
+#: parser would reject a 0.0.4 payload containing them).
+OPENMETRICS_CONTENT_TYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 _M_SCRAPES = _metrics.counter(
     "exporter_scrapes_total",
@@ -56,12 +70,14 @@ class TelemetryServer:
     """One process-local scrape endpoint over a metrics registry."""
 
     def __init__(self, port=0, host="127.0.0.1", registry=None,
-                 recorder=None, alerts=None):
+                 recorder=None, alerts=None, traces=None):
         self.host = host
         self._requested_port = int(port)
         self.registry = registry if registry is not None \
             else _metrics.REGISTRY
         self.recorder = recorder  # optional FlightRecorder for /varz
+        self.traces = traces  # Tracer or TraceStore for /tracez
+                              # (None = the process-global tracer)
         self._httpd = None
         self._thread = None
         self._checks = {}  # name -> callable() -> truthy | (ok, detail)
@@ -166,14 +182,35 @@ class TelemetryServer:
             all_ok = all_ok and ok
         return all_ok, results
 
+    def _trace_source(self):
+        """``(stats_source, store)`` — ``traces`` may be a ``Tracer``
+        (preferred: its stats include the started counter) or a bare
+        ``TraceStore``."""
+        src = self.traces
+        if src is None:
+            from . import tracing as _tracing  # lazy: avoids import cycle
+
+            src = _tracing.TRACER
+        return src, getattr(src, "store", src)
+
     # ------------------------------------------------------------ handlers
     def _handle(self, req):
-        path = req.path.split("?", 1)[0].rstrip("/") or "/"
+        path, _, query = req.path.partition("?")
+        path = path.rstrip("/") or "/"
         try:
             if path == "/metrics":
                 _M_SCRAPES.labels(endpoint="metrics").inc()
-                body = self.registry.render_prometheus().encode()
-                self._reply(req, 200, PROMETHEUS_CONTENT_TYPE, body)
+                # content negotiation: exemplars ride ONLY on the
+                # OpenMetrics variant — a 0.0.4 scraper gets clean
+                # classic text it can always parse
+                accept = req.headers.get("Accept") or ""
+                om = "application/openmetrics-text" in accept
+                text = self.registry.render_prometheus(exemplars=om)
+                if om:
+                    text += "# EOF\n"
+                self._reply(req, 200,
+                            OPENMETRICS_CONTENT_TYPE if om
+                            else PROMETHEUS_CONTENT_TYPE, text.encode())
             elif path == "/healthz":
                 _M_SCRAPES.labels(endpoint="healthz").inc()
                 ok, results = self.health()
@@ -190,8 +227,14 @@ class TelemetryServer:
                         "events": len(self.recorder),
                         "capacity": self.recorder.capacity,
                     }
+                # tracer sampling health rides on /varz so fleetwatch can
+                # see starved/overflowing trace stores without /tracez
+                varz["tracing"] = self._trace_source()[0].stats()
                 body = json.dumps(varz, default=repr).encode()
                 self._reply(req, 200, "application/json", body)
+            elif path == "/tracez":
+                _M_SCRAPES.labels(endpoint="tracez").inc()
+                self._handle_tracez(req, query)
             elif path == "/alertz":
                 _M_SCRAPES.labels(endpoint="alertz").inc()
                 if self.alerts is None:
@@ -209,7 +252,7 @@ class TelemetryServer:
                 _M_HTTP_ERRORS.inc()
                 self._reply(req, 404, "text/plain; charset=utf-8",
                             b"not found: try /metrics /healthz /varz "
-                            b"/alertz\n")
+                            b"/alertz /tracez\n")
         except BrokenPipeError:
             pass  # scraper hung up mid-reply; nothing to clean up
         except Exception:
@@ -219,6 +262,33 @@ class TelemetryServer:
                             b"internal error\n")
             except Exception:
                 pass  # socket already gone
+
+    def _handle_tracez(self, req, query):
+        """`/tracez` contract: list (``?limit=N``), fetch
+        (``?trace_id=<id>``), export (``&format=chrome``)."""
+        src, store = self._trace_source()
+        q = urllib.parse.parse_qs(query)
+        tid = (q.get("trace_id") or q.get("id") or [None])[0]
+        if tid is None:
+            try:
+                limit = int((q.get("limit") or [100])[0])
+            except ValueError:
+                limit = 100
+            doc = {"stats": src.stats(), "traces": store.list(limit=limit)}
+            self._reply(req, 200, "application/json",
+                        json.dumps(doc, default=repr).encode())
+            return
+        trace = store.get_trace(tid)
+        if trace is None:
+            self._reply(req, 404, "application/json", json.dumps(
+                {"error": f"unknown trace_id {tid!r} (expired from the "
+                          f"bounded store, or never sampled)"}).encode())
+            return
+        fmt = (q.get("format") or ["json"])[0]
+        doc = trace.to_chrome_trace() if fmt == "chrome" \
+            else trace.to_dict()
+        self._reply(req, 200, "application/json",
+                    json.dumps(doc, default=repr).encode())
 
     @staticmethod
     def _reply(req, code, ctype, body):

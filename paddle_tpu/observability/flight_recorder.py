@@ -38,8 +38,19 @@ from . import metrics as _metrics
 
 __all__ = [
     "FlightRecorder", "RECORDER", "record_event", "dump", "safe_dump",
-    "events", "clear",
+    "events", "clear", "register_sibling_dump",
 ]
+
+#: Best-effort sibling writers invoked after every dump() with
+#: ``(directory, reason_slug, dumpno)`` — how the trace store lands its
+#: ``traces_<reason>_*.json`` next to each black box without this module
+#: importing it (tracing imports flight_recorder, not the reverse).
+_SIBLING_DUMPERS: list = []
+
+
+def register_sibling_dump(fn):
+    _SIBLING_DUMPERS.append(fn)
+    return fn
 
 _M_EVENTS = _metrics.counter(
     "flight_recorder_events_total",
@@ -140,6 +151,11 @@ class FlightRecorder:
             if doc is not None:
                 with open(path[:-len(".jsonl")] + ".trace.json", "w") as f:
                     f.write(doc)
+        for hook in list(_SIBLING_DUMPERS):
+            try:
+                hook(directory, _slug(reason), dumpno)
+            except Exception:
+                pass  # a sibling writer must never break the black box
         _M_DUMPS.labels(reason=_slug(reason)).inc()
         return path
 

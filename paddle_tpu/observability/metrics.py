@@ -167,7 +167,8 @@ class _GaugeChild:
 
 
 class _HistogramChild:
-    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock")
+    __slots__ = ("_bounds", "_counts", "_sum", "_count", "_lock",
+                 "_exemplars")
 
     def __init__(self, bounds):
         self._bounds = bounds
@@ -175,8 +176,15 @@ class _HistogramChild:
         self._sum = 0.0
         self._count = 0
         self._lock = threading.Lock()
+        # bucket index -> (value, labels): the OpenMetrics exemplar of the
+        # bucket, linking an aggregate latency to one concrete trace
+        self._exemplars: dict[int, tuple] = {}
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
+        """``exemplar`` (a trace id string, or a label dict) attaches an
+        OpenMetrics exemplar to the bucket the observation lands in; each
+        bucket retains its WORST exemplar (highest value; ties go to the
+        newest) — the one a latency investigation wants first."""
         if not _runtime["enabled"]:
             return
         v = float(value)
@@ -185,6 +193,25 @@ class _HistogramChild:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            if exemplar is not None:
+                prev = self._exemplars.get(i)
+                if prev is None or v >= prev[0]:
+                    labels = ({str(k): str(lv) for k, lv in exemplar.items()}
+                              if isinstance(exemplar, dict)
+                              else {"trace_id": str(exemplar)})
+                    self._exemplars[i] = (v, labels)
+
+    def exemplars(self):
+        """``{le_string: {"labels": {...}, "value": v}}`` per bucket that
+        holds one (keys match ``bucket_counts()`` / the exposition ``le``
+        strings)."""
+        with self._lock:
+            items = dict(self._exemplars)
+        out = {}
+        for i, (v, labels) in items.items():
+            b = self._bounds[i] if i < len(self._bounds) else math.inf
+            out[_fmt(b)] = {"labels": dict(labels), "value": v}
+        return out
 
     @property
     def sum(self):
@@ -323,10 +350,10 @@ class Histogram(_Metric):
     def _make_child(self):
         return _HistogramChild(self.buckets)
 
-    def observe(self, value):
+    def observe(self, value, exemplar=None):
         if not _runtime["enabled"]:
             return
-        self._solo().observe(value)
+        self._solo().observe(value, exemplar=exemplar)
 
     @property
     def sum(self):
@@ -420,18 +447,28 @@ class MetricRegistry:
             for lv, child in m.series():
                 labels = dict(zip(m.labelnames, lv))
                 if m.kind == "histogram":
-                    series.append({"labels": labels, "sum": child.sum,
-                                   "count": child.count,
-                                   "buckets": {_fmt(b): c for b, c in
-                                               child.bucket_counts().items()}})
+                    entry = {"labels": labels, "sum": child.sum,
+                             "count": child.count,
+                             "buckets": {_fmt(b): c for b, c in
+                                         child.bucket_counts().items()}}
+                    ex = child.exemplars()
+                    if ex:  # present only when set, so parse() round-trips
+                        entry["exemplars"] = ex
+                    series.append(entry)
                 else:
                     series.append({"labels": labels, "value": child.value})
             out[m.name] = {"kind": m.kind, "help": m.help, "series": series}
         return out
 
-    def render_prometheus(self) -> str:
+    def render_prometheus(self, exemplars=True) -> str:
         """Prometheus/OpenMetrics text exposition — the `/metrics` payload
-        (serve it from any HTTP handler; nothing here binds a socket)."""
+        (serve it from any HTTP handler; nothing here binds a socket).
+
+        ``exemplars=False`` suppresses the OpenMetrics-style
+        ``# {trace_id="..."}`` bucket annotations: the classic
+        ``text/plain; version=0.0.4`` format has no exemplar syntax, so
+        the exporter only includes them for scrapers that negotiate the
+        OpenMetrics content type (the built-in fleet ``Scraper`` does)."""
         lines = []
         for m in self:
             if m.help:
@@ -441,10 +478,18 @@ class MetricRegistry:
             lines.append(f"# TYPE {m.name} {m.kind}")
             for lv, child in m.series():
                 if m.kind == "histogram":
+                    ex = child.exemplars() if exemplars else {}
                     for b, c in child.bucket_counts().items():
-                        ls = _labelstr(m.labelnames + ("le",),
-                                       lv + (_fmt(b),))
-                        lines.append(f"{m.name}_bucket{ls} {c}")
+                        le = _fmt(b)
+                        ls = _labelstr(m.labelnames + ("le",), lv + (le,))
+                        line = f"{m.name}_bucket{ls} {c}"
+                        e = ex.get(le)
+                        if e:  # OpenMetrics exemplar annotation: the
+                            # bucket's worst correlated trace
+                            els = _labelstr(tuple(e["labels"]),
+                                            tuple(e["labels"].values()))
+                            line += f" # {els} {_fmt(e['value'])}"
+                        lines.append(line)
                     ls = _labelstr(m.labelnames, lv)
                     lines.append(f"{m.name}_sum{ls} {_fmt(child.sum)}")
                     lines.append(f"{m.name}_count{ls} {child.count}")
@@ -487,8 +532,8 @@ def snapshot(registry=None) -> dict:
     return (registry or REGISTRY).snapshot()
 
 
-def render_prometheus(registry=None) -> str:
-    return (registry or REGISTRY).render_prometheus()
+def render_prometheus(registry=None, exemplars=True) -> str:
+    return (registry or REGISTRY).render_prometheus(exemplars=exemplars)
 
 
 def dump_jsonl(path, extra=None, registry=None):

@@ -141,41 +141,69 @@ def _parse_value(s: str) -> float:
     return float(s)
 
 
+def _scan_label_block(s: str, start: int) -> int:
+    """Index of the ``}`` closing the label block opened at ``start``
+    (quote- and escape-aware, so a ``}`` inside a label value never
+    truncates the block).  Raises on an unterminated block."""
+    j, n = start, len(s)
+    in_quotes = False
+    while j < n:
+        c = s[j]
+        if in_quotes:
+            if c == "\\":
+                j += 2
+                continue
+            if c == '"':
+                in_quotes = False
+        elif c == '"':
+            in_quotes = True
+        elif c == "}":
+            return j
+        j += 1
+    raise ValueError(f"unterminated label block: {s}")
+
+
+def _parse_exemplar(part: str, line: str):
+    """``{labels} value [timestamp]`` after the exemplar marker ``# `` —
+    the OpenMetrics exemplar a bucket sample may carry."""
+    part = part.strip()
+    if not part.startswith("{"):
+        return None
+    j = _scan_label_block(part, 1)
+    labels = _parse_labels(part[1:j], line)
+    rest = part[j + 1:].split()
+    if not rest:
+        return None
+    return {"labels": labels, "value": _parse_value(rest[0])}
+
+
 def _split_sample(line: str):
-    """``name{labels} value [timestamp]`` -> (name, labels, value).  The
-    brace scan is quote- and escape-aware, so a ``}`` inside a label value
-    never truncates the label block."""
+    """``name{labels} value [timestamp] [# {exemplar-labels} value]`` ->
+    (name, labels, value, exemplar).  The brace scan is quote- and
+    escape-aware, so a ``}`` inside a label value never truncates the
+    label block."""
     brace = line.find("{")
     space = line.find(" ")
     if brace != -1 and (space == -1 or brace < space):
         name = line[:brace]
-        j, n = brace + 1, len(line)
-        in_quotes = False
-        while j < n:
-            c = line[j]
-            if in_quotes:
-                if c == "\\":
-                    j += 2
-                    continue
-                if c == '"':
-                    in_quotes = False
-            elif c == '"':
-                in_quotes = True
-            elif c == "}":
-                break
-            j += 1
-        if j >= n:
-            raise ValueError(f"unterminated label block: {line}")
+        j = _scan_label_block(line, brace + 1)
         labels = _parse_labels(line[brace + 1:j], line)
         rest = line[j + 1:].strip()
     else:
         name, _, rest = line.partition(" ")
         labels = {}
         rest = rest.strip()
+    # exemplar annotation: the value/timestamp part never contains "#"
+    # (labels were already consumed above), so the first " # " is the
+    # OpenMetrics exemplar marker
+    exemplar = None
+    if " # " in rest:
+        rest, _, ex_part = rest.partition(" # ")
+        exemplar = _parse_exemplar(ex_part, line)
     parts = rest.split()
     if not parts:
         raise ValueError(f"sample line has no value: {line}")
-    return name, labels, _parse_value(parts[0])
+    return name, labels, _parse_value(parts[0]), exemplar
 
 
 def parse_prometheus(text: str) -> dict:
@@ -231,7 +259,7 @@ def parse_prometheus(text: str) -> dict:
             continue
         if line.startswith("#"):
             continue  # other comments are legal exposition noise
-        name, labels, value = _split_sample(line)
+        name, labels, value, exemplar = _split_sample(line)
         base = None
         for suffix in ("_bucket", "_sum", "_count"):
             if name.endswith(suffix) and name[:-len(suffix)] in hist_names:
@@ -243,6 +271,10 @@ def parse_prometheus(text: str) -> dict:
                 le = labels.pop("le", "+Inf")
                 s = hist_series(fam, labels)
                 s["buckets"][le] = int(value)
+                if exemplar is not None:
+                    # same shape snapshot() emits, so the round-trip
+                    # parse(render()) == snapshot() covers exemplars too
+                    s.setdefault("exemplars", {})[le] = exemplar
             elif name.endswith("_sum"):
                 hist_series(fam, labels)["sum"] = value
             else:
@@ -295,6 +327,9 @@ class SampleSet:
 
     def __init__(self):
         self._by_name: dict[str, list] = {}
+        # family name -> [trace_id] harvested from histogram exemplars —
+        # the metrics -> traces correlation alert notifications ship
+        self._exemplars: dict[str, list] = {}
 
     def add(self, name, labels, value):
         self._by_name.setdefault(str(name), []).append(
@@ -302,9 +337,19 @@ class SampleSet:
         return self
 
     def add_families(self, families, extra_labels=None):
-        """Merge a parsed/snapshot family dict (histograms flattened)."""
+        """Merge a parsed/snapshot family dict (histograms flattened).
+        Histogram exemplar ``trace_id``s are harvested into a per-family
+        side table (:meth:`exemplar_trace_ids`)."""
         for name, labels, value in flatten_families(families, extra_labels):
             self.add(name, labels, value)
+        for name, fam in families.items():
+            for s in fam.get("series", ()):
+                for ex in (s.get("exemplars") or {}).values():
+                    tid = (ex.get("labels") or {}).get("trace_id")
+                    if tid:
+                        ids = self._exemplars.setdefault(str(name), [])
+                        if tid not in ids:
+                            ids.append(tid)
         return self
 
     @classmethod
@@ -340,6 +385,20 @@ class SampleSet:
                 f"{name}{selector or {}} matches {len(hits)} samples; "
                 f"narrow the selector or use match()")
         return hits[0][1]
+
+    def exemplar_trace_ids(self, prefix):
+        """Exemplar ``trace_id``s of every histogram family named exactly
+        ``prefix`` or starting with it — ``"llm_ttft"`` finds the
+        ``llm_ttft_seconds`` exemplars, so a burn-rate alert on the SLO
+        series can name the traces that burned it."""
+        out = []
+        p = str(prefix)
+        for fam, ids in self._exemplars.items():
+            if fam == p or fam.startswith(p):
+                for tid in ids:
+                    if tid not in out:
+                        out.append(tid)
+        return out
 
     def __len__(self):
         return sum(len(v) for v in self._by_name.values())
@@ -432,7 +491,12 @@ class Scraper:
         conn = http.client.HTTPConnection(target.host, target.port,
                                           timeout=remaining)
         try:
-            conn.request("GET", path)
+            # negotiate OpenMetrics (with 0.0.4 fallback): the exporter
+            # attaches histogram exemplar annotations — the metrics ->
+            # /tracez correlation — only to the OpenMetrics variant
+            conn.request("GET", path, headers={
+                "Accept": "application/openmetrics-text; version=1.0.0, "
+                          "text/plain; version=0.0.4"})
             resp = conn.getresponse()
             return resp.status, resp.read().decode("utf-8", "replace")
         finally:

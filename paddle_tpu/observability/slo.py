@@ -97,8 +97,12 @@ class SLOTracker:
         return self
 
     def observe(self, value):
+        """Record one observation; returns True when it violated the
+        series' target — callers correlate the verdict with the request
+        that produced it (``Trace.mark_slo``: the tail sampler keeps
+        SLO-violating traces)."""
         if not _metrics._runtime["enabled"]:
-            return
+            return False
         v = float(value)
         violated = self.target is not None and v > self.target
         with self._lock:
@@ -119,6 +123,7 @@ class SLOTracker:
         for q, p in zip(self.quantiles, pcts):
             _M_LATENCY.labels(series=self.series,
                               quantile=_quantile_label(q)).set(p)
+        return violated
 
     def _percentile_locked(self, q):
         n = len(self._sorted)
@@ -159,7 +164,7 @@ class SLORegistry:
         return t
 
     def track(self, series, value):
-        self.tracker(series).observe(value)
+        return self.tracker(series).observe(value)
 
     def set_target(self, series, target):
         self.tracker(series).set_target(target)
@@ -176,7 +181,7 @@ SLOS = SLORegistry()
 
 
 def track(series, value):
-    SLOS.track(series, value)
+    return SLOS.track(series, value)
 
 
 def set_target(series, target):

@@ -41,16 +41,29 @@ def _record_event(name):
 
 
 class span:
-    """Context manager: trace span + latency histogram + event counter."""
+    """Context manager: trace span + latency histogram + event counter.
 
-    __slots__ = ("name", "histogram", "counter", "_t0", "_ev", "duration")
+    ``trace`` (a ``tracing.Trace``, or the falsy ``NULL_TRACE``) extends
+    the single instrumentation point to the request-scoped sinks: the
+    span joins the trace's tree (with ``attrs``), the flight-recorder
+    event carries the ``trace_id``, and the histogram observation carries
+    it as an OpenMetrics exemplar — metrics, black box and span tree all
+    name the same request.
+    """
 
-    def __init__(self, name, histogram=None, counter=None):
+    __slots__ = ("name", "histogram", "counter", "trace", "attrs",
+                 "_t0", "_ev", "_tspan", "duration")
+
+    def __init__(self, name, histogram=None, counter=None, trace=None,
+                 attrs=None):
         self.name = name
         self.histogram = histogram
         self.counter = counter
+        self.trace = trace if trace else None  # NULL_TRACE is falsy
+        self.attrs = attrs
         self._t0 = None
         self._ev = None
+        self._tspan = None
         self.duration = None
 
     def __enter__(self):
@@ -59,6 +72,9 @@ class span:
         self._ev = _record_event(self.name)
         if self._ev is not None:
             self._ev.__enter__()
+        if self.trace is not None:
+            self._tspan = self.trace.span(
+                self.name, **(self.attrs or {})).open()
         self._t0 = time.perf_counter()
         return self
 
@@ -69,17 +85,23 @@ class span:
             if self._ev is not None:
                 self._ev.__exit__(None, None, None)
                 self._ev = None
+            err = repr(exc[1]) if exc and exc[0] is not None else None
+            if self._tspan is not None:
+                self._tspan.close(error=err)
+                self._tspan = None
             if self.histogram is not None:
-                self.histogram.observe(self.duration)
+                self.histogram.observe(
+                    self.duration,
+                    exemplar=self.trace.trace_id
+                    if self.trace is not None else None)
             if self.counter is not None:
                 self.counter.inc()
-            if exc and exc[0] is not None:
+            fields = {"name": self.name, "duration_s": self.duration}
+            if self.trace is not None:
+                fields["trace_id"] = self.trace.trace_id
+            if err is not None:
                 # a span unwound by an exception is exactly the event a
                 # postmortem wants last in the black box
-                _flight.record_event("span", name=self.name,
-                                     duration_s=self.duration,
-                                     error=repr(exc[1]))
-            else:
-                _flight.record_event("span", name=self.name,
-                                     duration_s=self.duration)
+                fields["error"] = err
+            _flight.record_event("span", **fields)
         return False

@@ -17,6 +17,11 @@ Inputs
                             'B'/'E' pairs both count)
 --flight dump.jsonl         alternative timing source: a flight-recorder
                             dump whose `span` events carry duration_s
+--tracez trace.json         alternative timing source: a `/tracez` JSON
+                            trace (one trace's span tree), a
+                            `traces_*.json` store dump, or a list of
+                            traces — per-op census attribution on a
+                            SINGLE sampled request
 --census census.json        per-op cost table: the per_op_census() list,
                             or a {name: {flops, bytes}} mapping, or a
                             collective_census() dict
@@ -45,9 +50,12 @@ __all__ = ["load_timeline", "load_census", "join", "render_text", "main"]
 
 
 # ------------------------------------------------------------------ loading
-def load_timeline(path=None, events=None, flight_path=None):
+def load_timeline(path=None, events=None, flight_path=None,
+                  tracez_path=None):
     """-> OrderedDict name -> {"count", "total_us"} aggregated timings."""
-    if flight_path is not None:
+    if tracez_path is not None:
+        events = _events_from_tracez(tracez_path)
+    elif flight_path is not None:
         events = _events_from_flight(flight_path)
     elif path is not None:
         with open(path) as f:
@@ -97,6 +105,37 @@ def _events_from_flight(path):
             if rec.get("kind") == "span" and "duration_s" in rec:
                 events.append({"name": rec.get("name", "?"), "ph": "X",
                                "dur": float(rec["duration_s"]) * 1e6})
+    return events
+
+
+def _events_from_tracez(path):
+    """Span tree(s) of a `/tracez` JSON document as chrome 'X' events.
+
+    Accepts the three shapes the tracing plane writes: one trace dict
+    (``/tracez?trace_id=...``), a store dump ``{"traces": [...]}``
+    (``traces_<reason>_*.json`` next to a flight black box), or a bare
+    list of trace dicts."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict):
+        traces = doc["traces"] if "traces" in doc else [doc]
+    else:
+        traces = doc
+    events = []
+
+    def walk(span):
+        dur = span.get("duration_s")
+        if dur is not None:
+            events.append({"name": span.get("name", "?"), "ph": "X",
+                           "dur": float(dur) * 1e6})
+        for child in span.get("children", ()):
+            walk(child)
+
+    for t in traces:
+        if not isinstance(t, dict):
+            continue
+        for s in t.get("spans", ()):
+            walk(s)
     return events
 
 
@@ -222,6 +261,9 @@ def main(argv=None) -> int:
     src.add_argument("--trace", help="chrome-trace JSON (Profiler.export)")
     src.add_argument("--flight",
                      help="flight-recorder JSONL dump (span events)")
+    src.add_argument("--tracez",
+                     help="/tracez JSON trace or traces_*.json store dump "
+                          "(per-request span tree)")
     ap.add_argument("--census", default=None,
                     help="per-op census JSON (per_op_census / "
                          "collective_census output)")
@@ -230,7 +272,8 @@ def main(argv=None) -> int:
                     help="write the full joined table as JSON here")
     args = ap.parse_args(argv)
 
-    timeline = load_timeline(path=args.trace, flight_path=args.flight)
+    timeline = load_timeline(path=args.trace, flight_path=args.flight,
+                             tracez_path=args.tracez)
     census = load_census(args.census) if args.census else OrderedDict()
     rows = join(timeline, census)
     if not rows:
