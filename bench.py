@@ -525,6 +525,88 @@ def _bench_prefix_cache(on_accel):
                 n_req * new_toks / dt, 1)}
 
 
+def _bench_spec_decode(on_accel):
+    """Speculative decoding through the REAL engine: steady decode tok/s
+    spec-on vs spec-off on the same deterministic trace, plus the
+    acceptance/rollback accounting behind the speedup.
+
+    The drafter is a REPLAY drafter (each request's precomputed solo
+    greedy continuation) — deterministic and model-independent, so the
+    number isolates the verify-path mechanics (K+1 tokens per compiled
+    call, rollback trims) at a controlled acceptance rate rather than
+    mixing in a particular corpus's n-gram hit rate.  The engine-reported
+    acceptance_ratio and rollback counters are emitted alongside so a
+    regression in EITHER the mechanism or the accounting moves a number."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16", tensor_parallel=False,
+            use_flash_attention=True)
+        slots, L, ps, plen, new_toks, K = 8, 1024, 128, 256, 64, 4
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False,
+                               use_flash_attention=False)
+        slots, L, ps, plen, new_toks, K = 2, 128, 32, 16, 8, 3
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, plen).astype(np.int32)
+               for _ in range(slots)]
+    ids = paddle.to_tensor(np.stack(prompts))
+    solo = np.asarray(model.generate(ids, max_new_tokens=new_toks)._value)
+    seqs = [np.concatenate([p, solo[i]]) for i, p in enumerate(prompts)]
+
+    class _Replay:
+        name = "replay"
+
+        def propose(self, context, k):
+            ctx = np.asarray(context, np.int32).reshape(-1)
+            out = np.zeros(int(k), np.int32)
+            for s in seqs:
+                if ctx.size <= s.size and (s[:plen] == ctx[:plen]).all():
+                    tail = s[ctx.size:ctx.size + int(k)]
+                    out[:tail.size] = tail
+                    break
+            return out
+
+    def run(spec_k, drafter=None):
+        eng = LLMEngine(model, max_batch_slots=slots, max_seq_len=L,
+                        kv_layout="paged", page_size=ps, prefill_chunk=ps,
+                        spec_k=spec_k, spec_draft=drafter)
+        eng.warmup()
+        t0 = time.perf_counter()
+        futs = [eng.submit(p, max_new_tokens=new_toks) for p in prompts]
+        eng.run_until_complete()
+        dt = max(time.perf_counter() - t0, 1e-6)
+        for f in futs:
+            f.result(timeout=1)  # parity itself is the test suite's job
+        return slots * new_toks / dt, eng.stats()["spec"]
+
+    off_tps, _ = run(0)
+    on_tps, spec = run(K, _Replay())
+    return {
+        "spec_decode_tokens_per_sec": round(on_tps, 1),
+        "spec_off_tokens_per_sec": round(off_tps, 1),
+        "spec_decode_speedup": round(on_tps / max(off_tps, 1e-6), 2),
+        "spec_decode_batch": slots,
+        "spec_k": K,
+        "spec_acceptance_ratio": round(spec["acceptance_ratio"], 4),
+        "spec_verify_calls": int(spec["verify_calls"]),
+        "spec_rolled_back_tokens": int(spec["rolled_back_tokens"]),
+        "spec_rolled_back_pages": int(spec["rolled_back_pages"]),
+    }
+
+
 def _bench_llama7b_layer(on_accel):
     """One LLaMA-2-7B-dimension decoder layer (h=4096, ffn=11008, 32 heads)
     fwd+bwd at seq 2048 — anchors per-layer ms for BASELINE config #5 (the
@@ -1077,6 +1159,7 @@ def main():
                     (_bench_resnet, "resnet"),
                     (_bench_decode, "decode"),
                     (_bench_prefix_cache, "prefix_cache"),
+                    (_bench_spec_decode, "spec_decode"),
                     (_bench_llama7b_layer, "llama7b_layer"),
                     (_bench_ernie, "ernie"),
                     (_bench_vit, "vit"),

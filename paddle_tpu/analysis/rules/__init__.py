@@ -16,6 +16,8 @@ silent-noop           warning   exported functions whose body does nothing
 bare-except-swallow   error     swallowed faults in the recovery paths
 metrics-catalogue     error     metric namespace vs README catalogue (PR 2)
 docs-stale            warning   PROJECTION.md cites the newest BENCH round
+shape-polymorphism    warning   concrete .shape/.ndim/len() branching in
+                                traced functions (compile-zoo growth)
 ====================  ========  =================================================
 """
 from . import bare_except      # noqa: F401
@@ -25,4 +27,5 @@ from . import donation         # noqa: F401
 from . import dtype_drift      # noqa: F401
 from . import host_sync        # noqa: F401
 from . import impure_trace     # noqa: F401
+from . import shape_polymorphism  # noqa: F401
 from . import silent_noop      # noqa: F401

@@ -1,0 +1,87 @@
+"""shape-polymorphism: concrete-shape branching baked into traced code.
+
+``if x.shape[0] > 1:`` inside a jitted function is resolved at TRACE time —
+jax happily specializes the program on the concrete shape and the branch
+disappears from the compiled artifact.  That is sometimes exactly what you
+want (layout dispatch on a static config), but it silently multiplies the
+compile zoo (every distinct shape re-traces through a different branch) and
+breaks shape-polymorphic lowering/export, where ``x.shape[0]`` is a symbolic
+dimension that cannot be compared concretely.  The serving engine's
+bounded-bucket discipline only works when shape branches are deliberate and
+audited — so each one is reported as a warning and the intentional ones live
+in the baseline with a justification.
+
+Flagged inside traced spans (``_traced.traced_spans``):
+
+- ``if`` / ``elif`` / ``while`` / conditional expressions whose test reads
+  ``.shape`` or ``.ndim``, calls ``len(...)``, or probes via
+  ``getattr(x, "shape"/"ndim", ...)``.
+
+Documented false positives that stay clean:
+
+- shape math OUTSIDE a test position (``jnp.arange(x.shape[1])`` — static
+  and branch-free);
+- branching on shapes in eager helpers outside traced spans (host-side
+  dispatch into compiled programs is the sanctioned pattern);
+- ``if training:`` / value-based ``jnp.where`` inside traces — no shape
+  words in the test.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+from ._traced import callee_name, in_traced, traced_spans
+
+_SHAPE_ATTRS = frozenset({"shape", "ndim"})
+
+
+def _shape_probe(test) -> str | None:
+    """Name the first concrete-shape read in a branch test, else None."""
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and sub.attr in _SHAPE_ATTRS:
+            return f".{sub.attr}"
+        if isinstance(sub, ast.Call):
+            name = callee_name(sub.func)
+            if name == "len":
+                return "len()"
+            if (name == "getattr" and len(sub.args) >= 2
+                    and isinstance(sub.args[1], ast.Constant)
+                    and sub.args[1].value in _SHAPE_ATTRS):
+                return f'getattr(…, "{sub.args[1].value}")'
+    return None
+
+
+@register
+class ShapePolymorphismRule(FileRule):
+    name = "shape-polymorphism"
+    severity = "warning"
+    description = (
+        "if/while/conditional tests reading .shape/.ndim/len() inside "
+        "jit/pjit/shard_map — the branch is specialized away at trace time "
+        "and breaks shape-polymorphic lowering; baseline deliberate "
+        "layout dispatch")
+
+    def check(self, ctx):
+        spans = traced_spans(ctx.tree)
+        if not spans:
+            return []
+        out = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, (ast.If, ast.IfExp, ast.While)):
+                continue
+            if not in_traced(node, spans):
+                continue
+            probe = _shape_probe(node.test)
+            if probe is None:
+                continue
+            kind = {"If": "if", "IfExp": "conditional expression",
+                    "While": "while"}[type(node).__name__]
+            out.append(ctx.finding(
+                self, node,
+                f"{kind} test reads {probe} inside a traced function — the "
+                f"branch specializes on the concrete shape at trace time "
+                f"(re-traces per shape, breaks shape-polymorphic export); "
+                f"hoist the dispatch to the host caller or baseline it as "
+                f"deliberate"))
+        return out

@@ -39,6 +39,16 @@ free list runs dry.  Greedy outputs are bitwise identical with the cache
 on or off: shared pages hold exactly the kv the slot would have computed
 itself (causal attention — a token's kv never depends on what follows it).
 
+``spec_k > 0`` turns on SPECULATIVE decoding: a host-side drafter
+(prompt-lookup n-gram by default, or a small draft model —
+models/spec_decode.py) proposes K tokens per slot per tick and ONE
+compiled verify pass scores all K+1 positions through the same
+dense/paged cache paths, emitting the longest valid prefix plus a
+correction token — up to (K+1)x fewer serial model passes at identical
+greedy output.  Rollback rides the existing machinery: the slot position
+stops at the accept point, rejected rows are overwritten before any read,
+and pages past the accept point decref back to the pool each tick.
+
 The engine is deterministic and thread-free by default (`step()` pumps one
 decode tick; `run_until_complete()` drains); `start()` spawns the
 background pump for server use.
@@ -69,6 +79,8 @@ from ..observability import metrics as _obs
 from ..observability import slo as _slo
 from ..observability import tracing as _tracing
 from ..observability.spans import span as _span
+from ..ops.sampling import sample_rows as _sample_rows
+from ..ops.sampling import spec_accept as _spec_accept
 from ..tensor.tensor import Tensor
 
 __all__ = ["LLMEngine", "ServerOverloadedError", "DeadlineExceededError"]
@@ -139,11 +151,33 @@ _M_COW = _obs.counter(
 _M_PREFIX_EVICT = _obs.counter(
     "llm_prefix_evictions_total",
     "Cached prefix pages reclaimed (LRU eviction / tail steal-back)")
+_M_SPEC_DRAFTED = _obs.counter(
+    "llm_spec_drafted_tokens_total",
+    "Draft tokens proposed to speculative verify steps")
+_M_SPEC_ACCEPTED = _obs.counter(
+    "llm_spec_accepted_tokens_total",
+    "Draft tokens accepted by speculative verify steps")
+_M_SPEC_ROLLED_BACK = _obs.counter(
+    "llm_spec_rolled_back_tokens_total",
+    "Draft tokens rejected and rolled back by speculative verify steps")
+_M_SPEC_RB_PAGES = _obs.counter(
+    "llm_spec_rolled_back_pages_total",
+    "KV pages reclaimed by speculative rollback trims (paged layout)")
+_M_SPEC_ACCEPT_RATIO = _obs.gauge(
+    "llm_spec_acceptance_ratio",
+    "Cumulative accepted/drafted fraction of speculative decoding")
+_M_SPEC_VERIFY_S = _obs.histogram(
+    "llm_spec_verify_seconds",
+    "One compiled speculative verify call (K+1 positions per slot)")
+_M_ADM_REORDERS = _obs.counter(
+    "llm_admission_reorders_total",
+    "Cache-aware admissions that bypassed the FIFO queue head")
 
 #: LLMEngine(slo_targets={...}) keys -> SLO series names (observability.slo
 #: sliding-window percentiles + burn rates, README §Observability).
 _SLO_SERIES = {"ttft": "llm_ttft", "e2e": "llm_e2e",
-               "queue_wait": "llm_queue_wait", "tick": "llm_tick"}
+               "queue_wait": "llm_queue_wait", "tick": "llm_tick",
+               "verify": "llm_verify"}
 
 #: Decode ticks coalesce into ONE trace summary span per this many ticks
 #: (and per admission episode) — a 10k-token decode contributes a bounded
@@ -194,6 +228,7 @@ class _Request:
     future: Future
     do_sample: bool = False
     temperature: float = 1.0
+    top_k: int = 0
     top_p: float = 1.0
     deadline: float | None = None
     slot: int = -1
@@ -220,22 +255,21 @@ class _Request:
     dec_ticks: int = 0              # coalesced decode-summary window
     dec_tokens: int = 0
     dec_t0: float | None = None
+    adm_skips: int = 0              # cache-aware admission passed this
+                                    # request over (aging/fairness cap)
+    spec_drafted: int = 0           # speculative-decode window counters,
+    spec_accepted: int = 0          # flushed into the coalesced trace
+    spec_draft_s: float = 0.0       # spans alongside the decode summary
+    spec_verify_s: float = 0.0
 
 
-def _select_rows(logits, key, do_sample, temperature, top_p):
+def _select_rows(logits, key, do_sample, temperature, top_k, top_p):
     """Vectorized per-ROW token selection: each slot carries its own
-    (do_sample, temperature, top_p) — the serving analog of
-    generation._select, which takes scalars."""
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    lt = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)[:, None]
-    sorted_lt = jnp.sort(lt, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_lt, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    cutoff_idx = jnp.sum(cum < top_p[:, None], axis=-1, keepdims=True)
-    cutoff = jnp.take_along_axis(sorted_lt, cutoff_idx, axis=-1)
-    masked = jnp.where(lt < cutoff, -jnp.inf, lt)
-    sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
-    return jnp.where(do_sample, sampled, greedy)
+    (do_sample, temperature, top_k, top_p) — the serving face of the
+    fused sampler (ops/sampling.sample_rows), which generation._select
+    also delegates to, so the engine and the solo loop share one masking
+    + categorical implementation."""
+    return _sample_rows(logits, key, do_sample, temperature, top_k, top_p)
 
 
 class LLMEngine:
@@ -246,7 +280,8 @@ class LLMEngine:
                  page_size=128, num_pages=None, prefill_chunk=None,
                  prefix_cache=None, metrics_port=None, slo_targets=None,
                  flight_recorder_dir=None, healthy_heartbeat_age=60.0,
-                 alert_rules=None, tracer=None):
+                 alert_rules=None, tracer=None, spec_k=0, spec_draft=None,
+                 cache_aware_admission=False, admission_age_cap=4):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
         scheduling lever for high-latency hosts.  Slots that finish
@@ -313,7 +348,34 @@ class LLMEngine:
         event of the request carries it as ``trace_id`` — the aggregate
         planes point back at the exact request.  ``tracer=`` injects a
         private ``tracing.Tracer`` (its own store/sampling) for tests or
-        per-engine isolation."""
+        per-engine isolation.
+
+        ``spec_k > 0`` turns on SPECULATIVE decoding: each tick a
+        host-side drafter (``spec_draft``: "ngram" prompt-lookup by
+        default, any object with ``.propose``, or a small draft model —
+        models/spec_decode.py) proposes K tokens per active slot and ONE
+        compiled verify pass (S = K+1 through the same dense/paged cache
+        paths) scores them all; the longest valid prefix plus one
+        correction token is emitted, so a tick advances each slot by 1 to
+        K+1 tokens.  Greedy outputs stay bitwise identical to spec_k=0;
+        sampled slots use rejection sampling (distribution-preserving).
+        Rollback is free: the slot's logical position simply does not
+        advance past the accept point, and (paged) pages holding only
+        rejected rows are decref'd back to the pool each tick
+        (llm_spec_rolled_back_pages_total).  A verify that outruns the
+        page pool preempts recompute-style exactly like decode.
+        Incompatible with ``decode_chunk > 1`` (speculation already
+        amortizes the host round-trip; stacking the two schedulers is
+        unsupported).
+
+        ``cache_aware_admission=True`` (paged + prefix cache only) lets
+        admission pick among the first few queued requests the one with
+        the LONGEST cached prompt prefix instead of strict FIFO —
+        back-to-back warm requests admit while a cold miss would have
+        head-of-line blocked them.  Fairness: every time the queue head
+        is passed over its ``adm_skips`` ages by one; once it reaches
+        ``admission_age_cap`` the head admits next regardless of cache
+        affinity (llm_admission_reorders_total counts the bypasses)."""
         cfg = model.config
         self.model = model
         self.n_slots = int(max_batch_slots)
@@ -439,6 +501,35 @@ class LLMEngine:
             if self.max_queue_len and self.max_queue_len > 0 else 0)
         self._rng = np.random.default_rng(1234)  # admission-token sampling
         self.decode_chunk = max(1, int(decode_chunk))
+        self.spec_k = int(spec_k)
+        if self.spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if self.spec_k and self.decode_chunk > 1:
+            raise ValueError(
+                "spec_k and decode_chunk > 1 are mutually exclusive: "
+                "speculative verify already amortizes the host round-trip "
+                "(up to K+1 tokens per compiled call)")
+        self._drafter = None
+        if self.spec_k:
+            from ..models.spec_decode import get_drafter
+
+            self._drafter = get_drafter(spec_draft)
+        # engine-local speculative counters (stats() stays per-engine; the
+        # process-global registry series aggregate across engines)
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_rolled_back = 0
+        self._spec_rb_pages = 0
+        self._spec_verifies = 0
+        self.cache_aware = bool(cache_aware_admission)
+        self.admission_age_cap = max(1, int(admission_age_cap))
+        if self.cache_aware and (not self.paged or self._prefix is None):
+            raise ValueError(
+                "cache_aware_admission requires kv_layout='paged' with the "
+                "prefix cache enabled (the reorder key IS the cached-prefix "
+                "length)")
+        self._adm_reorders = 0
+        self._verify_jit = None
         self._decode_jit = {}  # scan length (effective chunk) -> jitted fn
         self._prefill_jit = {}
         self._thread = None
@@ -514,11 +605,12 @@ class LLMEngine:
     # ------------------------------------------------------------- public
 
     def submit(self, prompt_ids, max_new_tokens=32, do_sample=False,
-               temperature=1.0, top_p=1.0, timeout=None):
+               temperature=1.0, top_k=0, top_p=1.0, timeout=None):
         """Queue one prompt; returns a Future of the generated id list.
-        Sampling knobs are PER REQUEST: slots with different settings decode
-        in the same compiled step (top_k is not supported per-slot — its k
-        changes the program shape).
+        Sampling knobs are PER REQUEST — including ``top_k``: slots with
+        different settings decode in the same compiled step (the fused
+        sampler reads the k-th largest logit per row out of the sort the
+        top-p mask needs anyway, so k never changes the program shape).
 
         ``timeout`` (seconds) sets a per-request deadline: a request still
         queued — or still decoding — when it expires fails with
@@ -551,7 +643,8 @@ class LLMEngine:
         now = self._clock()
         req = _Request(arr, int(max_new_tokens), Future(),
                        do_sample=bool(do_sample),
-                       temperature=float(temperature), top_p=float(top_p),
+                       temperature=float(temperature), top_k=int(top_k),
+                       top_p=float(top_p),
                        deadline=(now + float(timeout))
                        if timeout is not None else None,
                        submit_ts=now,
@@ -637,6 +730,20 @@ class LLMEngine:
                 "cow_copies": self._cow_copies,
                 "evictions": self._prefix_evictions,
             }
+        spec = None
+        if self.spec_k:
+            spec = {
+                "k": self.spec_k,
+                "drafter": getattr(self._drafter, "name",
+                                   type(self._drafter).__name__),
+                "drafted_tokens": self._spec_drafted,
+                "accepted_tokens": self._spec_accepted,
+                "rolled_back_tokens": self._spec_rolled_back,
+                "rolled_back_pages": self._spec_rb_pages,
+                "verify_calls": self._spec_verifies,
+                "acceptance_ratio": self._spec_accepted / self._spec_drafted
+                if self._spec_drafted else 0.0,
+            }
         return {
             "queue_depth": self._pending.qsize(),
             "active_slots": sum(r is not None for r in self.slot_req),
@@ -647,6 +754,8 @@ class LLMEngine:
             "kv_page_utilization": pages_used / pages_total
             if pages_total else 0.0,
             "prefix_cache": prefix,
+            "spec": spec,
+            "admission_reorders": self._adm_reorders,
             "prefill_in_progress": self._prefilling is not None,
             "pump_alive": self._thread.is_alive()
             if self._thread is not None else False,
@@ -790,9 +899,28 @@ class LLMEngine:
                 "decode",
                 duration_s=max(0.0, time.perf_counter() - req.dec_t0),
                 ticks=int(req.dec_ticks), tokens=int(req.dec_tokens))
+            if req.spec_drafted:
+                # speculative summary triplet for the same window: the
+                # spec envelope plus its draft/verify phase breakdown,
+                # each carrying the window's mean accepted_len
+                acc_len = round(req.spec_accepted / req.dec_ticks, 3)
+                req.trace.add_span(
+                    "spec",
+                    duration_s=req.spec_draft_s + req.spec_verify_s,
+                    drafted=int(req.spec_drafted),
+                    accepted=int(req.spec_accepted),
+                    accepted_len=acc_len)
+                req.trace.add_span("draft", duration_s=req.spec_draft_s,
+                                   tokens=int(req.spec_drafted))
+                req.trace.add_span("verify", duration_s=req.spec_verify_s,
+                                   accepted_len=acc_len)
         req.dec_ticks = 0
         req.dec_tokens = 0
         req.dec_t0 = None
+        req.spec_drafted = 0
+        req.spec_accepted = 0
+        req.spec_draft_s = 0.0
+        req.spec_verify_s = 0.0
 
     def _end_trace(self, req, status, **attrs):
         """Terminal trace bookkeeping for a request leaving the engine:
@@ -1269,12 +1397,52 @@ class LLMEngine:
         if self._prefilling is not None:
             self._prefill_tick()
 
+    def _pop_admission_request(self):
+        """Pop the next request to admit.  FIFO by default; with
+        ``cache_aware_admission``, scan the first few queued requests and
+        pick the one with the LONGEST cached prompt prefix (strict FIFO
+        on ties), reusing each request's memoized radix match.  Fairness:
+        passed-over requests age by one ``adm_skips`` per bypass; once
+        the queue head hits ``admission_age_cap`` it admits next
+        regardless of cache affinity, so a cold request starves for a
+        bounded number of admissions only."""
+        if not self.cache_aware:
+            try:
+                return self._pending.get_nowait()
+            except queue.Empty:
+                return None
+        with self._pending.mutex:
+            q = self._pending.queue
+            if not q:
+                return None
+            best, best_hit = 0, -1
+            if q[0].adm_skips < self.admission_age_cap:
+                for idx in range(min(len(q), 8)):
+                    r = q[idx]
+                    hit = 0
+                    if not r.skip_cache:
+                        if r.match_epoch != self._prefix_epoch \
+                                or r.match_result is None:
+                            r.match_result = self._prefix.match(r.prompt)
+                            r.match_epoch = self._prefix_epoch
+                        hit = r.match_result[0]
+                    if hit > best_hit:
+                        best, best_hit = idx, hit
+            req = q[best]
+            del q[best]
+            if best:
+                for idx in range(best):
+                    q[idx].adm_skips += 1
+                _M_ADM_REORDERS.inc()
+                self._adm_reorders += 1
+            self._pending.not_full.notify()
+            return req
+
     def _start_prefill(self):
         free = [i for i, r in enumerate(self.slot_req) if r is None]
         while free and not self._pending.empty():
-            try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
+            req = self._pop_admission_request()
+            if req is None:
                 return
             if req.future.done():
                 # cancelled / failed by a pump-death race
@@ -1492,19 +1660,37 @@ class LLMEngine:
                      jnp.zeros((B,), jnp.int32),
                      jnp.zeros((B,), bool),
                      jnp.ones((B,), jnp.float32),
+                     jnp.zeros((B,), jnp.int32),
                      jnp.ones((B,), jnp.float32), keys)
             _, self.caches = jit(*args)
+            if self.spec_k:
+                vargs = (params, buffers, self.caches)
+                if self.paged:
+                    vargs += (self._pt_device(),)
+                vargs += (jnp.asarray(np.full((B, 1), self.pad, np.int32)),
+                          jnp.zeros((B, self.spec_k), jnp.int32),
+                          jnp.zeros((B,), jnp.int32),
+                          jnp.zeros((B,), bool),
+                          jnp.ones((B,), jnp.float32),
+                          jnp.zeros((B,), jnp.int32),
+                          jnp.ones((B,), jnp.float32),
+                          _fr.get_rng_key())
+                _, _, self.caches = self._get_verify()(*vargs)
         dt = time.perf_counter() - t0
         _M_WARMUP_S.set(dt)
         return dt
 
     def _host_select(self, row, req):
-        """First (admission) token: host-side mirror of _select_rows."""
+        """First (admission) token: host-side mirror of _select_rows, same
+        masking order (temperature -> top-k by VALUE -> top-p over the
+        survivors)."""
         if not req.do_sample:
             return int(row.argmax())
         lt = row.astype(np.float64) / max(req.temperature, 1e-6)
-        order = np.argsort(lt)[::-1]
-        s = lt[order]
+        if 0 < req.top_k < row.size:
+            kth = np.sort(lt)[::-1][req.top_k - 1]
+            lt = np.where(lt < kth, -np.inf, lt)
+        s = np.sort(lt)[::-1]
         e = np.exp(s - s.max())
         cum = np.cumsum(e / e.sum())
         cutoff = s[min(int((cum < req.top_p).sum()), s.size - 1)]
@@ -1517,7 +1703,7 @@ class LLMEngine:
 
         if self.paged:
             def run(params, buffers, caches, page_tbl, tokens, pos,
-                    do_sample, temperature, top_p, keys):
+                    do_sample, temperature, top_k, top_p, keys):
                 restore = model.bind_functional_state(params, buffers)
                 try:
                     with tape.no_grad():
@@ -1541,7 +1727,8 @@ class LLMEngine:
                                     for x in c)
                                 raw.append((vals[0], vals[1]) + vals[4:])
                             nxt = _select_rows(logits._value[:, -1], key,
-                                               do_sample, temperature, top_p)
+                                               do_sample, temperature,
+                                               top_k, top_p)
                             return (raw, nxt[:, None], p + 1), nxt
 
                         (caches, _, _), toks = jax.lax.scan(
@@ -1553,7 +1740,7 @@ class LLMEngine:
             return jax.jit(run, donate_argnums=(2,))
 
         def run(params, buffers, caches, tokens, pos, do_sample, temperature,
-                top_p, keys):
+                top_k, top_p, keys):
             restore = model.bind_functional_state(params, buffers)
             try:
                 with tape.no_grad():
@@ -1573,7 +1760,8 @@ class LLMEngine:
                         # select ON DEVICE: ships token ids over the tunnel,
                         # not [B, vocab] logits
                         nxt = _select_rows(logits._value[:, -1], key,
-                                           do_sample, temperature, top_p)
+                                           do_sample, temperature,
+                                           top_k, top_p)
                         return (raw, nxt[:, None], p + 1), nxt
 
                     (caches, _, _), toks = jax.lax.scan(
@@ -1583,6 +1771,71 @@ class LLMEngine:
             return toks.T, caches  # [B, chunk]
 
         return jax.jit(run, donate_argnums=(2,))
+
+    def _verify_fn(self):
+        """ONE compiled speculative verify: score K drafts + one bonus
+        position for every slot (S = K+1 through the same cache scatter /
+        attention paths decode uses) and run the accept/rollback decision
+        on device (ops/sampling.spec_accept) — only the [B, K+1] token
+        ladder and the [B] accept counts cross the host tunnel."""
+        model = self.model
+
+        if self.paged:
+            def run(params, buffers, caches, page_tbl, tokens, drafts, pos,
+                    do_sample, temperature, top_k, top_p, key):
+                restore = model.bind_functional_state(params, buffers)
+                try:
+                    with tape.no_grad():
+                        t_caches = [
+                            (Tensor(c[0]), Tensor(c[1]), pos,
+                             Tensor(page_tbl))
+                            + tuple(Tensor(x) for x in c[2:])
+                            for c in caches]
+                        ids_in = jnp.concatenate([tokens, drafts], axis=1)
+                        logits, new_caches = model.verify_step(
+                            Tensor(ids_in), caches=t_caches)
+                        raw = []
+                        for c in new_caches:
+                            vals = tuple(
+                                x._value if isinstance(x, Tensor) else x
+                                for x in c)
+                            raw.append((vals[0], vals[1]) + vals[4:])
+                        out, n_acc = _spec_accept(
+                            logits._value, drafts, key, do_sample,
+                            temperature, top_k, top_p)
+                finally:
+                    restore()
+                return out, n_acc, raw
+
+            return jax.jit(run, donate_argnums=(2,))
+
+        def run(params, buffers, caches, tokens, drafts, pos,
+                do_sample, temperature, top_k, top_p, key):
+            restore = model.bind_functional_state(params, buffers)
+            try:
+                with tape.no_grad():
+                    t_caches = [
+                        (Tensor(c[0]), Tensor(c[1]), pos)
+                        + tuple(Tensor(x) for x in c[3:])
+                        for c in caches]
+                    ids_in = jnp.concatenate([tokens, drafts], axis=1)
+                    logits, new_caches = model.verify_step(
+                        Tensor(ids_in), caches=t_caches)
+                    raw = [tuple(x._value if isinstance(x, Tensor) else x
+                                 for x in c) for c in new_caches]
+                    out, n_acc = _spec_accept(
+                        logits._value, drafts, key, do_sample,
+                        temperature, top_k, top_p)
+            finally:
+                restore()
+            return out, n_acc, raw
+
+        return jax.jit(run, donate_argnums=(2,))
+
+    def _get_verify(self):
+        if self._verify_jit is None:
+            self._verify_jit = self._verify_fn()
+        return self._verify_jit
 
     def step(self):
         """One engine tick: admit pending prompts, then decode one token
@@ -1620,6 +1873,11 @@ class LLMEngine:
         # effective chunk: stay inside the cache (slots AT capacity were
         # finished by the previous tick's done-check, so headroom >= 1)
         headroom = self.L - 1 - int(self.slot_pos[active].max())
+        if self.spec_k and headroom >= self.spec_k:
+            # speculative tick: verify writes rows pos .. pos+K, so it
+            # needs K rows of headroom; the last strides before capacity
+            # fall back to plain one-token decode below
+            return self._spec_tick(active)
         eff = max(1, min(self.decode_chunk, headroom))
         if self.paged:
             # grow page tables to cover this tick's writes; slots the pool
@@ -1637,6 +1895,8 @@ class LLMEngine:
         do_s = jnp.asarray([r is not None and r.do_sample for r in reqs])
         temp = jnp.asarray([r.temperature if r is not None else 1.0
                             for r in reqs], jnp.float32)
+        topk = jnp.asarray([r.top_k if r is not None else 0
+                            for r in reqs], jnp.int32)
         topp = jnp.asarray([r.top_p if r is not None else 1.0
                             for r in reqs], jnp.float32)
         from ..framework import random as _fr
@@ -1654,7 +1914,7 @@ class LLMEngine:
                     pt[i, :] = 0
             args += (jnp.asarray(pt),)
         nxt_dev, new_caches = jit(
-            *args, tokens, pos, do_s, temp, topp, keys)
+            *args, tokens, pos, do_s, temp, topk, topp, keys)
         # the returned tuples carry advanced pos at slot [2], but the
         # engine's [B] slot_pos vector stays authoritative — each tick
         # rebuilds the per-slot positions (finished slots do not advance)
@@ -1695,6 +1955,143 @@ class LLMEngine:
         # the shared step — harmless: a decode WRITES row `pos` before any
         # read past it, and admission rewrites rows [0, bucket) wholesale
         return emitted
+
+    def _spec_tick(self, active):
+        """One speculative tick: host-draft K tokens per active slot, ONE
+        compiled verify pass over S = K+1 positions for the whole pool,
+        emit each slot's accepted prefix + correction token, then roll
+        back — the slot position simply stops at the accept point, and
+        (paged) pages holding only rejected rows return to the pool."""
+        K = self.spec_k
+        if self.paged:
+            # the verify writes rows pos .. pos+K: grow/COW the page
+            # tables for all K+1 rows up front; a slot the pool cannot
+            # cover mid-verify preempts recompute-style, same as decode
+            active = self._ensure_decode_pages(active, K + 1)
+            self._update_page_gauges()
+            if not active:
+                return 0
+        t0 = time.perf_counter()
+        drafts = np.zeros((self.n_slots, K), np.int32)
+        for i in active:
+            req = self.slot_req[i]
+            ctx = np.concatenate(
+                [req.prompt, np.asarray(req.tokens, np.int32)])
+            drafts[i] = self._drafter.propose(ctx, K)
+        draft_s = time.perf_counter() - t0
+        reqs = self.slot_req
+        do_s = jnp.asarray([r is not None and r.do_sample for r in reqs])
+        temp = jnp.asarray([r.temperature if r is not None else 1.0
+                            for r in reqs], jnp.float32)
+        topk = jnp.asarray([r.top_k if r is not None else 0
+                            for r in reqs], jnp.int32)
+        topp = jnp.asarray([r.top_p if r is not None else 1.0
+                            for r in reqs], jnp.float32)
+        from ..framework import random as _fr
+
+        args = (self._params, self._buffers, self.caches)
+        if self.paged:
+            # same inactive-slot masking as decode: a mid-prefill slot's
+            # garbage scatter must land in the trash page
+            pt = self._pt_host.copy()
+            for i, r in enumerate(self.slot_req):
+                if r is None:
+                    pt[i, :] = 0
+            args += (jnp.asarray(pt),)
+        args += (jnp.asarray(self.last_token.reshape(-1, 1)),
+                 jnp.asarray(drafts), jnp.asarray(self.slot_pos),
+                 do_s, temp, topk, topp, _fr.get_rng_key())
+        jit = self._get_verify()
+        t1 = time.perf_counter()
+        if _obs.enabled():
+            with _span("llm_spec_verify", _M_SPEC_VERIFY_S) as sp:
+                out_dev, n_dev, self.caches = jit(*args)
+                out = np.asarray(out_dev).astype(np.int32)
+                n_acc = np.asarray(n_dev).astype(np.int32)
+            if sp.duration:
+                _slo.track("llm_verify", sp.duration)
+        else:
+            out_dev, n_dev, self.caches = jit(*args)
+            out = np.asarray(out_dev).astype(np.int32)
+            n_acc = np.asarray(n_dev).astype(np.int32)
+        verify_s = time.perf_counter() - t1
+        now_pc = time.perf_counter()
+        emitted = 0
+        drafted_tick = 0
+        accepted_tick = 0
+        rb_pages = 0
+        for i in list(active):
+            req = self.slot_req[i]
+            if req is None:
+                continue
+            if _obs.enabled():
+                if req.dec_t0 is None:
+                    req.dec_t0 = now_pc
+                req.dec_ticks += 1
+                req.spec_drafted += K
+                req.spec_accepted += int(n_acc[i])
+                req.spec_draft_s += draft_s
+                req.spec_verify_s += verify_s
+            drafted_tick += K
+            accepted_tick += int(n_acc[i])
+            # row i emits out[i, :n_acc[i]+1]: the accepted drafts plus
+            # one correction/bonus token (so every verify makes progress)
+            for j in range(int(n_acc[i]) + 1):
+                tok = int(out[i, j])
+                req.tokens.append(tok)
+                req.dec_tokens += 1
+                self.last_token[i] = tok
+                self.slot_pos[i] += 1
+                emitted += 1
+                done = (tok == self.eos
+                        or len(req.tokens) >= req.max_new_tokens
+                        or self.slot_pos[i] >= self.L - 1)
+                if done:
+                    self._finish(i)
+                    break
+            if self.paged and self.slot_req[i] is not None:
+                rb_pages += self._trim_rollback_pages(i)
+        rolled = drafted_tick - accepted_tick
+        self._spec_drafted += drafted_tick
+        self._spec_accepted += accepted_tick
+        self._spec_rolled_back += rolled
+        self._spec_rb_pages += rb_pages
+        self._spec_verifies += 1
+        _M_SPEC_DRAFTED.inc(drafted_tick)
+        _M_SPEC_ACCEPTED.inc(accepted_tick)
+        if rolled:
+            _M_SPEC_ROLLED_BACK.inc(rolled)
+        if rb_pages:
+            _M_SPEC_RB_PAGES.inc(rb_pages)
+        if self._spec_drafted:
+            _M_SPEC_ACCEPT_RATIO.set(
+                self._spec_accepted / self._spec_drafted)
+        if self.paged:
+            self._update_page_gauges()
+        for i in active:
+            req = self.slot_req[i]
+            if req is not None and req.dec_ticks >= _DECODE_SPAN_TICKS:
+                self._flush_decode_span(req)
+        return emitted
+
+    def _trim_rollback_pages(self, slot):
+        """Free pages holding ONLY rejected verify rows: valid rows are
+        0 .. slot_pos-1, so every page past the one holding row
+        slot_pos-1 was grown for drafts that rolled back.  Those pages
+        are exclusively owned (freshly allocated or COW-forked by
+        _ensure_decode_pages), so the decref hands them straight back to
+        the pool for other slots THIS tick instead of next."""
+        keep = (int(self.slot_pos[slot]) - 1) // self.ps + 1
+        pages = self._slot_pages[slot]
+        trimmed = 0
+        while len(pages) > keep:
+            page = pages.pop()
+            self._pt_host[slot, len(pages)] = 0
+            self._decref(page)
+            trimmed += 1
+        if trimmed:
+            self._pt_dirty = True
+        return trimmed
 
     def _expire_queued(self):
         """Fail and evict expired (or caller-cancelled) requests anywhere in

@@ -9,40 +9,114 @@ generation is a single device program.
 """
 from __future__ import annotations
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 
 from ..autograd import tape
 from ..framework import random as _random
+from ..ops.sampling import sample_rows, spec_accept
 from ..tensor.tensor import Tensor
 
 __all__ = ["generate"]
 
 
 def _select(logits, key, do_sample, temperature, top_k, top_p):
-    """logits [B, V] -> token ids [B, 1]."""
+    """logits [B, V] -> token ids [B, 1].  Scalar-knob wrapper over the
+    fused per-row sampler (ops/sampling.sample_rows) — ONE masking +
+    categorical implementation serves the solo loop, the serving engine
+    and the speculative verify programs."""
     if not do_sample:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
-    logits = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)
-    V = logits.shape[-1]
-    if top_k and top_k > 0:
-        kth = jax.lax.top_k(logits, min(int(top_k), V))[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest set with cumulative prob >= top_p (always >= 1 tok)
-        cutoff_idx = jnp.sum(cum < top_p, axis=-1, keepdims=True)
-        cutoff = jnp.take_along_axis(sorted_logits, cutoff_idx, axis=-1)
-        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)[:, None]
+    B = logits.shape[0]
+    return sample_rows(
+        logits, key, jnp.ones((B,), bool),
+        jnp.full((B,), temperature, jnp.float32),
+        jnp.full((B,), int(top_k), jnp.int32),
+        jnp.full((B,), top_p, jnp.float32))[:, None]
+
+
+def _to_static_caches(caches, ids, total, cache_dtype, kv_layout, page_size,
+                      share_prefix):
+    """Convert a prefill's concat-caches into HEAD-MAJOR static buffers
+    [B, H, L, D] (traced; runs inside the compiled prefill).  L is padded
+    up to a multiple of 128 so the Pallas decode kernel's key blocks tile
+    cleanly (the padded tail is never valid, the kernel masks by
+    position).  kv_layout="paged" additionally pads to whole pages and
+    reshapes each row's buffer into page-pool rows behind an identity page
+    table (page 0 stays the reserved trash page)."""
+    B, S0 = ids.shape
+    unit = 128
+    if kv_layout == "paged":
+        import math
+
+        unit = page_size * 128 // math.gcd(page_size, 128)
+    L_pad = ((total + unit - 1) // unit) * unit
+    n_pages = L_pad // page_size if kv_layout == "paged" else 0
+
+    def to_pool(x):  # [B, H, L_pad, D] -> [1 + B*M, H, ps, D]
+        Bb, H, L, D = x.shape
+        pg = x.reshape(Bb, H, n_pages, page_size, D)
+        pg = jnp.transpose(pg, (0, 2, 1, 3, 4))
+        pg = pg.reshape(Bb * n_pages, H, page_size, D)
+        return jnp.concatenate(
+            [jnp.zeros((1,) + pg.shape[1:], pg.dtype), pg], axis=0)
+
+    def to_spool(s):  # [B, H, L_pad] -> [1 + B*M, H, ps]
+        Bb, H, L = s.shape
+        pg = s.reshape(Bb, H, n_pages, page_size)
+        pg = jnp.transpose(pg, (0, 2, 1, 3))
+        pg = pg.reshape(Bb * n_pages, H, page_size)
+        return jnp.concatenate(
+            [jnp.full((1,) + pg.shape[1:], 1e-8, pg.dtype), pg],
+            axis=0)
+
+    page_tbl = None
+    if kv_layout == "paged":
+        page_tbl = (1 + jnp.arange(B * n_pages, dtype=jnp.int32)
+                    ).reshape(B, n_pages)
+        if share_prefix and B > 1:
+            # alias every row's page-aligned common prompt
+            # prefix onto row 0's PHYSICAL pages.  Aliased
+            # pages are never written: decode scatters at
+            # positions >= S0 >= cpl, whose page index is >=
+            # k_shared, and only pages < k_shared are shared.
+            same = jnp.all(ids == ids[:1], axis=0)
+            cpl = jnp.where(same.all(), S0, jnp.argmin(same))
+            k_shared = (cpl // page_size).astype(jnp.int32)
+            page_tbl = jnp.where(
+                jnp.arange(n_pages, dtype=jnp.int32)[None, :]
+                < k_shared, page_tbl[:1], page_tbl)
+    static = []
+    for (k, v) in caches:
+        pad = [(0, 0), (0, 0), (0, L_pad - S0), (0, 0)]
+        kp = jnp.pad(jnp.transpose(k._value, (0, 2, 1, 3)), pad)
+        vp = jnp.pad(jnp.transpose(v._value, (0, 2, 1, 3)), pad)
+        pos = jnp.asarray(S0, jnp.int32)
+        if cache_dtype == "int8":
+            from .kv_cache import _quantize_kv
+
+            kq, ks = _quantize_kv(kp)
+            vq, vs = _quantize_kv(vp)
+            if kv_layout == "paged":
+                static.append((to_pool(kq), to_pool(vq), pos,
+                               page_tbl, to_spool(ks),
+                               to_spool(vs)))
+            else:
+                static.append((kq, vq, pos, ks, vs))
+        elif kv_layout == "paged":
+            static.append((to_pool(kp), to_pool(vp), pos,
+                           page_tbl))
+        else:
+            static.append((kp, vp, pos))
+    return static
 
 
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              pad_token_id=0, cache_dtype=None, kv_layout=None,
-             page_size=128, share_prefix=False):
+             page_size=128, share_prefix=False, spec_k=0, spec_drafter=None):
     """Generate `max_new_tokens` continuations of `input_ids` [B, S0].
 
     Returns int32 ids [B, max_new_tokens]; once a row emits `eos_token_id`
@@ -70,6 +144,16 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     positions >= the prompt length, i.e. in each row's private pages, so
     no copy-on-write is ever needed here and outputs stay bitwise
     identical to private tables.
+
+    spec_k > 0 switches to SPECULATIVE decoding: a host-side drafter
+    (``spec_drafter``: "ngram" prompt-lookup by default, or a small draft
+    model — models/spec_decode.py) proposes K tokens per step and one
+    compiled verify pass scores all K+1 positions, accepting the longest
+    valid prefix (ops/sampling.spec_accept).  Greedy outputs are BITWISE
+    identical to spec_k=0 on every cache layout; sampled outputs are
+    distribution-preserving via rejection sampling.  Each verify emits
+    between 1 and K+1 tokens, so good drafts cut the number of serial
+    model passes by up to (K+1)x.
     """
     ids = input_ids._value if isinstance(input_ids, Tensor) else jnp.asarray(input_ids)
     ids = ids.astype(jnp.int32)
@@ -102,6 +186,14 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
             "share_prefix requires kv_layout='paged' (sharing rides on the "
             "page tables)")
     page_size = int(page_size)
+    if int(spec_k) < 0:
+        raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+    if spec_k:
+        return _generate_spec(
+            model, ids, int(max_new_tokens), bool(do_sample),
+            float(temperature), int(top_k), float(top_p), eos,
+            int(pad_token_id), cache_dtype, kv_layout, page_size,
+            bool(share_prefix), int(spec_k), spec_drafter)
     cache_key = (B, S0, int(max_new_tokens), bool(do_sample), float(temperature),
                  int(top_k), float(top_p), eos, int(pad_token_id),
                  bool(model.training), cache_dtype, kv_layout, page_size,
@@ -119,76 +211,9 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
         try:
             with tape.no_grad():
                 logits, caches = model.generate_step(Tensor(ids))
-                # convert the prefill's concat-caches into HEAD-MAJOR static
-                # buffers [B, H, L, D]; L is padded up to a multiple of 128 so
-                # the Pallas decode kernel's key blocks tile cleanly (the
-                # padded tail is never valid, the kernel masks by position).
-                # kv_layout="paged" additionally pads to whole pages and
-                # reshapes each row's buffer into page-pool rows behind an
-                # identity page table (page 0 stays the reserved trash page)
-                unit = 128
-                if kv_layout == "paged":
-                    import math
-
-                    unit = page_size * 128 // math.gcd(page_size, 128)
-                L_pad = ((total + unit - 1) // unit) * unit
-                n_pages = L_pad // page_size if kv_layout == "paged" else 0
-
-                def to_pool(x):  # [B, H, L_pad, D] -> [1 + B*M, H, ps, D]
-                    Bb, H, L, D = x.shape
-                    pg = x.reshape(Bb, H, n_pages, page_size, D)
-                    pg = jnp.transpose(pg, (0, 2, 1, 3, 4))
-                    pg = pg.reshape(Bb * n_pages, H, page_size, D)
-                    return jnp.concatenate(
-                        [jnp.zeros((1,) + pg.shape[1:], pg.dtype), pg], axis=0)
-
-                def to_spool(s):  # [B, H, L_pad] -> [1 + B*M, H, ps]
-                    Bb, H, L = s.shape
-                    pg = s.reshape(Bb, H, n_pages, page_size)
-                    pg = jnp.transpose(pg, (0, 2, 1, 3))
-                    pg = pg.reshape(Bb * n_pages, H, page_size)
-                    return jnp.concatenate(
-                        [jnp.full((1,) + pg.shape[1:], 1e-8, pg.dtype), pg],
-                        axis=0)
-
-                page_tbl = None
-                if kv_layout == "paged":
-                    page_tbl = (1 + jnp.arange(B * n_pages, dtype=jnp.int32)
-                                ).reshape(B, n_pages)
-                    if share_prefix and B > 1:
-                        # alias every row's page-aligned common prompt
-                        # prefix onto row 0's PHYSICAL pages.  Aliased
-                        # pages are never written: decode scatters at
-                        # positions >= S0 >= cpl, whose page index is >=
-                        # k_shared, and only pages < k_shared are shared.
-                        same = jnp.all(ids == ids[:1], axis=0)
-                        cpl = jnp.where(same.all(), S0, jnp.argmin(same))
-                        k_shared = (cpl // page_size).astype(jnp.int32)
-                        page_tbl = jnp.where(
-                            jnp.arange(n_pages, dtype=jnp.int32)[None, :]
-                            < k_shared, page_tbl[:1], page_tbl)
-                static = []
-                for (k, v) in caches:
-                    pad = [(0, 0), (0, 0), (0, L_pad - S0), (0, 0)]
-                    kp = jnp.pad(jnp.transpose(k._value, (0, 2, 1, 3)), pad)
-                    vp = jnp.pad(jnp.transpose(v._value, (0, 2, 1, 3)), pad)
-                    pos = jnp.asarray(S0, jnp.int32)
-                    if cache_dtype == "int8":
-                        from .kv_cache import _quantize_kv
-
-                        kq, ks = _quantize_kv(kp)
-                        vq, vs = _quantize_kv(vp)
-                        if kv_layout == "paged":
-                            static.append((to_pool(kq), to_pool(vq), pos,
-                                           page_tbl, to_spool(ks),
-                                           to_spool(vs)))
-                        else:
-                            static.append((kq, vq, pos, ks, vs))
-                    elif kv_layout == "paged":
-                        static.append((to_pool(kp), to_pool(vp), pos,
-                                       page_tbl))
-                    else:
-                        static.append((kp, vp, pos))
+                static = _to_static_caches(
+                    caches, ids, total, cache_dtype, kv_layout, page_size,
+                    share_prefix)
                 key, sub = jax.random.split(key)
                 tok = _select(logits._value[:, -1], sub, do_sample, temperature,
                               top_k, top_p)
@@ -223,5 +248,131 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     key = _random.get_rng_key()
     out = jitted(params, buffers, ids, key)
     t = Tensor(out)
+    t.stop_gradient = True
+    return t
+
+
+def _generate_spec(model, ids, max_new_tokens, do_sample, temperature,
+                   top_k, top_p, eos, pad_token_id, cache_dtype, kv_layout,
+                   page_size, share_prefix, spec_k, spec_drafter):
+    """Speculative decoding: K host-drafted tokens verified per compiled
+    step (S = K+1 through the same static/paged cache paths the plain
+    loop uses), host loop over draft -> verify -> accept.
+
+    Greedy output is BITWISE identical to the non-speculative loop: the
+    verify ladder's argmaxes are exactly the tokens single-step decoding
+    would have produced (ops/sampling.spec_accept), and every accepted
+    prefix extends them.  Rollback is free on the static layouts — the
+    per-row position vector simply does not advance past the accept
+    point, and rejected rows' kv is overwritten by the next verify pass
+    before any read can reach it.  ``do_sample`` rows run one-hot-q
+    rejection sampling (distribution-preserving, not bitwise).
+
+    Drafting is host-side (models/spec_decode; prompt-lookup n-gram by
+    default), so the compiled programs never depend on the draft source.
+    """
+    from .spec_decode import get_drafter
+
+    drafter = get_drafter(spec_drafter)
+    B, S0 = ids.shape
+    K = int(spec_k)
+    # verify scatters rows pos .. pos+K; pad the cache so a row one token
+    # short of max_new_tokens still scatters in-bounds
+    total = S0 + int(max_new_tokens) + K
+    params, buffers = model.functional_state()
+    cache_key = ("spec", B, S0, int(max_new_tokens), bool(do_sample),
+                 float(temperature), int(top_k), float(top_p), eos,
+                 int(pad_token_id), bool(model.training), cache_dtype,
+                 kv_layout, page_size, bool(share_prefix), K)
+    gen_cache = model.__dict__.setdefault("_generate_cache", {})
+    if cache_key not in gen_cache:
+        def prefill(params, buffers, ids, key):
+            restore = model.bind_functional_state(params, buffers)
+            try:
+                with tape.no_grad():
+                    logits, caches = model.generate_step(Tensor(ids))
+                    static = _to_static_caches(
+                        caches, ids, total, cache_dtype, kv_layout,
+                        page_size, share_prefix)
+                    # strip the scalar pos at [2]: the host loop owns the
+                    # per-row positions (rows advance by different amounts)
+                    stripped = [c[:2] + c[3:] for c in static]
+                    tok = _select(logits._value[:, -1], key, do_sample,
+                                  temperature, top_k, top_p)
+            finally:
+                restore()
+            return tok, stripped
+
+        def verify(params, buffers, caches, tok, drafts, pos, key):
+            restore = model.bind_functional_state(params, buffers)
+            try:
+                with tape.no_grad():
+                    t_caches = [
+                        tuple(Tensor(x) for x in c[:2]) + (pos,)
+                        + tuple(Tensor(x) for x in c[2:]) for c in caches]
+                    ids_in = jnp.concatenate([tok, drafts], axis=1)
+                    logits, new_caches = model.verify_step(
+                        Tensor(ids_in), caches=t_caches)
+                    raw = []
+                    for c in new_caches:
+                        vals = tuple(x._value if isinstance(x, Tensor) else x
+                                     for x in c)
+                        raw.append(vals[:2] + vals[3:])
+                    out, n_acc = spec_accept(
+                        logits._value, drafts, key,
+                        jnp.full((B,), do_sample, bool),
+                        jnp.full((B,), temperature, jnp.float32),
+                        jnp.full((B,), top_k, jnp.int32),
+                        jnp.full((B,), top_p, jnp.float32))
+            finally:
+                restore()
+            return out, n_acc, raw
+
+        gen_cache[cache_key] = (jax.jit(prefill),
+                                jax.jit(verify, donate_argnums=(2,)))
+    prefill_jit, verify_jit = gen_cache[cache_key]
+    key = _random.get_rng_key()
+    key, sub = jax.random.split(key)
+    first, caches = prefill_jit(params, buffers, ids, sub)
+    first = np.asarray(first).reshape(B)
+    out = np.full((B, int(max_new_tokens)), int(pad_token_id), np.int32)
+    counts = np.zeros(B, np.int64)
+    done = np.zeros(B, bool)
+    # pos[b] is the position the NEXT verify writes last[b]'s kv at —
+    # i.e. the count of already-written rows: S0 + emitted - 1 (the
+    # newest emitted token's kv is always written by the verify that
+    # consumes it, never by the one that produced it)
+    pos = np.full(B, S0, np.int32)
+    last = first.astype(np.int32)
+    ctx = [list(map(int, ids[b])) for b in range(B)]
+    for b in range(B):
+        out[b, 0] = last[b]
+        counts[b] = 1
+        ctx[b].append(int(last[b]))
+        if last[b] == eos or max_new_tokens <= 1:
+            done[b] = True
+    while not done.all():
+        drafts = np.stack([drafter.propose(np.asarray(ctx[b], np.int32), K)
+                           for b in range(B)])
+        key, sub = jax.random.split(key)
+        o_dev, n_dev, caches = verify_jit(
+            params, buffers, caches, jnp.asarray(last[:, None]),
+            jnp.asarray(drafts), jnp.asarray(pos), sub)
+        o = np.asarray(o_dev)
+        n = np.asarray(n_dev)
+        for b in range(B):
+            if done[b]:
+                continue
+            for j in range(int(n[b]) + 1):
+                tok = int(o[b, j])
+                out[b, counts[b]] = tok
+                counts[b] += 1
+                ctx[b].append(tok)
+                pos[b] += 1
+                last[b] = tok
+                if tok == eos or counts[b] >= max_new_tokens:
+                    done[b] = True
+                    break
+    t = Tensor(jnp.asarray(out))
     t.stop_gradient = True
     return t

@@ -203,6 +203,12 @@ class GPTForCausalLM(nn.Layer):
         hidden, caches = self.gpt(input_ids, caches=caches, use_cache=True)
         return self.lm_head(hidden[:, -1:]), caches
 
+    def verify_step(self, input_ids, caches):
+        """Speculative-decoding verify: full-ladder logits [B, S, V] for
+        S = K+1 tokens scored in one pass (see llama.py)."""
+        hidden, caches = self.gpt(input_ids, caches=caches, use_cache=True)
+        return self.lm_head(hidden), caches
+
     def prefill_step(self, input_ids, last_index):
         """Bucket-padded prefill for the serving engine (see llama.py)."""
         import jax
@@ -230,11 +236,13 @@ class GPTForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  pad_token_id=0, cache_dtype=None, kv_layout=None,
-                 page_size=128, share_prefix=False):
+                 page_size=128, share_prefix=False, spec_k=0,
+                 spec_drafter=None):
         """Compiled decode loop on a static kv-cache (models/generation.py)."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
                     top_k, top_p, eos_token_id, pad_token_id,
                     cache_dtype=cache_dtype, kv_layout=kv_layout,
-                    page_size=page_size, share_prefix=share_prefix)
+                    page_size=page_size, share_prefix=share_prefix,
+                    spec_k=spec_k, spec_drafter=spec_drafter)

@@ -80,13 +80,17 @@ def _to_head_major(kv):
 def _scatter(buf, kv, offset):
     """Write head-major new kv into the buffer at `offset` — a scalar (all
     slots aligned: the generate() loop) or a per-slot [B] vector
-    (continuous batching; decode S == 1)."""
+    (continuous batching decode S == 1; speculative verify S == K+1, where
+    token t of slot b lands at row offset[b] + t and rows past the
+    buffer's extent are dropped by the scatter's out-of-bounds rule)."""
     hm = kv
     if getattr(offset, "ndim", 0) >= 1:
         B, H = buf.shape[0], buf.shape[1]
-        bi = jnp.arange(B)[:, None]
-        hi = jnp.arange(H)[None, :]
-        return buf.at[bi, hi, offset[:, None]].set(hm[:, :, 0])
+        S = hm.shape[2]
+        bi = jnp.arange(B)[:, None, None]
+        hi = jnp.arange(H)[None, :, None]
+        ti = offset[:, None, None] + jnp.arange(S, dtype=jnp.int32)[None, None, :]
+        return buf.at[bi, hi, ti].set(hm)
     return jax.lax.dynamic_update_slice_in_dim(buf, hm, offset, 2)
 
 
@@ -113,10 +117,13 @@ def update_quant_cache(cache, k, v, offset, out_dtype):
         kv_q, scale = _quantize_kv(_to_head_major(kv))
         if getattr(offset, "ndim", 0) >= 1:
             B, H = buf.shape[0], buf.shape[1]
-            bi = jnp.arange(B)[:, None]
-            hi = jnp.arange(H)[None, :]
-            return (buf.at[bi, hi, offset[:, None]].set(kv_q[:, :, 0]),
-                    sbuf.at[bi, hi, offset[:, None]].set(scale[:, :, 0]))
+            Sq = kv_q.shape[2]
+            bi = jnp.arange(B)[:, None, None]
+            hi = jnp.arange(H)[None, :, None]
+            ti = offset[:, None, None] \
+                + jnp.arange(Sq, dtype=jnp.int32)[None, None, :]
+            return (buf.at[bi, hi, ti].set(kv_q),
+                    sbuf.at[bi, hi, ti].set(scale))
         return (jax.lax.dynamic_update_slice_in_dim(buf, kv_q, offset, 2),
                 jax.lax.dynamic_update_slice_in_dim(sbuf, scale, offset, 2))
 

@@ -396,6 +396,15 @@ class LlamaForCausalLM(nn.Layer):
         hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)
         return self.lm_head(hidden[:, -1:]), caches
 
+    def verify_step(self, input_ids, caches):
+        """Speculative-decoding verify: score S = K+1 tokens in ONE pass
+        through the decode cache path, returning the logits at EVERY
+        position [B, S, V] — generate_step keeps only the last, but the
+        accept/rollback decision needs the whole ladder (ops/sampling
+        spec_accept)."""
+        hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)
+        return self.lm_head(hidden), caches
+
     def prefill_step(self, input_ids, last_index):
         """Bucket-padded prefill (serving admission): the prompt is padded
         PAST `last_index`, so the next-token logits live there, not at -1
@@ -429,17 +438,21 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens=32, do_sample=False,
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  pad_token_id=0, cache_dtype=None, kv_layout=None,
-                 page_size=128, share_prefix=False):
+                 page_size=128, share_prefix=False, spec_k=0,
+                 spec_drafter=None):
         """Compiled autoregressive decoding on a static kv-cache — one XLA
         program for prefill + the whole token scan (models/generation.py).
         cache_dtype='int8' halves the kv-cache HBM footprint;
         kv_layout='paged' decodes through the paged pool + page-table
         layout (the serving engine's cache) for parity/benchmarking;
         share_prefix=True additionally aliases the batch's common prompt
-        prefix onto shared physical pages (the prefix-cache read path)."""
+        prefix onto shared physical pages (the prefix-cache read path);
+        spec_k=K enables speculative decoding (K drafts verified per
+        compiled step; greedy output is bitwise identical to spec_k=0)."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
                     top_k, top_p, eos_token_id, pad_token_id,
                     cache_dtype=cache_dtype, kv_layout=kv_layout,
-                    page_size=page_size, share_prefix=share_prefix)
+                    page_size=page_size, share_prefix=share_prefix,
+                    spec_k=spec_k, spec_drafter=spec_drafter)

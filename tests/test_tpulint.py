@@ -47,7 +47,8 @@ def by_rule(findings, rule):
 def test_rule_catalogue_registered():
     for name in ("host-sync-in-jit", "impure-trace", "collective-axis",
                  "donation-misuse", "dtype-drift", "silent-noop",
-                 "bare-except-swallow", "metrics-catalogue", "docs-stale"):
+                 "bare-except-swallow", "metrics-catalogue", "docs-stale",
+                 "shape-polymorphism"):
         assert name in RULES, f"rule {name} missing from registry"
 
 
@@ -288,6 +289,42 @@ def test_dtype_drift_sanctioned_idioms_stay_clean(tmp_path):
         "    m0 = jnp.zeros((4, 1), jnp.float32)\n"
         "    return s, m0, acc.astype(jnp.bfloat16)\n")})
     assert by_rule(out, "dtype-drift") == []
+
+
+# ---------------------------------------------------------- shape-polymorphism
+def test_shape_polymorphism_fires_in_traced_fn(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "@jax.jit\n"
+        "def step(x, cache):\n"
+        "    if x.shape[0] > 1:\n"
+        "        x = x * 2\n"
+        "    y = x if getattr(x, 'ndim', 0) > 1 else x[None]\n"
+        "    while len(cache) > 2:\n"
+        "        cache = cache[:-1]\n"
+        "    return x, y, cache\n")})
+    hits = by_rule(out, "shape-polymorphism")
+    assert [f.line for f in hits] == [4, 6, 7]
+    assert all(f.severity == "warning" for f in hits)
+
+
+def test_shape_polymorphism_clean_cases(tmp_path):
+    # shape math outside a test position, value-based branching inside the
+    # trace, and shape dispatch in eager host code are all sanctioned
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def step(x, training):\n"
+        "    pos = jnp.arange(x.shape[1])\n"
+        "    if training:\n"
+        "        x = x + pos\n"
+        "    return jnp.where(x > 0, x, 0.0)\n"
+        "def host_dispatch(x):\n"
+        "    if x.ndim == 2:\n"
+        "        return step(x, False)\n"
+        "    return step(x[None], False)\n")})
+    assert by_rule(out, "shape-polymorphism") == []
 
 
 # ----------------------------------------------------------------- silent-noop
