@@ -1132,6 +1132,82 @@ def _bench_tracing(on_accel):
     return out
 
 
+def _bench_router(on_accel):
+    """Serving-plane guard (ISSUE 12): the SAME deterministic
+    shared-prefix trace routed through 2 in-process replicas by the
+    prefix-affinity router vs alternated round-robin — affinity must win
+    on fleet-wide prefix-cache hit ratio — plus the router's own
+    per-request overhead (placement decision + admission ack over the
+    wire), so the front door can't quietly grow into a serving tax.
+    Host-side by construction: runs on CPU too."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.router import ReplicaServer, Router
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig.tiny(tensor_parallel=False,
+                           use_flash_attention=False)
+    ps, slots, n_req, new_toks = 16, 2, 8 if on_accel else 6, 4
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    head = rng.randint(0, cfg.vocab_size, 2 * ps).astype(np.int32)
+    prompts = [np.concatenate([head,
+                               rng.randint(0, cfg.vocab_size, ps // 2)
+                               .astype(np.int32)]) for _ in range(n_req)]
+
+    def engine():
+        return LLMEngine(model, max_batch_slots=slots, max_seq_len=128,
+                         kv_layout="paged", page_size=ps,
+                         prefill_chunk=ps, metrics_port=0)
+
+    def fleet_hit_ratio(engines):
+        hit = sum(e.stats()["prefix_cache"]["hit_tokens"] for e in engines)
+        tot = sum(e.stats()["prefix_cache"]["prompt_tokens"]
+                  for e in engines)
+        return hit / tot if tot else 0.0
+
+    # affinity-routed pass: live wire path through 2 replicas
+    reps = [ReplicaServer(engine(), name=f"bench-r{i}") for i in range(2)]
+    for r in reps:
+        r.engine.start()
+    router = Router(reps, page_size=ps, affinity_blocks=4)
+    try:
+        t0 = time.perf_counter()
+        for p in prompts:
+            router.request(p, max_new_tokens=new_toks, timeout=120)
+        dt = max(time.perf_counter() - t0, 1e-6)
+        rz = router.routerz()
+        aff_ratio = fleet_hit_ratio([r.engine for r in reps])
+    finally:
+        router.stop()
+        for r in reps:
+            r.engine.stop()
+
+    # round-robin baseline: the SAME trace alternated across fresh engines
+    rr = [engine(), engine()]
+    try:
+        futs = [rr[i % 2].submit(p, max_new_tokens=new_toks)
+                for i, p in enumerate(prompts)]
+        for e in rr:
+            e.run_until_complete()
+        for f in futs:
+            f.result(timeout=1)
+        rr_ratio = fleet_hit_ratio(rr)
+    finally:
+        for e in rr:
+            e.stop()
+    return {
+        "router_affinity_hit_ratio": round(rz["affinity"]["hit_ratio"], 4),
+        "router_prefix_cache_hit_ratio": round(aff_ratio, 4),
+        "router_prefix_cache_hit_ratio_round_robin": round(rr_ratio, 4),
+        "router_overhead_us_per_request": rz["overhead_us_mean"],
+        "router_trace_requests": n_req,
+        "router_trace_tokens_per_sec": round(n_req * new_toks / dt, 1),
+    }
+
+
 def main():
     import jax
 
@@ -1166,7 +1242,8 @@ def main():
                     (_bench_ocr, "ocr"),
                     (_bench_observability, "observability"),
                     (_bench_alerting, "alerting"),
-                    (_bench_tracing, "tracing")):
+                    (_bench_tracing, "tracing"),
+                    (_bench_router, "router")):
         if time.monotonic() > deadline:
             out[f"{tag}_skipped"] = "bench budget exhausted"
             continue

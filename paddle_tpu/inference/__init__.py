@@ -17,7 +17,8 @@ from ..tensor.tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "DynamicBatcher", "LLMEngine", "ServerOverloadedError",
-           "DeadlineExceededError"]
+           "DeadlineExceededError", "Router", "ReplicaServer",
+           "FleetController", "PrefixAffinityTable"]
 
 
 def __getattr__(name):
@@ -26,6 +27,11 @@ def __getattr__(name):
         from . import llm_server          # stack for classic predictor users
 
         return getattr(llm_server, name)
+    if name in ("Router", "ReplicaServer", "FleetController",
+                "PrefixAffinityTable"):   # lazy: the serving plane pulls in
+        from . import router              # the LLM stack transitively
+
+        return getattr(router, name)
     raise AttributeError(name)
 
 

@@ -172,6 +172,9 @@ _M_SPEC_VERIFY_S = _obs.histogram(
 _M_ADM_REORDERS = _obs.counter(
     "llm_admission_reorders_total",
     "Cache-aware admissions that bypassed the FIFO queue head")
+_M_DRAINING = _obs.gauge(
+    "llm_draining_value",
+    "1 while the engine is draining (admission closed, in-flight finishing)")
 
 #: LLMEngine(slo_targets={...}) keys -> SLO series names (observability.slo
 #: sliding-window percentiles + burn rates, README §Observability).
@@ -261,6 +264,9 @@ class _Request:
     spec_accepted: int = 0          # flushed into the coalesced trace
     spec_draft_s: float = 0.0       # spans alongside the decode summary
     spec_verify_s: float = 0.0
+    on_admit: object = None         # fired once at first slot admission —
+                                    # the router's admission ack (after it,
+                                    # the request is no longer retry-safe)
 
 
 def _select_rows(logits, key, do_sample, temperature, top_k, top_p):
@@ -534,6 +540,10 @@ class LLMEngine:
         self._prefill_jit = {}
         self._thread = None
         self._stop = False
+        self._draining = False  # drain(): admission closed, in-flight finish
+        self._adm_inflight = 0  # requests popped from the queue but not yet
+        # in a slot/_prefilling/terminal — keeps _drained() from declaring
+        # the engine empty mid-admission (pump thread owns the writes)
         self._lock = threading.Lock()
         # -------------------------------------------------- telemetry plane
         self._flight_dir = flight_recorder_dir \
@@ -565,6 +575,8 @@ class LLMEngine:
             self.telemetry.register_healthcheck("pump", self._check_pump)
             self.telemetry.register_healthcheck(
                 "pump_heartbeat", self._check_heartbeat)
+            self.telemetry.register_healthcheck(
+                "admission", self._check_admission)
             self.telemetry.start()
         elif alert_rules is not None:
             raise ValueError("alert_rules requires metrics_port (the rules "
@@ -602,10 +614,20 @@ class LLMEngine:
             return False, f"last pump turn {age:.1f}s ago"
         return True, f"last pump turn {age:.3f}s ago"
 
+    def _check_admission(self):
+        """Healthcheck: admission is open.  drain() flips this to failing
+        with detail ``"draining"`` — `/healthz` goes 503 and the router
+        (which probes per-replica health) stops routing here while the
+        in-flight requests finish."""
+        if self._draining:
+            return False, "draining"
+        return True, "accepting"
+
     # ------------------------------------------------------------- public
 
     def submit(self, prompt_ids, max_new_tokens=32, do_sample=False,
-               temperature=1.0, top_k=0, top_p=1.0, timeout=None):
+               temperature=1.0, top_k=0, top_p=1.0, timeout=None,
+               trace_id=None, on_admit=None):
         """Queue one prompt; returns a Future of the generated id list.
         Sampling knobs are PER REQUEST — including ``top_k``: slots with
         different settings decode in the same compiled step (the fused
@@ -618,7 +640,15 @@ class LLMEngine:
         is at max_queue_len the submit raises ServerOverloadedError (shed
         load with a reason, never grow without bound); a dead background
         pump raises immediately instead of handing back a future that can
-        never complete."""
+        never complete.  A DRAINING engine (see drain()) likewise sheds
+        with ServerOverloadedError while its in-flight requests finish.
+
+        ``trace_id`` adopts an inherited trace id (a router propagating
+        one request id across the wire) instead of minting a fresh one;
+        ``on_admit`` is a zero-arg callback fired ONCE when the request
+        first lands in a batch slot — the admission ack after which the
+        request must not be retried elsewhere (it will produce output
+        here)."""
         if self._pump_error is not None:
             raise RuntimeError(
                 "LLMEngine pump thread died; restart the engine"
@@ -649,8 +679,18 @@ class LLMEngine:
                        if timeout is not None else None,
                        submit_ts=now,
                        trace=self._tracer.start_trace(
-                           "llm_request", prompt_tokens=int(arr.size),
-                           max_new_tokens=int(max_new_tokens)))
+                           "llm_request", trace_id=trace_id,
+                           prompt_tokens=int(arr.size),
+                           max_new_tokens=int(max_new_tokens)),
+                       on_admit=on_admit)
+        if self._draining:
+            _M_SHED.inc()
+            _flight.record_event("shed", reason="draining",
+                                 prompt_len=int(arr.size), **_trace_kv(req))
+            req.trace.end(status="shed", reason="draining")
+            raise ServerOverloadedError(
+                "engine is draining (drain() in progress): new submits are "
+                "rejected — route to another replica")
         try:
             if self.max_queue_len is not None and self.max_queue_len <= 0:
                 raise queue.Full
@@ -762,6 +802,7 @@ class LLMEngine:
             "pump_error": repr(self._pump_error)
             if self._pump_error is not None else None,
             "stopping": self._stop,
+            "draining": self._draining,
             "requests": {
                 "submitted": _M_SUBMITTED.value,
                 "admitted": _M_ADMITTED.value,
@@ -826,6 +867,59 @@ class LLMEngine:
             self._fail_pending(RuntimeError("LLMEngine stopped"))
             # a fully-terminated pump leaves the engine clean and reusable
             self._stop = False
+
+    # ------------------------------------------------------------ draining
+
+    def _drained(self):
+        """True when nothing is queued, in the pump's hands mid-admission,
+        mid-prefill, or decoding."""
+        return (self._adm_inflight == 0 and self._pending.empty()
+                and self._prefilling is None
+                and all(r is None for r in self.slot_req))
+
+    def drain(self, timeout=None):
+        """Graceful drain — the zero-loss half of a rolling restart.
+
+        Flips the engine to DRAINING: new submits shed with
+        ServerOverloadedError, the "admission" healthcheck fails (so
+        `/healthz` goes 503 with detail ``"draining"`` and a router stops
+        sending traffic here), but everything already queued or in flight
+        RUNS TO COMPLETION — the contract stop() deliberately does not
+        offer (stop fails in-flight requests).  Idempotent; stays in
+        draining mode until resume() (so a controller can drain, restart,
+        then resume).
+
+        Joinable: blocks until the engine is empty and returns True, or
+        returns False when ``timeout`` (seconds, monotonic) elapses first
+        or the pump dies/stops mid-drain.  With a live background pump the
+        wait just sleeps; a caller-pumped (never-started) engine is pumped
+        here via step()."""
+        self._draining = True
+        _M_DRAINING.set(1.0)
+        _flight.record_event("drain_begin",
+                             queue_depth=self._pending.qsize())
+        deadline = None if timeout is None \
+            else self._clock() + float(timeout)
+        while not self._drained():
+            if self._pump_error is not None or self._stop:
+                return False
+            if deadline is not None and self._clock() > deadline:
+                return False
+            if self._thread is not None and self._thread.is_alive():
+                time.sleep(0.002)  # the background pump is doing the work
+            elif self._thread is not None:
+                return False  # pump died without a report mid-drain
+            else:
+                self.step()
+        _flight.record_event("drain_complete")
+        return True
+
+    def resume(self):
+        """Exit draining mode: admission reopens, `/healthz` recovers."""
+        self._draining = False
+        _M_DRAINING.set(0.0)
+        _flight.record_event("drain_resume")
+        return self
 
     def _loop(self):
         try:
@@ -955,6 +1049,14 @@ class LLMEngine:
             attrs["requeue_reason"] = req.requeue_reason
             req.requeue_reason = None
         req.adm_span = req.trace.span("admission", **attrs).open()
+        if req.on_admit is not None:
+            # admission ack: fired exactly once (re-admissions after a
+            # preemption requeue are the SAME request — still admitted)
+            cb, req.on_admit = req.on_admit, None
+            try:
+                cb()
+            except Exception:
+                pass  # a failing ack callback must never kill the pump
 
     def _observe_ttft(self, req):
         """The admission token IS the first token out (both layouts)."""
@@ -1000,34 +1102,44 @@ class LLMEngine:
     def _admit(self):
         free = [i for i, r in enumerate(self.slot_req) if r is None]
         while free and not self._pending.empty():
+            # _adm_inflight (incremented BEFORE the pop) covers the window
+            # where the request is out of the queue but not yet in a slot
+            # or terminal, so drain()'s _drained() — read from another
+            # thread — can never observe a momentarily-empty engine
+            self._adm_inflight += 1
             try:
-                req = self._pending.get_nowait()
-            except queue.Empty:
-                break
-            if req.future.done():
-                # cancelled by the caller, or failed by a pump-death race
-                # — don't waste a slot on it
-                self._end_trace(req, "cancelled")
-                continue
-            if req.deadline is not None and self._clock() > req.deadline:
-                _M_EXPIRED.labels(where="queued").inc()
-                _fail_future(req.future, DeadlineExceededError(
-                    "request deadline expired while queued for admission"))
-                self._end_trace(req, "expired", where="queued")
-                continue
-            slot = free.pop(0)
-            try:
-                self._admit_one(req, slot)
-            except Exception as e:
-                self.slot_req[slot] = None
-                free.insert(0, slot)
-                _fail_future(req.future, e)
-                self._end_trace(req, "error", error=repr(e))
-                if not self._caches_alive():
-                    # the slot writer donates self.caches (see
-                    # _prefill_tick): a consumed-buffer failure is
-                    # engine-fatal, not a per-request one
-                    raise
+                try:
+                    req = self._pending.get_nowait()
+                except queue.Empty:
+                    break
+                if req.future.done():
+                    # cancelled by the caller, or failed by a pump-death
+                    # race — don't waste a slot on it
+                    self._end_trace(req, "cancelled")
+                    continue
+                if req.deadline is not None \
+                        and self._clock() > req.deadline:
+                    _M_EXPIRED.labels(where="queued").inc()
+                    _fail_future(req.future, DeadlineExceededError(
+                        "request deadline expired while queued for "
+                        "admission"))
+                    self._end_trace(req, "expired", where="queued")
+                    continue
+                slot = free.pop(0)
+                try:
+                    self._admit_one(req, slot)
+                except Exception as e:
+                    self.slot_req[slot] = None
+                    free.insert(0, slot)
+                    _fail_future(req.future, e)
+                    self._end_trace(req, "error", error=repr(e))
+                    if not self._caches_alive():
+                        # the slot writer donates self.caches (see
+                        # _prefill_tick): a consumed-buffer failure is
+                        # engine-fatal, not a per-request one
+                        raise
+            finally:
+                self._adm_inflight -= 1
 
     def _admit_one(self, req, slot):
         req.admit_ts = self._clock()
@@ -1441,79 +1553,94 @@ class LLMEngine:
     def _start_prefill(self):
         free = [i for i, r in enumerate(self.slot_req) if r is None]
         while free and not self._pending.empty():
-            req = self._pop_admission_request()
-            if req is None:
+            # _adm_inflight (incremented BEFORE the pop) covers the window
+            # where the request is out of the queue but not yet in
+            # _prefilling, requeued, or terminal — drain()'s _drained(),
+            # read from another thread, must never observe a
+            # momentarily-empty engine mid-admission
+            self._adm_inflight += 1
+            try:
+                req = self._pop_admission_request()
+                if req is None:
+                    return
+                if req.future.done():
+                    # cancelled / failed by a pump-death race
+                    self._end_trace(req, "cancelled")
+                    continue
+                if req.deadline is not None \
+                        and self._clock() > req.deadline:
+                    _M_EXPIRED.labels(where="queued").inc()
+                    _fail_future(req.future, DeadlineExceededError(
+                        "request deadline expired while queued for "
+                        "admission"))
+                    self._end_trace(req, "expired", where="queued")
+                    continue
+                need = -(-(req.prompt.size + 1) // self.ps)
+                matched, shared = 0, []
+                if self._prefix is not None and not req.skip_cache:
+                    if req.match_epoch == self._prefix_epoch \
+                            and req.match_result is not None:
+                        # head-of-line request spinning on a full pool: the
+                        # index hasn't changed, don't re-hash the prompt's
+                        # blocks every tick
+                        matched, shared = req.match_result
+                    else:
+                        matched, shared = self._prefix.match(req.prompt)
+                        req.match_epoch = self._prefix_epoch
+                        req.match_result = (matched, shared)
+                if need > self.num_pages - 1:
+                    # TOTAL need, not unique: a cached prefix's pages
+                    # occupy the same pool, so a slot whose table must
+                    # reference more pages than exist can never complete —
+                    # admitting it would spin head-of-line forever (its
+                    # own matched pages pin the cache against eviction)
+                    _fail_future(req.future, ServerOverloadedError(
+                        f"prompt needs {need} kv pages but the pool only "
+                        f"has {self.num_pages - 1}; rejected"))
+                    self._end_trace(req, "shed", reason="pool_too_small",
+                                    pages_needed=int(need))
+                    continue
+                slot = free[0]
+                if shared:
+                    # map the cached prefix straight into the slot's
+                    # table; admission below is charged only for the
+                    # UNIQUE pages
+                    for p in shared:
+                        self._incref(p)
+                    self._slot_pages[slot] = list(shared)
+                    self._pt_host[slot, :len(shared)] = shared
+                    self._pt_dirty = True
+                if not self._alloc_pages(slot, need - len(shared)):
+                    # admission by free pages: head-of-line waits for
+                    # reclamation (put it back where it came from; the
+                    # shared holds roll back so the cache stays evictable
+                    # meanwhile)
+                    self._release_pages(slot)
+                    with self._pending.mutex:
+                        self._pending.queue.appendleft(req)
+                    return
+                # first admission EVER (admit_ts is stamped once and
+                # survives requeues): preemption/COW-starvation retries
+                # must not observe queue-wait twice nor double-count the
+                # hit-ratio denominator
+                first_admission = req.admit_ts is None
+                req.admit_ts = self._clock()
+                if req.submit_ts is not None and first_admission:
+                    self._trace_queue_wait(req)
+                    self._prefix_prompt_tokens += int(req.prompt.size)
+                    self._prefix_hit_tokens += int(matched)
+                    req.hit_tokens = int(matched)  # reversed if the
+                    # prefill is abandoned by a COW-starvation requeue
+                    # (the skipped chunks get recomputed privately, so the
+                    # hit never happened)
+                self._open_admission_span(req, slot,
+                                          cached_tokens=int(matched))
+                # chunked prefill starts at the first UNCACHED token — a
+                # hit skips every chunk the cache already covers
+                self._prefilling = (req, slot, matched)
                 return
-            if req.future.done():
-                # cancelled / failed by a pump-death race
-                self._end_trace(req, "cancelled")
-                continue
-            if req.deadline is not None and self._clock() > req.deadline:
-                _M_EXPIRED.labels(where="queued").inc()
-                _fail_future(req.future, DeadlineExceededError(
-                    "request deadline expired while queued for admission"))
-                self._end_trace(req, "expired", where="queued")
-                continue
-            need = -(-(req.prompt.size + 1) // self.ps)
-            matched, shared = 0, []
-            if self._prefix is not None and not req.skip_cache:
-                if req.match_epoch == self._prefix_epoch \
-                        and req.match_result is not None:
-                    # head-of-line request spinning on a full pool: the
-                    # index hasn't changed, don't re-hash the prompt's
-                    # blocks every tick
-                    matched, shared = req.match_result
-                else:
-                    matched, shared = self._prefix.match(req.prompt)
-                    req.match_epoch = self._prefix_epoch
-                    req.match_result = (matched, shared)
-            if need > self.num_pages - 1:
-                # TOTAL need, not unique: a cached prefix's pages occupy
-                # the same pool, so a slot whose table must reference more
-                # pages than exist can never complete — admitting it would
-                # spin head-of-line forever (its own matched pages pin the
-                # cache against eviction)
-                _fail_future(req.future, ServerOverloadedError(
-                    f"prompt needs {need} kv pages but the pool only has "
-                    f"{self.num_pages - 1}; rejected"))
-                self._end_trace(req, "shed", reason="pool_too_small",
-                                pages_needed=int(need))
-                continue
-            slot = free[0]
-            if shared:
-                # map the cached prefix straight into the slot's table;
-                # admission below is charged only for the UNIQUE pages
-                for p in shared:
-                    self._incref(p)
-                self._slot_pages[slot] = list(shared)
-                self._pt_host[slot, :len(shared)] = shared
-                self._pt_dirty = True
-            if not self._alloc_pages(slot, need - len(shared)):
-                # admission by free pages: head-of-line waits for
-                # reclamation (put it back where it came from; the shared
-                # holds roll back so the cache stays evictable meanwhile)
-                self._release_pages(slot)
-                with self._pending.mutex:
-                    self._pending.queue.appendleft(req)
-                return
-            # first admission EVER (admit_ts is stamped once and survives
-            # requeues): preemption/COW-starvation retries must not observe
-            # queue-wait twice nor double-count the hit-ratio denominator
-            first_admission = req.admit_ts is None
-            req.admit_ts = self._clock()
-            if req.submit_ts is not None and first_admission:
-                self._trace_queue_wait(req)
-                self._prefix_prompt_tokens += int(req.prompt.size)
-                self._prefix_hit_tokens += int(matched)
-                req.hit_tokens = int(matched)  # reversed if the prefill is
-                # abandoned by a COW-starvation requeue (the skipped chunks
-                # get recomputed privately, so the hit never happened)
-            self._open_admission_span(req, slot,
-                                      cached_tokens=int(matched))
-            # chunked prefill starts at the first UNCACHED token — a hit
-            # skips every chunk the cache already covers
-            self._prefilling = (req, slot, matched)
-            return
+            finally:
+                self._adm_inflight -= 1
 
     def _prefill_tick(self):
         """Run ONE prefill chunk of the admitting request; on the final
@@ -1541,7 +1668,6 @@ class LLMEngine:
             # the chunk would write into a page other slots still read and
             # no page can be freed for the fork: requeue recompute-style
             # (fully private next time) instead of wedging or failing
-            self._prefilling = None
             self._release_pages(slot)
             req.skip_cache = True
             # the hit credited at admission never materialized: the private
@@ -1558,6 +1684,10 @@ class LLMEngine:
             req.trace.inc_attr("preempt_requeues")
             with self._pending.mutex:
                 self._pending.queue.appendleft(req)
+            # clear the marker only after the requeue is visible, so
+            # drain()'s lock-free _drained() never sees an empty queue
+            # with the request parked nowhere
+            self._prefilling = None
             return
         chunk = np.full((1, C), self.pad, np.int32)
         chunk[0, :m] = req.prompt[done:done + m]
@@ -1591,7 +1721,6 @@ class LLMEngine:
         if done < n:
             self._prefilling = (req, slot, done)
             return
-        self._prefilling = None
         # the slot's pages now hold the whole prompt's kv: index the full
         # blocks + partial tail so CONCURRENT same-prefix requests hit
         # (insert precedes the first decode write, whose COW check then
@@ -1604,6 +1733,11 @@ class LLMEngine:
         self.slot_req[slot] = req
         self.slot_pos[slot] = n
         self.last_token[slot] = tok
+        # only now drop the in-flight marker: drain()'s lock-free
+        # _drained() must never observe _prefilling cleared while the
+        # slot is not yet active, or it declares the engine empty with
+        # this request still about to decode
+        self._prefilling = None
         _M_ADMITTED.inc()
         if req.adm_span is not None:
             req.adm_span.close()

@@ -35,9 +35,53 @@ import hashlib
 
 import numpy as np
 
-__all__ = ["PrefixCache"]
+__all__ = ["PrefixCache", "chained_block_key", "prefix_key"]
 
 _ROOT = b""  # parent key of a prompt's first block
+
+
+def chained_block_key(parent, blk_bytes, partial=False):
+    """Key of one page block given its ``parent`` chain key.
+
+    ``sha1(parent || tokens)`` — the key commits to the entire prefix up to
+    and including this block.  This is the ONE derivation shared by the
+    radix index below and the router's affinity table
+    (``inference.router``): factoring it here is what guarantees the two
+    can never diverge on what counts as "the same prefix".
+    """
+    h = hashlib.sha1(parent)
+    if partial:
+        # domain-separate partial tails: a 7-token tail must never
+        # collide with a full block whose first bytes match
+        h.update(b"\x00partial\x00")
+    h.update(blk_bytes)
+    return h.digest()
+
+
+def prefix_key(prompt, page_size, blocks=None):
+    """Affinity key of ``prompt``: the chained key of its cacheable prefix.
+
+    Chains the same page-aligned block keys ``PrefixCache`` indexes (over
+    the ``len(prompt) - 1`` usable tokens — the last token is always
+    recomputed), capped at ``blocks`` full blocks so a router can bucket on
+    the shared head (system prompt + few-shot prefix) instead of the whole
+    prompt.  Prompts shorter than one page fall back to the
+    domain-separated partial-tail key, matching ``PrefixCache.insert``'s
+    tail node — so two requests get the same key exactly when the cache
+    would give them the same chain.
+    """
+    prompt = np.asarray(prompt, np.int32)
+    ps = int(page_size)
+    usable = max(0, prompt.size - 1)
+    full = usable // ps
+    if blocks is not None:
+        full = min(full, int(blocks))
+    key = _ROOT
+    for i in range(full):
+        key = chained_block_key(key, prompt[i * ps:(i + 1) * ps].tobytes())
+    if full == 0 and usable > 0:
+        key = chained_block_key(key, prompt[:usable].tobytes(), partial=True)
+    return key
 
 
 class _Node:
@@ -74,15 +118,10 @@ class PrefixCache:
         self._tick += 1
         node.last_used = self._tick
 
-    @staticmethod
-    def _child_key(parent, blk_bytes, partial=False):
-        h = hashlib.sha1(parent)
-        if partial:
-            # domain-separate partial tails: a 7-token tail must never
-            # collide with a full block whose first bytes match
-            h.update(b"\x00partial\x00")
-        h.update(blk_bytes)
-        return h.digest()
+    # kept as a method name so call sites read as "the cache's key scheme";
+    # the derivation itself lives in chained_block_key (shared with the
+    # router's affinity table)
+    _child_key = staticmethod(chained_block_key)
 
     # ------------------------------------------------------------- lookup
 

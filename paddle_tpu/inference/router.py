@@ -1,0 +1,979 @@
+"""Multi-replica serving plane: prefix-affinity router + fleet controller.
+
+One ``LLMEngine`` is a single process; fleet traffic needs a front door
+over N of them.  This module is that door, stdlib-only (http.client /
+json / threading — the same constraint as the telemetry stack):
+
+- **ReplicaServer** puts one engine on the wire by registering three app
+  endpoints on the engine's EXISTING ``TelemetryServer`` (one port serves
+  data + `/metrics` + `/healthz`): ``POST /admitz`` (submit; immediate
+  accepted/shed ack), ``GET /pollz`` (bounded wait for the result),
+  ``POST /cancelz`` (the retry-safety probe — see below).
+- **Router** places each request by PREFIX AFFINITY first: the prompt's
+  chained page-block key (``prefix_cache.prefix_key`` — the SAME
+  derivation the radix index uses, so router and cache can never diverge)
+  looks up a bounded LRU affinity table mapping prefix -> replica, and
+  same-prefix traffic lands where the kv pages are already warm.  Cold
+  prefixes and dead affinities fall to LOAD-AWARE scoring fed by the
+  fleet ``Scraper`` (queue depth, kv-page utilization, SLO burn rate),
+  with round-robin breaking ties.  Per-replica deadlines bound each hop,
+  failures retry on the next replica, and a saturated fleet sheds with
+  ``ServerOverloadedError``.
+- **FleetController** closes the loop: it feeds the router's scrape
+  samples through the alerting plane (``AlertEngine`` + ``AlertPolicy``)
+  and executes the decisions — restart unhealthy replicas (port pinned,
+  so the address survives), QUARANTINE one that flaps (too many restarts
+  inside a window), and emit scale-up/down signals from sustained
+  burn-rate/backlog episodes.
+
+Retry-safety rule (README §Serving): a request may be retried on another
+replica ONLY when this one confirmably never accepted it — connect
+refused (nothing sent), a 503 ``admitted: false`` ack, an unknown
+``req_id``, or a ``/cancelz`` that reports the cancel WON (the replica
+will never deliver tokens for it).  After a stall/reset mid-exchange the
+router reconnects and asks ``/cancelz``: cancel won -> safe to retry
+elsewhere; cancel lost -> the result already exists, fetch it via
+``/pollz``.  Either way a request's tokens are delivered from exactly one
+replica.
+
+Trace propagation: the router starts one trace per request and ships its
+``trace_id`` in the ``/admitz`` body; the replica's engine adopts it
+(``submit(trace_id=)``), and a shared ``TraceStore`` grafts the two
+segments into ONE ``/tracez`` document — router hop and replica
+execution under a single id.
+
+No jax / numpy-heavy imports at module top beyond what prefix_key needs;
+the router itself never touches the device.
+"""
+from __future__ import annotations
+
+import http.client
+import itertools
+import json
+import socket
+import threading
+import time
+import urllib.parse
+import uuid
+from collections import OrderedDict, deque
+from concurrent.futures import Future, ThreadPoolExecutor
+
+import numpy as np
+
+from ..observability import flight_recorder as _flight
+from ..observability import metrics as _obs
+from ..observability import tracing as _tracing
+from .llm_server import DeadlineExceededError, ServerOverloadedError
+from .prefix_cache import prefix_key
+
+__all__ = ["PrefixAffinityTable", "ReplicaServer", "Router",
+           "FleetController"]
+
+# Router/fleet telemetry (README §Observability catalogue).
+_M_REQS = _obs.counter(
+    "router_requests_total",
+    "Requests routed, by terminal outcome", labelnames=("outcome",))
+_M_AFF_HITS = _obs.counter(
+    "router_affinity_hits_total",
+    "Requests routed to their prefix-affine replica")
+_M_AFF_MISSES = _obs.counter(
+    "router_affinity_misses_total",
+    "Requests with no usable prefix affinity (cold or replica unroutable)")
+_M_RETRIES = _obs.counter(
+    "router_retries_total",
+    "Un-accepted requests re-routed to the next replica")
+_M_SHED_R = _obs.counter(
+    "router_requests_shed_total",
+    "Requests shed by the router (no routable replica / fleet saturated)")
+_M_DUR = _obs.histogram(
+    "router_request_duration_seconds",
+    "End-to-end routed request latency (router-side)")
+_M_OVERHEAD = _obs.histogram(
+    "router_overhead_seconds",
+    "Router-added latency: routing decision + admission ack, excluding "
+    "replica execution")
+_M_REPLICA_UP = _obs.gauge(
+    "router_replica_up",
+    "Replica routability as the router sees it (1 routable, 0 not)",
+    labelnames=("replica",))
+_M_AFF_DEPTH = _obs.gauge(
+    "router_affinity_table_depth",
+    "Prefix->replica entries in the bounded affinity table")
+_M_FLEET_RESTARTS = _obs.counter(
+    "fleet_restarts_total",
+    "Replica restarts executed by the fleet controller")
+_M_FLEET_QUARANTINES = _obs.counter(
+    "fleet_quarantines_total",
+    "Replicas quarantined for flapping (restart storm inside the window)")
+_M_SCALE_SIGNAL = _obs.gauge(
+    "fleet_scale_signal_value",
+    "Latest controller scale signal (+1 scale up, -1 scale down, 0 hold)")
+_M_SCALE_UP = _obs.counter(
+    "fleet_scale_up_signals_total",
+    "Sustained burn-rate/backlog episodes that asked for more replicas")
+_M_SCALE_DOWN = _obs.counter(
+    "fleet_scale_down_signals_total",
+    "Sustained idle episodes that allowed shrinking the fleet")
+
+
+def _http_json(host, port, method, path, body=None, timeout=5.0):
+    """One JSON request/response over a fresh connection.  Uses
+    ``http.client`` (socket.create_connection underneath), so the
+    fault-injection harness (testing.faults.SocketFaults) applies."""
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        payload = None
+        headers = {}
+        if body is not None:
+            payload = json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        conn.request(method, path, body=payload, headers=headers)
+        resp = conn.getresponse()
+        raw = resp.read()
+        doc = json.loads(raw) if raw else {}
+        return resp.status, doc
+    finally:
+        conn.close()
+
+
+# --------------------------------------------------------------- affinity
+class PrefixAffinityTable:
+    """Bounded LRU map of prefix key -> replica name.
+
+    The key is ``prefix_cache.prefix_key`` of the prompt — the chained
+    page-block hash the radix index itself uses, so "same prefix" means
+    exactly "would share kv pages".  Bounded: recording past ``capacity``
+    evicts the least-recently-used entry, so a long-tailed prefix
+    population can never grow the router without bound.
+    """
+
+    def __init__(self, capacity=4096):
+        self.capacity = max(1, int(capacity))
+        self._table: "OrderedDict[bytes, str]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def __len__(self):
+        return len(self._table)
+
+    def get(self, key):
+        """Replica recorded for ``key`` (LRU-touched), or None."""
+        with self._lock:
+            name = self._table.get(key)
+            if name is not None:
+                self._table.move_to_end(key)
+            return name
+
+    def record(self, key, replica):
+        with self._lock:
+            self._table[key] = str(replica)
+            self._table.move_to_end(key)
+            while len(self._table) > self.capacity:
+                self._table.popitem(last=False)
+            _M_AFF_DEPTH.set(len(self._table))
+
+    def drop_replica(self, replica):
+        """Forget every entry pointing at ``replica`` (it restarted or
+        left: its kv pages are gone, the affinity is stale)."""
+        with self._lock:
+            dead = [k for k, v in self._table.items() if v == replica]
+            for k in dead:
+                del self._table[k]
+            _M_AFF_DEPTH.set(len(self._table))
+        return len(dead)
+
+
+# --------------------------------------------------------------- replica
+class _PendingRequest:
+    """Replica-side record of one wire request."""
+
+    __slots__ = ("future", "admitted", "cancelled")
+
+    def __init__(self, future):
+        self.future = future
+        self.admitted = threading.Event()  # set at first slot admission
+        self.cancelled = False
+
+
+class ReplicaServer:
+    """One engine on the wire, riding its own telemetry server's port.
+
+    Requires an engine built with ``metrics_port=`` (the data plane
+    shares the telemetry socket — one address per replica for `/admitz`,
+    `/pollz`, `/cancelz`, `/metrics`, `/healthz`, `/tracez`).  The port
+    is PINNED at construction: a ``restart()`` rebinds the same address,
+    so the router's target list stays valid across controller restarts.
+    """
+
+    #: Completed wire requests linger until this many are outstanding —
+    #: a crashed router must not leak the result table without bound.
+    MAX_PENDING = 1024
+
+    def __init__(self, engine, name=None):
+        if engine.telemetry is None:
+            raise ValueError(
+                "ReplicaServer needs an engine with metrics_port= (the "
+                "wire endpoints ride the telemetry server's port)")
+        self.engine = engine
+        engine.telemetry.pin()  # restart() must rebind the same address
+        self.name = str(name) if name else f"replica-{engine.telemetry.port}"
+        self._pending: "OrderedDict[str, _PendingRequest]" = OrderedDict()
+        self._lock = threading.Lock()
+        tel = engine.telemetry
+        tel.register_post_endpoint("/admitz", self._admitz)
+        tel.register_post_endpoint("/cancelz", self._cancelz)
+        tel.register_json_endpoint("/pollz", self._pollz)
+
+    @property
+    def port(self):
+        return self.engine.telemetry.port
+
+    @property
+    def url(self):
+        return self.engine.telemetry.url
+
+    def target(self):
+        """``host:port`` string for the router / scraper target list."""
+        return f"{self.engine.telemetry.host}:{self.port}"
+
+    # ------------------------------------------------------------ wire API
+    def _admitz(self, query, body):
+        """POST /admitz: submit one request.  Immediate ack: 200
+        ``{"accepted": true}`` once the engine queued it (it WILL resolve
+        — tokens or a terminal error — retrying elsewhere now risks double
+        execution), 503 ``{"accepted": false}`` when shed (draining /
+        queue full: confirmably never accepted, retry-safe)."""
+        try:
+            doc = json.loads(body or b"{}")
+            req_id = str(doc["req_id"])
+            prompt = np.asarray(doc["prompt_ids"], np.int32)
+        except Exception as e:
+            return 400, {"accepted": False, "error": f"bad request: {e!r}"}
+        rec_holder = {}
+
+        def on_admit():
+            rec = rec_holder.get("rec")
+            if rec is not None:
+                rec.admitted.set()
+
+        try:
+            fut = self.engine.submit(
+                prompt,
+                max_new_tokens=int(doc.get("max_new_tokens", 32)),
+                do_sample=bool(doc.get("do_sample", False)),
+                temperature=float(doc.get("temperature", 1.0)),
+                top_k=int(doc.get("top_k", 0)),
+                top_p=float(doc.get("top_p", 1.0)),
+                timeout=doc.get("timeout"),
+                trace_id=doc.get("trace_id") or None,
+                on_admit=on_admit)
+        except ServerOverloadedError as e:
+            return 503, {"accepted": False, "error": str(e),
+                         "draining": bool(self.engine.stats().get(
+                             "draining"))}
+        except Exception as e:
+            return 500, {"accepted": False, "error": repr(e)}
+        rec = _PendingRequest(fut)
+        rec_holder["rec"] = rec
+        with self._lock:
+            self._pending[req_id] = rec
+            # evict the OLDEST finished records past the bound; live
+            # futures are never dropped (their results must stay pollable)
+            while len(self._pending) > self.MAX_PENDING:
+                victim = next((k for k, r in self._pending.items()
+                               if r.future.done()), None)
+                if victim is None:
+                    break
+                del self._pending[victim]
+        return 200, {"accepted": True, "req_id": req_id,
+                     "replica": self.name}
+
+    def _cancelz(self, query, body):
+        """POST /cancelz?req_id=: the retry-safety probe.  ``cancelled:
+        true`` => this replica will NEVER deliver tokens for the request
+        (safe to retry it elsewhere); ``cancelled: false`` => the result
+        already exists — fetch it with /pollz instead of retrying."""
+        q = urllib.parse.parse_qs(query or "")
+        req_id = (q.get("req_id") or [None])[0]
+        with self._lock:
+            rec = self._pending.get(req_id or "")
+        if rec is None:
+            return 404, {"error": f"unknown req_id {req_id!r}"}
+        won = rec.future.cancel()
+        if won:
+            rec.cancelled = True
+        return 200, {"cancelled": bool(won or rec.future.cancelled()),
+                     "admitted": rec.admitted.is_set()}
+
+    def _pollz(self, query):
+        """GET /pollz?req_id=&wait_s=: bounded wait for the result.  The
+        wait is on the request FUTURE, so a routed caller needs no
+        long-lived connection into the engine thread."""
+        q = urllib.parse.parse_qs(query or "")
+        req_id = (q.get("req_id") or [None])[0]
+        try:
+            wait_s = float((q.get("wait_s") or [0.0])[0])
+        except ValueError:
+            wait_s = 0.0
+        with self._lock:
+            rec = self._pending.get(req_id or "")
+        if rec is None:
+            return 404, {"error": f"unknown req_id {req_id!r}"}
+        fut = rec.future
+        if wait_s > 0 and not fut.done():
+            try:
+                fut.result(timeout=wait_s)
+            except Exception:
+                pass  # classified below from the future's terminal state
+        if not fut.done():
+            return 200, {"done": False, "admitted": rec.admitted.is_set()}
+        with self._lock:
+            self._pending.pop(req_id, None)
+        if fut.cancelled():
+            return 200, {"done": True, "error": "cancelled",
+                         "error_type": "cancelled"}
+        exc = fut.exception()
+        if exc is not None:
+            return 200, {"done": True, "error": str(exc),
+                         "error_type": type(exc).__name__}
+        return 200, {"done": True, "tokens": list(fut.result())}
+
+    # ----------------------------------------------------------- lifecycle
+    def drain(self, timeout=None):
+        return self.engine.drain(timeout=timeout)
+
+    def restart(self):
+        """Stop and restart the engine in place (the controller's restart
+        actuation).  The pinned telemetry port rebinds the same address;
+        draining state clears — a restarted replica serves."""
+        self.engine.stop()
+        self.engine.resume()
+        self.engine.start()
+        return self
+
+
+# ----------------------------------------------------------------- router
+class _ReplicaState:
+    """Router-side view of one replica."""
+
+    __slots__ = ("name", "host", "port", "up", "draining", "quarantined",
+                 "restart_marks")
+
+    def __init__(self, name, host, port):
+        self.name = str(name)
+        self.host = host
+        self.port = int(port)
+        self.up = True          # until a poll says otherwise
+        self.draining = False
+        self.quarantined = False
+        self.restart_marks = deque()  # mono stamps of controller restarts
+
+    @property
+    def routable(self):
+        return self.up and not self.draining and not self.quarantined
+
+    def state(self):
+        if self.quarantined:
+            return "quarantined"
+        if self.draining:
+            return "draining"
+        return "up" if self.up else "down"
+
+    def to_dict(self):
+        return {"name": self.name, "target": f"{self.host}:{self.port}",
+                "state": self.state(), "up": self.up,
+                "draining": self.draining, "quarantined": self.quarantined,
+                "restarts": len(self.restart_marks)}
+
+
+class Router:
+    """Prefix-affinity-first HTTP router over N engine replicas.
+
+    ``replicas``: list of :class:`ReplicaServer` (in-process fleet) or
+    ``(name, "host:port")`` pairs / bare ``"host:port"`` strings (remote
+    fleet).  ``page_size`` and ``affinity_blocks`` define the affinity
+    key: the chained hash of the first ``affinity_blocks`` full
+    page-blocks of the prompt (``prefix_cache.prefix_key``) — deep enough
+    to separate system prompts, shallow enough that "same system prompt,
+    different question" still maps to one bucket.
+
+    Placement: affinity hit on a routable replica wins; otherwise
+    replicas are scored by the latest scrape samples (queue depth +
+    weighted kv-page utilization + weighted worst SLO burn rate) and the
+    round-robin cursor breaks ties — then the affinity is (re)recorded
+    for the replica that actually ACCEPTED the request.
+
+    ``poll()`` refreshes the fleet view: one scrape per replica (load
+    samples + scrape staleness -> up/down) plus one direct ``/healthz``
+    probe (per-replica draining detection — the healthcheck GAUGE is
+    process-global and aliases in-process fleets, the JSON detail is
+    not).  Call it from the controller's tick or any operator loop.
+    """
+
+    def __init__(self, replicas, page_size=128, affinity_blocks=4,
+                 affinity_capacity=4096, request_timeout_s=30.0,
+                 per_replica_timeout_s=None, max_retries=None,
+                 scrape_timeout_s=2.0, staleness_s=30.0, poll_wait_s=0.05,
+                 metrics_port=None, tracer=None, clock=time.monotonic,
+                 max_workers=8):
+        from ..observability.scrape import Scraper, ScrapeTarget
+
+        self.ps = int(page_size)
+        self.affinity_blocks = int(affinity_blocks)
+        self.affinity = PrefixAffinityTable(affinity_capacity)
+        self.request_timeout_s = float(request_timeout_s)
+        self.per_replica_timeout_s = None if per_replica_timeout_s is None \
+            else float(per_replica_timeout_s)
+        self.poll_wait_s = float(poll_wait_s)
+        self.staleness_s = float(staleness_s)
+        self._clock = clock
+        self._tracer = tracer if tracer is not None else _tracing.TRACER
+        self._replicas: "OrderedDict[str, _ReplicaState]" = OrderedDict()
+        for rep in replicas:
+            if isinstance(rep, ReplicaServer):
+                name, target = rep.name, rep.target()
+            elif isinstance(rep, tuple):
+                name, target = rep
+            else:
+                name, target = None, str(rep)
+            host, _, port = str(target).rpartition(":")
+            name = str(name) if name else f"{host}:{port}"
+            if name in self._replicas:
+                raise ValueError(f"duplicate replica name {name!r}")
+            self._replicas[name] = _ReplicaState(name, host, port)
+        if not self._replicas:
+            raise ValueError("Router needs at least one replica")
+        self.max_retries = len(self._replicas) - 1 if max_retries is None \
+            else int(max_retries)
+        self.scraper = Scraper(
+            [ScrapeTarget(f"{r.host}:{r.port}", name=r.name)
+             for r in self._replicas.values()],
+            timeout_s=scrape_timeout_s, retries=0)
+        self._samples = None  # latest fleet SampleSet (load scores)
+        self._rr = itertools.count()  # round-robin tie-breaker cursor
+        self._affinity_hits = 0
+        self._affinity_misses = 0
+        self._shed = 0
+        self._retries = 0
+        self._overhead_s = 0.0  # decision + admission ack, summed
+        self._overhead_n = 0
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(
+            max_workers=int(max_workers),
+            thread_name_prefix="paddle-tpu-router")
+        self.telemetry = None
+        if metrics_port is not None:
+            from ..observability.exporter import TelemetryServer
+
+            self.telemetry = TelemetryServer(port=metrics_port,
+                                             traces=self._tracer)
+            self.telemetry.register_healthcheck("fleet", self._check_fleet)
+            self.telemetry.register_json_endpoint(
+                "/routerz", lambda query: self.routerz())
+            self.telemetry.start()
+
+    # ------------------------------------------------------------ fleet view
+    def _check_fleet(self):
+        n = sum(r.routable for r in self._replicas.values())
+        if n == 0:
+            return False, "no routable replica"
+        return True, f"{n}/{len(self._replicas)} replicas routable"
+
+    def replicas(self):
+        return list(self._replicas.values())
+
+    def quarantine(self, name, on=True):
+        rep = self._replicas[str(name)]
+        rep.quarantined = bool(on)
+        if on:
+            self.affinity.drop_replica(rep.name)
+        self._publish_up()
+        return rep
+
+    def _publish_up(self):
+        for r in self._replicas.values():
+            _M_REPLICA_UP.labels(replica=r.name).set(
+                1.0 if r.routable else 0.0)
+
+    def probe_health(self, rep, timeout=2.0):
+        """Direct per-replica `/healthz` probe: returns the parsed JSON
+        (or None when unreachable) and updates the draining flag from the
+        ``admission`` check's detail — per-replica truth even when N
+        in-process engines alias the process-global gauges."""
+        try:
+            status, doc = _http_json(rep.host, rep.port, "GET", "/healthz",
+                                     timeout=timeout)
+        except Exception:
+            return None
+        checks = doc.get("checks") or {}
+        adm = checks.get("admission") or {}
+        rep.draining = (not adm.get("ok", True)) \
+            and adm.get("detail") == "draining"
+        return doc
+
+    def poll(self):
+        """Refresh the fleet view: scrape every replica (load samples;
+        scrape failure/staleness marks it down) and probe `/healthz` for
+        draining.  Returns ``(SampleSet, [ScrapeResult])`` — the
+        controller feeds both into the alerting plane."""
+        samples, results = self.scraper.poll()
+        for res in results:
+            rep = self._replicas.get(res.target.name)
+            if rep is None:
+                continue
+            rep.up = res.ok and \
+                self.scraper.staleness(rep.name) <= self.staleness_s
+            if rep.up:
+                self.probe_health(rep)
+            else:
+                rep.draining = False  # unreachable, not draining
+        self._samples = samples
+        self._publish_up()
+        return samples, results
+
+    # ------------------------------------------------------------- placement
+    def _sample(self, name, family, selector=None, default=0.0):
+        samples = self._samples
+        if samples is None:
+            return default
+        sel = {"target": name}
+        if selector:
+            sel.update(selector)
+        hits = samples.match(family, sel)
+        return max(v for _, v in hits) if hits else default
+
+    def load_score(self, name):
+        """Lower = less loaded.  Queue depth is the primary signal; page
+        utilization and the worst SLO burn rate weigh in so a replica
+        with a short queue but a nearly-dry page pool (or burning error
+        budget) stops attracting cold traffic."""
+        q = self._sample(name, "llm_queue_depth")
+        util = self._sample(name, "llm_kv_page_utilization_ratio")
+        burn = self._sample(name, "slo_burn_rate_ratio")
+        return q + 4.0 * util + 8.0 * burn
+
+    def pick_replicas(self, prompt_ids):
+        """Ordered candidate list for one request: the prefix-affine
+        replica first (if routable), then the rest by ascending load
+        score with the round-robin cursor breaking ties.  Returns
+        ``(key, [replica_state, ...], affinity_hit)``."""
+        key = prefix_key(prompt_ids, self.ps, blocks=self.affinity_blocks)
+        routable = [r for r in self._replicas.values() if r.routable]
+        aff_name = self.affinity.get(key)
+        first = None
+        hit = False
+        if aff_name is not None:
+            for r in routable:
+                if r.name == aff_name:
+                    first, hit = r, True
+                    break
+        rest = [r for r in routable if r is not first]
+        if rest:
+            rr = next(self._rr)
+            scored = sorted(
+                enumerate(rest),
+                key=lambda iv: (self.load_score(iv[1].name),
+                                (iv[0] - rr) % len(rest)))
+            rest = [r for _, r in scored]
+        order = ([first] if first is not None else []) + rest
+        return key, order, hit
+
+    # ------------------------------------------------------------- data path
+    def request(self, prompt_ids, max_new_tokens=32, do_sample=False,
+                temperature=1.0, top_k=0, top_p=1.0, timeout=None):
+        """Route one request and block for its tokens.
+
+        Raises ``ServerOverloadedError`` when no replica accepts it
+        (fleet saturated / all down), ``DeadlineExceededError`` past the
+        request deadline, or the replica-side error otherwise."""
+        t0 = self._clock()
+        deadline = t0 + (self.request_timeout_s
+                         if timeout is None else float(timeout))
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        trace = self._tracer.start_trace(
+            "router_request", prompt_tokens=int(prompt.size),
+            max_new_tokens=int(max_new_tokens))
+        key, order, aff_hit = self.pick_replicas(prompt)
+        with self._lock:
+            if aff_hit:
+                self._affinity_hits += 1
+            else:
+                self._affinity_misses += 1
+        (_M_AFF_HITS if aff_hit else _M_AFF_MISSES).inc()
+        trace.set_attr("affinity_hit", bool(aff_hit))
+        if not order:
+            self._count_shed(trace, "no_routable_replica")
+            raise ServerOverloadedError(
+                "no routable replica (all down/draining/quarantined)")
+        req_id = uuid.uuid4().hex
+        body = {"req_id": req_id, "prompt_ids": [int(t) for t in prompt],
+                "max_new_tokens": int(max_new_tokens),
+                "do_sample": bool(do_sample),
+                "temperature": float(temperature), "top_k": int(top_k),
+                "top_p": float(top_p),
+                "trace_id": trace.trace_id or None}
+        last_err = None
+        for attempt, rep in enumerate(order[:self.max_retries + 1]):
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                self._finish(trace, "expired", t0, None)
+                raise DeadlineExceededError(
+                    "request deadline expired while routing")
+            hop_budget = remaining if self.per_replica_timeout_s is None \
+                else min(remaining, self.per_replica_timeout_s)
+            if attempt:
+                _M_RETRIES.inc()
+                with self._lock:
+                    self._retries += 1
+                trace.inc_attr("retries")
+            body["timeout"] = round(hop_budget, 3)
+            with trace.span("admit", replica=rep.name,
+                            attempt=attempt) as sp:
+                verdict, doc = self._admit_on(rep, body, hop_budget)
+                sp.set_attr("verdict", verdict)
+            if verdict == "accepted":
+                overhead = max(0.0, self._clock() - t0)
+                _M_OVERHEAD.observe(overhead)
+                with self._lock:
+                    self._overhead_s += overhead
+                    self._overhead_n += 1
+                self.affinity.record(key, rep.name)
+                return self._await_result(rep, req_id, trace, t0,
+                                          deadline, doc)
+            last_err = doc.get("error")
+            if verdict == "down":
+                rep.up = False
+                self.affinity.drop_replica(rep.name)
+                self._publish_up()
+            elif verdict == "draining":
+                rep.draining = True
+                self._publish_up()
+            elif verdict == "dead":
+                # sent but unconfirmable AND /cancelz unreachable: the
+                # replica may still execute it — retrying elsewhere could
+                # deliver twice, so this request fails here
+                self._finish(trace, "error", t0, None)
+                raise ServerOverloadedError(
+                    f"replica {rep.name} died mid-request and its cancel "
+                    f"probe is unreachable; not retry-safe: {last_err}")
+            # "shed"/"rejected": confirmably never accepted — retry next
+        self._count_shed(trace, "retries_exhausted")
+        raise ServerOverloadedError(
+            f"no replica accepted the request after "
+            f"{min(len(order), self.max_retries + 1)} attempt(s); "
+            f"last error: {last_err}")
+
+    def submit(self, prompt_ids, **kwargs):
+        """Async variant: returns a Future of the token list."""
+        return self._pool.submit(self.request, prompt_ids, **kwargs)
+
+    def _admit_on(self, rep, body, hop_budget):
+        """One admission attempt.  Returns ``(verdict, doc)`` with
+        verdict in {"accepted", "shed", "rejected", "down", "draining",
+        "dead"} — "down"/"shed"/"rejected"/"draining" are all
+        CONFIRMABLY un-accepted (retry-safe); "dead" is not."""
+        try:
+            status, doc = _http_json(rep.host, rep.port, "POST", "/admitz",
+                                     body=body, timeout=hop_budget)
+        except (ConnectionRefusedError, ConnectionAbortedError) as e:
+            return "down", {"error": repr(e)}  # nothing reached the peer
+        except (socket.timeout, ConnectionResetError, OSError,
+                http.client.HTTPException) as e:
+            # ambiguous: the request may have been sent.  Reconnect and
+            # ask /cancelz — the retry-safety probe.
+            return self._recover(rep, body["req_id"], e)
+        if status == 200 and doc.get("accepted"):
+            return "accepted", doc
+        if status == 503:
+            return ("draining" if doc.get("draining") else "shed"), doc
+        return "rejected", doc
+
+    def _recover(self, rep, req_id, exc):
+        """Post-stall/reset classification via /cancelz (fresh
+        connection): cancel won -> retry-safe ("shed"); cancel lost ->
+        result exists, poll it ("accepted"); unknown id -> never arrived
+        ("down"); probe unreachable -> "dead" (not retry-safe)."""
+        try:
+            status, doc = _http_json(
+                rep.host, rep.port, "POST",
+                f"/cancelz?req_id={req_id}", timeout=2.0)
+        except Exception:
+            return "dead", {"error": f"{exc!r}; cancel probe unreachable"}
+        if status == 404:
+            return "down", {"error": f"{exc!r}; request never arrived"}
+        if doc.get("cancelled"):
+            return "shed", {"error": f"{exc!r}; cancelled un-admitted"}
+        return "accepted", {"recovered": True}
+
+    def _await_result(self, rep, req_id, trace, t0, deadline, admit_doc):
+        """Poll the accepted request to completion on ``rep``.  The
+        request is past its admission ack, so errors here are terminal —
+        never retried on another replica."""
+        with trace.span("replica_execute", replica=rep.name) as sp:
+            probe_failures = 0
+            while True:
+                remaining = deadline - self._clock()
+                if remaining <= 0:
+                    self._cancel_quiet(rep, req_id)
+                    self._finish(trace, "expired", t0, rep)
+                    raise DeadlineExceededError(
+                        f"request deadline expired awaiting replica "
+                        f"{rep.name}")
+                wait = min(self.poll_wait_s, remaining)
+                try:
+                    status, doc = _http_json(
+                        rep.host, rep.port, "GET",
+                        f"/pollz?req_id={req_id}&wait_s={wait:.3f}",
+                        timeout=max(1.0, wait * 4))
+                except Exception as e:
+                    # admitted work: keep polling on fresh connections
+                    # until the deadline — transient socket faults must
+                    # not lose a request that is still decoding
+                    probe_failures += 1
+                    sp.set_attr("poll_failures", probe_failures)
+                    continue
+                if status == 404:
+                    self._finish(trace, "error", t0, rep)
+                    raise ServerOverloadedError(
+                        f"replica {rep.name} forgot accepted request "
+                        f"{req_id} (restarted?)")
+                if not doc.get("done"):
+                    continue
+                err = doc.get("error")
+                if err is not None:
+                    et = doc.get("error_type", "")
+                    self._finish(trace, "error", t0, rep)
+                    if et == "DeadlineExceededError":
+                        raise DeadlineExceededError(err)
+                    if et == "ServerOverloadedError":
+                        raise ServerOverloadedError(err)
+                    raise RuntimeError(
+                        f"replica {rep.name} failed the request: {err}")
+                tokens = [int(t) for t in doc.get("tokens", [])]
+                sp.set_attr("tokens", len(tokens))
+                self._finish(trace, "ok", t0, rep)
+                return tokens
+
+    def _cancel_quiet(self, rep, req_id):
+        try:
+            _http_json(rep.host, rep.port, "POST",
+                       f"/cancelz?req_id={req_id}", timeout=1.0)
+        except Exception:
+            pass
+
+    def _count_shed(self, trace, reason):
+        _M_SHED_R.inc()
+        _M_REQS.labels(outcome="shed").inc()
+        with self._lock:
+            self._shed += 1
+        _flight.record_event("router_shed", reason=reason)
+        trace.end(status="shed", reason=reason)
+
+    def _finish(self, trace, status, t0, rep):
+        dur = max(0.0, self._clock() - t0)
+        _M_DUR.observe(dur, exemplar=trace.trace_id or None)
+        _M_REQS.labels(outcome=status).inc()
+        trace.end(status=status,
+                  replica=rep.name if rep is not None else None)
+
+    # ------------------------------------------------------------- operator
+    def routerz(self):
+        """The `/routerz` payload: per-replica state + routing counters."""
+        with self._lock:
+            hits, misses = self._affinity_hits, self._affinity_misses
+            shed, retries = self._shed, self._retries
+            ov_s, ov_n = self._overhead_s, self._overhead_n
+        total = hits + misses
+        return {
+            "replicas": [r.to_dict() for r in self._replicas.values()],
+            "affinity": {
+                "entries": len(self.affinity),
+                "capacity": self.affinity.capacity,
+                "hits": hits, "misses": misses,
+                "hit_ratio": hits / total if total else 0.0,
+                "blocks": self.affinity_blocks,
+                "page_size": self.ps,
+            },
+            "shed": shed,
+            "retries": retries,
+            "overhead_us_mean": round(ov_s / ov_n * 1e6, 2) if ov_n
+            else 0.0,
+        }
+
+    def stats(self):
+        return self.routerz()
+
+    def stop(self):
+        self._pool.shutdown(wait=False)
+        if self.telemetry is not None:
+            self.telemetry.stop()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+
+# ------------------------------------------------------------- controller
+class FleetController:
+    """Alert-driven replica lifecycle: restart, quarantine, scale signals.
+
+    Consumes the router's fleet scrape through the PR-7 alerting plane:
+    ``tick()`` polls the router (sense), evaluates the rule set (decide),
+    and executes the policy's decisions (act) — restart a replica whose
+    healthcheck fails or whose scrape target went down/stale, QUARANTINE
+    one that restarts more than ``restart_limit`` times inside
+    ``restart_window_s`` (flapping: restarting it again just burns
+    traffic), and derive scale signals from sustained episodes:
+    ``scale_patience`` consecutive hot ticks (SLO burn / queue backlog
+    firing) emit +1, the same count of idle ticks (nothing firing, empty
+    queues) emit -1.
+
+    ``replicas`` maps name -> :class:`ReplicaServer` for in-process
+    restart actuation; ``restart_hook(name)`` overrides it for external
+    fleets (k8s delete-pod, systemd restart).  Deterministic under an
+    injected ``clock`` and explicit ``tick(samples=, now=)``.
+    """
+
+    def __init__(self, router, replicas=None, rules=None,
+                 restart_hook=None, clock=time.monotonic,
+                 restart_limit=3, restart_window_s=600.0,
+                 scale_patience=3):
+        from ..observability.alerts import (AlertEngine, AlertPolicy,
+                                            default_rules)
+
+        self.router = router
+        self.replicas = dict(replicas or {})
+        self.restart_hook = restart_hook
+        self._clock = clock
+        self.restart_limit = int(restart_limit)
+        self.restart_window_s = float(restart_window_s)
+        self.scale_patience = max(1, int(scale_patience))
+        self.engine = AlertEngine(
+            rules=rules if rules is not None else default_rules(),
+            clock=clock)
+        actions = {r.name: act for r, act in
+                   ((r, self._ACTIONS.get(r.name))
+                    for r in self.engine.rules) if act}
+        self.policy = AlertPolicy(actions=actions, engine=self.engine,
+                                  clock=clock, min_interval_s=0)
+        self._hot_ticks = 0
+        self._cold_ticks = 0
+        self.scale_signal = 0
+        self.restarts: list[tuple] = []      # (now, replica, alert)
+        self.quarantines: list[tuple] = []   # (now, replica)
+
+    #: Which firing rules actuate which lifecycle action.  SLO burn and
+    #: backlog deliberately do NOT restart anything — they are load, not
+    #: sickness; they feed the scale signal instead.
+    _ACTIONS = {
+        "healthcheck_failing": "restart",
+        "scrape_target_down": "restart",
+        "scrape_target_stale": "restart",
+        "slo_burn_rate_high": "widen_deadline",
+        "llm_queue_backlog": "widen_deadline",
+    }
+
+    def tick(self, samples=None, now=None):
+        """One sense-decide-act turn.  Returns a summary dict."""
+        if samples is None:
+            samples, _ = self.router.poll()
+        now = self._clock() if now is None else now
+        decisions = self.policy.poll(samples=samples, now=now)
+        acted = {"restarts": [], "quarantines": [], "decisions":
+                 [d.to_dict() for d in decisions]}
+        for d in decisions:
+            if d.action != "restart":
+                continue
+            if d.alert == "healthcheck_failing" \
+                    and d.labels.get("check") == "admission":
+                continue  # intentional drain, not sickness
+            name = d.labels.get("target")
+            if not name or name not in {r.name for r in
+                                        self.router.replicas()}:
+                continue
+            rep = self.router._replicas[name]
+            if rep.quarantined:
+                continue  # already benched; restarting it again is noise
+            if rep.draining:
+                continue  # let the drain finish; restart would lose work
+            if self._flapping(rep, now):
+                rep.quarantined = True
+                self.router.affinity.drop_replica(name)
+                _M_FLEET_QUARANTINES.inc()
+                self.quarantines.append((now, name))
+                acted["quarantines"].append(name)
+                _flight.record_event("fleet_quarantine", replica=name,
+                                     alert=d.alert)
+                continue
+            self._restart(rep, d, now)
+            acted["restarts"].append(name)
+        self._scale(samples, now)
+        acted["scale"] = self.scale_signal
+        self.router._publish_up()
+        return acted
+
+    def _flapping(self, rep, now):
+        """True when one MORE restart would exceed the per-window limit —
+        the restart storm verdict that benches the replica instead."""
+        while rep.restart_marks and \
+                now - rep.restart_marks[0] > self.restart_window_s:
+            rep.restart_marks.popleft()
+        return len(rep.restart_marks) >= self.restart_limit
+
+    def _restart(self, rep, decision, now):
+        rep.restart_marks.append(now)
+        self.restarts.append((now, rep.name, decision.alert))
+        _M_FLEET_RESTARTS.inc()
+        _flight.record_event("fleet_restart", replica=rep.name,
+                             alert=decision.alert)
+        # stale affinity: the restarted engine's kv pages are gone
+        self.router.affinity.drop_replica(rep.name)
+        if rep.name in self.replicas:
+            self.replicas[rep.name].restart()
+            rep.up = True
+            rep.draining = False
+        elif self.restart_hook is not None:
+            self.restart_hook(rep.name)
+
+    def _scale(self, samples, now):
+        """Sustained-episode scale signal: ``scale_patience`` consecutive
+        hot ticks (burn/backlog firing) => +1; the same count of idle
+        ticks (nothing firing AND no queued work) => -1; otherwise 0."""
+        firing = {f["alert"] for f in self.engine.firing()}
+        hot = bool(firing & {"slo_burn_rate_high", "llm_queue_backlog"})
+        depth = sum(v for _, v in samples.match("llm_queue_depth")) \
+            if samples is not None else 0.0
+        cold = not firing and depth <= 0
+        signal = 0
+        if hot:
+            self._hot_ticks += 1
+            self._cold_ticks = 0
+            if self._hot_ticks == self.scale_patience:
+                signal = 1
+                _M_SCALE_UP.inc()
+        elif cold:
+            self._cold_ticks += 1
+            self._hot_ticks = 0
+            if self._cold_ticks == self.scale_patience:
+                signal = -1
+                _M_SCALE_DOWN.inc()
+        else:
+            self._hot_ticks = 0
+            self._cold_ticks = 0
+        self.scale_signal = signal
+        _M_SCALE_SIGNAL.set(float(signal))
+        if signal:
+            _flight.record_event("fleet_scale_signal", signal=int(signal))
+        return signal
+
+    def stats(self):
+        return {
+            "restarts": len(self.restarts),
+            "quarantines": len(self.quarantines),
+            "scale_signal": self.scale_signal,
+            "hot_ticks": self._hot_ticks,
+            "cold_ticks": self._cold_ticks,
+            "replicas": [r.to_dict() for r in self.router.replicas()],
+        }

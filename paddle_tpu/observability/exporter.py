@@ -82,6 +82,11 @@ class TelemetryServer:
         self._thread = None
         self._checks = {}  # name -> callable() -> truthy | (ok, detail)
         self._checks_lock = threading.Lock()
+        # app endpoints (the router rides the telemetry server instead of
+        # owning a second HTTP stack): path -> fn(query) -> JSON doc for
+        # GETs, path -> fn(query, body_bytes) -> (code, doc) for POSTs
+        self._json_endpoints = {}
+        self._post_endpoints = {}
         self.alerts = None  # AlertEngine served on /alertz
         self._alerts_eval = True
         if alerts is not None:
@@ -96,10 +101,32 @@ class TelemetryServer:
         self._alerts_eval = bool(eval_on_request)
         return self
 
+    def register_json_endpoint(self, path, fn):
+        """Serve ``fn(query_string) -> JSON-serializable doc`` on GET
+        ``path`` (e.g. the router's ``/routerz``).  ``fn`` may instead
+        return ``(status_code, doc)``."""
+        self._json_endpoints[str(path).rstrip("/")] = fn
+        return self
+
+    def register_post_endpoint(self, path, fn):
+        """Serve ``fn(query_string, body_bytes) -> (status_code, doc)`` on
+        POST ``path`` — the data-plane hook (``/admitz``, ``/cancelz``)
+        that lets a replica share one port with its telemetry."""
+        self._post_endpoints[str(path).rstrip("/")] = fn
+        return self
+
     # ----------------------------------------------------------- lifecycle
     @property
     def port(self):
         return self._httpd.server_address[1] if self._httpd else None
+
+    def pin(self):
+        """Freeze the currently-bound port as the requested port, so a
+        ``stop()``/``start()`` cycle (a fleet-controller restart) rebinds
+        the SAME address and the replica's URL stays stable."""
+        if self._httpd is not None:
+            self._requested_port = self._httpd.server_address[1]
+        return self
 
     @property
     def url(self):
@@ -119,6 +146,9 @@ class TelemetryServer:
 
             def do_GET(self):  # noqa: N802 (http.server API)
                 server._handle(self)
+
+            def do_POST(self):  # noqa: N802 (http.server API)
+                server._handle_post(self)
 
             def log_message(self, *args):
                 pass  # scrapes must not spam the training job's stdout
@@ -248,6 +278,12 @@ class TelemetryServer:
                            "firing": self.alerts.firing()}
                 body = json.dumps(doc, default=repr).encode()
                 self._reply(req, 200, "application/json", body)
+            elif path in self._json_endpoints:
+                _M_SCRAPES.labels(endpoint=path.lstrip("/")).inc()
+                out = self._json_endpoints[path](query)
+                code, doc = out if isinstance(out, tuple) else (200, out)
+                self._reply(req, code, "application/json",
+                            json.dumps(doc, default=repr).encode())
             else:
                 _M_HTTP_ERRORS.inc()
                 self._reply(req, 404, "text/plain; charset=utf-8",
@@ -262,6 +298,32 @@ class TelemetryServer:
                             b"internal error\n")
             except Exception:
                 pass  # socket already gone
+
+    def _handle_post(self, req):
+        path, _, query = req.path.partition("?")
+        path = path.rstrip("/") or "/"
+        try:
+            fn = self._post_endpoints.get(path)
+            if fn is None:
+                _M_HTTP_ERRORS.inc()
+                self._reply(req, 404, "text/plain; charset=utf-8",
+                            b"not found\n")
+                return
+            _M_SCRAPES.labels(endpoint=path.lstrip("/")).inc()
+            length = int(req.headers.get("Content-Length") or 0)
+            body = req.rfile.read(length) if length > 0 else b""
+            code, doc = fn(query, body)
+            self._reply(req, code, "application/json",
+                        json.dumps(doc, default=repr).encode())
+        except BrokenPipeError:
+            pass
+        except Exception:
+            _M_HTTP_ERRORS.inc()
+            try:
+                self._reply(req, 500, "text/plain; charset=utf-8",
+                            b"internal error\n")
+            except Exception:
+                pass
 
     def _handle_tracez(self, req, query):
         """`/tracez` contract: list (``?limit=N``), fetch

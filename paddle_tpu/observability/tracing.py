@@ -161,8 +161,11 @@ class Trace:
                  "slo_violations", "sampled_reason", "root", "_t0",
                  "_stack", "_tracer", "_end_lock", "_ended")
 
-    def __init__(self, tracer, name, attrs=None):
-        self.trace_id = tracer._next_id()
+    def __init__(self, tracer, name, attrs=None, trace_id=None):
+        # an inherited id (router -> replica propagation) keeps both hops
+        # of one request under a single /tracez document — the store
+        # grafts same-id segments into one tree
+        self.trace_id = str(trace_id) if trace_id else tracer._next_id()
         self.name = str(name)
         self.status = None  # set by end()
         # one wall stamp per trace: forensic joins with external logs share
@@ -443,11 +446,46 @@ class TraceStore:
             return "slo"
         return None
 
+    @staticmethod
+    def _graft(primary, other):
+        """Merge ``other`` (a same-id segment of the same request — e.g.
+        the replica-side trace of a routed call) into ``primary``'s tree
+        as one child span named after ``other``.  Offsets come from the
+        segments' wall stamps (the only clock two processes share)."""
+        sp = Span(other.name,
+                  max(0.0, other.start_unix - primary.start_unix),
+                  other.root.attrs)
+        sp.duration_s = other.duration_s or 0.0
+        if other.status is not None and other.status != "ok":
+            sp.error = other.status
+        sp.children = list(other.root.children)
+        primary.root.children.append(sp)
+        for s in other.slo_violations:
+            if s not in primary.slo_violations:
+                primary.slo_violations.append(s)
+
     def offer(self, trace):
         """Tail-sampling decision for one completed trace.  Returns the
-        keep reason, or None when the trace was dropped."""
+        keep reason, or None when the trace was dropped.
+
+        A trace whose id is ALREADY stored is a second segment of the
+        same request (inherited ids, ``Tracer.start_trace(trace_id=)``):
+        it is grafted into the stored tree — earliest segment becomes the
+        root (the router hop starts before the replica hop) — instead of
+        overwriting it, so `/tracez` shows one document for the whole
+        routed request."""
         reason = self.keep_reason(trace)
         with self._lock:
+            existing = self._traces.get(trace.trace_id)
+            if existing is not None and existing is not trace:
+                if trace.start_unix <= existing.start_unix:
+                    primary, other = trace, existing
+                else:
+                    primary, other = existing, trace
+                self._graft(primary, other)
+                primary.sampled_reason = existing.sampled_reason
+                self._traces[trace.trace_id] = primary
+                return primary.sampled_reason
             if reason is None:
                 if self.sample_every:
                     self._ok_seen += 1
@@ -553,11 +591,14 @@ class Tracer:
             self._seq += 1
             return f"{self._run}-{self._seq:06x}"
 
-    def start_trace(self, name, **attrs):
+    def start_trace(self, name, trace_id=None, **attrs):
+        """``trace_id=None`` mints a fresh id; passing one adopts it (the
+        replica side of a routed request inherits the router's id so the
+        store can graft both segments into one tree)."""
         if not _metrics._runtime["enabled"] or not self.enabled:
             return NULL_TRACE
         _M_STARTED.inc()
-        return Trace(self, name, attrs)
+        return Trace(self, name, attrs, trace_id=trace_id)
 
     def _finish(self, trace):
         if self.store is not None:

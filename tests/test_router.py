@@ -1,0 +1,589 @@
+"""Multi-replica serving plane (ISSUE 12): prefix-affinity router, drain,
+and the elastic fleet controller.
+
+Oracles: the router's affinity key is pinned to the EXACT chained
+page-block derivation the radix prefix index uses (shared helper + golden
+digest); ``LLMEngine.drain()`` finishes every in-flight request, rejects
+new submits, and is idempotent/joinable; same-prefix requests routed
+through two live in-process replicas land on ONE replica and beat a
+round-robin split on prefix-cache hit ratio over the same trace; a killed
+replica's traffic fails over inside the request deadline; one ``/tracez``
+document carries the router hop AND the replica execution under a single
+trace_id; and the fleet controller's restart/quarantine/scale decisions
+are deterministic under an injected clock.  Chaos tests (``faults``
+marker) drive socket drops/resets through the retry-safety rule.
+"""
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import LLMEngine, ServerOverloadedError
+from paddle_tpu.inference.prefix_cache import (
+    _ROOT, PrefixCache, chained_block_key, prefix_key,
+)
+from paddle_tpu.inference.router import (
+    FleetController, PrefixAffinityTable, ReplicaServer, Router, _http_json,
+)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.observability import scrape as obs_scrape
+from paddle_tpu.observability import tracing
+from paddle_tpu.testing import faults
+
+pytestmark = pytest.mark.quick
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(7)
+    cfg = LlamaConfig.tiny(tensor_parallel=False, use_flash_attention=False,
+                           max_position_embeddings=256)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m
+
+
+def _oracle(model, prompt, n):
+    ids = paddle.to_tensor(np.asarray(prompt, np.int32)[None, :])
+    out = model.generate(ids, max_new_tokens=n)
+    return list(np.asarray(out._value)[0])
+
+
+def _tracer(sample_every=1, capacity=128):
+    return tracing.Tracer(store=tracing.TraceStore(
+        capacity=capacity, sample_every=sample_every))
+
+
+def _engine(model, tracer=None, **kw):
+    kw.setdefault("max_batch_slots", 2)
+    kw.setdefault("max_seq_len", 128)
+    kw.setdefault("kv_layout", "paged")
+    kw.setdefault("page_size", 16)
+    kw.setdefault("prefill_chunk", 16)
+    kw.setdefault("metrics_port", 0)
+    return LLMEngine(model, tracer=tracer, **kw)
+
+
+def _replica(model, name, tracer=None, **kw):
+    rs = ReplicaServer(_engine(model, tracer=tracer, **kw), name=name)
+    rs.engine.start()
+    return rs
+
+
+def _ss(**named_samples):
+    s = obs_scrape.SampleSet()
+    for name, series in named_samples.items():
+        for labels, value in series:
+            s.add(name, labels, value)
+    return s
+
+
+def _shared_prefix_prompts(n, head_tokens=32, tail_tokens=8, seed=11):
+    """n prompts sharing a ``head_tokens`` head (2 full 16-token pages)
+    with distinct random tails — the router's bread-and-butter traffic."""
+    rng = np.random.RandomState(seed)
+    head = rng.randint(0, 1024, head_tokens).astype(np.int32)
+    return [np.concatenate([head,
+                            rng.randint(0, 1024, tail_tokens)
+                            .astype(np.int32)]) for _ in range(n)]
+
+
+# ------------------------------------------------- satellite 1: prefix_key
+def test_prefix_key_matches_cache_chain_and_golden():
+    """The router affinity key IS the radix index's chained block key:
+    manual chain == prefix_key == the node key PrefixCache itself stores
+    — and the digest is pinned so the derivation can never drift."""
+    p = np.arange(13, dtype=np.int32)  # 12 usable tokens = 3 full 4-blocks
+    k = _ROOT
+    for i in range(3):
+        k = chained_block_key(k, p[i * 4:(i + 1) * 4].tobytes())
+    assert prefix_key(p, 4) == k
+    assert prefix_key(p, 4).hex() \
+        == "66fe6dfe4f40fd2dd3cd1e5ccc498cf0eaf59af3"
+    # identity with the live index: insert the usable prefix and the cache
+    # holds a node under exactly the affinity key
+    cache = PrefixCache(page_size=4)
+    cache.insert(p[:12], slot_pages=[1, 2, 3])
+    assert prefix_key(p, 4) in cache._nodes
+    # short prompt: the domain-separated partial-tail key, again matching
+    # what insert() files the tail under
+    q = np.arange(3, dtype=np.int32)
+    assert prefix_key(q, 4) \
+        == chained_block_key(_ROOT, q[:2].tobytes(), partial=True)
+    assert prefix_key(q, 4).hex() \
+        == "720d24b6b85771b11d3642aa2211cbf81bd96ad6"
+    cache2 = PrefixCache(page_size=4)
+    cache2.insert(q[:2], slot_pages=[1])
+    assert prefix_key(q, 4) in cache2._nodes
+
+
+def test_prefix_key_blocks_cap_buckets_shared_heads():
+    """Same system prompt + different questions = ONE affinity bucket:
+    the blocks cap drops the divergent tail."""
+    rng = np.random.RandomState(3)
+    head = rng.randint(0, 1024, 8).astype(np.int32)
+    a = np.concatenate([head, rng.randint(0, 1024, 5).astype(np.int32)])
+    b = np.concatenate([head, rng.randint(0, 1024, 7).astype(np.int32)])
+    assert prefix_key(a, 4, blocks=2) == prefix_key(b, 4, blocks=2)
+    assert prefix_key(a, 4) != prefix_key(b, 4)  # uncapped: tails differ
+
+
+def test_affinity_table_lru_bound_and_drop():
+    t = PrefixAffinityTable(capacity=2)
+    t.record(b"a", "r1")
+    t.record(b"b", "r2")
+    assert t.get(b"a") == "r1"  # touches a: b is now LRU
+    t.record(b"c", "r1")
+    assert t.get(b"b") is None and len(t) == 2
+    assert t.drop_replica("r1") == 2
+    assert len(t) == 0 and t.get(b"a") is None
+
+
+# --------------------------------------------------- satellite 2: drain()
+def test_drain_finishes_inflight_rejects_new_and_resumes(model):
+    """Caller-pumped drain: every admitted request finishes exactly (zero
+    loss), new submits shed with ServerOverloadedError, drain is
+    idempotent, and resume() reopens admission."""
+    rng = np.random.RandomState(21)
+    eng = LLMEngine(model, max_batch_slots=2, max_seq_len=128)
+    prompts = [rng.randint(0, 1024, n).astype(np.int32) for n in (9, 14, 7)]
+    futs = [eng.submit(p, max_new_tokens=4) for p in prompts]
+    t = threading.Thread(target=lambda: eng.drain(timeout=60), daemon=True)
+    assert eng.drain(timeout=60) is True  # steps inline: no pump thread
+    assert eng.stats()["draining"] is True
+    for p, f in zip(prompts, futs):
+        assert f.result(timeout=1) == _oracle(model, p, 4)  # zero loss
+    with pytest.raises(ServerOverloadedError):
+        eng.submit(prompts[0], max_new_tokens=2)
+    assert eng.drain(timeout=5) is True  # idempotent: already drained
+    t.start()
+    t.join(timeout=10)  # joinable from another thread too
+    assert not t.is_alive()
+    eng.resume()
+    assert eng.stats()["draining"] is False
+    assert eng.generate(prompts[0], max_new_tokens=3) \
+        == _oracle(model, prompts[0], 3)
+
+
+def test_drain_flips_healthz_503_and_recovers(model):
+    """Draining shows on the wire: /healthz goes 503 with the admission
+    check failing, stats()["draining"] is true, and resume() heals it."""
+    rng = np.random.RandomState(22)
+    eng = _engine(model)
+    eng.start()
+    try:
+        f = eng.submit(rng.randint(0, 1024, 10).astype(np.int32),
+                       max_new_tokens=3)
+        assert eng.drain(timeout=60) is True  # background pump finishes it
+        assert f.done() and len(f.result(timeout=1)) == 3
+        host, port = eng.telemetry.host, eng.telemetry.port
+        status, doc = _http_json(host, port, "GET", "/healthz")
+        assert status == 503
+        assert doc["checks"]["admission"] == \
+            {"ok": False, "detail": "draining"}
+        eng.resume()
+        status, doc = _http_json(host, port, "GET", "/healthz")
+        assert status == 200 and doc["checks"]["admission"]["ok"]
+    finally:
+        eng.stop()
+
+
+# ------------------------------------------------ replica wire endpoints
+def test_replica_wire_admit_poll_cancel_contract(model):
+    """The /cancelz contract the retry-safety rule rests on: cancelling a
+    queued (never-admitted) request WINS and the request resolves as
+    cancelled; cancelling a finished one LOSES and /pollz still returns
+    the tokens (exactly one delivery either way)."""
+    rng = np.random.RandomState(23)
+    eng = _engine(model)  # caller-pumped: nothing runs until we step
+    rs = ReplicaServer(eng, name="rw")
+    p = rng.randint(0, 1024, 10).astype(np.int32)
+    body = json.dumps({"req_id": "q1", "prompt_ids": [int(t) for t in p],
+                       "max_new_tokens": 3}).encode()
+    code, doc = rs._admitz("", body)
+    assert code == 200 and doc["accepted"] and doc["replica"] == "rw"
+    code, doc = rs._cancelz("req_id=q1", b"")
+    assert code == 200 and doc["cancelled"] is True  # queued: cancel wins
+    assert doc["admitted"] is False
+    code, doc = rs._pollz("req_id=q1&wait_s=0")
+    assert doc == {"done": True, "error": "cancelled",
+                   "error_type": "cancelled"}
+    # second request runs to completion: cancel must LOSE, tokens survive
+    code, doc = rs._admitz("", json.dumps(
+        {"req_id": "q2", "prompt_ids": [int(t) for t in p],
+         "max_new_tokens": 3}).encode())
+    assert code == 200 and doc["accepted"]
+    eng.run_until_complete()
+    code, doc = rs._cancelz("req_id=q2", b"")
+    assert code == 200 and doc["cancelled"] is False and doc["admitted"]
+    code, doc = rs._pollz("req_id=q2&wait_s=0")
+    assert doc["done"] is True and doc["tokens"] == _oracle(model, p, 3)
+    assert rs._cancelz("req_id=nope", b"")[0] == 404
+    assert rs._pollz("req_id=nope")[0] == 404
+    # draining replica sheds on the wire with the retry-safe 503 ack
+    eng.drain(timeout=30)
+    code, doc = rs._admitz("", json.dumps(
+        {"req_id": "q3", "prompt_ids": [1, 2, 3]}).encode())
+    assert code == 503 and doc["accepted"] is False and doc["draining"]
+
+
+# ----------------------------------------------- tentpole: affinity e2e
+def test_router_affinity_beats_round_robin_same_trace(model):
+    """Acceptance: same-prefix requests through 2 live replicas land on
+    ONE replica (affinity hits > 0) and the fleet-wide prefix-cache hit
+    ratio strictly beats a round-robin split of the SAME trace; one
+    grafted trace holds router + replica spans under a single id."""
+    tracer = _tracer()
+    prompts = _shared_prefix_prompts(4)
+    r1 = _replica(model, "aff-1", tracer=tracer)
+    r2 = _replica(model, "aff-2", tracer=tracer)
+    router = Router([r1, r2], page_size=16, affinity_blocks=4,
+                    request_timeout_s=120.0, tracer=tracer)
+    try:
+        outs = [router.request(p, max_new_tokens=4) for p in prompts]
+        for p, got in zip(prompts, outs):
+            assert got == _oracle(model, p, 4)
+        rz = router.routerz()
+        assert rz["affinity"]["hits"] == 3  # all but the cold first
+        assert rz["affinity"]["misses"] == 1
+        assert rz["affinity"]["entries"] == 1  # one shared-head bucket
+        affinity_hit_tokens = sum(
+            rep.engine.stats()["prefix_cache"]["hit_tokens"]
+            for rep in (r1, r2))
+        affinity_prompt_tokens = sum(
+            rep.engine.stats()["prefix_cache"]["prompt_tokens"]
+            for rep in (r1, r2))
+        affinity_ratio = affinity_hit_tokens / affinity_prompt_tokens
+
+        # round-robin baseline: the SAME trace alternated across two
+        # FRESH replicas — each cold replica re-prefills the shared head
+        e1, e2 = _engine(model, metrics_port=None), \
+            _engine(model, metrics_port=None)
+        futs = [(e1 if i % 2 == 0 else e2).submit(p, max_new_tokens=4)
+                for i, p in enumerate(prompts)]
+        e1.run_until_complete()
+        e2.run_until_complete()
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=1) == _oracle(model, p, 4)
+        rr_hit = sum(e.stats()["prefix_cache"]["hit_tokens"]
+                     for e in (e1, e2))
+        rr_prompt = sum(e.stats()["prefix_cache"]["prompt_tokens"]
+                        for e in (e1, e2))
+        assert affinity_ratio > rr_hit / rr_prompt
+
+        # ---- single grafted trace: router hop + replica execution
+        summaries = [s for s in tracer.store.list()
+                     if s["name"] == "router_request"
+                     and s["status"] == "ok"]
+        assert summaries, "router traces were not stored"
+        t = tracer.store.get_trace(summaries[-1]["trace_id"])
+        names = [n for n, _ in t.span_tree()]
+        assert "admit" in names and "replica_execute" in names
+        assert "llm_request" in names  # the grafted replica segment
+        replica_seg = t.find_spans("llm_request")[0]
+        assert [c.name for c in replica_seg.children][:2] \
+            == ["queue_wait", "admission"]
+        # the whole story lives under ONE id — no second trace document
+        assert len([s for s in tracer.store.list()
+                    if s["trace_id"] == t.trace_id]) == 1
+    finally:
+        router.stop()
+        r1.engine.stop()
+        r2.engine.stop()
+
+
+def test_router_drain_shifts_traffic_zero_wire_loss(model):
+    """Draining a replica: the router discovers it on poll(), new traffic
+    lands only on the healthy sibling, and nothing in flight is lost."""
+    prompts = _shared_prefix_prompts(3, seed=12)
+    r1 = _replica(model, "dr-1")
+    r2 = _replica(model, "dr-2")
+    router = Router([r1, r2], page_size=16, request_timeout_s=120.0,
+                    tracer=_tracer())
+    try:
+        assert router.request(prompts[0], max_new_tokens=3) \
+            == _oracle(model, prompts[0], 3)  # affinity -> first replica
+        landed = router.affinity.get(prefix_key(prompts[0], 16, blocks=4))
+        victim, healthy = (r1, r2) if landed == "dr-1" else (r2, r1)
+        assert victim.drain(timeout=60) is True  # zero in-flight to lose
+        router.poll()  # /healthz probe flips the draining flag
+        state = {r["name"]: r for r in router.routerz()["replicas"]}
+        assert state[victim.name]["state"] == "draining"
+        for p in prompts[1:]:
+            assert router.request(p, max_new_tokens=3) \
+                == _oracle(model, p, 3)
+        # every post-drain request hit the healthy replica's wire only
+        assert len(victim._pending) == 0
+        assert router.affinity.get(
+            prefix_key(prompts[0], 16, blocks=4)) == healthy.name
+        victim.engine.resume()
+        router.poll()
+        state = {r["name"]: r for r in router.routerz()["replicas"]}
+        assert state[victim.name]["state"] == "up"
+    finally:
+        router.stop()
+        r1.engine.stop()
+        r2.engine.stop()
+
+
+def test_router_kill_failover_and_shed_when_fleet_down(model):
+    """A killed replica's traffic fails over to the survivor within the
+    deadline (connect refused = confirmably never accepted -> retry-safe);
+    with the whole fleet down the router sheds instead of hanging."""
+    prompts = _shared_prefix_prompts(2, seed=13)
+    r1 = _replica(model, "ko-1")
+    r2 = _replica(model, "ko-2")
+    router = Router([r1, r2], page_size=16, request_timeout_s=120.0,
+                    tracer=_tracer())
+    try:
+        assert router.request(prompts[0], max_new_tokens=3) \
+            == _oracle(model, prompts[0], 3)
+        landed = router.affinity.get(prefix_key(prompts[0], 16, blocks=4))
+        victim, survivor = (r1, r2) if landed == "ko-1" else (r2, r1)
+        victim.engine.stop()  # port closes with the telemetry server
+        t0 = time.monotonic()
+        assert router.request(prompts[1], max_new_tokens=3, timeout=60) \
+            == _oracle(model, prompts[1], 3)
+        assert time.monotonic() - t0 < 60
+        rz = router.routerz()
+        assert rz["retries"] >= 1
+        state = {r["name"]: r for r in rz["replicas"]}
+        assert state[victim.name]["up"] is False  # marked down on refusal
+        assert router.affinity.get(
+            prefix_key(prompts[1], 16, blocks=4)) == survivor.name
+        survivor.engine.stop()  # now the whole fleet is gone
+        router.poll()
+        with pytest.raises(ServerOverloadedError):
+            router.request(prompts[0], max_new_tokens=2, timeout=10)
+        assert router.routerz()["shed"] >= 1
+    finally:
+        router.stop()
+        r1.engine.stop()
+        r2.engine.stop()
+
+
+def test_router_routerz_served_on_own_telemetry_port(model):
+    """/routerz (and /healthz) ride the router's own TelemetryServer —
+    the operator surface fleetwatch --routerz reads."""
+    r1 = _replica(model, "rz-1")
+    router = Router([r1], page_size=16, metrics_port=0, tracer=_tracer())
+    try:
+        status, doc = _http_json(router.telemetry.host,
+                                 router.telemetry.port, "GET", "/routerz")
+        assert status == 200
+        assert [r["name"] for r in doc["replicas"]] == ["rz-1"]
+        assert doc["affinity"]["capacity"] == 4096
+        status, hz = _http_json(router.telemetry.host,
+                                router.telemetry.port, "GET", "/healthz")
+        assert status == 200 and hz["checks"]["fleet"]["ok"]
+    finally:
+        router.stop()
+        r1.engine.stop()
+
+
+# ------------------------------------------------------ fleet controller
+def _hc(target, check, value):
+    return ({"target": target, "check": check}, float(value))
+
+
+def test_controller_restarts_unhealthy_replica(model):
+    """A sustained failing healthcheck fires through the alerting plane
+    and the controller restarts the replica in place — same address,
+    pump back alive, stale affinity dropped."""
+    rs = _replica(model, "fc-1")
+    router = Router([rs], page_size=16, tracer=_tracer())
+    ctl = FleetController(router, replicas={"fc-1": rs},
+                          clock=lambda: 0.0, restart_limit=3)
+    try:
+        port_before = rs.port
+        router.affinity.record(b"k", "fc-1")
+        bad = _ss(healthcheck_status_value=[_hc("fc-1", "pump", 0.0)])
+        assert ctl.tick(samples=bad, now=0.0)["restarts"] == []  # pending
+        acted = ctl.tick(samples=bad, now=16.0)  # past for_s=15 -> firing
+        assert acted["restarts"] == ["fc-1"]
+        assert rs.port == port_before  # pinned: the address survived
+        assert rs.engine._thread is not None \
+            and rs.engine._thread.is_alive()
+        assert router.affinity.get(b"k") is None  # kv pages are gone
+        assert ctl.stats()["restarts"] == 1
+        # same firing episode: no restart storm from one sick interval
+        assert ctl.tick(samples=bad, now=17.0)["restarts"] == []
+    finally:
+        router.stop()
+        rs.engine.stop()
+
+
+def test_controller_quarantines_flapping_replica(model):
+    """A replica that keeps earning restarts inside the window gets
+    benched instead of restarted again — and stops taking traffic."""
+    rs = _replica(model, "fq-1")
+    router = Router([rs], page_size=16, tracer=_tracer())
+    ctl = FleetController(router, replicas={"fq-1": rs},
+                          clock=lambda: 0.0, restart_limit=1,
+                          restart_window_s=600.0)
+    try:
+        bad = _ss(healthcheck_status_value=[_hc("fq-1", "pump", 0.0)])
+        good = _ss(healthcheck_status_value=[_hc("fq-1", "pump", 1.0)])
+        ctl.tick(samples=bad, now=0.0)
+        assert ctl.tick(samples=bad, now=16.0)["restarts"] == ["fq-1"]
+        ctl.tick(samples=good, now=30.0)  # episode resolves
+        ctl.tick(samples=bad, now=40.0)   # relapse: new episode pending
+        acted = ctl.tick(samples=bad, now=56.0)
+        assert acted["restarts"] == [] \
+            and acted["quarantines"] == ["fq-1"]
+        state = {r["name"]: r for r in router.routerz()["replicas"]}
+        assert state["fq-1"]["state"] == "quarantined"
+        with pytest.raises(ServerOverloadedError):
+            router.request(np.arange(8, dtype=np.int32), max_new_tokens=2,
+                           timeout=5)
+        # a quarantined replica earns no further restarts
+        assert ctl.tick(samples=bad, now=80.0)["restarts"] == []
+    finally:
+        router.stop()
+        rs.engine.stop()
+
+
+def test_controller_skips_draining_admission_alert(model):
+    """An intentional drain flips the admission healthcheck — the
+    controller must NOT mistake it for sickness and restart (a restart
+    would fail the very in-flight requests drain protects)."""
+    rs = _replica(model, "fd-1")
+    router = Router([rs], page_size=16, tracer=_tracer())
+    ctl = FleetController(router, replicas={"fd-1": rs},
+                          clock=lambda: 0.0)
+    try:
+        draining = _ss(
+            healthcheck_status_value=[_hc("fd-1", "admission", 0.0)])
+        ctl.tick(samples=draining, now=0.0)
+        acted = ctl.tick(samples=draining, now=16.0)
+        assert acted["restarts"] == [] and acted["quarantines"] == []
+        assert any(d["alert"] == "healthcheck_failing"
+                   for d in acted["decisions"])  # it DID fire; we skipped
+    finally:
+        router.stop()
+        rs.engine.stop()
+
+
+def test_controller_scale_signals_from_sustained_episodes(model):
+    """Scale signals need persistence: +1 only after ``scale_patience``
+    consecutive hot ticks (backlog alert firing), -1 only after the same
+    count of idle ticks, one signal per episode."""
+    rs = _replica(model, "fs-1")
+    router = Router([rs], page_size=16, tracer=_tracer())
+    ctl = FleetController(router, replicas={"fs-1": rs},
+                          clock=lambda: 0.0, scale_patience=2)
+    try:
+        hot = _ss(llm_queue_depth=[({"target": "fs-1"}, 200.0)])
+        cold = _ss(llm_queue_depth=[({"target": "fs-1"}, 0.0)])
+        assert ctl.tick(samples=hot, now=0.0)["scale"] == 0   # pending
+        assert ctl.tick(samples=hot, now=31.0)["scale"] == 0  # hot #1
+        assert ctl.tick(samples=hot, now=33.0)["scale"] == 1  # hot #2: up
+        assert ctl.tick(samples=hot, now=35.0)["scale"] == 0  # once only
+        signals = [ctl.tick(samples=cold, now=200.0 + i)["scale"]
+                   for i in range(4)]
+        assert signals.count(-1) == 1  # one down-signal per idle episode
+        assert signals[-1] == 0
+    finally:
+        router.stop()
+        rs.engine.stop()
+
+
+# ------------------------------------------------------------ chaos suite
+@pytest.mark.faults
+def test_chaos_connect_drop_retries_on_healthy_replica(model):
+    """A dropped connect never reached the replica: confirmably
+    un-accepted, so the router retries on the sibling within the deadline
+    and the fleet keeps serving."""
+    prompts = _shared_prefix_prompts(2, seed=31)
+    r1 = _replica(model, "ch-1")
+    r2 = _replica(model, "ch-2")
+    router = Router([r1, r2], page_size=16, request_timeout_s=120.0,
+                    tracer=_tracer())
+    try:
+        assert router.request(prompts[0], max_new_tokens=3) \
+            == _oracle(model, prompts[0], 3)
+        landed = router.affinity.get(prefix_key(prompts[0], 16, blocks=4))
+        victim = r1 if landed == "ch-1" else r2
+        with faults.SocketFaults(victim.port,
+                                 faults={i: "drop" for i in range(8)}):
+            t0 = time.monotonic()
+            assert router.request(prompts[1], max_new_tokens=3,
+                                  timeout=60) \
+                == _oracle(model, prompts[1], 3)
+            assert time.monotonic() - t0 < 60
+        rz = router.routerz()
+        assert rz["retries"] >= 1
+        assert {r["name"]: r["up"] for r in rz["replicas"]}[victim.name] \
+            is False
+        router.poll()  # fault gone: the victim scrapes healthy again
+        assert {r["name"]: r["state"]
+                for r in router.routerz()["replicas"]}[victim.name] == "up"
+    finally:
+        router.stop()
+        r1.engine.stop()
+        r2.engine.stop()
+
+
+@pytest.mark.faults
+def test_chaos_reset_mid_send_uses_cancel_probe_then_retries(model):
+    """A connection reset DURING the admit exchange is ambiguous: the
+    router must confirm non-delivery via /cancelz on a fresh connection
+    (404 = never arrived) before retrying on the sibling."""
+    prompts = _shared_prefix_prompts(2, seed=32)
+    r1 = _replica(model, "cr-1")
+    r2 = _replica(model, "cr-2")
+    router = Router([r1, r2], page_size=16, request_timeout_s=120.0,
+                    tracer=_tracer())
+    try:
+        assert router.request(prompts[0], max_new_tokens=3) \
+            == _oracle(model, prompts[0], 3)
+        landed = router.affinity.get(prefix_key(prompts[0], 16, blocks=4))
+        victim = r1 if landed == "cr-1" else r2
+        # connect 0: the admit POST resets mid-send; connect 1 is the
+        # cancel probe on a FRESH connection — it must go through clean
+        with faults.SocketFaults(victim.port, faults={0: "reset"}) as sf:
+            assert router.request(prompts[1], max_new_tokens=3,
+                                  timeout=60) \
+                == _oracle(model, prompts[1], 3)
+            assert sf.connects >= 2  # admit + the recovery probe
+        assert router.routerz()["retries"] >= 1
+        assert len(victim._pending) == 0  # nothing ever landed on it
+    finally:
+        router.stop()
+        r1.engine.stop()
+        r2.engine.stop()
+
+
+@pytest.mark.faults
+def test_chaos_scrape_staleness_marks_replica_down(model):
+    """A replica whose /metrics stops answering is marked down by scrape
+    staleness on poll() — the router stops even trying it, the survivor
+    carries the fleet, and recovery heals on the next poll."""
+    prompts = _shared_prefix_prompts(3, seed=33)
+    r1 = _replica(model, "cs-1")
+    r2 = _replica(model, "cs-2")
+    router = Router([r1, r2], page_size=16, request_timeout_s=120.0,
+                    scrape_timeout_s=0.5, tracer=_tracer())
+    try:
+        router.poll()
+        with faults.SocketFaults(r1.port,
+                                 faults={i: "drop" for i in range(16)}):
+            router.poll()
+            state = {r["name"]: r for r in router.routerz()["replicas"]}
+            assert state["cs-1"]["up"] is False
+            assert state["cs-2"]["up"] is True
+            wire_before = len(r1._pending)
+            for p in prompts:  # fleet keeps serving, never touching cs-1
+                assert router.request(p, max_new_tokens=3, timeout=60) \
+                    == _oracle(model, p, 3)
+            assert len(r1._pending) == wire_before
+        router.poll()
+        assert {r["name"]: r["up"]
+                for r in router.routerz()["replicas"]}["cs-1"] is True
+    finally:
+        router.stop()
+        r1.engine.stop()
+        r2.engine.stop()

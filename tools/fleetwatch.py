@@ -10,6 +10,7 @@ Usage::
         [--rules rules.json] [--no-default-rules]
         [--json] [--watch] [--interval 10] [--iterations N]
         [--log alerts.jsonl]
+    python tools/fleetwatch.py --routerz HOST:PORT [--json]
     python tools/fleetwatch.py --selftest
 
 One shot by default: scrape every target once (per-target monotonic
@@ -19,6 +20,12 @@ from a JSON list of rule dicts), print targets + alert states.  `--watch`
 re-polls every `--interval` seconds until interrupted (`--iterations`
 bounds it for scripting).  `--json` emits the machine-readable form of the
 same payload `/alertz` serves, plus per-target scrape results.
+
+`--routerz HOST:PORT` asks a serving router (inference.router.Router run
+with ``metrics_port=``) for its `/routerz` document and renders the fleet
+view: per-replica up/draining/quarantined state, affinity-table occupancy
+and hit ratio, shed and retry counts.  Exit 0 when every replica is
+routable, 1 otherwise.
 
 `--selftest` runs the embedded acceptance corpus: a canned Prometheus
 exposition (escapes, histograms, +Inf) must parse sample-for-sample, a
@@ -89,6 +96,44 @@ def render_status(results, state, now):
                 f"{labels[:48]}")
     lines.append(f"({quiet} rule(s) quiet)")
     return "\n".join(lines)
+
+
+def render_routerz(doc):
+    """Text fleet view of a router's /routerz document."""
+    aff = doc.get("affinity", {})
+    lines = ["REPLICA                       STATE        TARGET"
+             "                 RESTARTS"]
+    for r in doc.get("replicas", []):
+        lines.append(f"{r['name']:<28}  {r['state']:<11}"
+                     f"  {r['target']:<20}  {r.get('restarts', 0):>8}")
+    lines.append("")
+    occupancy = (f"{aff.get('entries', 0)}/{aff.get('capacity', 0)}"
+                 if aff.get("capacity") else "0/0")
+    lines.append(
+        f"affinity: {occupancy} entries"
+        f"  hit_ratio={aff.get('hit_ratio', 0.0):.3f}"
+        f"  (hits={aff.get('hits', 0)} misses={aff.get('misses', 0)}"
+        f"  blocks={aff.get('blocks', '-')}"
+        f" page_size={aff.get('page_size', '-')})")
+    lines.append(f"shed: {doc.get('shed', 0)}"
+                 f"   retries: {doc.get('retries', 0)}"
+                 f"   overhead: {doc.get('overhead_us_mean', 0.0)}us/req")
+    return "\n".join(lines)
+
+
+def run_routerz(target, timeout, as_json):
+    import urllib.request
+
+    url = target if "//" in target else f"http://{target}"
+    with urllib.request.urlopen(f"{url.rstrip('/')}/routerz",
+                                timeout=timeout) as resp:
+        doc = json.loads(resp.read())
+    if as_json:
+        print(json.dumps(doc, default=repr))
+    else:
+        print(render_routerz(doc))
+    return 0 if all(r.get("state") == "up"
+                    for r in doc.get("replicas", [])) else 1
 
 
 def load_rules(args, alerts_mod):
@@ -212,11 +257,16 @@ def main(argv=None) -> int:
     ap.add_argument("--iterations", type=int, default=0,
                     help="with --watch: stop after N polls (0 = forever)")
     ap.add_argument("--log", help="append alert transitions to this JSONL")
+    ap.add_argument("--routerz", metavar="HOST:PORT",
+                    help="render a serving router's /routerz fleet view "
+                         "instead of scraping targets")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
 
     if args.selftest:
         return selftest()
+    if args.routerz:
+        return run_routerz(args.routerz, args.timeout, args.as_json)
     if not args.targets:
         ap.error("need at least one HOST:PORT target (or --selftest)")
 

@@ -103,6 +103,7 @@ def import_instrumented(repo_root=None):
     import paddle_tpu.distributed.store  # noqa: F401
     import paddle_tpu.hapi.callbacks  # noqa: F401
     import paddle_tpu.inference.llm_server  # noqa: F401
+    import paddle_tpu.inference.router  # noqa: F401
     from paddle_tpu.observability import REGISTRY
     return REGISTRY
 
