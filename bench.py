@@ -607,6 +607,62 @@ def _bench_spec_decode(on_accel):
     }
 
 
+def _bench_ragged_attention(on_accel):
+    """ONE ragged paged-attention kernel vs the gathered dense fallback,
+    µs per call, at the two serving shapes that used to be dense-only: a
+    prefill chunk (S = chunk) and the spec-verify ladder (S = K+1).  The
+    A/B pins the SAME shapes through both paths via the dispatcher's
+    _FORCE_PATH hook, so the delta is purely Pallas-kernel-walking-pages
+    vs gather-every-page-then-masked-dense.  On CPU the kernel side runs
+    in interpret mode — the numbers there are a smoke signal, not perf."""
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu.ops import decode_attention as da
+
+    if on_accel:
+        B, H, Hkv, D, ps, M = 8, 16, 8, 128, 128, 16  # 2k-token pool/slot
+        shapes = (("prefill_chunk", 256, 1024), ("verify", 5, 1536))
+        reps = 20
+    else:
+        B, H, Hkv, D, ps, M = 2, 4, 2, 128, 128, 4
+        shapes = (("prefill_chunk", 128, 256), ("verify", 5, 200))
+        reps = 2
+
+    rng = np.random.RandomState(0)
+    P = 1 + B * M  # page 0 is the trash page
+    kp = jnp.asarray(rng.randn(P, Hkv, ps, D).astype(np.float32) * 0.3)
+    vp = jnp.asarray(rng.randn(P, Hkv, ps, D).astype(np.float32) * 0.3)
+    pt = jnp.asarray(
+        [[1 + b * M + j for j in range(M)] for b in range(B)], jnp.int32)
+
+    out = {"ragged_attn_batch": B, "ragged_attn_pages_per_slot": M}
+    for tag, S, off in shapes:
+        q = jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+        offs = jnp.full((B,), off, jnp.int32)
+
+        def run(force):
+            da._FORCE_PATH = force
+            try:
+                f = jax.jit(lambda qq: da.paged_decode_attention(
+                    qq, kp, vp, offs, pt))
+                _ = np.asarray(f(q))  # compile
+                t0 = time.perf_counter()
+                for _i in range(reps):
+                    r = f(q)
+                _ = np.asarray(r)
+                return (time.perf_counter() - t0) / reps * 1e6
+            finally:
+                da._FORCE_PATH = None
+
+        kern_us, dense_us = run(None), run("dense")
+        out[f"ragged_attn_{tag}_kernel_us"] = round(kern_us, 1)
+        out[f"ragged_attn_{tag}_dense_us"] = round(dense_us, 1)
+        out[f"ragged_attn_{tag}_speedup"] = round(
+            dense_us / max(kern_us, 1e-9), 2)
+    return out
+
+
 def _bench_llama7b_layer(on_accel):
     """One LLaMA-2-7B-dimension decoder layer (h=4096, ffn=11008, 32 heads)
     fwd+bwd at seq 2048 — anchors per-layer ms for BASELINE config #5 (the
@@ -1236,6 +1292,7 @@ def main():
                     (_bench_decode, "decode"),
                     (_bench_prefix_cache, "prefix_cache"),
                     (_bench_spec_decode, "spec_decode"),
+                    (_bench_ragged_attention, "ragged_attention"),
                     (_bench_llama7b_layer, "llama7b_layer"),
                     (_bench_ernie, "ernie"),
                     (_bench_vit, "vit"),

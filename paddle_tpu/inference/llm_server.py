@@ -176,6 +176,16 @@ _M_DRAINING = _obs.gauge(
     "llm_draining_value",
     "1 while the engine is draining (admission closed, in-flight finishing)")
 
+
+def _attn_dispatch_series():
+    """[(label values, count)] for every `llm_attn_kernel_total` child.
+    The family is declared in ops/decode_attention.py (the dispatchers own
+    the trace-time counting); read it through the registry so stats() and
+    /metrics agree even if this module loaded first."""
+    fam = _obs.REGISTRY.get("llm_attn_kernel_total")
+    return [(labels, child.value) for labels, child in fam.series()] \
+        if fam is not None else []
+
 #: LLMEngine(slo_targets={...}) keys -> SLO series names (observability.slo
 #: sliding-window percentiles + burn rates, README §Observability).
 _SLO_SERIES = {"ttft": "llm_ttft", "e2e": "llm_e2e",
@@ -813,6 +823,13 @@ class LLMEngine:
             },
             "decode_tokens": _M_DECODE_TOKENS.value,
             "decode_tokens_per_second": _M_DECODE_TPS.value,
+            # attention dispatch decisions (trace-time, process-global):
+            # {(path, reason): count} from llm_attn_kernel_total — a
+            # "paged_dense" entry on a TPU engine means some compiled
+            # program fell off the ragged-kernel path
+            "attn_dispatch": {
+                "/".join(labels): count
+                for labels, count in _attn_dispatch_series()},
             "queue_wait_seconds": self._hist_summary(_M_QUEUE_WAIT),
             "ttft_seconds": self._hist_summary(_M_TTFT),
             "e2e_seconds": self._hist_summary(_M_E2E),
@@ -1466,7 +1483,10 @@ class LLMEngine:
     def _chunk_prefill_fn(self):
         """ONE compiled program prefills any prompt in fixed-size chunks —
         ids [1, C] against the paged pools at per-slot offset `off`,
-        killing the per-bucket prefill compile zoo.  Returns the logits at
+        killing the per-bucket prefill compile zoo.  On tile-aligned
+        shapes the chunk's attention is the RAGGED paged Pallas kernel
+        (the chunk offset rides the kernel's prefetched lengths;
+        llm_attn_kernel_total counts the dispatch).  Returns the logits at
         `last_index` (the final chunk's last real token) and the updated
         pools; the page table row routes the scatter, padded tail rows land
         in the trash page / are overwritten by the first decode."""
@@ -1909,9 +1929,11 @@ class LLMEngine:
     def _verify_fn(self):
         """ONE compiled speculative verify: score K drafts + one bonus
         position for every slot (S = K+1 through the same cache scatter /
-        attention paths decode uses) and run the accept/rollback decision
-        on device (ops/sampling.spec_accept) — only the [B, K+1] token
-        ladder and the [B] accept counts cross the host tunnel."""
+        attention paths decode uses — on tile-aligned paged shapes that is
+        the ragged Pallas kernel walking the page tables, not a gathered
+        dense pass) and run the accept/rollback decision on device
+        (ops/sampling.spec_accept) — only the [B, K+1] token ladder and
+        the [B] accept counts cross the host tunnel."""
         model = self.model
 
         if self.paged:

@@ -63,8 +63,9 @@ class GPTBlock(nn.Layer):
         if cache is not None and len(cache) in (4, 6):
             # PAGED layout (kv_cache.py paged contract): scatter into the
             # global page pool, attend through the slot's page table —
-            # decode S==1 hits the ragged paged Pallas kernel, chunked
-            # prefill (S>1) the gathered dense math
+            # ONE ragged paged Pallas kernel for any S on tile-aligned
+            # shapes (decode, prefill chunks, spec-verify); gathered dense
+            # math only for CPU-odd shapes
             from .kv_cache import paged_attention_update
 
             offset = cache[2]

@@ -225,10 +225,12 @@ def update_paged_quant_cache(cache, k, v, offset):
 
 def paged_attention_update(cache, q, k, v, offset):
     """Scatter new k/v [B, S, H, D] into the paged cache, then attend q
-    through the page table (ragged paged Pallas kernel at S == 1 on TPU,
-    gathered dense math otherwise) — the ONE paged decode / chunked-prefill
-    hot path shared by every attention family that understands the paged
-    4/6-tuples.  Returns (new_cache, out [B, S, Hq, D])."""
+    through the page table (the ragged paged Pallas kernel for ANY S >= 1
+    on tile-aligned shapes — decode, prefill chunks, the K+1 spec-verify
+    ladder; gathered dense math only for CPU-odd shapes) — the ONE paged
+    decode / chunked-prefill / verify hot path shared by every attention
+    family that understands the paged 4/6-tuples.  Returns
+    (new_cache, out [B, S, Hq, D])."""
     from ..ops.decode_attention import paged_decode_attention
 
     if len(cache) == 6:

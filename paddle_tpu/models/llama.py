@@ -186,10 +186,12 @@ class LlamaAttention(nn.Layer):
         k = apply_op(lambda a, c, s: apply_rope(a, c, s, offset), (k, rope_cos, rope_sin), name="rope")
 
         if paged_cache and attn_mask is None:
-            # paged decode / chunked-prefill path: scatter into the page
-            # pool, then attend through the page table (ragged paged Pallas
-            # kernel at S == 1 on TPU; gathered dense math for prefill
-            # chunks and CPU)
+            # paged decode / chunked-prefill / spec-verify path: scatter
+            # into the page pool, then attend through the page table — ONE
+            # ragged paged Pallas kernel for any S on tile-aligned shapes
+            # (S=1 decode, prefill chunks, the K+1 verify ladder); gathered
+            # dense math only for CPU-odd shapes
+            # (llm_attn_kernel_total{path,reason} counts the dispatch)
             new_cache, out = paged_attention_update(cache, q, k, v, offset)
             out = out.reshape([B, S, self.num_heads * self.head_dim])
             out = self.o_proj(out)
@@ -398,10 +400,11 @@ class LlamaForCausalLM(nn.Layer):
 
     def verify_step(self, input_ids, caches):
         """Speculative-decoding verify: score S = K+1 tokens in ONE pass
-        through the decode cache path, returning the logits at EVERY
-        position [B, S, V] — generate_step keeps only the last, but the
-        accept/rollback decision needs the whole ladder (ops/sampling
-        spec_accept)."""
+        through the decode cache path (on the paged layout this is the
+        ragged Pallas kernel — the verify ladder is just another ragged
+        query block), returning the logits at EVERY position [B, S, V] —
+        generate_step keeps only the last, but the accept/rollback
+        decision needs the whole ladder (ops/sampling spec_accept)."""
         hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)
         return self.lm_head(hidden), caches
 
@@ -426,7 +429,9 @@ class LlamaForCausalLM(nn.Layer):
         `last_index`, caches) — the logits only matter on the final chunk;
         earlier chunks pay one [B, 1, V] head gemv for shape stability
         (llm_server.py compiles exactly ONE chunk program, killing the
-        per-bucket prefill zoo)."""
+        per-bucket prefill zoo).  On tile-aligned shapes the chunk's
+        attention is the ragged paged Pallas kernel — the per-slot chunk
+        offset rides the kernel's prefetched lengths vector."""
         import jax
 
         hidden, caches = self.llama(input_ids, caches=caches, use_cache=True)

@@ -32,7 +32,31 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..observability import metrics as _obs
+
 NEG_INF = -1e30
+
+#: Dispatch decisions are made at TRACE time (the kernel/fallback choice is
+#: shape-static), so the counter ticks once per attention call site per
+#: compiled program — a fallback regression shows up as `path="paged_dense"`
+#: increments on /metrics the moment the offending program compiles, not as
+#: a silent latency cliff.  reason ∈ {tile_aligned, off_tile,
+#: query_rows_over_vmem, grid_too_large, forced}.
+_M_ATTN_DISPATCH = _obs.counter(
+    "llm_attn_kernel_total",
+    "Attention dispatch decisions at trace time: which path (Pallas kernel "
+    "vs dense fallback) served an attention call site and why",
+    labelnames=("path", "reason"))
+
+#: Test hook: "dense" forces every dispatcher onto the fallback path (used
+#: by the kernel-vs-fallback engine parity suite and bench.py's ragged
+#: round to A/B the SAME shapes through both paths).  None = normal
+#: shape-based dispatch.
+_FORCE_PATH = None
+
+
+def _note(path, reason):
+    _M_ATTN_DISPATCH.labels(path=path, reason=reason).inc()
 
 
 def _interpret_default():
@@ -235,9 +259,20 @@ def decode_attention(q, k, v, offset, k_scale=None, v_scale=None, scale=None,
     #          B=32 (the per-(b,h) DMA grid stops amortizing) -> kernel only
     #          while the grid stays small.
     use_kernel = shapes_ok and (k_scale is not None or B * H <= 192)
+    if _FORCE_PATH == "dense":
+        use_kernel = False
+        reason = "forced"
+    elif use_kernel:
+        reason = "tile_aligned"
+    elif not shapes_ok:
+        reason = "multi_query" if S != 1 else "off_tile"
+    else:
+        reason = "grid_too_large"
     if use_kernel:
+        _note("static_kernel", reason)
         return _decode_pallas(q, k, v, offset, k_scale, v_scale, scale, bk,
                               interpret)
+    _note("static_dense", reason)
     return _decode_dense(q, k, v, offset, k_scale, v_scale, scale)
 
 
@@ -246,14 +281,21 @@ def decode_attention(q, k, v, offset, k_scale=None, v_scale=None, scale=None,
 # Ragged paged attention (the arxiv 2604.15464 design, adapted to this
 # stack's head-major page layout): the kv cache is a global page pool
 # [P, Hkv, page_size, D] plus per-slot page tables [B, max_pages] — capacity
-# scales with ACTUAL sequence lengths, not max_seq_len.  The decode kernel
-# walks each slot's pages through a scalar-prefetched page table: the
-# BlockSpec index map reads pt_ref[b, p], so the pipeline DMAs exactly the
-# pages the slot owns.  Slots shorter than max_pages point their unused
-# table entries at the trash page (kv_cache.TRASH_PAGE); consecutive equal
-# block indices elide the re-fetch, so the ragged tail costs ~one trash-page
-# DMA per (slot, head-group), with the compute skipped by the valid-length
-# mask.
+# scales with ACTUAL sequence lengths, not max_seq_len.  ONE kernel serves
+# every ragged query-block shape the serving engine produces: S=1 continuous
+# -batching decode, prefill chunks of S=C tokens at arbitrary per-slot chunk
+# offsets, and the S=K+1 speculative-verify ladder — the per-slot (offset,
+# query-length) pair rides the scalar-prefetched `lengths` vector
+# (lengths[b] = offset[b] + S) and drives a per-ROW causal mask inside the
+# online-softmax page loop: query s of slot b attends keys
+# [0, lengths[b] - S + s].  The kernel walks each slot's pages through the
+# scalar-prefetched page table: the BlockSpec index map reads pt_ref[b, ·],
+# so the pipeline DMAs exactly the pages the slot owns.  Slots shorter than
+# max_pages point their unused table entries at the trash page
+# (kv_cache.TRASH_PAGE) — the index map CLAMPS the walk to the slot's last
+# valid page, so the ragged tail repeats a block index the pipeline has
+# already fetched and the trash page is never DMA'd at all (trash-fetch
+# elision; the tail compute is skipped by the valid-length gate).
 
 
 def gather_pages(pool, page_tbl):
@@ -268,14 +310,20 @@ def gather_pages(pool, page_tbl):
     return jnp.transpose(g, (0, 2, 1, 3)).reshape(B, H, M * ps)
 
 
-def _paged_kernel(len_ref, pt_ref, q_ref, k_ref, v_ref, *refs, ps, G,
+def _paged_kernel(len_ref, pt_ref, q_ref, k_ref, v_ref, *refs, ps, S, G,
                   rep, scale, quant):
     """One (slot, kv-head-group, page) grid step: fold this page's keys and
     values into the slot's online-softmax state (m/l/acc VMEM scratch that
-    persists across the sequential page axis).  int8 pages dequantize in
-    VMEM: payload cast once per page, per-(head, token) scales applied to
-    the score/probability rows outside the dots (the static kernel's
-    recipe)."""
+    persists across the sequential page axis).  The query block is RAGGED:
+    its rows are laid out [G kv heads, S query positions, rep query heads]
+    (row g*S*rep + s*rep + r is query position s of query head g*rep + r),
+    so one [S*rep, D] x [ps, D]^T dot per kv head scores every query row of
+    that head at once, and a per-row causal threshold
+    lengths[b] - S + s + 1 masks each row to its own prefix — S=1 decode,
+    prefill chunks, and the K+1 verify ladder are the SAME kernel at
+    different static S.  int8 pages dequantize in VMEM: payload cast once
+    per page, per-(head, token) scales applied to the score/probability
+    rows outside the dots (the static kernel's recipe)."""
     if quant:  # inputs continue with the scale pages, THEN output + scratch
         ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
     else:
@@ -285,9 +333,10 @@ def _paged_kernel(len_ref, pt_ref, q_ref, k_ref, v_ref, *refs, ps, G,
     p = pl.program_id(2)
     M = pl.num_programs(2)
     valid = len_ref[b]
-    Hg = G * rep
+    sg = S * rep          # query rows per kv head
+    rows = G * sg         # query rows per grid step
     D = q_ref.shape[-1]
-    Hp = q_ref.shape[-2]  # Hg padded to the 8-sublane tile
+    Rp = q_ref.shape[-2]  # rows padded to the 8-sublane tile
 
     @pl.when(p == 0)
     def _init():
@@ -304,58 +353,73 @@ def _paged_kernel(len_ref, pt_ref, q_ref, k_ref, v_ref, *refs, ps, G,
             kb, vb = k_ref[0], v_ref[0]
         rows_s = []
         for g in range(G):
-            kg = kb[g]
-            for r in range(rep):
-                h = g * rep + r
-                qh = q_ref[0, 0, h:h + 1, :]  # [1, D]
-                rows_s.append(jax.lax.dot_general(
-                    qh, kg, (((1,), (1,)), ((), ())),
-                    preferred_element_type=jnp.float32))
-        s = jnp.concatenate(rows_s, axis=0) * scale  # [Hg, ps]
+            qg = q_ref[0, 0, g * sg:(g + 1) * sg, :]  # [S*rep, D]
+            rows_s.append(jax.lax.dot_general(
+                qg, kb[g], (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32))
+        s = (jnp.concatenate(rows_s, axis=0) if G > 1
+             else rows_s[0]) * scale  # [rows, ps]
         if quant:
             ks = ks_ref[0].reshape(G, ps)
-            s = s * jnp.repeat(ks, rep, axis=0) if rep > 1 else s * ks
-        kpos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (1, ps), 1)
-        s = jnp.where(kpos < valid, s, NEG_INF)
-        m_prev = m_ref[:Hg, :1]
-        l_prev = l_ref[:Hg, :1]
+            s = s * (jnp.repeat(ks, sg, axis=0) if sg > 1 else ks)
+        # per-row causal end: row g*sg + s*rep + r is query position s, and
+        # query s of a slot whose lengths entry is `valid` = offset + S may
+        # read keys [0, offset + s] — i.e. kpos < valid - S + s + 1.  Row 0
+        # always has offset + 1 >= 1 valid keys, so page 0 (the only page
+        # guaranteed to participate) leaves no row's running max at NEG_INF.
+        ri = jax.lax.broadcasted_iota(jnp.int32, (rows, 1), 0)
+        qend = valid - S + (ri // rep) % S + 1
+        kpos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (rows, ps), 1)
+        s = jnp.where(kpos < qend, s, NEG_INF)
+        m_prev = m_ref[:rows, :1]
+        l_prev = l_ref[:rows, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        pexp = jnp.exp(s - m_new)  # [Hg, ps] f32
+        pexp = jnp.exp(s - m_new)  # [rows, ps] f32
         corr = jnp.exp(m_prev - m_new)
         l_new = l_prev * corr + jnp.sum(pexp, axis=1, keepdims=True)
         if quant:
             vs = vs_ref[0].reshape(G, ps)
-            pexp = pexp * jnp.repeat(vs, rep, axis=0) if rep > 1 \
-                else pexp * vs
+            pexp = pexp * (jnp.repeat(vs, sg, axis=0) if sg > 1 else vs)
         pb = pexp.astype(jnp.bfloat16 if quant else vb.dtype)
         outs = []
         for g in range(G):
             outs.append(jax.lax.dot_general(
-                pb[g * rep:(g + 1) * rep], vb[g], (((1,), (0,)), ((), ())),
+                pb[g * sg:(g + 1) * sg], vb[g], (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32))
-        pv = jnp.concatenate(outs, axis=0)  # [Hg, D]
-        m_ref[:Hg, :1] = m_new
-        l_ref[:Hg, :1] = l_new
-        acc_ref[:Hg, :] = acc_ref[:Hg, :] * corr + pv
+        pv = jnp.concatenate(outs, axis=0) if G > 1 else outs[0]  # [rows, D]
+        m_ref[:rows, :1] = m_new
+        l_ref[:rows, :1] = l_new
+        acc_ref[:rows, :] = acc_ref[:rows, :] * corr + pv
 
     @pl.when(p == M - 1)
     def _emit():
-        l = l_ref[:Hg, :1]
-        out = (acc_ref[:Hg, :]
+        l = l_ref[:rows, :1]
+        out = (acc_ref[:rows, :]
                / jnp.where(l <= 0.0, 1.0, l)).astype(o_ref.dtype)
-        if Hp != Hg:
+        if Rp != rows:
             out = jnp.concatenate(
-                [out, jnp.zeros((Hp - Hg, D), o_ref.dtype)], axis=0)
+                [out, jnp.zeros((Rp - rows, D), o_ref.dtype)], axis=0)
         o_ref[0, 0] = out
 
 
-def _pick_group_paged(Hkv, ps, D, quant):
+def _paged_state_bytes(rows, D):
+    """VMEM bytes of the per-grid-step ragged query state: the q block plus
+    the f32 m/l/acc online-softmax scratch (shared bound between the group
+    picker and the dispatcher's S cap)."""
+    return rows * (4 * D            # q block (f32 worst case)
+                   + 2 * 4 * 128    # m + l scratch rows
+                   + 4 * D)         # acc scratch
+
+
+def _pick_group_paged(Hkv, ps, D, quant, S=1, rep=1):
     """kv heads per grid step: page blocks are small (one page, not the
-    whole sequence), so the bound is the double-buffered page pair staying
-    comfortably inside VMEM."""
+    whole sequence), so the bounds are the double-buffered page pair
+    staying comfortably inside VMEM plus — now that query blocks are
+    ragged — the G*S*rep query rows of q/m/l/acc state."""
     per_head = ps * D * (1 if quant else 2) * 2  # k + v page blocks
     for g in (16, 8, 4, 2, 1):
-        if Hkv % g == 0 and g * per_head <= 2 * 1024 * 1024:
+        if (Hkv % g == 0 and g * per_head <= 2 * 1024 * 1024
+                and _paged_state_bytes(g * S * rep, D) <= 6 * 1024 * 1024):
             return g
     return 1
 
@@ -367,39 +431,54 @@ def _paged_pallas(q, k_pages, v_pages, lengths, page_tbl, k_scale, v_scale,
     M = page_tbl.shape[1]
     rep = H // Hkv
     quant = k_scale is not None
-    G = _pick_group_paged(Hkv, ps, D, quant)
+    G = _pick_group_paged(Hkv, ps, D, quant, S, rep)
     ng = Hkv // G
-    Hg = G * rep
-    Hp = max(Hg, 8)
-    qg = jnp.transpose(q, (0, 2, 1, 3)).reshape(B, ng, Hg, D)
-    if Hp != Hg:
-        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Hp - Hg), (0, 0)))
+    rows = G * S * rep
+    Rp = max(8, -(-rows // 8) * 8)  # 8-sublane tile floor for q/out blocks
+    # ragged row layout [G, S, rep]: query head h = j*G*rep + g*rep + r of
+    # position s lands at row g*S*rep + s*rep + r of group j — each kv
+    # head's S*rep query rows are contiguous, so the kernel scores them
+    # with ONE dot per kv head (at S=1 this is exactly the old [G, rep]
+    # head order)
+    qg = jnp.transpose(q, (0, 2, 1, 3))        # [B, H, S, D]
+    qg = qg.reshape(B, ng, G, rep, S, D)
+    qg = jnp.transpose(qg, (0, 1, 2, 4, 3, 5)).reshape(B, ng, rows, D)
+    if Rp != rows:
+        qg = jnp.pad(qg, ((0, 0), (0, 0), (0, Rp - rows), (0, 0)))
     lengths = jnp.asarray(lengths, jnp.int32).reshape(B)
     page_tbl = jnp.asarray(page_tbl, jnp.int32)
 
-    # index maps receive the prefetched (lengths, page-table) refs last; the
-    # page axis walks pt_ref[b, p] — THE ragged gather
+    # Index maps receive the prefetched (lengths, page-table) refs last;
+    # the page axis walks the slot's table — THE ragged gather.  Trash-fetch
+    # elision: grid steps past the slot's last valid page CLAMP to that last
+    # page, so the pipeline sees a repeated block index and skips the DMA
+    # entirely (the valid-length gate already skips the compute) — the
+    # ragged tail of a short slot in a long-max-len pool costs zero
+    # bandwidth instead of one trash-page fetch per (slot, head-group).
+    def _pidx(b, p, lens, pt):
+        return pt[b, jnp.minimum(p, jnp.maximum(lens[b] - 1, 0) // ps)]
+
     in_specs = [
-        pl.BlockSpec((1, 1, Hp, D), lambda b, g, p, _len, _pt: (b, g, 0, 0)),
+        pl.BlockSpec((1, 1, Rp, D), lambda b, g, p, _len, _pt: (b, g, 0, 0)),
         pl.BlockSpec((1, G, ps, D),
-                     lambda b, g, p, _len, pt: (pt[b, p], g, 0, 0)),
+                     lambda b, g, p, lens, pt: (_pidx(b, p, lens, pt), g, 0, 0)),
         pl.BlockSpec((1, G, ps, D),
-                     lambda b, g, p, _len, pt: (pt[b, p], g, 0, 0)),
+                     lambda b, g, p, lens, pt: (_pidx(b, p, lens, pt), g, 0, 0)),
     ]
     args = [qg, k_pages, v_pages]
     if quant:
         sb = ps // 128
         in_specs += [
             pl.BlockSpec((1, G, sb, 128),
-                         lambda b, g, p, _len, pt: (pt[b, p], g, 0, 0)),
+                         lambda b, g, p, lens, pt: (_pidx(b, p, lens, pt), g, 0, 0)),
             pl.BlockSpec((1, G, sb, 128),
-                         lambda b, g, p, _len, pt: (pt[b, p], g, 0, 0)),
+                         lambda b, g, p, lens, pt: (_pidx(b, p, lens, pt), g, 0, 0)),
         ]
         P = k_pages.shape[0]
         args += [k_scale.reshape(P, Hkv, sb, 128),
                  v_scale.reshape(P, Hkv, sb, 128)]
 
-    kernel = functools.partial(_paged_kernel, ps=ps, G=G, rep=rep,
+    kernel = functools.partial(_paged_kernel, ps=ps, S=S, G=G, rep=rep,
                                scale=scale, quant=quant)
     out = pl.pallas_call(
         kernel,
@@ -408,24 +487,38 @@ def _paged_pallas(q, k_pages, v_pages, lengths, page_tbl, k_scale, v_scale,
             grid=(B, ng, M),
             in_specs=in_specs,
             out_specs=pl.BlockSpec(
-                (1, 1, Hp, D), lambda b, g, p, _len, _pt: (b, g, 0, 0)),
-            scratch_shapes=[pltpu.VMEM((Hp, 128), jnp.float32),
-                            pltpu.VMEM((Hp, 128), jnp.float32),
-                            pltpu.VMEM((Hp, D), jnp.float32)],
+                (1, 1, Rp, D), lambda b, g, p, _len, _pt: (b, g, 0, 0)),
+            scratch_shapes=[pltpu.VMEM((Rp, 128), jnp.float32),
+                            pltpu.VMEM((Rp, 128), jnp.float32),
+                            pltpu.VMEM((Rp, D), jnp.float32)],
         ),
-        out_shape=jax.ShapeDtypeStruct((B, ng, Hp, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, ng, Rp, D), q.dtype),
         interpret=interpret,
         compiler_params=None if interpret else pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
     )(lengths, page_tbl, *args)
-    out = out[:, :, :Hg, :].reshape(B, H, 1, D)
-    return out.transpose(0, 2, 1, 3)  # [B, 1, H, D]
+    out = out[:, :, :rows, :].reshape(B, ng, G, S, rep, D)
+    out = jnp.transpose(out, (0, 1, 2, 4, 3, 5)).reshape(B, H, S, D)
+    return out.transpose(0, 2, 1, 3)  # [B, S, H, D]
 
 
 def _paged_dense(q, k_pages, v_pages, offset, page_tbl, k_scale, v_scale,
                  scale):
-    """XLA fallback (CPU tests, chunked-prefill S > 1, odd page sizes):
-    gather the slot's pages into a contiguous view, then the dense math."""
+    """XLA fallback (CPU / odd page or head shapes): gather the slot's
+    pages into a contiguous view, then the dense math.  The gather is
+    CAPPED at the batch-max logical length when the offsets are concrete
+    (page tables are padded to max_pages, but no slot can have valid keys
+    past max(offset) + S): on a mixed-length batch in a long-max-len pool
+    this trims the materialized view — and the O(S * M * ps) masked score
+    matrix behind it — from every slot's FULL table to the pages anyone
+    actually uses.  Traced offsets (shape-polymorphic callers) keep the
+    full-table gather: the cap must be static to change the gather shape."""
+    S, M, ps = q.shape[1], page_tbl.shape[1], k_pages.shape[2]
+    if not isinstance(jnp.asarray(offset), jax.core.Tracer):
+        import numpy as np
+
+        used = min(M, -(-(int(np.max(np.asarray(offset))) + S) // ps))
+        page_tbl = page_tbl[:, :max(used, 1)]
     k = gather_pages(k_pages, page_tbl)
     v = gather_pages(v_pages, page_tbl)
     if k_scale is not None:
@@ -442,9 +535,14 @@ def paged_decode_attention(q, k_pages, v_pages, offset, page_tbl,
                            interpret=None):
     """Attention of q [B, S, H, D] against a PAGED cache: pool
     [P, Hkv, page_size, D] + page table [B, max_pages], with the first
-    offset + s positions of each slot valid for query position s.  int8
-    pools pass per-(head, token) scale pools [P, Hkv, page_size].
-    Returns [B, S, H, D] in q's dtype."""
+    offset + s positions of each slot valid for query position s (offset a
+    scalar or a per-slot [B] vector).  int8 pools pass per-(head, token)
+    scale pools [P, Hkv, page_size].  Any S >= 1 rides the ONE ragged
+    Pallas kernel on tile-aligned shapes — S=1 decode, prefill chunks,
+    and the K+1 spec-verify ladder; the gathered dense path survives only
+    for CPU-odd shapes (D/page off the 128 tile, mismatched head counts)
+    or a query block too large for VMEM.  Returns [B, S, H, D] in q's
+    dtype."""
     B, S, H, D = q.shape
     Hkv, ps = k_pages.shape[1], k_pages.shape[2]
     if scale is None:
@@ -455,10 +553,22 @@ def paged_decode_attention(q, k_pages, v_pages, offset, page_tbl,
         jnp.asarray(offset, jnp.int32), (B,)).astype(jnp.int32) + S
     # ps % 128 == 0 keeps every page block (and the reshaped scale pages)
     # on clean (sublane, 128-lane) tiles; anything else is fallback-only
-    shapes_ok = (S == 1 and D % 128 == 0 and ps % 128 == 0
-                 and H % Hkv == 0)
-    if shapes_ok:
+    tile_ok = D % 128 == 0 and ps % 128 == 0 and H % Hkv == 0
+    # even at G=1 the S*rep query rows of q/m/l/acc state must fit VMEM
+    rows_ok = tile_ok and _paged_state_bytes(
+        S * (H // Hkv), D) <= 6 * 1024 * 1024
+    if _FORCE_PATH == "dense":
+        reason, use_kernel = "forced", False
+    elif not tile_ok:
+        reason, use_kernel = "off_tile", False
+    elif not rows_ok:
+        reason, use_kernel = "query_rows_over_vmem", False
+    else:
+        reason, use_kernel = "tile_aligned", True
+    if use_kernel:
+        _note("paged_kernel", reason)
         return _paged_pallas(q, k_pages, v_pages, lengths, page_tbl,
                              k_scale, v_scale, scale, interpret)
+    _note("paged_dense", reason)
     return _paged_dense(q, k_pages, v_pages, offset, page_tbl,
                         k_scale, v_scale, scale)

@@ -7,6 +7,7 @@ GQA through the BlockSpec index map."""
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from paddle_tpu.ops.decode_attention import (
@@ -146,7 +147,8 @@ def test_paged_matches_contiguous_static():
 
 
 def test_paged_dispatcher_fallbacks():
-    # S = 2 (chunked prefill) -> dense path, strictly growing prefixes
+    # S = 2 (chunked prefill) now rides the RAGGED kernel: strictly
+    # growing per-row prefixes, row 0 equal to the S=1 call
     q, kp, vp, pt, lens = _mk_paged()
     q2 = jnp.concatenate([q, q], axis=1)
     out = paged_decode_attention(q2, kp, vp, lens, pt, interpret=True)
@@ -160,4 +162,151 @@ def test_paged_dispatcher_fallbacks():
     got = paged_decode_attention(q3, kp3, vp3, lens3, pt3, interpret=True)
     want = _paged_dense(q3, kp3, vp3, lens3, pt3, None, None, 1 / 128 ** 0.5)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------- ragged S >= 1 query blocks
+#
+# ONE kernel serves S=1 decode, prefill chunks at arbitrary offsets, and
+# the K+1 spec-verify ladder: per-slot lengths (= offset + S) prefetched
+# into the kernel drive a per-ROW causal mask.  Every test pits the
+# interpret-mode kernel against the gathered dense fallback on the SAME
+# poisoned-trash page pool.
+
+
+def _mk_ragged_q(B, S, H, D=128, seed=3):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(rng.randn(B, S, H, D).astype(np.float32) * 0.3)
+
+
+@pytest.mark.parametrize("offs", [(123, 253, 380),   # straddle page edges
+                                  (0, 127, 256)])    # incl. offset 0 / edge
+def test_paged_kernel_ragged_verify_ladder(offs):
+    """S = K+1 verify shape with per-slot offsets (the spec-decode tick)."""
+    S = 5
+    q, kp, vp, pt, lens = _mk_paged(lens=tuple(o + S for o in offs))
+    qs = _mk_ragged_q(3, S, 8)
+    off = jnp.asarray(offs, jnp.int32)
+    got = _paged_pallas(qs, kp, vp, off + S, pt, None, None,
+                        scale=1 / 128 ** 0.5, interpret=True)
+    want = _paged_dense(qs, kp, vp, off, pt, None, None, 1 / 128 ** 0.5)
+    assert np.isfinite(np.asarray(got)).all()
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_ragged_chunk_gqa():
+    """A full prefill chunk (S = 128) at a mid-page chunk offset, GQA
+    rep = 4 — the chunked-prefill shape."""
+    S, off = 128, 200
+    q, kp, vp, pt, lens = _mk_paged(Hkv=2, lens=(off + S,) * 3)
+    qs = _mk_ragged_q(3, S, 8, seed=5)
+    got = _paged_pallas(qs, kp, vp, jnp.full((3,), off + S, jnp.int32), pt,
+                        None, None, scale=0.1, interpret=True)
+    want = _paged_dense(qs, kp, vp, off, pt, None, None, 0.1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_kernel_ragged_int8():
+    """int8 dequant-in-VMEM with a ragged S=3 block and per-slot offsets."""
+    S = 3
+    q, kp, vp, pt, lens = _mk_paged(lens=(60 + S, 250 + S, 500 + S),
+                                    poison_trash=False)
+    kq, ks = _quantize_kv(kp)
+    vq, vs = _quantize_kv(vp)
+    qs = _mk_ragged_q(3, S, 8, seed=6)
+    off = jnp.asarray((60, 250, 500), jnp.int32)
+    got = _paged_pallas(qs, kq, vq, off + S, pt, ks, vs,
+                        scale=1 / 128 ** 0.5, interpret=True)
+    want = _paged_dense(qs, kq, vq, off, pt, ks, vs, 1 / 128 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=4e-4, atol=4e-4)
+
+
+def test_paged_ragged_rows_match_single_query_calls():
+    """Cross-check without the dense oracle: row s of a ragged S-block
+    equals an S=1 call at offset + s (the ladder IS S stacked decodes)."""
+    S = 4
+    q, kp, vp, pt, lens = _mk_paged(lens=(37 + S, 300 + S, 507 + S))
+    qs = _mk_ragged_q(3, S, 8, seed=7)
+    off = jnp.asarray((37, 300, 507), jnp.int32)
+    got = _paged_pallas(qs, kp, vp, off + S, pt, None, None,
+                        scale=1 / 128 ** 0.5, interpret=True)
+    for s in range(S):
+        solo = _paged_pallas(qs[:, s:s + 1], kp, vp, off + s + 1, pt,
+                             None, None, scale=1 / 128 ** 0.5,
+                             interpret=True)
+        np.testing.assert_allclose(np.asarray(got[:, s:s + 1]),
+                                   np.asarray(solo), rtol=2e-5, atol=2e-5)
+
+
+def test_paged_dispatcher_ragged_reasons_and_counter():
+    """Dispatch accounting: tile-aligned ragged S hits the kernel
+    (llm_attn_kernel_total{path="paged_kernel"}), a query block too big
+    for VMEM and a forced-dense override fall back with their reasons."""
+    from paddle_tpu.observability import REGISTRY
+
+    from paddle_tpu.ops import decode_attention as da
+
+    fam = REGISTRY.get("llm_attn_kernel_total")
+
+    def counts():
+        return {l: c.value for l, c in fam.series()}
+
+    q, kp, vp, pt, lens = _mk_paged()
+    qs = _mk_ragged_q(3, 3, 8, seed=8)
+    before = counts().get(("paged_kernel", "tile_aligned"), 0.0)
+    out = paged_decode_attention(qs, kp, vp, lens, pt, interpret=True)
+    assert counts()[("paged_kernel", "tile_aligned")] == before + 1
+    want = _paged_dense(qs, kp, vp, lens, pt, None, None, 1 / 128 ** 0.5)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # a ragged block whose S*rep rows of VMEM state cannot fit -> dense
+    huge = _mk_ragged_q(3, 1600, 8, seed=9)  # 3200 rows > 6MB state cap
+    b = counts().get(("paged_dense", "query_rows_over_vmem"), 0.0)
+    paged_decode_attention(huge, kp, vp, lens, pt, interpret=True)
+    assert counts()[("paged_dense", "query_rows_over_vmem")] == b + 1
+    # the test/bench override pins the fallback for A/B runs
+    b = counts().get(("paged_dense", "forced"), 0.0)
+    da._FORCE_PATH = "dense"
+    try:
+        forced = paged_decode_attention(qs, kp, vp, lens, pt,
+                                        interpret=True)
+    finally:
+        da._FORCE_PATH = None
+    assert counts()[("paged_dense", "forced")] == b + 1
+    np.testing.assert_allclose(np.asarray(forced), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_paged_dense_gather_cap():
+    """The fallback's gather stops at the batch-max logical length when
+    offsets are concrete: a short batch in a long-max-pages pool reads
+    only the used pages (same numbers either way — the tail it skips is
+    causally masked)."""
+    from paddle_tpu.ops import decode_attention as da
+
+    q, kp, vp, pt, lens = _mk_paged(M=16, lens=(37, 100, 120))
+    seen = []
+    orig = da.gather_pages
+
+    def spy(pool, tbl):
+        seen.append(tbl.shape[1])
+        return orig(pool, tbl)
+
+    da.gather_pages = spy
+    try:
+        got = _paged_dense(q, kp, vp, lens, pt, None, None, 1 / 128 ** 0.5)
+    finally:
+        da.gather_pages = orig
+    assert seen and all(m == 1 for m in seen)  # 121 tokens -> 1 page of 128
+    want = _paged_dense(q, kp, vp, lens, pt[:, :2], None, None,
+                        1 / 128 ** 0.5)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    # traced offsets keep the full-table gather (shape must stay static)
+    jitted = jax.jit(lambda o: da._paged_dense(
+        q, kp, vp, o, pt, None, None, 1 / 128 ** 0.5))
+    np.testing.assert_allclose(np.asarray(jitted(lens)), np.asarray(got),
                                rtol=2e-5, atol=2e-5)

@@ -98,6 +98,7 @@ def import_instrumented(repo_root=None):
         sys.path.insert(0, repo_root)
     import paddle_tpu  # noqa: F401
     import paddle_tpu.distributed.checkpoint  # noqa: F401
+    import paddle_tpu.ops.decode_attention  # noqa: F401
     import paddle_tpu.distributed.fault_tolerance  # noqa: F401
     import paddle_tpu.distributed.sharded_train_step  # noqa: F401
     import paddle_tpu.distributed.store  # noqa: F401
