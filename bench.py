@@ -1056,6 +1056,43 @@ def _bench_observability(on_accel):
     return out
 
 
+def _bench_xplane_parse(on_accel):
+    """Profiling-plane cost guard (ISSUE 14): wire-parse + per-op
+    aggregation throughput of the dependency-free XPlane reader over a
+    realistic blob (the committed golden dump replicated 64x —
+    concatenated XSpace serializations merge, so the blob is one legal
+    multi-plane dump).  trace_report --xplane runs at operator cadence,
+    but a regression from linear to quadratic (span copies, repeated
+    metadata resolution) would make real multi-GB TPU dumps unusable.
+    Host-side by construction: runs on CPU too."""
+    import os
+
+    from paddle_tpu.observability import xplane
+
+    golden = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "data", "golden.xplane.pb")
+    with open(golden, "rb") as f:
+        blob = f.read() * 64
+
+    def med(fn, n):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    parse_s = med(lambda: xplane.parse_xspace(blob), 9)
+    space = xplane.parse_xspace(blob)
+    summ_s = med(lambda: xplane.per_op_summary(space), 9)
+    mb = len(blob) / 1e6
+    return {
+        "xplane_parse_us_per_mb": round(parse_s * 1e6 / mb, 1),
+        "xplane_summary_us_per_mb": round(summ_s * 1e6 / mb, 1),
+        "xplane_bench_ops": len(xplane.per_op_summary(space)),
+    }
+
+
 def _bench_alerting(on_accel):
     """Alerting-plane cost guard (ISSUE 7): exposition parse cost of a
     realistic scraped payload and rule-evaluation cost per engine tick
@@ -1300,6 +1337,7 @@ def main():
                     (_bench_observability, "observability"),
                     (_bench_alerting, "alerting"),
                     (_bench_tracing, "tracing"),
+                    (_bench_xplane_parse, "xplane"),
                     (_bench_router, "router")):
         if time.monotonic() > deadline:
             out[f"{tag}_skipped"] = "bench budget exhausted"

@@ -27,6 +27,7 @@ from ..tensor.tensor import Tensor
 from ..framework import random as _random
 from ..jit._step_impl import build_step_fn, init_scaler_state
 from ..observability import metrics as _obs
+from ..observability import profiling as _profiling
 from ..observability import slo as _slo
 from ..observability.spans import span as _span
 from .sharding_ctx import mesh_scope, param_sharding
@@ -93,6 +94,7 @@ class ShardedTrainStep:
         # batch axis 0 sharded over all data-like mesh axes present
         data_axes = tuple(a for a in ("dp", "sharding") if a in mesh.axis_names and mesh.shape[a] > 1)
         self.batch_spec = batch_spec if batch_spec is not None else P(data_axes if data_axes else None)
+        _profiling.install_compile_hooks()
         self._jitted = None
         self._opt_state = None
         self._param_sharding = None
@@ -175,6 +177,7 @@ class ShardedTrainStep:
                 return inner(*args)
 
         donate = (0, 2) if self._donate else ()
+        _profiling.record_compile("train_step")
         self._jitted = jax.jit(traced, in_shardings=in_shardings, out_shardings=out_shardings,
                                donate_argnums=donate)
 

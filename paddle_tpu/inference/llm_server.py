@@ -76,6 +76,7 @@ import jax.numpy as jnp
 from ..autograd import tape
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _obs
+from ..observability import profiling as _profiling
 from ..observability import slo as _slo
 from ..observability import tracing as _tracing
 from ..observability.spans import span as _span
@@ -548,6 +549,10 @@ class LLMEngine:
         self._verify_jit = None
         self._decode_jit = {}  # scan length (effective chunk) -> jitted fn
         self._prefill_jit = {}
+        # page id -> trace_id of the request whose prefill first indexed
+        # it in the prefix cache (the COW-fork provenance stamp; bounded
+        # by num_pages since inserts overwrite reused page ids)
+        self._page_donor = {}
         self._thread = None
         self._stop = False
         self._draining = False  # drain(): admission closed, in-flight finish
@@ -572,6 +577,9 @@ class LLMEngine:
         self._first_tick_done = False
         self.healthy_heartbeat_age = float(healthy_heartbeat_age)
         self._tracer = tracer if tracer is not None else _tracing.TRACER
+        # always-on compile telemetry: backend compiles land on
+        # jit_compiles_total{fn="backend"} even without a metrics port
+        _profiling.install_compile_hooks()
         self.telemetry = None
         self.alert_engine = None
         if metrics_port is not None:
@@ -587,6 +595,9 @@ class LLMEngine:
                 "pump_heartbeat", self._check_heartbeat)
             self.telemetry.register_healthcheck(
                 "admission", self._check_admission)
+            # refresh hbm_* gauges at scrape time + a /varz section
+            self.telemetry.register_collect(
+                _profiling.poll_device_memory, varz_key="device_memory")
             self.telemetry.start()
         elif alert_rules is not None:
             raise ValueError("alert_rules requires metrics_port (the rules "
@@ -839,6 +850,10 @@ class LLMEngine:
             # tracer sampling health (started/sampled/dropped + store
             # occupancy) — fleetwatch's view of whether /tracez is useful
             "tracing": self._tracer.stats(),
+            # per-device HBM occupancy (empty on backends that expose no
+            # memory_stats — CPU); polling here also refreshes the
+            # hbm_* gauges
+            "device_memory": _profiling.poll_device_memory(),
             "telemetry_url": self.telemetry.url
             if self.telemetry is not None else None,
         }
@@ -1113,6 +1128,7 @@ class LLMEngine:
 
     def _get_prefill(self, Lb):
         if Lb not in self._prefill_jit:
+            _profiling.record_compile("prefill")
             self._prefill_jit[Lb] = self._prefill_fn(Lb)
         return self._prefill_jit[Lb]
 
@@ -1193,6 +1209,7 @@ class LLMEngine:
         layers (instead of 2-5 host-dispatched updates per layer)."""
         key = ("w", Lb)
         if key not in self._prefill_jit:
+            _profiling.record_compile("slot_writer")
             quant = self.cache_dtype == "int8"
 
             def write(caches, kvs, slot):
@@ -1326,6 +1343,7 @@ class LLMEngine:
         if self._cow_jit is None:
             from ..models.kv_cache import cow_copy_pages
 
+            _profiling.record_compile("cow_copy")
             self._cow_jit = jax.jit(cow_copy_pages, donate_argnums=(0,))
         return self._cow_jit
 
@@ -1389,9 +1407,11 @@ class LLMEngine:
             return self._prefilling[0]
         return r
 
-    def _cache_insert(self, slot, prompt):
+    def _cache_insert(self, slot, prompt, trace_id=None):
         """Register a freshly prefilled prompt's pages in the prefix index;
-        the index's new holds are incref'd so they outlive the slot."""
+        the index's new holds are incref'd so they outlive the slot.
+        ``trace_id`` stamps the newly held pages' COW-fork provenance —
+        a later request admitted over them links back to this donor."""
         if self._prefix is None:
             return
         new_holds = self._prefix.insert(prompt, self._slot_pages[slot])
@@ -1400,6 +1420,8 @@ class LLMEngine:
         for page in new_holds:
             self._incref(page)
             self._page_cached[page] = True
+            if trace_id:
+                self._page_donor[page] = trace_id
 
     def _slot_held_pages(self):
         """Pages mapped by at least one SLOT (a page held only by the
@@ -1515,6 +1537,7 @@ class LLMEngine:
 
     def _get_chunk_prefill(self):
         if "chunk" not in self._prefill_jit:
+            _profiling.record_compile("chunk_prefill")
             self._prefill_jit["chunk"] = self._chunk_prefill_fn()
         return self._prefill_jit["chunk"]
 
@@ -1653,8 +1676,23 @@ class LLMEngine:
                     # prefill is abandoned by a COW-starvation requeue
                     # (the skipped chunks get recomputed privately, so the
                     # hit never happened)
-                self._open_admission_span(req, slot,
-                                          cached_tokens=int(matched))
+                # COW-fork provenance: the deepest shared page's donor
+                # trace links this admission back to the request whose
+                # prefill populated the prefix (rendered by /tracez as a
+                # cross-trace link)
+                donor = None
+                for p in reversed(shared):
+                    d = self._page_donor.get(p)
+                    if d and d != req.trace.trace_id:
+                        donor = d
+                        break
+                if donor:
+                    self._open_admission_span(
+                        req, slot, cached_tokens=int(matched),
+                        prefix_donor=donor)
+                else:
+                    self._open_admission_span(req, slot,
+                                              cached_tokens=int(matched))
                 # chunked prefill starts at the first UNCACHED token — a
                 # hit skips every chunk the cache already covers
                 self._prefilling = (req, slot, matched)
@@ -1745,7 +1783,7 @@ class LLMEngine:
         # blocks + partial tail so CONCURRENT same-prefix requests hit
         # (insert precedes the first decode write, whose COW check then
         # sees the tail page as shared and forks it)
-        self._cache_insert(slot, req.prompt)
+        self._cache_insert(slot, req.prompt, trace_id=req.trace.trace_id)
         tok = self._host_select(np.asarray(logits[0, 0]), req)
         first = not req.tokens  # re-admission after preemption continues
         req.slot = slot
@@ -1791,6 +1829,12 @@ class LLMEngine:
                     jnp.zeros((1, self.M), jnp.int32),
                     jnp.full((1, C), self.pad, jnp.int32),
                     jnp.zeros((1,), jnp.int32), jnp.asarray(0, jnp.int32))
+                # the COW fork program too: a warm engine's first
+                # shared-prefix fork must not compile (and must not trip
+                # recompile_storm).  A trash-page self-copy is harmless.
+                self.caches = self._get_cow_copy()(
+                    self.caches, jnp.asarray(0, jnp.int32),
+                    jnp.asarray(0, jnp.int32))
             else:
                 for Lb in (buckets if buckets is not None else self.buckets):
                     Lb = int(Lb)
@@ -1802,6 +1846,7 @@ class LLMEngine:
             eff = max(1, min(self.decode_chunk, self.L - 1))
             jit = self._decode_jit.get(eff)
             if jit is None:
+                _profiling.record_compile("decode")
                 jit = self._decode_jit[eff] = self._decode_fn()
             from ..framework import random as _fr
 
@@ -1832,6 +1877,9 @@ class LLMEngine:
                 _, _, self.caches = self._get_verify()(*vargs)
         dt = time.perf_counter() - t0
         _M_WARMUP_S.set(dt)
+        # every expected program is now compiled: later compiles are
+        # recompiles (jit_recompiles_total -> the recompile_storm rule)
+        _profiling.mark_warm()
         return dt
 
     def _host_select(self, row, req):
@@ -1990,6 +2038,7 @@ class LLMEngine:
 
     def _get_verify(self):
         if self._verify_jit is None:
+            _profiling.record_compile("verify")
             self._verify_jit = self._verify_fn()
         return self._verify_jit
 
@@ -2044,6 +2093,7 @@ class LLMEngine:
                 return 0
         jit = self._decode_jit.get(eff)
         if jit is None:
+            _profiling.record_compile("decode")
             jit = self._decode_jit[eff] = self._decode_fn()
         tokens = jnp.asarray(self.last_token.reshape(-1, 1))
         pos = jnp.asarray(self.slot_pos)

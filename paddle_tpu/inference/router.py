@@ -783,8 +783,24 @@ class Router:
             shed, retries = self._shed, self._retries
             ov_s, ov_n = self._overhead_s, self._overhead_n
         total = hits + misses
+        replicas = []
+        for r in self._replicas.values():
+            d = r.to_dict()
+            # Profiling-plane enrichment (PR 14): both keys stay absent
+            # when the replica exports neither family, so /routerz
+            # consumers can distinguish "old replica" from "0.0".
+            hbm = self._sample(r.name, "hbm_utilization_ratio",
+                               default=None)
+            if hbm is not None:
+                d["hbm_utilization_ratio"] = round(hbm, 4)
+            stamp = self._sample(r.name, "jit_last_compile_unix_seconds",
+                                 default=0.0)
+            if stamp > 0:
+                now = time.time()  # tpulint: disable=impure-trace
+                d["last_compile_age_s"] = round(max(0.0, now - stamp), 1)
+            replicas.append(d)
         return {
-            "replicas": [r.to_dict() for r in self._replicas.values()],
+            "replicas": replicas,
             "affinity": {
                 "entries": len(self.affinity),
                 "capacity": self.affinity.capacity,

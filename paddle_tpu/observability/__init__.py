@@ -45,6 +45,19 @@ And orthogonal to the aggregate planes, the forensic plane (ISSUE 8):
   OpenMetrics histogram EXEMPLARS (``# {trace_id="..."}`` annotations on
   `/metrics` that ``parse_prometheus`` round-trips).
 
+And below the host boundary, the profiling plane (ISSUE 14):
+
+- `observability.xplane` — dependency-free reader for the
+  ``.xplane.pb`` dumps ``jax.profiler.trace()`` writes (hand-rolled
+  protobuf wire parsing; no tensorflow/protobuf import), decoding
+  per-HLO device events for the census<->timeline join
+  (``tools/trace_report.py --xplane``);
+- `observability.profiling` — ``ProfilingSession`` (a profiler window
+  filed under the owning span), compile telemetry
+  (``jit_compiles_total`` / ``jit_recompiles_total`` feeding the
+  ``recompile_storm`` alert rule) and device-memory telemetry
+  (``hbm_*`` gauges from ``device.memory_stats()``).
+
 Quick start::
 
     import paddle_tpu as paddle
@@ -78,6 +91,13 @@ from .alerts import (  # noqa: F401
 from .tracing import (  # noqa: F401
     Trace, Tracer, TraceStore, TRACES, TRACER, NULL_TRACE, start_trace,
 )
+from .xplane import (  # noqa: F401
+    parse_xspace, load_xspace, find_dump, per_op_summary,
+)
+from .profiling import (  # noqa: F401
+    ProfilingSession, install_compile_hooks, record_compile, mark_warm,
+    poll_device_memory,
+)
 from . import metrics  # noqa: F401
 from . import spans  # noqa: F401
 from . import flight_recorder  # noqa: F401
@@ -86,6 +106,8 @@ from . import slo  # noqa: F401
 from . import scrape  # noqa: F401
 from . import alerts  # noqa: F401
 from . import tracing  # noqa: F401
+from . import xplane  # noqa: F401
+from . import profiling  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
@@ -100,4 +122,8 @@ __all__ = [
     "JsonlNotifier", "alerts",
     "Trace", "Tracer", "TraceStore", "TRACES", "TRACER", "NULL_TRACE",
     "start_trace", "tracing",
+    "parse_xspace", "load_xspace", "find_dump", "per_op_summary",
+    "xplane",
+    "ProfilingSession", "install_compile_hooks", "record_compile",
+    "mark_warm", "poll_device_memory", "profiling",
 ]

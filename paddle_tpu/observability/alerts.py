@@ -178,7 +178,8 @@ def default_rules(queue_depth=64, burn_rate=0.5, staleness_s=60.0,
     """The stock rule set over the existing README catalogue: SLO burn
     rate, component healthchecks (including the LLM pump heartbeat-age
     check), store deadline pressure, serving backlog, recovery restart
-    storms, and the scraper's own target liveness/staleness."""
+    storms, post-warmup recompilation storms, and the scraper's own
+    target liveness/staleness."""
     return [
         Rule("slo_burn_rate_high", kind="burn_rate", threshold=burn_rate,
              for_s=30.0,
@@ -204,6 +205,13 @@ def default_rules(queue_depth=64, burn_rate=0.5, staleness_s=60.0,
              window_s=restart_window_s, for_s=0.0,
              description="run_with_recovery restarted more than twice "
                          "inside the window — the job is crash-looping"),
+        Rule("recompile_storm", kind="delta",
+             metric="jit_recompiles_total", op=">", threshold=0.0,
+             window_s=300.0, for_s=0.0,
+             description="an XLA program compiled AFTER the process "
+                         "declared itself warm (warmup() finished) — "
+                         "shape/dtype churn is eating device time on "
+                         "recompiles"),
         # exported_target="" matches only THIS scraper's own liveness
         # samples, never a target's re-exported view of its own fleet
         # (scrape.SampleSet.match: empty selector value = label absent)

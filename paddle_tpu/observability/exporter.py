@@ -87,6 +87,7 @@ class TelemetryServer:
         # GETs, path -> fn(query, body_bytes) -> (code, doc) for POSTs
         self._json_endpoints = {}
         self._post_endpoints = {}
+        self._collectors = []  # (fn, varz_key) pre-scrape refresh hooks
         self.alerts = None  # AlertEngine served on /alertz
         self._alerts_eval = True
         if alerts is not None:
@@ -107,6 +108,27 @@ class TelemetryServer:
         return ``(status_code, doc)``."""
         self._json_endpoints[str(path).rstrip("/")] = fn
         return self
+
+    def register_collect(self, fn, varz_key=None):
+        """Run ``fn()`` at the top of every `/metrics` and `/varz`
+        request — the pull-model refresh hook for gauges that mirror
+        external state (the engine registers
+        ``profiling.poll_device_memory`` here so ``hbm_*`` is current at
+        scrape time, not at the last engine tick).  With ``varz_key``
+        the return value is additionally embedded in the `/varz`
+        document under that key.  A raising collector is skipped, never
+        a 500: stale gauges beat a dead scrape."""
+        self._collectors.append((fn, str(varz_key) if varz_key else None))
+        return self
+
+    def _collect(self, varz=None):
+        for fn, key in self._collectors:
+            try:
+                out = fn()
+            except Exception:
+                continue
+            if varz is not None and key is not None:
+                varz[key] = out
 
     def register_post_endpoint(self, path, fn):
         """Serve ``fn(query_string, body_bytes) -> (status_code, doc)`` on
@@ -230,6 +252,7 @@ class TelemetryServer:
         try:
             if path == "/metrics":
                 _M_SCRAPES.labels(endpoint="metrics").inc()
+                self._collect()
                 # content negotiation: exemplars ride ONLY on the
                 # OpenMetrics variant — a 0.0.4 scraper gets clean
                 # classic text it can always parse
@@ -251,7 +274,9 @@ class TelemetryServer:
                             "application/json", body)
             elif path == "/varz":
                 _M_SCRAPES.labels(endpoint="varz").inc()
-                varz = {"metrics": self.registry.snapshot()}
+                varz = {"metrics": None}
+                self._collect(varz)
+                varz["metrics"] = self.registry.snapshot()
                 if self.recorder is not None:
                     varz["flight_recorder"] = {
                         "events": len(self.recorder),

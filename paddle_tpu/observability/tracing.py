@@ -272,8 +272,25 @@ class Trace:
         return self._ended
 
     # ---------------------------------------------------------- exports
+    def links(self):
+        """Cross-trace links: span attributes named ``*_donor`` hold
+        another trace's trace_id (the COW-fork ``prefix_donor`` stamp on
+        an admission span) — collected here so `/tracez` renders a COW
+        storm as a navigable graph instead of a bare attribute."""
+        out = []
+
+        def walk(sp):
+            for k, v in sp.attrs.items():
+                if k.endswith("_donor") and v:
+                    out.append({"span": sp.name, "attr": k,
+                                "trace_id": str(v)})
+            for c in sp.children:
+                walk(c)
+        walk(self.root)
+        return out
+
     def to_dict(self):
-        return {
+        d = {
             "trace_id": self.trace_id,
             "name": self.name,
             "status": self.status,
@@ -285,6 +302,10 @@ class Trace:
             "attrs": dict(self.root.attrs),
             "spans": [c.to_dict() for c in self.root.children],
         }
+        links = self.links()
+        if links:
+            d["links"] = links
+        return d
 
     def span_tree(self):
         """Nested ``[name, [children...]]`` lists — the exact-tree
@@ -391,6 +412,9 @@ class _NullTrace:
         return []
 
     def find_spans(self, name):
+        return []
+
+    def links(self):
         return []
 
 

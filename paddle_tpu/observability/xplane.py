@@ -1,0 +1,401 @@
+"""Dependency-free reader for the ``.xplane.pb`` dumps ``jax.profiler``
+writes — the device-tracer half of the profiling plane (ISSUE 14).
+
+``jax.profiler.trace(logdir)`` (and ``start_trace``/``stop_trace``)
+serializes an XSpace protobuf under
+``<logdir>/plugins/profile/<run>/<host>.xplane.pb``: per-device planes of
+per-HLO events with picosecond timings — the ground truth the census
+cost model (``distributed.census.per_op_census``) wants to be joined
+against.  Importing tensorflow (or protobuf) for the schema would drag a
+second framework into the image, so this module hand-rolls the protobuf
+wire format the same way ``scrape.py`` hand-rolls the Prometheus text
+format: stdlib only, one pass per message, strict about what it
+understands and silent about what it doesn't (unknown fields are legal
+protobuf and are skipped, not errors).
+
+Wire format notes (README §Observability, "Profiling plane"):
+
+- A protobuf message is a flat sequence of ``(tag, payload)`` records;
+  ``tag = field_number << 3 | wire_type``.  Wire types used by XSpace:
+  0 = varint, 1 = fixed 64-bit (doubles), 2 = length-delimited
+  (strings, nested messages, maps).
+- Field numbers (``tsl/profiler/protobuf/xplane.proto``):
+  XSpace.planes=1; XPlane id=1 name=2 lines=3 event_metadata=4
+  stat_metadata=5 stats=6; XLine id=1 name=2 timestamp_ns=3 events=4
+  duration_ps=9 display_name=11; XEvent metadata_id=1 offset_ps=2
+  duration_ps=3 stats=4 num_occurrences=5; XStat metadata_id=1
+  double_value=2 uint64_value=3 int64_value=4 str_value=5 bytes_value=6
+  ref_value=7; X{Event,Stat}Metadata id=1 name=2.
+- Map fields (``event_metadata``/``stat_metadata``) encode each entry as
+  a nested message with key=1, value=2.
+- ``ref_value`` is string interning: the stat's value is the NAME of the
+  stat_metadata entry it points at (XLA uses it for ``hlo_op`` /
+  ``hlo_category`` strings repeated across thousands of events).
+- int64 fields are plain varints; negatives arrive as 10-byte two's
+  complement, so a decoded value >= 2**63 folds down by 2**64.
+
+Event timings are ``line.timestamp_ns`` + ``event.offset_ps``, lasting
+``event.duration_ps``.  On TPU the interesting planes are
+``/device:TPU:*``; a CPU run (what tier-1 exercises) has the same ops on
+the ``/host:CPU`` plane's XLA-client lines (``tf_XLA...`` /
+``TfrtCpuClient``), with the per-op ``hlo_op`` / ``hlo_module`` /
+``program_id`` stats resolved through the metadata maps either way.
+
+No jax / numpy imports (same contract as ``observability.metrics``) —
+the parser must be loadable in a stdlib-only context.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from collections import OrderedDict
+
+__all__ = [
+    "XStat", "XEvent", "XLine", "XPlane", "XSpace",
+    "parse_xspace", "load_xspace", "find_dump",
+    "iter_events", "per_op_summary", "to_timeline",
+]
+
+_WIRE_VARINT, _WIRE_FIXED64, _WIRE_LEN, _WIRE_FIXED32 = 0, 1, 2, 5
+
+
+# ------------------------------------------------------------ wire reading
+def _read_varint(buf, pos, end):
+    """Little-endian base-128 varint at ``pos`` -> (value, next_pos)."""
+    result = 0
+    shift = 0
+    while True:
+        if pos >= end:
+            raise ValueError("truncated varint")
+        b = buf[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, pos
+        shift += 7
+        if shift > 63:
+            raise ValueError("varint wider than 64 bits")
+
+
+def _fields(buf, pos, end):
+    """Yield ``(field_number, wire_type, value)`` records of one message.
+
+    ``value`` is an int for varints, a float for fixed64 (every fixed64
+    in xplane.proto is a double), and a ``(start, end)`` byte span for
+    length-delimited payloads — spans keep nested decoding copy-free."""
+    while pos < end:
+        tag, pos = _read_varint(buf, pos, end)
+        field, wire = tag >> 3, tag & 7
+        if wire == _WIRE_VARINT:
+            value, pos = _read_varint(buf, pos, end)
+        elif wire == _WIRE_LEN:
+            size, pos = _read_varint(buf, pos, end)
+            if pos + size > end:
+                raise ValueError(
+                    f"length-delimited field {field} overruns the buffer")
+            value = (pos, pos + size)
+            pos += size
+        elif wire == _WIRE_FIXED64:
+            if pos + 8 > end:
+                raise ValueError(f"truncated fixed64 field {field}")
+            value = struct.unpack_from("<d", buf, pos)[0]
+            pos += 8
+        elif wire == _WIRE_FIXED32:
+            if pos + 4 > end:
+                raise ValueError(f"truncated fixed32 field {field}")
+            value = struct.unpack_from("<f", buf, pos)[0]
+            pos += 4
+        else:  # groups (3/4) predate proto3; XLA never emits them
+            raise ValueError(f"unsupported wire type {wire} "
+                             f"(field {field})")
+        yield field, wire, value
+
+
+def _int64(v):
+    """Fold a 64-bit varint into a signed int (negatives arrive as
+    two's complement)."""
+    return v - (1 << 64) if v >= (1 << 63) else v
+
+
+def _text(buf, span):
+    return bytes(buf[span[0]:span[1]]).decode("utf-8", "replace")
+
+
+# ------------------------------------------------------- decoded structure
+class XStat:
+    """One resolved stat: metadata name + the oneof value (int, float,
+    str or bytes; ``ref_value`` already chased to its interned string)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name, value):
+        self.name = name
+        self.value = value
+
+    def __repr__(self):
+        return f"XStat({self.name}={self.value!r})"
+
+
+class XEvent:
+    __slots__ = ("name", "offset_ps", "duration_ps", "num_occurrences",
+                 "stats")
+
+    def __init__(self):
+        self.name = ""
+        self.offset_ps = 0
+        self.duration_ps = 0
+        self.num_occurrences = 0  # aggregated-event form (offset absent)
+        self.stats = {}  # stat name -> resolved value
+
+    @property
+    def duration_us(self):
+        return self.duration_ps / 1e6
+
+
+class XLine:
+    __slots__ = ("id", "name", "display_name", "timestamp_ns",
+                 "duration_ps", "events")
+
+    def __init__(self):
+        self.id = 0
+        self.name = ""
+        self.display_name = ""
+        self.timestamp_ns = 0
+        self.duration_ps = 0
+        self.events = []
+
+
+class XPlane:
+    __slots__ = ("id", "name", "lines", "stats")
+
+    def __init__(self):
+        self.id = 0
+        self.name = ""
+        self.lines = []
+        self.stats = {}  # plane-level stats, resolved
+
+
+class XSpace:
+    __slots__ = ("planes", "hostnames")
+
+    def __init__(self):
+        self.planes = []
+        self.hostnames = []
+
+
+# ------------------------------------------------------------ message walk
+def _decode_metadata_map(buf, span):
+    """An ``event_metadata``/``stat_metadata`` map entry -> (id, name).
+
+    Entry: key=1 (varint id), value=2 (XEventMetadata/XStatMetadata,
+    whose own fields are id=1, name=2)."""
+    key, name = 0, ""
+    for field, wire, value in _fields(buf, *span):
+        if field == 1 and wire == _WIRE_VARINT:
+            key = value
+        elif field == 2 and wire == _WIRE_LEN:
+            for f2, w2, v2 in _fields(buf, *value):
+                if f2 == 1 and w2 == _WIRE_VARINT:
+                    key = key or v2  # metadata carries its own id too
+                elif f2 == 2 and w2 == _WIRE_LEN:
+                    name = _text(buf, v2)
+    return key, name
+
+
+def _decode_stat(buf, span, stat_meta):
+    """XStat -> resolved ``XStat`` (ref_value chased through the
+    stat_metadata name table)."""
+    name, value = "", None
+    for field, wire, v in _fields(buf, *span):
+        if field == 1 and wire == _WIRE_VARINT:  # metadata_id
+            name = stat_meta.get(v, f"stat_{v}")
+        elif field == 2:                          # double_value
+            value = v
+        elif field == 3 and wire == _WIRE_VARINT:  # uint64_value
+            value = v
+        elif field == 4 and wire == _WIRE_VARINT:  # int64_value
+            value = _int64(v)
+        elif field == 5 and wire == _WIRE_LEN:     # str_value
+            value = _text(buf, v)
+        elif field == 6 and wire == _WIRE_LEN:     # bytes_value
+            value = bytes(buf[v[0]:v[1]])
+        elif field == 7 and wire == _WIRE_VARINT:  # ref_value -> interned
+            value = stat_meta.get(v, f"ref_{v}")
+    return XStat(name, value)
+
+
+def _decode_event(buf, span, event_meta, stat_meta):
+    ev = XEvent()
+    for field, wire, v in _fields(buf, *span):
+        if field == 1 and wire == _WIRE_VARINT:    # metadata_id
+            ev.name = event_meta.get(v, f"event_{v}")
+        elif field == 2 and wire == _WIRE_VARINT:  # offset_ps (oneof)
+            ev.offset_ps = _int64(v)
+        elif field == 3 and wire == _WIRE_VARINT:  # duration_ps
+            ev.duration_ps = _int64(v)
+        elif field == 4 and wire == _WIRE_LEN:     # stats
+            st = _decode_stat(buf, v, stat_meta)
+            ev.stats[st.name] = st.value
+        elif field == 5 and wire == _WIRE_VARINT:  # num_occurrences (oneof)
+            ev.num_occurrences = v
+    return ev
+
+
+def _decode_line(buf, span, event_meta, stat_meta):
+    ln = XLine()
+    for field, wire, v in _fields(buf, *span):
+        if field == 1 and wire == _WIRE_VARINT:
+            ln.id = _int64(v)
+        elif field == 2 and wire == _WIRE_LEN:
+            ln.name = _text(buf, v)
+        elif field == 3 and wire == _WIRE_VARINT:
+            ln.timestamp_ns = _int64(v)
+        elif field == 4 and wire == _WIRE_LEN:
+            ln.events.append(_decode_event(buf, v, event_meta, stat_meta))
+        elif field == 9 and wire == _WIRE_VARINT:
+            ln.duration_ps = _int64(v)
+        elif field == 11 and wire == _WIRE_LEN:
+            ln.display_name = _text(buf, v)
+    return ln
+
+
+def _decode_plane(buf, span):
+    """Two passes: serializers write fields in number order so the
+    metadata maps (fields 4/5) trail the lines (field 3) — collect raw
+    line spans first, resolve names second."""
+    plane = XPlane()
+    line_spans, stat_spans = [], []
+    event_meta, stat_meta = {}, {}
+    for field, wire, v in _fields(buf, *span):
+        if field == 1 and wire == _WIRE_VARINT:
+            plane.id = v
+        elif field == 2 and wire == _WIRE_LEN:
+            plane.name = _text(buf, v)
+        elif field == 3 and wire == _WIRE_LEN:
+            line_spans.append(v)
+        elif field == 4 and wire == _WIRE_LEN:
+            k, name = _decode_metadata_map(buf, v)
+            event_meta[k] = name
+        elif field == 5 and wire == _WIRE_LEN:
+            k, name = _decode_metadata_map(buf, v)
+            stat_meta[k] = name
+        elif field == 6 and wire == _WIRE_LEN:
+            stat_spans.append(v)
+    for s in stat_spans:
+        st = _decode_stat(buf, s, stat_meta)
+        plane.stats[st.name] = st.value
+    for s in line_spans:
+        plane.lines.append(_decode_line(buf, s, event_meta, stat_meta))
+    return plane
+
+
+def parse_xspace(data) -> XSpace:
+    """Parse serialized XSpace bytes -> :class:`XSpace`.
+
+    Concatenated serializations merge (standard protobuf semantics:
+    repeated fields accumulate) — ``parse_xspace(a + b)`` sees both
+    dumps' planes."""
+    buf = memoryview(bytes(data))
+    space = XSpace()
+    for field, wire, v in _fields(buf, 0, len(buf)):
+        if field == 1 and wire == _WIRE_LEN:
+            space.planes.append(_decode_plane(buf, v))
+        elif field == 4 and wire == _WIRE_LEN:
+            space.hostnames.append(_text(buf, v))
+    return space
+
+
+# --------------------------------------------------------------- file I/O
+def find_dump(path):
+    """Resolve ``path`` to one ``.xplane.pb`` file.
+
+    A file path is returned as-is; a directory (a profiler ``logdir`` or
+    any parent of ``plugins/profile/<run>/``) is searched recursively and
+    the newest dump wins (ties broken by name, so the pick is
+    deterministic under equal mtimes)."""
+    if os.path.isfile(path):
+        return path
+    best = None
+    for root, _dirs, files in os.walk(path):
+        for fn in files:
+            if fn.endswith(".xplane.pb"):
+                full = os.path.join(root, fn)
+                key = (os.path.getmtime(full), full)
+                if best is None or key > best[0]:
+                    best = (key, full)
+    if best is None:
+        raise FileNotFoundError(
+            f"no .xplane.pb under {path!r} — did the profiler session "
+            f"actually run (jax.profiler.trace writes "
+            f"<logdir>/plugins/profile/<run>/<host>.xplane.pb)?")
+    return best[1]
+
+
+def load_xspace(path) -> XSpace:
+    """``find_dump`` + ``parse_xspace``."""
+    with open(find_dump(path), "rb") as f:
+        return parse_xspace(f.read())
+
+
+# ----------------------------------------------------------- op extraction
+#: Host-plane lines that are Python/runtime bookkeeping, never HLO ops.
+_HOST_NOISE_LINES = ("python", "TensorFlow Name Scope", "TensorFlow Ops",
+                     "Launch Stats", "Steps", "Framework Name Scope")
+
+
+def _op_lines(space):
+    """The (plane, line) pairs whose events are per-HLO op executions.
+
+    Device planes (``/device:...``) win when present (a real TPU run);
+    otherwise the ``/host:CPU`` plane's XLA-client lines (the TFRT
+    thread-pool lines a CPU run records) carry the same events."""
+    device = [(p, ln) for p in space.planes
+              if p.name.startswith("/device:") for ln in p.lines]
+    if device:
+        return device
+    return [(p, ln) for p in space.planes if p.name == "/host:CPU"
+            for ln in p.lines if ln.name not in _HOST_NOISE_LINES]
+
+
+def iter_events(space, lines=None):
+    """Yield ``(plane, line, event)`` over the per-HLO op lines (or an
+    explicit ``lines`` list of (plane, line) pairs)."""
+    for plane, line in (lines if lines is not None else _op_lines(space)):
+        for ev in line.events:
+            yield plane, line, ev
+
+
+def per_op_summary(space) -> "OrderedDict[str, dict]":
+    """Aggregate the op lines into ``name -> {count, total_us,
+    hlo_module, program_id}`` (insertion-ordered by first appearance).
+
+    The keys are XLA HLO instruction names (``dot.3``, ``fusion.12``) —
+    exactly the namespace ``census.per_op_census`` emits, so the
+    ``trace_report`` join needs no fuzzy matching for same-program runs.
+    Events that carry an ``hlo_op`` stat differing from their own name
+    (device planes nest kernels under op metadata) aggregate under the
+    stat."""
+    out: "OrderedDict[str, dict]" = OrderedDict()
+    for _plane, _line, ev in iter_events(space):
+        name = ev.stats.get("hlo_op") or ev.name
+        if not name:
+            continue
+        row = out.setdefault(str(name), {
+            "count": 0, "total_us": 0.0, "hlo_module": None,
+            "program_id": None})
+        row["count"] += max(1, int(ev.num_occurrences or 1))
+        row["total_us"] += ev.duration_ps / 1e6
+        if row["hlo_module"] is None and "hlo_module" in ev.stats:
+            row["hlo_module"] = str(ev.stats["hlo_module"])
+        if row["program_id"] is None and "program_id" in ev.stats:
+            row["program_id"] = ev.stats["program_id"]
+    return out
+
+
+def to_timeline(path_or_space) -> "OrderedDict[str, dict]":
+    """The ``trace_report.load_timeline`` shape (``name -> {count,
+    total_us, ...}``) straight from a dump path / logdir / parsed space —
+    the ``--xplane`` entry point."""
+    space = path_or_space if isinstance(path_or_space, XSpace) \
+        else load_xspace(path_or_space)
+    return per_op_summary(space)

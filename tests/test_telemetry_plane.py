@@ -637,11 +637,13 @@ def test_trace_report_join_and_ranking(tmp_path):
     assert rows[3]["total_us"] == 0.0  # census ops never seen on timeline
     text = tr.render_text(rows, top=3)
     assert "host_copy *" in text and "3/5 ops shown" in text
-    # CLI writes JSON and exits 0
+    # CLI writes versioned JSON and exits 0
     out = str(tmp_path / "rows.json")
     assert tr.main(["--trace", tpath, "--census", cpath,
                     "--json", out]) == 0
-    assert len(json.load(open(out))) == 5
+    doc = json.load(open(out))
+    assert doc["schema_version"] == tr.SCHEMA_VERSION
+    assert len(doc["rows"]) == 5
 
 
 def test_trace_report_from_recorded_train_step(tmp_path):

@@ -69,13 +69,33 @@ def _fmt_age(seconds):
     return f"{seconds / 3600:.1f}h"
 
 
-def render_status(results, state, now):
+def _target_extras(samples, name, wall_now):
+    """(hbm%, last-compile age) for one scrape target — dashes when the
+    target predates the profiling plane (PR 14) or runs on a backend
+    with no memory_stats."""
+    hbm, age = "-", "-"
+    if samples is not None:
+        hits = samples.match("hbm_utilization_ratio", {"target": name})
+        if hits:
+            hbm = f"{max(v for _, v in hits) * 100:.0f}%"
+        hits = samples.match("jit_last_compile_unix_seconds",
+                             {"target": name})
+        stamp = max((v for _, v in hits), default=0.0)
+        if stamp > 0 and wall_now is not None:
+            age = _fmt_age(max(0.0, wall_now - stamp))
+    return hbm, age
+
+
+def render_status(results, state, now, samples=None, wall_now=None):
     """Text status table: targets first, then every non-inactive alert."""
-    lines = ["TARGET                        UP  DURATION  ATTEMPTS  ERROR"]
+    lines = ["TARGET                        UP  DURATION  ATTEMPTS  "
+             "HBM%  COMPILED  ERROR"]
     for r in results:
+        hbm, age = _target_extras(samples, r.target.name, wall_now)
         lines.append(
             f"{r.target.name:<28}  {'up' if r.ok else 'DOWN':<4}"
             f"{r.duration_s * 1000:7.1f}ms  {r.attempts:>8}  "
+            f"{hbm:>4}  {age:>8}  "
             f"{(r.error or '-')[:40]}")
     lines.append("")
     lines.append("ALERT                      STATE     SINCE  VALUE"
@@ -102,10 +122,15 @@ def render_routerz(doc):
     """Text fleet view of a router's /routerz document."""
     aff = doc.get("affinity", {})
     lines = ["REPLICA                       STATE        TARGET"
-             "                 RESTARTS"]
+             "                 RESTARTS  HBM%  COMPILED"]
     for r in doc.get("replicas", []):
+        # pre-PR-14 routers omit these keys — render dashes, never crash
+        hbm = r.get("hbm_utilization_ratio")
+        hbm = f"{hbm * 100:.0f}%" if hbm is not None else "-"
+        age = _fmt_age(r.get("last_compile_age_s"))
         lines.append(f"{r['name']:<28}  {r['state']:<11}"
-                     f"  {r['target']:<20}  {r.get('restarts', 0):>8}")
+                     f"  {r['target']:<20}  {r.get('restarts', 0):>8}"
+                     f"  {hbm:>4}  {age:>8}")
     lines.append("")
     occupancy = (f"{aff.get('entries', 0)}/{aff.get('capacity', 0)}"
                  if aff.get("capacity") else "0/0")
@@ -158,7 +183,8 @@ def run_once(scraper, engine, as_json):
             "targets": [r.to_dict() for r in results],
             "firing": firing, **state}, default=repr))
     else:
-        print(render_status(results, state, now=time.monotonic()))
+        print(render_status(results, state, now=time.monotonic(),
+                            samples=samples, wall_now=time.time()))
     unhealthy = bool(firing) or any(not r.ok for r in results)
     return 1 if unhealthy else 0
 

@@ -105,6 +105,8 @@ def import_instrumented(repo_root=None):
     import paddle_tpu.hapi.callbacks  # noqa: F401
     import paddle_tpu.inference.llm_server  # noqa: F401
     import paddle_tpu.inference.router  # noqa: F401
+    import paddle_tpu.observability.profiling  # noqa: F401
+    import paddle_tpu.observability.xplane  # noqa: F401
     from paddle_tpu.observability import REGISTRY
     return REGISTRY
 

@@ -22,6 +22,11 @@ Inputs
                             `traces_*.json` store dump, or a list of
                             traces — per-op census attribution on a
                             SINGLE sampled request
+--xplane dump               per-HLO DEVICE timings from a
+                            `jax.profiler.trace()` dump: a `.xplane.pb`
+                            file or any logdir above one
+                            (observability.xplane — measured GF/s per
+                            op instead of a span-name substring join)
 --census census.json        per-op cost table: the per_op_census() list,
                             or a {name: {flops, bytes}} mapping, or a
                             collective_census() dict
@@ -33,6 +38,11 @@ Join rule: exact name match first, else substring containment either way
 (census op ``dot.4`` matches timeline event ``jit_step/dot.4``); census
 rows without a timed event and events without census costs both stay in
 the table (flagged) — unattributed time is a finding, not noise.
+
+Exit code: 0 on a usable table; 1 when there is nothing to attribute at
+all; 2 when a census was supplied but NOT ONE timed row joined it — CI
+can gate on "the profile and the cost model describe the same program".
+``--json`` writes ``{"schema_version": 2, "rows": [...]}``.
 
 Usage::
 
@@ -46,13 +56,20 @@ import json
 import sys
 from collections import OrderedDict
 
-__all__ = ["load_timeline", "load_census", "join", "render_text", "main"]
+__all__ = ["load_timeline", "load_census", "join", "render_text", "main",
+           "SCHEMA_VERSION"]
+
+#: Version of the --json document ({"schema_version", "rows"}).  v1 was
+#: the bare row list; v2 wrapped it so consumers can detect drift.
+SCHEMA_VERSION = 2
 
 
 # ------------------------------------------------------------------ loading
 def load_timeline(path=None, events=None, flight_path=None,
-                  tracez_path=None):
+                  tracez_path=None, xplane_path=None):
     """-> OrderedDict name -> {"count", "total_us"} aggregated timings."""
+    if xplane_path is not None:
+        return _timeline_from_xplane(xplane_path)
     if tracez_path is not None:
         events = _events_from_tracez(tracez_path)
     elif flight_path is not None:
@@ -87,6 +104,20 @@ def load_timeline(path=None, events=None, flight_path=None,
         row["count"] += 1
         row["total_us"] += max(0.0, dur)
     return out
+
+
+def _timeline_from_xplane(path):
+    """Per-HLO device timings of a profiler dump, via the dependency-free
+    observability.xplane reader (imported lazily: the other sources must
+    keep working without the package on sys.path)."""
+    import os
+    import sys
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.observability import xplane
+    return xplane.to_timeline(path)
 
 
 def _events_from_flight(path):
@@ -264,6 +295,9 @@ def main(argv=None) -> int:
     src.add_argument("--tracez",
                      help="/tracez JSON trace or traces_*.json store dump "
                           "(per-request span tree)")
+    src.add_argument("--xplane",
+                     help="jax.profiler .xplane.pb dump (or a logdir "
+                          "above one): per-HLO device timings")
     ap.add_argument("--census", default=None,
                     help="per-op census JSON (per_op_census / "
                          "collective_census output)")
@@ -273,7 +307,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     timeline = load_timeline(path=args.trace, flight_path=args.flight,
-                             tracez_path=args.tracez)
+                             tracez_path=args.tracez,
+                             xplane_path=args.xplane)
     census = load_census(args.census) if args.census else OrderedDict()
     rows = join(timeline, census)
     if not rows:
@@ -283,8 +318,18 @@ def main(argv=None) -> int:
     print(render_text(rows, top=args.top))
     if args.json_out:
         with open(args.json_out, "w") as f:
-            json.dump(rows, f, indent=1)
+            json.dump({"schema_version": SCHEMA_VERSION, "rows": rows},
+                      f, indent=1)
         print(f"wrote {len(rows)} rows to {args.json_out}")
+    if census and not any(r["matched"] and r["total_us"] > 0
+                          for r in rows):
+        # a census that joins NOTHING timed means the profile and the
+        # cost model describe different programs — fail loudly so CI
+        # can gate on it
+        print("trace_report: census joined zero timed rows — the "
+              "timeline and the census do not describe the same program",
+              file=sys.stderr)
+        return 2
     return 0
 
 
