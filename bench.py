@@ -1301,6 +1301,90 @@ def _bench_router(on_accel):
     }
 
 
+def _bench_multi_tenant(on_accel):
+    """Multi-tenant serving guard (ISSUE 15): the SAME deterministic trace
+    decoded three ways — every request on its own adapter (the mixed
+    many-tenant case the paged pool exists for), every request on ONE
+    adapter, and a no-adapter base engine — so the batched-gather
+    epilogue's cost and the adapter-MIX penalty (which must be ~zero:
+    only the gather rows change) are both pinned.  Plus the host-side
+    constraint-mask cost per decode tick (automaton mask + device
+    upload), since that's the only per-tick work constrained decoding
+    adds.  Host/gather-bound by construction: runs on CPU too."""
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.inference.constrain import compile_constraint
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.models.lora import (AdapterRegistry, LoraAdapter,
+                                        lora_sites)
+
+    cfg = LlamaConfig.tiny(tensor_parallel=False,
+                           use_flash_attention=False)
+    n_adapters = 64 if on_accel else 12
+    slots, n_req, new_toks, ps = 4, (16 if on_accel else 8), 8, 16
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    sites = lora_sites(model)
+    adapters = {f"a{i}": LoraAdapter.random(sites, rank=4, seed=1000 + i)
+                for i in range(n_adapters)}
+    reg = AdapterRegistry.from_adapters(model, adapters, rank=4)
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, 12).astype(np.int32)
+               for _ in range(n_req)]
+
+    def run(eng, aids):
+        futs = [eng.submit(p, max_new_tokens=new_toks, adapter_id=a)
+                for p, a in zip(prompts, aids)]
+        eng.run_until_complete()
+        toks = sum(len(f.result(timeout=1)) for f in futs)
+        return toks
+
+    def timed(adapters_reg, aids):
+        eng = LLMEngine(model, max_batch_slots=slots, max_seq_len=64,
+                        kv_layout="paged", page_size=ps, prefill_chunk=ps,
+                        adapters=adapters_reg)
+        try:
+            eng.warmup()
+            run(eng, aids)  # prime the first-request eager-op compiles
+            t0 = time.perf_counter()
+            toks = run(eng, aids)
+            return toks / max(time.perf_counter() - t0, 1e-6)
+        finally:
+            eng.stop()
+
+    mixed_ids = [f"a{i % n_adapters}" for i in range(n_req)]
+    mixed = timed(reg, mixed_ids)
+    single = timed(reg, ["a0"] * n_req)
+    base = timed(None, [None] * n_req)
+
+    # host-side constraint cost per decode tick: advance-independent —
+    # mask lookup for every slot + one [B, V] device upload, exactly what
+    # the engine's constrained decode path does each tick
+    tc = compile_constraint(r"[0-9]+", ["%d" % i if i < 10 else f"w{i}"
+                                        for i in range(cfg.vocab_size)],
+                            cfg.vocab_size - 1)
+    cursors = [tc.cursor() for _ in range(slots)]
+    iters = 50
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        mask = np.stack([c.mask() for c in cursors])
+        jnp.asarray(mask).block_until_ready()
+    mask_us = (time.perf_counter() - t0) / iters * 1e6
+
+    return {
+        "multi_tenant_adapters": n_adapters,
+        "multi_tenant_mixed_tokens_per_sec": round(mixed, 1),
+        "multi_tenant_single_adapter_tokens_per_sec": round(single, 1),
+        "multi_tenant_base_tokens_per_sec": round(base, 1),
+        "multi_tenant_mix_penalty_ratio": round(single / mixed, 3),
+        "multi_tenant_lora_overhead_ratio": round(base / single, 3),
+        "constraint_mask_us_per_tick": round(mask_us, 1),
+    }
+
+
 def main():
     import jax
 
@@ -1338,7 +1422,8 @@ def main():
                     (_bench_alerting, "alerting"),
                     (_bench_tracing, "tracing"),
                     (_bench_xplane_parse, "xplane"),
-                    (_bench_router, "router")):
+                    (_bench_router, "router"),
+                    (_bench_multi_tenant, "multi_tenant")):
         if time.monotonic() > deadline:
             out[f"{tag}_skipped"] = "bench budget exhausted"
             continue

@@ -18,7 +18,8 @@ from ..tensor.tensor import Tensor
 __all__ = ["Config", "Predictor", "create_predictor", "PrecisionType",
            "DynamicBatcher", "LLMEngine", "ServerOverloadedError",
            "DeadlineExceededError", "Router", "ReplicaServer",
-           "FleetController", "PrefixAffinityTable"]
+           "FleetController", "PrefixAffinityTable",
+           "compile_constraint", "TokenConstraint"]
 
 
 def __getattr__(name):
@@ -32,6 +33,10 @@ def __getattr__(name):
         from . import router              # the LLM stack transitively
 
         return getattr(router, name)
+    if name in ("compile_constraint", "TokenConstraint"):
+        from . import constrain           # lazy: keeps the classic
+
+        return getattr(constrain, name)   # predictor import path lean
     raise AttributeError(name)
 
 

@@ -66,6 +66,7 @@ import queue
 import threading
 import time
 from concurrent.futures import Future
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -74,6 +75,7 @@ import jax
 import jax.numpy as jnp
 
 from ..autograd import tape
+from ..ops import lora as _oplora
 from ..observability import flight_recorder as _flight
 from ..observability import metrics as _obs
 from ..observability import profiling as _profiling
@@ -83,6 +85,7 @@ from ..observability.spans import span as _span
 from ..ops.sampling import sample_rows as _sample_rows
 from ..ops.sampling import spec_accept as _spec_accept
 from ..tensor.tensor import Tensor
+from . import constrain as _constrain
 
 __all__ = ["LLMEngine", "ServerOverloadedError", "DeadlineExceededError"]
 
@@ -278,15 +281,37 @@ class _Request:
     on_admit: object = None         # fired once at first slot admission —
                                     # the router's admission ack (after it,
                                     # the request is no longer retry-safe)
+    adapter_id: object = None       # LoRA adapter id (None = base model)
+    adapter_page: int = 0           # pool page pinned while in a slot;
+                                    # 0 = none held (page 0 is the zero
+                                    # adapter, never refcounted)
+    constraint: object = None       # compiled TokenConstraint (shared,
+                                    # immutable automaton tables)
+    cursor: object = None           # per-request automaton cursor; its
+                                    # state SURVIVES preemption requeues
+                                    # (the regrown prompt's generated tail
+                                    # was already consumed token by token)
 
 
-def _select_rows(logits, key, do_sample, temperature, top_k, top_p):
+def _select_rows(logits, key, do_sample, temperature, top_k, top_p,
+                 token_mask=None):
     """Vectorized per-ROW token selection: each slot carries its own
     (do_sample, temperature, top_k, top_p) — the serving face of the
     fused sampler (ops/sampling.sample_rows), which generation._select
     also delegates to, so the engine and the solo loop share one masking
-    + categorical implementation."""
-    return _sample_rows(logits, key, do_sample, temperature, top_k, top_p)
+    + categorical implementation.  ``token_mask`` (bool [B, V]) is the
+    constrained-decoding path; all-True rows are exact no-ops."""
+    return _sample_rows(logits, key, do_sample, temperature, top_k, top_p,
+                        token_mask=token_mask)
+
+
+def _lora_ctx(pool, tree, rows):
+    """LoRA epilogue activation for the compiled serving programs' trace:
+    a no-op when the engine has no adapter pool (``pool`` carries only the
+    static site layout; the traced weights ride in ``tree``/``rows``)."""
+    if pool is None:
+        return nullcontext()
+    return _oplora.activate(pool.site_pools(tree), rows)
 
 
 class LLMEngine:
@@ -298,7 +323,8 @@ class LLMEngine:
                  prefix_cache=None, metrics_port=None, slo_targets=None,
                  flight_recorder_dir=None, healthy_heartbeat_age=60.0,
                  alert_rules=None, tracer=None, spec_k=0, spec_draft=None,
-                 cache_aware_admission=False, admission_age_cap=4):
+                 cache_aware_admission=False, admission_age_cap=4,
+                 adapters=None, constraint_vocab=None):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
         scheduling lever for high-latency hosts.  Slots that finish
@@ -392,7 +418,21 @@ class LLMEngine:
         head-of-line blocked them.  Fairness: every time the queue head
         is passed over its ``adm_skips`` ages by one; once it reaches
         ``admission_age_cap`` the head admits next regardless of cache
-        affinity (llm_admission_reorders_total counts the bypasses)."""
+        affinity (llm_admission_reorders_total counts the bypasses).
+
+        ``adapters=`` (paged only) attaches a shared
+        ``models.lora.AdapterRegistry``: requests submitted with
+        ``adapter_id=`` decode through that adapter's paged LoRA weight
+        blocks — per-slot page rows gather into ONE compiled program, so
+        a batch can mix adapters freely and swapping adapters never
+        recompiles.  Admission charges the adapter pool like the kv pool:
+        a request whose adapter cannot be loaded (every page pinned)
+        waits at the head of the queue for a release; the reference drops
+        on finish/expiry/preemption (llm_adapter_* metric family).
+        ``constraint_vocab=`` (list: token id -> string) lets wire-form
+        constraints (regex str / JSON-schema dict, e.g. from the router)
+        be compiled replica-side; pre-compiled ``TokenConstraint``
+        objects work without it."""
         cfg = model.config
         self.model = model
         self.n_slots = int(max_batch_slots)
@@ -546,6 +586,30 @@ class LLMEngine:
                 "prefix cache enabled (the reorder key IS the cached-prefix "
                 "length)")
         self._adm_reorders = 0
+        # -------------------------------------------- multi-tenant serving
+        self.adapters = adapters
+        if adapters is not None:
+            if not self.paged:
+                raise ValueError(
+                    "adapters= requires kv_layout='paged' (the adapter pool "
+                    "rides the paged serving path; dense slots have no page "
+                    "rows to gather)")
+            from ..models.lora import AdapterRegistry
+
+            if not isinstance(adapters, AdapterRegistry):
+                raise TypeError(
+                    "adapters= must be a models.lora.AdapterRegistry, got "
+                    f"{type(adapters).__name__}")
+        self._vocab = int(cfg.vocab_size)
+        self._constraint_vocab = (list(constraint_vocab)
+                                  if constraint_vocab is not None else None)
+        self._constraint_cache = {}  # wire spec -> compiled TokenConstraint
+        # reused on ticks with no constrained rows: an all-True mask is an
+        # exact no-op through the fused sampler, so unconstrained batches
+        # stay bitwise identical to a mask-free program — and the mask arg
+        # is ALWAYS present, so turning constraints on never recompiles
+        self._mask_all_true = (jnp.ones((self.n_slots, self._vocab), bool)
+                               if self.paged else None)
         self._verify_jit = None
         self._decode_jit = {}  # scan length (effective chunk) -> jitted fn
         self._prefill_jit = {}
@@ -646,9 +710,66 @@ class LLMEngine:
 
     # ------------------------------------------------------------- public
 
+    def _compile_constraint(self, constraint):
+        """Normalize submit()'s ``constraint=`` into a compiled, shared
+        ``inference.constrain.TokenConstraint``.  Wire forms (regex str /
+        JSON-schema dict, e.g. arriving via the router) compile once per
+        distinct spec and memoize — same spec => the same automaton
+        tables, so repeat traffic pays zero rebuild."""
+        if constraint is None:
+            return None
+        if not self.paged:
+            raise ValueError(
+                "constraint= requires kv_layout='paged' (the token-mask "
+                "path rides the paged decode program)")
+        if self.spec_k:
+            raise ValueError(
+                "constraint= does not compose with spec_k (constraint "
+                "masks are per-position; drafted tokens cannot be "
+                "pre-masked)")
+        c = constraint
+        if isinstance(c, (str, dict)):
+            if self._constraint_vocab is None:
+                raise ValueError(
+                    "wire-form constraints (regex str / schema dict) need "
+                    "the engine constructed with constraint_vocab= (token "
+                    "id -> string); alternatively pass a compiled "
+                    "TokenConstraint")
+            if self.eos < 0:
+                raise ValueError(
+                    "constrained decoding needs eos_token_id configured on "
+                    "the engine (the automaton terminates by emitting eos)")
+            import json
+
+            # no sort_keys: JSON-schema object property ORDER is part of
+            # the compiled regex (declaration-order emission)
+            key = c if isinstance(c, str) else json.dumps(c)
+            cached = self._constraint_cache.get(key)
+            if cached is None:
+                from .constrain import compile_constraint
+
+                cached = compile_constraint(c, self._constraint_vocab,
+                                            self.eos)
+                self._constraint_cache[key] = cached
+            c = cached
+        if not hasattr(c, "cursor"):
+            raise TypeError(
+                "constraint= must be a regex str, a JSON-schema dict, or a "
+                f"compiled TokenConstraint, got {type(c).__name__}")
+        if int(c.V) != self._vocab:
+            raise ValueError(
+                f"constraint vocab size {c.V} != model vocab size "
+                f"{self._vocab}")
+        if int(c.eos_token_id) != self.eos:
+            raise ValueError(
+                f"constraint eos {int(c.eos_token_id)} != engine eos "
+                f"{self.eos}")
+        return c
+
     def submit(self, prompt_ids, max_new_tokens=32, do_sample=False,
                temperature=1.0, top_k=0, top_p=1.0, timeout=None,
-               trace_id=None, on_admit=None):
+               trace_id=None, on_admit=None, adapter_id=None,
+               constraint=None):
         """Queue one prompt; returns a Future of the generated id list.
         Sampling knobs are PER REQUEST — including ``top_k``: slots with
         different settings decode in the same compiled step (the fused
@@ -669,7 +790,15 @@ class LLMEngine:
         ``on_admit`` is a zero-arg callback fired ONCE when the request
         first lands in a batch slot — the admission ack after which the
         request must not be retried elsewhere (it will produce output
-        here)."""
+        here).
+
+        ``adapter_id`` decodes the request through a LoRA adapter
+        registered on the engine's ``adapters=`` registry (per-request —
+        one batch mixes adapters freely); ``constraint`` masks decoding
+        to a token automaton: a regex str, a JSON-schema dict (compiled
+        replica-side, needs ``constraint_vocab=``) or a pre-compiled
+        ``TokenConstraint``.  Both validate here — bad adapter ids and
+        malformed constraints fail at submit, never in the pump."""
         if self._pump_error is not None:
             raise RuntimeError(
                 "LLMEngine pump thread died; restart the engine"
@@ -691,6 +820,20 @@ class LLMEngine:
             np.int32).reshape(-1)
         if arr.size == 0 or arr.size > self.L - 1:
             raise ValueError(f"prompt length {arr.size} not in [1, {self.L - 1}]")
+        if adapter_id is not None:
+            if self.adapters is None:
+                raise ValueError(
+                    "adapter_id= requires an engine constructed with "
+                    "adapters= (a models.lora.AdapterRegistry)")
+            if adapter_id not in self.adapters.ids():
+                raise KeyError(
+                    f"unknown adapter {adapter_id!r}; register it on the "
+                    "engine's AdapterRegistry first")
+        try:
+            cst = self._compile_constraint(constraint)
+        except (TypeError, ValueError):
+            _constrain.count_reject()  # validation rejects are violations
+            raise
         now = self._clock()
         req = _Request(arr, int(max_new_tokens), Future(),
                        do_sample=bool(do_sample),
@@ -703,7 +846,9 @@ class LLMEngine:
                            "llm_request", trace_id=trace_id,
                            prompt_tokens=int(arr.size),
                            max_new_tokens=int(max_new_tokens)),
-                       on_admit=on_admit)
+                       on_admit=on_admit,
+                       adapter_id=adapter_id, constraint=cst,
+                       cursor=cst.cursor() if cst is not None else None)
         if self._draining:
             _M_SHED.inc()
             _flight.record_event("shed", reason="draining",
@@ -816,6 +961,8 @@ class LLMEngine:
             if pages_total else 0.0,
             "prefix_cache": prefix,
             "spec": spec,
+            "adapters": self.adapters.stats()
+            if self.adapters is not None else None,
             "admission_reorders": self._adm_reorders,
             "prefill_in_progress": self._prefilling is not None,
             "pump_alive": self._thread.is_alive()
@@ -1003,6 +1150,7 @@ class LLMEngine:
                 req, slot, _ = self._prefilling
                 self._prefilling = None
                 self._release_pages(slot)
+                self._release_adapter(req)
                 _fail_future(req.future, exc)
                 self._end_trace(req, "error", error=repr(exc))
             for i, req in enumerate(self.slot_req):
@@ -1010,6 +1158,7 @@ class LLMEngine:
                     self.slot_req[i] = None
                     self.last_token[i] = self.pad
                     self._release_pages(i)
+                    self._release_adapter(req)
                     _fail_future(req.future, exc)
                     self._end_trace(req, "error", error=repr(exc))
 
@@ -1398,6 +1547,27 @@ class LLMEngine:
             return True
         return False
 
+    def _lora_args(self, pages):
+        """(lora_tree, lora_rows) tail for the paged compiled programs.
+        The tree is the pool's live device arrays (a jit ARGUMENT —
+        loading/evicting adapters swaps data, never the program) and
+        ``pages`` the per-row pool pages (0 = the reserved zero adapter:
+        its epilogue contributes exact zeros).  Dummies keep the call
+        signature stable when the engine has no adapter pool."""
+        if self.adapters is None:
+            return ((), jnp.zeros((0,), jnp.int32))
+        return (self.adapters.pool.tree(),
+                jnp.asarray(np.asarray(pages, np.int32)))
+
+    def _release_adapter(self, req):
+        """Drop a request's adapter-pool reference (idempotent: requests
+        that never acquired — queued, dense, base-model — hold page 0).
+        Called on every terminal/requeue path, mirroring _release_pages;
+        a preempted request re-acquires at re-admission."""
+        if req is not None and req.adapter_page:
+            self.adapters.release(req.adapter_id)
+            req.adapter_page = 0
+
     def _req_for_slot(self, slot):
         """The request currently writing through ``slot`` — active, or
         the one mid-chunked-prefill (its slot_req entry is still None)."""
@@ -1407,14 +1577,17 @@ class LLMEngine:
             return self._prefilling[0]
         return r
 
-    def _cache_insert(self, slot, prompt, trace_id=None):
+    def _cache_insert(self, slot, prompt, trace_id=None, adapter_id=None):
         """Register a freshly prefilled prompt's pages in the prefix index;
         the index's new holds are incref'd so they outlive the slot.
         ``trace_id`` stamps the newly held pages' COW-fork provenance —
-        a later request admitted over them links back to this donor."""
+        a later request admitted over them links back to this donor.
+        ``adapter_id`` seeds the hash chain: kv computed under one adapter
+        is only ever matched by requests for the same adapter."""
         if self._prefix is None:
             return
-        new_holds = self._prefix.insert(prompt, self._slot_pages[slot])
+        new_holds = self._prefix.insert(prompt, self._slot_pages[slot],
+                                        adapter_id=adapter_id)
         if new_holds:
             self._prefix_epoch += 1
         for page in new_holds:
@@ -1454,6 +1627,7 @@ class LLMEngine:
         self.last_token[slot] = self.pad
         held = len(self._slot_pages[slot])
         self._release_pages(slot)
+        self._release_adapter(req)
         _M_PAGE_PREEMPT.inc()
         _flight.record_event("page_preemption", slot=int(slot),
                              pages_held=int(held),
@@ -1513,11 +1687,13 @@ class LLMEngine:
         pools; the page table row routes the scatter, padded tail rows land
         in the trash page / are overwritten by the first decode."""
         model = self.model
+        pool = self.adapters.pool if self.adapters is not None else None
 
-        def run(params, buffers, caches, page_row, ids, off, last_index):
+        def run(params, buffers, caches, page_row, ids, off, last_index,
+                lora_tree, lora_rows):
             restore = model.bind_functional_state(params, buffers)
             try:
-                with tape.no_grad():
+                with tape.no_grad(), _lora_ctx(pool, lora_tree, lora_rows):
                     t_caches = [
                         (Tensor(c[0]), Tensor(c[1]), off, Tensor(page_row))
                         + tuple(Tensor(x) for x in c[2:])
@@ -1578,7 +1754,8 @@ class LLMEngine:
                     if not r.skip_cache:
                         if r.match_epoch != self._prefix_epoch \
                                 or r.match_result is None:
-                            r.match_result = self._prefix.match(r.prompt)
+                            r.match_result = self._prefix.match(
+                                r.prompt, adapter_id=r.adapter_id)
                             r.match_epoch = self._prefix_epoch
                         hit = r.match_result[0]
                     if hit > best_hit:
@@ -1628,7 +1805,8 @@ class LLMEngine:
                         # blocks every tick
                         matched, shared = req.match_result
                     else:
-                        matched, shared = self._prefix.match(req.prompt)
+                        matched, shared = self._prefix.match(
+                            req.prompt, adapter_id=req.adapter_id)
                         req.match_epoch = self._prefix_epoch
                         req.match_result = (matched, shared)
                 if need > self.num_pages - 1:
@@ -1662,6 +1840,18 @@ class LLMEngine:
                     with self._pending.mutex:
                         self._pending.queue.appendleft(req)
                     return
+                if req.adapter_id is not None and not req.adapter_page:
+                    page = self.adapters.acquire(req.adapter_id)
+                    if page is None:
+                        # adapter pool dry (every page pinned by live
+                        # requests): wait at the head for a release,
+                        # exactly like the kv-page wait above — roll the
+                        # kv holds back so the pool stays reclaimable
+                        self._release_pages(slot)
+                        with self._pending.mutex:
+                            self._pending.queue.appendleft(req)
+                        return
+                    req.adapter_page = page
                 # first admission EVER (admit_ts is stamped once and
                 # survives requeues): preemption/COW-starvation retries
                 # must not observe queue-wait twice nor double-count the
@@ -1708,6 +1898,7 @@ class LLMEngine:
                                  and self._clock() > req.deadline):
             self._prefilling = None
             self._release_pages(slot)
+            self._release_adapter(req)
             if not req.future.done():
                 _M_EXPIRED.labels(where="inflight").inc()
                 _fail_future(req.future, DeadlineExceededError(
@@ -1727,6 +1918,7 @@ class LLMEngine:
             # no page can be freed for the fork: requeue recompute-style
             # (fully private next time) instead of wedging or failing
             self._release_pages(slot)
+            self._release_adapter(req)
             req.skip_cache = True
             # the hit credited at admission never materialized: the private
             # re-prefill recomputes every chunk the cache was covering
@@ -1752,7 +1944,8 @@ class LLMEngine:
         args = (self._params, self._buffers, self.caches,
                 self._pt_device()[slot:slot + 1], jnp.asarray(chunk),
                 jnp.asarray([done], jnp.int32),
-                jnp.asarray(m - 1, jnp.int32))
+                jnp.asarray(m - 1, jnp.int32)) \
+            + self._lora_args([req.adapter_page])
         try:
             jit = self._get_chunk_prefill()
             if _obs.enabled():
@@ -1765,6 +1958,7 @@ class LLMEngine:
         except Exception as e:
             self._prefilling = None
             self._release_pages(slot)
+            self._release_adapter(req)
             _fail_future(req.future, e)
             self._end_trace(req, "error", error=repr(e))
             if not self._caches_alive():
@@ -1783,7 +1977,8 @@ class LLMEngine:
         # blocks + partial tail so CONCURRENT same-prefix requests hit
         # (insert precedes the first decode write, whose COW check then
         # sees the tail page as shared and forks it)
-        self._cache_insert(slot, req.prompt, trace_id=req.trace.trace_id)
+        self._cache_insert(slot, req.prompt, trace_id=req.trace.trace_id,
+                           adapter_id=req.adapter_id)
         tok = self._host_select(np.asarray(logits[0, 0]), req)
         first = not req.tokens  # re-admission after preemption continues
         req.slot = slot
@@ -1828,7 +2023,8 @@ class LLMEngine:
                     params, buffers, self.caches,
                     jnp.zeros((1, self.M), jnp.int32),
                     jnp.full((1, C), self.pad, jnp.int32),
-                    jnp.zeros((1,), jnp.int32), jnp.asarray(0, jnp.int32))
+                    jnp.zeros((1,), jnp.int32), jnp.asarray(0, jnp.int32),
+                    *self._lora_args([0]))
                 # the COW fork program too: a warm engine's first
                 # shared-prefix fork must not compile (and must not trip
                 # recompile_storm).  A trash-page self-copy is harmless.
@@ -1860,7 +2056,12 @@ class LLMEngine:
                      jnp.zeros((B,), bool),
                      jnp.ones((B,), jnp.float32),
                      jnp.zeros((B,), jnp.int32),
-                     jnp.ones((B,), jnp.float32), keys)
+                     jnp.ones((B,), jnp.float32))
+            if self.paged:
+                args += (self._mask_all_true, keys)
+                args += self._lora_args([0] * B)
+            else:
+                args += (keys,)
             _, self.caches = jit(*args)
             if self.spec_k:
                 vargs = (params, buffers, self.caches)
@@ -1874,7 +2075,14 @@ class LLMEngine:
                           jnp.zeros((B,), jnp.int32),
                           jnp.ones((B,), jnp.float32),
                           _fr.get_rng_key())
+                if self.paged:
+                    vargs += self._lora_args([0] * B)
                 _, _, self.caches = self._get_verify()(*vargs)
+            if self.adapters is not None:
+                # the pool's donating page writer compiles here too, so a
+                # post-warmup register()/acquire() never counts as a
+                # recompile
+                self.adapters.warm()
         dt = time.perf_counter() - t0
         _M_WARMUP_S.set(dt)
         # every expected program is now compiled: later compiles are
@@ -1884,10 +2092,16 @@ class LLMEngine:
 
     def _host_select(self, row, req):
         """First (admission) token: host-side mirror of _select_rows, same
-        masking order (temperature -> top-k by VALUE -> top-p over the
-        survivors)."""
+        masking order (constraint mask -> temperature -> top-k by VALUE ->
+        top-p over the survivors)."""
+        if req.cursor is not None:
+            row = np.where(req.cursor.mask(), row, -np.inf)
         if not req.do_sample:
-            return int(row.argmax())
+            tok = int(row.argmax())
+            if req.cursor is not None:
+                req.cursor.advance(tok)
+                _constrain.count_masked_token()
+            return tok
         lt = row.astype(np.float64) / max(req.temperature, 1e-6)
         if 0 < req.top_k < row.size:
             kth = np.sort(lt)[::-1][req.top_k - 1]
@@ -1898,17 +2112,29 @@ class LLMEngine:
         cutoff = s[min(int((cum < req.top_p).sum()), s.size - 1)]
         lt = np.where(lt < cutoff, -np.inf, lt)
         p = np.exp(lt - lt.max())
-        return int(self._rng.choice(row.size, p=p / p.sum()))
+        tok = int(self._rng.choice(row.size, p=p / p.sum()))
+        if req.cursor is not None:
+            req.cursor.advance(tok)
+            _constrain.count_masked_token()
+        return tok
 
     def _decode_fn(self):
         model = self.model
+        pool = self.adapters.pool if self.adapters is not None else None
 
         if self.paged:
+            # token_mask and the lora tail are ALWAYS in the signature:
+            # constrained rows upload their automaton mask rows, the rest
+            # ride the cached all-True mask (an exact sampler no-op), and
+            # adapter swaps change only the gathered rows — so turning
+            # either feature on after warmup() never recompiles
             def run(params, buffers, caches, page_tbl, tokens, pos,
-                    do_sample, temperature, top_k, top_p, keys):
+                    do_sample, temperature, top_k, top_p, token_mask,
+                    keys, lora_tree, lora_rows):
                 restore = model.bind_functional_state(params, buffers)
                 try:
-                    with tape.no_grad():
+                    with tape.no_grad(), _lora_ctx(pool, lora_tree,
+                                                   lora_rows):
                         def tick(carry, key):
                             caches, tok, p = carry
                             # engine-side caches hold only the page POOLS
@@ -1930,7 +2156,8 @@ class LLMEngine:
                                 raw.append((vals[0], vals[1]) + vals[4:])
                             nxt = _select_rows(logits._value[:, -1], key,
                                                do_sample, temperature,
-                                               top_k, top_p)
+                                               top_k, top_p,
+                                               token_mask=token_mask)
                             return (raw, nxt[:, None], p + 1), nxt
 
                         (caches, _, _), toks = jax.lax.scan(
@@ -1983,13 +2210,16 @@ class LLMEngine:
         (ops/sampling.spec_accept) — only the [B, K+1] token ladder and
         the [B] accept counts cross the host tunnel."""
         model = self.model
+        pool = self.adapters.pool if self.adapters is not None else None
 
         if self.paged:
             def run(params, buffers, caches, page_tbl, tokens, drafts, pos,
-                    do_sample, temperature, top_k, top_p, key):
+                    do_sample, temperature, top_k, top_p, key,
+                    lora_tree, lora_rows):
                 restore = model.bind_functional_state(params, buffers)
                 try:
-                    with tape.no_grad():
+                    with tape.no_grad(), _lora_ctx(pool, lora_tree,
+                                                   lora_rows):
                         t_caches = [
                             (Tensor(c[0]), Tensor(c[1]), pos,
                              Tensor(page_tbl))
@@ -2084,6 +2314,13 @@ class LLMEngine:
             # fall back to plain one-token decode below
             return self._spec_tick(active)
         eff = max(1, min(self.decode_chunk, headroom))
+        # a constrained row's automaton state advances per TOKEN, and the
+        # uploaded mask is constant across a chunk — so ticks with any
+        # constrained row decode one token at a time
+        constrained = self.paged and any(
+            r is not None and r.cursor is not None for r in self.slot_req)
+        if constrained:
+            eff = 1
         if self.paged:
             # grow page tables to cover this tick's writes; slots the pool
             # cannot cover any longer are preempted (shed, not wedged)
@@ -2119,8 +2356,24 @@ class LLMEngine:
                 if r is None:
                     pt[i, :] = 0
             args += (jnp.asarray(pt),)
-        nxt_dev, new_caches = jit(
-            *args, tokens, pos, do_s, temp, topk, topp, keys)
+        if self.paged:
+            if constrained:
+                # per-row [V] masks from each constrained row's automaton
+                # state; unconstrained rows stay all-True (exact no-op)
+                mask_np = np.ones((self.n_slots, self._vocab), bool)
+                for i, r in enumerate(reqs):
+                    if r is not None and r.cursor is not None:
+                        mask_np[i] = r.cursor.mask()
+                token_mask = jnp.asarray(mask_np)
+            else:
+                token_mask = self._mask_all_true
+            rows = [r.adapter_page if r is not None else 0 for r in reqs]
+            nxt_dev, new_caches = jit(
+                *args, tokens, pos, do_s, temp, topk, topp, token_mask,
+                keys, *self._lora_args(rows))
+        else:
+            nxt_dev, new_caches = jit(
+                *args, tokens, pos, do_s, temp, topk, topp, keys)
         # the returned tuples carry advanced pos at slot [2], but the
         # engine's [B] slot_pos vector stays authoritative — each tick
         # rebuilds the per-slot positions (finished slots do not advance)
@@ -2144,6 +2397,11 @@ class LLMEngine:
                     continue  # finished earlier in this chunk: surplus
                 tok = int(nxt[i, j])
                 req.tokens.append(tok)
+                if req.cursor is not None:
+                    # host automaton tracks the device-selected token; the
+                    # NEXT tick's mask upload reads the advanced state
+                    req.cursor.advance(tok)
+                    _constrain.count_masked_token()
                 req.dec_tokens += 1
                 self.last_token[i] = tok
                 self.slot_pos[i] += 1
@@ -2207,6 +2465,9 @@ class LLMEngine:
         args += (jnp.asarray(self.last_token.reshape(-1, 1)),
                  jnp.asarray(drafts), jnp.asarray(self.slot_pos),
                  do_s, temp, topk, topp, _fr.get_rng_key())
+        if self.paged:
+            args += self._lora_args(
+                [r.adapter_page if r is not None else 0 for r in reqs])
         jit = self._get_verify()
         t1 = time.perf_counter()
         if _obs.enabled():
@@ -2343,6 +2604,7 @@ class LLMEngine:
                 self.slot_req[i] = None
                 self.last_token[i] = self.pad
                 self._release_pages(i)
+                self._release_adapter(req)
                 _M_EXPIRED.labels(where="inflight").inc()
                 _flight.record_event("deadline_expiry", where="inflight",
                                      slot=int(i), tokens=len(req.tokens),
@@ -2357,6 +2619,7 @@ class LLMEngine:
         self.slot_req[slot] = None
         self.last_token[slot] = self.pad
         self._release_pages(slot)
+        self._release_adapter(req)
         if req is not None:
             _M_COMPLETED.inc()
             if req.submit_ts is not None:

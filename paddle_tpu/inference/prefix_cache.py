@@ -40,6 +40,24 @@ __all__ = ["PrefixCache", "chained_block_key", "prefix_key"]
 _ROOT = b""  # parent key of a prompt's first block
 
 
+def _root_key(adapter_id):
+    """Chain seed for a prompt's first block.
+
+    ``None`` (the base model) keeps the historical empty seed, so every
+    pre-multi-tenant key — and the golden digests pinning them — is
+    unchanged.  A LoRA adapter id seeds the chain with a domain-separated
+    digest of the id: kv computed under adapter A never matches a request
+    for adapter B (same tokens, different weights => different kv), and
+    the router's affinity table inherits the same split because it hashes
+    through :func:`prefix_key`.
+    """
+    if adapter_id is None:
+        return _ROOT
+    h = hashlib.sha1(b"\x00adapter\x00")
+    h.update(str(adapter_id).encode("utf-8", "surrogatepass"))
+    return h.digest()
+
+
 def chained_block_key(parent, blk_bytes, partial=False):
     """Key of one page block given its ``parent`` chain key.
 
@@ -58,7 +76,7 @@ def chained_block_key(parent, blk_bytes, partial=False):
     return h.digest()
 
 
-def prefix_key(prompt, page_size, blocks=None):
+def prefix_key(prompt, page_size, blocks=None, adapter_id=None):
     """Affinity key of ``prompt``: the chained key of its cacheable prefix.
 
     Chains the same page-aligned block keys ``PrefixCache`` indexes (over
@@ -68,7 +86,9 @@ def prefix_key(prompt, page_size, blocks=None):
     prompt.  Prompts shorter than one page fall back to the
     domain-separated partial-tail key, matching ``PrefixCache.insert``'s
     tail node — so two requests get the same key exactly when the cache
-    would give them the same chain.
+    would give them the same chain.  ``adapter_id`` seeds the chain
+    (:func:`_root_key`): kv under different adapters never matches, and
+    ``None`` keeps the historical keys bit for bit.
     """
     prompt = np.asarray(prompt, np.int32)
     ps = int(page_size)
@@ -76,7 +96,7 @@ def prefix_key(prompt, page_size, blocks=None):
     full = usable // ps
     if blocks is not None:
         full = min(full, int(blocks))
-    key = _ROOT
+    key = _root_key(adapter_id)
     for i in range(full):
         key = chained_block_key(key, prompt[i * ps:(i + 1) * ps].tobytes())
     if full == 0 and usable > 0:
@@ -125,7 +145,7 @@ class PrefixCache:
 
     # ------------------------------------------------------------- lookup
 
-    def match(self, prompt):
+    def match(self, prompt, adapter_id=None):
         """Longest cached prefix of ``prompt`` an admission can map.
 
         Capped at ``len(prompt) - 1`` tokens: the last prompt token's
@@ -137,7 +157,7 @@ class PrefixCache:
         """
         prompt = np.asarray(prompt, np.int32)
         usable = prompt.size - 1
-        key, matched, pages = _ROOT, 0, []
+        key, matched, pages = _root_key(adapter_id), 0, []
         while matched + self.ps <= usable:
             k = self._child_key(key, prompt[matched:matched + self.ps]
                                 .tobytes())
@@ -168,7 +188,7 @@ class PrefixCache:
 
     # ----------------------------------------------------------- mutation
 
-    def insert(self, prompt, slot_pages):
+    def insert(self, prompt, slot_pages, adapter_id=None):
         """Register a freshly prefilled prompt's pages.
 
         ``slot_pages[i]`` must hold tokens ``i*ps .. (i+1)*ps - 1`` — the
@@ -179,7 +199,7 @@ class PrefixCache:
         """
         prompt = np.asarray(prompt, np.int32)
         n = prompt.size
-        key, new_holds = _ROOT, []
+        key, new_holds = _root_key(adapter_id), []
         full = n // self.ps
         for i in range(full):
             blk = prompt[i * self.ps:(i + 1) * self.ps]

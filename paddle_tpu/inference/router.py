@@ -265,6 +265,8 @@ class ReplicaServer:
                 top_p=float(doc.get("top_p", 1.0)),
                 timeout=doc.get("timeout"),
                 trace_id=doc.get("trace_id") or None,
+                adapter_id=doc.get("adapter_id"),
+                constraint=doc.get("constraint"),
                 on_admit=on_admit)
         except ServerOverloadedError as e:
             return 503, {"accepted": False, "error": str(e),
@@ -545,18 +547,30 @@ class Router:
         """Lower = less loaded.  Queue depth is the primary signal; page
         utilization and the worst SLO burn rate weigh in so a replica
         with a short queue but a nearly-dry page pool (or burning error
-        budget) stops attracting cold traffic."""
+        budget) stops attracting cold traffic.  Device HBM pressure
+        (``hbm_utilization_ratio``, exported by the profiling plane) joins
+        with the same weight as page utilization — absent-not-zero: a
+        pre-profiling replica that doesn't export the family contributes
+        nothing rather than looking artificially idle."""
         q = self._sample(name, "llm_queue_depth")
         util = self._sample(name, "llm_kv_page_utilization_ratio")
         burn = self._sample(name, "slo_burn_rate_ratio")
-        return q + 4.0 * util + 8.0 * burn
+        score = q + 4.0 * util + 8.0 * burn
+        hbm = self._sample(name, "hbm_utilization_ratio", default=None)
+        if hbm is not None:
+            score += 4.0 * hbm
+        return score
 
-    def pick_replicas(self, prompt_ids):
+    def pick_replicas(self, prompt_ids, adapter_id=None):
         """Ordered candidate list for one request: the prefix-affine
         replica first (if routable), then the rest by ascending load
         score with the round-robin cursor breaking ties.  Returns
-        ``(key, [replica_state, ...], affinity_hit)``."""
-        key = prefix_key(prompt_ids, self.ps, blocks=self.affinity_blocks)
+        ``(key, [replica_state, ...], affinity_hit)``.  ``adapter_id``
+        seeds the affinity key (prefix_cache._root_key), so requests for
+        different adapters never share an affinity bucket — their kv is
+        not reusable across adapters."""
+        key = prefix_key(prompt_ids, self.ps, blocks=self.affinity_blocks,
+                         adapter_id=adapter_id)
         routable = [r for r in self._replicas.values() if r.routable]
         aff_name = self.affinity.get(key)
         first = None
@@ -579,8 +593,14 @@ class Router:
 
     # ------------------------------------------------------------- data path
     def request(self, prompt_ids, max_new_tokens=32, do_sample=False,
-                temperature=1.0, top_k=0, top_p=1.0, timeout=None):
+                temperature=1.0, top_k=0, top_p=1.0, timeout=None,
+                adapter_id=None, constraint=None):
         """Route one request and block for its tokens.
+
+        ``adapter_id`` selects a LoRA adapter registered on the replicas
+        (and partitions the affinity key — adapter kv is never shared);
+        ``constraint`` is a regex string or JSON-schema dict compiled
+        replica-side into a decoding mask (inference/constrain.py).
 
         Raises ``ServerOverloadedError`` when no replica accepts it
         (fleet saturated / all down), ``DeadlineExceededError`` past the
@@ -592,7 +612,8 @@ class Router:
         trace = self._tracer.start_trace(
             "router_request", prompt_tokens=int(prompt.size),
             max_new_tokens=int(max_new_tokens))
-        key, order, aff_hit = self.pick_replicas(prompt)
+        key, order, aff_hit = self.pick_replicas(prompt,
+                                                 adapter_id=adapter_id)
         with self._lock:
             if aff_hit:
                 self._affinity_hits += 1
@@ -611,6 +632,10 @@ class Router:
                 "temperature": float(temperature), "top_k": int(top_k),
                 "top_p": float(top_p),
                 "trace_id": trace.trace_id or None}
+        if adapter_id is not None:
+            body["adapter_id"] = adapter_id
+        if constraint is not None:
+            body["constraint"] = constraint
         last_err = None
         for attempt, rep in enumerate(order[:self.max_retries + 1]):
             remaining = deadline - self._clock()

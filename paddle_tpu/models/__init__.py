@@ -3,3 +3,4 @@ from .llama import LlamaConfig, LlamaForCausalLM, LlamaModel  # noqa: F401
 from .bert import BertConfig, BertModel, BertForPretraining, ErnieConfig, ErnieModel, ErnieForPretraining  # noqa: F401
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
 from .generation import generate  # noqa: F401
+from .lora import AdapterRegistry, LoraAdapter, lora_sites  # noqa: F401

@@ -14,27 +14,101 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from contextlib import nullcontext
+
 from ..autograd import tape
 from ..framework import random as _random
+from ..ops import lora as _oplora
 from ..ops.sampling import sample_rows, spec_accept
 from ..tensor.tensor import Tensor
 
 __all__ = ["generate"]
 
 
-def _select(logits, key, do_sample, temperature, top_k, top_p):
+def _resolve_lora(model, adapter_id, adapters):
+    """-> (pool, page, release_cb|None) for the solo-parity adapter path.
+
+    ``adapters`` is either a shared ``models.lora.AdapterRegistry`` (the
+    engine's pool — page contents then match the engine bit for bit; the
+    adapter is pinned for the duration of the call) or a plain
+    ``{id: LoraAdapter}`` mapping (a throwaway 2-page pool is built)."""
+    if adapter_id is None:
+        return None, 0, None
+    from .lora import AdapterRegistry, build_solo_pool
+
+    if adapters is None:
+        raise ValueError(
+            "adapter_id= needs adapters= (an AdapterRegistry or an "
+            "{id: LoraAdapter} mapping)")
+    if isinstance(adapters, AdapterRegistry):
+        page = adapters.acquire(adapter_id)
+        if page is None:
+            raise RuntimeError(
+                "adapter pool exhausted: every page is pinned by live "
+                "requests")
+        return adapters.pool, page, (lambda: adapters.release(adapter_id))
+    return build_solo_pool(model, adapters[adapter_id]), 1, None
+
+
+def _resolve_constraint(token_mask_fn):
+    """``token_mask_fn`` is a compiled ``inference.constrain``
+    TokenConstraint, or a zero-arg callable returning one (the "fn"
+    spelling for lazy compilation)."""
+    if token_mask_fn is None:
+        return None
+    c = token_mask_fn() if callable(token_mask_fn) else token_mask_fn
+    if not hasattr(c, "device_tables"):
+        raise TypeError(
+            "token_mask_fn must be an inference.constrain.TokenConstraint "
+            "(or a zero-arg callable returning one), got "
+            f"{type(c).__name__}")
+    return c
+
+
+def _lora_trace_ctx(pool, lora_tree, lora_rows):
+    """Context manager activating the LoRA epilogues during tracing; a
+    no-op when the call carries no adapter.  ``lora_tree`` is the traced
+    pool tree (a jit argument — swapping adapter weights never
+    recompiles); ``pool`` only supplies the static site layout."""
+    if pool is None:
+        return nullcontext()
+    return _oplora.activate(pool.site_pools(lora_tree), lora_rows)
+
+
+def _gen_extra_args(pool, page, B, constraint):
+    """The per-call device-array tail (lora_tree, lora_rows, c_masks,
+    c_trans) — dummies keep the jit signature stable when a knob is
+    off."""
+    if pool is not None:
+        tree, rows = pool.tree(), jnp.full((B,), page, jnp.int32)
+    else:
+        tree, rows = (), jnp.zeros((0,), jnp.int32)
+    if constraint is not None:
+        cm, ct = constraint.device_tables()
+    else:
+        cm, ct = jnp.zeros((1, 1), bool), jnp.zeros((1, 1), jnp.int32)
+    return tree, rows, cm, ct
+
+
+def _select(logits, key, do_sample, temperature, top_k, top_p,
+            token_mask=None):
     """logits [B, V] -> token ids [B, 1].  Scalar-knob wrapper over the
     fused per-row sampler (ops/sampling.sample_rows) — ONE masking +
     categorical implementation serves the solo loop, the serving engine
-    and the speculative verify programs."""
+    and the speculative verify programs.  ``token_mask`` (bool [B, V]) is
+    the constrained-decoding path: greedy rows argmax over the masked
+    logits, sampled rows inherit it through mask_logits."""
     if not do_sample:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)[:, None]
+        src = logits if token_mask is None else jnp.where(
+            token_mask, logits, -jnp.inf)
+        return jnp.argmax(src, axis=-1).astype(jnp.int32)[:, None]
     B = logits.shape[0]
     return sample_rows(
         logits, key, jnp.ones((B,), bool),
         jnp.full((B,), temperature, jnp.float32),
         jnp.full((B,), int(top_k), jnp.int32),
-        jnp.full((B,), top_p, jnp.float32))[:, None]
+        jnp.full((B,), top_p, jnp.float32),
+        token_mask=token_mask)[:, None]
 
 
 def _to_static_caches(caches, ids, total, cache_dtype, kv_layout, page_size,
@@ -116,7 +190,8 @@ def _to_static_caches(caches, ids, total, cache_dtype, kv_layout, page_size,
 def generate(model, input_ids, max_new_tokens=32, do_sample=False,
              temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
              pad_token_id=0, cache_dtype=None, kv_layout=None,
-             page_size=128, share_prefix=False, spec_k=0, spec_drafter=None):
+             page_size=128, share_prefix=False, spec_k=0, spec_drafter=None,
+             adapter_id=None, adapters=None, token_mask_fn=None):
     """Generate `max_new_tokens` continuations of `input_ids` [B, S0].
 
     Returns int32 ids [B, max_new_tokens]; once a row emits `eos_token_id`
@@ -144,6 +219,25 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     positions >= the prompt length, i.e. in each row's private pages, so
     no copy-on-write is ever needed here and outputs stay bitwise
     identical to private tables.
+
+    adapter_id=/adapters= runs the whole generation through a LoRA
+    adapter: every hooked projection adds the paged-pool epilogue
+    ``(x @ A[page]) @ B[page]`` (ops/lora.py).  ``adapters`` is the
+    serving engine's ``AdapterRegistry`` (page contents and math then
+    match the engine bit for bit — the solo-parity surface the
+    multi-tenant tests pin down) or a plain ``{id: LoraAdapter}``
+    mapping.  ``adapter_id=None`` rows never touch the epilogue, so the
+    output is bitwise identical to a build without LoRA.
+
+    token_mask_fn= (a compiled ``inference.constrain.TokenConstraint``,
+    or a zero-arg callable returning one) turns on CONSTRAINED decoding:
+    the automaton's dense ``[n_states, V]`` mask/transition tables ride
+    into the compiled program as device arrays, the scan carries one
+    int32 automaton state per row, and every step's logits are masked
+    before selection (explicit mask -> temperature -> top-k -> top-p).
+    The same table bits drive the serving engine's per-tick mask upload,
+    so engine and solo constrained outputs are bitwise identical.  Not
+    composable with spec_k (masks are per-position).
 
     spec_k > 0 switches to SPECULATIVE decoding: a host-side drafter
     (``spec_drafter``: "ngram" prompt-lookup by default, or a small draft
@@ -188,73 +282,129 @@ def generate(model, input_ids, max_new_tokens=32, do_sample=False,
     page_size = int(page_size)
     if int(spec_k) < 0:
         raise ValueError(f"spec_k must be >= 0, got {spec_k}")
-    if spec_k:
-        return _generate_spec(
-            model, ids, int(max_new_tokens), bool(do_sample),
-            float(temperature), int(top_k), float(top_p), eos,
-            int(pad_token_id), cache_dtype, kv_layout, page_size,
-            bool(share_prefix), int(spec_k), spec_drafter)
-    cache_key = (B, S0, int(max_new_tokens), bool(do_sample), float(temperature),
-                 int(top_k), float(top_p), eos, int(pad_token_id),
-                 bool(model.training), cache_dtype, kv_layout, page_size,
-                 bool(share_prefix))
-    gen_cache = model.__dict__.setdefault("_generate_cache", {})
-    if cache_key in gen_cache:
+    constraint = _resolve_constraint(token_mask_fn)
+    if constraint is not None:
+        if spec_k:
+            raise ValueError(
+                "token_mask_fn does not compose with spec_k (constraint "
+                "masks are per-position; drafts cannot be pre-masked)")
+        vocab = getattr(getattr(model, "config", None), "vocab_size", None)
+        if vocab is not None and int(vocab) != constraint.V:
+            raise ValueError(
+                f"constraint vocab size {constraint.V} != model vocab "
+                f"size {int(vocab)}")
+        if eos_token_id is None:
+            eos = int(constraint.eos_token_id)
+        elif eos != int(constraint.eos_token_id):
+            raise ValueError(
+                f"eos_token_id {eos} != the constraint's eos "
+                f"{int(constraint.eos_token_id)}")
+    pool, page, release = _resolve_lora(model, adapter_id, adapters)
+    try:
+        if spec_k:
+            return _generate_spec(
+                model, ids, int(max_new_tokens), bool(do_sample),
+                float(temperature), int(top_k), float(top_p), eos,
+                int(pad_token_id), cache_dtype, kv_layout, page_size,
+                bool(share_prefix), int(spec_k), spec_drafter, pool, page)
+        # the lora/constraint signatures capture only SHAPE-relevant facts
+        # (pool geometry, automaton size), so swapping adapter weights or
+        # constraint specs of the same shape reuses the compiled program
+        lora_sig = (None if pool is None else
+                    ("lora", pool.num_pages, pool.rank, str(pool.dtype)))
+        c_sig = (None if constraint is None else
+                 ("constraint", constraint.n_states, constraint.V))
+        cache_key = (B, S0, int(max_new_tokens), bool(do_sample),
+                     float(temperature), int(top_k), float(top_p), eos,
+                     int(pad_token_id), bool(model.training), cache_dtype,
+                     kv_layout, page_size, bool(share_prefix), lora_sig,
+                     c_sig)
+        gen_cache = model.__dict__.setdefault("_generate_cache", {})
+        extra = _gen_extra_args(pool, page, B, constraint)
+        if cache_key in gen_cache:
+            key = _random.get_rng_key()
+            out = gen_cache[cache_key](params, buffers, ids, key, *extra)
+            t = Tensor(out)
+            t.stop_gradient = True
+            return t
+        use_c = constraint is not None
+
+        def run(params, buffers, ids, key, lora_tree, lora_rows, c_masks,
+                c_trans):
+            restore = model.bind_functional_state(params, buffers)
+            try:
+                with tape.no_grad(), _lora_trace_ctx(pool, lora_tree,
+                                                     lora_rows):
+                    logits, caches = model.generate_step(Tensor(ids))
+                    static = _to_static_caches(
+                        caches, ids, total, cache_dtype, kv_layout,
+                        page_size, share_prefix)
+                    key, sub = jax.random.split(key)
+                    cstate = jnp.zeros((B,), jnp.int32) if use_c else None
+                    mask = c_masks[cstate] if use_c else None
+                    tok = _select(logits._value[:, -1], sub, do_sample,
+                                  temperature, top_k, top_p, mask)
+                    if use_c:
+                        cstate = c_trans[cstate, tok[:, 0]]
+                    done = (tok[:, 0] == eos)
+
+                    def body(carry, key_t):
+                        if use_c:
+                            caches, tok, done, cstate = carry
+                        else:
+                            caches, tok, done = carry
+                            cstate = None
+                        t_caches = [tuple(Tensor(x)
+                                          if getattr(x, "ndim", 0) > 0
+                                          else x for x in c) for c in caches]
+                        logits, new_caches = model.generate_step(
+                            Tensor(tok), caches=t_caches)
+                        mask = c_masks[cstate] if use_c else None
+                        nxt = _select(logits._value[:, -1], key_t, do_sample,
+                                      temperature, top_k, top_p, mask)
+                        nxt = jnp.where(done[:, None],
+                                        jnp.asarray(pad_token_id, jnp.int32),
+                                        nxt)
+                        new_done = done | (nxt[:, 0] == eos)
+                        raw = [tuple(x._value if isinstance(x, Tensor) else x
+                                     for x in c) for c in new_caches]
+                        if use_c:
+                            # finished rows emit pad; park them in state 0
+                            # (any valid state works — masks are unused
+                            # once done) so the gather stays in-bounds
+                            ncs = jnp.where(
+                                new_done, 0, c_trans[cstate, nxt[:, 0]])
+                            return (raw, nxt, new_done, ncs), tok[:, 0]
+                        return (raw, nxt, new_done), tok[:, 0]
+
+                    if max_new_tokens > 1:
+                        keys = jax.random.split(key, max_new_tokens - 1)
+                        init = ((static, tok, done, cstate) if use_c
+                                else (static, tok, done))
+                        carry, toks = jax.lax.scan(body, init, keys)
+                        out = jnp.concatenate([toks.T, carry[1]], axis=1)
+                    else:
+                        out = tok
+            finally:
+                restore()
+            return out
+
+        jitted = jax.jit(run)
+        gen_cache[cache_key] = jitted
         key = _random.get_rng_key()
-        out = gen_cache[cache_key](params, buffers, ids, key)
+        out = jitted(params, buffers, ids, key, *extra)
         t = Tensor(out)
         t.stop_gradient = True
         return t
-
-    def run(params, buffers, ids, key):
-        restore = model.bind_functional_state(params, buffers)
-        try:
-            with tape.no_grad():
-                logits, caches = model.generate_step(Tensor(ids))
-                static = _to_static_caches(
-                    caches, ids, total, cache_dtype, kv_layout, page_size,
-                    share_prefix)
-                key, sub = jax.random.split(key)
-                tok = _select(logits._value[:, -1], sub, do_sample, temperature,
-                              top_k, top_p)
-                done = (tok[:, 0] == eos)
-
-                def body(carry, key_t):
-                    caches, tok, done = carry
-                    t_caches = [tuple(Tensor(x) if getattr(x, "ndim", 0) > 0
-                                      else x for x in c) for c in caches]
-                    logits, new_caches = model.generate_step(
-                        Tensor(tok), caches=t_caches)
-                    nxt = _select(logits._value[:, -1], key_t, do_sample,
-                                  temperature, top_k, top_p)
-                    nxt = jnp.where(done[:, None], jnp.asarray(pad_token_id, jnp.int32), nxt)
-                    new_done = done | (nxt[:, 0] == eos)
-                    raw = [tuple(x._value if isinstance(x, Tensor) else x
-                                 for x in c) for c in new_caches]
-                    return (raw, nxt, new_done), tok[:, 0]
-
-                if max_new_tokens > 1:
-                    keys = jax.random.split(key, max_new_tokens - 1)
-                    (_, last, _), toks = jax.lax.scan(body, (static, tok, done), keys)
-                    out = jnp.concatenate([toks.T, last], axis=1)
-                else:
-                    out = tok
-        finally:
-            restore()
-        return out
-
-    jitted = jax.jit(run)
-    gen_cache[cache_key] = jitted
-    key = _random.get_rng_key()
-    out = jitted(params, buffers, ids, key)
-    t = Tensor(out)
-    t.stop_gradient = True
-    return t
+    finally:
+        if release is not None:
+            release()
 
 
 def _generate_spec(model, ids, max_new_tokens, do_sample, temperature,
                    top_k, top_p, eos, pad_token_id, cache_dtype, kv_layout,
-                   page_size, share_prefix, spec_k, spec_drafter):
+                   page_size, share_prefix, spec_k, spec_drafter,
+                   pool=None, page=0):
     """Speculative decoding: K host-drafted tokens verified per compiled
     step (S = K+1 through the same static/paged cache paths the plain
     loop uses), host loop over draft -> verify -> accept.
@@ -280,16 +430,20 @@ def _generate_spec(model, ids, max_new_tokens, do_sample, temperature,
     # short of max_new_tokens still scatters in-bounds
     total = S0 + int(max_new_tokens) + K
     params, buffers = model.functional_state()
+    lora_sig = (None if pool is None else
+                ("lora", pool.num_pages, pool.rank, str(pool.dtype)))
     cache_key = ("spec", B, S0, int(max_new_tokens), bool(do_sample),
                  float(temperature), int(top_k), float(top_p), eos,
                  int(pad_token_id), bool(model.training), cache_dtype,
-                 kv_layout, page_size, bool(share_prefix), K)
+                 kv_layout, page_size, bool(share_prefix), K, lora_sig)
     gen_cache = model.__dict__.setdefault("_generate_cache", {})
+    l_tree, l_rows = _gen_extra_args(pool, page, B, None)[:2]
     if cache_key not in gen_cache:
-        def prefill(params, buffers, ids, key):
+        def prefill(params, buffers, ids, key, lora_tree, lora_rows):
             restore = model.bind_functional_state(params, buffers)
             try:
-                with tape.no_grad():
+                with tape.no_grad(), _lora_trace_ctx(pool, lora_tree,
+                                                     lora_rows):
                     logits, caches = model.generate_step(Tensor(ids))
                     static = _to_static_caches(
                         caches, ids, total, cache_dtype, kv_layout,
@@ -303,10 +457,12 @@ def _generate_spec(model, ids, max_new_tokens, do_sample, temperature,
                 restore()
             return tok, stripped
 
-        def verify(params, buffers, caches, tok, drafts, pos, key):
+        def verify(params, buffers, caches, tok, drafts, pos, key,
+                   lora_tree, lora_rows):
             restore = model.bind_functional_state(params, buffers)
             try:
-                with tape.no_grad():
+                with tape.no_grad(), _lora_trace_ctx(pool, lora_tree,
+                                                     lora_rows):
                     t_caches = [
                         tuple(Tensor(x) for x in c[:2]) + (pos,)
                         + tuple(Tensor(x) for x in c[2:]) for c in caches]
@@ -333,7 +489,7 @@ def _generate_spec(model, ids, max_new_tokens, do_sample, temperature,
     prefill_jit, verify_jit = gen_cache[cache_key]
     key = _random.get_rng_key()
     key, sub = jax.random.split(key)
-    first, caches = prefill_jit(params, buffers, ids, sub)
+    first, caches = prefill_jit(params, buffers, ids, sub, l_tree, l_rows)
     first = np.asarray(first).reshape(B)
     out = np.full((B, int(max_new_tokens)), int(pad_token_id), np.int32)
     counts = np.zeros(B, np.int64)
@@ -357,7 +513,7 @@ def _generate_spec(model, ids, max_new_tokens, do_sample, temperature,
         key, sub = jax.random.split(key)
         o_dev, n_dev, caches = verify_jit(
             params, buffers, caches, jnp.asarray(last[:, None]),
-            jnp.asarray(drafts), jnp.asarray(pos), sub)
+            jnp.asarray(drafts), jnp.asarray(pos), sub, l_tree, l_rows)
         o = np.asarray(o_dev)
         n = np.asarray(n_dev)
         for b in range(B):

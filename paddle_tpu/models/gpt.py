@@ -5,6 +5,7 @@ from dataclasses import dataclass
 
 from .. import nn
 from ..nn import functional as F
+from ..ops import lora as _lora
 from ..tensor import creation
 from ..distributed.meta_parallel.mp_layers import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
@@ -54,10 +55,32 @@ class GPTBlock(nn.Layer):
         self.drop = nn.Dropout(config.hidden_dropout_prob)
         self.attn_drop = config.attention_probs_dropout_prob
 
+    def _proj_out(self, x, attn_flat):
+        y = self.proj(attn_flat)
+        d = _lora.apply_site("proj", attn_flat)
+        return x + self.drop(y if d is None else y + d)
+
+    def _mlp(self, x):
+        h = self.ln_2(x)
+        u = self.fc_in(h)
+        d_in = _lora.apply_site("fc_in", h)
+        if d_in is not None:  # multi-tenant LoRA epilogues (see forward)
+            u = u + d_in
+        g = F.gelu(u)
+        y = self.fc_out(g)
+        d_out = _lora.apply_site("fc_out", g)
+        return x + self.drop(y if d_out is None else y + d_out)
+
     def forward(self, x, cache=None, use_cache=False):
         B, S = x.shape[0], x.shape[1]
         h = self.ln_1(x)
-        qkv = self.qkv(h).reshape([B, S, 3, self.num_heads, self.head_dim])
+        qkv = self.qkv(h)
+        dqkv = _lora.apply_site("qkv", h)
+        if dqkv is not None:
+            # multi-tenant LoRA epilogue: per-row adapter-page gathers add
+            # the low-rank delta; zero-adapter rows gather page 0 (exact +0)
+            qkv = qkv + dqkv
+        qkv = qkv.reshape([B, S, 3, self.num_heads, self.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn_mask = None
         if cache is not None and len(cache) in (4, 6):
@@ -70,8 +93,8 @@ class GPTBlock(nn.Layer):
 
             offset = cache[2]
             new_cache, attn = paged_attention_update(cache, q, k, v, offset)
-            x = x + self.drop(self.proj(attn.reshape([B, S, -1])))
-            x = x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+            x = self._proj_out(x, attn.reshape([B, S, -1]))
+            x = self._mlp(x)
             return x, new_cache
         elif cache is not None and len(cache) in (3, 5):
             # static head-major (k_buf, v_buf, pos) layout for the compiled
@@ -96,8 +119,8 @@ class GPTBlock(nn.Layer):
                 attn = apply_op(
                     lambda qq, kk, vv: decode_attention(qq, kk, vv, offset),
                     (q, k_b, v_b), name="decode_attention")
-            x = x + self.drop(self.proj(attn.reshape([B, S, -1])))
-            x = x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+            x = self._proj_out(x, attn.reshape([B, S, -1]))
+            x = self._mlp(x)
             return x, new_cache
         elif cache is not None:
             from ..tensor import manipulation as M
@@ -120,8 +143,8 @@ class GPTBlock(nn.Layer):
             q, k, v, is_causal=attn_mask is None, attn_mask=attn_mask,
             dropout_p=self.attn_drop if self.training else 0.0,
         )
-        x = x + self.drop(self.proj(attn.reshape([B, S, -1])))
-        x = x + self.drop(self.fc_out(F.gelu(self.fc_in(self.ln_2(x)))))
+        x = self._proj_out(x, attn.reshape([B, S, -1]))
+        x = self._mlp(x)
         if use_cache or cache is not None:
             return x, new_cache
         return x
@@ -238,7 +261,8 @@ class GPTForCausalLM(nn.Layer):
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  pad_token_id=0, cache_dtype=None, kv_layout=None,
                  page_size=128, share_prefix=False, spec_k=0,
-                 spec_drafter=None):
+                 spec_drafter=None, adapter_id=None, adapters=None,
+                 token_mask_fn=None):
         """Compiled decode loop on a static kv-cache (models/generation.py)."""
         from .generation import generate as _gen
 
@@ -246,4 +270,6 @@ class GPTForCausalLM(nn.Layer):
                     top_k, top_p, eos_token_id, pad_token_id,
                     cache_dtype=cache_dtype, kv_layout=kv_layout,
                     page_size=page_size, share_prefix=share_prefix,
-                    spec_k=spec_k, spec_drafter=spec_drafter)
+                    spec_k=spec_k, spec_drafter=spec_drafter,
+                    adapter_id=adapter_id, adapters=adapters,
+                    token_mask_fn=token_mask_fn)

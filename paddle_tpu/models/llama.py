@@ -17,6 +17,7 @@ import numpy as np
 
 from .. import nn
 from ..nn import functional as F
+from ..ops import lora as _lora
 from ..tensor.tensor import Tensor, apply_op
 from ..tensor import manipulation as M
 from ..distributed.meta_parallel.mp_layers import (
@@ -131,6 +132,11 @@ class LlamaAttention(nn.Layer):
         else:
             self.o_proj = nn.Linear(self.num_heads * self.head_dim, self.hidden_size, bias_attr=False)
 
+    def _o(self, out):
+        y = self.o_proj(out)
+        d = _lora.apply_site("o", out)
+        return y if d is None else y + d
+
     def forward(self, hidden_states, rope, attn_mask=None, cache=None, use_cache=False):
         """rope: (cos, sin) Tensors shared at LlamaModel level (one copy, not 32).
         cache=None with use_cache=True is the prefill step: the returned cache is
@@ -141,14 +147,13 @@ class LlamaAttention(nn.Layer):
                    and type(self.v_proj) is nn.Linear  # not wrapped (quant etc.)
                    and all(getattr(p, "bias", None) is None
                            for p in (self.q_proj, self.k_proj, self.v_proj)))
+        nq = self.num_heads * self.head_dim
+        nkv = self.num_kv_heads * self.head_dim
         if S == 1 and fusable:
             # decode step: ONE fused qkv gemv instead of three — at batch<<128
             # each projection is weight-streaming-bound and per-op latency
             # dominates; the concat of the (loop-invariant) weights is hoisted
             # out of the decode scan by XLA LICM, so the fusion costs nothing
-            nq = self.num_heads * self.head_dim
-            nkv = self.num_kv_heads * self.head_dim
-
             def _fused_qkv(h, wq, wk, wv):
                 w = jnp.concatenate([wq, wk, wv], axis=1)
                 return h @ w.astype(h.dtype)
@@ -157,13 +162,23 @@ class LlamaAttention(nn.Layer):
                            (hidden_states, self.q_proj.weight,
                             self.k_proj.weight, self.v_proj.weight),
                            name="fused_qkv")
-            q = qkv[:, :, :nq].reshape([B, S, self.num_heads, self.head_dim])
-            k = qkv[:, :, nq:nq + nkv].reshape([B, S, self.num_kv_heads, self.head_dim])
-            v = qkv[:, :, nq + nkv:].reshape([B, S, self.num_kv_heads, self.head_dim])
+            q = qkv[:, :, :nq]
+            k = qkv[:, :, nq:nq + nkv]
+            v = qkv[:, :, nq + nkv:]
         else:
-            q = self.q_proj(hidden_states).reshape([B, S, self.num_heads, self.head_dim])
-            k = self.k_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
-            v = self.v_proj(hidden_states).reshape([B, S, self.num_kv_heads, self.head_dim])
+            q = self.q_proj(hidden_states)
+            k = self.k_proj(hidden_states)
+            v = self.v_proj(hidden_states)
+        dq = _lora.apply_site("q", hidden_states)
+        if dq is not None:
+            # multi-tenant LoRA epilogue: per-row adapter-page gathers add
+            # the low-rank delta; zero-adapter rows gather page 0 (exact +0)
+            q = q + dq
+            k = k + _lora.apply_site("k", hidden_states)
+            v = v + _lora.apply_site("v", hidden_states)
+        q = q.reshape([B, S, self.num_heads, self.head_dim])
+        k = k.reshape([B, S, self.num_kv_heads, self.head_dim])
+        v = v.reshape([B, S, self.num_kv_heads, self.head_dim])
 
         # a 3-tuple cache (k_buf, v_buf, pos) is the STATIC layout used by the
         # compiled generate() loop: fixed-size HEAD-MAJOR [B, H, L, D] buffers
@@ -194,7 +209,7 @@ class LlamaAttention(nn.Layer):
             # (llm_attn_kernel_total{path,reason} counts the dispatch)
             new_cache, out = paged_attention_update(cache, q, k, v, offset)
             out = out.reshape([B, S, self.num_heads * self.head_dim])
-            out = self.o_proj(out)
+            out = self._o(out)
             if use_cache:
                 return out, new_cache
             return out
@@ -217,7 +232,7 @@ class LlamaAttention(nn.Layer):
                     lambda qq, kk, vv: decode_attention(qq, kk, vv, offset),
                     (q, k_b, v_b), name="decode_attention")
             out = out.reshape([B, S, self.num_heads * self.head_dim])
-            out = self.o_proj(out)
+            out = self._o(out)
             if use_cache:
                 return out, new_cache
             return out
@@ -265,7 +280,7 @@ class LlamaAttention(nn.Layer):
                 q, k, v, attn_mask=attn_mask, is_causal=attn_mask is None, backend=backend,
             )
         out = out.reshape([B, S, self.num_heads * self.head_dim])
-        out = self.o_proj(out)
+        out = self._o(out)
         if use_cache:
             return out, new_cache
         return out
@@ -298,8 +313,17 @@ class LlamaMLP(nn.Layer):
             gu = apply_op(_fused_gu, (x, self.gate_proj.weight, self.up_proj.weight),
                           name="fused_gate_up")
             inter = self.gate_proj.weight.shape[1]
-            return self.down_proj(F.silu(gu[:, :, :inter]) * gu[:, :, inter:])
-        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+            g, u = gu[:, :, :inter], gu[:, :, inter:]
+        else:
+            g, u = self.gate_proj(x), self.up_proj(x)
+        dg = _lora.apply_site("gate", x)
+        if dg is not None:  # multi-tenant LoRA epilogues (see LlamaAttention)
+            g = g + dg
+            u = u + _lora.apply_site("up", x)
+        h = F.silu(g) * u
+        y = self.down_proj(h)
+        dd = _lora.apply_site("down", h)
+        return y if dd is None else y + dd
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -444,7 +468,8 @@ class LlamaForCausalLM(nn.Layer):
                  temperature=1.0, top_k=0, top_p=1.0, eos_token_id=None,
                  pad_token_id=0, cache_dtype=None, kv_layout=None,
                  page_size=128, share_prefix=False, spec_k=0,
-                 spec_drafter=None):
+                 spec_drafter=None, adapter_id=None, adapters=None,
+                 token_mask_fn=None):
         """Compiled autoregressive decoding on a static kv-cache — one XLA
         program for prefill + the whole token scan (models/generation.py).
         cache_dtype='int8' halves the kv-cache HBM footprint;
@@ -453,11 +478,17 @@ class LlamaForCausalLM(nn.Layer):
         share_prefix=True additionally aliases the batch's common prompt
         prefix onto shared physical pages (the prefix-cache read path);
         spec_k=K enables speculative decoding (K drafts verified per
-        compiled step; greedy output is bitwise identical to spec_k=0)."""
+        compiled step; greedy output is bitwise identical to spec_k=0);
+        adapter_id=/adapters= routes the call through a paged LoRA
+        adapter pool (models/lora.py); token_mask_fn= applies a compiled
+        token automaton (inference/constrain.py) for constrained
+        decoding."""
         from .generation import generate as _gen
 
         return _gen(self, input_ids, max_new_tokens, do_sample, temperature,
                     top_k, top_p, eos_token_id, pad_token_id,
                     cache_dtype=cache_dtype, kv_layout=kv_layout,
                     page_size=page_size, share_prefix=share_prefix,
-                    spec_k=spec_k, spec_drafter=spec_drafter)
+                    spec_k=spec_k, spec_drafter=spec_drafter,
+                    adapter_id=adapter_id, adapters=adapters,
+                    token_mask_fn=token_mask_fn)

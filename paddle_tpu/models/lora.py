@@ -1,0 +1,357 @@
+"""Paged LoRA adapter pool: refcounted A/B weight pages + registry.
+
+The PR-6 kv page-pool playbook applied to WEIGHTS instead of KV: adapter
+low-rank factors live in per-projection device pools shaped
+``[num_adapter_pages, D_in, r]`` (A) and ``[num_adapter_pages, r, D_out]``
+(B), bf16, and every batch row addresses its adapter through an int32
+page id — so a mixed-tenant batch is one gather away from its weights and
+the compiled program never changes shape when the adapter mix does.
+
+Page 0 is the reserved ZERO adapter (all-zero A/B, never written, never
+evicted): ``adapter_id=None`` rows gather it and receive an exact ``+0``
+delta, so base-model traffic co-batches with adapter traffic without a
+masking branch.
+
+:class:`AdapterRegistry` owns the host-side accounting the kv allocator
+owns for pages: refcounts (a live request pins its adapter for its whole
+lifetime — admission charges the pool, finish/expiry/preemption release
+it), a free list, and LRU eviction of LOADED-BUT-UNREFERENCED adapters
+when a cold adapter needs a page.  ``acquire`` returning ``None`` means
+"every page is pinned right now" — the engine requeues the request
+head-of-line exactly like kv-pool exhaustion.
+
+Metric families (lazily registered here, linted via
+``tools/metrics_lint.py import_instrumented``):
+``llm_adapter_loads_total``, ``llm_adapter_evictions_total``,
+``llm_adapter_pool_pages_in_use_count``,
+``llm_adapter_pool_utilization_ratio``.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..observability import metrics as _obs
+from ..observability import profiling as _profiling
+
+__all__ = ["lora_sites", "LoraAdapter", "LoraPool", "AdapterRegistry",
+           "build_solo_pool"]
+
+_M_LOADS = _obs.counter(
+    "llm_adapter_loads_total",
+    "Adapter weight uploads into LoRA pool pages (cold loads + reloads)")
+_M_EVICTIONS = _obs.counter(
+    "llm_adapter_evictions_total",
+    "Unreferenced LoRA adapters LRU-evicted to make room for a cold load")
+_M_PAGES_IN_USE = _obs.gauge(
+    "llm_adapter_pool_pages_in_use_count",
+    "LoRA adapter pages pinned by live requests (refcount > 0)")
+_M_POOL_UTIL = _obs.gauge(
+    "llm_adapter_pool_utilization_ratio",
+    "Pinned adapter pages / usable pool pages (page 0 excluded)")
+
+
+def lora_sites(model):
+    """Projection-site name -> ``(d_in, d_out)`` for a supported model.
+
+    Site names match the ``ops.lora.apply_site`` hooks in the model
+    forwards; the A pool for a site is ``[P, d_in, r]`` and the B pool is
+    ``[P, r, d_out]``.
+    """
+    cfg = getattr(model, "config", None) or model  # model or bare config
+    kind = type(model).__name__
+    h = cfg.hidden_size
+    inter = cfg.intermediate_size
+    if "Llama" in kind:
+        hd = h // cfg.num_attention_heads
+        nq = cfg.num_attention_heads * hd
+        nkv = cfg.num_key_value_heads * hd
+        return {"q": (h, nq), "k": (h, nkv), "v": (h, nkv), "o": (nq, h),
+                "gate": (h, inter), "up": (h, inter), "down": (inter, h)}
+    if "GPT" in kind:
+        return {"qkv": (h, 3 * h), "proj": (h, h),
+                "fc_in": (h, inter), "fc_out": (inter, h)}
+    raise ValueError(f"no LoRA site map for model type {kind!r}")
+
+
+class LoraAdapter:
+    """Host-side adapter weights: ``{site: (A [d_in, r], B [r, d_out])}``.
+
+    ``scale`` (alpha / r in the usual parameterisation) is folded into B
+    at construction so the serving path is a bare two-matmul epilogue.
+    """
+
+    def __init__(self, weights, rank=None, scale=1.0):
+        self.weights = {}
+        self.rank = 0
+        for site, (a, b) in weights.items():
+            a = np.asarray(a, np.float32)
+            b = np.asarray(b, np.float32) * float(scale)
+            if a.ndim != 2 or b.ndim != 2 or a.shape[1] != b.shape[0]:
+                raise ValueError(
+                    f"adapter site {site!r}: A {a.shape} / B {b.shape} are "
+                    f"not a rank-factorised pair")
+            self.weights[site] = (a, b)
+            self.rank = max(self.rank, a.shape[1])
+        if rank is not None and int(rank) != self.rank:
+            raise ValueError(f"declared rank {rank} != factor rank {self.rank}")
+
+    @staticmethod
+    def random(sites, rank, seed, scale=0.05):
+        """A deterministic random adapter for tests/benches.  Unlike the
+        training init (B=0 so the delta starts as a no-op), BOTH factors
+        are non-zero so the adapter visibly changes logits."""
+        rng = np.random.default_rng(seed)
+        w = {}
+        for site, (din, dout) in sites.items():
+            w[site] = (rng.standard_normal((din, rank)).astype(np.float32),
+                       rng.standard_normal((rank, dout)).astype(np.float32))
+        return LoraAdapter(w, rank=rank, scale=scale)
+
+    def validate_against(self, sites, rank):
+        if set(self.weights) != set(sites):
+            raise ValueError(
+                f"adapter sites {sorted(self.weights)} != pool sites "
+                f"{sorted(sites)}")
+        for site, (din, dout) in sites.items():
+            a, b = self.weights[site]
+            if a.shape[0] != din or b.shape[1] != dout:
+                raise ValueError(
+                    f"adapter site {site!r}: ({a.shape[0]}, {b.shape[1]}) "
+                    f"does not match model ({din}, {dout})")
+        if self.rank > rank:
+            raise ValueError(f"adapter rank {self.rank} > pool rank {rank}")
+
+
+class LoraPool:
+    """Device-side paged A/B pools, one (A, B) pair per projection site.
+
+    The pools are ordinary jax arrays passed as ARGUMENTS into the
+    engine's compiled programs (the per-slot device-array knob mechanism),
+    so loading/evicting adapters changes values, never program shapes.
+    Page writes go through ONE jitted donating updater, pre-compiled by
+    :meth:`warm` so post-warmup loads cannot show up as recompiles.
+    """
+
+    def __init__(self, sites, num_pages, rank, dtype=jnp.bfloat16):
+        if num_pages < 2:
+            raise ValueError("LoRA pool needs >= 2 pages (page 0 is the "
+                             "reserved zero adapter)")
+        self.sites = dict(sites)
+        self.site_names = sorted(self.sites)
+        self.num_pages = int(num_pages)
+        self.rank = int(rank)
+        self.dtype = dtype
+        self._tree = tuple(
+            (jnp.zeros((self.num_pages, self.sites[s][0], self.rank), dtype),
+             jnp.zeros((self.num_pages, self.rank, self.sites[s][1]), dtype))
+            for s in self.site_names)
+        self._write_jit = jax.jit(self._write_impl, donate_argnums=(0,))
+        self._write_compiled = False
+
+    @staticmethod
+    def _write_impl(tree, idx, vals):
+        return tuple((a.at[idx].set(av), b.at[idx].set(bv))
+                     for (a, b), (av, bv) in zip(tree, vals))
+
+    def tree(self):
+        """The pools as a jit-friendly pytree (site-name sorted)."""
+        return self._tree
+
+    def site_pools(self, tree=None):
+        """``{site: (a_pool, b_pool)}`` for ``ops.lora.activate`` — from
+        ``tree`` when called inside a traced function (tracers), else from
+        the live pool arrays."""
+        t = self._tree if tree is None else tree
+        return dict(zip(self.site_names, t))
+
+    def _padded(self, adapter):
+        vals = []
+        for s in self.site_names:
+            a, b = adapter.weights[s]
+            r = a.shape[1]
+            if r < self.rank:  # zero-padded ranks contribute exact zeros
+                a = np.pad(a, ((0, 0), (0, self.rank - r)))
+                b = np.pad(b, ((0, self.rank - r), (0, 0)))
+            vals.append((jnp.asarray(a, self.dtype),
+                         jnp.asarray(b, self.dtype)))
+        return tuple(vals)
+
+    def write(self, page, adapter):
+        if not 0 < page < self.num_pages:
+            raise IndexError(f"adapter page {page} outside usable pool")
+        if not self._write_compiled:
+            _profiling.record_compile("lora_write")
+            self._write_compiled = True
+        self._tree = self._write_jit(self._tree, page, self._padded(adapter))
+
+    def warm(self):
+        """Compile the page writer by rewriting page 0 with zeros (a
+        value-level no-op that preserves the zero-adapter invariant), so a
+        post-warmup adapter load is a cache hit, not a recompile."""
+        if not self._write_compiled:
+            _profiling.record_compile("lora_write")
+            self._write_compiled = True
+        # build the zero values exactly the way write() builds real ones —
+        # host float32 numpy through jnp.asarray(., dtype) — so the tiny
+        # per-shape convert programs XLA compiles for the host->device
+        # dtype cast are also warmed (they'd otherwise land on
+        # jit_recompiles_total at the first post-warmup adapter load)
+        zeros = tuple(
+            (jnp.asarray(np.zeros((self.sites[s][0], self.rank),
+                                  np.float32), self.dtype),
+             jnp.asarray(np.zeros((self.rank, self.sites[s][1]),
+                                  np.float32), self.dtype))
+            for s in self.site_names)
+        self._tree = self._write_jit(self._tree, 0, zeros)
+
+
+class AdapterRegistry:
+    """Loads/pins adapters by id over a :class:`LoraPool`.
+
+    Refcount contract (mirrors the engine's kv page allocator):
+    ``acquire(id)`` at admission pins the adapter's page (loading it
+    first if cold, LRU-evicting an unreferenced adapter if the free list
+    is dry); ``release(id)`` at finish/expiry/preemption unpins it.  A
+    released adapter STAYS loaded — warm for the next request — until a
+    cold load needs its page.  ``acquire`` returns ``None`` when every
+    page is pinned, and raises on unknown ids and on decref-below-zero
+    (loud, like ``kv page decref below zero``).
+    """
+
+    def __init__(self, model, max_adapters=8, rank=8, dtype=jnp.bfloat16):
+        self.sites = lora_sites(model)
+        self.pool = LoraPool(self.sites, int(max_adapters) + 1, rank, dtype)
+        self._adapters = {}   # id -> LoraAdapter (host weights)
+        self._page_of = {}    # id -> loaded page
+        self._ref = {}        # id -> live-request refcount (loaded ids only)
+        self._free = list(range(1, self.pool.num_pages))
+        self._stamp = 0       # LRU clock for unreferenced loaded adapters
+        self._mru = {}        # id -> last acquire/release stamp
+        self._lock = threading.RLock()
+        self.loads = 0
+        self.evictions = 0
+
+    @staticmethod
+    def from_adapters(model, adapters, rank=None, dtype=jnp.bfloat16):
+        """Registry sized to hold every adapter in ``adapters`` resident."""
+        r = rank or max((a.rank for a in adapters.values()), default=8)
+        reg = AdapterRegistry(model, max_adapters=max(1, len(adapters)),
+                              rank=r, dtype=dtype)
+        for aid, ad in adapters.items():
+            reg.register(aid, ad)
+        return reg
+
+    def register(self, adapter_id, adapter):
+        if adapter_id is None:
+            raise ValueError("adapter_id None is the reserved zero adapter")
+        adapter.validate_against(self.sites, self.pool.rank)
+        with self._lock:
+            self._adapters[adapter_id] = adapter
+
+    def ids(self):
+        with self._lock:
+            return sorted(self._adapters)
+
+    # ------------------------------------------------------------ paging
+    def acquire(self, adapter_id):
+        """Pin ``adapter_id`` and return its page (0 for ``None``), or
+        ``None`` when the pool is exhausted by pinned adapters."""
+        if adapter_id is None:
+            return 0
+        with self._lock:
+            if adapter_id not in self._adapters:
+                raise KeyError(f"unknown adapter id {adapter_id!r}")
+            page = self._page_of.get(adapter_id)
+            if page is None:
+                page = self._take_page()
+                if page is None:
+                    return None
+                self.pool.write(page, self._adapters[adapter_id])
+                self._page_of[adapter_id] = page
+                self._ref[adapter_id] = 0
+                self.loads += 1
+                _M_LOADS.inc()
+            self._ref[adapter_id] += 1
+            self._stamp += 1
+            self._mru[adapter_id] = self._stamp
+            self._update_gauges()
+            return page
+
+    def release(self, adapter_id):
+        if adapter_id is None:
+            return
+        with self._lock:
+            ref = self._ref.get(adapter_id)
+            assert ref is not None and ref > 0, \
+                f"adapter {adapter_id!r} release below zero"
+            self._ref[adapter_id] = ref - 1
+            self._stamp += 1
+            self._mru[adapter_id] = self._stamp
+            self._update_gauges()
+
+    def page_for(self, adapter_id):
+        """Loaded page for ``adapter_id`` (no pin), ``None`` when cold."""
+        if adapter_id is None:
+            return 0
+        with self._lock:
+            return self._page_of.get(adapter_id)
+
+    def _take_page(self):
+        if self._free:
+            return self._free.pop()
+        victim = None
+        for aid, ref in self._ref.items():
+            if ref == 0 and (victim is None
+                             or self._mru.get(aid, 0) < self._mru.get(victim, 0)):
+                victim = aid
+        if victim is None:
+            return None  # every loaded adapter is pinned
+        page = self._page_of.pop(victim)
+        del self._ref[victim]
+        self._mru.pop(victim, None)
+        self.evictions += 1
+        _M_EVICTIONS.inc()
+        return page
+
+    # ------------------------------------------------------- introspection
+    def pinned_pages(self):
+        with self._lock:
+            return sum(1 for r in self._ref.values() if r > 0)
+
+    def _update_gauges(self):
+        usable = self.pool.num_pages - 1
+        pinned = sum(1 for r in self._ref.values() if r > 0)
+        _M_PAGES_IN_USE.set(pinned)
+        _M_POOL_UTIL.set(pinned / usable if usable else 0.0)
+
+    def stats(self):
+        with self._lock:
+            return {
+                "pages_total": self.pool.num_pages - 1,
+                "pages_loaded": len(self._page_of),
+                "pages_pinned": sum(1 for r in self._ref.values() if r > 0),
+                "registered": len(self._adapters),
+                "loads": self.loads,
+                "evictions": self.evictions,
+                "rank": self.pool.rank,
+            }
+
+    def warm(self):
+        self.pool.warm()
+
+
+def build_solo_pool(model, adapter, dtype=jnp.bfloat16):
+    """A minimal 2-page pool (zero page + ``adapter`` on page 1) for the
+    solo ``generate(adapter_id=...)`` parity path when the caller passes
+    bare adapter weights instead of a shared registry.  Uses the
+    adapter's own rank; the extra zero-padded rank columns a larger
+    registry pool would carry contribute exact zeros, so tokens match."""
+    sites = lora_sites(model)
+    adapter.validate_against(sites, adapter.rank)
+    pool = LoraPool(sites, 2, adapter.rank, dtype)
+    pool.write(1, adapter)
+    return pool

@@ -1,0 +1,104 @@
+"""Batched-gather LoRA epilogue: ``y += (x @ A_g) @ B_g`` per row.
+
+Multi-tenant serving runs MANY LoRA fine-tunes through ONE compiled
+program.  The adapter weights live in paged device pools (one
+``[num_adapter_pages, D_in, r]`` A-pool and one ``[num_adapter_pages, r,
+D_out]`` B-pool per projection site — see ``models/lora.py``), and each
+batch row carries an int32 adapter-page id.  The epilogue gathers that
+row's A/B pages with ``jnp.take`` and adds the low-rank delta to the base
+projection — no per-adapter branch, no recompile when the mix changes,
+exactly the per-slot DEVICE-ARRAY knob mechanism the fused sampler uses
+for top-k/top-p.
+
+Zero-adapter convention: page 0 of every pool is all zeros and is never
+written.  ``adapter_id=None`` rows gather page 0, so a mixed batch of
+base-model and adapter traffic needs no masking branch — the delta is an
+exact ``+0`` (zero matmuls produce exact zeros, and adding them cannot
+change any logit comparison).
+
+The context threading is deliberately out-of-band: model forwards call
+``apply_site(site, x)`` which returns ``None`` unless a pool context is
+active (``with activate(...)``), so the base model's traced program is
+bit-for-bit unchanged when multi-tenancy is off.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+import jax.numpy as jnp
+
+from ..tensor.tensor import apply_op
+
+__all__ = ["lora_epilogue", "activate", "active_sites", "apply_site"]
+
+
+def lora_epilogue(x, a_pool, b_pool, rows):
+    """Low-rank delta for a batch of rows against paged A/B pools.
+
+    ``x``: ``[B, S, D_in]`` activations (any float dtype).
+    ``a_pool``: ``[P, D_in, r]`` adapter A pages (bf16; page 0 zeros).
+    ``b_pool``: ``[P, r, D_out]`` adapter B pages (bf16; page 0 zeros).
+    ``rows``: ``[B]`` int32 adapter-page id per batch row.
+
+    Returns ``[B, S, D_out]`` in ``x.dtype``.  The gathered pages are cast
+    up to the activation dtype BEFORE the matmuls so an f32 model gets f32
+    accumulation (bf16 -> f32 is exact), keeping engine-vs-solo runs
+    bitwise comparable as long as both read the same bf16 page bits.
+    """
+    a = jnp.take(a_pool, rows, axis=0).astype(x.dtype)  # [B, D_in, r]
+    b = jnp.take(b_pool, rows, axis=0).astype(x.dtype)  # [B, r, D_out]
+    u = jnp.einsum("bsd,bdr->bsr", x, a)
+    return jnp.einsum("bsr,bro->bso", u, b)
+
+
+class _Ctx:
+    __slots__ = ("sites", "rows")
+
+    def __init__(self, sites, rows):
+        self.sites = sites  # {site: (a_pool, b_pool)} raw arrays/tracers
+        self.rows = rows    # [B] int32 raw array/tracer
+
+
+_tls = threading.local()
+
+
+def _current():
+    return getattr(_tls, "ctx", None)
+
+
+@contextmanager
+def activate(site_pools, rows):
+    """Make ``site_pools`` ({site: (a_pool, b_pool)}) + per-row page ids
+    visible to ``apply_site`` for the duration of the block.  Used INSIDE
+    jitted functions at trace time, so the pools/rows may be tracers; the
+    context is thread-local because tracing happens in the caller's
+    thread."""
+    prev = _current()
+    _tls.ctx = _Ctx(dict(site_pools), rows)
+    try:
+        yield
+    finally:
+        _tls.ctx = prev
+
+
+def active_sites():
+    """Site names visible in the current context ('' when inactive)."""
+    ctx = _current()
+    return frozenset(ctx.sites) if ctx is not None else frozenset()
+
+
+def apply_site(site, x):
+    """The hook model forwards call: low-rank delta Tensor for ``site``
+    computed from Tensor ``x``, or ``None`` when no pool context is active
+    (the common single-tenant case — zero trace-graph change)."""
+    ctx = _current()
+    if ctx is None:
+        return None
+    ab = ctx.sites.get(site)
+    if ab is None:
+        return None
+    a_pool, b_pool = ab
+    rows = ctx.rows
+    return apply_op(lambda h: lora_epilogue(h, a_pool, b_pool, rows),
+                    (x,), name=f"lora_{site}")

@@ -19,10 +19,11 @@ Contracts the repo's parity tests pin down:
 
 - greedy rows are a bare ``argmax`` — bitwise identical to
   ``generation._select`` and to the pre-fusion ``_select_rows``;
-- the masking order is temperature -> top-k -> top-p (top-p renormalizes
-  over the top-k survivors), matching ``generation._select``; masks apply
-  only where enabled (k in [1, V), p < 1), so disabled knobs are exact
-  no-ops;
+- the masking order is explicit token-mask -> temperature -> top-k ->
+  top-p (top-p renormalizes over the top-k survivors), matching
+  ``generation._select``; masks apply only where enabled (k in [1, V),
+  p < 1, token mask all-True rows untouched), so disabled knobs are
+  exact no-ops;
 - ``spec_accept``'s greedy path accepts the longest draft prefix that
   matches the verifier's argmax ladder — by construction the emitted
   tokens are the verifier's own argmaxes, which is what makes speculative
@@ -36,16 +37,25 @@ import jax.numpy as jnp
 __all__ = ["mask_logits", "sample_rows", "spec_accept"]
 
 
-def mask_logits(logits, temperature, top_k, top_p):
+def mask_logits(logits, temperature, top_k, top_p, token_mask=None):
     """Temperature/top-k/top-p masking, vectorized per row.
 
     logits ``[B, V]``; ``temperature``/``top_p`` f32 ``[B]``; ``top_k``
     int32 ``[B]`` (0, or >= V, disables).  Returns f32 logits with
     masked-out entries at ``-inf`` — feed to ``jax.random.categorical``
     (which normalizes) or ``softmax``.
+
+    ``token_mask`` (optional bool ``[B, V]``) is the EXPLICIT mask path
+    used by constrained decoding: False entries are forced to ``-inf``
+    before top-k/top-p, so the constraint shrinks the candidate set the
+    statistical knobs then act on.  An all-True mask is an exact no-op
+    (``jnp.where`` returns the untouched lane), preserving bitwise parity
+    for unconstrained rows.
     """
     V = logits.shape[-1]
     lt = logits.astype(jnp.float32) / jnp.maximum(temperature, 1e-6)[:, None]
+    if token_mask is not None:
+        lt = jnp.where(token_mask, lt, -jnp.inf)
     k = jnp.asarray(top_k, jnp.int32)
     use_k = (k > 0) & (k < V)
     # k-th largest value per row; masking by VALUE (< kth) keeps ties at
@@ -66,15 +76,23 @@ def mask_logits(logits, temperature, top_k, top_p):
     return jnp.where(use_p[:, None] & (lt < cutoff), -jnp.inf, lt)
 
 
-def sample_rows(logits, key, do_sample, temperature, top_k, top_p):
+def sample_rows(logits, key, do_sample, temperature, top_k, top_p,
+                token_mask=None):
     """Per-row token selection: logits ``[B, V]`` -> int32 ids ``[B]``.
 
     Each row carries its own ``(do_sample, temperature, top_k, top_p)``;
     greedy rows take the raw argmax (no masking touches them), sampled
     rows draw categorically from the masked distribution.
+
+    ``token_mask`` (bool ``[B, V]``) constrains BOTH paths: greedy rows
+    argmax over the masked logits (a constrained greedy row must emit an
+    allowed token), and sampled rows inherit the mask through
+    :func:`mask_logits`.  Rows with an all-True mask are untouched.
     """
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    masked = mask_logits(logits, temperature, top_k, top_p)
+    greedy_src = logits if token_mask is None else jnp.where(
+        token_mask, logits, -jnp.inf)
+    greedy = jnp.argmax(greedy_src, axis=-1).astype(jnp.int32)
+    masked = mask_logits(logits, temperature, top_k, top_p, token_mask)
     sampled = jax.random.categorical(key, masked, axis=-1).astype(jnp.int32)
     return jnp.where(do_sample, sampled, greedy)
 

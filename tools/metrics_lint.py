@@ -103,8 +103,10 @@ def import_instrumented(repo_root=None):
     import paddle_tpu.distributed.sharded_train_step  # noqa: F401
     import paddle_tpu.distributed.store  # noqa: F401
     import paddle_tpu.hapi.callbacks  # noqa: F401
+    import paddle_tpu.inference.constrain  # noqa: F401
     import paddle_tpu.inference.llm_server  # noqa: F401
     import paddle_tpu.inference.router  # noqa: F401
+    import paddle_tpu.models.lora  # noqa: F401
     import paddle_tpu.observability.profiling  # noqa: F401
     import paddle_tpu.observability.xplane  # noqa: F401
     from paddle_tpu.observability import REGISTRY
