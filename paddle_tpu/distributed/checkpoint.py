@@ -697,6 +697,8 @@ class CheckpointManager:
     """
 
     def __init__(self, path, keep=3, save_interval=1, retry=None):
+        import threading
+
         from .fault_tolerance import RetryPolicy
 
         self.path = path
@@ -704,15 +706,59 @@ class CheckpointManager:
         self.save_interval = max(1, int(save_interval))
         self.retry = retry if retry is not None else RetryPolicy()
         os.makedirs(path, exist_ok=True)
+        # async-save machinery: ONE worker thread drains a FIFO queue, so
+        # overlapping async saves serialize in submission order (a second
+        # save queues behind the first — they can never interleave their
+        # tmp+rename commits)
+        self._async_cv = threading.Condition()
+        self._async_queue = []
+        self._async_pending = 0
+        self._async_thread = None
+        self._async_errors = []
 
     def should_save(self, step):
         return step % self.save_interval == 0
 
-    def save(self, step, state, force=False, trace=None):
-        from .fault_tolerance import retry_call
+    def save(self, step, state, force=False, trace=None, async_=False):
+        """Save ``state`` at ``step`` (subject to ``save_interval`` unless
+        ``force``).
 
+        ``async_=True`` moves the serialize + tmp-write + commit onto a
+        background worker and returns a ``concurrent.futures.Future`` of
+        the checkpoint path immediately — training continues while the
+        bytes land.  The atomic tmp+rename/COMMITTED protocol is
+        unchanged (it runs verbatim on the worker), so a process killed
+        mid-async-save leaves an uncommitted dir that ``latest_step`` /
+        ``restore`` never see.  Overlapping async saves queue FIFO behind
+        each other; ``wait()`` joins them all and surfaces the first
+        failure.  The ``state`` pytree is captured by reference — jax
+        arrays are immutable so this is safe, but host numpy buffers must
+        not be mutated in place before the save completes.
+        """
         if not force and not self.should_save(step):
             return None
+        if not async_:
+            return self._save_sync(step, state, trace)
+        import threading
+        from concurrent.futures import Future
+
+        fut = Future()
+        with self._async_cv:
+            self._async_queue.append((step, state, trace, fut))
+            self._async_pending += 1
+            # the worker unregisters itself (sets _async_thread=None)
+            # UNDER the condition before exiting, so this check can never
+            # race a dying worker into dropping the job
+            if self._async_thread is None:
+                self._async_thread = threading.Thread(
+                    target=self._async_worker, daemon=True,
+                    name="paddle-tpu-ckpt-save")
+                self._async_thread.start()
+        return fut
+
+    def _save_sync(self, step, state, trace=None):
+        from .fault_tolerance import retry_call
+
         try:
             ckpt = retry_call(save_state, self.path, state, step=step,
                               policy=self.retry, trace=trace)
@@ -722,6 +768,42 @@ class CheckpointManager:
         if jax.process_index() == 0:
             self._gc()
         return ckpt
+
+    def _async_worker(self):
+        while True:
+            with self._async_cv:
+                if not self._async_queue:
+                    self._async_thread = None
+                    return
+                step, state, trace, fut = self._async_queue.pop(0)
+            try:
+                ckpt = self._save_sync(step, state, trace)
+            except BaseException as e:
+                fut.set_exception(e)
+                with self._async_cv:
+                    self._async_errors.append(e)
+                    self._async_pending -= 1
+                    self._async_cv.notify_all()
+            else:
+                fut.set_result(ckpt)
+                with self._async_cv:
+                    self._async_pending -= 1
+                    self._async_cv.notify_all()
+
+    def wait(self, timeout=None):
+        """Join every outstanding async save.  Raises the FIRST async
+        failure (then forgets it — the next wait() starts clean) and
+        returns True; returns False when ``timeout`` elapses with saves
+        still in flight."""
+        with self._async_cv:
+            done = self._async_cv.wait_for(
+                lambda: self._async_pending == 0, timeout=timeout)
+            if not done:
+                return False
+            if self._async_errors:
+                err, self._async_errors = self._async_errors[0], []
+                raise err
+        return True
 
     def _gc(self):
         """Delete steps older than the ``keep`` newest VALID ones.  Partial

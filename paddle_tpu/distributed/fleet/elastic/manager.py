@@ -254,6 +254,44 @@ class ElasticManager:
                                 else 3 * self.interval)
         return decisions
 
+    def run(self, step_fn, num_steps, manager, get_state, set_state, *,
+            check_every=1, samples_fn=None, widen_step_s=None,
+            **recovery_kwargs):
+        """Run a training loop with THIS manager as the restart authority
+        — ``run_with_recovery`` is the restart body (the PR-1 leftover).
+
+        After every ``check_every``-th completed step the alert plane is
+        polled (``poll_alerts(samples_fn())``) and ``check()`` consulted;
+        a pending telemetry-driven restart (``check()==RESTART``) is
+        consumed and raised as ``AlertRestart``, which
+        ``run_with_recovery`` heals by restoring the NEWEST valid
+        checkpoint from ``manager`` and replaying from there — the
+        telemetry-driven restart replays instead of diverging.
+        ``recovery_kwargs`` pass through (max_restarts, on_event,
+        telemetry_port, ...).  Returns run_with_recovery's summary dict.
+        """
+        from ...fault_tolerance import (AlertRestart, Preemption,
+                                        run_with_recovery)
+
+        every = max(1, int(check_every))
+
+        def wrapped(step):
+            step_fn(step)
+            if (step + 1) % every:
+                return
+            if self.alert_policy is not None:
+                samples = samples_fn() if samples_fn is not None else None
+                self.poll_alerts(samples=samples,
+                                 widen_step_s=widen_step_s)
+            if self.check() == ElasticStatus.RESTART:
+                d = self.consume_restart()
+                if d is not None:
+                    raise AlertRestart(d)
+                raise Preemption("elastic manager requested restart")
+
+        return run_with_recovery(wrapped, num_steps, manager, get_state,
+                                 set_state, **recovery_kwargs)
+
     def exit(self, completed=True):
         self._stop.set()
         self.store.delete_key(self._node_key())

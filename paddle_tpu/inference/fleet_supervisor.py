@@ -1,0 +1,525 @@
+"""Process-level fleet supervision: real replica subprocesses, crash-safe.
+
+``ReplicaSupervisor`` closes the gap the in-process fleet (PRs 10–15)
+could not: replicas here are REAL processes (``python -m
+paddle_tpu.inference.replica_main``) that can be kill -9'd, SIGSTOPped,
+or OOM-killed — and the fleet keeps serving.  The supervisor owns the
+process lifecycle; the existing ``Router``/``FleetController`` pair
+keeps owning traffic and policy:
+
+- **Spawn**: each replica gets an assigned port, PINNED across restarts
+  (the router's target list stays valid), and enters rotation only after
+  its ``/healthz`` answers 200 within the readiness gate.
+- **Supervise**: ``tick()`` reaps dead children and respawns them on a
+  jittered-exponential-backoff schedule; a replica that dies more than
+  ``restart_limit`` times inside ``restart_window_s`` (the PR-10
+  FleetController thresholds) is QUARANTINED — killed, benched in the
+  router, affinity dropped.  A child that is alive but unresponsive
+  (SIGSTOP wedge: the socket accepts, nothing answers) is SIGKILLed and
+  respawned after ``unhealthy_after_s`` of failed probes.
+- **Witness**: the supervisor is the router's *death witness* — it
+  exports ``witness(name) -> incarnation | None`` (None = no live
+  process).  The router captures the incarnation at admit time; any later
+  change CONFIRMS the admitted process died, making a mid-request kill -9
+  retry-safe (the dead incarnation can never deliver, so re-routing
+  cannot double-deliver).
+- **Scale**: ``apply_scale(+1)`` spawns a fresh replica and atomically
+  adds it to the router's rotation + scrape targets; ``apply_scale(-1)``
+  removes a victim from rotation first, drains it (bounded), SIGTERMs,
+  and escalates to SIGKILL only on deadline expiry.  Feed it the
+  FleetController's sustained ``scale_signal``.
+- **Shutdown**: ``stop()`` SIGTERMs every child (the entrypoint drains
+  bounded by its ``--drain-deadline``), waits the grace window on a
+  monotonic deadline, then SIGKILLs stragglers — counted on
+  ``fleet_proc_sigkill_escalations_total`` because every escalation is a
+  drain that failed its contract.
+
+Deterministic under an injected ``clock`` for the backoff/quarantine
+arithmetic; the actual process waits are bounded by monotonic deadlines
+(the tpulint wall-clock discipline).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+
+from ..distributed.fault_tolerance import ExponentialBackoff
+from ..observability import flight_recorder as _flight
+from ..observability import metrics as _obs
+from .router import _http_json
+
+__all__ = ["ReplicaSupervisor", "SupervisedReplica"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# Process-fleet telemetry (README §Observability catalogue).
+_M_SPAWNS = _obs.counter(
+    "fleet_proc_spawns_total",
+    "Replica processes spawned by the supervisor (launches + respawns)")
+_M_RESTARTS = _obs.counter(
+    "fleet_proc_restarts_total",
+    "Replica processes respawned after death or unresponsiveness")
+_M_BACKOFF = _obs.gauge(
+    "fleet_proc_backoff_seconds",
+    "Current restart backoff delay per replica (0 while running)",
+    labelnames=("replica",))
+_M_SIGKILLS = _obs.counter(
+    "fleet_proc_sigkill_escalations_total",
+    "Shutdowns escalated to SIGKILL after the drain/term grace deadline")
+_M_READY = _obs.histogram(
+    "fleet_proc_ready_seconds",
+    "Spawn-to-ready latency: exec to the first /healthz 200")
+
+
+class SupervisedReplica:
+    """Supervisor-side state of one replica process."""
+
+    __slots__ = ("name", "port", "proc", "incarnation", "state",
+                 "spawned_at", "restart_marks", "backoff_attempt",
+                 "next_spawn_at", "unhealthy_since", "fault_spec",
+                 "fault_incarnations")
+
+    def __init__(self, name, port):
+        self.name = str(name)
+        self.port = int(port)
+        self.proc = None
+        self.incarnation = 0       # bumped at every spawn
+        self.state = "init"        # init|starting|ready|backoff|
+        #                            quarantined|stopping|stopped
+        self.spawned_at = 0.0
+        self.restart_marks = deque()   # mono stamps of observed deaths
+        self.backoff_attempt = 0
+        self.next_spawn_at = 0.0
+        self.unhealthy_since = None
+        self.fault_spec = None         # ProcFaults spec for future spawns
+        self.fault_incarnations = None  # None = every future incarnation
+
+    @property
+    def pid(self):
+        return self.proc.pid if self.proc is not None else None
+
+    def alive(self):
+        return self.proc is not None and self.proc.poll() is None
+
+    def target(self):
+        return f"127.0.0.1:{self.port}"
+
+    def to_dict(self):
+        return {"name": self.name, "port": self.port, "pid": self.pid,
+                "incarnation": self.incarnation, "state": self.state,
+                "restarts": max(0, self.incarnation - 1),
+                "deaths_in_window": len(self.restart_marks)}
+
+
+class ReplicaSupervisor:
+    """Spawn + supervise N ``replica_main`` subprocesses (module doc)."""
+
+    def __init__(self, count=2, *, model="tiny", page_size=16, slots=2,
+                 max_seq_len=128, seed=7, drain_deadline_s=5.0,
+                 term_grace_s=5.0, ready_timeout_s=180.0,
+                 unhealthy_after_s=10.0, probe_timeout_s=1.0,
+                 restart_limit=3, restart_window_s=600.0, backoff=None,
+                 max_replicas=8, min_replicas=1, faults_enabled=False,
+                 name_prefix="replica", log_dir=None,
+                 clock=time.monotonic):
+        self.model = str(model)
+        self.page_size = int(page_size)
+        self.slots = int(slots)
+        self.max_seq_len = int(max_seq_len)
+        self.seed = int(seed)
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.term_grace_s = float(term_grace_s)
+        self.ready_timeout_s = float(ready_timeout_s)
+        self.unhealthy_after_s = float(unhealthy_after_s)
+        self.probe_timeout_s = float(probe_timeout_s)
+        self.restart_limit = int(restart_limit)
+        self.restart_window_s = float(restart_window_s)
+        self.backoff = backoff if backoff is not None else \
+            ExponentialBackoff(base=0.25, factor=2.0, max_delay=5.0)
+        self.max_replicas = int(max_replicas)
+        self.min_replicas = max(1, int(min_replicas))
+        self.faults_enabled = bool(faults_enabled)
+        self.name_prefix = str(name_prefix)
+        self.log_dir = log_dir
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._replicas: dict[str, SupervisedReplica] = {}
+        self._next_idx = int(count)
+        self._router = None
+        self.escalations = 0
+        for i in range(int(count)):
+            name = f"{self.name_prefix}-{i}"
+            self._replicas[name] = SupervisedReplica(name,
+                                                     self._free_port())
+
+    # ------------------------------------------------------------- plumbing
+    @staticmethod
+    def _free_port():
+        with socket.socket() as s:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
+    def replicas(self):
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get(self, name):
+        return self._replicas[str(name)]
+
+    def targets(self):
+        """(name, host:port) pairs for Router construction."""
+        return [(r.name, r.target()) for r in self.replicas()
+                if r.state not in ("stopping", "stopped")]
+
+    def attach(self, router):
+        """Wire the router: membership changes flow supervisor -> router,
+        and the router gains this supervisor as its death witness (the
+        incarnation check that makes process death retry-safe)."""
+        self._router = router
+        router.set_process_witness(self.witness)
+        return self
+
+    def witness(self, name):
+        """Router death-witness: the live incarnation serving ``name``,
+        or None when no live process exists.  A captured value that later
+        DIFFERS (or goes None) proves the admit-time process is gone."""
+        rep = self._replicas.get(str(name))
+        if rep is None or not rep.alive():
+            return None
+        return rep.incarnation
+
+    # -------------------------------------------------------------- spawning
+    def _spawn(self, rep, now):
+        rep.incarnation += 1
+        argv = [sys.executable, "-m", "paddle_tpu.inference.replica_main",
+                "--name", rep.name, "--port", str(rep.port),
+                "--model", self.model,
+                "--page-size", str(self.page_size),
+                "--slots", str(self.slots),
+                "--max-seq-len", str(self.max_seq_len),
+                "--seed", str(self.seed),
+                "--drain-deadline", str(self.drain_deadline_s)]
+        if self.faults_enabled:
+            argv.append("--allow-faultz")
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + \
+            env.get("PYTHONPATH", "")
+        if rep.fault_spec and (rep.fault_incarnations is None
+                               or rep.incarnation in rep.fault_incarnations):
+            from ..testing.faults import proc_fault_env
+            env = proc_fault_env(rep.fault_spec, env)
+        out = subprocess.DEVNULL
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            out = open(os.path.join(
+                self.log_dir, f"{rep.name}.{rep.incarnation}.log"), "ab")
+        rep.proc = subprocess.Popen(argv, env=env, stdout=out,
+                                    stderr=subprocess.STDOUT)
+        if out is not subprocess.DEVNULL:
+            out.close()  # the child holds its own fd now
+        rep.state = "starting"
+        rep.spawned_at = now
+        rep.unhealthy_since = None
+        _M_SPAWNS.inc()
+        _flight.record_event("fleet_proc_spawn", replica=rep.name,
+                             incarnation=rep.incarnation, pid=rep.proc.pid)
+
+    def _wait_ready(self, rep, deadline):
+        """Poll ``/healthz`` until 200, death, or the deadline.  Returns
+        True when the replica entered rotation-ready state."""
+        while True:
+            if rep.proc is None or rep.proc.poll() is not None:
+                return False  # died before readiness
+            now = self._clock()
+            if now >= deadline:
+                # slow-start past the gate: this incarnation is a failure
+                self._kill(rep)
+                _flight.record_event("fleet_proc_ready_timeout",
+                                     replica=rep.name)
+                return False
+            try:
+                status, _doc = _http_json(
+                    "127.0.0.1", rep.port, "GET", "/healthz",
+                    timeout=min(self.probe_timeout_s,
+                                max(0.05, deadline - now)))
+                if status == 200:
+                    rep.state = "ready"
+                    rep.backoff_attempt = 0
+                    _M_BACKOFF.labels(replica=rep.name).set(0.0)
+                    _M_READY.observe(max(0.0,
+                                         self._clock() - rep.spawned_at))
+                    return True
+            except Exception:
+                pass  # not bound yet / not healthy yet: keep gating
+            time.sleep(0.05)
+
+    def start(self):
+        """Spawn every replica concurrently, then gate on readiness.  A
+        replica that fails its gate is left scheduled for backoff respawn
+        (``tick()`` picks it up) — start() never wedges on one bad child."""
+        now = self._clock()
+        for rep in self.replicas():
+            if rep.proc is None:
+                self._spawn(rep, now)
+        deadline = self._clock() + self.ready_timeout_s
+        for rep in self.replicas():
+            if rep.state == "starting" and not self._wait_ready(rep,
+                                                                deadline):
+                self._record_death(rep, self._clock(),
+                                   reason="failed readiness gate")
+        return self
+
+    def ready(self):
+        return all(r.state == "ready" for r in self.replicas()
+                   if r.state not in ("stopping", "stopped"))
+
+    # ----------------------------------------------------------- supervision
+    def _kill(self, rep):
+        if rep.proc is not None and rep.proc.poll() is None:
+            try:
+                rep.proc.kill()
+                rep.proc.wait(timeout=10)
+            except (ProcessLookupError, subprocess.TimeoutExpired):
+                pass
+
+    def _record_death(self, rep, now, reason=""):
+        """One observed death: mark the flap window, schedule the backoff
+        respawn, and mark the replica down in the router (affinity pages
+        died with the process)."""
+        rep.restart_marks.append(now)
+        rep.state = "backoff"
+        rep.backoff_attempt += 1
+        delay = self.backoff.delay(rep.backoff_attempt)
+        rep.next_spawn_at = now + delay
+        _M_BACKOFF.labels(replica=rep.name).set(delay)
+        _flight.record_event("fleet_proc_death", replica=rep.name,
+                             incarnation=rep.incarnation, reason=reason,
+                             backoff_s=round(delay, 3))
+        router = self._router
+        if router is not None and rep.name in router._replicas:
+            router._replicas[rep.name].up = False
+            router.affinity.drop_replica(rep.name)
+            router._publish_up()
+
+    def _flapping(self, rep, now):
+        while rep.restart_marks and \
+                now - rep.restart_marks[0] > self.restart_window_s:
+            rep.restart_marks.popleft()
+        return len(rep.restart_marks) > self.restart_limit
+
+    def _quarantine(self, rep, now):
+        self._kill(rep)
+        rep.state = "quarantined"
+        _M_BACKOFF.labels(replica=rep.name).set(0.0)
+        _flight.record_event("fleet_proc_quarantine", replica=rep.name,
+                             deaths=len(rep.restart_marks))
+        router = self._router
+        if router is not None and rep.name in router._replicas:
+            router.quarantine(rep.name)
+
+    def _respawn(self, rep, now):
+        self._spawn(rep, now)
+        _M_RESTARTS.inc()
+        ok = self._wait_ready(rep, self._clock() + self.ready_timeout_s)
+        if not ok:
+            self._record_death(rep, self._clock(),
+                               reason="respawn failed readiness")
+        elif self._router is not None \
+                and rep.name not in self._router._replicas:
+            self._router.add_replica((rep.name, rep.target()))
+        return ok
+
+    def _probe_alive(self, rep, now):
+        """Liveness probe of a RUNNING child: any HTTP answer counts (a
+        draining 503 is alive); only no-answer-at-all (the SIGSTOP wedge)
+        accrues unhealthiness."""
+        try:
+            _http_json("127.0.0.1", rep.port, "GET", "/healthz",
+                       timeout=self.probe_timeout_s)
+        except Exception:
+            if rep.unhealthy_since is None:
+                rep.unhealthy_since = now
+            return False
+        rep.unhealthy_since = None
+        return True
+
+    def tick(self, now=None):
+        """One supervision turn: reap deaths, respawn on schedule,
+        quarantine flappers, SIGKILL+respawn wedged children.  Returns a
+        summary dict (what a controller loop logs)."""
+        now = self._clock() if now is None else now
+        acted = {"respawned": [], "quarantined": [], "killed": []}
+        for rep in self.replicas():
+            if rep.state in ("quarantined", "stopping", "stopped", "init"):
+                continue
+            if not rep.alive():
+                if rep.state != "backoff":
+                    rc = rep.proc.returncode if rep.proc is not None \
+                        else None
+                    self._record_death(rep, now, reason=f"exit {rc}")
+                if self._flapping(rep, now):
+                    self._quarantine(rep, now)
+                    acted["quarantined"].append(rep.name)
+                elif now >= rep.next_spawn_at:
+                    if self._respawn(rep, now):
+                        acted["respawned"].append(rep.name)
+                continue
+            # alive: detect the alive-but-wedged state (SIGSTOP et al.)
+            if not self._probe_alive(rep, now) and \
+                    now - rep.unhealthy_since >= self.unhealthy_after_s:
+                self._kill(rep)
+                acted["killed"].append(rep.name)
+                self._record_death(rep, now, reason="unresponsive")
+        return acted
+
+    def restart_replica(self, name):
+        """FleetController ``restart_hook``: kill + immediate respawn
+        (policy already decided this replica is sick — no backoff wait)."""
+        rep = self._replicas[str(name)]
+        if rep.state in ("quarantined", "stopping", "stopped"):
+            return False
+        now = self._clock()
+        self._kill(rep)
+        rep.restart_marks.append(now)
+        return self._respawn(rep, now)
+
+    # ---------------------------------------------------------------- faults
+    def set_fault(self, name, spec, incarnations=None):
+        """Arm a ProcFaults spec for FUTURE spawns of ``name`` (passed via
+        the environment); ``incarnations`` limits it to specific
+        incarnation numbers (None = all future)."""
+        rep = self._replicas[str(name)]
+        rep.fault_spec = dict(spec) if spec else None
+        rep.fault_incarnations = set(incarnations) \
+            if incarnations is not None else None
+
+    def arm_fault(self, name, spec):
+        """Arm a ProcFaults spec on the LIVE process of ``name`` via its
+        /faultz endpoint (requires ``faults_enabled=True`` spawns)."""
+        rep = self._replicas[str(name)]
+        status, doc = _http_json("127.0.0.1", rep.port, "POST", "/faultz",
+                                 body=dict(spec), timeout=5.0)
+        if status != 200:
+            raise RuntimeError(f"arm_fault({name}) failed: {doc}")
+        return doc
+
+    # ----------------------------------------------------------------- scale
+    def apply_scale(self, sig, now=None):
+        """Actuate one controller scale signal: +1 spawns a replica into
+        rotation, -1 drains and reaps one.  Returns the affected replica
+        name or None (signal 0 / at the fleet bounds)."""
+        if sig > 0:
+            return self.scale_up(now=now)
+        if sig < 0:
+            return self.scale_down(now=now)
+        return None
+
+    def scale_up(self, now=None):
+        now = self._clock() if now is None else now
+        with self._lock:
+            active = [r for r in self._replicas.values()
+                      if r.state not in ("stopping", "stopped")]
+            if len(active) >= self.max_replicas:
+                return None
+            name = f"{self.name_prefix}-{self._next_idx}"
+            self._next_idx += 1
+            rep = SupervisedReplica(name, self._free_port())
+            self._replicas[name] = rep
+        self._spawn(rep, now)
+        if not self._wait_ready(rep, self._clock() + self.ready_timeout_s):
+            self._record_death(rep, self._clock(),
+                               reason="scale-up failed readiness")
+            return None
+        if self._router is not None:
+            self._router.add_replica((rep.name, rep.target()))
+        _flight.record_event("fleet_proc_scale_up", replica=rep.name)
+        return rep.name
+
+    def scale_down(self, now=None):
+        now = self._clock() if now is None else now
+        with self._lock:
+            candidates = [r for r in self._replicas.values()
+                          if r.state == "ready"]
+            if len(candidates) <= self.min_replicas:
+                return None
+            rep = candidates[-1]  # newest first out (LIFO keeps the
+            rep.state = "stopping"  # long-lived warm replicas serving)
+        if self._router is not None \
+                and rep.name in self._router._replicas:
+            self._router.remove_replica(rep.name)
+        self._stop_one(rep)
+        _flight.record_event("fleet_proc_scale_down", replica=rep.name)
+        return rep.name
+
+    # -------------------------------------------------------------- shutdown
+    def _stop_one(self, rep, deadline=None):
+        """Drain -> SIGTERM -> grace -> SIGKILL for one child; counts the
+        escalation.  ``deadline`` (monotonic) bounds the whole sequence."""
+        if deadline is None:
+            deadline = self._clock() + self.drain_deadline_s \
+                + self.term_grace_s
+        escalated = False
+        if rep.alive():
+            try:
+                rep.proc.send_signal(signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        while rep.alive():
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                # drain blew its deadline: escalate
+                self._kill(rep)
+                self.escalations += 1
+                _M_SIGKILLS.inc()
+                _flight.record_event("fleet_proc_sigkill",
+                                     replica=rep.name)
+                escalated = True
+                break
+            try:
+                rep.proc.wait(timeout=min(0.1, remaining))
+            except subprocess.TimeoutExpired:
+                continue
+        rep.state = "stopped"
+        _M_BACKOFF.labels(replica=rep.name).set(0.0)
+        return escalated
+
+    def stop(self):
+        """Graceful fleet shutdown: SIGTERM everyone (each child drains
+        bounded by its --drain-deadline), shared monotonic grace
+        deadline, SIGKILL only the stragglers.  Returns the escalation
+        count for this stop."""
+        before = self.escalations
+        reps = [r for r in self.replicas() if r.state != "stopped"]
+        for rep in reps:
+            if rep.alive():
+                try:
+                    rep.proc.send_signal(signal.SIGTERM)
+                except ProcessLookupError:
+                    pass
+        deadline = self._clock() + self.drain_deadline_s + self.term_grace_s
+        for rep in reps:
+            self._stop_one(rep, deadline=deadline)
+        return self.escalations - before
+
+    # -------------------------------------------------------------- operator
+    def procz(self):
+        """The `/procz` payload: per-process supervision state."""
+        return {"replicas": [r.to_dict() for r in self.replicas()],
+                "escalations": self.escalations,
+                "model": self.model}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -179,6 +179,10 @@ _M_ADM_REORDERS = _obs.counter(
 _M_DRAINING = _obs.gauge(
     "llm_draining_value",
     "1 while the engine is draining (admission closed, in-flight finishing)")
+_M_DRAIN_EXPIRED = _obs.counter(
+    "llm_drain_expired_total",
+    "Requests failed with DeadlineExceededError because a bounded drain "
+    "(drain(deadline_s=)) expired with them still queued or in flight")
 
 
 def _attn_dispatch_series():
@@ -1056,7 +1060,7 @@ class LLMEngine:
                 and self._prefilling is None
                 and all(r is None for r in self.slot_req))
 
-    def drain(self, timeout=None):
+    def drain(self, timeout=None, deadline_s=None):
         """Graceful drain — the zero-loss half of a rolling restart.
 
         Flips the engine to DRAINING: new submits shed with
@@ -1070,16 +1074,34 @@ class LLMEngine:
 
         Joinable: blocks until the engine is empty and returns True, or
         returns False when ``timeout`` (seconds, monotonic) elapses first
-        or the pump dies/stops mid-drain.  With a live background pump the
-        wait just sleeps; a caller-pumped (never-started) engine is pumped
-        here via step()."""
+        or the pump dies/stops mid-drain — ``timeout`` gives up WITHOUT
+        touching the remaining work (it keeps running).
+
+        ``deadline_s`` is the HARD bound a supervisor-driven SIGTERM
+        drain needs: when it expires, every request still queued or in
+        flight is failed with ``DeadlineExceededError`` (never silently
+        dropped — each is counted on ``llm_drain_expired_total`` and its
+        future resolves with the error) and drain returns True with the
+        engine EMPTY, so shutdown can always proceed."""
         self._draining = True
         _M_DRAINING.set(1.0)
         _flight.record_event("drain_begin",
                              queue_depth=self._pending.qsize())
         deadline = None if timeout is None \
             else self._clock() + float(timeout)
+        hard = None if deadline_s is None \
+            else self._clock() + float(deadline_s)
         while not self._drained():
+            if hard is not None and self._clock() >= hard:
+                # deadline expired: fail the remainder LOUDLY and finish
+                # the drain — a wedged request must not wedge shutdown.
+                # _fail_pending serializes on the engine lock, so a live
+                # pump mid-step finishes its step first.
+                n = self._fail_pending(DeadlineExceededError(
+                    f"drain deadline ({deadline_s}s) expired"))
+                _M_DRAIN_EXPIRED.inc(n)
+                _flight.record_event("drain_expired", failed=n)
+                break
             if self._pump_error is not None or self._stop:
                 return False
             if deadline is not None and self._clock() > deadline:
@@ -1130,7 +1152,8 @@ class LLMEngine:
 
     def _drain_queue(self, exc):
         """Fail every QUEUED request (the queue has its own mutex — safe
-        without the engine lock)."""
+        without the engine lock).  Returns how many were failed."""
+        n = 0
         while not self._pending.empty():
             try:
                 req = self._pending.get_nowait()
@@ -1138,14 +1161,17 @@ class LLMEngine:
                 break
             _fail_future(req.future, exc)
             self._end_trace(req, "error", error=repr(exc))
+            n += 1
+        return n
 
     def _fail_pending(self, exc):
         """Fail every queued and in-flight request with `exc`.  Takes the
         engine lock: a caller thread pumping run_until_complete must not
         race the dying background pump on the slot table (step() released
-        the lock when its exception unwound)."""
+        the lock when its exception unwound).  Returns how many requests
+        were failed."""
         with self._lock:
-            self._drain_queue(exc)
+            n = self._drain_queue(exc)
             if self._prefilling is not None:
                 req, slot, _ = self._prefilling
                 self._prefilling = None
@@ -1153,6 +1179,7 @@ class LLMEngine:
                 self._release_adapter(req)
                 _fail_future(req.future, exc)
                 self._end_trace(req, "error", error=repr(exc))
+                n += 1
             for i, req in enumerate(self.slot_req):
                 if req is not None:
                     self.slot_req[i] = None
@@ -1161,6 +1188,8 @@ class LLMEngine:
                     self._release_adapter(req)
                     _fail_future(req.future, exc)
                     self._end_trace(req, "error", error=repr(exc))
+                    n += 1
+            return n
 
     # --------------------------------------------------- request tracing
 

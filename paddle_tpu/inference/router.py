@@ -82,6 +82,11 @@ _M_AFF_MISSES = _obs.counter(
 _M_RETRIES = _obs.counter(
     "router_retries_total",
     "Un-accepted requests re-routed to the next replica")
+_M_REPLICA_LOST = _obs.counter(
+    "router_replica_lost_total",
+    "Accepted requests re-routed after the supervisor's death witness "
+    "confirmed the admitted replica process died (retry-safe: the dead "
+    "incarnation can never deliver)")
 _M_SHED_R = _obs.counter(
     "router_requests_shed_total",
     "Requests shed by the router (no routable replica / fleet saturated)")
@@ -387,6 +392,12 @@ class _ReplicaState:
                 "restarts": len(self.restart_marks)}
 
 
+class _ReplicaLost(Exception):
+    """Internal: the death witness CONFIRMED the process serving an
+    accepted request died — retry-safe despite admission (the dead
+    incarnation can never deliver), so request() re-routes it."""
+
+
 class Router:
     """Prefix-affinity-first HTTP router over N engine replicas.
 
@@ -430,15 +441,9 @@ class Router:
         self._clock = clock
         self._tracer = tracer if tracer is not None else _tracing.TRACER
         self._replicas: "OrderedDict[str, _ReplicaState]" = OrderedDict()
+        self._witness = None  # supervisor death witness (set_process_witness)
         for rep in replicas:
-            if isinstance(rep, ReplicaServer):
-                name, target = rep.name, rep.target()
-            elif isinstance(rep, tuple):
-                name, target = rep
-            else:
-                name, target = None, str(rep)
-            host, _, port = str(target).rpartition(":")
-            name = str(name) if name else f"{host}:{port}"
+            name, host, port = self._parse_replica(rep)
             if name in self._replicas:
                 raise ValueError(f"duplicate replica name {name!r}")
             self._replicas[name] = _ReplicaState(name, host, port)
@@ -474,14 +479,32 @@ class Router:
             self.telemetry.start()
 
     # ------------------------------------------------------------ fleet view
+    @staticmethod
+    def _parse_replica(rep):
+        """ReplicaServer | (name, "host:port") | "host:port" ->
+        (name, host, port)."""
+        if isinstance(rep, ReplicaServer):
+            name, target = rep.name, rep.target()
+        elif isinstance(rep, tuple):
+            name, target = rep
+        else:
+            name, target = None, str(rep)
+        host, _, port = str(target).rpartition(":")
+        return (str(name) if name else f"{host}:{port}"), host, port
+
+    def _snapshot(self):
+        with self._lock:
+            return list(self._replicas.values())
+
     def _check_fleet(self):
-        n = sum(r.routable for r in self._replicas.values())
+        reps = self._snapshot()
+        n = sum(r.routable for r in reps)
         if n == 0:
             return False, "no routable replica"
-        return True, f"{n}/{len(self._replicas)} replicas routable"
+        return True, f"{n}/{len(reps)} replicas routable"
 
     def replicas(self):
-        return list(self._replicas.values())
+        return self._snapshot()
 
     def quarantine(self, name, on=True):
         rep = self._replicas[str(name)]
@@ -491,8 +514,50 @@ class Router:
         self._publish_up()
         return rep
 
+    def set_process_witness(self, fn):
+        """Install the supervisor's death witness: ``fn(name)`` returns
+        the live incarnation number serving ``name`` or None when no
+        live process exists.  With a witness installed, an ACCEPTED
+        request whose replica's incarnation changed (or vanished) is
+        re-routed instead of failed — process death is proof the admitted
+        work can never be delivered, so the retry cannot double-deliver."""
+        self._witness = fn
+        return self
+
+    def add_replica(self, replica):
+        """Add one replica to the live rotation (same accepted forms as
+        the constructor).  Router view and scrape-target list update
+        atomically with respect to placement/poll — both swap under the
+        router lock / by list snapshot."""
+        from ..observability.scrape import ScrapeTarget
+
+        name, host, port = self._parse_replica(replica)
+        state = _ReplicaState(name, host, port)
+        with self._lock:
+            if name in self._replicas:
+                raise ValueError(f"duplicate replica name {name!r}")
+            self._replicas[name] = state
+        self.scraper.add_target(ScrapeTarget(f"{host}:{port}", name=name))
+        self._publish_up()
+        _flight.record_event("router_replica_added", replica=name)
+        return state
+
+    def remove_replica(self, name):
+        """Drop one replica from rotation: placement stops immediately,
+        its scrape target and affinity entries go with it."""
+        name = str(name)
+        with self._lock:
+            rep = self._replicas.pop(name, None)
+        if rep is None:
+            return None
+        self.scraper.remove_target(name)
+        self.affinity.drop_replica(name)
+        _M_REPLICA_UP.labels(replica=name).set(0.0)
+        _flight.record_event("router_replica_removed", replica=name)
+        return rep
+
     def _publish_up(self):
-        for r in self._replicas.values():
+        for r in self._snapshot():
             _M_REPLICA_UP.labels(replica=r.name).set(
                 1.0 if r.routable else 0.0)
 
@@ -571,7 +636,7 @@ class Router:
         not reusable across adapters."""
         key = prefix_key(prompt_ids, self.ps, blocks=self.affinity_blocks,
                          adapter_id=adapter_id)
-        routable = [r for r in self._replicas.values() if r.routable]
+        routable = [r for r in self._snapshot() if r.routable]
         aff_name = self.affinity.get(key)
         first = None
         hit = False
@@ -651,6 +716,7 @@ class Router:
                     self._retries += 1
                 trace.inc_attr("retries")
             body["timeout"] = round(hop_budget, 3)
+            inc0 = self._witness_of(rep)  # pre-admit incarnation capture
             with trace.span("admit", replica=rep.name,
                             attempt=attempt) as sp:
                 verdict, doc = self._admit_on(rep, body, hop_budget)
@@ -662,9 +728,30 @@ class Router:
                     self._overhead_s += overhead
                     self._overhead_n += 1
                 self.affinity.record(key, rep.name)
-                return self._await_result(rep, req_id, trace, t0,
-                                          deadline, doc)
+                try:
+                    return self._await_result(rep, req_id, trace, t0,
+                                              deadline, doc,
+                                              self._witness_of(rep))
+                except _ReplicaLost as e:
+                    # the admitted process DIED (witnessed): it can never
+                    # deliver, so re-routing cannot double-deliver
+                    last_err = str(e)
+                    _M_REPLICA_LOST.inc()
+                    rep.up = False
+                    self.affinity.drop_replica(rep.name)
+                    self._publish_up()
+                    _flight.record_event("router_replica_lost",
+                                         replica=rep.name, req_id=req_id)
+                    req_id = uuid.uuid4().hex
+                    body["req_id"] = req_id
+                    continue
             last_err = doc.get("error")
+            if verdict == "dead" and self._confirm_lost(rep, inc0):
+                # cancel probe unreachable, but the witness confirms the
+                # admit-time process is gone — nothing alive holds the
+                # request, so it is retry-safe after all
+                verdict = "down"
+                last_err = f"{last_err}; process death witnessed"
             if verdict == "down":
                 rep.up = False
                 self.affinity.drop_replica(rep.name)
@@ -712,6 +799,44 @@ class Router:
             return ("draining" if doc.get("draining") else "shed"), doc
         return "rejected", doc
 
+    def _witness_of(self, rep):
+        """Current live incarnation of ``rep`` per the death witness
+        (None = witness absent OR no live process)."""
+        if self._witness is None:
+            return None
+        try:
+            return self._witness(rep.name)
+        except Exception:
+            return None
+
+    def _process_lost(self, rep, inc0):
+        """True when the death witness CONFIRMS the process observed at
+        ``inc0`` is gone (died or was respawned since).  Without a
+        witness this is always False — the conservative pre-supervisor
+        behavior."""
+        if self._witness is None:
+            return False
+        try:
+            inc = self._witness(rep.name)
+        except Exception:
+            return False
+        return inc is None or inc != inc0
+
+    def _confirm_lost(self, rep, inc0, wait_s=1.0):
+        """_process_lost with a short confirm window: a SIGKILLed child
+        is not waitable by the supervisor for a few milliseconds, so the
+        witness can lag the wire failure that got us here.  Only the
+        already-terminal dead-verdict path pays the wait, and only when
+        the witness keeps vouching for a process whose socket just
+        vanished."""
+        deadline = self._clock() + wait_s
+        while True:
+            if self._process_lost(rep, inc0):
+                return True
+            if self._clock() >= deadline:
+                return False
+            time.sleep(0.02)
+
     def _recover(self, rep, req_id, exc):
         """Post-stall/reset classification via /cancelz (fresh
         connection): cancel won -> retry-safe ("shed"); cancel lost ->
@@ -729,10 +854,13 @@ class Router:
             return "shed", {"error": f"{exc!r}; cancelled un-admitted"}
         return "accepted", {"recovered": True}
 
-    def _await_result(self, rep, req_id, trace, t0, deadline, admit_doc):
+    def _await_result(self, rep, req_id, trace, t0, deadline, admit_doc,
+                      inc0=None):
         """Poll the accepted request to completion on ``rep``.  The
         request is past its admission ack, so errors here are terminal —
-        never retried on another replica."""
+        EXCEPT witnessed process death (``inc0`` is the admit-time
+        incarnation): a dead process can never deliver, so
+        ``_ReplicaLost`` unwinds to request() for a safe re-route."""
         with trace.span("replica_execute", replica=rep.name) as sp:
             probe_failures = 0
             while True:
@@ -752,11 +880,21 @@ class Router:
                 except Exception as e:
                     # admitted work: keep polling on fresh connections
                     # until the deadline — transient socket faults must
-                    # not lose a request that is still decoding
+                    # not lose a request that is still decoding.  A
+                    # witnessed process death is NOT transient: bail out
+                    # for a retry-safe re-route.
+                    if self._process_lost(rep, inc0):
+                        raise _ReplicaLost(
+                            f"replica {rep.name} process died awaiting "
+                            f"{req_id}: {e!r}")
                     probe_failures += 1
                     sp.set_attr("poll_failures", probe_failures)
                     continue
                 if status == 404:
+                    if self._process_lost(rep, inc0):
+                        raise _ReplicaLost(
+                            f"replica {rep.name} restarted and forgot "
+                            f"accepted request {req_id}")
                     self._finish(trace, "error", t0, rep)
                     raise ServerOverloadedError(
                         f"replica {rep.name} forgot accepted request "
@@ -809,7 +947,7 @@ class Router:
             ov_s, ov_n = self._overhead_s, self._overhead_n
         total = hits + misses
         replicas = []
-        for r in self._replicas.values():
+        for r in self._snapshot():
             d = r.to_dict()
             # Profiling-plane enrichment (PR 14): both keys stay absent
             # when the replica exports neither family, so /routerz

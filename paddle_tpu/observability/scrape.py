@@ -483,6 +483,27 @@ class Scraper:
         self._last_ok: dict[str, float] = {}   # target name -> mono stamp
         self._started = self._clock()
 
+    def add_target(self, target):
+        """Add one target to the live rotation.  The target list is
+        swapped atomically (rebuilt, never mutated in place), so a
+        concurrent ``poll()`` keeps iterating its own snapshot."""
+        t = target if isinstance(target, ScrapeTarget) else ScrapeTarget(
+            target)
+        if any(x.name == t.name for x in self.targets):
+            raise ValueError(f"duplicate target name {t.name!r}")
+        self.targets = self.targets + [t]
+        # a just-added target has never answered: date its staleness from
+        # now, not from scraper construction
+        self._last_ok.setdefault(t.name, self._clock())
+        return t
+
+    def remove_target(self, name):
+        """Drop a target by name (atomic list swap; unknown names are a
+        no-op so remove is idempotent under supervisor churn)."""
+        name = str(name)
+        self.targets = [t for t in self.targets if t.name != name]
+        self._last_ok.pop(name, None)
+
     # ------------------------------------------------------------ one target
     def _fetch(self, target, path, deadline):
         remaining = deadline - self._clock()
@@ -566,6 +587,8 @@ class Scraper:
         results: dict[str, ScrapeResult] = {}
         abandoned: set[str] = set()
         lock = threading.Lock()
+        targets = self.targets  # snapshot: membership swaps mid-poll are
+        #                         someone else's poll
 
         def worker(t):
             r = self.scrape_one(t, defer_publish=True)
@@ -576,7 +599,7 @@ class Scraper:
 
         threads = [threading.Thread(target=worker, args=(t,), daemon=True,
                                     name=f"scrape-{t.name}")
-                   for t in self.targets]
+                   for t in targets]
         deadline = self._clock() + self.timeout_s + 0.25
         for th in threads:
             th.start()
@@ -585,7 +608,7 @@ class Scraper:
         now = self._clock()
         samples = SampleSet()
         out = []
-        for t in self.targets:
+        for t in targets:
             with lock:
                 r = results.get(t.name)
                 if r is None:

@@ -18,6 +18,16 @@ run reproduces exactly.
   (recv times out), or ``reset`` (peer reset mid-exchange).
 - ``preemption_schedule`` — raises ``Preemption`` the first time each listed
   step index is reached (the signal ``run_with_recovery`` heals from).
+- ``ProcFaults`` — PROCESS-level faults for the multi-process serving
+  fleet: a replica subprocess (``inference/replica_main.py``) loads a
+  fault spec from its environment (or has one armed at runtime via its
+  ``/faultz`` endpoint) and consults it at the same call-count-keyed
+  seams: SIGKILL itself before answering the Nth ``/admitz`` or
+  ``/pollz`` (kill -9 mid-request), wedge its SIGTERM drain (forcing the
+  supervisor's SIGKILL escalation), delay readiness past the gate, or
+  exit immediately at startup (a crash-looping replica).  ``sigstop`` /
+  ``sigcont`` wrap the wedge where the process stays ALIVE but stops
+  answering — `/healthz` stalls while the listening socket stays open.
 """
 from __future__ import annotations
 
@@ -25,14 +35,17 @@ import builtins
 import errno as _errno
 import fnmatch
 import io
+import json as _json
 import os
+import signal as _signal
 import socket as _socket
 
 from ..distributed.fault_tolerance import Preemption
 
 __all__ = [
     "InjectedFault", "TornWrite", "Preemption", "FaultyFS", "SocketFaults",
-    "flip_bit", "preemption_schedule",
+    "flip_bit", "preemption_schedule", "ProcFaults", "PROC_FAULTS_ENV",
+    "proc_fault_env", "load_proc_faults", "sigstop", "sigcont",
 ]
 
 
@@ -259,3 +272,118 @@ class SocketFaults:
     def __exit__(self, *exc):
         _socket.create_connection = self._real
         return False
+
+
+# ------------------------------------------------------------ process faults
+#: Environment variable carrying the JSON fault spec into a replica
+#: subprocess — set by the supervisor at spawn (per incarnation), read by
+#: ``replica_main`` before it builds anything heavy.
+PROC_FAULTS_ENV = "PADDLE_TPU_PROC_FAULTS"
+
+
+class ProcFaults:
+    """Deterministic process-level fault schedule for ONE replica process.
+
+    The spec is a plain dict (JSON-serializable so it crosses the exec
+    boundary via :data:`PROC_FAULTS_ENV`); all counters are call-count
+    keyed within the process — no wall clock, no RNG:
+
+    - ``kill_at_admit: n`` — SIGKILL this process immediately BEFORE
+      answering its ``n``-th ``/admitz`` (0-based): the router's admit
+      connection dies mid-exchange with nothing delivered — the real
+      kill -9 mid-request.
+    - ``kill_at_poll: n`` — SIGKILL before answering the ``n``-th
+      ``/pollz``: the request was admitted (ack delivered) but the
+      process dies before any result can be fetched.
+    - ``wedge_drain: true`` — the SIGTERM drain handler never finishes
+      (sleeps forever instead of draining), forcing the supervisor's
+      SIGKILL escalation on its deadline.
+    - ``slow_start_s: x`` — sleep ``x`` seconds before binding the
+      telemetry port, delaying readiness past the supervisor's gate.
+    - ``exit_at_start: true`` — exit(3) before serving anything: the
+      crash-looping replica a restart-storm quarantine must bench.
+
+    ``on_admit()`` / ``on_poll()`` are invoked by the replica entrypoint
+    inside its endpoint wrappers; ``arm()`` merges a new spec at runtime
+    (the ``/faultz`` seam — a test can arm the NEXT fault on a live
+    fleet without respawning it).
+    """
+
+    def __init__(self, spec=None):
+        self.spec = dict(spec or {})
+        self.admits = 0
+        self.polls = 0
+
+    # -- schedule queries -------------------------------------------------
+    @property
+    def exit_at_start(self):
+        return bool(self.spec.get("exit_at_start"))
+
+    @property
+    def slow_start_s(self):
+        return float(self.spec.get("slow_start_s", 0.0))
+
+    @property
+    def wedge_drain(self):
+        return bool(self.spec.get("wedge_drain"))
+
+    def arm(self, spec):
+        """Merge ``spec`` into the live schedule (counters keep running —
+        a ``kill_at_admit`` armed mid-flight keys off the SAME admit
+        counter the process has been advancing since birth)."""
+        self.spec.update(spec or {})
+        return dict(self.spec)
+
+    # -- seams called by replica_main ------------------------------------
+    def _kill_self(self):
+        os.kill(os.getpid(), _signal.SIGKILL)
+
+    def on_admit(self):
+        """Call-counted /admitz seam: dies BEFORE the reply when armed."""
+        idx = self.admits
+        self.admits += 1
+        if self.spec.get("kill_at_admit") == idx:
+            self._kill_self()
+
+    def on_poll(self):
+        """Call-counted /pollz seam: dies BEFORE the reply when armed."""
+        idx = self.polls
+        self.polls += 1
+        if self.spec.get("kill_at_poll") == idx:
+            self._kill_self()
+
+
+def proc_fault_env(spec, env=None):
+    """Return a copy of ``env`` (default ``os.environ``) with the fault
+    spec serialized into :data:`PROC_FAULTS_ENV` — what a supervisor
+    passes to ``subprocess.Popen`` to arm faults for ONE incarnation."""
+    out = dict(os.environ if env is None else env)
+    if spec:
+        out[PROC_FAULTS_ENV] = _json.dumps(spec)
+    else:
+        out.pop(PROC_FAULTS_ENV, None)
+    return out
+
+
+def load_proc_faults(environ=None):
+    """Parse :data:`PROC_FAULTS_ENV` into a :class:`ProcFaults` (empty
+    schedule when unset/corrupt — a replica never refuses to start over
+    a bad fault spec; the faults are the test harness, not the product)."""
+    raw = (os.environ if environ is None else environ).get(PROC_FAULTS_ENV)
+    if not raw:
+        return ProcFaults()
+    try:
+        return ProcFaults(_json.loads(raw))
+    except (ValueError, TypeError):
+        return ProcFaults()
+
+
+def sigstop(pid):
+    """Freeze a process (SIGSTOP): its sockets stay OPEN but nothing
+    answers — the wedge that distinguishes 'dead' from 'unresponsive'."""
+    os.kill(int(pid), _signal.SIGSTOP)
+
+
+def sigcont(pid):
+    """Thaw a SIGSTOPped process."""
+    os.kill(int(pid), _signal.SIGCONT)

@@ -150,3 +150,61 @@ def test_elastic_hold_below_min_np():
     assert m.enabled
     assert not m.wait_for_np(timeout=0.3)
     m.exit()
+
+
+# -------------------------------------------------------- run() restart body
+def test_elastic_run_restart_body_recovers(tmp_path):
+    """ElasticManager.run is the restart body: a firing alert arms
+    check()==RESTART mid-run, the step loop raises AlertRestart, and
+    run_with_recovery restores the last checkpoint and replays to a
+    bitwise-correct finish.  Clocks injected end to end — no wall-time
+    dependence."""
+    import jax.numpy as jnp
+
+    from paddle_tpu.distributed import checkpoint as ckpt
+    from paddle_tpu.observability import alerts as obs_alerts
+    from paddle_tpu.observability import scrape as obs_scrape
+
+    t = [0.0]
+    eng = obs_alerts.AlertEngine(
+        rules=[obs_alerts.Rule("rep_unhealthy", metric="healthcheck_ok",
+                               op="<", threshold=1.0, for_s=0.0)],
+        clock=lambda: t[0])
+    pol = obs_alerts.AlertPolicy({"rep_unhealthy": "restart"},
+                                 engine=eng, clock=lambda: t[0])
+    em = ElasticManager(np="1:3", heartbeat_interval=0.05,
+                        alert_policy=pol)
+
+    polls = []
+
+    def samples_fn():
+        t[0] += 1.0  # the injected clock advances once per check
+        polls.append(t[0])
+        s = obs_scrape.SampleSet()
+        # poll #3 reports the wedge; every other poll is healthy
+        s.add("healthcheck_ok", {"host": "h1"},
+              0.0 if len(polls) == 3 else 1.0)
+        return s
+
+    rng = np.random.default_rng(5)
+    xs = [rng.standard_normal(4).astype(np.float32) for _ in range(6)]
+    w0 = jnp.zeros(4, jnp.float32)
+    ref = w0
+    for x in xs:
+        ref = ref * np.float32(0.9) + jnp.asarray(x)
+
+    box = {"w": w0}
+    executed = []
+
+    def step_fn(i):
+        executed.append(i)
+        box["w"] = box["w"] * np.float32(0.9) + jnp.asarray(xs[i])
+
+    cm = ckpt.CheckpointManager(str(tmp_path), keep=3, save_interval=2)
+    report = em.run(step_fn, 6, cm, samples_fn=samples_fn,
+                    get_state=lambda: {"w": box["w"]},
+                    set_state=lambda s: box.__setitem__("w", s["w"]))
+    assert report == {"completed": 6, "restarts": 1}
+    assert em.check() != ElasticStatus.RESTART  # decision was consumed
+    assert len(executed) > 6  # the interrupted step really replayed
+    assert np.asarray(box["w"]).tobytes() == np.asarray(ref).tobytes()

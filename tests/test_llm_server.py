@@ -561,3 +561,33 @@ def test_engine_with_gpt_family():
         ids = paddle.to_tensor(np.asarray(p, np.int32)[None, :])
         want = list(np.asarray(gpt.generate(ids, max_new_tokens=6)._value)[0])
         assert f.result(timeout=1) == want
+
+
+def test_drain_deadline_fails_remainder_loudly(model):
+    """drain(deadline_s=) is the bounded SIGTERM drain: when it expires,
+    everything still queued/in flight fails with DeadlineExceededError
+    (counted, never silently dropped) and the engine ends EMPTY so
+    shutdown can proceed."""
+    from paddle_tpu.inference import llm_server as ls
+
+    rng = np.random.RandomState(9)
+    eng = LLMEngine(model, max_batch_slots=1, max_seq_len=128)
+    f_run = eng.submit(rng.randint(0, 1024, 8).astype(np.int32),
+                       max_new_tokens=4)
+    eng.step()  # admitted into the only slot, mid-decode
+    f_queued = eng.submit(rng.randint(0, 1024, 8).astype(np.int32),
+                          max_new_tokens=4)
+    before = ls._M_DRAIN_EXPIRED.value
+    assert eng.drain(deadline_s=0.0) is True  # expires immediately
+    for f in (f_run, f_queued):
+        with pytest.raises(ls.DeadlineExceededError):
+            f.result(timeout=1)
+    assert ls._M_DRAIN_EXPIRED.value == before + 2
+    assert eng.slot_req == [None] and eng._pending.empty()  # truly empty
+    # a deadline that is NOT hit behaves like the plain join
+    eng.resume()
+    f_ok = eng.submit(rng.randint(0, 1024, 8).astype(np.int32),
+                      max_new_tokens=2)
+    assert eng.drain(deadline_s=60.0) is True
+    assert len(f_ok.result(timeout=1)) == 2
+    assert ls._M_DRAIN_EXPIRED.value == before + 2  # no new expiries

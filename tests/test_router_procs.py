@@ -1,0 +1,252 @@
+"""Process-level fleet chaos (inference/fleet_supervisor.py + replica_main).
+
+Every replica here is a REAL subprocess (`python -m
+paddle_tpu.inference.replica_main`) spawned by a ReplicaSupervisor; the
+router reaches it over real HTTP.  The chaos gates are deterministic:
+kills are keyed to call counts (ProcFaults seams), never wall-clock
+races — a kill at "admit #1" lands at exactly the same wire event every
+run.  The stub engine (a no-JAX deterministic token oracle behind the
+identical wire protocol) keeps the suite CPU-cheap; one tiny-Llama gate
+proves the same retry-safety story with a real engine.
+
+Oracle for zero double-delivery: replicas share a seed, so the SAME
+prompt must yield the SAME tokens from ANY replica — a request whose
+first home was SIGKILLed mid-flight must come back with exactly the
+tokens a healthy fleet returns, exactly once.
+"""
+import os
+import signal as _sig
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.fault_tolerance import ExponentialBackoff
+from paddle_tpu.inference import router as router_mod
+from paddle_tpu.inference.fleet_supervisor import ReplicaSupervisor
+from paddle_tpu.inference.prefix_cache import prefix_key
+from paddle_tpu.inference.router import FleetController, Router
+from paddle_tpu.testing import faults as faults_mod
+
+pytestmark = pytest.mark.faults
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PAGE = 16
+BLOCKS = 4
+
+
+def _mk_fleet(count=2, model="stub", **kw):
+    """Supervisor + router + controller over real replica subprocesses,
+    tuned for test latency (fast backoff, tight drain bounds)."""
+    kw.setdefault("backoff",
+                  ExponentialBackoff(base=0.05, factor=2.0,
+                                     max_delay=0.25, jitter=0.0))
+    kw.setdefault("drain_deadline_s", 2.0)
+    kw.setdefault("term_grace_s", 2.0)
+    sup = ReplicaSupervisor(count=count, model=model, page_size=PAGE,
+                            faults_enabled=True, **kw)
+    sup.start()
+    assert sup.ready(), [r.to_dict() for r in sup.replicas()]
+    router = Router(sup.targets(), page_size=PAGE,
+                    affinity_blocks=BLOCKS, metrics_port=None)
+    sup.attach(router)
+    controller = FleetController(router, restart_hook=sup.restart_replica)
+    return sup, router, controller
+
+
+def _teardown(sup, router):
+    try:
+        router.stop()
+    finally:
+        sup.stop()
+
+
+def _prompt(seed=11, n=24):
+    return np.random.RandomState(seed).randint(0, 1024, n).astype(np.int32)
+
+
+def _affine(sup, router, prompt):
+    """The replica the affinity table pinned ``prompt`` to."""
+    name = router.affinity.get(prefix_key(prompt, PAGE, blocks=BLOCKS))
+    assert name is not None, "no affinity recorded"
+    return sup.get(name)
+
+
+def _wait_respawn(sup, rep, old_pid, timeout=60.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        sup.tick()
+        if rep.state == "ready" and rep.pid != old_pid:
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"no respawn: {rep.to_dict()}")
+
+
+# ------------------------------------------------------------- chaos gates
+
+def test_kill9_mid_admit_is_retry_safe():
+    """SIGKILL at the exact admit call: the process dies BEFORE acking,
+    the death witness proves it, and the request lands on the sibling
+    with identical tokens — zero loss, zero double-delivery."""
+    sup, router, _ = _mk_fleet()
+    try:
+        prompt = _prompt()
+        toks0 = router.request(prompt, max_new_tokens=4)
+        victim = _affine(sup, router, prompt)
+        counters = sup.arm_fault(victim.name, {})
+        sup.arm_fault(victim.name,
+                      {"kill_at_admit": counters["admits"]})
+        toks1 = router.request(prompt, max_new_tokens=4)
+        assert toks1 == toks0
+        assert not victim.alive()
+    finally:
+        _teardown(sup, router)
+
+
+def test_kill9_mid_poll_reroutes_accepted_request():
+    """SIGKILL after the admit ack, at the poll: the request was ACCEPTED
+    by the dead process, so only the incarnation witness makes the retry
+    safe — the router re-issues under a FRESH req_id on a sibling and
+    counts router_replica_lost_total."""
+    sup, router, _ = _mk_fleet()
+    try:
+        prompt = _prompt()
+        toks0 = router.request(prompt, max_new_tokens=4)
+        victim = _affine(sup, router, prompt)
+        lost0 = router_mod._M_REPLICA_LOST.value
+        counters = sup.arm_fault(victim.name, {})
+        sup.arm_fault(victim.name, {"kill_at_poll": counters["polls"]})
+        toks1 = router.request(prompt, max_new_tokens=4)
+        assert toks1 == toks0
+        assert not victim.alive()
+        assert router_mod._M_REPLICA_LOST.value == lost0 + 1
+    finally:
+        _teardown(sup, router)
+
+
+def test_sigstop_wedge_is_downmarked_then_restarted():
+    """A SIGSTOPped child answers nothing: the supervisor's liveness
+    probe accrues unhealthy time, SIGKILLs the wedge, and a fresh
+    incarnation replaces it."""
+    sup, router, _ = _mk_fleet(unhealthy_after_s=0.3, probe_timeout_s=0.2)
+    try:
+        prompt = _prompt()
+        toks0 = router.request(prompt, max_new_tokens=4)
+        victim = _affine(sup, router, prompt)
+        pid0, inc0 = victim.pid, victim.incarnation
+        faults_mod.sigstop(pid0)
+        _wait_respawn(sup, victim, pid0)
+        assert victim.incarnation == inc0 + 1
+        router.poll()
+        assert router.request(prompt, max_new_tokens=4) == toks0
+    finally:
+        _teardown(sup, router)
+
+
+def test_restart_storm_quarantines_and_drops_affinity():
+    """A replica that dies on every spawn blows its flap budget: the
+    supervisor quarantines it (no more respawns), the router drops its
+    affinity entries, and the fleet keeps serving on the sibling."""
+    sup, router, _ = _mk_fleet(restart_limit=1, restart_window_s=600.0)
+    try:
+        prompt = _prompt()
+        toks0 = router.request(prompt, max_new_tokens=4)
+        victim = _affine(sup, router, prompt)
+        sup.set_fault(victim.name, {"exit_at_start": True})
+        os.kill(victim.pid, _sig.SIGKILL)
+        deadline = time.monotonic() + 60
+        while victim.state != "quarantined":
+            assert time.monotonic() < deadline, victim.to_dict()
+            sup.tick()
+            time.sleep(0.05)
+        key = prefix_key(prompt, PAGE, blocks=BLOCKS)
+        assert router.affinity.get(key) != victim.name
+        router.poll()
+        assert router.request(prompt, max_new_tokens=4) == toks0
+        states = {r["name"]: r["state"]
+                  for r in router.routerz()["replicas"]}
+        assert states[victim.name] == "quarantined", states
+        # quarantine is terminal for tick(): no further respawns
+        sup.tick()
+        assert victim.state == "quarantined" and not victim.alive()
+    finally:
+        _teardown(sup, router)
+
+
+def test_scale_signals_spawn_and_reap_processes():
+    """+1 spawns a real process into rotation (scrape target, routable);
+    -1 reaps the newest one cleanly — no SIGKILL escalation."""
+    sup, router, _ = _mk_fleet()
+    try:
+        name = sup.apply_scale(+1)
+        assert name is not None and sup.get(name).state == "ready"
+        assert any(r["name"] == name
+                   for r in router.routerz()["replicas"])
+        assert name in [t.name for t in router.scraper.targets]
+        pid = sup.get(name).pid
+        reaped = sup.apply_scale(-1)
+        assert reaped == name  # LIFO: newest first out
+        assert sup.get(name).state == "stopped"
+        assert all(r["name"] != name
+                   for r in router.routerz()["replicas"])
+        assert sup.escalations == 0
+        with pytest.raises(OSError):
+            os.kill(pid, 0)  # really gone
+    finally:
+        _teardown(sup, router)
+
+
+def test_crash_during_drain_escalates_to_sigkill():
+    """wedge_drain turns SIGTERM shutdown into a hang: the supervisor
+    must SIGKILL exactly on deadline expiry and count the escalation."""
+    sup, router, _ = _mk_fleet(drain_deadline_s=0.3, term_grace_s=0.3)
+    try:
+        victim = sup.replicas()[0]
+        sup.arm_fault(victim.name, {"wedge_drain": True})
+        t0 = time.monotonic()
+        esc = sup.stop()
+        waited = time.monotonic() - t0
+        assert esc == 1, f"expected exactly one escalation, got {esc}"
+        assert waited >= 0.6 - 0.05, \
+            f"SIGKILL before the deadline ({waited:.2f}s)"
+        assert all(not r.alive() for r in sup.replicas())
+    finally:
+        router.stop()
+
+
+def test_kill9_mid_stream_tiny_engine_no_double_delivery():
+    """The real-engine gate: tiny-Llama replicas, SIGKILL keyed to the
+    poll AFTER the admit ack — the accepted request is re-issued on the
+    sibling and matches the healthy-fleet tokens exactly once."""
+    sup, router, _ = _mk_fleet(model="tiny", slots=2, max_seq_len=128)
+    try:
+        prompt = _prompt(seed=3, n=20)
+        toks0 = router.request(prompt, max_new_tokens=3)
+        assert len(toks0) == 3
+        victim = _affine(sup, router, prompt)
+        lost0 = router_mod._M_REPLICA_LOST.value
+        counters = sup.arm_fault(victim.name, {})
+        sup.arm_fault(victim.name, {"kill_at_poll": counters["polls"]})
+        toks1 = router.request(prompt, max_new_tokens=3)
+        assert toks1 == toks0
+        assert not victim.alive()
+        assert router_mod._M_REPLICA_LOST.value == lost0 + 1
+    finally:
+        _teardown(sup, router)
+
+
+# --------------------------------------------------------------- CLI smoke
+
+def test_fleetserve_procs_selftest():
+    """`fleetserve --procs --selftest` end-to-end in its own interpreter:
+    spawn 2 -> kill 1 -> witness retry -> respawn -> scale-up -> clean
+    zero-escalation shutdown."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "fleetserve.py"),
+         "--procs", "--selftest", "--model", "stub"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "fleetserve --procs selftest: ok" in proc.stdout

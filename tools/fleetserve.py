@@ -10,6 +10,9 @@ Usage::
         [--affinity-blocks 4] [--controller-interval 5.0]
         [--iterations N]
     python tools/fleetserve.py --selftest
+    python tools/fleetserve.py --procs [--model tiny|stub]
+        [--drain-deadline 5.0]
+    python tools/fleetserve.py --procs --selftest
 
 Starts ``--replicas`` tiny-model ``LLMEngine`` replicas (each on its own
 ephemeral telemetry+data port), wires a ``Router`` over them (its own
@@ -25,6 +28,18 @@ The tiny Llama keeps this runnable on a laptop CPU; production fleets
 replace the in-process replicas with real engine processes and pass
 ``(name, "host:port")`` pairs to ``Router`` — everything else (affinity,
 drain, retry-safety, controller) is identical.
+
+``--procs`` IS that production shape, locally: a ``ReplicaSupervisor``
+spawns each replica as a real ``python -m
+paddle_tpu.inference.replica_main`` subprocess (its own interpreter, its
+own telemetry port), gates rotation entry on ``/healthz``, restarts
+crashed children with jittered exponential backoff, quarantines
+flappers, and actuates the controller's scale signals by actually
+spawning/reaping processes.  The supervisor also serves ``/procz`` on
+the router port and acts as the router's death witness, so a replica
+dying mid-request is retried on a sibling with zero double-delivery.
+``--model stub`` swaps the tiny Llama for a deterministic no-JAX token
+oracle — same wire protocol, seconds-fast spawns — for drills and CI.
 
 ``--selftest`` runs a deterministic smoke: 2 replicas, a shared-prefix
 trace routed through the live wire path, asserting affinity convergence
@@ -82,6 +97,153 @@ def _stop_fleet(router, replicas):
     router.stop()
     for r in replicas:
         r.engine.stop()
+
+
+def _build_proc_fleet(args, *, faults_enabled=False):
+    """(supervisor, router, controller) over real replica subprocesses."""
+    from paddle_tpu.inference.fleet_supervisor import ReplicaSupervisor
+    from paddle_tpu.inference.router import FleetController, Router
+
+    sup = ReplicaSupervisor(
+        count=args.replicas, model=args.model, page_size=args.page_size,
+        slots=args.slots, max_seq_len=args.max_seq_len,
+        drain_deadline_s=args.drain_deadline,
+        faults_enabled=faults_enabled)
+    sup.start()
+    if not sup.ready():
+        sup.stop()
+        raise RuntimeError(
+            "fleet failed readiness: "
+            + ", ".join(f"{r.name}={r.state}" for r in sup.replicas()))
+    router = Router(sup.targets(), page_size=args.page_size,
+                    affinity_blocks=args.affinity_blocks,
+                    metrics_port=args.port)
+    sup.attach(router)
+    controller = FleetController(router, restart_hook=sup.restart_replica)
+    if router.telemetry is not None:
+        router.telemetry.register_json_endpoint(
+            "/procz", lambda q: sup.procz())
+    return sup, router, controller
+
+
+def serve_procs(args):
+    sup, router, controller = _build_proc_fleet(args)
+    print(f"router: http://{router.telemetry.host}:{router.telemetry.port}"
+          f"  (/metrics /healthz /routerz /procz /tracez)")
+    for rep in sup.replicas():
+        print(f"  {rep.name}: http://{rep.target()}  pid={rep.pid}"
+              f"  ({args.model} engine)")
+    print(f"watch:  python tools/fleetwatch.py --procz "
+          f"{router.telemetry.host}:{router.telemetry.port}")
+    ticks = 0
+    try:
+        while args.iterations <= 0 or ticks < args.iterations:
+            time.sleep(args.controller_interval)
+            acted = controller.tick()
+            sup_acted = sup.tick()
+            if acted["scale"]:
+                sup.apply_scale(acted["scale"])
+            ticks += 1
+            note = []
+            if sup_acted["respawned"]:
+                note.append(f"respawned {sup_acted['respawned']}")
+            if sup_acted["quarantined"]:
+                note.append(f"quarantined {sup_acted['quarantined']}")
+            if sup_acted["killed"]:
+                note.append(f"killed wedged {sup_acted['killed']}")
+            if acted["scale"]:
+                note.append(f"scale signal {acted['scale']:+d}")
+            state = ",".join(f"{r['name']}={r['state']}(pid {r['pid']})"
+                             for r in sup.procz()["replicas"])
+            print(f"tick {ticks}: {state}"
+                  + (f"  [{'; '.join(note)}]" if note else ""))
+    except KeyboardInterrupt:
+        pass
+    finally:
+        router.stop()
+        esc = sup.stop()
+        print(f"fleet stopped ({esc} SIGKILL escalation(s))")
+    return 0
+
+
+def selftest_procs(args):
+    """Process-fleet smoke: spawn 2 real replicas, kill one mid-rotation,
+    prove witness-backed retry + supervised respawn + scale-up entering
+    rotation + bounded zero-escalation shutdown."""
+    import signal as _sig
+
+    import numpy as np
+
+    from paddle_tpu.inference.prefix_cache import prefix_key
+
+    args.replicas = 2
+    sup, router, controller = _build_proc_fleet(args)
+    ok = False
+    try:
+        rng = np.random.RandomState(11)
+        prompt = rng.randint(0, 1024, 24).astype(np.int32)
+
+        # 1. route through real subprocesses; both replicas share the
+        #    seed, so the same prompt must yield the same tokens anywhere
+        toks0 = router.request(prompt, max_new_tokens=3)
+        assert len(toks0) == 3, toks0
+        landed = router.affinity.get(
+            prefix_key(prompt, args.page_size, blocks=args.affinity_blocks))
+        victim = sup.get(landed)
+        pid0 = victim.pid
+
+        # 2. SIGKILL the affine replica; the very next request hits the
+        #    corpse, the death witness proves the process is gone, and the
+        #    router re-routes retry-safely with identical tokens
+        os.kill(pid0, _sig.SIGKILL)
+        victim.proc.wait(timeout=30)
+        toks1 = router.request(prompt, max_new_tokens=3)
+        assert toks1 == toks0, (toks1, toks0)
+
+        # 3. the supervisor notices, backs off, respawns a fresh pid
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            sup.tick()
+            if victim.state == "ready" and victim.pid != pid0:
+                break
+            time.sleep(0.1)
+        assert victim.state == "ready" and victim.pid != pid0, \
+            f"victim not respawned: {victim.to_dict()}"
+        router.poll()
+        toks2 = router.request(prompt, max_new_tokens=3)
+        assert toks2 == toks0, (toks2, toks0)
+
+        # 4. scale-up actually spawns a process and enters rotation
+        newcomer = sup.apply_scale(+1)
+        assert newcomer is not None
+        assert sup.get(newcomer).state == "ready"
+        assert any(r["name"] == newcomer
+                   for r in router.routerz()["replicas"]), "not in rotation"
+
+        # 5. procz renders (what fleetwatch --procz shows)
+        sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+        import fleetwatch
+
+        table = fleetwatch.render_procz(sup.procz())
+        assert landed in table and newcomer in table
+        print(table)
+
+        # 6. bounded graceful shutdown: everyone drains inside the
+        #    deadline, zero SIGKILL escalations
+        router.stop()
+        esc = sup.stop()
+        assert esc == 0, f"{esc} unexpected SIGKILL escalation(s)"
+        ok = True
+        print(f"fleetserve --procs selftest: ok (pid {pid0} killed, "
+              f"respawned as pid {victim.pid}, inc {victim.incarnation}; "
+              f"scaled up {newcomer}; 0 escalations)")
+        return 0
+    finally:
+        if not ok:
+            try:
+                router.stop()
+            finally:
+                sup.stop()
 
 
 def serve(args):
@@ -194,8 +356,19 @@ def main(argv=None) -> int:
     ap.add_argument("--iterations", type=int, default=0,
                     help="stop the controller loop after N ticks "
                          "(0 = run until interrupted)")
+    ap.add_argument("--procs", action="store_true",
+                    help="spawn replicas as real replica_main "
+                         "subprocesses under a ReplicaSupervisor")
+    ap.add_argument("--model", choices=("tiny", "stub"), default="tiny",
+                    help="--procs replica engine: tiny Llama or the "
+                         "deterministic no-JAX stub")
+    ap.add_argument("--drain-deadline", type=float, default=5.0,
+                    help="--procs per-replica drain bound before "
+                         "SIGKILL escalation")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
+    if args.procs:
+        return selftest_procs(args) if args.selftest else serve_procs(args)
     if args.selftest:
         return selftest()
     return serve(args)

@@ -11,6 +11,7 @@ Usage::
         [--json] [--watch] [--interval 10] [--iterations N]
         [--log alerts.jsonl]
     python tools/fleetwatch.py --routerz HOST:PORT [--json]
+    python tools/fleetwatch.py --procz HOST:PORT [--json]
     python tools/fleetwatch.py --selftest
 
 One shot by default: scrape every target once (per-target monotonic
@@ -26,6 +27,12 @@ with ``metrics_port=``) for its `/routerz` document and renders the fleet
 view: per-replica up/draining/quarantined state, affinity-table occupancy
 and hit ratio, shed and retry counts.  Exit 0 when every replica is
 routable, 1 otherwise.
+
+`--procz HOST:PORT` asks a process-fleet supervisor (``fleetserve
+--procs``) for its `/procz` document and renders the supervision view:
+per-child pid, incarnation, restart count, supervisor state
+(starting/ready/backoff/quarantined), and the SIGKILL escalation count.
+Exit 0 when every child is ready, 1 otherwise.
 
 `--selftest` runs the embedded acceptance corpus: a canned Prometheus
 exposition (escapes, histograms, +Inf) must parse sample-for-sample, a
@@ -161,6 +168,37 @@ def run_routerz(target, timeout, as_json):
                     for r in doc.get("replicas", [])) else 1
 
 
+def render_procz(doc):
+    """Text supervision view of a fleet supervisor's /procz document."""
+    lines = ["REPLICA                       STATE         PID      "
+             "INC  RESTARTS  FLAPS"]
+    for r in doc.get("replicas", []):
+        pid = "-" if r.get("pid") is None else str(r["pid"])
+        lines.append(f"{r['name']:<28}  {r['state']:<12}  {pid:<7}"
+                     f"  {r.get('incarnation', 0):>3}"
+                     f"  {r.get('restarts', 0):>8}"
+                     f"  {r.get('deaths_in_window', 0):>5}")
+    lines.append("")
+    lines.append(f"engine: {doc.get('model', '-')}"
+                 f"   sigkill escalations: {doc.get('escalations', 0)}")
+    return "\n".join(lines)
+
+
+def run_procz(target, timeout, as_json):
+    import urllib.request
+
+    url = target if "//" in target else f"http://{target}"
+    with urllib.request.urlopen(f"{url.rstrip('/')}/procz",
+                                timeout=timeout) as resp:
+        doc = json.loads(resp.read())
+    if as_json:
+        print(json.dumps(doc, default=repr))
+    else:
+        print(render_procz(doc))
+    return 0 if all(r.get("state") == "ready"
+                    for r in doc.get("replicas", [])) else 1
+
+
 def load_rules(args, alerts_mod):
     rules = [] if args.no_default_rules else alerts_mod.default_rules()
     if args.rules:
@@ -286,6 +324,9 @@ def main(argv=None) -> int:
     ap.add_argument("--routerz", metavar="HOST:PORT",
                     help="render a serving router's /routerz fleet view "
                          "instead of scraping targets")
+    ap.add_argument("--procz", metavar="HOST:PORT",
+                    help="render a process-fleet supervisor's /procz "
+                         "supervision view instead of scraping targets")
     ap.add_argument("--selftest", action="store_true")
     args = ap.parse_args(argv)
 
@@ -293,6 +334,8 @@ def main(argv=None) -> int:
         return selftest()
     if args.routerz:
         return run_routerz(args.routerz, args.timeout, args.as_json)
+    if args.procz:
+        return run_procz(args.procz, args.timeout, args.as_json)
     if not args.targets:
         ap.error("need at least one HOST:PORT target (or --selftest)")
 

@@ -104,6 +104,7 @@ def import_instrumented(repo_root=None):
     import paddle_tpu.distributed.store  # noqa: F401
     import paddle_tpu.hapi.callbacks  # noqa: F401
     import paddle_tpu.inference.constrain  # noqa: F401
+    import paddle_tpu.inference.fleet_supervisor  # noqa: F401
     import paddle_tpu.inference.llm_server  # noqa: F401
     import paddle_tpu.inference.router  # noqa: F401
     import paddle_tpu.models.lora  # noqa: F401
