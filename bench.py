@@ -1093,6 +1093,150 @@ def _bench_xplane_parse(on_accel):
     }
 
 
+def _bench_roofline(on_accel):
+    """Roofline-plane cost guard (ISSUE 17): residual-join throughput —
+    µs per MB of dump to go from a parsed XSpace + census to the sorted
+    residual table (predict + match + rank).  Companion to
+    xplane_summary_us_per_mb: the sentinel runs at CI cadence over real
+    multi-GB dumps, so the join must stay linear in ops.  Host-side by
+    construction: runs on CPU too."""
+    import os
+
+    from paddle_tpu.observability import roofline, xplane
+
+    golden = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tests", "data", "golden.xplane.pb")
+    with open(golden, "rb") as f:
+        blob = f.read() * 64
+    measured = xplane.per_op_summary(xplane.parse_xspace(blob))
+    # synthetic census covering every measured op (worst-case: every row
+    # matches, nothing early-outs) plus prefixed variants to exercise the
+    # containment fallback
+    census = {}
+    for i, name in enumerate(measured):
+        census[name.rsplit("/", 1)[-1]] = {
+            "opcode": "dot", "flops": 1e9 * (i + 1), "bytes": 1e6 * (i + 1)}
+
+    def med(fn, n):
+        ts = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            fn()
+            ts.append(time.perf_counter() - t0)
+        return sorted(ts)[len(ts) // 2]
+
+    join_s = med(lambda: roofline.residual_rows(measured, census,
+                                                197e12, 819e9), 9)
+    mb = len(blob) / 1e6
+    return {
+        "roofline_join_us_per_mb": round(join_s * 1e6 / mb, 1),
+        "roofline_bench_ops": len(measured),
+    }
+
+
+def _profile_roofline(on_accel, round_name=None):
+    """bench --profile: the measured-vs-predicted loop (ISSUE 17).
+
+    Two deliberately opposite configs — a gemm scan chain that should pin
+    the compute roof and a streaming reduce that should pin the memory
+    roof — each compiled once (the same executable feeds
+    census.per_op_census AND the profiled window), wrapped in a
+    ProfilingSession, joined into per-config residual reports, merged
+    into ONE content-addressed round, and (with --round) persisted as
+    ROOFLINE_<round>.json for the sentinel to diff against.  Residual
+    tables go to stderr (stdout stays the one-JSON-line contract)."""
+    import os
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+
+    from paddle_tpu import cost_model
+    from paddle_tpu.distributed import census as _census
+    from paddle_tpu.observability import profiling, roofline
+
+    pf = cost_model.peak_flops_per_device()
+    pbw = cost_model.peak_hbm_bytes_per_sec()
+    if pbw <= 0:  # unknown host (CPU): explicit measured fallback
+        pbw = cost_model.peak_hbm_bytes_per_sec(measure=True)
+    if pf <= 0:
+        # small-scale gemm probe (the 8192^2 hw probe is accelerator
+        # budget): enough to anchor CPU rounds, spec table rules on TPU
+        n = 1024
+        x = jnp.ones((n, n), jnp.float32)
+
+        @jax.jit
+        def chain(x):
+            def body(c, _):
+                return c @ x, ()
+            return jax.lax.scan(body, x, None, length=8)[0]
+
+        jax.block_until_ready(chain(x))
+        t0 = time.perf_counter()
+        jax.block_until_ready(chain(x))
+        dt = time.perf_counter() - t0
+        pf = 8 * 2 * n ** 3 / dt if dt > 0 else 0.0
+
+    d = 2048 if on_accel else 512
+    m = 1 << (26 if on_accel else 22)  # streaming vector elements
+    steps = 8
+    dtype = jnp.bfloat16 if on_accel else jnp.float32
+
+    def gemm_chain(x, w):
+        # unrolled on purpose: a lax.scan hides the dots inside the
+        # while-body computation, which the entry-only census can't cost
+        for _ in range(4):
+            x = x @ w
+        return x
+
+    def stream_reduce(a, b):
+        return jnp.sum(jnp.abs(a + b), dtype=jnp.float32)
+
+    configs = {
+        "gemm": (gemm_chain, (jnp.ones((d, d), dtype) * 0.01,
+                              jnp.ones((d, d), dtype) * 0.01),
+                 {"kind": "gemm_scan_chain", "d": d, "depth": 4,
+                  "dtype": str(jnp.dtype(dtype)), "steps": steps}),
+        "stream": (stream_reduce, (jnp.ones((m,), dtype),
+                                   jnp.ones((m,), dtype)),
+                   {"kind": "stream_abs_sum", "elems": m,
+                    "dtype": str(jnp.dtype(dtype)), "steps": steps}),
+    }
+
+    out = {}
+    reports = {}
+    for name, (fn, args, cfg) in configs.items():
+        compiled = jax.jit(fn).lower(*args).compile()
+        cens = _census.per_op_census(compiled)
+        r = compiled(*args)
+        jax.block_until_ready(r)  # warm before the profiled window
+        with profiling.ProfilingSession() as prof:
+            for _ in range(steps):
+                r = compiled(*args)
+            jax.block_until_ready(r)
+        rep = roofline.build_report(prof.summary, cens, pf, pbw,
+                                    config=cfg)
+        reports[name] = rep
+        s = rep["summary"]
+        print(f"--- roofline[{name}] ---", file=sys.stderr)
+        print(roofline.render_text(rep, top=10), file=sys.stderr)
+        out[f"roofline_{name}_residual_ratio"] = s["residual_ratio"]
+        out[f"roofline_{name}_wasted_us"] = s["wasted_us"]
+        out[f"roofline_{name}_ops"] = s["ops"]
+    merged = roofline.merge_reports(reports)
+    roofline.export_gauges(merged)
+    out["roofline_round_key"] = merged["key"]
+    out["roofline_peak_flops_per_sec"] = round(pf, 1)
+    out["roofline_peak_hbm_bytes_per_sec"] = round(pbw, 1)
+    if round_name:
+        root = os.path.dirname(os.path.abspath(__file__))
+        out["roofline_round_path"] = roofline.save_round(
+            merged, root, round_name)
+        print(f"persisted roofline round {round_name} "
+              f"(key {merged['key']})", file=sys.stderr)
+    return out
+
+
 def _bench_alerting(on_accel):
     """Alerting-plane cost guard (ISSUE 7): exposition parse cost of a
     realistic scraped payload and rule-evaluation cost per engine tick
@@ -1385,8 +1529,23 @@ def _bench_multi_tenant(on_accel):
     }
 
 
-def main():
+def main(argv=None):
+    import argparse
+
     import jax
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--profile", action="store_true",
+                    help="also run the roofline measured-vs-predicted "
+                         "loop (_profile_roofline): per-config "
+                         "ProfilingSession windows joined against their "
+                         "census into residual tables (stderr) and "
+                         "roofline_* fields on the JSON line")
+    ap.add_argument("--round", default=None,
+                    help="with --profile: persist the merged round as "
+                         "ROOFLINE_<NAME>.json next to bench.py (the "
+                         "sentinel baseline)")
+    args = ap.parse_args(argv)
 
     on_accel = jax.default_backend() not in ("cpu",)
     out = {}
@@ -1422,6 +1581,7 @@ def main():
                     (_bench_alerting, "alerting"),
                     (_bench_tracing, "tracing"),
                     (_bench_xplane_parse, "xplane"),
+                    (_bench_roofline, "roofline"),
                     (_bench_router, "router"),
                     (_bench_multi_tenant, "multi_tenant")):
         if time.monotonic() > deadline:
@@ -1431,6 +1591,12 @@ def main():
             out.update(fn(on_accel))
         except Exception as e:  # keep the line printable even if one bench dies
             out[f"{tag}_error"] = repr(e)[:300]
+
+    if args.profile:
+        try:
+            out.update(_profile_roofline(on_accel, round_name=args.round))
+        except Exception as e:
+            out["roofline_profile_error"] = repr(e)[:300]
 
     # headline MFU: the 7B-shape (h=4096) config when it ran — BASELINE
     # config #5's hidden sizes — else the 738M config

@@ -15,7 +15,8 @@ dtype-drift           warning   f32 upcasts materialized in bf16 hot paths
 silent-noop           warning   exported functions whose body does nothing
 bare-except-swallow   error     swallowed faults in the recovery paths
 metrics-catalogue     error     metric namespace vs README catalogue (PR 2)
-docs-stale            warning   PROJECTION.md cites the newest BENCH round
+docs-stale            warning   PROJECTION.md cites the newest BENCH and
+                                ROOFLINE rounds
 shape-polymorphism    warning   concrete .shape/.ndim/len() branching in
                                 traced functions (compile-zoo growth)
 ====================  ========  =================================================

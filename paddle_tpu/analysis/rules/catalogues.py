@@ -6,8 +6,8 @@
   lives in tools/metrics_lint.py and is loaded from there, so the two
   entrypoints cannot drift.
 - ``docs-stale`` — ``tools/docs_lint.py``: PROJECTION.md must cite the
-  newest ``BENCH_r*.json`` round; a stale citation means the pod projections
-  are anchored to superseded measurements.
+  newest ``BENCH_r*.json`` and ``ROOFLINE_*.json`` rounds; a stale citation
+  means the pod projections are anchored to superseded measurements.
 
 Both degrade to a ``note`` (never fails the build) when their inputs are
 absent — fixture trees and installed-package environments have no tools/
@@ -86,8 +86,8 @@ class DocsStaleRule(ProjectRule):
     name = "docs-stale"
     severity = "warning"
     description = (
-        "PROJECTION.md must cite the newest BENCH_r*.json round "
-        "(tools/docs_lint.py)")
+        "PROJECTION.md must cite the newest BENCH_r*.json and "
+        "ROOFLINE_*.json rounds (tools/docs_lint.py)")
 
     def check_project(self, project):
         dl = _load_tool(project.root, "docs_lint.py", "_tpulint_docs")

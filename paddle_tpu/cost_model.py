@@ -18,7 +18,7 @@ import jax.numpy as jnp
 
 from .tensor.tensor import Tensor
 
-__all__ = ["CostModel", "peak_flops_per_device"]
+__all__ = ["CostModel", "peak_flops_per_device", "peak_hbm_bytes_per_sec"]
 
 #: Dense bf16 peak FLOP/s per chip, by device_kind substring (public TPU
 #: spec sheets; the MFU denominator).  Unknown kinds (CPU hosts, new
@@ -54,6 +54,74 @@ def peak_flops_per_device(device=None) -> float:
         if sub in kind:
             return peak
     return 0.0
+
+
+#: HBM bandwidth per chip in bytes/s, by device_kind substring (public TPU
+#: spec sheets; the roofline's memory-term denominator).  Same shape and
+#: lookup order as _PEAK_FLOPS_BY_KIND.
+_PEAK_HBM_BW_BY_KIND = (
+    ("v6 lite", 1640e9), ("v6e", 1640e9),
+    ("v5p", 2765e9), ("v5 lite", 819e9), ("v5e", 819e9),
+    ("v4", 1228e9), ("v3", 900e9), ("v2", 700e9),
+)
+
+#: One-shot microbench cache: the measured fallback touches hundreds of MB
+#: of HBM, so it runs at most once per process.
+_MEASURED_HBM_BW: float | None = None
+
+
+def peak_hbm_bytes_per_sec(device=None, measure=False) -> float:
+    """Peak HBM bytes/s of one attached device (0.0 when unknown).
+
+    Same contract as :func:`peak_flops_per_device`:
+    ``PADDLE_TPU_PEAK_HBM_BW`` overrides everything, then the device-kind
+    spec table.  When the kind is unknown (CPU hosts, new generations), a
+    microbench fallback — timing a large on-device ``jnp.copy`` — can
+    stand in, but ONLY behind explicit opt-in (``measure=True`` or
+    ``PADDLE_TPU_MEASURE_HBM_BW=1``): tier-1 predictions must stay
+    deterministic, and a measured "peak" silently becoming the roofline
+    denominator would make every residual ratio ~1.0 by construction.
+    The measurement is cached for the process.
+    """
+    env = os.environ.get("PADDLE_TPU_PEAK_HBM_BW")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        dev = device or jax.devices()[0]
+        kind = dev.device_kind.lower()
+    except Exception:
+        return 0.0
+    for sub, peak in _PEAK_HBM_BW_BY_KIND:
+        if sub in kind:
+            return peak
+    if measure or os.environ.get("PADDLE_TPU_MEASURE_HBM_BW") == "1":
+        return _measure_hbm_bytes_per_sec(dev)
+    return 0.0
+
+
+def _measure_hbm_bytes_per_sec(device, mbytes=256, reps=4) -> float:
+    """Time a large device-to-device copy: ``mbytes`` read + ``mbytes``
+    written per rep, best-of-``reps`` (bandwidth microbenches take the max:
+    stragglers are scheduling noise, not the memory system)."""
+    global _MEASURED_HBM_BW
+    if _MEASURED_HBM_BW is not None:
+        return _MEASURED_HBM_BW
+    n = mbytes * (1 << 20) // 4
+    src = jax.device_put(jnp.zeros((n,), jnp.float32), device)
+    copy = jax.jit(lambda x: jnp.copy(x))  # runs where the operand lives
+    jax.block_until_ready(copy(src))  # compile + warm
+    best = 0.0
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        jax.block_until_ready(copy(src))
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            best = max(best, 2 * n * 4 / dt)
+    _MEASURED_HBM_BW = best
+    return best
 
 
 def _unwrap(args):

@@ -58,6 +58,18 @@ And below the host boundary, the profiling plane (ISSUE 14):
   ``recompile_storm`` alert rule) and device-memory telemetry
   (``hbm_*`` gauges from ``device.memory_stats()``).
 
+And joining the profiling plane against the cost model, the roofline
+residual plane (ISSUE 17):
+
+- `observability.roofline` — per-HLO measured-vs-predicted attribution
+  (min-time roofline ``max(flops/peak_flops, bytes/peak_bw)`` vs XPlane
+  per-op µs), compute-/memory-bound classification, content-addressed
+  ``ROOFLINE_<round>.json`` rounds and the per-op regression sentinel
+  (``tools/roofline_report.py --diff``); exports
+  ``roofline_residual_ratio{op}`` / ``roofline_bound_fraction{bound}``
+  and ``roofline_regressions_total`` (the ``roofline_regression``
+  default delta alert rule's series).
+
 Quick start::
 
     import paddle_tpu as paddle
@@ -98,6 +110,10 @@ from .profiling import (  # noqa: F401
     ProfilingSession, install_compile_hooks, record_compile, mark_warm,
     poll_device_memory,
 )
+from .roofline import (  # noqa: F401
+    predict_op, residual_rows, build_report, merge_reports, diff_reports,
+    record_diff, export_gauges, save_round, load_round, newest_round,
+)
 from . import metrics  # noqa: F401
 from . import spans  # noqa: F401
 from . import flight_recorder  # noqa: F401
@@ -108,6 +124,7 @@ from . import alerts  # noqa: F401
 from . import tracing  # noqa: F401
 from . import xplane  # noqa: F401
 from . import profiling  # noqa: F401
+from . import roofline  # noqa: F401
 
 __all__ = [
     "Counter", "Gauge", "Histogram", "MetricRegistry", "REGISTRY",
@@ -126,4 +143,7 @@ __all__ = [
     "xplane",
     "ProfilingSession", "install_compile_hooks", "record_compile",
     "mark_warm", "poll_device_memory", "profiling",
+    "predict_op", "residual_rows", "build_report", "merge_reports",
+    "diff_reports", "record_diff", "export_gauges", "save_round",
+    "load_round", "newest_round", "roofline",
 ]

@@ -178,8 +178,8 @@ def default_rules(queue_depth=64, burn_rate=0.5, staleness_s=60.0,
     """The stock rule set over the existing README catalogue: SLO burn
     rate, component healthchecks (including the LLM pump heartbeat-age
     check), store deadline pressure, serving backlog, recovery restart
-    storms, post-warmup recompilation storms, and the scraper's own
-    target liveness/staleness."""
+    storms, post-warmup recompilation storms, roofline residual
+    regressions, and the scraper's own target liveness/staleness."""
     return [
         Rule("slo_burn_rate_high", kind="burn_rate", threshold=burn_rate,
              for_s=30.0,
@@ -212,6 +212,13 @@ def default_rules(queue_depth=64, burn_rate=0.5, staleness_s=60.0,
                          "declared itself warm (warmup() finished) — "
                          "shape/dtype churn is eating device time on "
                          "recompiles"),
+        Rule("roofline_regression", kind="delta",
+             metric="roofline_regressions_total", op=">", threshold=0.0,
+             window_s=3600.0, for_s=0.0, severity="ticket",
+             description="the roofline sentinel (roofline_report --diff / "
+                         "roofline.record_diff) flagged an op whose "
+                         "measured-vs-predicted residual regressed past "
+                         "threshold within the window"),
         # exported_target="" matches only THIS scraper's own liveness
         # samples, never a target's re-exported view of its own fleet
         # (scrape.SampleSet.match: empty selector value = label absent)

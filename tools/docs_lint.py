@@ -7,9 +7,16 @@ the NEWEST round (lexically last glob match), so a PROJECTION.md citing an
 older round is stale output that no longer matches what the generator would
 produce — the projections and the measurements have drifted apart.
 
-Check: the basename stem of the newest ``BENCH_r*.json`` (e.g. ``BENCH_r05``)
-must appear in PROJECTION.md.  Fix: ``python tools/project_pod.py --validate
---write``.
+Checks (each absent-tolerant: no rounds on disk = nothing to cite):
+
+- the basename stem of the newest ``BENCH_r*.json`` (e.g. ``BENCH_r05``)
+  must appear in PROJECTION.md;
+- once a ``ROOFLINE_*.json`` residual round exists (the roofline plane's
+  content-addressed artifact), the newest one's stem must appear too —
+  the projections cite the measured-vs-predicted round they were checked
+  against, same idiom as the BENCH anchor.
+
+Fix for both: ``python tools/project_pod.py --validate --write``.
 
 Usage: ``python tools/docs_lint.py [--root DIR]``; exit 1 on findings.
 """
@@ -22,6 +29,7 @@ import re
 import sys
 
 _BENCH_CITE_RE = re.compile(r"BENCH_r[0-9][0-9a-z_]*")
+_ROOFLINE_CITE_RE = re.compile(r"ROOFLINE_r[0-9][0-9a-z_]*")
 
 
 def newest_bench(root: str):
@@ -31,18 +39,20 @@ def newest_bench(root: str):
     return os.path.basename(paths[-1]) if paths else None
 
 
-def check(root: str):
-    """Return findings as (relpath, line, message) tuples; empty = clean."""
-    newest = newest_bench(root)
-    proj = os.path.join(root, "PROJECTION.md")
-    if newest is None or not os.path.exists(proj):
-        return []
+def newest_roofline(root: str):
+    """Basename of the newest roofline residual round, or None (same
+    lexical-sort contract as ``newest_bench`` /
+    ``observability.roofline.newest_round``)."""
+    paths = sorted(glob.glob(os.path.join(root, "ROOFLINE_*.json")))
+    return os.path.basename(paths[-1]) if paths else None
+
+
+def _check_citation(lines, newest, cite_re, what):
+    """One round-family citation check -> findings list."""
     stem = newest[:-len(".json")] if newest.endswith(".json") else newest
-    with open(proj, encoding="utf-8") as f:
-        lines = f.read().splitlines()
     cited_lines = []  # (lineno, {stems cited on that line})
     for i, line in enumerate(lines, 1):
-        hits = set(_BENCH_CITE_RE.findall(line))
+        hits = set(cite_re.findall(line))
         if hits:
             cited_lines.append((i, hits))
     all_cited = set().union(*(h for _, h in cited_lines)) if cited_lines \
@@ -51,14 +61,33 @@ def check(root: str):
         return []
     if not cited_lines:
         return [("PROJECTION.md", 1,
-                 f"cites no BENCH round at all — newest is {newest}; "
+                 f"cites no {what} round at all — newest is {newest}; "
                  f"regenerate with `python tools/project_pod.py --validate "
                  f"--write`")]
     line_no, stale = cited_lines[0]
     return [("PROJECTION.md", line_no,
-             f"cites {sorted(stale)[0]} but the newest bench round is "
+             f"cites {sorted(stale)[0]} but the newest {what} round is "
              f"{newest} — regenerate with `python tools/project_pod.py "
              f"--validate --write`")]
+
+
+def check(root: str):
+    """Return findings as (relpath, line, message) tuples; empty = clean."""
+    proj = os.path.join(root, "PROJECTION.md")
+    if not os.path.exists(proj):
+        return []
+    with open(proj, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    findings = []
+    bench = newest_bench(root)
+    if bench is not None:
+        findings.extend(_check_citation(lines, bench, _BENCH_CITE_RE,
+                                        "bench"))
+    roofline = newest_roofline(root)
+    if roofline is not None:
+        findings.extend(_check_citation(lines, roofline,
+                                        _ROOFLINE_CITE_RE, "roofline"))
+    return findings
 
 
 def main(argv=None) -> int:
@@ -70,8 +99,9 @@ def main(argv=None) -> int:
     for path, line, msg in findings:
         print(f"{path}:{line}: docs-stale {msg}")
     if not findings:
-        print("docs_lint: PROJECTION.md cites the newest bench round "
-              f"({newest_bench(args.root)})")
+        print("docs_lint: PROJECTION.md cites the newest rounds "
+              f"(bench {newest_bench(args.root)}, roofline "
+              f"{newest_roofline(args.root)})")
     return 1 if findings else 0
 
 

@@ -8,8 +8,9 @@ walks the default registry and fails on:
 - non-snake_case names (anything outside ``[a-z][a-z0-9_]*``);
 - names without a recognized unit suffix (``_total``, ``_seconds``,
   ``_bytes``, ``_ratio``, ``_per_second``, ``_depth``, ``_slots``,
-  ``_step``, ``_count``, ``_value``) — a unitless gauge named ``foo`` rots
-  into three dashboards disagreeing about its dimension;
+  ``_step``, ``_count``, ``_value``, ``_fraction``) — a unitless gauge
+  named ``foo`` rots into three dashboards disagreeing about its
+  dimension;
 - names not documented in README.md's "## Observability" metric catalogue —
   undocumented series are invisible to operators and drift silently;
 - label names that are not snake_case.
@@ -40,7 +41,8 @@ _BACKTICK_RE = re.compile(r"`([a-z][a-z0-9_]*)(?:\{[^}`]*\})?`")
 #: the Prometheus liveness-boolean convention (the scraper's
 #: ``scrape_target_up{target}`` mirrors Prometheus' own ``up`` series).
 UNIT_SUFFIXES = ("_total", "_seconds", "_bytes", "_ratio", "_per_second",
-                 "_depth", "_slots", "_step", "_count", "_value", "_up")
+                 "_depth", "_slots", "_step", "_count", "_value", "_up",
+                 "_fraction")
 
 
 def documented_names(readme_path: str) -> set[str]:
@@ -109,6 +111,7 @@ def import_instrumented(repo_root=None):
     import paddle_tpu.inference.router  # noqa: F401
     import paddle_tpu.models.lora  # noqa: F401
     import paddle_tpu.observability.profiling  # noqa: F401
+    import paddle_tpu.observability.roofline  # noqa: F401
     import paddle_tpu.observability.xplane  # noqa: F401
     from paddle_tpu.observability import REGISTRY
     return REGISTRY

@@ -368,11 +368,20 @@ def main():
         "serving_capacity": project_serving_capacity(bench),
         "validation": val,
         "bench_source": os.path.basename(paths[-1]) if paths else None,
+        "roofline_source": _newest_roofline(),
     }
     print(json.dumps(proj, indent=1))
     if args.write:
         write_md(proj)
     return proj
+
+
+def _newest_roofline():
+    """Basename of the newest roofline residual round, or None (same
+    lexical 'newest = last glob match' contract as the BENCH source;
+    tools/docs_lint.py polices that PROJECTION.md cites it)."""
+    paths = sorted(glob.glob(os.path.join(ROOT, "ROOFLINE_*.json")))
+    return os.path.basename(paths[-1]) if paths else None
 
 
 def write_md(proj):
@@ -383,7 +392,14 @@ def write_md(proj):
              f"`{proj['bench_source']}`); collective times are analytic on "
              "public v5e ICI specs; the traffic formulas are validated "
              "against the 8-device virtual mesh census below.",
-             "",
+             ""]
+    if proj.get("roofline_source"):
+        lines += [f"Per-op measured-vs-predicted attribution: "
+                  f"`{proj['roofline_source']}` (the roofline residual "
+                  f"plane's newest round; see `tools/roofline_report.py "
+                  f"--diff` for the regression sentinel).",
+                  ""]
+    lines += [
              "## Interconnect model", "",
              f"- ICI one-way per link: {ICI_LINK_GBS} GB/s; bidirectional "
              f"ring per torus axis: {RING_AXIS_GBS} GB/s",

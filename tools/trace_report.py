@@ -33,6 +33,12 @@ Inputs
 --top K                     rows to print (default 20, by total time,
                             then by flops for time-less census rows)
 --json out.json             also write the full joined table as JSON
+--roofline                  residual-annotate joined rows against the
+                            min-time roofline (observability.roofline):
+                            predicted µs, measured/predicted ratio,
+                            compute-/memory-bound; peaks default to the
+                            cost_model lookups, overridable with
+                            --peak-flops / --peak-bw
 
 Join rule: exact name match first, else substring containment either way
 (census op ``dot.4`` matches timeline event ``jit_step/dot.4``); census
@@ -206,7 +212,25 @@ def load_census(path):
 
 
 # ------------------------------------------------------------------ joining
+def _roofline():
+    """The roofline plane, imported lazily with the same sys.path dance as
+    `_timeline_from_xplane` (stdlib-only module, so this stays cheap)."""
+    import os
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    from paddle_tpu.observability import roofline
+    return roofline
+
+
 def _match(event_name, census):
+    # the join rule lives in roofline.match_name (one matcher for the CLI
+    # and the residual plane); inline fallback keeps this tool usable as a
+    # bare script with the package unreachable
+    try:
+        return _roofline().match_name(event_name, census)
+    except ImportError:
+        pass
     if event_name in census:
         return event_name
     # trace names prefix ops with the program path ("jit_step/dot.12"):
@@ -304,6 +328,16 @@ def main(argv=None) -> int:
     ap.add_argument("--top", type=int, default=20)
     ap.add_argument("--json", dest="json_out", default=None,
                     help="write the full joined table as JSON here")
+    ap.add_argument("--roofline", action="store_true",
+                    help="residual-annotate the joined rows (predicted "
+                         "min-time, measured/predicted ratio, compute- vs "
+                         "memory-bound) and print the residual table")
+    ap.add_argument("--peak-flops", type=float, default=None,
+                    help="roofline FLOP/s denominator (default: "
+                         "cost_model.peak_flops_per_device)")
+    ap.add_argument("--peak-bw", type=float, default=None,
+                    help="roofline HBM bytes/s denominator (default: "
+                         "cost_model.peak_hbm_bytes_per_sec)")
     args = ap.parse_args(argv)
 
     timeline = load_timeline(path=args.trace, flight_path=args.flight,
@@ -316,6 +350,19 @@ def main(argv=None) -> int:
               "to attribute")
         return 1
     print(render_text(rows, top=args.top))
+    if args.roofline:
+        roofline = _roofline()
+        pf, pbw = args.peak_flops, args.peak_bw
+        if pf is None or pbw is None:
+            from paddle_tpu import cost_model
+            pf = cost_model.peak_flops_per_device() if pf is None else pf
+            pbw = cost_model.peak_hbm_bytes_per_sec() if pbw is None \
+                else pbw
+        roofline.annotate_rows(rows, pf, pbw)
+        print()
+        print(roofline.render_text(
+            sorted(rows, key=lambda r: (-r["wasted_us"], -r["total_us"],
+                                        r["name"])), top=args.top))
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump({"schema_version": SCHEMA_VERSION, "rows": rows},
@@ -325,10 +372,20 @@ def main(argv=None) -> int:
                           for r in rows):
         # a census that joins NOTHING timed means the profile and the
         # cost model describe different programs — fail loudly so CI
-        # can gate on it
+        # can gate on it, and show WHAT failed to match so the operator
+        # can tell a naming-scheme drift from an empty dump
         print("trace_report: census joined zero timed rows — the "
               "timeline and the census do not describe the same program",
               file=sys.stderr)
+        timed = sorted((r for r in rows if r["total_us"] > 0),
+                       key=lambda r: -r["total_us"])
+        costed = sorted((r for r in rows if r["total_us"] == 0
+                         and not r["matched"]),
+                        key=lambda r: (-r["flops"], -r["bytes"]))
+        for label, side in (("timeline", timed), ("census", costed)):
+            names = ", ".join(r["name"] for r in side[:5]) or "(empty)"
+            print(f"  unmatched {label} names (top {min(5, len(side))}): "
+                  f"{names}", file=sys.stderr)
         return 2
     return 0
 
