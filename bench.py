@@ -1445,6 +1445,54 @@ def _bench_router(on_accel):
     }
 
 
+def _bench_tpulint(on_accel):
+    """Static-analysis cost guard (ISSUE 18): tpulint file-rule throughput
+    in microseconds per thousand source lines over the real package.  The
+    pre-commit loop budget is "sub-second for a spot-lint"; a rule that
+    re-walks the AST per node (quadratic) or re-parses per rule would blow
+    that silently while --check still passes.  Runs the engine in-process
+    (serial, file rules only — project rules import jax and are bounded by
+    compile time, not lint time).  Host-only by construction."""
+    import importlib.util
+    import os
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    spec = importlib.util.spec_from_file_location(
+        "_bench_tpulint_analysis",
+        os.path.join(repo, "paddle_tpu", "analysis", "__init__.py"),
+        submodule_search_locations=[
+            os.path.join(repo, "paddle_tpu", "analysis")])
+    analysis = importlib.util.module_from_spec(spec)
+    import sys as _sys
+    _sys.modules["_bench_tpulint_analysis"] = analysis
+    spec.loader.exec_module(analysis)
+
+    pairs = analysis.list_target_files(repo, ["paddle_tpu"])
+    kloc = sum(sum(1 for _ in open(a, "rb")) for a, _ in pairs) / 1000.0
+
+    def run():
+        project = analysis.ProjectContext(repo)
+        file_rules = [r for r in analysis.RULES.values()
+                      if isinstance(r, analysis.FileRule)]
+        n = 0
+        for abspath, relpath in pairs:
+            n += len(analysis.lint_file(project, abspath, relpath,
+                                        file_rules))
+        return n
+
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        run()
+        ts.append(time.perf_counter() - t0)
+    med = sorted(ts)[len(ts) // 2]
+    return {
+        "tpulint_us_per_kloc": round(med * 1e6 / max(kloc, 1e-9), 1),
+        "tpulint_bench_kloc": round(kloc, 1),
+        "tpulint_bench_rules": len(analysis.RULES),
+    }
+
+
 def _bench_multi_tenant(on_accel):
     """Multi-tenant serving guard (ISSUE 15): the SAME deterministic trace
     decoded three ways — every request on its own adapter (the mixed
@@ -1583,7 +1631,8 @@ def main(argv=None):
                     (_bench_xplane_parse, "xplane"),
                     (_bench_roofline, "roofline"),
                     (_bench_router, "router"),
-                    (_bench_multi_tenant, "multi_tenant")):
+                    (_bench_multi_tenant, "multi_tenant"),
+                    (_bench_tpulint, "tpulint")):
         if time.monotonic() > deadline:
             out[f"{tag}_skipped"] = "bench budget exhausted"
             continue

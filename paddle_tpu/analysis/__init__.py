@@ -25,10 +25,15 @@ from .engine import (  # noqa: F401
     ProjectRule,
     Rule,
     apply_baseline,
+    finding_sort_key,
+    lint_file,
+    list_target_files,
     load_baseline,
+    project_rule_findings,
     register,
     render_json,
     render_text,
+    run_files,
     run_project,
 )
 from . import rules  # noqa: F401  (registers the built-in suite)

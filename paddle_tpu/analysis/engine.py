@@ -178,6 +178,56 @@ class ProjectContext:
         self.root = os.path.abspath(root)
         self._mesh_axes = -1  # unset sentinel
         self._export_cache = {}
+        #: absolute lint targets, set by :func:`run_project` — project rules
+        #: that walk source (lock-guard-inference) analyze exactly the
+        #: linted tree, not whatever else lives under root
+        self.lint_targets = None
+        self._parsed = None
+
+    # ---------------------------------------------------------- parsed files
+    def parsed_files(self):
+        """[(relpath, tree, lines)] for every parseable ``.py`` under the
+        lint targets (fallback: ``<root>/paddle_tpu``, else root).  Cached —
+        cross-function project rules share one parse of the tree.  Files
+        that fail to parse are skipped here; the per-file pass already
+        emitted their ``parse-error`` finding."""
+        if self._parsed is not None:
+            return self._parsed
+        targets = self.lint_targets
+        if not targets:
+            pkg = os.path.join(self.root, "paddle_tpu")
+            targets = [pkg if os.path.isdir(pkg) else self.root]
+        out, seen = [], set()
+        for target in targets:
+            for abspath in _iter_py_files(target):
+                if abspath in seen:
+                    continue
+                seen.add(abspath)
+                relpath = os.path.relpath(
+                    abspath, self.root).replace(os.sep, "/")
+                try:
+                    with open(abspath, encoding="utf-8") as f:
+                        source = f.read()
+                    tree = ast.parse(source)
+                except (OSError, SyntaxError, ValueError):
+                    continue
+                out.append((relpath, tree, source.splitlines()))
+        self._parsed = out
+        return out
+
+    def suppressions_for(self, relpath: str) -> dict:
+        """lineno -> suppressed-rule set for a parsed file ({} when the path
+        was not linted — e.g. a project finding anchored at README.md)."""
+        for rp, _tree, lines in self.parsed_files():
+            if rp == relpath:
+                out = {}
+                for i, line in enumerate(lines, 1):
+                    m = _SUPPRESS_RE.search(line)
+                    if m:
+                        out[i] = {r.strip() for r in m.group(1).split(",")
+                                  if r.strip()}
+                return out
+        return {}
 
     # ------------------------------------------------------------- mesh axes
     def mesh_axes(self):
@@ -372,6 +422,80 @@ def _selected(rule: Rule, select, ignore) -> bool:
     return True
 
 
+def list_target_files(root: str, paths=None):
+    """Deduplicated ``(abspath, relpath)`` pairs for the lint targets, in
+    walk order — the one file enumeration shared by the serial runner and
+    the ``--jobs`` parallel driver (identical lists => identical findings)."""
+    root = os.path.abspath(root)
+    targets = [os.path.join(root, p) if not os.path.isabs(p) else p
+               for p in (paths or [root])]
+    out, seen = [], set()
+    for target in targets:
+        for abspath in _iter_py_files(target):
+            if abspath in seen:
+                continue
+            seen.add(abspath)
+            out.append((abspath,
+                        os.path.relpath(abspath, root).replace(os.sep, "/")))
+    return out
+
+
+def lint_file(project, abspath: str, relpath: str, file_rules):
+    """File-rule pass for ONE file -> post-suppression findings."""
+    try:
+        ctx = FileContext(project, abspath, relpath)
+    except (SyntaxError, ValueError, OSError) as e:
+        # OSError: broken symlink / perms / deleted mid-walk — one
+        # unreadable file must not abort the whole run
+        return [Finding(rule="parse-error", path=relpath,
+                        line=getattr(e, "lineno", 1) or 1, col=0,
+                        message=f"cannot read/parse: {e}", severity="error")]
+    file_findings = []
+    for rule in file_rules:
+        if rule.applies_to(relpath):
+            file_findings.extend(rule.check(ctx))
+    sup = ctx.suppressions()
+    return [f for f in file_findings
+            if f.rule not in sup.get(f.line, ())
+            and "all" not in sup.get(f.line, ())]
+
+
+def run_files(root: str, pairs, select=None, ignore=None):
+    """Worker entry for process-parallel lints: run the FILE rules over
+    ``pairs`` (list of ``(abspath, relpath)``) and return findings as dicts
+    — pickle-stable across the Pool boundary.  Project rules stay in the
+    parent process."""
+    project = ProjectContext(os.path.abspath(root))
+    file_rules = [r for r in RULES.values()
+                  if isinstance(r, FileRule) and _selected(r, select, ignore)]
+    out = []
+    for abspath, relpath in pairs:
+        out.extend(f.to_dict()
+                   for f in lint_file(project, abspath, relpath, file_rules))
+    return out
+
+
+def project_rule_findings(project, select=None, ignore=None):
+    """Run the project rules and apply each file's inline suppressions to
+    their findings (a ``# tpulint: disable=lock-guard-inference`` must work
+    for project rules exactly like it does for file rules)."""
+    findings = []
+    for rule in RULES.values():
+        if isinstance(rule, ProjectRule) and _selected(rule, select, ignore):
+            for f in rule.check_project(project):
+                sup = project.suppressions_for(f.path).get(f.line, ())
+                if f.rule in sup or "all" in sup:
+                    continue
+                findings.append(f)
+    return findings
+
+
+def finding_sort_key(f: Finding):
+    """The one ordering applied to every findings list — the serial runner
+    and the ``--jobs`` merge must sort identically to stay byte-identical."""
+    return (f.path, f.line, f.col, f.rule)
+
+
 def run_project(root: str, paths=None, select=None, ignore=None,
                 project_rules: bool = True):
     """Lint ``paths`` (files/dirs, default: the whole root) and return the
@@ -381,42 +505,15 @@ def run_project(root: str, paths=None, select=None, ignore=None,
     project = ProjectContext(root)
     targets = [os.path.join(root, p) if not os.path.isabs(p) else p
                for p in (paths or [root])]
+    project.lint_targets = targets
     file_rules = [r for r in RULES.values()
                   if isinstance(r, FileRule) and _selected(r, select, ignore)]
     findings = []
-    seen = set()
-    for target in targets:
-        for abspath in _iter_py_files(target):
-            if abspath in seen:
-                continue
-            seen.add(abspath)
-            relpath = os.path.relpath(abspath, root).replace(os.sep, "/")
-            try:
-                ctx = FileContext(project, abspath, relpath)
-            except (SyntaxError, ValueError, OSError) as e:
-                # OSError: broken symlink / perms / deleted mid-walk — one
-                # unreadable file must not abort the whole run
-                findings.append(Finding(
-                    rule="parse-error", path=relpath,
-                    line=getattr(e, "lineno", 1) or 1, col=0,
-                    message=f"cannot read/parse: {e}", severity="error"))
-                continue
-            file_findings = []
-            for rule in file_rules:
-                if rule.applies_to(relpath):
-                    file_findings.extend(rule.check(ctx))
-            sup = ctx.suppressions()
-            for f in file_findings:
-                on_line = sup.get(f.line, ())
-                if f.rule in on_line or "all" in on_line:
-                    continue
-                findings.append(f)
+    for abspath, relpath in list_target_files(root, paths):
+        findings.extend(lint_file(project, abspath, relpath, file_rules))
     if project_rules:
-        for rule in RULES.values():
-            if isinstance(rule, ProjectRule) and _selected(rule, select,
-                                                           ignore):
-                findings.extend(rule.check_project(project))
-    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        findings.extend(project_rule_findings(project, select, ignore))
+    findings.sort(key=finding_sort_key)
     return findings
 
 

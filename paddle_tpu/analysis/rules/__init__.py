@@ -19,14 +19,27 @@ docs-stale            warning   PROJECTION.md cites the newest BENCH and
                                 ROOFLINE rounds
 shape-polymorphism    warning   concrete .shape/.ndim/len() branching in
                                 traced functions (compile-zoo growth)
+lock-guard-inference  warning   per-class inferred guarded-attribute sets;
+                                lock-free reads/writes of guarded state
+blocking-under-lock   warning   blocking I/O / sleep / join / jit dispatch
+                                inside `with <lock>` (error in inference/
+                                + observability/ hot paths)
+refcount-balance      warning   acquire/incref without a release on every
+                                exit edge (except / early return)
+scan-carry-dtype      warning   loop carries cast to a concrete dtype the
+                                init does not share (upcast/recompile)
 ====================  ========  =================================================
 """
 from . import bare_except      # noqa: F401
+from . import blocking_lock    # noqa: F401
 from . import catalogues       # noqa: F401
 from . import collective_axis  # noqa: F401
 from . import donation         # noqa: F401
 from . import dtype_drift      # noqa: F401
 from . import host_sync        # noqa: F401
 from . import impure_trace     # noqa: F401
+from . import lock_guard       # noqa: F401
+from . import refcount_balance  # noqa: F401
+from . import scan_carry       # noqa: F401
 from . import shape_polymorphism  # noqa: F401
 from . import silent_noop      # noqa: F401

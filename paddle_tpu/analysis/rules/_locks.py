@@ -1,0 +1,142 @@
+"""Shared helpers: which expressions are LOCKS, and what runs under them?
+
+The concurrency rule family (``lock-guard-inference``, ``blocking-under-lock``,
+``refcount-balance``) needs one shared answer to three questions:
+
+- *Is this expression a lock?*  Two signals, both purely lexical: the name
+  (``self._lock``, ``_checks_lock``, ``cond`` — matched by underscore-separated
+  segment so ``clock``/``blocker`` do NOT match) and the constructor
+  (anything assigned ``threading.Lock()`` / ``RLock()`` / ``Condition()`` /
+  ``Semaphore()`` counts regardless of its name).
+- *What are the aliases?*  ``lk = self._lock; with lk:`` guards the same
+  attribute set as ``with self._lock:`` — :func:`file_lock_names` folds
+  single-assignment aliases of known lock attributes into the lock-name set.
+- *What is lexically inside a block?*  :func:`iter_lexical` walks a statement
+  list without descending into nested ``def``/``lambda``/``class`` bodies —
+  code in a nested function is *deferred*, not executed while the lock is
+  held, so rules must neither flag nor learn from it.
+"""
+from __future__ import annotations
+
+import ast
+
+from ._traced import callee_name
+
+#: threading constructors whose result is a lock for our purposes.  Condition
+#: and Semaphore are included: ``with self._cond:`` holds the underlying lock.
+THREADING_LOCK_CTORS = frozenset({
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"})
+
+#: Name segments that mark a variable/attribute as a lock.  Matched on whole
+#: ``_``-separated segments so ``self._clock`` and ``blocker`` stay clean
+#: while ``self._checks_lock``, ``_seq_lock``, ``mu`` and ``cond`` match.
+_LOCK_SEGMENTS = frozenset({
+    "lock", "locks", "mutex", "mu", "cond", "condition",
+    "sem", "semaphore", "cv"})
+
+
+def is_lockish_name(name: str) -> bool:
+    """Does ``name`` look like a lock, judged by its ``_``-split segments?"""
+    return any(seg in _LOCK_SEGMENTS
+               for seg in name.lower().strip("_").split("_"))
+
+
+def attr_chain(node) -> str:
+    """Dotted source form of a Name/Attribute chain (``self._lock``,
+    ``jax.lax.psum``), or ``""`` when the chain has a non-name root."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def is_lock_ctor(node) -> bool:
+    """``threading.Lock()`` / ``RLock()`` / ... call?"""
+    return (isinstance(node, ast.Call)
+            and callee_name(node.func) in THREADING_LOCK_CTORS)
+
+
+def file_lock_names(tree):
+    """(lock_attrs, lock_names) assigned a threading ctor anywhere in the
+    file, plus local aliases of those attrs (``lk = self._lock``).
+
+    ``lock_attrs`` are attribute names (``_lock`` from ``self._lock = ...``);
+    ``lock_names`` are bare variable names (module-level ``_lock``, closure
+    locals, and aliases).  Name-based detection (:func:`is_lockish_name`)
+    is applied separately by :func:`is_lock_expr` — these sets only carry
+    the constructor/alias facts a name cannot.
+    """
+    attrs, names = set(), set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Attribute):
+                    attrs.add(t.attr)
+                elif isinstance(t, ast.Name):
+                    names.add(t.id)
+    # alias pass (after ctor pass so `lk = self._lock` sees `_lock`)
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Attribute)
+                and (node.value.attr in attrs
+                     or is_lockish_name(node.value.attr))):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    names.add(t.id)
+    return attrs, names
+
+
+def is_lock_expr(expr, lock_attrs=frozenset(), lock_names=frozenset()) -> bool:
+    """Is ``expr`` (typically a ``with``-item) a lock?  Only bare names and
+    attribute chains qualify — a call like ``lock_path.open()`` never does."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in lock_attrs or is_lockish_name(expr.attr)
+    if isinstance(expr, ast.Name):
+        return expr.id in lock_names or is_lockish_name(expr.id)
+    return False
+
+
+def lock_items(with_node, lock_attrs=frozenset(), lock_names=frozenset()):
+    """The lock expressions among a With statement's context managers."""
+    return [it.context_expr for it in with_node.items
+            if is_lock_expr(it.context_expr, lock_attrs, lock_names)]
+
+
+def iter_lexical(nodes, skip=None):
+    """Yield every AST node lexically within ``nodes`` (a node or list),
+    without descending into nested function/lambda/class bodies — those run
+    later, not here.  ``skip(node) -> True`` prunes a subtree after yielding
+    its root (used to hand nested lock-``with`` blocks to their own scan)."""
+    stack = list(nodes) if isinstance(nodes, list) else [nodes]
+    while stack:
+        n = stack.pop()
+        yield n
+        if skip is not None and skip(n):
+            continue
+        for child in ast.iter_child_nodes(n):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda, ast.ClassDef)):
+                continue
+            stack.append(child)
+
+
+def jit_bound_names(tree):
+    """Names/attrs assigned from a ``jit``/``pjit`` call anywhere in the file
+    — calling one of these IS device dispatch (blocks on compile the first
+    time), wherever the call site is."""
+    out = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and callee_name(node.value.func) in ("jit", "pjit")):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                out.add(t.id)
+            elif isinstance(t, ast.Attribute):
+                out.add(t.attr)
+    return out

@@ -1,0 +1,177 @@
+"""blocking-under-lock: no blocking work inside a ``with <lock>`` body.
+
+A lock in the serving tick path is a shared-latency budget: every
+millisecond spent holding it is added to every other thread's p99.  The
+review logs of PRs 7/8/15 caught the same bug by hand three times (a JSONL
+log write under the engine lock, a notifier callback under the engine lock,
+a device upload under the adapter-registry lock) — this rule fails lint
+instead.  Findings are ``error`` under ``paddle_tpu/inference/`` and
+``paddle_tpu/observability/`` (the tick/scrape hot paths, where a stall is a
+direct TTFT/SLO cost) and ``warning`` elsewhere.
+
+Blocking categories (one finding per ``with``-block per category):
+
+- ``sleep`` — ``time.sleep(...)``
+- ``thread-join`` — ``t.join()`` / ``t.join(5)`` / ``t.join(timeout=...)``
+  (string/path joins have non-numeric arguments and are ignored)
+- ``future-result`` — ``fut.result(...)``
+- ``wait`` — ``event.wait(...)``; ``cond.wait()`` on the *held* condition is
+  NOT flagged (it releases the lock while waiting — that is its contract)
+- ``subprocess`` — ``subprocess.*``, ``os.system``/``popen``/``waitpid``
+- ``net-io`` — ``socket.*``/``urllib.*``/``requests.*``/``http.*`` roots,
+  ``urlopen``/``create_connection``/``getaddrinfo``, and local ``_http*``
+  helpers (the router's ``_http_json`` is a network round-trip)
+- ``file-io`` — builtin ``open()``, ``os.replace``/``rename``/``makedirs``/
+  ``fsync``/``remove``/``unlink``, ``shutil.*``, ``json.dump``
+- ``jit-dispatch`` — ``jnp.asarray``/``jnp.array``/``jax.device_put``/
+  ``.block_until_ready()``, names bound from ``jit(...)``/``pjit(...)``,
+  ``*_jit`` callables, and the double-call idiom ``self._get_foo(k)(...)``
+  (fetch-then-invoke of a cached jitted callable — first call compiles)
+
+True positives this rule exists for::
+
+    with self._lock:
+        self._trace.append(ev)
+        json.dump(self._trace, open(path, "w"))   # file-io under the lock
+
+    with self._lock:
+        w = jnp.asarray(host_w)                   # device transfer under lock
+
+Documented false-positive patterns (and their dispositions):
+
+- ``cond.wait()`` inside ``with cond:`` — skipped automatically (the wait
+  releases the lock).
+- A warmup/startup path that deliberately compiles under the engine lock
+  while no traffic exists — real finding by the rule's lights; baseline it
+  with a justification (``llm_server.warmup`` is the canonical entry).
+- A lock whose entire purpose is serializing the blocking call itself
+  (single-writer JSONL append) — baseline with a justification naming the
+  invariant.
+
+Code inside nested ``def``/``lambda`` bodies is never flagged: it is
+deferred, not executed while the lock is held.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+from ._locks import (attr_chain, file_lock_names, iter_lexical,
+                     jit_bound_names, lock_items)
+from ._traced import callee_name
+
+#: Paths where a lock stall is a direct serving-latency cost -> error.
+HOT_PREFIXES = ("paddle_tpu/inference/", "paddle_tpu/observability/")
+
+_NET_ROOTS = ("socket.", "urllib.", "requests.", "http.")
+_NET_NAMES = frozenset({"urlopen", "create_connection", "getaddrinfo"})
+_OS_BLOCKING = frozenset({
+    "os.system", "os.popen", "os.waitpid", "os.replace", "os.rename",
+    "os.makedirs", "os.fsync", "os.remove", "os.unlink"})
+_FILE_OS = frozenset({"os.replace", "os.rename", "os.makedirs", "os.fsync",
+                      "os.remove", "os.unlink"})
+_JNP_DISPATCH = frozenset({"asarray", "array", "device_put", "copy"})
+
+
+def _classify(call, jit_names, held_lock_dumps):
+    """(category, label) for a blocking call, or None."""
+    func = call.func
+    name = callee_name(func)
+    chain = attr_chain(func)
+    root = chain.split(".", 1)[0] + "." if "." in chain else ""
+
+    if name == "sleep" and (chain in ("sleep", "time.sleep")
+                            or chain.endswith(".sleep")):
+        return ("sleep", chain or name)
+    if name == "join" and isinstance(func, ast.Attribute):
+        if isinstance(func.value, ast.Constant):
+            return None  # ", ".join(...)
+        blocking_sig = (
+            (not call.args and not call.keywords)
+            or any(kw.arg == "timeout" for kw in call.keywords)
+            or (len(call.args) == 1
+                and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, (int, float))))
+        if blocking_sig and not chain.startswith("os.path"):
+            return ("thread-join", chain or ".join()")
+        return None
+    if name == "result" and isinstance(func, ast.Attribute):
+        return ("future-result", chain or ".result()")
+    if name == "wait" and isinstance(func, ast.Attribute):
+        # cond.wait() on the HELD lock releases it — that is the point
+        if ast.dump(func.value) in held_lock_dumps:
+            return None
+        return ("wait", chain or ".wait()")
+    if root == "subprocess." or chain in _OS_BLOCKING - _FILE_OS:
+        return ("subprocess", chain)
+    if (root in _NET_ROOTS or name in _NET_NAMES
+            or name.lstrip("_").startswith("http")
+            or name.startswith("_http")):
+        return ("net-io", chain or name)
+    if ((name == "open" and isinstance(func, ast.Name))
+            or chain in _FILE_OS or root == "shutil."
+            or (name == "dump" and root == "json.")):
+        return ("file-io", chain or name)
+    if ((root in ("jnp.", "jax.") and name in _JNP_DISPATCH)
+            or name == "block_until_ready"
+            or name in jit_names or name.endswith("_jit")):
+        return ("jit-dispatch", chain or name)
+    if isinstance(func, ast.Call):
+        inner = callee_name(func.func)
+        if inner.startswith("_get_") or inner in jit_names \
+                or inner.endswith("_jit"):
+            return ("jit-dispatch", f"{inner}(...)(...)")
+    return None
+
+
+@register
+class BlockingUnderLockRule(FileRule):
+    name = "blocking-under-lock"
+    severity = "warning"
+    description = ("blocking calls (I/O, sleep, join, subprocess, jit "
+                   "dispatch) lexically inside a `with <lock>` body; error "
+                   "in inference/ + observability/ hot paths")
+
+    def check(self, ctx):
+        lock_attrs, lock_names = file_lock_names(ctx.tree)
+        jit_names = jit_bound_names(ctx.tree)
+        hot = ctx.relpath.startswith(HOT_PREFIXES)
+        findings = []
+        for wnode in ast.walk(ctx.tree):
+            if not isinstance(wnode, ast.With):
+                continue
+            locks = lock_items(wnode, lock_attrs, lock_names)
+            if not locks:
+                continue
+            held = {ast.dump(e) for e in locks}
+            lock_src = attr_chain(locks[0]) or "lock"
+
+            # A nested lock-`with` gets its own scan as the walk reaches it;
+            # pruning here keeps each call attributed to its innermost lock.
+            def _nested_lock_with(n):
+                return (n is not wnode and isinstance(n, ast.With)
+                        and lock_items(n, lock_attrs, lock_names))
+
+            hits = {}  # category -> [(node, label)]
+            # items too: `with self._lock, open(p) as f:` opens under the lock
+            extra = [it.context_expr for it in wnode.items
+                     if it.context_expr not in locks]
+            for n in iter_lexical(list(wnode.body) + extra,
+                                  skip=_nested_lock_with):
+                if not isinstance(n, ast.Call):
+                    continue
+                got = _classify(n, jit_names, held)
+                if got:
+                    hits.setdefault(got[0], []).append((n, got[1]))
+            for category, sites in sorted(hits.items()):
+                sites.sort(key=lambda s: (s[0].lineno, s[0].col_offset))
+                node, label = sites[0]
+                more = (f" (+{len(sites) - 1} more in this block)"
+                        if len(sites) > 1 else "")
+                findings.append(ctx.finding(
+                    self, node,
+                    f"`{label}` blocks while holding `{lock_src}` "
+                    f"({category}){more} — move it outside the critical "
+                    f"section (snapshot under the lock, act outside)",
+                    severity="error" if hot else "warning"))
+        return findings

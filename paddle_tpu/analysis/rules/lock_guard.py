@@ -1,0 +1,250 @@
+"""lock-guard-inference: a lightweight AST-level race detector.
+
+Nobody writes down which lock guards which attribute — the code does.  Per
+class, this rule *infers* the guarded-attribute set: ``self._foo`` counts as
+guarded by ``self._lock`` when it is accessed at least
+:data:`MIN_GUARDED_ACCESSES` times inside ``with self._lock:`` bodies AND at
+least one of those accesses is a write (read-only-under-lock attributes are
+usually just convenience, not an invariant).  Any *other* method that then
+reads or writes a guarded attribute while holding no lock is a candidate
+race and gets flagged.
+
+What counts as "under the lock" (all alias-aware — ``lk = self._lock;
+with lk:`` guards the same set):
+
+- lexically inside a ``with self._lock`` body in the same method;
+- anywhere in a ``_``-private method that is ONLY ever called with the lock
+  held — the intra-class call graph is closed over transitively, so the
+  ``step() -> _step_locked() -> _admit()`` tower in llm_server needs no
+  annotations (public methods are never exempted this way: external callers
+  are invisible to the AST);
+- anywhere in a method whose name ends in ``_locked`` — the repo's explicit
+  "caller holds the lock" convention.
+
+Never flagged: ``__init__``/``__new__``/``__del__``/``__post_init__``
+(construction and teardown are single-threaded by contract), and accesses
+inside nested ``def``/``lambda`` bodies (deferred execution — the lock state
+at run time is unknowable lexically).
+
+True positive (the shape this rule exists for)::
+
+    class Router:
+        def add(self, r):
+            with self._lock:
+                self._replicas[r.name] = r     # infers: _replicas guarded
+        def drop(self, name):
+            with self._lock:
+                del self._replicas[name]
+        def peek(self, name):
+            return self._replicas[name]        # flagged: no lock held
+
+Documented false-positive patterns (and their dispositions):
+
+- A deliberately lock-free reader (a ``stats()``/metrics snapshot that
+  tolerates torn reads for latency) — baseline it with a justification
+  naming the tolerance, or suppress inline; the point is that lock-free
+  access is now a *decision on record*, not an accident.
+- A public method that is in fact only called under the lock — rename it
+  ``*_locked`` or make it private to encode the contract.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import Finding, ProjectRule, register
+from ._locks import file_lock_names, iter_lexical, lock_items
+
+#: An attribute joins the guarded set at this many under-lock accesses
+#: (with >=1 write among them).  Below it, the evidence is too thin to
+#: out-rank coincidence.
+MIN_GUARDED_ACCESSES = 3
+
+#: Method names that mutate their receiver in place — `self.xs.append(v)`
+#: is a write to `self.xs` even though the Attribute reads as a Load.
+_MUTATORS = frozenset({
+    "append", "appendleft", "add", "pop", "popleft", "clear", "update",
+    "insert", "extend", "remove", "discard", "setdefault", "sort"})
+
+#: Methods whose unlocked accesses are never flagged.
+_EXEMPT_METHODS = frozenset({
+    "__init__", "__new__", "__del__", "__post_init__",
+    "__getstate__", "__setstate__", "__repr__"})
+
+
+class _MethodFacts:
+    """Per-method lexical facts: lock spans, attr accesses, self-calls."""
+
+    def __init__(self, cls_locks, lock_names, method):
+        self.node = method
+        self.name = method.name
+        aliases = set()
+        for n in iter_lexical(list(method.body)):
+            if (isinstance(n, ast.Assign)
+                    and isinstance(n.value, ast.Attribute)
+                    and isinstance(n.value.value, ast.Name)
+                    and n.value.value.id == "self"
+                    and n.value.attr in cls_locks):
+                aliases |= {t.id for t in n.targets
+                            if isinstance(t, ast.Name)}
+        self.spans = []  # (start, end) of `with <lock>` bodies
+        for n in iter_lexical(list(method.body)):
+            if isinstance(n, ast.With) and lock_items(
+                    n, cls_locks, lock_names | aliases):
+                self.spans.append((n.lineno, n.end_lineno or n.lineno))
+        # write-position self-attrs: `self.x = v` is a Store, but the
+        # dominant mutations — `self.d[k] = v`, `del self.d[k]`,
+        # `self.xs.append(v)` — leave the Attribute in Load context;
+        # collect their node ids first so they count as writes
+        def _self_attr(x):
+            return (isinstance(x, ast.Attribute)
+                    and isinstance(x.value, ast.Name) and x.value.id == "self")
+        write_ids = set()
+        for n in iter_lexical(list(method.body)):
+            if (isinstance(n, ast.Subscript)
+                    and isinstance(n.ctx, (ast.Store, ast.Del))
+                    and _self_attr(n.value)):
+                write_ids.add(id(n.value))
+            elif (isinstance(n, ast.Call)
+                  and isinstance(n.func, ast.Attribute)
+                  and n.func.attr in _MUTATORS
+                  and _self_attr(n.func.value)):
+                write_ids.add(id(n.func.value))
+        # (attr, node, is_store, under_lock) for self.<attr> accesses
+        self.accesses = []
+        # (callee method name, under_lock) for self.<m>() calls
+        self.self_calls = []
+        for n in iter_lexical(list(method.body)):
+            if (isinstance(n, ast.Attribute)
+                    and isinstance(n.value, ast.Name)
+                    and n.value.id == "self"
+                    and n.attr not in cls_locks):
+                store = (isinstance(n.ctx, (ast.Store, ast.Del))
+                         or id(n) in write_ids)
+                self.accesses.append(
+                    (n.attr, n, store, self.under_lock(n.lineno)))
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and isinstance(n.func.value, ast.Name)
+                    and n.func.value.id == "self"):
+                self.self_calls.append(
+                    (n.func.attr, self.under_lock(n.lineno)))
+
+    def under_lock(self, lineno) -> bool:
+        return any(s <= lineno <= e for s, e in self.spans)
+
+
+@register
+class LockGuardInferenceRule(ProjectRule):
+    name = "lock-guard-inference"
+    severity = "warning"
+    description = ("per class, infer which attributes a lock guards (>=%d "
+                   "locked accesses incl. a write) and flag lock-free "
+                   "reads/writes of them" % MIN_GUARDED_ACCESSES)
+
+    def check_project(self, project):
+        findings = []
+        for relpath, tree, lines in project.parsed_files():
+            _, lock_names = file_lock_names(tree)
+            for cls in (n for n in ast.walk(tree)
+                        if isinstance(n, ast.ClassDef)):
+                findings.extend(self._check_class(
+                    relpath, lines, cls, lock_names))
+        return findings
+
+    # ------------------------------------------------------------- internals
+    def _check_class(self, relpath, lines, cls, lock_names):
+        methods = [n for n in cls.body
+                   if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        cls_locks = self._class_locks(methods)
+        if not cls_locks:
+            return []
+        facts = [_MethodFacts(cls_locks, lock_names, m) for m in methods]
+
+        # ---- exempt closure: private methods only ever called under lock
+        exempt = {f.name for f in facts if f.name.endswith("_locked")}
+        callsites = {}  # method name -> [(caller facts, under_lock)]
+        for f in facts:
+            for callee, locked in f.self_calls:
+                callsites.setdefault(callee, []).append((f, locked))
+        changed = True
+        while changed:
+            changed = False
+            for f in facts:
+                if f.name in exempt or not f.name.startswith("_") \
+                        or f.name.startswith("__"):
+                    continue
+                sites = callsites.get(f.name)
+                if sites and all(
+                        locked or caller.name in exempt
+                        for caller, locked in sites):
+                    exempt.add(f.name)
+                    changed = True
+
+        def effective_locked(f, locked):
+            return locked or f.name in exempt
+
+        # ---- inference: guarded attr -> (locked count, write count)
+        counts = {}
+        for f in facts:
+            for attr, node, store, locked in f.accesses:
+                if effective_locked(f, locked):
+                    c = counts.setdefault(attr, [0, 0])
+                    c[0] += 1
+                    c[1] += int(store)
+        guarded = {a for a, (n, w) in counts.items()
+                   if n >= MIN_GUARDED_ACCESSES and w >= 1}
+        if not guarded:
+            return []
+        lock_name = sorted(cls_locks)[0]
+
+        # ---- flag lock-free accesses, one finding per (method, attr)
+        findings = []
+        flagged = set()
+        for f in facts:
+            if f.name in exempt or f.name in _EXEMPT_METHODS:
+                continue
+            for attr, node, store, locked in f.accesses:
+                if attr not in guarded or effective_locked(f, locked):
+                    continue
+                key = (f.name, attr)
+                if key in flagged:
+                    continue
+                flagged.add(key)
+                n, w = counts[attr]
+                line = node.lineno
+                findings.append(Finding(
+                    rule=self.name, path=relpath,
+                    line=line, col=node.col_offset,
+                    message=(
+                        f"self.{attr} is guarded by self.{lock_name} in "
+                        f"{cls.name} ({n} locked accesses, {w} writes) but "
+                        f"{'written' if store else 'read'} without it in "
+                        f"{f.name}() — take the lock, or record the "
+                        f"lock-free access as deliberate"),
+                    severity=self.severity,
+                    content=(lines[line - 1].strip()
+                             if 0 < line <= len(lines) else "")))
+        return findings
+
+    @staticmethod
+    def _class_locks(methods):
+        """Lock attributes of the class: assigned a threading ctor in any
+        method, or used as a lock-ish `with self.X:` item."""
+        from ._locks import is_lock_ctor, is_lockish_name
+        locks = set()
+        for m in methods:
+            for n in iter_lexical(list(m.body)):
+                if isinstance(n, ast.Assign) and is_lock_ctor(n.value):
+                    locks |= {t.attr for t in n.targets
+                              if isinstance(t, ast.Attribute)
+                              and isinstance(t.value, ast.Name)
+                              and t.value.id == "self"}
+                elif isinstance(n, ast.With):
+                    for it in n.items:
+                        e = it.context_expr
+                        if (isinstance(e, ast.Attribute)
+                                and isinstance(e.value, ast.Name)
+                                and e.value.id == "self"
+                                and is_lockish_name(e.attr)):
+                            locks.add(e.attr)
+        return locks

@@ -1,0 +1,289 @@
+"""refcount-balance: every acquire must release on every exit edge.
+
+The serving stack has three refcounted pools — KV pages
+(``_incref``/``_decref`` + the raw ``self._page_ref[p] += 1`` counter),
+adapter pages (``AdapterRegistry.acquire``/``release``), and plain
+``threading`` locks taken imperatively (``.acquire()``/``.release()``).
+A leak on an ``except`` or early-``return`` edge is invisible until the pool
+runs dry under load; the conservation tests in ``test_prefix_cache.py`` /
+``test_multi_tenant.py`` catch it at runtime — this rule catches it at lint.
+
+Recognizers live in :data:`POOLS` — one line per pool; a new pool opts in by
+adding its ``(label, acquire-names, release-names)`` row.  Raw counters
+(``self.<x>_ref[k] += 1`` / ``-= 1``) are matched by the ``_ref`` attribute
+suffix.
+
+A function that calls an acquire-recognizer is accepted when one of:
+
+- the acquire is a ``with`` item (``with pool.acquire(k) as page:``);
+- a ``try/finally`` whose ``finally`` releases covers the acquire (either
+  encloses it, or starts within 3 lines after it);
+- the acquire sits in a ``try`` whose every ``except`` handler releases AND
+  a release follows on the normal path;
+- ownership escapes: the acquired resource is returned, yielded, stored
+  into ``self``/a container, or passed to another call — the caller or the
+  store owns the release now (this is how ``_alloc_pages`` hands pages to
+  the request table);
+- a matching release appears between the acquire and EVERY later ``return``
+  (and on the fall-off-the-end path).
+
+Otherwise it is flagged: no release at all, a ``return`` that skips the
+release, or — when risky calls sit between acquire and release with no
+``try/finally`` — an exception edge that would leak.
+
+True positive::
+
+    def claim(self, k):
+        self._incref(k)
+        if self._budget[k] > self.cap:
+            return None          # leaked: the incref is never undone
+        return self._decode(k)   # escape of the DECODE, not the refcount
+
+False positives this rule deliberately does NOT emit:
+
+- ``try/finally`` release (the sanctioned shape) — covered above;
+- functions *implementing* an acquire/release API (their own name is a
+  recognizer) — skipped, the pairing is cross-method by design;
+- ``__enter__``/``__exit__`` pairs — skipped for the same reason;
+- acquire whose result is returned/stored — ownership moved, the release
+  lives with the new owner (pair it with a conservation test).
+
+Documented residual false-positive pattern: a release performed by a helper
+the rule cannot see (``self._teardown()`` calling ``release`` internally).
+Baseline it naming the helper that releases.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+from ._locks import attr_chain, iter_lexical
+from ._traced import callee_name
+
+#: One row per refcounted pool: (label, acquire callee names, release callee
+#: names).  New pools opt in with one line here.
+POOLS = (
+    ("lock/adapter-pool", frozenset({"acquire"}),
+     frozenset({"release", "release_page"})),
+    ("kv-page", frozenset({"incref", "_incref"}),
+     frozenset({"decref", "_decref"})),
+)
+
+#: ``self.<attr>[k] += 1`` with this attr suffix is a raw refcount bump
+#: (llm_server's ``_page_ref``), paired with the matching ``-= 1``.
+REF_ATTR_SUFFIX = "_ref"
+
+_ACQUIRE_NAMES = frozenset().union(*(p[1] for p in POOLS))
+_RELEASE_NAMES = frozenset().union(*(p[2] for p in POOLS))
+
+#: Callees that cannot plausibly raise in a way that leaks the refcount —
+#: used for the exception-window check between acquire and release.
+_SAFE_CALLEES = frozenset({
+    "append", "add", "discard", "remove", "pop", "popleft", "get", "items",
+    "keys", "values", "setdefault", "update", "extend", "clear", "insert",
+    "len", "int", "float", "str", "bool", "min", "max", "abs", "sum", "id",
+    "isinstance", "sorted", "list", "dict", "set", "tuple", "frozenset",
+    "enumerate", "zip", "range", "monotonic", "perf_counter", "time",
+    "debug", "info", "warning", "error", "inc", "dec", "observe", "labels",
+    "set_value", "notify", "notify_all", "startswith", "endswith", "join",
+    "split", "format", "copy", "count", "index",
+}) | _ACQUIRE_NAMES | _RELEASE_NAMES
+
+
+def _release_names_for(acq_name: str):
+    out = set()
+    for _, acq, rel in POOLS:
+        if acq_name in acq:
+            out |= rel
+    return out
+
+
+def _stmt_parents(fn):
+    """node -> parent map, lexical to ``fn`` (nested defs excluded)."""
+    parents = {}
+    for n in iter_lexical(list(fn.body)):
+        for c in ast.iter_child_nodes(n):
+            parents[c] = n
+    for c in fn.body:
+        parents.setdefault(c, fn)
+    return parents
+
+
+def _is_release(node, rel_names):
+    """A release for this pool: a matching call, or ``<x>_ref[k] -= 1``."""
+    if (isinstance(node, ast.Call)
+            and callee_name(node.func) in rel_names):
+        return True
+    if (isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Sub)
+            and isinstance(node.target, ast.Subscript)
+            and isinstance(node.target.value, ast.Attribute)
+            and node.target.value.attr.endswith(REF_ATTR_SUFFIX)):
+        return True
+    return False
+
+
+@register
+class RefcountBalanceRule(FileRule):
+    name = "refcount-balance"
+    severity = "warning"
+    description = ("acquire-style calls (POOLS table: acquire/incref/"
+                   "_page_ref bumps) must release on every exit edge "
+                   "(except/early-return) or sit under try/finally")
+
+    def check(self, ctx):
+        findings = []
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if (fn.name in _ACQUIRE_NAMES or fn.name in _RELEASE_NAMES
+                    or fn.name in ("__enter__", "__exit__", "__del__",
+                                   "close", "shutdown")):
+                continue  # implements the API / cross-method pairing
+            findings.extend(self._check_fn(ctx, fn))
+        return findings
+
+    # ------------------------------------------------------------- internals
+    def _check_fn(self, ctx, fn):
+        nodes = list(iter_lexical(list(fn.body)))
+        with_items = {id(it.context_expr) for n in nodes
+                      if isinstance(n, ast.With) for it in n.items}
+        acquires = []  # (node, lineno, rel_names, resource_repr, result_name)
+        for n in nodes:
+            if (isinstance(n, ast.Call)
+                    and callee_name(n.func) in _ACQUIRE_NAMES
+                    and id(n) not in with_items):
+                acquires.append(n)
+            elif (isinstance(n, ast.AugAssign)
+                  and isinstance(n.op, ast.Add)
+                  and isinstance(n.target, ast.Subscript)
+                  and isinstance(n.target.value, ast.Attribute)
+                  and n.target.value.attr.endswith(REF_ATTR_SUFFIX)):
+                acquires.append(n)
+        if not acquires:
+            return []
+
+        parents = _stmt_parents(fn)
+        out = []
+        for acq in acquires:
+            f = self._check_acquire(ctx, fn, acq, nodes, parents)
+            if f is not None:
+                out.append(f)
+        return out
+
+    def _check_acquire(self, ctx, fn, acq, nodes, parents):
+        if isinstance(acq, ast.Call):
+            acq_name = callee_name(acq.func)
+            rel_names = _release_names_for(acq_name)
+            resource = attr_chain(acq.func) or acq_name
+        else:  # AugAssign += 1 on *_ref
+            rel_names = frozenset()
+            resource = attr_chain(acq.target.value) + "[...] += 1"
+
+        # -------------------------------------------------- ownership escape
+        result_name = None
+        if isinstance(acq, ast.Call):
+            parent = parents.get(acq)
+            if isinstance(parent, ast.Assign) and parent.value is acq:
+                tgts = parent.targets
+                if len(tgts) == 1 and isinstance(tgts[0], ast.Name):
+                    result_name = tgts[0].id
+                else:
+                    return None  # stored into self./container: owner moved
+            elif not isinstance(parent, (ast.Expr, type(None))):
+                # `return pool.acquire(k)` / `xs.append(self._incref(p))` /
+                # part of a larger expression: the value escapes
+                return None
+            elif acq.args and isinstance(acq.args[0], ast.Name):
+                # no-result acquire (`self._incref(p)`): if the refcounted
+                # KEY itself escapes (stored in the request table, returned),
+                # the release lives with the new owner (`_free_pages`)
+                result_name = acq.args[0].id
+        if result_name is not None and self._escapes(
+                fn, acq, result_name, rel_names):
+            return None
+
+        # -------------------------------------------------- try/finally etc.
+        rel_pred = lambda n: _is_release(n, rel_names)  # noqa: E731
+        line = acq.lineno
+        for t in (n for n in nodes if isinstance(n, ast.Try)):
+            if not any(rel_pred(x) for b in [t.finalbody]
+                       for s in b for x in ast.walk(s)):
+                continue
+            if (t.lineno <= line <= (t.end_lineno or t.lineno)
+                    or line < t.lineno <= line + 3):
+                return None  # finally-covered
+        for t in (n for n in nodes if isinstance(n, ast.Try)):
+            if not (t.lineno <= line <= (t.body[-1].end_lineno
+                                         or t.lineno)):
+                continue
+            if t.handlers and all(
+                    any(rel_pred(x) for s in h.body for x in ast.walk(s))
+                    for h in t.handlers):
+                return None  # every except edge releases
+
+        # ---------------------------------------------------- release matching
+        releases = [n for n in nodes if rel_pred(n)
+                    and n.lineno > line]
+        if not releases:
+            return ctx.finding(
+                self, acq,
+                f"`{resource}` acquired but never released in "
+                f"{fn.name}() — release on every exit edge, use "
+                f"try/finally, or hand ownership off explicitly")
+        first_rel = min(n.lineno for n in releases)
+        for ret in (n for n in nodes if isinstance(n, ast.Return)):
+            if ret.lineno <= line:
+                continue
+            if not any(line < r.lineno <= ret.lineno for r in releases):
+                return ctx.finding(
+                    self, acq,
+                    f"`{resource}` acquired but the return at line "
+                    f"{ret.lineno} exits {fn.name}() without a "
+                    f"release — release before returning or use "
+                    f"try/finally")
+        # ------------------------------------------------- exception window
+        risky = [n for n in nodes
+                 if isinstance(n, ast.Call)
+                 and line < n.lineno < first_rel
+                 and callee_name(n.func) not in _SAFE_CALLEES]
+        if risky:
+            return ctx.finding(
+                self, acq,
+                f"`{resource}` acquired at line {line} but "
+                f"`{callee_name(risky[0].func)}()` (line "
+                f"{risky[0].lineno}) can raise before the release at "
+                f"line {first_rel} — wrap the span in try/finally")
+        return None
+
+    @staticmethod
+    def _escapes(fn, acq, name, rel_names):
+        """Does ``name`` (the acquire result) leave this function's
+        ownership — returned, yielded, stored, or passed along?"""
+        for n in iter_lexical(list(fn.body)):
+            if isinstance(n, (ast.Return, ast.Yield, ast.YieldFrom)):
+                v = n.value
+                if v is not None and any(
+                        isinstance(x, ast.Name) and x.id == name
+                        for x in ast.walk(v)):
+                    return True
+            elif isinstance(n, ast.Call) and n is not acq:
+                if callee_name(n.func) in rel_names:
+                    continue
+                if any(isinstance(x, ast.Name) and x.id == name
+                       for a in list(n.args) + [k.value for k in n.keywords]
+                       for x in ast.walk(a)):
+                    return True
+            elif isinstance(n, (ast.Assign, ast.AugAssign)):
+                tgts = (n.targets if isinstance(n, ast.Assign)
+                        else [n.target])
+                for t in tgts:
+                    if not isinstance(t, (ast.Subscript, ast.Attribute)):
+                        continue
+                    # stored as a VALUE (`self.x = page`) or as a KEY
+                    # (`self._page_cached[page] = True`) — either way the
+                    # table now owns the release
+                    if any(isinstance(x, ast.Name) and x.id == name
+                           for src in (n.value, t)
+                           for x in ast.walk(src)):
+                        return True
+        return False

@@ -1,0 +1,235 @@
+"""scan-carry-dtype: loop carries must leave the body at the dtype they
+entered.
+
+``lax.scan`` / ``fori_loop`` / ``while_loop`` require the carry pytree to
+have identical dtypes on entry and exit, but jax only errors when the
+mismatch is *structural*.  A body that casts its carry to a concrete dtype
+(``acc.astype(jnp.float32)``) silently pins the loop to that dtype: call the
+step with bf16 state and either (a) XLA re-compiles a second program per
+dtype (compile-zoo growth) or (b) the whole carry is upcast — double the HBM
+for the loop state and double the carry bandwidth per step.  The ROADMAP has
+carried this as a standing-floor candidate since the dtype-drift rule
+landed; it is the loop-carry completion of that rule.
+
+Flagged: a concrete-dtype cast in the *returned carry position* of a loop
+body —
+
+- ``scan`` body: first element of the returned ``(carry, y)`` pair;
+- ``fori_loop`` body (``f(i, carry)``) / ``while_loop`` body: the whole
+  return value;
+- through one level of local assignment (``acc = x.astype(jnp.float32);
+  return (acc, y)`` is resolved).
+
+Concrete = ``jnp.float32``-style attribute, bare dtype name, or a string
+constant (``"bfloat16"``).  Casts *derived from the carry itself* are the
+sanctioned idiom and never flagged::
+
+    def body(c, x):
+        upd = jnp.dot(a, b).astype(c.dtype)     # OK: follows the carry
+        return c + upd, None
+
+Nor is a cast whose dtype the loop's *init* visibly shares — entry == exit
+is the stable case (the flash-attention f32 accumulator pattern)::
+
+    acc0 = jnp.zeros((B, D), jnp.float32)
+    def body(i, acc):
+        return acc + p.astype(jnp.float32)      # OK: init is f32 too
+    out = lax.fori_loop(0, n, body, acc0)
+
+True positive::
+
+    def body(c, x):
+        c = (c * decay + x).astype(jnp.float32)  # entry dtype unknown ->
+        return c, c                              # silent f32 pin: flagged
+
+Documented false-positive pattern: a body that *intentionally* widens the
+carry (and whose caller passes an f32 init defined in another file) — the
+init dtype is not lexically visible, so the rule cannot prove stability.
+Baseline it with a justification naming where the init is pinned.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileRule, register
+from ._locks import attr_chain
+from ._traced import callee_name, _unwrap_partial
+
+#: (callee, body-arg position, carry-param position, init-arg position)
+_LOOPS = {
+    "scan": (0, 0, 1),        # scan(f, init, xs): f(carry, x)
+    "fori_loop": (2, 1, 3),   # fori_loop(lo, hi, body, init): body(i, c)
+    "while_loop": (1, 0, 2),  # while_loop(cond, body, init): body(c)
+}
+
+_CONCRETE_DTYPES = frozenset({
+    "float64", "float32", "float16", "bfloat16",
+    "float8_e4m3fn", "float8_e5m2",
+    "int64", "int32", "int16", "int8", "uint64", "uint32", "uint16", "uint8",
+    "bool_", "complex64", "complex128",
+    "f32", "f16", "bf16", "i32", "i8", "u8",
+})
+
+
+def _concrete_dtype(node):
+    """Dtype name when ``node`` is a concrete dtype expression, else None.
+    ``c.dtype`` / ``jnp.result_type(...)`` / a plain variable are symbolic
+    (carry-derived or unknown) and return None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value if node.value in _CONCRETE_DTYPES else None
+    if isinstance(node, ast.Attribute) and node.attr in _CONCRETE_DTYPES:
+        return node.attr
+    if isinstance(node, ast.Name) and node.id in _CONCRETE_DTYPES:
+        return node.id
+    return None
+
+
+def _casts_in(expr):
+    """[(node, dtype-name)] concrete-dtype casts anywhere in ``expr``:
+    ``x.astype(D)``, ``fn(..., dtype=D)``, ``jnp.float32(x)``."""
+    out = []
+    for n in ast.walk(expr):
+        if not isinstance(n, ast.Call):
+            continue
+        name = callee_name(n.func)
+        if name == "astype" and n.args:
+            d = _concrete_dtype(n.args[0])
+            if d:
+                out.append((n, d))
+        elif name in _CONCRETE_DTYPES and isinstance(n.func, ast.Attribute):
+            out.append((n, name))
+        for kw in n.keywords:
+            if kw.arg == "dtype":
+                d = _concrete_dtype(kw.value)
+                if d:
+                    out.append((n, d))
+    return out
+
+
+def _dtypes_mentioned(expr, assigns, depth=2):
+    """Concrete dtype names lexically visible in ``expr``, resolving plain
+    names through local assignments ``depth`` levels."""
+    out = set()
+    for n in ast.walk(expr):
+        d = _concrete_dtype(n)
+        if d:
+            out.add(d)
+        elif (isinstance(n, ast.Name) and depth > 0
+              and n.id in assigns and n is not expr):
+            for rhs in assigns[n.id]:
+                out |= _dtypes_mentioned(rhs, assigns, depth - 1)
+    if isinstance(expr, ast.Name) and expr.id in assigns and depth > 0:
+        for rhs in assigns[expr.id]:
+            out |= _dtypes_mentioned(rhs, assigns, depth - 1)
+    return out
+
+
+def _local_assigns(scope):
+    """name -> [RHS exprs] for plain-name assignments in ``scope``."""
+    out = {}
+    for n in ast.walk(scope):
+        if isinstance(n, ast.Assign):
+            for t in n.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(n.value)
+        elif isinstance(n, ast.AugAssign) and isinstance(n.target, ast.Name):
+            out.setdefault(n.target.id, []).append(n.value)
+    return out
+
+
+def _body_returns(fn):
+    """Return statements lexically in ``fn`` (not nested defs)."""
+    stack = list(fn.body) if not isinstance(fn, ast.Lambda) else [fn.body]
+    rets = []
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Return) and n.value is not None:
+            rets.append(n.value)
+            continue
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(n))
+    if isinstance(fn, ast.Lambda):
+        rets.append(fn.body)
+    return rets
+
+
+@register
+class ScanCarryDtypeRule(FileRule):
+    name = "scan-carry-dtype"
+    severity = "warning"
+    description = ("lax.scan/fori_loop/while_loop bodies whose carry is cast "
+                   "to a concrete dtype the init does not visibly share "
+                   "(silent upcast: HBM + recompile hazard)")
+
+    def check(self, ctx):
+        tree = ctx.tree
+        defs = {}  # name -> [(lineno, def/lambda node)]
+        for n in ast.walk(tree):
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(n.name, []).append((n.lineno, n))
+            elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Lambda):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        defs.setdefault(t.id, []).append((n.lineno, n.value))
+
+        def resolve(name, at_line):
+            """Nearest def of ``name`` preceding the call site — the usual
+            `def body(...)` + `scan(body, ...)` adjacency; a same-named
+            method elsewhere in the file must not shadow it."""
+            cands = sorted(defs.get(name, ()))
+            before = [d for ln, d in cands if ln <= at_line]
+            if before:
+                return before[-1]
+            return cands[0][1] if cands else None
+        findings = []
+        for call in ast.walk(tree):
+            if not (isinstance(call, ast.Call)
+                    and callee_name(call.func) in _LOOPS):
+                continue
+            kind = callee_name(call.func)
+            body_pos, carry_pos, init_pos = _LOOPS[kind]
+            if len(call.args) <= max(body_pos, init_pos):
+                continue
+            body = _unwrap_partial(call.args[body_pos])
+            if isinstance(body, ast.Name):
+                body = resolve(body.id, call.lineno)
+            if not isinstance(body, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            params = body.args.args
+            if len(params) <= carry_pos:
+                continue
+
+            assigns = _local_assigns(body)
+            # dtypes the init visibly pins (resolved through enclosing-scope
+            # assignments): entry == exit for these -> stable, not flagged
+            init_dtypes = _dtypes_mentioned(call.args[init_pos],
+                                            _local_assigns(tree), depth=2)
+
+            seen = set()
+            for ret in _body_returns(body):
+                if kind == "scan":
+                    if not (isinstance(ret, ast.Tuple) and ret.elts):
+                        continue
+                    carry_expr = ret.elts[0]
+                else:
+                    carry_expr = ret
+                # resolve returned names one assignment level deep
+                exprs = [carry_expr]
+                for n in ast.walk(carry_expr):
+                    if isinstance(n, ast.Name) and n.id in assigns:
+                        exprs.extend(assigns[n.id])
+                for e in exprs:
+                    for node, dtype in _casts_in(e):
+                        if dtype in init_dtypes or id(node) in seen:
+                            continue
+                        seen.add(id(node))
+                        findings.append(ctx.finding(
+                            self, node,
+                            f"`{kind}` carry leaves the body as {dtype} "
+                            f"regardless of its entry dtype — cast with "
+                            f"`.astype(carry.dtype)` (or pin the init to "
+                            f"{dtype} in the same scope) to avoid a silent "
+                            f"upcast/recompile"))
+        return findings

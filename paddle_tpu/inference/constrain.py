@@ -337,14 +337,24 @@ class TokenConstraint:
         return Cursor(self)
 
     def device_tables(self):
-        """``(masks, trans)`` as device arrays for the solo scan path."""
-        with self._dev_lock:
-            if self._dev is None:
-                import jax.numpy as jnp
+        """``(masks, trans)`` as device arrays for the solo scan path.
 
-                self._dev = (jnp.asarray(self.masks),
-                             jnp.asarray(self.trans))
-            return self._dev
+        The host->device upload happens OUTSIDE the lock: a first-use
+        upload must not stall every concurrent mask/cursor caller behind
+        the transfer.  Two racing first callers may both upload; the
+        loser's copy is dropped (the tables are immutable, so either copy
+        is correct) — publish-under-lock keeps the winner stable."""
+        with self._dev_lock:
+            dev = self._dev
+        if dev is None:
+            import jax.numpy as jnp
+
+            dev = (jnp.asarray(self.masks), jnp.asarray(self.trans))
+            with self._dev_lock:
+                if self._dev is None:
+                    self._dev = dev
+                dev = self._dev
+        return dev
 
 
 class Cursor:

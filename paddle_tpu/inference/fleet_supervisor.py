@@ -172,7 +172,8 @@ class ReplicaSupervisor:
             return list(self._replicas.values())
 
     def get(self, name):
-        return self._replicas[str(name)]
+        with self._lock:  # scale_up/_down mutate the table concurrently
+            return self._replicas[str(name)]
 
     def targets(self):
         """(name, host:port) pairs for Router construction."""
@@ -191,7 +192,8 @@ class ReplicaSupervisor:
         """Router death-witness: the live incarnation serving ``name``,
         or None when no live process exists.  A captured value that later
         DIFFERS (or goes None) proves the admit-time process is gone."""
-        rep = self._replicas.get(str(name))
+        with self._lock:
+            rep = self._replicas.get(str(name))
         if rep is None or not rep.alive():
             return None
         return rep.incarnation
@@ -384,7 +386,8 @@ class ReplicaSupervisor:
     def restart_replica(self, name):
         """FleetController ``restart_hook``: kill + immediate respawn
         (policy already decided this replica is sick — no backoff wait)."""
-        rep = self._replicas[str(name)]
+        with self._lock:  # lookup only — kill/respawn must not hold the lock
+            rep = self._replicas[str(name)]
         if rep.state in ("quarantined", "stopping", "stopped"):
             return False
         now = self._clock()
@@ -397,7 +400,8 @@ class ReplicaSupervisor:
         """Arm a ProcFaults spec for FUTURE spawns of ``name`` (passed via
         the environment); ``incarnations`` limits it to specific
         incarnation numbers (None = all future)."""
-        rep = self._replicas[str(name)]
+        with self._lock:
+            rep = self._replicas[str(name)]
         rep.fault_spec = dict(spec) if spec else None
         rep.fault_incarnations = set(incarnations) \
             if incarnations is not None else None
@@ -405,7 +409,8 @@ class ReplicaSupervisor:
     def arm_fault(self, name, spec):
         """Arm a ProcFaults spec on the LIVE process of ``name`` via its
         /faultz endpoint (requires ``faults_enabled=True`` spawns)."""
-        rep = self._replicas[str(name)]
+        with self._lock:  # lookup only — the HTTP round-trip runs unlocked
+            rep = self._replicas[str(name)]
         status, doc = _http_json("127.0.0.1", rep.port, "POST", "/faultz",
                                  body=dict(spec), timeout=5.0)
         if status != 200:

@@ -158,7 +158,8 @@ class PrefixAffinityTable:
         self._lock = threading.Lock()
 
     def __len__(self):
-        return len(self._table)
+        with self._lock:  # len() during a concurrent record() can resize
+            return len(self._table)
 
     def get(self, key):
         """Replica recorded for ``key`` (LRU-touched), or None."""
@@ -507,7 +508,10 @@ class Router:
         return self._snapshot()
 
     def quarantine(self, name, on=True):
-        rep = self._replicas[str(name)]
+        # lookup under the lock (add/remove mutate the dict concurrently);
+        # the per-replica flag flip happens on the handle outside it
+        with self._lock:
+            rep = self._replicas[str(name)]
         rep.quarantined = bool(on)
         if on:
             self.affinity.drop_replica(rep.name)
@@ -583,8 +587,13 @@ class Router:
         draining.  Returns ``(SampleSet, [ScrapeResult])`` — the
         controller feeds both into the alerting plane."""
         samples, results = self.scraper.poll()
+        # snapshot the membership once under the lock; the slow per-replica
+        # probes then run against stable handles (a replica removed mid-poll
+        # just gets one last harmless probe)
+        with self._lock:
+            replicas = dict(self._replicas)
         for res in results:
-            rep = self._replicas.get(res.target.name)
+            rep = replicas.get(res.target.name)
             if rep is None:
                 continue
             rep.up = res.ok and \

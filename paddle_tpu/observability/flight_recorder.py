@@ -105,7 +105,8 @@ class FlightRecorder:
             self._events.clear()
 
     def __len__(self):
-        return len(self._events)
+        with self._lock:  # the deque resizes under concurrent record()s
+            return len(self._events)
 
     # ------------------------------------------------------------- dumping
     def dump(self, directory, reason="manual", extra=None, trace=True):

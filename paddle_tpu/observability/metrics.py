@@ -410,7 +410,8 @@ class MetricRegistry:
                               buckets=buckets)
 
     def get(self, name):
-        return self._metrics.get(name)
+        with self._lock:  # same race as names(): first-use register() resizes
+            return self._metrics.get(name)
 
     def names(self):
         with self._lock:  # list() during a concurrent register() can resize

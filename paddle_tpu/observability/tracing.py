@@ -456,7 +456,8 @@ class TraceStore:
         _ALL_STORES.add(self)
 
     def __len__(self):
-        return len(self._traces)
+        with self._lock:  # finish()/eviction mutate the store concurrently
+            return len(self._traces)
 
     def keep_reason(self, trace):
         """The tail-sampling verdict for ``trace`` (None = drop).  Does

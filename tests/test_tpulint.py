@@ -48,7 +48,9 @@ def test_rule_catalogue_registered():
     for name in ("host-sync-in-jit", "impure-trace", "collective-axis",
                  "donation-misuse", "dtype-drift", "silent-noop",
                  "bare-except-swallow", "metrics-catalogue", "docs-stale",
-                 "shape-polymorphism"):
+                 "shape-polymorphism", "lock-guard-inference",
+                 "blocking-under-lock", "refcount-balance",
+                 "scan-carry-dtype"):
         assert name in RULES, f"rule {name} missing from registry"
 
 
@@ -508,6 +510,224 @@ def test_docs_lint_cli_clean_on_repo():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+# ------------------------------------------------------- lock-guard-inference
+LOCK_GUARD_KW = dict(project_rules=True, select={"lock-guard-inference"})
+
+
+def test_lock_guard_infers_and_flags_unlocked_access(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/router.py": (
+        "import threading\n"
+        "class Router:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._replicas = {}\n"
+        "    def add(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._replicas[k] = v\n"
+        "    def drop(self, k):\n"
+        "        with self._lock:\n"
+        "            del self._replicas[k]\n"
+        "    def size(self):\n"
+        "        with self._lock:\n"
+        "            return len(self._replicas)\n"
+        "    def peek(self, k):\n"
+        "        return self._replicas[k]\n")}, **LOCK_GUARD_KW)
+    hits = by_rule(out, "lock-guard-inference")
+    assert len(hits) == 1 and "peek" in hits[0].message
+    assert "_replicas" in hits[0].message and hits[0].line == 16
+
+
+def test_lock_guard_alias_and_locked_suffix_stay_clean(tmp_path):
+    """`lk = self._lock; with lk:` counts as locked (alias-aware), a
+    `*_locked` method encodes the caller-holds-it contract, and a private
+    helper only ever called under the lock joins the exempt closure."""
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/router.py": (
+        "import threading\n"
+        "class Router:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._replicas = {}\n"
+        "    def add(self, k, v):\n"
+        "        lk = self._lock\n"
+        "        with lk:\n"
+        "            self._replicas[k] = v\n"
+        "    def drop(self, k):\n"
+        "        with self._lock:\n"
+        "            del self._replicas[k]\n"
+        "            self._evict_one()\n"
+        "    def _evict_one(self):\n"
+        "        self._replicas.pop('x', None)\n"
+        "    def flush_locked(self):\n"
+        "        self._replicas.clear()\n")}, **LOCK_GUARD_KW)
+    assert by_rule(out, "lock-guard-inference") == []
+
+
+def test_lock_guard_inline_suppression_works_for_project_rule(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/router.py": (
+        "import threading\n"
+        "class Router:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._replicas = {}\n"
+        "    def add(self, k, v):\n"
+        "        with self._lock:\n"
+        "            self._replicas[k] = v\n"
+        "    def drop(self, k):\n"
+        "        with self._lock:\n"
+        "            del self._replicas[k]\n"
+        "    def size(self):\n"
+        "        with self._lock:\n"
+        "            return len(self._replicas)\n"
+        "    def peek(self, k):\n"
+        "        return self._replicas[k]"
+        "  # tpulint: disable=lock-guard-inference\n")}, **LOCK_GUARD_KW)
+    assert by_rule(out, "lock-guard-inference") == []
+
+
+# -------------------------------------------------------- blocking-under-lock
+def test_blocking_under_lock_error_in_hot_path_warning_elsewhere(tmp_path):
+    src = ("import time\n"
+           "import threading\n"
+           "class E:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def tick(self):\n"
+           "        with self._lock:\n"
+           "            time.sleep(0.1)\n")
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/engine.py": src,
+                               "paddle_tpu/nn/util.py": src})
+    hits = by_rule(out, "blocking-under-lock")
+    assert {(f.path, f.severity) for f in hits} == {
+        ("paddle_tpu/inference/engine.py", "error"),
+        ("paddle_tpu/nn/util.py", "warning")}
+    assert all("sleep" in f.message for f in hits)
+
+
+def test_blocking_under_lock_nesting_attributes_to_innermost(tmp_path):
+    """A nested lock-`with` owns its own body: the sleep is attributed to
+    the inner lock once, not double-counted against the outer one."""
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/engine.py": (
+        "import time\n"
+        "import threading\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._page_lock = threading.Lock()\n"
+        "    def tick(self):\n"
+        "        with self._lock:\n"
+        "            with self._page_lock:\n"
+        "                time.sleep(0.1)\n"
+        "            self.x = 1\n")})
+    hits = by_rule(out, "blocking-under-lock")
+    assert len(hits) == 1 and "_page_lock" in hits[0].message
+
+
+def test_blocking_under_lock_condition_wait_stays_clean(tmp_path):
+    """cond.wait() inside `with cond:` releases the lock — its contract."""
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/engine.py": (
+        "import threading\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._cv = threading.Condition()\n"
+        "    def park(self):\n"
+        "        with self._cv:\n"
+        "            self._cv.wait()\n"
+        "    def park_other(self, evt):\n"
+        "        with self._cv:\n"
+        "            evt.wait()\n")})
+    hits = by_rule(out, "blocking-under-lock")
+    assert len(hits) == 1 and hits[0].line == 10  # evt.wait only
+
+
+# ----------------------------------------------------------- refcount-balance
+def test_refcount_early_return_skips_release_fires(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/pool.py": (
+        "class Pool:\n"
+        "    def claim(self, k):\n"
+        "        self._page_ref[k] += 1\n"
+        "        if self.budget <= 0:\n"
+        "            return None\n"
+        "        self._page_ref[k] -= 1\n")})
+    hits = by_rule(out, "refcount-balance")
+    assert len(hits) == 1 and "return at line 5" in hits[0].message
+
+
+def test_refcount_try_finally_and_ownership_escape_stay_clean(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/pool.py": (
+        "class Pool:\n"
+        "    def safe(self, k):\n"
+        "        self._pool.acquire(k)\n"
+        "        try:\n"
+        "            self.work()\n"
+        "        finally:\n"
+        "            self._pool.release(k)\n"
+        "    def alloc(self):\n"
+        "        p = self._pool.acquire(1)\n"
+        "        return p\n"
+        "    def register(self, p):\n"
+        "        self._incref(p)\n"
+        "        self._table[p] = True\n")})
+    assert by_rule(out, "refcount-balance") == []
+
+
+def test_refcount_never_released_fires(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/pool.py": (
+        "class Pool:\n"
+        "    def leak(self, k):\n"
+        "        self._page_ref[k] += 1\n"
+        "        self.tick = self.tick + 1\n")})
+    hits = by_rule(out, "refcount-balance")
+    assert len(hits) == 1 and "never released" in hits[0].message
+
+
+# ----------------------------------------------------------- scan-carry-dtype
+def test_scan_carry_concrete_cast_fires(tmp_path):
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "def run(xs):\n"
+        "    def body(c, x):\n"
+        "        c = (c * 0.9 + x).astype(jnp.float32)\n"
+        "        return c, c\n"
+        "    return lax.scan(body, xs[0], xs)\n")})
+    hits = by_rule(out, "scan-carry-dtype")
+    assert len(hits) == 1 and "float32" in hits[0].message
+
+
+def test_scan_carry_stable_init_and_carry_derived_stay_clean(tmp_path):
+    """The flash-attention idiom (init pinned to the same dtype in the same
+    scope) and `.astype(c.dtype)` (cast follows the carry) are sanctioned."""
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "def stable(ps):\n"
+        "    acc0 = jnp.zeros((4,), jnp.float32)\n"
+        "    def body(i, acc):\n"
+        "        return acc + ps[i].astype(jnp.float32)\n"
+        "    return lax.fori_loop(0, 3, body, acc0)\n"
+        "def follows(xs):\n"
+        "    def body(c, x):\n"
+        "        return c + x.astype(c.dtype), None\n"
+        "    return lax.scan(body, xs[0], xs)\n")})
+    assert by_rule(out, "scan-carry-dtype") == []
+
+
+def test_scan_carry_resolves_adjacent_body_not_same_named_method(tmp_path):
+    """`scan(step, ...)` must bind to the `def step` just above the call,
+    not a same-named method elsewhere in the file (the rnn.py layout)."""
+    out = lint_tree(tmp_path, {"paddle_tpu/mod.py": (
+        "import jax.numpy as jnp\n"
+        "from jax import lax\n"
+        "class Decoder:\n"
+        "    def step(self, c):\n"
+        "        return c.astype(jnp.int32), None\n"
+        "def run(xs):\n"
+        "    def step(c, x):\n"
+        "        return c + x, None\n"
+        "    return lax.scan(step, xs[0], xs)\n")})
+    assert by_rule(out, "scan-carry-dtype") == []
+
+
 # ------------------------------------------------------------------ CLI driver
 def test_cli_check_paddle_tpu_clean_on_shipped_tree():
     """The tier-1 gate: a new finding anywhere in the package fails this."""
@@ -557,3 +777,127 @@ def test_cli_json_format_and_select(tmp_path):
     assert r.returncode == 1
     assert payload["counts"]["error"] == 1
     assert payload["findings"][0]["rule"] == "host-sync-in-jit"
+
+
+def _multi_file_fixture(tmp_path):
+    """A fixture tree with findings spread over several files — enough
+    parallelism for --jobs to actually shard the work."""
+    (tmp_path / "paddle_tpu").mkdir(exist_ok=True)
+    for i in range(6):
+        (tmp_path / "paddle_tpu" / f"mod{i}.py").write_text(
+            "import time\n"
+            "import jax\n"
+            "@jax.jit\n"
+            f"def step{i}(x):\n"
+            "    return x + time.time()\n")
+    (tmp_path / "paddle_tpu" / "clean.py").write_text("X = 1\n")
+
+
+def test_cli_jobs_output_byte_identical_to_serial(tmp_path):
+    """--jobs N is a pure speedup: findings, order, rendering all match the
+    serial run exactly (the acceptance bar for the parallel driver)."""
+    _multi_file_fixture(tmp_path)
+    runs = {}
+    for jobs in ("1", "3"):
+        r = subprocess.run(
+            [sys.executable, TPULINT, "--check", "paddle_tpu",
+             "--jobs", jobs, "--format", "json"],
+            capture_output=True, text=True, cwd=str(tmp_path), timeout=120)
+        assert r.returncode == 1
+        runs[jobs] = r.stdout
+    assert runs["1"] == runs["3"]
+    assert json.loads(runs["1"])["counts"]["error"] >= 6
+
+
+def test_cli_changed_lints_only_touched_files(tmp_path):
+    """--changed REF lints files differing from REF plus untracked ones —
+    the committed-and-unchanged bad file must NOT appear."""
+    _multi_file_fixture(tmp_path)
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+
+    def git(*a):
+        r = subprocess.run(["git", *a], cwd=str(tmp_path), env=env,
+                           capture_output=True, text=True, timeout=60)
+        assert r.returncode == 0, r.stderr
+    git("init", "-q")
+    git("add", "-A")
+    git("commit", "-qm", "seed")
+    (tmp_path / "paddle_tpu" / "mod0.py").write_text(
+        "import time\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def step0(x):\n"
+        "    return x + time.time()  # still bad, now changed\n")
+    (tmp_path / "paddle_tpu" / "fresh.py").write_text(
+        "import time\n"
+        "import jax\n"
+        "@jax.jit\n"
+        "def fresh(x):\n"
+        "    return x + time.time()\n")
+    r = subprocess.run(
+        [sys.executable, TPULINT, "--changed", "--format", "json"],
+        capture_output=True, text=True, cwd=str(tmp_path), timeout=120)
+    assert r.returncode == 1, r.stdout + r.stderr
+    paths = {f["path"] for f in json.loads(r.stdout)["findings"]}
+    assert paths == {"paddle_tpu/mod0.py", "paddle_tpu/fresh.py"}
+    # same files passed explicitly -> identical output (spot-lint parity)
+    r2 = subprocess.run(
+        [sys.executable, TPULINT, "paddle_tpu/fresh.py",
+         "paddle_tpu/mod0.py", "--format", "json"],
+        capture_output=True, text=True, cwd=str(tmp_path), timeout=120)
+    assert r2.stdout == r.stdout
+
+
+def test_cli_changed_clean_when_nothing_touched(tmp_path):
+    (tmp_path / "paddle_tpu").mkdir()
+    (tmp_path / "paddle_tpu" / "a.py").write_text("X = 1\n")
+    env = dict(os.environ, GIT_AUTHOR_NAME="t", GIT_AUTHOR_EMAIL="t@t",
+               GIT_COMMITTER_NAME="t", GIT_COMMITTER_EMAIL="t@t")
+    subprocess.run(["git", "init", "-q"], cwd=str(tmp_path), env=env,
+                   timeout=60)
+    subprocess.run(["git", "add", "-A"], cwd=str(tmp_path), env=env,
+                   timeout=60)
+    subprocess.run(["git", "commit", "-qm", "seed"], cwd=str(tmp_path),
+                   env=env, capture_output=True, timeout=60)
+    r = subprocess.run([sys.executable, TPULINT, "--changed"],
+                       capture_output=True, text=True, cwd=str(tmp_path),
+                       timeout=120)
+    assert r.returncode == 0
+    assert "nothing to lint" in r.stdout
+
+
+def test_cli_explain_prints_rule_doc():
+    r = subprocess.run(
+        [sys.executable, TPULINT, "--explain", "refcount-balance"],
+        capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 0
+    assert "refcount-balance" in r.stdout and "warning" in r.stdout
+    assert "try/finally" in r.stdout  # the module doc, not just the one-liner
+    r = subprocess.run([sys.executable, TPULINT, "--explain", "nope"],
+                       capture_output=True, text=True, cwd=REPO, timeout=120)
+    assert r.returncode == 2 and "unknown rule" in r.stderr
+
+
+def test_cli_list_rules_shows_counts_after_check(tmp_path):
+    _multi_file_fixture(tmp_path)
+    subprocess.run([sys.executable, TPULINT, "--check", "paddle_tpu"],
+                   capture_output=True, text=True, cwd=str(tmp_path),
+                   timeout=120)
+    r = subprocess.run(
+        [sys.executable, TPULINT, "--list-rules", "--root", str(tmp_path)],
+        capture_output=True, text=True, cwd=str(tmp_path), timeout=120)
+    assert r.returncode == 0
+    line = [ln for ln in r.stdout.splitlines()
+            if ln.startswith("impure-trace")][0]
+    assert "[last check: 6 open" in line
+
+
+def test_cli_select_docs_stale_clean_on_repo():
+    """Satellite pin: the docs-lint namespace still resolves via --select
+    and the shipped tree's citations are current (no drift since PR 17)."""
+    r = subprocess.run(
+        [sys.executable, TPULINT, "--check", "paddle_tpu",
+         "--select", "docs-stale"],
+        capture_output=True, text=True, cwd=REPO, timeout=240)
+    assert r.returncode == 0, r.stdout + r.stderr

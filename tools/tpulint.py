@@ -11,10 +11,13 @@ engine: ``paddle_tpu/analysis/``.
 Usage::
 
     python tools/tpulint.py --check paddle_tpu          # the tier-1 gate
-    python tools/tpulint.py --list-rules
+    python tools/tpulint.py --list-rules                # + last-run counts
     python tools/tpulint.py path/ --format json
     python tools/tpulint.py --check paddle_tpu --select impure-trace
     python tools/tpulint.py --check paddle_tpu --write-baseline /tmp/b.json
+    python tools/tpulint.py --changed                   # touched vs HEAD
+    python tools/tpulint.py --check paddle_tpu --jobs 4 # parallel file pass
+    python tools/tpulint.py --explain blocking-under-lock
 
 Exit codes: 0 clean, 1 findings at/above --fail-on, 2 usage/baseline error.
 
@@ -30,10 +33,13 @@ does import the live package, and degrades to a note if it cannot.)
 from __future__ import annotations
 
 import argparse
+import hashlib
 import importlib.util
 import json
 import os
+import subprocess
 import sys
+import tempfile
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -64,6 +70,99 @@ def _resolve_root(targets):
     return os.getcwd()
 
 
+def _changed_files(root, ref):
+    """Root-relative ``.py`` paths touched vs ``ref`` plus untracked ones —
+    the file set a pre-push spot-lint cares about.  Returns None (not [])
+    when git itself is unusable so the caller can distinguish "nothing
+    changed" from "cannot tell"."""
+    try:
+        diff = subprocess.run(
+            ["git", "diff", "--name-only", "--diff-filter=d", ref],
+            cwd=root, capture_output=True, text=True, timeout=30)
+        untracked = subprocess.run(
+            ["git", "ls-files", "--others", "--exclude-standard"],
+            cwd=root, capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if diff.returncode != 0:
+        return None
+    names = diff.stdout.splitlines() + untracked.stdout.splitlines()
+    out = []
+    for n in sorted(set(names)):
+        if n.endswith(".py") and os.path.exists(os.path.join(root, n)):
+            out.append(n)
+    return out
+
+
+def _parallel_worker(payload):
+    """Pool entry: file-rule lint over one chunk of ``(abspath, relpath)``
+    pairs.  Module-level (picklable) and self-loading so it works under both
+    fork and spawn start methods."""
+    root, pairs, select, ignore = payload
+    analysis = load_analysis()
+    return analysis.run_files(root, pairs,
+                              select=set(select) if select else None,
+                              ignore=set(ignore) if ignore else None)
+
+
+def _run_parallel(analysis, root, targets, select, ignore, project_rules,
+                  jobs):
+    """``--jobs N``: file rules fan out across a process pool; project rules
+    (which need the whole tree + possibly the live package) stay in the
+    parent.  Chunks preserve walk order and the final sort uses the same
+    key as the serial runner, so output is byte-identical to ``--jobs 1``."""
+    import multiprocessing
+
+    pairs = analysis.list_target_files(root, targets)
+    jobs = max(1, min(int(jobs), len(pairs) or 1))
+    chunks = [pairs[i::jobs] for i in range(jobs)]
+    # round-robin balances big/small files; order restored by the sort below
+    payloads = [(root, c, sorted(select) if select else None,
+                 sorted(ignore) if ignore else None)
+                for c in chunks if c]
+    with multiprocessing.Pool(processes=jobs) as pool:
+        dict_lists = pool.map(_parallel_worker, payloads)
+    findings = [analysis.Finding(**d) for dl in dict_lists for d in dl]
+    if project_rules:
+        project = analysis.ProjectContext(os.path.abspath(root))
+        project.lint_targets = [
+            t if os.path.isabs(t) else os.path.join(root, t)
+            for t in (targets or [root])]
+        findings.extend(analysis.project_rule_findings(project, select,
+                                                       ignore))
+    findings.sort(key=analysis.finding_sort_key)
+    return findings
+
+
+def _counts_path(root):
+    """Per-root scratch file for ``--list-rules`` finding counts — keyed by
+    the root path so parallel checkouts don't clobber each other."""
+    digest = hashlib.sha256(os.path.abspath(root).encode()).hexdigest()[:16]
+    return os.path.join(tempfile.gettempdir(), f"tpulint_counts_{digest}.json")
+
+
+def _save_counts(root, findings, baselined):
+    counts = {}
+    for f in findings:
+        counts.setdefault(f.rule, {"open": 0, "baselined": 0})["open"] += 1
+    for f in baselined:
+        counts.setdefault(f.rule, {"open": 0, "baselined": 0})[
+            "baselined"] += 1
+    try:
+        with open(_counts_path(root), "w", encoding="utf-8") as fh:
+            json.dump({"root": os.path.abspath(root), "counts": counts}, fh)
+    except OSError:
+        pass  # counts are a convenience; never fail the lint over them
+
+
+def _load_counts(root):
+    try:
+        with open(_counts_path(root), encoding="utf-8") as fh:
+            return json.load(fh).get("counts", {})
+    except (OSError, ValueError):
+        return {}
+
+
 def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     ap = argparse.ArgumentParser(
@@ -88,7 +187,19 @@ def main(argv=None) -> int:
                     default="warning",
                     help="lowest severity that fails the run (default: "
                          "warning; notes never fail)")
-    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="list the rule catalogue, with per-rule finding "
+                         "counts from the last --check of this root")
+    ap.add_argument("--explain", metavar="RULE",
+                    help="print a rule's full documentation (severity, "
+                         "scope, rationale, true/false-positive examples)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="lint only files changed vs REF (default HEAD) "
+                         "plus untracked files — the pre-push spot-lint")
+    ap.add_argument("--jobs", type=int, default=1, metavar="N",
+                    help="run file rules across N worker processes "
+                         "(output is byte-identical to the serial run)")
     ap.add_argument("--write-baseline", metavar="FILE",
                     help="write current findings as baseline entries (each "
                          "needs its justification filled in before the "
@@ -97,13 +208,58 @@ def main(argv=None) -> int:
 
     analysis = load_analysis()
 
+    if args.explain:
+        rule = analysis.RULES.get(args.explain)
+        if rule is None:
+            print(f"tpulint: unknown rule: {args.explain} "
+                  f"(see --list-rules)", file=sys.stderr)
+            return 2
+        scope = ("project" if isinstance(rule, analysis.ProjectRule)
+                 else "file")
+        print(f"{rule.name}  [{rule.severity}, {scope}-scoped]")
+        print(f"  {rule.description}")
+        doc = getattr(sys.modules.get(type(rule).__module__), "__doc__",
+                      None)
+        if doc:
+            print()
+            print(doc.strip())
+        return 0
+
     if args.list_rules:
+        counts = _load_counts(args.root or REPO_ROOT)
         for name in sorted(analysis.RULES):
             r = analysis.RULES[name]
-            print(f"{name:22s} [{r.severity}] {r.description}")
+            c = counts.get(name)
+            tail = ""
+            if c is not None:
+                tail = f"  [last check: {c['open']} open"
+                tail += (f", {c['baselined']} baselined]" if c["baselined"]
+                         else "]")
+            print(f"{name:22s} [{r.severity}] {r.description}{tail}")
+        if counts:
+            print("\n(counts from the last --check of this root; "
+                  "re-run --check to refresh)")
         return 0
 
     targets = list(args.paths) + list(args.check)
+    if args.changed is not None:
+        scope = targets or ["paddle_tpu"]
+        changed_root = (os.path.abspath(args.root) if args.root
+                        else _resolve_root(scope))
+        changed = _changed_files(changed_root, args.changed)
+        if changed is None:
+            print(f"tpulint: --changed {args.changed}: git unusable under "
+                  f"{changed_root}", file=sys.stderr)
+            return 2
+        changed = [c for c in changed
+                   if any(s in (".", "") or c == s
+                          or c.startswith(s.rstrip("/") + "/")
+                          for s in scope)]
+        if not changed:
+            print(f"tpulint: no changed files vs {args.changed} in scope "
+                  f"({', '.join(scope)}) — nothing to lint")
+            return 0
+        targets = changed
     if not targets:
         targets = ["paddle_tpu"]
     root = os.path.abspath(args.root) if args.root else _resolve_root(targets)
@@ -134,11 +290,15 @@ def main(argv=None) -> int:
     project_rules = (bool(args.select)
                      or any(os.path.abspath(t) in whole for t in abs_targets))
 
-    findings = analysis.run_project(
-        root, paths=targets,
-        select=set(args.select) if args.select else None,
-        ignore=set(args.ignore) if args.ignore else None,
-        project_rules=project_rules)
+    select = set(args.select) if args.select else None
+    ignore = set(args.ignore) if args.ignore else None
+    if args.jobs > 1:
+        findings = _run_parallel(analysis, root, targets, select, ignore,
+                                 project_rules, args.jobs)
+    else:
+        findings = analysis.run_project(
+            root, paths=targets, select=select, ignore=ignore,
+            project_rules=project_rules)
 
     if args.write_baseline:
         entries = [{"rule": f.rule, "path": f.path, "content": f.content,
@@ -184,6 +344,11 @@ def main(argv=None) -> int:
                        for t in rel_targets)
 
         unused = [e for e in unused if _in_scope(e)]
+
+    if not args.select and not args.ignore:
+        # full-catalogue runs refresh the --list-rules counts; a filtered
+        # spot-lint must not make untouched rules look suddenly clean
+        _save_counts(root, findings, baselined)
 
     if args.format == "json":
         print(analysis.render_json(findings, len(baselined), unused))
