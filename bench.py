@@ -525,6 +525,105 @@ def _bench_prefix_cache(on_accel):
                 n_req * new_toks / dt, 1)}
 
 
+def _bench_kv_tiers(on_accel):
+    """Hierarchical kv tiers through the REAL engine: the effective prefix
+    capacity multiplier over HBM-only, the promote-vs-reprefill cost per
+    page (the economics that justify the copy), and the off-tick-path
+    guard number ``kv_promote_us_per_page`` — the per-page promotion
+    latency the CI sentinel watches so a regression that drags the upload
+    toward re-prefill cost fails loudly instead of silently burning the
+    capacity win."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import LLMEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_accel:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5504,
+            num_hidden_layers=12, num_attention_heads=16,
+            num_key_value_heads=16, max_position_embeddings=2048,
+            dtype="bfloat16", tensor_parallel=False,
+            use_flash_attention=True)
+        L, ps, slots, host_pages, plen, new_toks = 1152, 128, 8, 64, 640, 8
+    else:
+        cfg = LlamaConfig.tiny(tensor_parallel=False,
+                               use_flash_attention=False)
+        L, ps, slots, host_pages, plen, new_toks = 128, 32, 2, 16, 96, 2
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_accel:
+        model.bfloat16()
+    model.eval()
+    rng = np.random.RandomState(0)
+    num_pages = slots * (plen // ps + 2)
+    eng = LLMEngine(model, max_batch_slots=slots, max_seq_len=L,
+                    kv_layout="paged", page_size=ps, num_pages=num_pages,
+                    prefill_chunk=ps, host_cache_pages=host_pages)
+    eng.warmup()
+    # per-call promotion timing, engine-local (the registry histogram
+    # aggregates across every engine the process ever ran)
+    promote = {"s": 0.0, "pages": 0}
+    inner = eng._promote_from_tiers
+
+    def timed(req):
+        t = time.perf_counter()
+        n = inner(req)
+        promote["s"] += time.perf_counter() - t
+        promote["pages"] += n
+        return n
+
+    eng._promote_from_tiers = timed
+    # warm the gather/upload programs on a same-shape cycle (same pow-2
+    # upload bucket): first use compiles, and a compile is not the number
+    warm = rng.randint(0, cfg.vocab_size, plen).astype(np.int32)
+    eng.generate(warm, max_new_tokens=1)
+    while eng.demote_step(force=True):
+        pass
+    eng._evict_prefix(int(eng._page_cached.sum()))
+    eng.generate(warm, max_new_tokens=1)
+    promote["s"], promote["pages"] = 0.0, 0
+    tiers0 = eng.stats()["prefix_cache"]["tiers"]
+    prompt = rng.randint(0, cfg.vocab_size, plen).astype(np.int32)
+    t0 = time.perf_counter()
+    eng.generate(prompt, max_new_tokens=new_toks)   # cold chunked prefill
+    t_cold = time.perf_counter() - t0
+    while eng.demote_step(force=True):              # stage every page ...
+        pass
+    eng._evict_prefix(int(eng._page_cached.sum()))  # ... and drop the HBM copy
+    t0 = time.perf_counter()
+    eng.generate(prompt, max_new_tokens=new_toks)   # promote path
+    t_promote = time.perf_counter() - t0
+    fresh = rng.randint(0, cfg.vocab_size, plen).astype(np.int32)
+    t0 = time.perf_counter()
+    eng.generate(fresh, max_new_tokens=new_toks)    # warm re-prefill baseline
+    t_reprefill = time.perf_counter() - t0
+    pages = max(promote["pages"], 1)
+    tiers = eng.stats()["prefix_cache"]["tiers"]
+    # capacity: pages a warm prefix can live in without being destroyed —
+    # HBM page pool (minus the trash page) alone vs with the lower tiers
+    hbm_pages = num_pages - 1
+    return {
+        "kv_tier_capacity_multiplier": round(
+            (hbm_pages + host_pages) / hbm_pages, 2),
+        "kv_tier_host_pages": host_pages,
+        "kv_tier_hbm_pages": hbm_pages,
+        "kv_promote_us_per_page": round(1e6 * promote["s"] / pages, 1),
+        "kv_promote_vs_reprefill_ratio": round(
+            t_promote / max(t_reprefill, 1e-9), 3),
+        "kv_tier_promoted_pages": int(tiers["promotions"]
+                                      - tiers0["promotions"]),
+        "kv_tier_demoted_pages": int(tiers["demotions"]
+                                     - tiers0["demotions"]),
+        "kv_tier_hit_tokens": int(tiers["host"]["hit_tokens"]
+                                  + tiers["disk"]["hit_tokens"]
+                                  - tiers0["host"]["hit_tokens"]
+                                  - tiers0["disk"]["hit_tokens"]),
+        "kv_tier_cold_prefill_ms": round(t_cold * 1e3, 1),
+        "kv_tier_promote_path_ms": round(t_promote * 1e3, 1),
+    }
+
+
 def _bench_spec_decode(on_accel):
     """Speculative decoding through the REAL engine: steady decode tok/s
     spec-on vs spec-off on the same deterministic trace, plus the
@@ -1619,6 +1718,7 @@ def main(argv=None):
                     (_bench_resnet, "resnet"),
                     (_bench_decode, "decode"),
                     (_bench_prefix_cache, "prefix_cache"),
+                    (_bench_kv_tiers, "kv_tiers"),
                     (_bench_spec_decode, "spec_decode"),
                     (_bench_ragged_attention, "ragged_attention"),
                     (_bench_llama7b_layer, "llama7b_layer"),

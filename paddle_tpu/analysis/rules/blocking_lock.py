@@ -23,10 +23,15 @@ Blocking categories (one finding per ``with``-block per category):
   helpers (the router's ``_http_json`` is a network round-trip)
 - ``file-io`` — builtin ``open()``, ``os.replace``/``rename``/``makedirs``/
   ``fsync``/``remove``/``unlink``, ``shutil.*``, ``json.dump``
-- ``jit-dispatch`` — ``jnp.asarray``/``jnp.array``/``jax.device_put``/
-  ``.block_until_ready()``, names bound from ``jit(...)``/``pjit(...)``,
-  ``*_jit`` callables, and the double-call idiom ``self._get_foo(k)(...)``
-  (fetch-then-invoke of a cached jitted callable — first call compiles)
+- ``jit-dispatch`` — ``jnp.asarray``/``jnp.array``/``jax.device_put``,
+  names bound from ``jit(...)``/``pjit(...)``, ``*_jit`` callables, and the
+  double-call idiom ``self._get_foo(k)(...)`` (fetch-then-invoke of a
+  cached jitted callable — first call compiles)
+- ``device-transfer`` — ``jax.device_get``, bare ``np.asarray`` (a device
+  array operand forces a BLOCKING device->host copy; dispatch is async but
+  the fetch is not), and ``.block_until_ready()``.  The hierarchical-kv
+  demotion worker is the canonical tenant: gather dispatch under the lock
+  is fine, the host-side fetch must happen outside it
 
 True positives this rule exists for::
 
@@ -71,6 +76,8 @@ _OS_BLOCKING = frozenset({
 _FILE_OS = frozenset({"os.replace", "os.rename", "os.makedirs", "os.fsync",
                       "os.remove", "os.unlink"})
 _JNP_DISPATCH = frozenset({"asarray", "array", "device_put", "copy"})
+_DEVICE_TRANSFER = frozenset({"jax.device_get", "np.asarray",
+                              "numpy.asarray"})
 
 
 def _classify(call, jit_names, held_lock_dumps):
@@ -112,8 +119,12 @@ def _classify(call, jit_names, held_lock_dumps):
             or chain in _FILE_OS or root == "shutil."
             or (name == "dump" and root == "json.")):
         return ("file-io", chain or name)
+    if (chain in _DEVICE_TRANSFER or name == "device_get"
+            or name == "block_until_ready"):
+        # device->host transfers BLOCK on the copy (unlike async dispatch):
+        # checked before jit-dispatch so jax.device_get lands here
+        return ("device-transfer", chain or name)
     if ((root in ("jnp.", "jax.") and name in _JNP_DISPATCH)
-            or name == "block_until_ready"
             or name in jit_names or name.endswith("_jit")):
         return ("jit-dispatch", chain or name)
     if isinstance(func, ast.Call):

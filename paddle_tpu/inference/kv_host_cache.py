@@ -1,0 +1,299 @@
+"""Hierarchical kv tiers under the prefix cache: host RAM + disk.
+
+HBM holds far fewer warm prefixes than a fleet has warm sessions — eviction
+from the radix index (`prefix_cache.PrefixCache`) used to DESTROY a prefix,
+so every capacity miss cost a full re-prefill at compute-bound rates.  This
+module is the two lower tiers that turn that miss into a copy:
+
+- **Host tier** (`HostKVPool`): pinned-numpy page blocks keyed by the SAME
+  chained block hashes as the radix index (and therefore adapter-seeded —
+  tiers can never cross adapters: the seed is baked into every key).  One
+  entry == one kv page across every layer's pools (bf16 2-tuples or int8
+  4-tuples with f32 scales — whatever `gather_pages_to_host` produced).
+  Bounded in PAGES; LRU overflow demotes once more, to disk.
+- **Disk tier**: one file per entry on the checkpoint volume, written with
+  the PR-1 atomic protocol (tmp + ``os.replace``) and a sha256 over the
+  blob, so a torn spill is INVISIBLE: a truncated or bit-flipped file fails
+  verification on load, is quarantined (renamed ``*.quarantined``, never
+  retried), and the engine falls back to re-prefill — corrupt kv is never
+  served.  bf16 round-trips bit-exact through ml_dtypes' numpy dtype.
+
+The pool owns NO device memory, NO locks and NO metric families: the
+engine serializes access under its own lock and owns the counters — this
+class stays a plain deterministic data structure that unit-tests stand
+alone (same division of labor as the radix index itself).
+
+A full in-memory catalog (key -> tier + tail tokens) spans both tiers, so
+chain walks and partial-tail longest-common-prefix matching never touch
+disk; only a confirmed promotion pays the read.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+import numpy as np
+
+__all__ = ["HostKVPool"]
+
+_SCHEMA = 1
+_SUFFIX = ".kvblk"
+
+
+def _np_dtype(name):
+    """numpy dtype from its string name; ``bfloat16`` resolves through
+    ml_dtypes (jax's numpy-compatible bf16), which plain np.dtype rejects."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import jax.numpy as jnp
+
+        return np.dtype(getattr(jnp, name))
+
+
+class _Entry:
+    """One staged kv page: per-layer tuples of host arrays + tail tokens."""
+
+    __slots__ = ("key", "parent", "ntok", "tokens", "blocks", "tier")
+
+    def __init__(self, key, parent, ntok, tokens, blocks, tier="host"):
+        self.key = key
+        self.parent = parent
+        self.ntok = int(ntok)
+        self.tokens = tokens  # None for full blocks; np.int32 for partials
+        self.blocks = blocks  # [(np arrays per pool element)] per layer
+        self.tier = tier      # which tier served it (set on get())
+
+
+class HostKVPool:
+    """Host-RAM + disk staging tiers for demoted prefix-cache pages.
+
+    ``host_pages`` bounds the RAM tier (entries, i.e. kv pages); overflow
+    spills LRU-first to ``disk_dir`` when configured (bounded by
+    ``disk_pages``, oldest spill deleted first) and is dropped otherwise.
+    All keys are the radix index's chained block hashes — content
+    addressed, so an entry can never go stale while its key exists (same
+    key == same tokens under the same adapter == same kv bytes).
+    """
+
+    def __init__(self, host_pages=64, disk_dir=None, disk_pages=0):
+        self.host_pages = max(0, int(host_pages))
+        self.disk_dir = disk_dir
+        self.disk_pages = max(0, int(disk_pages)) if disk_dir else 0
+        self._host: dict[bytes, _Entry] = {}   # insertion order == LRU
+        self._disk: dict[bytes, dict] = {}     # key -> catalog record
+        self._partials: dict[bytes, set[bytes]] = {}  # parent -> tail keys
+        self.host_bytes = 0
+        # plain counters the engine's stats()/metrics read
+        self.demotions_to_disk = 0
+        self.disk_loads = 0
+        self.quarantined = 0
+        self.dropped = 0
+        if self.disk_dir:
+            os.makedirs(self.disk_dir, exist_ok=True)
+
+    # ------------------------------------------------------------- lookup
+
+    def __contains__(self, key):
+        return key in self._host or key in self._disk
+
+    def __len__(self):
+        return len(self._host) + len(self._disk)
+
+    def tier_of(self, key):
+        if key in self._host:
+            return "host"
+        if key in self._disk:
+            return "disk"
+        return None
+
+    def partial_candidates(self, parent):
+        """Catalog records of every partial tail staged under ``parent``
+        (both tiers): ``(key, ntok, tokens)`` — LCP matching runs on the
+        in-memory tokens, disk is only read for the winner."""
+        out = []
+        for k in sorted(self._partials.get(parent, ())):
+            if k in self._host:
+                e = self._host[k]
+                out.append((k, e.ntok, e.tokens))
+            elif k in self._disk:
+                rec = self._disk[k]
+                out.append((k, rec["ntok"], rec["tokens"]))
+        return out
+
+    def get(self, key):
+        """The staged entry for ``key`` or None.  A disk hit verifies the
+        blob checksum; any mismatch/parse failure quarantines the file
+        (renamed, counted, never retried) and reads as a miss — the
+        engine then re-prefills instead of serving corrupt kv."""
+        e = self._host.get(key)
+        if e is not None:
+            self._host[key] = self._host.pop(key)  # LRU touch
+            e.tier = "host"
+            return e
+        rec = self._disk.get(key)
+        if rec is None:
+            return None
+        e = self._load_spill(key, rec)
+        if e is None:
+            return None
+        self.disk_loads += 1
+        e.tier = "disk"
+        return e
+
+    # ----------------------------------------------------------- mutation
+
+    def put(self, key, parent, ntok, tokens, blocks):
+        """Stage one demoted page.  Idempotent by key (content-addressed);
+        RAM overflow demotes the pool's own LRU entry to disk."""
+        if key in self._host or key in self._disk:
+            return False
+        if self.host_pages <= 0:
+            return False
+        tokens = None if tokens is None else np.asarray(tokens, np.int32)
+        e = _Entry(key, parent, ntok, tokens, blocks)
+        self._host[key] = e
+        self.host_bytes += self._entry_bytes(e)
+        if tokens is not None:
+            self._partials.setdefault(parent, set()).add(key)
+        while len(self._host) > self.host_pages:
+            old_key, old = next(iter(self._host.items()))
+            self._pop_host(old_key)
+            if self.disk_pages > 0:
+                self._spill(old)
+                self.demotions_to_disk += 1
+            else:
+                self._drop_partial(old_key, old.parent)
+                self.dropped += 1
+        return True
+
+    def discard(self, key):
+        """Drop ``key`` from whichever tier holds it (quarantine's caller-
+        side twin: the engine discards an entry it refused to promote)."""
+        if key in self._host:
+            e = self._pop_host(key)
+            self._drop_partial(key, e.parent)
+        elif key in self._disk:
+            rec = self._disk.pop(key)
+            self._drop_partial(key, rec["parent"])
+            try:
+                os.remove(rec["path"])
+            except OSError:
+                pass
+
+    def _pop_host(self, key):
+        e = self._host.pop(key)
+        self.host_bytes -= self._entry_bytes(e)
+        return e
+
+    def _drop_partial(self, key, parent):
+        sibs = self._partials.get(parent)
+        if sibs is not None:
+            sibs.discard(key)
+            if not sibs:
+                del self._partials[parent]
+
+    @staticmethod
+    def _entry_bytes(e):
+        return sum(int(a.nbytes) for lt in e.blocks for a in lt)
+
+    # ---------------------------------------------------------- disk tier
+
+    def _spill_path(self, key):
+        return os.path.join(self.disk_dir, key.hex() + _SUFFIX)
+
+    def _spill(self, e):
+        """Atomic spill: header JSON line + concatenated raw blobs, sha256
+        over the blob region, tmp + ``os.replace`` (the PR-1 checkpoint
+        protocol) — a writer killed mid-write leaves only a tmp file or a
+        torn final file that checksum verification quarantines on load."""
+        while len(self._disk) >= self.disk_pages:
+            old_key = next(iter(self._disk))
+            rec = self._disk.pop(old_key)
+            self._drop_partial(old_key, rec["parent"])
+            try:
+                os.remove(rec["path"])
+            except OSError:
+                pass
+        blob = b"".join(np.ascontiguousarray(a).tobytes()
+                        for lt in e.blocks for a in lt)
+        header = {
+            "schema": _SCHEMA,
+            "parent": e.parent.hex(),
+            "ntok": e.ntok,
+            "tokens": None if e.tokens is None else e.tokens.tolist(),
+            "layout": [[(str(a.dtype), list(a.shape)) for a in lt]
+                       for lt in e.blocks],
+            "sha256": hashlib.sha256(blob).hexdigest(),
+            "blob_bytes": len(blob),
+        }
+        path = self._spill_path(e.key)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(json.dumps(header).encode() + b"\n")
+                f.write(blob)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError:
+            # failing media / torn injected write: the spill is lost (the
+            # entry degrades to a tier miss), never half-visible
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            self.dropped += 1
+            self._drop_partial(e.key, e.parent)
+            return
+        self._disk[e.key] = {"path": path, "parent": e.parent,
+                             "ntok": e.ntok, "tokens": e.tokens}
+
+    def _load_spill(self, key, rec):
+        """Read + verify one spill; corrupt files are quarantined and the
+        catalog entry dropped, so the caller sees a plain miss."""
+        try:
+            with open(rec["path"], "rb") as f:
+                header = json.loads(f.readline())
+                blob = f.read()
+            if (header.get("schema") != _SCHEMA
+                    or len(blob) != header["blob_bytes"]
+                    or hashlib.sha256(blob).hexdigest() != header["sha256"]):
+                raise ValueError("kv spill failed verification")
+            blocks, off = [], 0
+            for lt in header["layout"]:
+                arrs = []
+                for dtype_name, shape in lt:
+                    dt = _np_dtype(dtype_name)
+                    n = int(np.prod(shape)) * dt.itemsize
+                    arrs.append(np.frombuffer(
+                        blob[off:off + n], dtype=dt).reshape(shape))
+                    off += n
+                blocks.append(tuple(arrs))
+            tokens = rec["tokens"]
+            return _Entry(key, rec["parent"], rec["ntok"], tokens, blocks)
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            self.quarantined += 1
+            self._disk.pop(key, None)
+            self._drop_partial(key, rec["parent"])
+            try:
+                os.replace(rec["path"], rec["path"] + ".quarantined")
+            except OSError:
+                pass
+            return None
+
+    # -------------------------------------------------------- diagnostics
+
+    def stats(self):
+        return {
+            "host_entries": len(self._host),
+            "host_pages": self.host_pages,
+            "host_bytes": self.host_bytes,
+            "disk_entries": len(self._disk),
+            "disk_pages": self.disk_pages,
+            "demotions_to_disk": self.demotions_to_disk,
+            "disk_loads": self.disk_loads,
+            "quarantined": self.quarantined,
+            "dropped": self.dropped,
+        }

@@ -183,6 +183,23 @@ _M_DRAIN_EXPIRED = _obs.counter(
     "llm_drain_expired_total",
     "Requests failed with DeadlineExceededError because a bounded drain "
     "(drain(deadline_s=)) expired with them still queued or in flight")
+_M_TIER_HITS = _obs.counter(
+    "llm_prefix_tier_hits_total",
+    "Prompt tokens served per cache tier at admission (hbm = radix pages "
+    "already resident; host/disk = pages promoted from a lower tier)",
+    labelnames=("tier",))
+_M_KV_DEMOTIONS = _obs.counter(
+    "llm_kv_demotions_total",
+    "Cached prefix pages staged device->host by the demotion worker")
+_M_KV_PROMOTIONS = _obs.counter(
+    "llm_kv_promotions_total",
+    "Staged prefix pages uploaded host->device at admission")
+_M_KV_HOST_BYTES = _obs.gauge(
+    "llm_kv_host_pool_bytes",
+    "Bytes of kv pages currently staged in the host-RAM tier")
+_M_KV_PROMOTE_S = _obs.histogram(
+    "llm_kv_promote_seconds",
+    "One batched promotion (tier reads + a single host->device upload)")
 
 
 def _attn_dispatch_series():
@@ -198,7 +215,7 @@ def _attn_dispatch_series():
 #: sliding-window percentiles + burn rates, README §Observability).
 _SLO_SERIES = {"ttft": "llm_ttft", "e2e": "llm_e2e",
                "queue_wait": "llm_queue_wait", "tick": "llm_tick",
-               "verify": "llm_verify"}
+               "verify": "llm_verify", "promote": "llm_promote"}
 
 #: Decode ticks coalesce into ONE trace summary span per this many ticks
 #: (and per admission episode) — a 10k-token decode contributes a bounded
@@ -261,6 +278,8 @@ class _Request:
     hit_tokens: int = 0       # cache hit credited at first admission —
                               # reversed if a COW-starved requeue abandons
                               # the prefill those tokens were skipping
+    tier_hit_tokens: int = 0  # of those, tokens PROMOTED from the host or
+                              # disk tier (hbm attribution = hit - these)
     tokens: list = field(default_factory=list)
     submit_ts: float | None = None  # engine-clock stamps for the latency
     admit_ts: float | None = None   # histograms (queue wait / TTFT / e2e)
@@ -328,7 +347,9 @@ class LLMEngine:
                  flight_recorder_dir=None, healthy_heartbeat_age=60.0,
                  alert_rules=None, tracer=None, spec_k=0, spec_draft=None,
                  cache_aware_admission=False, admission_age_cap=4,
-                 adapters=None, constraint_vocab=None):
+                 adapters=None, constraint_vocab=None, host_cache_pages=0,
+                 disk_cache_dir=None, disk_cache_pages=0,
+                 demote_watermark=0.25, demote_batch=8):
         """decode_chunk > 1 runs k decode steps per compiled call (a
         lax.scan), amortizing the host round-trip k-fold — the multi-step
         scheduling lever for high-latency hosts.  Slots that finish
@@ -436,7 +457,22 @@ class LLMEngine:
         ``constraint_vocab=`` (list: token id -> string) lets wire-form
         constraints (regex str / JSON-schema dict, e.g. from the router)
         be compiled replica-side; pre-compiled ``TokenConstraint``
-        objects work without it."""
+        objects work without it.
+
+        ``host_cache_pages > 0`` (paged + prefix cache) turns on the
+        HIERARCHICAL KV tiers (README §Serving, "Hierarchical KV"): a
+        background worker stages cold cached prefix pages device->host
+        into a ``kv_host_cache.HostKVPool`` whenever the free-page ratio
+        drops under ``demote_watermark`` (up to ``demote_batch`` pages
+        per pass, ONE batched gather program), so a later LRU eviction
+        DEMOTES the prefix instead of destroying it.  ``disk_cache_dir``
+        (+ ``disk_cache_pages``) adds a third tier: host-RAM overflow
+        spills to checksummed files (atomic tmp+rename; a torn spill
+        quarantines on load and reads as a miss).  Admission PROMOTES
+        staged blocks back with one batched host->device upload and
+        prefills from the first truly-uncached token — eviction becomes
+        a copy at PCIe/DRAM rates, not a re-prefill, and greedy decode
+        stays bitwise identical to tiers off."""
         cfg = model.config
         self.model = model
         self.n_slots = int(max_batch_slots)
@@ -451,6 +487,10 @@ class LLMEngine:
             raise ValueError(
                 "prefix_cache requires kv_layout='paged' (sharing rides on "
                 "the page tables)")
+        if host_cache_pages and not self.paged:
+            raise ValueError(
+                "host_cache_pages requires kv_layout='paged' (the kv tiers "
+                "stage and re-map page-pool pages)")
         self._prefix = None  # set by the paged branch below
         self.ps = int(page_size)
         if self.paged:
@@ -532,6 +572,29 @@ class LLMEngine:
             self._prefix_epoch = 0  # bumped on insert/evict: invalidates
                                     # requests' memoized match results
             self._cow_jit = None
+            # ---- hierarchical kv tiers (host RAM + disk under the radix
+            # index): demotion stages pages AHEAD of eviction, promotion
+            # re-uploads them at admission — README §Serving
+            self._host_kv = None
+            if host_cache_pages:
+                if self._prefix is None:
+                    raise ValueError(
+                        "host_cache_pages requires the prefix cache (the "
+                        "tiers are keyed by its chained block hashes)")
+                from .kv_host_cache import HostKVPool
+
+                self._host_kv = HostKVPool(host_pages=host_cache_pages,
+                                           disk_dir=disk_cache_dir,
+                                           disk_pages=disk_cache_pages)
+            self.demote_watermark = float(demote_watermark)
+            self.demote_batch = max(1, int(demote_batch))
+            self._gather_jit = None
+            self._upload_jit = None
+            self._demote_thread = None
+            self._demote_mutex = threading.Lock()
+            self._tier_hit_tokens = {"hbm": 0, "host": 0, "disk": 0}
+            self._kv_demotions = 0
+            self._kv_promotions = 0
         elif cache_dtype == "int8":
             self.caches = [
                 (jnp.zeros((B, H, L, D), jnp.int8),
@@ -666,6 +729,12 @@ class LLMEngine:
             # refresh hbm_* gauges at scrape time + a /varz section
             self.telemetry.register_collect(
                 _profiling.poll_device_memory, varz_key="device_memory")
+            if self.paged and self._host_kv is not None:
+                # per-tier occupancy/hit-ratio on /varz — fleetwatch and
+                # the router read this absent-not-zero (older replicas
+                # simply have no prefix_tiers section)
+                self.telemetry.register_collect(
+                    self._tier_snapshot, varz_key="prefix_tiers")
             self.telemetry.start()
         elif alert_rules is not None:
             raise ValueError("alert_rules requires metrics_port (the rules "
@@ -940,6 +1009,11 @@ class LLMEngine:
                 "cow_copies": self._cow_copies,
                 "evictions": self._prefix_evictions,
             }
+            tiers = self._tier_snapshot()
+            if tiers is not None:
+                # absent-not-zero: engines without the hierarchical tiers
+                # simply have no "tiers" key (fleetwatch renders a dash)
+                prefix["tiers"] = tiers
         spec = None
         if self.spec_k:
             spec = {
@@ -1020,6 +1094,14 @@ class LLMEngine:
             self._pump_error = None
             self._thread = threading.Thread(target=self._loop, daemon=True)
             self._thread.start()
+        if self.paged and self._host_kv is not None \
+                and (self._demote_thread is None
+                     or not self._demote_thread.is_alive()):
+            # demotion worker: device->host staging stays OFF the decode
+            # tick (synchronous engines call demote_step() themselves)
+            self._demote_thread = threading.Thread(
+                target=self._demote_loop, daemon=True)
+            self._demote_thread.start()
         return self
 
     def stop(self):
@@ -1048,6 +1130,14 @@ class LLMEngine:
             self._drain_queue(RuntimeError("LLMEngine stopped"))
         else:
             self._fail_pending(RuntimeError("LLMEngine stopped"))
+            if self.paged and self._host_kv is not None \
+                    and self._demote_thread is not None:
+                # pump terminated => the engine lock is free, so the
+                # worker exits at its next _stop check — join BEFORE the
+                # _stop reset below would resurrect its loop
+                self._demote_thread.join(timeout=5)
+                if not self._demote_thread.is_alive():
+                    self._demote_thread = None
             # a fully-terminated pump leaves the engine clean and reusable
             self._stop = False
 
@@ -1504,11 +1594,18 @@ class LLMEngine:
             return False
         freed = 0
         while freed < need:
-            page = self._prefix.evict_one(
+            evicted = self._prefix.evict_one(
                 lambda p: int(self._page_ref[p]) == 1
                 and bool(self._page_cached[p]))
-            if page is None:
+            if evicted is None:
                 return False
+            key, _tokens, page, _ntok = evicted
+            # hierarchical tiers: a page the demotion worker already staged
+            # host-side survives this eviction as a DEMOTION (the host/disk
+            # entry under the same chain key re-promotes at admission); an
+            # unstaged page is destroyed exactly as before
+            if self._host_kv is not None and key in self._host_kv:
+                _flight.record_event("kv_demote_complete", page=int(page))
             self._page_cached[page] = False
             self._decref(page)
             _M_PREFIX_EVICT.inc()
@@ -1565,7 +1662,7 @@ class LLMEngine:
             return True
         if int(self._page_ref[old]) == 2 and self._page_cached[old] \
                 and self._prefix is not None \
-                and self._prefix.evict_page(old):
+                and self._prefix.evict_page(old) is not None:
             # steal-back: the diverging tail is the least valuable entry in
             # the cache anyway — reclaim it rather than preempt the slot
             self._page_cached[old] = False
@@ -1575,6 +1672,229 @@ class LLMEngine:
             self._prefix_epoch += 1
             return True
         return False
+
+    # ------------------------------------------------- hierarchical kv tiers
+
+    def _get_gather(self):
+        if self._gather_jit is None:
+            from ..models.kv_cache import gather_pages_to_host
+
+            _profiling.record_compile("kv_gather")
+            # NOT donated: the gather only READS the pools; later donating
+            # programs (decode/prefill) serialize behind it in dispatch
+            # order, so the snapshot is consistent with the allocator state
+            # at dispatch time
+            self._gather_jit = jax.jit(gather_pages_to_host)
+        return self._gather_jit
+
+    def _get_upload(self):
+        if self._upload_jit is None:
+            from ..models.kv_cache import upload_host_pages
+
+            _profiling.record_compile("kv_upload")
+            self._upload_jit = jax.jit(upload_host_pages,
+                                       donate_argnums=(0,))
+        return self._upload_jit
+
+    def demote_step(self, force=False):
+        """ONE demotion pass: stage up to ``demote_batch`` least-recently-
+        used cached prefix pages device->host, so a later LRU eviction
+        completes as a tier DEMOTION instead of destroying the prefix.
+
+        Runs on the background demotion worker (start()), or synchronously
+        from tests/operators — NEVER on the decode tick.  Gated by the
+        free-page watermark unless ``force``.  Lock protocol: candidate
+        scan + ONE batched gather dispatch under the engine lock (dispatch
+        is async), the blocking device->host fetch OUTSIDE it, commit
+        under the lock again — the decode tick never waits on a transfer.
+        Cached pages are frozen (COW forks or steals them before any
+        write) and keys are content-addressed, so the fetched snapshot
+        commits unconditionally: even a page evicted mid-copy yields a
+        valid entry for its key.  Returns the number of pages staged."""
+        if self._host_kv is None:
+            return 0
+        with self._demote_mutex:
+            with self._lock:
+                total = self.num_pages - 1
+                if not force and total and \
+                        len(self._free_pages) / total >= self.demote_watermark:
+                    return 0
+                cands = []
+                for key, parent, page, ntok, tokens \
+                        in self._prefix.lru_entries():
+                    if not bool(self._page_cached[page]) \
+                            or key in self._host_kv:
+                        continue
+                    cands.append((key, parent, page, ntok, tokens))
+                    if len(cands) >= self.demote_batch:
+                        break
+                if not cands:
+                    return 0
+                # fixed-shape batch (ONE compiled gather program ever):
+                # pad with the trash page, discard the padded outputs
+                pages_arr = np.zeros(self.demote_batch, np.int32)
+                for i, c in enumerate(cands):
+                    pages_arr[i] = c[2]
+                gathered = self._get_gather()(self.caches, pages_arr)
+            # the blocking device->host transfer, OUTSIDE the engine lock
+            host = [tuple(np.asarray(x) for x in lt) for lt in gathered]
+            staged_blocks = [
+                [tuple(np.ascontiguousarray(x[i]) for x in lt)
+                 for lt in host]
+                for i in range(len(cands))]
+            with self._lock:
+                staged = 0
+                for (key, parent, page, ntok, tokens), blocks \
+                        in zip(cands, staged_blocks):
+                    if self._host_kv.put(key, parent, ntok, tokens, blocks):
+                        staged += 1
+                self._kv_demotions += staged
+                _M_KV_DEMOTIONS.inc(staged)
+                _M_KV_HOST_BYTES.set(self._host_kv.host_bytes)
+        if staged:
+            _flight.record_event("kv_demote", pages=int(staged))
+        return staged
+
+    def _demote_loop(self):
+        """Background demotion worker (started with the pump): polls the
+        watermark off the tick critical path.  A dying worker degrades to
+        no-demotion serving — it never takes the engine down."""
+        while not self._stop:
+            try:
+                self.demote_step()
+            except Exception as e:  # pragma: no cover - defensive
+                _flight.record_event("demote_worker_error", error=repr(e))
+                return
+            time.sleep(0.01)
+
+    def _promote_from_tiers(self, req):
+        """Re-admit staged (demoted) blocks of ``req``'s prompt: walk the
+        prompt's chain keys; blocks missing from the radix index but
+        present in the host/disk tier are uploaded back to freshly
+        allocated pages in ONE batched scatter program and re-enter the
+        index under their original keys — the normal match that follows
+        sees them exactly as if they had never been evicted, so chunked
+        prefill starts at the first truly-uncached token.  Free-list-only
+        allocation: promotion never evicts (a demote<->promote thrash
+        cycle would cost more than the re-prefill it saves).  A
+        quarantined/lost entry truncates the chain there — the remainder
+        re-prefills, corrupt kv is never served.  Returns pages promoted.
+        """
+        prompt = np.asarray(req.prompt, np.int32)
+        usable = int(prompt.size) - 1
+        ps = self.ps
+        from .prefix_cache import _root_key, chained_block_key
+
+        key, pos, plan = _root_key(req.adapter_id), 0, []
+        # a full block's key is computable whenever the prompt HOLDS all ps
+        # tokens — even when the n-1 logits cap makes only part of it
+        # matchable (match() partially uses a resident full node the same
+        # way), so walk to prompt.size and credit the usable part
+        while pos + ps <= int(prompt.size):
+            k = chained_block_key(key, prompt[pos:pos + ps].tobytes())
+            if self._prefix.node_info(k) is None:
+                if k not in self._host_kv:
+                    break
+                plan.append((k, key, min(ps, usable - pos)))
+            key = k
+            pos += ps
+        if pos < usable:
+            # partial tail under the chain point: the longest-common-prefix
+            # winner, same selection rule as PrefixCache.match
+            best, best_t = None, 0
+            for pk, ntok, toks in self._host_kv.partial_candidates(key):
+                if self._prefix.node_info(pk) is not None:
+                    continue  # already resident: match uses it directly
+                t_max = min(int(ntok), usable - pos)
+                if t_max <= 0:
+                    continue
+                toks = np.asarray(toks, np.int32)
+                eq = toks[:t_max] == prompt[pos:pos + t_max]
+                t = t_max if eq.all() else int(np.argmin(eq))
+                if t > best_t:
+                    best, best_t = pk, t
+            if best is not None:
+                plan.append((best, key, best_t))
+        plan = plan[:len(self._free_pages)]
+        if not plan:
+            return 0
+        t0 = time.perf_counter()
+        entries = []
+        for k, parent, credit in plan:
+            e = self._host_kv.get(k)
+            if e is None:
+                break  # quarantined mid-chain: children are unreachable
+            entries.append((k, parent, credit, e))
+        if not entries:
+            return 0
+        n = len(entries)
+        B = 1 << (n - 1).bit_length()  # pow-2 buckets bound retraces
+        popped = [self._free_pages.pop() for _ in range(n)]
+        pages_arr = np.zeros(B, np.int32)  # padding targets the trash page
+        pages_arr[:n] = popped
+        first = entries[0][3].blocks
+        blocks = [
+            tuple(np.stack([e.blocks[li][j] for (_k, _p, _c, e) in entries]
+                           + [np.zeros_like(first[li][j])] * (B - n))
+                  for j in range(len(first[li])))
+            for li in range(len(first))]
+        try:
+            self.caches = self._get_upload()(self.caches, pages_arr, blocks)
+        except Exception:
+            # the upload donates self.caches; the pump's _caches_alive
+            # check escalates a consumed-buffer failure to the watchdog
+            self._free_pages.extend(reversed(popped))
+            raise
+        tier_tok = {"host": 0, "disk": 0}
+        for (k, parent, credit, e), page in zip(entries, popped):
+            self._page_ref[page] = 1
+            self._page_cached[page] = True
+            self._prefix.readmit(k, parent, page, e.ntok, e.tokens)
+            tier_tok[e.tier] += int(credit)
+        self._pt_dirty = True
+        self._prefix_epoch += 1
+        self._kv_promotions += n
+        _M_KV_PROMOTIONS.inc(n)
+        for tier, tok in tier_tok.items():
+            if tok:
+                _M_TIER_HITS.labels(tier=tier).inc(tok)
+                self._tier_hit_tokens[tier] += tok
+        req.tier_hit_tokens += sum(tier_tok.values())
+        dur = time.perf_counter() - t0
+        _M_KV_PROMOTE_S.observe(dur)
+        _slo.track("llm_promote", dur)
+        _flight.record_event(
+            "kv_promote", pages=n, host_tokens=tier_tok["host"],
+            disk_tokens=tier_tok["disk"], **_trace_kv(req))
+        return n
+
+    def _tier_snapshot(self):
+        """stats()/`/varz` "tiers" block — lock-free single reads, same
+        contract as stats(); None when the tiers are off (absent-not-zero
+        for pre-tier replicas and configs)."""
+        if not self.paged or self._host_kv is None:
+            return None
+        hk = self._host_kv.stats()
+        pt = self._prefix_prompt_tokens
+        hits = dict(self._tier_hit_tokens)
+        return {
+            "host": {"entries": hk["host_entries"],
+                     "capacity": hk["host_pages"],
+                     "bytes": hk["host_bytes"],
+                     "hit_tokens": hits["host"],
+                     "hit_ratio": hits["host"] / pt if pt else 0.0},
+            "disk": {"entries": hk["disk_entries"],
+                     "capacity": hk["disk_pages"],
+                     "loads": hk["disk_loads"],
+                     "quarantined": hk["quarantined"],
+                     "hit_tokens": hits["disk"],
+                     "hit_ratio": hits["disk"] / pt if pt else 0.0},
+            "hbm_hit_tokens": hits["hbm"],
+            "demotions": self._kv_demotions,
+            "promotions": self._kv_promotions,
+            "spilled_to_disk": hk["demotions_to_disk"],
+            "dropped": hk["dropped"],
+        }
 
     def _lora_args(self, pages):
         """(lora_tree, lora_rows) tail for the paged compiled programs.
@@ -1825,6 +2145,12 @@ class LLMEngine:
                     self._end_trace(req, "expired", where="queued")
                     continue
                 need = -(-(req.prompt.size + 1) // self.ps)
+                if self._host_kv is not None and not req.skip_cache \
+                        and len(self._host_kv):
+                    # hierarchical tiers: re-upload demoted blocks FIRST;
+                    # a promotion bumps the prefix epoch, so the match
+                    # below re-runs against the readmitted nodes
+                    self._promote_from_tiers(req)
                 matched, shared = 0, []
                 if self._prefix is not None and not req.skip_cache:
                     if req.match_epoch == self._prefix_epoch \
@@ -1895,6 +2221,13 @@ class LLMEngine:
                     # prefill is abandoned by a COW-starvation requeue
                     # (the skipped chunks get recomputed privately, so the
                     # hit never happened)
+                    if self._host_kv is not None:
+                        # tier attribution: whatever the promotion above
+                        # did not supply was already HBM-resident
+                        hbm = max(0, int(matched) - req.tier_hit_tokens)
+                        if hbm:
+                            _M_TIER_HITS.labels(tier="hbm").inc(hbm)
+                            self._tier_hit_tokens["hbm"] += hbm
                 # COW-fork provenance: the deepest shared page's donor
                 # trace links this admission back to the request whose
                 # prefill populated the prefix (rendered by /tracez as a

@@ -253,9 +253,12 @@ class PrefixCache:
     def evict_one(self, evictable):
         """Remove the least-recently-used LEAF whose page satisfies
         ``evictable(page)`` (the engine passes "held by nobody but the
-        cache").  Returns the freed page (caller decrefs) or None.  The
-        LRU scan is O(nodes) — the index is host-side and small next to a
-        page pool worth of HBM."""
+        cache").  Returns ``(key, tokens, page, ntok)`` of the evicted
+        node (caller decrefs the page) or None.  Returning the node's
+        identity — not just its page — is what lets the hierarchical-kv
+        demotion path look up / commit a host-tier copy WITHOUT
+        re-deriving the hash chain.  The LRU scan is O(nodes) — the index
+        is host-side and small next to a page pool worth of HBM."""
         best = None
         for node in self._nodes.values():
             if node.nchildren == 0 and evictable(node.page):
@@ -264,18 +267,60 @@ class PrefixCache:
         if best is None:
             return None
         self._remove(best)
-        return best.page
+        return best.key, best.tokens, best.page, best.ntok
 
     def evict_page(self, page):
         """Remove the leaf node holding ``page`` (the steal-back path: a
         slot about to write a tail page whose ONLY other holder is the
-        cache reclaims it in place instead of paying a copy).  Returns
-        True if a node was removed."""
+        cache reclaims it in place instead of paying a copy).  Returns the
+        removed node's ``(key, tokens, page, ntok)`` — same shape as
+        :meth:`evict_one`, so the demotion path treats both eviction
+        flavors identically — or None when no leaf holds the page."""
         for node in self._nodes.values():
             if node.page == page and node.nchildren == 0:
                 self._remove(node)
-                return True
-        return False
+                return node.key, node.tokens, node.page, node.ntok
+        return None
+
+    # ------------------------------------------------- hierarchical tiers
+
+    def node_info(self, key):
+        """(page, ntok) of the node at ``key`` or None — the demotion
+        worker's commit check: a staged host copy is only valid while the
+        node still exists on the SAME page (cached pages are frozen by the
+        COW rule, so same node + same page == same content)."""
+        node = self._nodes.get(key)
+        return (node.page, node.ntok) if node is not None else None
+
+    def lru_entries(self):
+        """Every node as ``(key, parent, page, ntok, tokens)``, least
+        recently used first — the demotion worker's candidate scan (it
+        stages cold entries host-side BEFORE eviction destroys them)."""
+        return [(n.key, n.parent, n.page, n.ntok, n.tokens)
+                for n in sorted(self._nodes.values(),
+                                key=lambda n: n.last_used)]
+
+    def readmit(self, key, parent, page, ntok, tokens=None):
+        """Re-insert a PROMOTED node (host/disk tier -> a freshly uploaded
+        device page) under its original chain key.  The caller walks the
+        chain in order, so ``parent`` is already present (or is the chain
+        seed); after readmission a re-run of :meth:`match` sees the page
+        exactly as if it had never been evicted.  Returns False (no-op)
+        when the key is already indexed — a concurrent prefill won the
+        race and the caller must roll its page back."""
+        if key in self._nodes:
+            return False
+        node = _Node(key, parent, page, ntok,
+                     None if tokens is None
+                     else np.asarray(tokens, np.int32))
+        self._nodes[key] = node
+        if node.tokens is not None:
+            self._partials.setdefault(parent, set()).add(key)
+        par = self._nodes.get(parent)
+        if par is not None:
+            par.nchildren += 1
+        self._touch(node)
+        return True
 
     def _remove(self, node):
         del self._nodes[node.key]

@@ -970,6 +970,25 @@ class Router:
             if stamp > 0:
                 now = time.time()  # tpulint: disable=impure-trace
                 d["last_compile_age_s"] = round(max(0.0, now - stamp), 1)
+            # Hierarchical-kv enrichment (PR 19): absent when the replica
+            # predates the tiers or runs with them off — a pre-tier
+            # replica must read as "no tiers", not "empty tiers".
+            host_bytes = self._sample(r.name, "llm_kv_host_pool_bytes",
+                                      default=None)
+            if host_bytes is not None:
+                tiers = {"host_pool_bytes": int(host_bytes)}
+                for tier in ("hbm", "host", "disk"):
+                    tok = self._sample(r.name, "llm_prefix_tier_hits_total",
+                                       selector={"tier": tier}, default=None)
+                    if tok is not None:
+                        tiers[f"{tier}_hit_tokens"] = int(tok)
+                lower = sum(tiers.get(k, 0) for k in
+                            ("host_hit_tokens", "disk_hit_tokens"))
+                total_tok = lower + tiers.get("hbm_hit_tokens", 0)
+                if total_tok:
+                    tiers["lower_tier_hit_ratio"] = round(
+                        lower / total_tok, 4)
+                d["kv_tiers"] = tiers
             replicas.append(d)
         return {
             "replicas": replicas,

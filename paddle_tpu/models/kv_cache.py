@@ -153,6 +153,33 @@ def cow_copy_pages(caches, src, dst):
     return [tuple(x.at[dst].set(x[src]) for x in c) for c in caches]
 
 
+def gather_pages_to_host(caches, pages):
+    """Gather the rows of page ids ``pages`` ([N] int32) across every
+    layer's pools in ONE batched program — the device half of a DEMOTION
+    (hierarchical kv: HBM -> host RAM).  ``caches`` is the engine's
+    per-layer list of pool tuples (k/v pools plus scale pools in the int8
+    layout; every element is ``[P, ...]`` page-major, the same contract as
+    :func:`cow_copy_pages`), so one generic row gather covers both
+    layouts.  Returns per-layer tuples of ``[N, ...]`` blocks; the caller
+    fetches them host-side (``np.asarray``) OUTSIDE any engine lock —
+    dispatch is async, the transfer is the blocking part."""
+    return [tuple(x[pages] for x in c) for c in caches]
+
+
+def upload_host_pages(caches, pages, blocks):
+    """Scatter host-staged page blocks back into the pools in ONE batched
+    program — the device half of a PROMOTION (host RAM -> HBM), the dual
+    of :func:`gather_pages_to_host`.  ``blocks`` mirrors the gather's
+    output: per-layer tuples of ``[N, ...]`` rows, scattered to page ids
+    ``pages`` ([N] int32).  Padding entries may target ``TRASH_PAGE``
+    (garbage rows land in the reserved page, never in live memory).  The
+    caller typically donates ``caches`` — after the upload the promoted
+    pages are indistinguishable from never-evicted ones (the ragged paged
+    kernel just walks page tables)."""
+    return [tuple(x.at[pages].set(b) for x, b in zip(c, blk))
+            for c, blk in zip(caches, blocks)]
+
+
 def _token_pages_rows(pos, page_tbl, S, page_size, max_pages):
     """Per-token (page id, row) for S new tokens starting at `pos` (scalar
     or [B]).  Positions past the table's coverage (a padded prefill tail

@@ -82,11 +82,16 @@ def test_lru_evicts_leaves_first_in_touch_order():
     # under the n-1 cap): B is now least recently used
     pc.match(np.concatenate([a, [99]]).astype(np.int32))
     evictable = lambda p: True  # noqa: E731
-    assert pc.evict_one(evictable) == 6   # B's tail (leaf) first
-    assert pc.evict_one(evictable) == 5   # then B0 (became a leaf)
+    # evict_one returns (key, tokens, page, ntok) — the demotion path's
+    # identity handoff (PR-19); page order is what LRU policy pins here
+    key, tokens, page, ntok = pc.evict_one(evictable)
+    assert page == 6 and ntok == 3          # B's tail (leaf) first
+    assert tokens.tolist() == [1, 2, 3] and isinstance(key, bytes)
+    key, tokens, page, ntok = pc.evict_one(evictable)
+    assert page == 5 and tokens is None and ntok == 4  # then B0 (now a leaf)
     # A0 has a child (A1): only A1 is a leaf
-    assert pc.evict_one(evictable) == 4
-    assert pc.evict_one(evictable) == 3
+    assert pc.evict_one(evictable)[2] == 4
+    assert pc.evict_one(evictable)[2] == 3
     assert pc.evict_one(evictable) is None and len(pc) == 0
 
 
@@ -94,7 +99,7 @@ def test_evict_one_respects_predicate():
     pc = PrefixCache(page_size=4)
     pc.insert(np.arange(4, dtype=np.int32), [3])
     assert pc.evict_one(lambda p: False) is None
-    assert pc.evict_one(lambda p: p == 3) == 3
+    assert pc.evict_one(lambda p: p == 3)[2] == 3
 
 
 def test_freeable_count_pins_ancestors_of_live_pages():
@@ -114,9 +119,10 @@ def test_freeable_count_pins_ancestors_of_live_pages():
 def test_evict_page_steal_back():
     pc = PrefixCache(page_size=4)
     pc.insert(np.arange(6, dtype=np.int32), [3, 4])
-    assert pc.evict_page(4) is True       # the tail leaf
-    assert pc.evict_page(4) is False      # already gone
-    assert pc.evict_page(3) is True       # now a leaf itself
+    key, tokens, page, ntok = pc.evict_page(4)   # the tail leaf
+    assert page == 4 and ntok == 2 and tokens.tolist() == [4, 5]
+    assert pc.evict_page(4) is None              # already gone
+    assert pc.evict_page(3)[2] == 3              # now a leaf itself
 
 
 # -------------------------------------------- allocator invariants (engine)

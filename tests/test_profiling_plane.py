@@ -359,3 +359,48 @@ def test_router_routerz_enriches_replicas_from_samples():
         assert "last_compile_age_s" not in by_name["r2"]
     finally:
         r.stop()
+
+
+def test_router_routerz_kv_tiers_absent_not_zero():
+    """Hierarchical-kv enrichment (PR 19): a replica exporting the tier
+    families gets a kv_tiers block with per-tier hit attribution; a
+    pre-tier replica keeps the key ABSENT (never an empty/zero block)."""
+    r = Router([("r1", "127.0.0.1:1"), ("r2", "127.0.0.1:2")])
+    try:
+        ss = obs_scrape.SampleSet()
+        ss.add("llm_kv_host_pool_bytes", {"target": "r1"}, 2.5e6)
+        ss.add("llm_prefix_tier_hits_total",
+               {"target": "r1", "tier": "hbm"}, 60.0)
+        ss.add("llm_prefix_tier_hits_total",
+               {"target": "r1", "tier": "host"}, 30.0)
+        ss.add("llm_prefix_tier_hits_total",
+               {"target": "r1", "tier": "disk"}, 10.0)
+        r._samples = ss
+        doc = r.routerz()
+        by_name = {d["name"]: d for d in doc["replicas"]}
+        tiers = by_name["r1"]["kv_tiers"]
+        assert tiers["host_pool_bytes"] == 2500000
+        assert tiers["hbm_hit_tokens"] == 60
+        assert tiers["host_hit_tokens"] == 30
+        assert tiers["disk_hit_tokens"] == 10
+        assert tiers["lower_tier_hit_ratio"] == 0.4
+        assert "kv_tiers" not in by_name["r2"]  # pre-PR-19 replica
+    finally:
+        r.stop()
+
+
+def test_fleetwatch_routerz_renders_kv_tier_column():
+    fw = _load_tool("fleetwatch")
+    out = fw.render_routerz({"replicas": [
+        {"name": "old", "state": "up", "target": "h:1", "restarts": 0},
+        {"name": "new", "state": "up", "target": "h:2", "restarts": 0,
+         "kv_tiers": {"host_pool_bytes": 2500000, "hbm_hit_tokens": 60,
+                      "host_hit_tokens": 30, "disk_hit_tokens": 10,
+                      "lower_tier_hit_ratio": 0.4}},
+    ], "affinity": {"entries": 0, "capacity": 1, "hits": 0, "misses": 0,
+                    "hit_ratio": 0.0, "blocks": 1, "page_size": 32}})
+    assert "KVTIERS" in out.splitlines()[0]
+    old = [ln for ln in out.splitlines() if ln.startswith("old")][0]
+    new = [ln for ln in out.splitlines() if ln.startswith("new")][0]
+    assert old.rstrip().endswith("-")  # absent tiers render a dash
+    assert "2.5MB/40%" in new

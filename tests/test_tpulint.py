@@ -639,6 +639,78 @@ def test_blocking_under_lock_condition_wait_stays_clean(tmp_path):
     assert len(hits) == 1 and hits[0].line == 10  # evt.wait only
 
 
+def test_blocking_under_lock_device_transfer_category(tmp_path):
+    """`jax.device_get` / bare `np.asarray` / `.block_until_ready()` under a
+    held lock are device->host transfers: the dispatch is async but the fetch
+    BLOCKS, so they get their own category (PR-19's demotion-worker rule)."""
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/engine.py": (
+        "import threading\n"
+        "import jax\n"
+        "import numpy as np\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def fetch(self, dev):\n"
+        "        with self._lock:\n"
+        "            return jax.device_get(dev)\n"
+        "    def snap(self, dev):\n"
+        "        with self._lock:\n"
+        "            return np.asarray(dev)\n"
+        "    def sync(self, dev):\n"
+        "        with self._lock:\n"
+        "            dev.block_until_ready()\n")})
+    hits = by_rule(out, "blocking-under-lock")
+    assert len(hits) == 3  # one per with-block
+    assert all("device-transfer" in f.message for f in hits)
+    assert all(f.severity == "error" for f in hits)  # inference/ is hot
+    assert {f.line for f in hits} == {9, 12, 15}
+
+
+def test_blocking_under_lock_device_transfer_clean_outside_lock(tmp_path):
+    """The same transfers OUTSIDE the lock (the demote worker's protocol:
+    dispatch under the lock, fetch outside) and inside nested defs stay
+    clean — deferred code never runs while the lock is held."""
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/engine.py": (
+        "import threading\n"
+        "import jax\n"
+        "import numpy as np\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def demote(self, dev):\n"
+        "        with self._lock:\n"
+        "            snap = dev\n"
+        "        return np.asarray(jax.device_get(snap))\n"
+        "    def deferred(self, dev):\n"
+        "        with self._lock:\n"
+        "            fn = lambda: np.asarray(dev)\n"
+        "        return fn\n")})
+    assert by_rule(out, "blocking-under-lock") == []
+
+
+def test_blocking_under_lock_device_transfer_vs_jit_dispatch(tmp_path):
+    """`jnp.asarray` stays jit-dispatch (async device upload); bare
+    `np.asarray` is device-transfer (blocking fetch) — the classifier must
+    not conflate the two directions."""
+    out = lint_tree(tmp_path, {"paddle_tpu/inference/engine.py": (
+        "import threading\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "class E:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def up(self, host):\n"
+        "        with self._lock:\n"
+        "            return jnp.asarray(host)\n"
+        "    def down(self, dev):\n"
+        "        with self._lock:\n"
+        "            return np.asarray(dev)\n")})
+    hits = sorted(by_rule(out, "blocking-under-lock"), key=lambda f: f.line)
+    assert len(hits) == 2
+    assert "jit-dispatch" in hits[0].message and hits[0].line == 9
+    assert "device-transfer" in hits[1].message and hits[1].line == 12
+
+
 # ----------------------------------------------------------- refcount-balance
 def test_refcount_early_return_skips_release_fires(tmp_path):
     out = lint_tree(tmp_path, {"paddle_tpu/inference/pool.py": (

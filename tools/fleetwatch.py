@@ -129,15 +129,25 @@ def render_routerz(doc):
     """Text fleet view of a router's /routerz document."""
     aff = doc.get("affinity", {})
     lines = ["REPLICA                       STATE        TARGET"
-             "                 RESTARTS  HBM%  COMPILED"]
+             "                 RESTARTS  HBM%  COMPILED  KVTIERS"]
     for r in doc.get("replicas", []):
         # pre-PR-14 routers omit these keys — render dashes, never crash
         hbm = r.get("hbm_utilization_ratio")
         hbm = f"{hbm * 100:.0f}%" if hbm is not None else "-"
         age = _fmt_age(r.get("last_compile_age_s"))
+        # pre-PR-19 replicas (or tiers off) omit kv_tiers entirely
+        tiers = r.get("kv_tiers")
+        if tiers is None:
+            kvt = "-"
+        else:
+            mb = tiers.get("host_pool_bytes", 0) / 1e6
+            ratio = tiers.get("lower_tier_hit_ratio")
+            kvt = f"{mb:.1f}MB"
+            if ratio is not None:
+                kvt += f"/{ratio * 100:.0f}%"
         lines.append(f"{r['name']:<28}  {r['state']:<11}"
                      f"  {r['target']:<20}  {r.get('restarts', 0):>8}"
-                     f"  {hbm:>4}  {age:>8}")
+                     f"  {hbm:>4}  {age:>8}  {kvt:>7}")
     lines.append("")
     occupancy = (f"{aff.get('entries', 0)}/{aff.get('capacity', 0)}"
                  if aff.get("capacity") else "0/0")

@@ -186,10 +186,11 @@ def project_serving_capacity(bench):
     rates and kv-cache capacity from the newest bench round, plus the paged
     layout's capacity at the same HBM budget and the PREFIX-CACHE capacity
     on the shared-prefix fleet trace.  Paged/prefix numbers come from the
-    round's kv_paged_* / kv_prefix_* fields when present; until a round
-    measures them, they are derived with the same trace accounting bench.py
-    uses (mixed lengths 100..L step 100 for paged; one shared system prompt
-    + varied tails for prefix, page_size 128) and labeled so."""
+    round's kv_paged_* / kv_prefix_* / kv_tier_* fields when present; until
+    a round measures them, they are derived with the same trace accounting
+    bench.py uses (mixed lengths 100..L step 100 for paged; one shared
+    system prompt + varied tails for prefix, page_size 128; host DRAM ~10x
+    HBM for the hierarchical kv tiers) and labeled so."""
     from bench import paged_capacity_trace, shared_prefix_trace
 
     tok8 = bench.get("llama_decode_steady_tokens_per_sec")
@@ -245,6 +246,29 @@ def project_serving_capacity(bench):
         else "derived from the paged numbers via the bench.py shared-prefix"
              " trace formula",
     }
+    # hierarchical kv tiers (host RAM + disk under the prefix cache): warm
+    # prefixes survive HBM eviction in a host pool and re-enter via one
+    # batched upload, so the WARM-SET capacity scales with host DRAM while
+    # decode throughput is untouched (demotion runs off the tick path).
+    # A v5e-class host hangs ~10x its per-chip HBM in DRAM off each chip,
+    # so the derived fallback multiplies the HBM prefix budget by 11 (HBM
+    # + 10x host); a measured round's kv_tier_* fields replace it.
+    measured_tier = "kv_tier_capacity_multiplier" in bench
+    dram_to_hbm = 10
+    tier_mult = bench.get("kv_tier_capacity_multiplier", 1 + dram_to_hbm)
+    out.update({
+        "kv_tier_capacity_multiplier": tier_mult,
+        "kv_tier_warm_prefix_pages": int(budget_pages * tier_mult),
+        "kv_tier_warm_prefix_batch": int(
+            (budget_pages * tier_mult - tr["shared_full_pages"])
+            // tr["unique_pages"]),
+        "kv_promote_us_per_page": bench.get("kv_promote_us_per_page"),
+        "kv_promote_vs_reprefill_ratio": bench.get(
+            "kv_promote_vs_reprefill_ratio"),
+        "tier_numbers_source": "measured (bench kv_tier_*)" if measured_tier
+        else f"derived: host DRAM ~{dram_to_hbm}x per-chip HBM, promotion "
+             "latency unmeasured until a round runs _bench_kv_tiers",
+    })
     if tok32q:
         out["pod_decode_tokens_per_sec_256chips_int8_b32"] = round(
             tok32q * 256, 0)
