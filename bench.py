@@ -1155,6 +1155,44 @@ def _bench_observability(on_accel):
     return out
 
 
+def _bench_goodput(on_accel):
+    """Goodput-ledger overhead guard (ISSUE 20): cost of one
+    section+carve+token step on an enabled vs disabled ledger.  The
+    ledger sits inside the engine tick and the recovery step loop, so
+    its enabled cost must stay in single-digit microseconds and its
+    disabled cost at ~one dict lookup — a regression here taxes every
+    step of every instrumented run.  Host-side by construction: runs on
+    CPU too."""
+    from paddle_tpu import observability as obs
+    from paddle_tpu.observability import goodput
+
+    iters = 20000 if on_accel else 5000
+
+    def window(led):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            with led.section("step"):
+                led.carve("compile", 1e-9)
+            led.count_tokens("useful", 1)
+        return (time.perf_counter() - t0) / iters
+
+    out = {}
+    try:
+        # median of 3 per mode, interleaved so drift lands on both sides
+        on_s, off_s = [], []
+        for _ in range(3):
+            obs.enable()
+            on_s.append(window(goodput.TimeLedger("train")))
+            obs.disable()
+            off_s.append(window(goodput.TimeLedger("train")))
+        on_med, off_med = sorted(on_s)[1], sorted(off_s)[1]
+        out["goodput_overhead_us_per_step"] = round(on_med * 1e6, 3)
+        out["goodput_disabled_us_per_step"] = round(off_med * 1e6, 3)
+    finally:
+        obs.enable()
+    return out
+
+
 def _bench_xplane_parse(on_accel):
     """Profiling-plane cost guard (ISSUE 14): wire-parse + per-op
     aggregation throughput of the dependency-free XPlane reader over a
@@ -1726,6 +1764,7 @@ def main(argv=None):
                     (_bench_vit, "vit"),
                     (_bench_ocr, "ocr"),
                     (_bench_observability, "observability"),
+                    (_bench_goodput, "goodput"),
                     (_bench_alerting, "alerting"),
                     (_bench_tracing, "tracing"),
                     (_bench_xplane_parse, "xplane"),

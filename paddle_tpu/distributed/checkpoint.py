@@ -48,6 +48,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs
 from ..observability.spans import span as _span
 
@@ -738,22 +739,28 @@ class CheckpointManager:
         if not force and not self.should_save(step):
             return None
         if not async_:
-            return self._save_sync(step, state, trace)
+            # goodput ledger: a sync save blocks the train loop for its
+            # whole write — the full span is checkpoint_save time
+            with _goodput.active_section("train", "checkpoint_save"):
+                return self._save_sync(step, state, trace)
         import threading
         from concurrent.futures import Future
 
-        fut = Future()
-        with self._async_cv:
-            self._async_queue.append((step, state, trace, fut))
-            self._async_pending += 1
-            # the worker unregisters itself (sets _async_thread=None)
-            # UNDER the condition before exiting, so this check can never
-            # race a dying worker into dropping the job
-            if self._async_thread is None:
-                self._async_thread = threading.Thread(
-                    target=self._async_worker, daemon=True,
-                    name="paddle-tpu-ckpt-save")
-                self._async_thread.start()
+        # goodput ledger: of an async save only this enqueue (and a later
+        # wait()) blocks the caller; the worker's write overlaps training
+        with _goodput.active_section("train", "checkpoint_save"):
+            fut = Future()
+            with self._async_cv:
+                self._async_queue.append((step, state, trace, fut))
+                self._async_pending += 1
+                # the worker unregisters itself (sets _async_thread=None)
+                # UNDER the condition before exiting, so this check can
+                # never race a dying worker into dropping the job
+                if self._async_thread is None:
+                    self._async_thread = threading.Thread(
+                        target=self._async_worker, daemon=True,
+                        name="paddle-tpu-ckpt-save")
+                    self._async_thread.start()
         return fut
 
     def _save_sync(self, step, state, trace=None):
@@ -795,14 +802,16 @@ class CheckpointManager:
         failure (then forgets it — the next wait() starts clean) and
         returns True; returns False when ``timeout`` elapses with saves
         still in flight."""
-        with self._async_cv:
-            done = self._async_cv.wait_for(
-                lambda: self._async_pending == 0, timeout=timeout)
-            if not done:
-                return False
-            if self._async_errors:
-                err, self._async_errors = self._async_errors[0], []
-                raise err
+        # goodput ledger: the join is the async save's other blocking slice
+        with _goodput.active_section("train", "checkpoint_save"):
+            with self._async_cv:
+                done = self._async_cv.wait_for(
+                    lambda: self._async_pending == 0, timeout=timeout)
+                if not done:
+                    return False
+                if self._async_errors:
+                    err, self._async_errors = self._async_errors[0], []
+                    raise err
         return True
 
     def _gc(self):

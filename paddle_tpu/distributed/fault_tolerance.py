@@ -31,6 +31,7 @@ import threading
 import time
 
 from ..observability import flight_recorder as _flight
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs
 from ..observability import tracing as _tracing
 from ..observability.spans import span as _span
@@ -159,7 +160,8 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
                       save_initial=True, on_event=None,
                       flight_recorder_dir=None, telemetry_port=None,
                       healthy_step_age=600.0, alert_policy=None,
-                      alert_every=1):
+                      alert_every=1, restart_backoff=None,
+                      goodput_ledger=None):
     """Run ``num_steps`` training steps under checkpoint-restore supervision.
 
     ``step_fn(step)`` performs one training step (a closure over the model /
@@ -207,6 +209,18 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
     keep), flight events carry its ``trace_id``, and the checkpoint
     histograms carry it as an exemplar — the crash dump's sibling
     ``traces_*.json`` holds the run's causal timeline.
+
+    Goodput plane (ISSUE 20): the whole run keeps a train
+    ``goodput.TimeLedger`` — step/compile (backend-compile seconds carved
+    out by the PR-14 ``record_compile`` hook)/checkpoint_save/restore/
+    restart_backoff leaves, idle the residual — published at every
+    episode boundary and conservation-checked + closed at run end; the
+    final snapshot is returned under ``"goodput"``.  Pass
+    ``goodput_ledger`` to own the ledger (e.g. to attribute
+    ``data_wait`` from inside ``step_fn``); ``restart_backoff`` (an
+    ``ExponentialBackoff``, default ``None`` = no delay) sleeps between
+    a recoverable failure and its restore — the production anti-herd
+    pause, attributed to the ``restart_backoff`` bucket.
     """
     recoverable = tuple(recoverable)
     if flight_recorder_dir is None:
@@ -241,6 +255,11 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
     restarts = 0
     dumped_exc = [None]  # the exception the inner handler already dumped
     tr = _tracing.start_trace("run_with_recovery", num_steps=int(num_steps))
+    # installed process-wide so CheckpointManager.save's async blocking
+    # slice and record_compile's backend-compile seconds land on THIS run
+    led = goodput_ledger if goodput_ledger is not None \
+        else _goodput.TimeLedger("train")
+    _goodput.install(led)
     # per-restart-attempt "episode" span, held open across the step loop;
     # steps coalesce into bounded "steps" summary spans inside it
     ep = {"span": None, "index": 0, "steps": 0, "t0": None}
@@ -266,10 +285,11 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
             _flush_steps()
             ep["span"].close(error=error)
             ep["span"] = None
+            led.publish()
 
     try:
         if manager.latest_step() is not None:
-            with tr.span("restore", resume=True):
+            with led.section("restore"), tr.span("restore", resume=True):
                 completed = _restore(manager, set_state, trace=tr)
             _flight.record_event("recovery_resumed", step=completed,
                                  **({"trace_id": tr.trace_id}
@@ -281,11 +301,12 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
             if save_initial:
                 # without an initial snapshot, a failure before the first
                 # periodic save would leave nothing to restore
-                manager.save(0, get_state(), force=True, trace=tr)
+                with led.section("checkpoint_save"):
+                    manager.save(0, get_state(), force=True, trace=tr)
         _open_episode(completed)
         while completed < num_steps:
             try:
-                with _span("recovery_step"):
+                with led.section("step"), _span("recovery_step"):
                     step_fn(completed)
                 completed += 1
                 ep["steps"] += 1
@@ -294,11 +315,13 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
                 # -> host sync) — only pay for it on steps that save
                 if completed == num_steps:
                     _flush_steps()
-                    manager.save(completed, get_state(), force=True,
-                                 trace=tr)
+                    with led.section("checkpoint_save"):
+                        manager.save(completed, get_state(), force=True,
+                                     trace=tr)
                 elif manager.should_save(completed):
                     _flush_steps()
-                    manager.save(completed, get_state(), trace=tr)
+                    with led.section("checkpoint_save"):
+                        manager.save(completed, get_state(), trace=tr)
                 if alert_policy is not None \
                         and completed % max(1, int(alert_every)) == 0:
                     for d in alert_policy.poll():
@@ -326,7 +349,13 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
                 if restarts > max_restarts:
                     raise
                 _M_RESTARTS.inc()
-                with tr.span("restore", after=repr(e)):
+                if restart_backoff is not None:
+                    pause = restart_backoff.delay(restarts)
+                    if pause > 0:
+                        with led.section("restart_backoff"):
+                            time.sleep(pause)
+                with led.section("restore"), tr.span("restore",
+                                                     after=repr(e)):
                     completed = _restore(manager, set_state, cause=e,
                                          trace=tr)
                 _flight.record_event("recovery_restored", step=completed)
@@ -335,7 +364,10 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
                     on_event("restored", {"step": completed, "error": e})
         _close_episode()
         tr.end("ok", completed=completed, restarts=restarts)
-        return {"completed": completed, "restarts": restarts}
+        # close asserts conservation: sum(buckets) == wall span (1e-6)
+        snap = led.close(reason="run_end")
+        return {"completed": completed, "restarts": restarts,
+                "goodput": snap}
     except BaseException as e:
         # anything escaping the supervisor is fatal to THIS run — including
         # a recoverable raised outside the step loop (a Preemption landing
@@ -346,8 +378,12 @@ def run_with_recovery(step_fn, num_steps, manager, get_state, set_state, *,
         if e is not dumped_exc[0]:
             _flight.record_event("fatal_failure", error=repr(e))
             _dump("fatal", error=repr(e))
+        # suppressed: a ledger bug must never mask the fatal error
+        with contextlib.suppress(Exception):
+            led.close(reason="fatal")
         raise
     finally:
+        _goodput.uninstall(led)
         if server is not None:
             server.stop()
 

@@ -26,6 +26,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..tensor.tensor import Tensor
 from ..framework import random as _random
 from ..jit._step_impl import build_step_fn, init_scaler_state
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs
 from ..observability import profiling as _profiling
 from ..observability import slo as _slo
@@ -264,7 +265,12 @@ class ShardedTrainStep:
         if not _obs.enabled():
             return self._step(*batch)
         compiled_call = self._jitted is None
-        with _span("sharded_train_step") as sp:
+        # goodput ledger: attributes to `step` on the active train ledger
+        # (backend-compile seconds inside a first call are carved out to
+        # `compile` by the record_compile hook); nested same-bucket under
+        # run_with_recovery's own step section — never double-counted
+        with _goodput.active_section("train", "step"), \
+                _span("sharded_train_step") as sp:
             out = self._step(*batch)
         self._record_step_metrics(sp.duration,
                                   tuple(getattr(b, "_value", b) for b in batch),

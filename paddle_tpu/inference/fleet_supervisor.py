@@ -51,6 +51,7 @@ from collections import deque
 
 from ..distributed.fault_tolerance import ExponentialBackoff
 from ..observability import flight_recorder as _flight
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs
 from .router import _http_json
 
@@ -257,8 +258,13 @@ class ReplicaSupervisor:
                     rep.state = "ready"
                     rep.backoff_attempt = 0
                     _M_BACKOFF.labels(replica=rep.name).set(0.0)
-                    _M_READY.observe(max(0.0,
-                                         self._clock() - rep.spawned_at))
+                    spawn_to_ready = max(0.0,
+                                         self._clock() - rep.spawned_at)
+                    _M_READY.observe(spawn_to_ready)
+                    # goodput ledger (ISSUE 20): spawn->ready window is
+                    # fleet capacity lost to the respawn — counter-only
+                    # (replica windows overlap one supervisor wall clock)
+                    _goodput.fleet_attribute("respawn", spawn_to_ready)
                     return True
             except Exception:
                 pass  # not bound yet / not healthy yet: keep gating
@@ -303,6 +309,10 @@ class ReplicaSupervisor:
         delay = self.backoff.delay(rep.backoff_attempt)
         rep.next_spawn_at = now + delay
         _M_BACKOFF.labels(replica=rep.name).set(delay)
+        # goodput ledger: the scheduled backoff window is capacity the
+        # fleet will not have — attributed at scheduling time (the window
+        # is fully determined here; tick() only waits it out)
+        _goodput.fleet_attribute("restart_backoff", delay)
         _flight.record_event("fleet_proc_death", replica=rep.name,
                              incarnation=rep.incarnation, reason=reason,
                              backoff_s=round(delay, 3))

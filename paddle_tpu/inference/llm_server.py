@@ -77,6 +77,7 @@ import jax.numpy as jnp
 from ..autograd import tape
 from ..ops import lora as _oplora
 from ..observability import flight_recorder as _flight
+from ..observability import goodput as _goodput
 from ..observability import metrics as _obs
 from ..observability import profiling as _profiling
 from ..observability import slo as _slo
@@ -173,6 +174,12 @@ _M_SPEC_ACCEPT_RATIO = _obs.gauge(
 _M_SPEC_VERIFY_S = _obs.histogram(
     "llm_spec_verify_seconds",
     "One compiled speculative verify call (K+1 positions per slot)")
+_M_RECOMPUTE_TOKENS = _obs.counter(
+    "llm_recompute_tokens_total",
+    "Prompt+prefix tokens re-prefilled after a requeue (page-pool-dry "
+    "or mid-verify preemption, COW-starved prefill) — the token cost of "
+    "preemption, feeding the goodput ledger's preempt_recomputed class",
+    labelnames=("reason",))
 _M_ADM_REORDERS = _obs.counter(
     "llm_admission_reorders_total",
     "Cache-aware admissions that bypassed the FIFO queue head")
@@ -645,6 +652,12 @@ class LLMEngine:
         self._spec_rolled_back = 0
         self._spec_rb_pages = 0
         self._spec_verifies = 0
+        self._recompute_tokens = 0  # prompt+prefix tokens re-prefilled
+        # goodput ledger (ISSUE 20): serve-domain wall-clock + token
+        # attribution; sections open only under the engine lock, so the
+        # conservation invariant (sum(buckets) == wall span) holds after
+        # every tick — tests assert it via self._goodput.check()
+        self._goodput = _goodput.TimeLedger("serve")
         self.cache_aware = bool(cache_aware_admission)
         self.admission_age_cap = max(1, int(admission_age_cap))
         if self.cache_aware and (not self.paged or self._prefix is None):
@@ -729,6 +742,11 @@ class LLMEngine:
             # refresh hbm_* gauges at scrape time + a /varz section
             self.telemetry.register_collect(
                 _profiling.poll_device_memory, varz_key="device_memory")
+            # goodput counters/ratio refresh at scrape time too (publish
+            # pushes the delta since the last scrape), and the ledger
+            # snapshot becomes a /varz section
+            self.telemetry.register_collect(
+                self._goodput.publish, varz_key="goodput")
             if self.paged and self._host_kv is not None:
                 # per-tier occupancy/hit-ratio on /varz — fleetwatch and
                 # the router read this absent-not-zero (older replicas
@@ -924,6 +942,7 @@ class LLMEngine:
                        cursor=cst.cursor() if cst is not None else None)
         if self._draining:
             _M_SHED.inc()
+            self._goodput.count_tokens("shed", int(arr.size))
             _flight.record_event("shed", reason="draining",
                                  prompt_len=int(arr.size), **_trace_kv(req))
             req.trace.end(status="shed", reason="draining")
@@ -936,6 +955,7 @@ class LLMEngine:
             self._pending.put_nowait(req)
         except queue.Full:
             _M_SHED.inc()
+            self._goodput.count_tokens("shed", int(arr.size))
             _flight.record_event("shed", queue_len=self.max_queue_len,
                                  prompt_len=int(arr.size), **_trace_kv(req))
             req.trace.end(status="shed", reason="queue_full")
@@ -1072,6 +1092,11 @@ class LLMEngine:
             # sliding-window percentiles + burn rates (observability.slo);
             # like the registry series these are process-global
             "slo": _slo.summary(prefix="llm_"),
+            # goodput ledger (ISSUE 20): wall-clock buckets + token classes
+            # for THIS engine — snapshot only, no conservation check here
+            # (stats() must never raise on a mid-tick scrape)
+            "goodput": self._goodput.snapshot(),
+            "recompute_tokens": self._recompute_tokens,
             # tracer sampling health (started/sampled/dropped + store
             # occupancy) — fleetwatch's view of whether /tracez is useful
             "tracing": self._tracer.stats(),
@@ -1464,6 +1489,9 @@ class LLMEngine:
         self.slot_req[slot] = req
         self.slot_pos[slot] = n
         self.last_token[slot] = tok
+        # the admission token IS the first token out: useful, like every
+        # decode-tick emission
+        self._goodput.count_tokens("useful", 1)
         _M_ADMITTED.inc()
         req.adm_span.close()
         req.adm_span = None
@@ -1702,23 +1730,39 @@ class LLMEngine:
         completes as a tier DEMOTION instead of destroying the prefix.
 
         Runs on the background demotion worker (start()), or synchronously
-        from tests/operators — NEVER on the decode tick.  Gated by the
-        free-page watermark unless ``force``.  Lock protocol: candidate
-        scan + ONE batched gather dispatch under the engine lock (dispatch
-        is async), the blocking device->host fetch OUTSIDE it, commit
-        under the lock again — the decode tick never waits on a transfer.
-        Cached pages are frozen (COW forks or steals them before any
-        write) and keys are content-addressed, so the fetched snapshot
-        commits unconditionally: even a page evicted mid-copy yields a
-        valid entry for its key.  Returns the number of pages staged."""
+        from tests/operators — NEVER on the decode tick.  Gated by page
+        AND device-memory pressure unless ``force``: demotion proceeds
+        when ``max(1 - free_page_ratio, hbm_utilization_ratio)`` crosses
+        ``1 - demote_watermark`` — a pool that still has free pages but
+        whose device is near its HBM limit (other pools, activation
+        spikes) starts staging early.  The HBM term reads the PR-14
+        ``memory_stats()`` poll and is absent-tolerant: CPU backends
+        report nothing, the term is 0, and the gate degrades to the
+        original free-page watermark.  Lock protocol: the memory poll
+        (a host call per device) runs BEFORE the engine lock; candidate
+        scan + ONE batched gather dispatch under the engine lock
+        (dispatch is async), the blocking device->host fetch OUTSIDE it,
+        commit under the lock again — the decode tick never waits on a
+        transfer.  Cached pages are frozen (COW forks or steals them
+        before any write) and keys are content-addressed, so the fetched
+        snapshot commits unconditionally: even a page evicted mid-copy
+        yields a valid entry for its key.  Returns the number of pages
+        staged."""
         if self._host_kv is None:
             return 0
+        hbm_pressure = 0.0
+        if not force:
+            hbm_pressure = max(
+                (row["utilization"]
+                 for row in _profiling.poll_device_memory()), default=0.0)
         with self._demote_mutex:
             with self._lock:
                 total = self.num_pages - 1
-                if not force and total and \
-                        len(self._free_pages) / total >= self.demote_watermark:
-                    return 0
+                if not force and total:
+                    pressure = max(
+                        1.0 - len(self._free_pages) / total, hbm_pressure)
+                    if pressure <= 1.0 - self.demote_watermark:
+                        return 0
                 cands = []
                 for key, parent, page, ntok, tokens \
                         in self._prefix.lru_entries():
@@ -1963,14 +2007,16 @@ class LLMEngine:
                 _M_PREFIX_HIT_RATIO.set(
                     self._prefix_hit_tokens / self._prefix_prompt_tokens)
 
-    def _preempt_slot(self, slot):
+    def _preempt_slot(self, slot, origin="decode"):
         """Preempt an in-flight request whose next token has no free page:
         reclaim its pages and REQUEUE it (recompute-style preemption) — the
         prompt is extended with the tokens generated so far, so
         re-admission re-prefills the full prefix and greedy decoding
         continues exactly where it left off.  A request already holding the
         entire pool can never fit and fails with ServerOverloadedError
-        instead of looping forever."""
+        instead of looping forever.  ``origin`` labels the recompute
+        counter: ``"verify"`` when the pool ran dry growing the K+1
+        verify ladder (mid-verify requeue), ``"decode"`` otherwise."""
         req = self.slot_req[slot]
         self.slot_req[slot] = None
         self.last_token[slot] = self.pad
@@ -1991,6 +2037,7 @@ class LLMEngine:
             _fail_future(req.future, ServerOverloadedError(
                 f"request needs more kv pages than the whole pool "
                 f"({self.num_pages - 1} pages x {self.ps} tokens); rejected"))
+            self._goodput.count_tokens("shed", int(req.prompt.size))
             self._end_trace(req, "shed", reason="pool_exhausted",
                             pages_held=int(held))
             return
@@ -1999,10 +2046,19 @@ class LLMEngine:
         req.trace.inc_attr("preempt_requeues")
         req.prompt = np.concatenate(
             [req.prompt, np.asarray(req.tokens, np.int32)])
+        # every token of the extended prompt (original prompt + generated
+        # so far) must be re-prefilled from scratch — preemption's token
+        # bill, on the registry counter and the goodput token ledger
+        recompute = int(req.prompt.size)
+        _M_RECOMPUTE_TOKENS.labels(
+            reason="mid_verify" if origin == "verify"
+            else "page_pool_dry").inc(recompute)
+        self._recompute_tokens += recompute
+        self._goodput.count_tokens("preempt_recomputed", recompute)
         with self._pending.mutex:
             self._pending.queue.appendleft(req)
 
-    def _ensure_decode_pages(self, active, eff):
+    def _ensure_decode_pages(self, active, eff, origin="decode"):
         """Grow each active slot's page table to cover the rows this tick
         will write (pos .. pos+eff-1), COW-forking any of those pages that
         are shared; preempt slots the pool cannot cover.  Returns the
@@ -2022,7 +2078,7 @@ class LLMEngine:
             if ok:
                 out.append(i)
             else:
-                self._preempt_slot(i)
+                self._preempt_slot(i, origin=origin)
         return out
 
     def _chunk_prefill_fn(self):
@@ -2173,6 +2229,7 @@ class LLMEngine:
                     _fail_future(req.future, ServerOverloadedError(
                         f"prompt needs {need} kv pages but the pool only "
                         f"has {self.num_pages - 1}; rejected"))
+                    self._goodput.count_tokens("shed", int(req.prompt.size))
                     self._end_trace(req, "shed", reason="pool_too_small",
                                     pages_needed=int(need))
                     continue
@@ -2294,6 +2351,13 @@ class LLMEngine:
                 req.adm_span = None
             req.requeue_reason = "prefill_cow"
             req.trace.inc_attr("preempt_requeues")
+            # the whole prompt re-prefills privately next episode — the
+            # chunks already written AND the cache-hit tokens just
+            # un-credited are all recomputed
+            recompute = int(req.prompt.size)
+            _M_RECOMPUTE_TOKENS.labels(reason="prefill_cow").inc(recompute)
+            self._recompute_tokens += recompute
+            self._goodput.count_tokens("preempt_recomputed", recompute)
             with self._pending.mutex:
                 self._pending.queue.appendleft(req)
             # clear the marker only after the requeue is visible, so
@@ -2308,6 +2372,7 @@ class LLMEngine:
                 jnp.asarray([done], jnp.int32),
                 jnp.asarray(m - 1, jnp.int32)) \
             + self._lora_args([req.adapter_page])
+        t_pf = time.perf_counter()
         try:
             jit = self._get_chunk_prefill()
             if _obs.enabled():
@@ -2331,6 +2396,12 @@ class LLMEngine:
                 raise
             return
         _M_PREFILL_CHUNKS.inc()
+        # goodput ledger: a first-episode chunk is productive prefill; a
+        # re-admission (adm_episode > 1: page-pool-dry, mid-verify or
+        # COW-starved requeue) recomputes kv it already computed once
+        self._goodput.carve(
+            "preempt_recompute_waste" if req.adm_episode > 1 else "prefill",
+            time.perf_counter() - t_pf)
         done += m
         if done < n:
             self._prefilling = (req, slot, done)
@@ -2345,6 +2416,9 @@ class LLMEngine:
         first = not req.tokens  # re-admission after preemption continues
         req.slot = slot
         req.tokens.append(tok)
+        # the final prefill chunk emits one token (both on first admission
+        # and on a post-preemption re-admission): useful either way
+        self._goodput.count_tokens("useful", 1)
         self.slot_req[slot] = req
         self.slot_pos[slot] = n
         self.last_token[slot] = tok
@@ -2644,9 +2718,17 @@ class LLMEngine:
                 out = self._step_locked()
                 self._first_tick_done = True
                 return out
-            with _span("llm_decode_tick", _M_TICK_SECONDS) as sp:
-                emitted = self._step_locked()
+            # goodput ledger: a draining tick runs under a queue_drain
+            # section — the compute carves (decode/prefill/verify) debit
+            # it, so queue_drain holds only the drain's overhead slice
+            drain_sec = (self._goodput.section("queue_drain")
+                         if self._draining else _goodput.NULL)
+            with drain_sec:
+                with _span("llm_decode_tick", _M_TICK_SECONDS) as sp:
+                    emitted = self._step_locked()
             self._first_tick_done = True
+            if emitted:
+                self._goodput.count_tokens("useful", emitted)
             if sp.duration:
                 _slo.track("llm_tick", sp.duration)
             if emitted and sp.duration:
@@ -2690,6 +2772,7 @@ class LLMEngine:
             self._update_page_gauges()
             if not active:
                 return 0
+        t_dec = time.perf_counter()
         jit = self._decode_jit.get(eff)
         if jit is None:
             _profiling.record_compile("decode")
@@ -2741,6 +2824,10 @@ class LLMEngine:
         # rebuilds the per-slot positions (finished slots do not advance)
         self.caches = new_caches
         nxt = np.asarray(nxt_dev).astype(np.int32)  # [B, eff]
+        # goodput ledger: arg staging + compiled call + the host sync that
+        # materializes it — productive decode seconds (token bookkeeping
+        # below stays in the idle/queue_drain residual)
+        self._goodput.carve("decode", time.perf_counter() - t_dec)
         if _obs.enabled():
             # per-request decode accounting for the coalesced trace
             # summary spans: one stamp per tick, not per token
@@ -2793,7 +2880,8 @@ class LLMEngine:
             # the verify writes rows pos .. pos+K: grow/COW the page
             # tables for all K+1 rows up front; a slot the pool cannot
             # cover mid-verify preempts recompute-style, same as decode
-            active = self._ensure_decode_pages(active, K + 1)
+            active = self._ensure_decode_pages(active, K + 1,
+                                               origin="verify")
             self._update_page_gauges()
             if not active:
                 return 0
@@ -2881,6 +2969,19 @@ class LLMEngine:
             if self.paged and self.slot_req[i] is not None:
                 rb_pages += self._trim_rollback_pages(i)
         rolled = drafted_tick - accepted_tick
+        # goodput ledger: split the draft+verify compute by acceptance —
+        # the rejected-draft share of the window bought nothing, so it is
+        # spec_rollback_waste, not verify; rolled tokens join the token
+        # ledger's waste class
+        spec_s = draft_s + verify_s
+        if drafted_tick:
+            waste_s = spec_s * (rolled / drafted_tick)
+            self._goodput.carve("verify", spec_s - waste_s)
+            self._goodput.carve("spec_rollback_waste", waste_s)
+        else:
+            self._goodput.carve("verify", spec_s)
+        if rolled:
+            self._goodput.count_tokens("spec_rolled_back", rolled)
         self._spec_drafted += drafted_tick
         self._spec_accepted += accepted_tick
         self._spec_rolled_back += rolled

@@ -989,6 +989,13 @@ class Router:
                     tiers["lower_tier_hit_ratio"] = round(
                         lower / total_tok, 4)
                 d["kv_tiers"] = tiers
+            # Goodput-ledger enrichment (PR 20): absent when the replica
+            # predates the ledger — fleetwatch renders a dash, never 0.0
+            # (a 0.0 goodput ratio means "all waste", a real alarm).
+            gp = self._sample(r.name, "goodput_ratio",
+                              selector={"domain": "serve"}, default=None)
+            if gp is not None:
+                d["goodput_ratio"] = round(gp, 4)
             replicas.append(d)
         return {
             "replicas": replicas,

@@ -179,7 +179,8 @@ def default_rules(queue_depth=64, burn_rate=0.5, staleness_s=60.0,
     rate, component healthchecks (including the LLM pump heartbeat-age
     check), store deadline pressure, serving backlog, recovery restart
     storms, post-warmup recompilation storms, roofline residual
-    regressions, and the scraper's own target liveness/staleness."""
+    regressions, sustained goodput degradation, and the scraper's own
+    target liveness/staleness."""
     return [
         Rule("slo_burn_rate_high", kind="burn_rate", threshold=burn_rate,
              for_s=30.0,
@@ -237,6 +238,16 @@ def default_rules(queue_depth=64, burn_rate=0.5, staleness_s=60.0,
              metric="exporter_scrapes_total", for_s=30.0, severity="ticket",
              description="a previously-reporting telemetry exporter's "
                          "series vanished from the scrape"),
+        # absence of the family never fires this (threshold rules skip
+        # targets without samples) — only a ledger that IS reporting and
+        # IS mostly waste trips it
+        Rule("goodput_degraded", metric="goodput_ratio", op="<",
+             threshold=0.5, for_s=60.0, severity="ticket",
+             description="a goodput ledger reports less than half its "
+                         "wall clock in productive buckets (step / "
+                         "decode+prefill+verify) for a sustained minute — "
+                         "restarts, preemption recompute, or spec "
+                         "rollback are eating the fleet"),
     ]
 
 

@@ -1,0 +1,381 @@
+"""Goodput ledger (ISSUE 20): exhaustive wall-clock and token
+attribution — where did every monotonic second GO?
+
+Every plane so far answers "how fast was X" (SLO quantiles, span trees,
+per-HLO roofline residuals); none answers for the *denominator*.  A run
+that restarts twice, recompiles after warmup, and rolls back half its
+speculative drafts can post healthy step latencies while wasting a
+third of its compute.  :class:`TimeLedger` attributes every second of a
+run's wall span to exactly ONE leaf bucket:
+
+===========  ============================================================
+domain       buckets (productive ones starred)
+===========  ============================================================
+``train``    ``step``\\*, ``compile``, ``checkpoint_save`` (sync saves;
+             of an ``async_=True`` save only its blocking enqueue/wait
+             slice), ``restore``, ``restart_backoff``, ``data_wait``,
+             ``idle``
+``serve``    ``decode``\\*, ``prefill``\\*, ``verify``\\* (spec draft +
+             verify, acceptance-weighted), ``spec_rollback_waste``,
+             ``preempt_recompute_waste``, ``queue_drain``, ``idle``
+``fleet``    ``respawn``, ``restart_backoff`` — counter-only (see below)
+===========  ============================================================
+
+Attribution model
+-----------------
+``section(bucket)`` context managers form a nesting stack; a child's
+elapsed time is subtracted from its parent's frame on exit, so leaves
+are mutually exclusive BY CONSTRUCTION and ``idle`` is the residual
+``wall - sum(explicit)``.  That makes the conservation invariant
+
+    ``sum(buckets) + idle == wall span``  (tolerance 1e-6)
+
+machine-checkable: :meth:`check` recomputes the wall span independently
+and raises :class:`LedgerError` on violation (the only way to violate
+it is double-counting — two threads opening sections on one ledger
+concurrently, which no instrumented seam does: train is
+single-threaded, serve sections open only under the engine lock).
+:meth:`close` runs the check, publishes, and files a
+``goodput_ledger`` flight-recorder event — the dump shape
+``tools/goodput_report.py --flight`` renders.
+
+``carve(bucket, seconds)`` credits a bucket for time that elapsed
+*inside* the innermost open section (debited from that section's frame
+like a virtual child) — the PR-14 ``record_compile`` hook carves XLA
+backend-compile seconds out of the surrounding ``step`` into
+``compile``, and the spec tick carves the rejected-draft share of its
+verify window into ``spec_rollback_waste``.  Conservation is unaffected
+(carving moves seconds between leaves, never mints them).
+
+The parallel token ledger counts ``useful`` emitted tokens against
+``spec_rolled_back`` / ``preempt_recomputed`` / ``shed`` waste classes.
+
+The ``fleet`` domain (``ReplicaSupervisor`` respawn + backoff windows)
+is counter-only via :func:`fleet_attribute`: N replicas back off
+concurrently against one supervisor wall clock, so a per-process
+conservation invariant cannot hold there — the counters still feed
+``goodput_seconds_total`` for fleet aggregation.
+
+Cost discipline: every record path is gated on the same
+``metrics._runtime["enabled"]`` dict lookup as spans / flight events —
+``bench.py _bench_goodput`` guards the disabled path next to
+``obs_overhead``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+from . import metrics as _metrics
+from . import flight_recorder as _flight
+
+__all__ = [
+    "TimeLedger", "LedgerError", "TRAIN_BUCKETS", "SERVE_BUCKETS",
+    "FLEET_BUCKETS", "TOKEN_CLASSES", "PRODUCTIVE", "NULL",
+    "install", "uninstall", "active", "active_section", "on_compile",
+    "fleet_attribute",
+]
+
+TRAIN_BUCKETS = ("step", "compile", "checkpoint_save", "restore",
+                 "restart_backoff", "data_wait", "idle")
+SERVE_BUCKETS = ("decode", "prefill", "verify", "spec_rollback_waste",
+                 "preempt_recompute_waste", "queue_drain", "idle")
+FLEET_BUCKETS = ("respawn", "restart_backoff")
+TOKEN_CLASSES = ("useful", "spec_rolled_back", "preempt_recomputed",
+                 "shed")
+
+#: Buckets that count toward the goodput numerator, per domain.
+PRODUCTIVE = {
+    "train": ("step",),
+    "serve": ("decode", "prefill", "verify"),
+    "fleet": (),
+}
+
+_M_SECONDS = _metrics.counter(
+    "goodput_seconds_total",
+    "Wall seconds attributed per ledger leaf bucket — mutually "
+    "exclusive; per domain, sum(buckets incl. idle) equals the wall "
+    "span (fleet buckets are counter-only: overlapping replica windows)",
+    labelnames=("domain", "bucket"))
+_M_TOKENS = _metrics.counter(
+    "goodput_tokens_total",
+    "Token ledger: useful emitted tokens vs spec_rolled_back / "
+    "preempt_recomputed / shed waste classes",
+    labelnames=("domain", "class"))
+_M_RATIO = _metrics.gauge(
+    "goodput_ratio",
+    "Productive seconds (train: step; serve: decode+prefill+verify) "
+    "over total wall span, cumulative since ledger start",
+    labelnames=("domain",))
+
+
+class LedgerError(AssertionError):
+    """Conservation invariant violated (double-counted wall time)."""
+
+
+class _NullSection:
+    """No-op section: the disabled path and absent-active-ledger path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL = _NullSection()
+
+
+class _Section:
+    __slots__ = ("_led", "bucket", "_t0", "_child")
+
+    def __init__(self, led, bucket):
+        self._led = led
+        self.bucket = bucket
+        self._t0 = None
+        self._child = 0.0
+
+    def __enter__(self):
+        self._t0 = self._led._clock()
+        self._child = 0.0
+        with self._led._lock:
+            self._led._stack.append(self)
+        return self
+
+    def __exit__(self, etype, exc, tb):
+        led = self._led
+        elapsed = max(0.0, led._clock() - self._t0)
+        with led._lock:
+            stack = led._stack
+            if stack and stack[-1] is self:
+                stack.pop()
+            elif self in stack:  # defensive: misnested exit
+                stack.remove(self)
+            led._buckets[self.bucket] = (
+                led._buckets.get(self.bucket, 0.0)
+                + max(0.0, elapsed - self._child))
+            if stack:
+                stack[-1]._child += elapsed
+        return False
+
+
+class TimeLedger:
+    """One domain's wall-clock + token attribution, conservation-checked.
+
+    The wall span opens at construction (monotonic clock; injectable
+    for deterministic tests).  All mutators are gated on the process
+    observability flag — with the plane disabled a ledger attributes
+    nothing and every second lands in ``idle``."""
+
+    def __init__(self, domain, buckets=None, productive=None,
+                 clock=time.perf_counter, token_classes=TOKEN_CLASSES):
+        self.domain = str(domain)
+        if buckets is None:
+            buckets = {"train": TRAIN_BUCKETS, "serve": SERVE_BUCKETS,
+                       "fleet": FLEET_BUCKETS}.get(self.domain, ())
+        self.productive = tuple(
+            PRODUCTIVE.get(self.domain, ()) if productive is None
+            else productive)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._buckets = {b: 0.0 for b in buckets if b != "idle"}
+        self._tokens = {c: 0 for c in token_classes}
+        self._stack = []
+        self._t0 = clock()
+        self._pub_seconds = {}  # bucket -> already-published seconds
+        self._pub_tokens = {}   # class  -> already-published count
+        self._closed = False
+
+    # ------------------------------------------------------------ recording
+    def section(self, bucket):
+        """Context manager attributing its (exclusive) elapsed time to
+        ``bucket``.  Nested sections subtract from their parent."""
+        if not _metrics._runtime["enabled"]:
+            return NULL
+        return _Section(self, str(bucket))
+
+    def carve(self, bucket, seconds):
+        """Credit ``bucket`` for ``seconds`` that elapsed inside the
+        innermost open section (debited from that section's frame like
+        a virtual child; with no section open, the credit comes out of
+        the idle residual)."""
+        s = float(seconds)
+        if not _metrics._runtime["enabled"] or s <= 0.0:
+            return
+        bucket = str(bucket)
+        with self._lock:
+            self._buckets[bucket] = self._buckets.get(bucket, 0.0) + s
+            if self._stack:
+                self._stack[-1]._child += s
+
+    def transfer(self, src, dst, seconds):
+        """Post-hoc move of already-credited seconds between buckets
+        (clamped to what ``src`` holds)."""
+        if not _metrics._runtime["enabled"]:
+            return
+        with self._lock:
+            s = max(0.0, min(float(seconds), self._buckets.get(src, 0.0)))
+            if s <= 0.0:
+                return
+            self._buckets[src] -= s
+            self._buckets[dst] = self._buckets.get(dst, 0.0) + s
+
+    def count_tokens(self, cls, n):
+        n = int(n)
+        if not _metrics._runtime["enabled"] or n <= 0:
+            return
+        cls = str(cls)
+        with self._lock:
+            self._tokens[cls] = self._tokens.get(cls, 0) + n
+
+    # ------------------------------------------------------------ reporting
+    def wall(self, now=None):
+        return max(0.0, (self._clock() if now is None else now) - self._t0)
+
+    def _raw(self, now=None):
+        """Unrounded (wall, buckets-with-idle, tokens) triple — the
+        conservation check must see full precision, not the 6-decimal
+        JSON rounding (7 rounded buckets can drift past 1e-6)."""
+        wall = self.wall(now)
+        with self._lock:
+            buckets = dict(self._buckets)
+            tokens = dict(self._tokens)
+        buckets["idle"] = wall - sum(buckets.values())
+        return wall, buckets, tokens
+
+    def snapshot(self, now=None):
+        """JSON shape for ``stats()["goodput"]`` / ``/varz``: every
+        bucket (idle materialized as the residual), the token classes,
+        and the cumulative goodput ratio."""
+        wall, buckets, tokens = self._raw(now)
+        productive = sum(buckets.get(b, 0.0) for b in self.productive)
+        return {
+            "domain": self.domain,
+            "wall_s": round(wall, 6),
+            "ratio": round(productive / wall, 6) if wall > 0 else 0.0,
+            "buckets": {b: round(v, 6) for b, v in buckets.items()},
+            "tokens": tokens,
+        }
+
+    def check(self, tolerance=1e-6, now=None):
+        """Assert the conservation invariant; returns the snapshot.
+        Raises :class:`LedgerError` when sum(buckets) diverges from the
+        wall span or any leaf went negative (double-counted time)."""
+        now = self._clock() if now is None else now
+        wall, buckets, tokens = self._raw(now)
+        total = sum(buckets.values())
+        if abs(total - wall) > tolerance:
+            raise LedgerError(
+                f"goodput[{self.domain}]: sum(buckets)={total!r} != "
+                f"wall={wall!r} (tolerance {tolerance})")
+        for b, v in buckets.items():
+            if v < -tolerance:
+                raise LedgerError(
+                    f"goodput[{self.domain}]: bucket {b!r} negative "
+                    f"({v!r}) — wall time double-counted")
+        for c, n in tokens.items():
+            if n < 0:
+                raise LedgerError(
+                    f"goodput[{self.domain}]: token class {c!r} "
+                    f"negative ({n})")
+        return self.snapshot(now)
+
+    def publish(self, now=None):
+        """Push the delta since the last publish onto the registry
+        counters and refresh the ratio gauge; returns the snapshot.
+        Registered as a telemetry pre-scrape collect hook (the hbm_*
+        idiom), so scrapes always see current attribution."""
+        snap = self.snapshot(now)
+        if not _metrics._runtime["enabled"]:
+            return snap
+        with self._lock:
+            for b, v in snap["buckets"].items():
+                if b == "idle":
+                    continue  # residual, not a counter: derivable
+                d = v - self._pub_seconds.get(b, 0.0)
+                if d > 0:
+                    _M_SECONDS.labels(domain=self.domain, bucket=b).inc(d)
+                    self._pub_seconds[b] = v
+            idle = snap["buckets"].get("idle", 0.0)
+            d = idle - self._pub_seconds.get("idle", 0.0)
+            if d > 0:
+                _M_SECONDS.labels(domain=self.domain, bucket="idle").inc(d)
+                self._pub_seconds["idle"] = idle
+            for c, n in snap["tokens"].items():
+                d = n - self._pub_tokens.get(c, 0)
+                if d > 0:
+                    _M_TOKENS.labels(domain=self.domain,
+                                     **{"class": c}).inc(d)
+                    self._pub_tokens[c] = n
+        _M_RATIO.labels(domain=self.domain).set(snap["ratio"])
+        return snap
+
+    def close(self, reason="close", tolerance=1e-6):
+        """End of the measured span: conservation-check, publish, and
+        file the ``goodput_ledger`` flight event (the shape
+        ``goodput_report --flight`` renders).  Idempotent."""
+        if self._closed:
+            return self.snapshot()
+        now = self._clock()
+        snap = self.check(tolerance, now=now)
+        self.publish(now=now)
+        self._closed = True
+        _flight.record_event(
+            "goodput_ledger", domain=self.domain, reason=str(reason),
+            wall_s=snap["wall_s"], ratio=snap["ratio"],
+            buckets=snap["buckets"], tokens=snap["tokens"])
+        return snap
+
+
+# --------------------------------------------------- active-ledger registry
+# One ledger per domain may be "installed" process-wide so seams that
+# cannot thread a ledger through their signature (CheckpointManager.save,
+# the record_compile hook) still attribute to the run that owns them.
+_active = {}
+_active_lock = threading.Lock()
+
+
+def install(ledger):
+    """Make ``ledger`` the process-wide active ledger for its domain."""
+    with _active_lock:
+        _active[ledger.domain] = ledger
+    return ledger
+
+
+def uninstall(ledger):
+    """Remove ``ledger`` if it is still the active one for its domain."""
+    with _active_lock:
+        if _active.get(ledger.domain) is ledger:
+            del _active[ledger.domain]
+
+
+def active(domain):
+    return _active.get(domain)
+
+
+def active_section(domain, bucket):
+    """``section(bucket)`` on the active ledger for ``domain`` — the
+    no-op singleton when none is installed or the plane is disabled."""
+    if not _metrics._runtime["enabled"]:
+        return NULL
+    led = _active.get(domain)
+    return NULL if led is None else led.section(bucket)
+
+
+def on_compile(seconds):
+    """PR-14 hook: ``record_compile`` reports XLA backend-compile
+    seconds here; carved out of the active train ledger's surrounding
+    section (normally ``step``) into ``compile``."""
+    led = _active.get("train")
+    if led is not None:
+        led.carve("compile", seconds)
+
+
+def fleet_attribute(bucket, seconds):
+    """Counter-only attribution for the fleet domain (respawn/backoff
+    windows overlap across replicas, so no conservation invariant)."""
+    s = float(seconds)
+    if not _metrics._runtime["enabled"] or s <= 0.0:
+        return
+    _M_SECONDS.labels(domain="fleet", bucket=str(bucket)).inc(s)

@@ -41,6 +41,7 @@ import time
 
 from . import metrics as _metrics
 from . import flight_recorder as _flight
+from . import goodput as _goodput
 from . import xplane as _xplane
 
 __all__ = [
@@ -105,6 +106,10 @@ def record_compile(fn, seconds=None, warm=None):
     _M_LAST_COMPILE.set(time.time())  # tpulint: disable=impure-trace
     if seconds is not None:
         _M_COMPILE_S.observe(float(seconds))
+        # goodput ledger (ISSUE 20): backend-compile seconds are the one
+        # timed compile source, carved out of the active train ledger's
+        # surrounding `step` section into its `compile` bucket
+        _goodput.on_compile(float(seconds))
     if _state["warm"] if warm is None else warm:
         _M_RECOMPILES.labels(fn=fn).inc()
 

@@ -204,7 +204,7 @@ def test_elastic_run_restart_body_recovers(tmp_path):
     report = em.run(step_fn, 6, cm, samples_fn=samples_fn,
                     get_state=lambda: {"w": box["w"]},
                     set_state=lambda s: box.__setitem__("w", s["w"]))
-    assert report == {"completed": 6, "restarts": 1}
+    assert (report["completed"], report["restarts"]) == (6, 1)
     assert em.check() != ElasticStatus.RESTART  # decision was consumed
     assert len(executed) > 6  # the interrupted step really replayed
     assert np.asarray(box["w"]).tobytes() == np.asarray(ref).tobytes()

@@ -533,7 +533,7 @@ def test_recovery_preemption_dump_and_telemetry_endpoint(tmp_path):
             telemetry_port=0, on_event=on_event)
     finally:
         ex.TelemetryServer.start = orig_start
-    assert report == {"completed": 4, "restarts": 1}
+    assert (report["completed"], report["restarts"]) == (4, 1)
     assert float(state["x"][0]) == 4.0
     dumps = [n for n in os.listdir(tmp_path / "ck" / "flight_recorder")
              if n.startswith("flight_recoverable_")]

@@ -77,10 +77,12 @@ def _fmt_age(seconds):
 
 
 def _target_extras(samples, name, wall_now):
-    """(hbm%, last-compile age) for one scrape target — dashes when the
-    target predates the profiling plane (PR 14) or runs on a backend
-    with no memory_stats."""
-    hbm, age = "-", "-"
+    """(hbm%, last-compile age, goodput%) for one scrape target — dashes
+    when the target predates the profiling plane (PR 14) / goodput
+    ledger (PR 20) or runs on a backend with no memory_stats.  A dash is
+    load-bearing: 0% goodput means "all waste", a real alarm, so an
+    absent family must never render as 0."""
+    hbm, age, goodput = "-", "-", "-"
     if samples is not None:
         hits = samples.match("hbm_utilization_ratio", {"target": name})
         if hits:
@@ -90,19 +92,23 @@ def _target_extras(samples, name, wall_now):
         stamp = max((v for _, v in hits), default=0.0)
         if stamp > 0 and wall_now is not None:
             age = _fmt_age(max(0.0, wall_now - stamp))
-    return hbm, age
+        hits = samples.match("goodput_ratio", {"target": name})
+        if hits:  # worst domain: a train+serve colocation shows its pain
+            goodput = f"{min(v for _, v in hits) * 100:.0f}%"
+    return hbm, age, goodput
 
 
 def render_status(results, state, now, samples=None, wall_now=None):
     """Text status table: targets first, then every non-inactive alert."""
     lines = ["TARGET                        UP  DURATION  ATTEMPTS  "
-             "HBM%  COMPILED  ERROR"]
+             "HBM%  COMPILED  GOODPUT  ERROR"]
     for r in results:
-        hbm, age = _target_extras(samples, r.target.name, wall_now)
+        hbm, age, goodput = _target_extras(samples, r.target.name,
+                                           wall_now)
         lines.append(
             f"{r.target.name:<28}  {'up' if r.ok else 'DOWN':<4}"
             f"{r.duration_s * 1000:7.1f}ms  {r.attempts:>8}  "
-            f"{hbm:>4}  {age:>8}  "
+            f"{hbm:>4}  {age:>8}  {goodput:>7}  "
             f"{(r.error or '-')[:40]}")
     lines.append("")
     lines.append("ALERT                      STATE     SINCE  VALUE"
@@ -129,12 +135,15 @@ def render_routerz(doc):
     """Text fleet view of a router's /routerz document."""
     aff = doc.get("affinity", {})
     lines = ["REPLICA                       STATE        TARGET"
-             "                 RESTARTS  HBM%  COMPILED  KVTIERS"]
+             "                 RESTARTS  HBM%  COMPILED  GOODPUT  KVTIERS"]
     for r in doc.get("replicas", []):
         # pre-PR-14 routers omit these keys — render dashes, never crash
         hbm = r.get("hbm_utilization_ratio")
         hbm = f"{hbm * 100:.0f}%" if hbm is not None else "-"
         age = _fmt_age(r.get("last_compile_age_s"))
+        # pre-PR-20 replicas omit goodput_ratio — dash, never 0%
+        gp = r.get("goodput_ratio")
+        gp = f"{gp * 100:.0f}%" if gp is not None else "-"
         # pre-PR-19 replicas (or tiers off) omit kv_tiers entirely
         tiers = r.get("kv_tiers")
         if tiers is None:
@@ -147,7 +156,7 @@ def render_routerz(doc):
                 kvt += f"/{ratio * 100:.0f}%"
         lines.append(f"{r['name']:<28}  {r['state']:<11}"
                      f"  {r['target']:<20}  {r.get('restarts', 0):>8}"
-                     f"  {hbm:>4}  {age:>8}  {kvt:>7}")
+                     f"  {hbm:>4}  {age:>8}  {gp:>7}  {kvt:>7}")
     lines.append("")
     occupancy = (f"{aff.get('entries', 0)}/{aff.get('capacity', 0)}"
                  if aff.get("capacity") else "0/0")

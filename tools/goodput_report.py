@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""goodput_report — render a goodput ledger's wall-clock and token
+attribution (paddle_tpu.observability.goodput as a CLI), and gate on it.
+
+Live mode — scrape one telemetry endpoint's /metrics::
+
+    python tools/goodput_report.py HOST:PORT [--threshold 0.5] [--json]
+
+The `goodput_seconds_total{domain,bucket}` counters are re-aggregated
+per domain into the bucket table (idle included — per domain the buckets
+sum to the wall span, that is the ledger's conservation invariant), the
+goodput ratio is derived as productive/wall from the same counters, and
+`goodput_tokens_total{domain,class}` fills the token line.
+
+Flight mode — read a flight-recorder dump instead of a live process::
+
+    python tools/goodput_report.py --flight DUMP.jsonl [--threshold ...]
+    python tools/goodput_report.py --flight DUMPDIR
+
+Renders the LAST `goodput_ledger` event per domain from the dump (a
+directory picks the newest `flight_*.jsonl` inside it) — the post-mortem
+view of a run that already closed its ledger.
+
+`--threshold R` turns the report into a gate: exit 2 when any reporting
+domain's goodput ratio is below R.  Domains with no productive buckets
+defined (fleet) never trip the gate.  Exit 1 means NO goodput data at
+all — distinct from healthy, so a cron gate cannot rot silently when a
+replica stops exporting the family.
+
+`--selftest` runs the embedded corpus: a healthy and a degraded canned
+exposition must produce the golden ratios and gate decisions, and a
+canned flight dump must render.  Exit 0 = healthy.
+
+Exit codes: 0 healthy report; 1 no goodput data; 2 `--threshold` tripped.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+__all__ = ["main", "build_report", "gate"]
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _plane():
+    if _REPO not in sys.path:
+        sys.path.insert(0, _REPO)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from paddle_tpu.observability import goodput, scrape
+    return goodput, scrape
+
+
+# ------------------------------------------------------------------- build
+def build_report(samples, productive_map):
+    """Per-domain report rows from a SampleSet of goodput_* families:
+    ``{domain: {"wall_s", "ratio", "buckets", "tokens"}}`` — ``ratio``
+    is None for domains with no productive buckets defined (fleet:
+    counter-only, no conservation, nothing to gate)."""
+    domains = {}
+    for labels, v in samples.match("goodput_seconds_total"):
+        d, b = labels.get("domain"), labels.get("bucket")
+        if d and b:
+            row = domains.setdefault(d, {"buckets": {}, "tokens": {}})
+            row["buckets"][b] = row["buckets"].get(b, 0.0) + v
+    for labels, v in samples.match("goodput_tokens_total"):
+        d, c = labels.get("domain"), labels.get("class")
+        if d and c:
+            row = domains.setdefault(d, {"buckets": {}, "tokens": {}})
+            row["tokens"][c] = row["tokens"].get(c, 0) + int(v)
+    for d, row in domains.items():
+        wall = sum(row["buckets"].values())
+        prod_buckets = productive_map.get(d, ())
+        productive = sum(row["buckets"].get(b, 0.0) for b in prod_buckets)
+        row["wall_s"] = round(wall, 6)
+        row["ratio"] = (round(productive / wall, 6)
+                        if prod_buckets and wall > 0 else None)
+    return domains
+
+
+def report_from_flight(path):
+    """Last `goodput_ledger` event per domain out of a flight-recorder
+    JSONL dump (a directory argument picks the newest flight_*.jsonl)."""
+    if os.path.isdir(path):
+        dumps = sorted(f for f in os.listdir(path)
+                       if f.startswith("flight_") and f.endswith(".jsonl"))
+        if not dumps:
+            raise FileNotFoundError(f"no flight_*.jsonl under {path}")
+        path = os.path.join(path, dumps[-1])
+    domains = {}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                evt = json.loads(line)
+            except ValueError:
+                continue  # a torn tail line must not kill the post-mortem
+            if evt.get("kind") != "goodput_ledger":
+                continue
+            d = evt.get("domain", "?")
+            domains[d] = {  # later events win: last ledger close per domain
+                "wall_s": evt.get("wall_s", 0.0),
+                "ratio": evt.get("ratio"),
+                "buckets": dict(evt.get("buckets") or {}),
+                "tokens": dict(evt.get("tokens") or {}),
+                "reason": evt.get("reason"),
+            }
+    return domains
+
+
+# ------------------------------------------------------------------ render
+def render_text(report, productive_map):
+    lines = []
+    for d in sorted(report):
+        row = report[d]
+        wall = row.get("wall_s") or sum(row["buckets"].values())
+        ratio = row.get("ratio")
+        head = f"domain {d}: wall {wall:.3f}s"
+        if ratio is not None:
+            head += f"  goodput {ratio * 100:.1f}%"
+        if row.get("reason"):
+            head += f"  (ledger close: {row['reason']})"
+        lines.append(head)
+        prod = set(productive_map.get(d, ()))
+        width = max((len(b) for b in row["buckets"]), default=6)
+        for b, v in sorted(row["buckets"].items(),
+                           key=lambda kv: -kv[1]):
+            share = v / wall * 100 if wall > 0 else 0.0
+            star = "*" if b in prod else " "
+            lines.append(f"  {b:<{width}}{star} {v:>10.3f}s  {share:5.1f}%")
+        toks = {c: n for c, n in row["tokens"].items() if n}
+        if toks:
+            useful = toks.get("useful", 0)
+            waste = sum(n for c, n in toks.items() if c != "useful")
+            eff = useful / (useful + waste) if useful + waste else 0.0
+            detail = " ".join(f"{c}={n}" for c, n in sorted(toks.items()))
+            lines.append(f"  tokens: {detail}  "
+                         f"(efficiency {eff * 100:.1f}%)")
+        lines.append("")
+    lines.append("(* = productive bucket: the goodput numerator)")
+    return "\n".join(lines)
+
+
+def gate(report, threshold):
+    """(exit_code, [degraded domain names]) for a report under
+    ``--threshold``: 1 = no data, 2 = a reporting domain is below the
+    threshold, 0 = healthy.  ``threshold=None`` only distinguishes
+    no-data from healthy."""
+    if not report:
+        return 1, []
+    if threshold is None:
+        return 0, []
+    degraded = sorted(d for d, row in report.items()
+                      if row.get("ratio") is not None
+                      and row["ratio"] < threshold)
+    return (2, degraded) if degraded else (0, [])
+
+
+def run(report, productive_map, threshold, as_json):
+    code, degraded = gate(report, threshold)
+    if code == 1:
+        print("goodput_report: no goodput_* data — nothing to attribute",
+              file=sys.stderr)
+        return 1
+    if as_json:
+        print(json.dumps({"domains": report, "degraded": degraded},
+                         default=repr, sort_keys=True))
+    else:
+        print(render_text(report, productive_map))
+    for d in degraded:
+        print(f"goodput_report: domain {d!r} goodput "
+              f"{report[d]['ratio']:.4f} below threshold {threshold}",
+              file=sys.stderr)
+    return code
+
+
+# ---------------------------------------------------------------- selftest
+#: Healthy corpus: train 94% in step, serve 90% productive.
+SELFTEST_HEALTHY = """\
+# TYPE goodput_seconds_total counter
+goodput_seconds_total{domain="train",bucket="step"} 94.0
+goodput_seconds_total{domain="train",bucket="compile"} 3.0
+goodput_seconds_total{domain="train",bucket="checkpoint_save"} 2.0
+goodput_seconds_total{domain="train",bucket="idle"} 1.0
+goodput_seconds_total{domain="serve",bucket="decode"} 80.0
+goodput_seconds_total{domain="serve",bucket="prefill"} 8.0
+goodput_seconds_total{domain="serve",bucket="verify"} 2.0
+goodput_seconds_total{domain="serve",bucket="idle"} 10.0
+# TYPE goodput_tokens_total counter
+goodput_tokens_total{domain="serve",class="useful"} 9000
+goodput_tokens_total{domain="serve",class="spec_rolled_back"} 100
+"""
+
+#: Degraded corpus: restarts and rollback waste eat the train clock.
+SELFTEST_DEGRADED = """\
+# TYPE goodput_seconds_total counter
+goodput_seconds_total{domain="train",bucket="step"} 30.0
+goodput_seconds_total{domain="train",bucket="restore"} 40.0
+goodput_seconds_total{domain="train",bucket="restart_backoff"} 20.0
+goodput_seconds_total{domain="train",bucket="idle"} 10.0
+goodput_seconds_total{domain="fleet",bucket="respawn"} 55.0
+"""
+
+SELFTEST_FLIGHT = """\
+{"flight_recorder":1,"reason":"selftest","events":2}
+{"seq":1,"kind":"goodput_ledger","domain":"train","reason":"run_end",\
+"wall_s":10.0,"ratio":0.9,"buckets":{"step":9.0,"idle":1.0},\
+"tokens":{"useful":0}}
+{"seq":2,"kind":"goodput_ledger","domain":"train","reason":"fatal",\
+"wall_s":20.0,"ratio":0.45,"buckets":{"step":9.0,"restore":9.0,\
+"idle":2.0},"tokens":{"useful":0}}
+"""
+
+
+def selftest():
+    goodput, scrape = _plane()
+    import tempfile
+
+    def _report(corpus):
+        ss = scrape.SampleSet().add_families(
+            scrape.parse_prometheus(corpus))
+        return build_report(ss, goodput.PRODUCTIVE)
+
+    healthy = _report(SELFTEST_HEALTHY)
+    assert healthy["train"]["ratio"] == 0.94, healthy["train"]
+    assert healthy["serve"]["ratio"] == 0.9, healthy["serve"]
+    assert healthy["train"]["wall_s"] == 100.0
+    assert healthy["serve"]["tokens"]["useful"] == 9000
+    assert gate(healthy, 0.5) == (0, [])
+    assert gate(healthy, None) == (0, [])
+
+    degraded = _report(SELFTEST_DEGRADED)
+    assert degraded["train"]["ratio"] == 0.3, degraded["train"]
+    # fleet has no productive buckets: reports, never gates
+    assert degraded["fleet"]["ratio"] is None
+    assert gate(degraded, 0.5) == (2, ["train"])
+    assert gate({}, 0.5) == (1, [])  # absent family = no-data, not healthy
+
+    with tempfile.TemporaryDirectory() as td:
+        p = os.path.join(td, "flight_selftest_0001_00000002.jsonl")
+        with open(p, "w") as f:
+            f.write(SELFTEST_FLIGHT)
+        for arg in (p, td):  # file and newest-in-directory forms
+            fl = report_from_flight(arg)
+            assert fl["train"]["ratio"] == 0.45, fl  # last event wins
+            assert fl["train"]["reason"] == "fatal"
+        assert gate(fl, 0.5) == (2, ["train"])
+
+    text = render_text(healthy, goodput.PRODUCTIVE)
+    assert "domain train" in text and "goodput 94.0%" in text
+    assert "step" in text and "efficiency" in text
+    print("goodput_report selftest: ok (healthy ratio 0.94, degraded "
+          "gate trips at 0.5, flight last-event-wins)")
+    return 0
+
+
+# -------------------------------------------------------------------- main
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("target", nargs="?", metavar="HOST:PORT",
+                    help="telemetry endpoint to scrape (/metrics)")
+    ap.add_argument("--flight", metavar="DUMP",
+                    help="render a flight-recorder dump (.jsonl or a "
+                         "directory of them) instead of scraping")
+    ap.add_argument("--threshold", type=float, default=None,
+                    help="exit 2 when any domain's goodput ratio is "
+                         "below this")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--selftest", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.selftest:
+        return selftest()
+    goodput, scrape = _plane()
+    if args.flight:
+        try:
+            report = report_from_flight(args.flight)
+        except (OSError, FileNotFoundError) as e:
+            print(f"goodput_report: {e}", file=sys.stderr)
+            return 1
+    elif args.target:
+        import urllib.request
+        url = (args.target if "//" in args.target
+               else f"http://{args.target}")
+        with urllib.request.urlopen(f"{url.rstrip('/')}/metrics",
+                                    timeout=args.timeout) as resp:
+            text = resp.read().decode("utf-8", "replace")
+        ss = scrape.SampleSet().add_families(scrape.parse_prometheus(text))
+        report = build_report(ss, goodput.PRODUCTIVE)
+    else:
+        ap.error("need HOST:PORT, --flight DUMP, or --selftest")
+    return run(report, goodput.PRODUCTIVE, args.threshold, args.as_json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

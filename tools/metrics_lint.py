@@ -110,6 +110,7 @@ def import_instrumented(repo_root=None):
     import paddle_tpu.inference.llm_server  # noqa: F401
     import paddle_tpu.inference.router  # noqa: F401
     import paddle_tpu.models.lora  # noqa: F401
+    import paddle_tpu.observability.goodput  # noqa: F401
     import paddle_tpu.observability.profiling  # noqa: F401
     import paddle_tpu.observability.roofline  # noqa: F401
     import paddle_tpu.observability.xplane  # noqa: F401
